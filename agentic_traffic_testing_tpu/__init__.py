@@ -1,0 +1,20 @@
+"""agentic_traffic_testing_tpu — TPU-native agentic-traffic testbed framework.
+
+Ground-up JAX/XLA/Pallas rebuild of the capabilities of the
+dlamagna/agentic-traffic-testing testbed: the GPU `llm-backend`
+(vLLM + CUDA paged attention + NCCL) is replaced by an in-tree TPU serving
+stack — paged-KV attention, continuous batching, tensor parallelism over ICI —
+behind the identical HTTP + Prometheus contract, so the agents, dashboards and
+experiment pipeline run unmodified.
+
+Package map:
+  models/    Llama-family model definitions (pure-functional JAX, scan-over-layers)
+  ops/       compute kernels: jnp reference ops + Pallas TPU kernels
+  runtime/   paged KV cache, block allocator, continuous-batching scheduler, engine
+  parallel/  device mesh, TP/SP shardings, ring attention, collectives
+  serving/   HTTP serving layer (aiohttp), Prometheus metrics, chat templating
+  training/  minimal sharded train step (used by multi-chip dry-run + finetuning)
+  utils/     tokenizers, env config, misc
+"""
+
+__version__ = "0.1.0"

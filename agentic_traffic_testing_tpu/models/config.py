@@ -1,0 +1,186 @@
+"""Model architecture configs for the Llama family (and Qwen2 variant).
+
+The reference testbed serves `meta-llama/Llama-3.2-3B-Instruct` (default),
+`meta-llama/Llama-3.1-8B-Instruct` and `Qwen/Qwen2.5-7B-Instruct` through vLLM
+(reference: infra/.env.example:117-123, llm/config/llama-3.1-8b.yaml:1-5).
+Here the architecture is first-party: one dataclass covers the dense
+decoder-only family (RMSNorm + RoPE + GQA + SwiGLU), with `qkv_bias` toggling
+the Qwen2 variant.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+from typing import Any, Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1-style frequency-dependent RoPE rescaling parameters.
+
+    Frozen (hashable) so ModelConfig can be a static jit argument.
+    """
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position_embeddings: int = 8192
+
+    def __getitem__(self, key: str):  # dict-style access for shared numerics code
+        return getattr(self, key)
+
+    @staticmethod
+    def from_dict(d: Optional[dict]) -> Optional["RopeScaling"]:
+        if d is None:
+            return None
+        if d.get("rope_type", d.get("type", "llama3")) != "llama3":
+            return None  # e.g. qwen default/dynamic — treated as unscaled
+        return RopeScaling(
+            factor=float(d.get("factor", 8.0)),
+            low_freq_factor=float(d.get("low_freq_factor", 1.0)),
+            high_freq_factor=float(d.get("high_freq_factor", 4.0)),
+            original_max_position_embeddings=int(d.get("original_max_position_embeddings", 8192)),
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyper-parameters for a dense decoder-only transformer."""
+
+    name: str = "tiny"
+    vocab_size: int = 262              # == ByteTokenizer.vocab_size (256 bytes + 6 specials)
+    hidden_size: int = 128
+    intermediate_size: int = 256
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: int = 2
+    head_dim: Optional[int] = None     # defaults to hidden_size // num_heads
+    rope_theta: float = 500000.0
+    rope_scaling: Optional[RopeScaling] = None
+    rms_norm_eps: float = 1e-5
+    max_position_embeddings: int = 8192
+    tie_word_embeddings: bool = False
+    qkv_bias: bool = False             # True for Qwen2.x
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+    def num_params(self) -> int:
+        """Approximate parameter count (embeddings + blocks + head)."""
+        d, hd = self.hidden_size, self.head_dim_
+        attn = d * (self.num_heads * hd) + 2 * d * (self.num_kv_heads * hd) + (self.num_heads * hd) * d
+        mlp = 3 * d * self.intermediate_size
+        norms = 2 * d
+        per_layer = attn + mlp + norms
+        emb = self.vocab_size * d
+        head = 0 if self.tie_word_embeddings else self.vocab_size * d
+        return emb + self.num_layers * per_layer + head + d
+
+    def kv_bytes_per_token(self, dtype_bytes: int = 2) -> int:
+        return 2 * self.num_layers * self.num_kv_heads * self.head_dim_ * dtype_bytes
+
+    @staticmethod
+    def from_hf_config(cfg: dict, name: str = "hf") -> "ModelConfig":
+        """Build from a HuggingFace `config.json` dict (offline-friendly)."""
+        return ModelConfig(
+            name=name,
+            vocab_size=cfg["vocab_size"],
+            hidden_size=cfg["hidden_size"],
+            intermediate_size=cfg["intermediate_size"],
+            num_layers=cfg["num_hidden_layers"],
+            num_heads=cfg["num_attention_heads"],
+            num_kv_heads=cfg.get("num_key_value_heads", cfg["num_attention_heads"]),
+            head_dim=cfg.get("head_dim"),
+            rope_theta=cfg.get("rope_theta", 10000.0),
+            rope_scaling=RopeScaling.from_dict(cfg.get("rope_scaling")),
+            rms_norm_eps=cfg.get("rms_norm_eps", 1e-5),
+            max_position_embeddings=cfg.get("max_position_embeddings", 8192),
+            tie_word_embeddings=cfg.get("tie_word_embeddings", False),
+            qkv_bias=cfg.get("model_type") == "qwen2",
+        )
+
+    @staticmethod
+    def from_local_dir(path: str, name: Optional[str] = None) -> "ModelConfig":
+        with open(os.path.join(path, "config.json")) as f:
+            cfg = json.load(f)
+        return ModelConfig.from_hf_config(cfg, name=name or os.path.basename(path.rstrip("/")))
+
+
+def _llama3_rope_scaling() -> RopeScaling:
+    return RopeScaling()
+
+
+# Architecture presets for the models the reference testbed configures
+# (reference: infra/.env.example:117-123). Shapes match the published HF configs.
+PRESETS: dict[str, ModelConfig] = {
+    "tiny": ModelConfig(),
+    "debug-512": ModelConfig(
+        name="debug-512", vocab_size=2048, hidden_size=512, intermediate_size=1536,
+        num_layers=4, num_heads=8, num_kv_heads=4, rope_theta=500000.0,
+    ),
+    "llama-3.2-1b": ModelConfig(
+        name="llama-3.2-1b", vocab_size=128256, hidden_size=2048, intermediate_size=8192,
+        num_layers=16, num_heads=32, num_kv_heads=8, head_dim=64, rope_theta=500000.0,
+        rope_scaling=_llama3_rope_scaling(), max_position_embeddings=131072,
+        tie_word_embeddings=True,
+    ),
+    "llama-3.2-3b": ModelConfig(
+        name="llama-3.2-3b", vocab_size=128256, hidden_size=3072, intermediate_size=8192,
+        num_layers=28, num_heads=24, num_kv_heads=8, head_dim=128, rope_theta=500000.0,
+        rope_scaling=_llama3_rope_scaling(), max_position_embeddings=131072,
+        tie_word_embeddings=True,
+    ),
+    "llama-3.1-8b": ModelConfig(
+        name="llama-3.1-8b", vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+        num_layers=32, num_heads=32, num_kv_heads=8, head_dim=128, rope_theta=500000.0,
+        rope_scaling=_llama3_rope_scaling(), max_position_embeddings=131072,
+    ),
+    "llama-3-70b": ModelConfig(
+        name="llama-3-70b", vocab_size=128256, hidden_size=8192, intermediate_size=28672,
+        num_layers=80, num_heads=64, num_kv_heads=8, head_dim=128, rope_theta=500000.0,
+        rope_scaling=_llama3_rope_scaling(), max_position_embeddings=131072,
+    ),
+    "qwen2.5-7b": ModelConfig(
+        name="qwen2.5-7b", vocab_size=152064, hidden_size=3584, intermediate_size=18944,
+        num_layers=28, num_heads=28, num_kv_heads=4, rope_theta=1000000.0,
+        max_position_embeddings=32768, qkv_bias=True,
+    ),
+}
+
+
+_HF_ALIASES = {
+    "meta-llama/llama-3.2-1b-instruct": "llama-3.2-1b",
+    "meta-llama/llama-3.2-3b-instruct": "llama-3.2-3b",
+    "meta-llama/llama-3.1-8b-instruct": "llama-3.1-8b",
+    "meta-llama/meta-llama-3-70b-instruct": "llama-3-70b",
+    "meta-llama/llama-3.3-70b-instruct": "llama-3-70b",
+    "qwen/qwen2.5-7b-instruct": "qwen2.5-7b",
+}
+
+
+def resolve_config(model: str) -> ModelConfig:
+    """Resolve a model name to a ModelConfig.
+
+    Accepts a preset key, a HF model id the testbed configures, or a local
+    directory containing `config.json` (the offline weight-loading path).
+    """
+    key = model.lower()
+    if key in PRESETS:
+        return PRESETS[key]
+    if key in _HF_ALIASES:
+        return PRESETS[_HF_ALIASES[key]]
+    if os.path.isdir(model):
+        return ModelConfig.from_local_dir(model)
+    raise ValueError(
+        f"unknown model {model!r}: not a preset ({sorted(PRESETS)}), "
+        f"known HF id, or local directory with config.json"
+    )

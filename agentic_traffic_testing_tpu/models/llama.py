@@ -1,0 +1,248 @@
+"""Llama-family decoder in pure-functional JAX, built for TPU serving.
+
+Design (TPU-first, not a port):
+  * Layer weights are *stacked* along a leading [L, ...] axis and the decoder
+    runs as a single `lax.scan` over layers — one compiled layer body instead
+    of L inlined copies, keeping compile time flat from the 1B configs to the
+    80-layer 70B config.
+  * The paged KV cache rides in the scan carry as full [L, ...] arrays,
+    updated per-layer with `dynamic_update_index_in_dim`; with buffer donation
+    XLA performs the update in place in HBM.
+  * Three entry points share one layer body:
+      - `forward_full`:  causal LM forward, no cache (training / golden tests)
+      - `prefill`:       prompt pass that scatter-writes KV into block tables
+      - `decode_step`:   one-token step reading KV through block tables
+  * All are shape-static and jit/pjit-friendly; batch and length padding is
+    the scheduler's job (`runtime/scheduler.py` buckets shapes).
+
+Behavioral parity target: the model families the reference testbed serves via
+vLLM (reference: infra/.env.example:117-123; llm/config/llama-3.1-8b.yaml).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.models.config import ModelConfig
+from agentic_traffic_testing_tpu.ops.jnp_ops import (
+    apply_rope,
+    causal_attention,
+    rms_norm,
+    rope_sin_cos,
+    swiglu,
+)
+from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
+from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
+
+Params = dict  # nested dict pytree; see `init_params` for the schema
+
+
+def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
+    """Random-init parameters (normal, std 0.02), HF-compatible schema.
+
+    Schema (stacked over layers, L leading):
+      tok_embed  [V, D]
+      layers:
+        ln_attn [L, D]; ln_mlp [L, D]
+        wq [L, D, H*hd]; wk [L, D, KH*hd]; wv [L, D, KH*hd]; wo [L, H*hd, D]
+        (bq/bk/bv [L, ...] when cfg.qkv_bias — the Qwen2 variant)
+        w_gate [L, D, F]; w_up [L, D, F]; w_down [L, F, D]
+      final_norm [D]
+      lm_head    [V, D]  (absent when cfg.tie_word_embeddings)
+    """
+    d, hd, f = cfg.hidden_size, cfg.head_dim_, cfg.intermediate_size
+    h, kh, L, v = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers, cfg.vocab_size
+    keys = iter(jax.random.split(key, 16))
+
+    def w(k, shape):
+        return (jax.random.normal(k, shape, jnp.float32) * 0.02).astype(dtype)
+
+    layers = {
+        "ln_attn": jnp.ones((L, d), dtype),
+        "ln_mlp": jnp.ones((L, d), dtype),
+        "wq": w(next(keys), (L, d, h * hd)),
+        "wk": w(next(keys), (L, d, kh * hd)),
+        "wv": w(next(keys), (L, d, kh * hd)),
+        "wo": w(next(keys), (L, h * hd, d)),
+        "w_gate": w(next(keys), (L, d, f)),
+        "w_up": w(next(keys), (L, d, f)),
+        "w_down": w(next(keys), (L, f, d)),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, h * hd), dtype)
+        layers["bk"] = jnp.zeros((L, kh * hd), dtype)
+        layers["bv"] = jnp.zeros((L, kh * hd), dtype)
+    params: Params = {
+        "tok_embed": w(next(keys), (v, d)),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), (v, d))
+    return params
+
+
+def _qkv(x: jax.Array, lp: dict, cfg: ModelConfig):
+    """Project hidden states to q/k/v heads. x: [B, T, D]."""
+    b, t, _ = x.shape
+    h, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
+    q = x @ lp["wq"]
+    k = x @ lp["wk"]
+    v = x @ lp["wv"]
+    if cfg.qkv_bias:
+        q = q + lp["bq"]
+        k = k + lp["bk"]
+        v = v + lp["bv"]
+    return (
+        q.reshape(b, t, h, hd),
+        k.reshape(b, t, kh, hd),
+        v.reshape(b, t, kh, hd),
+    )
+
+
+def _mlp_block(x: jax.Array, lp: dict) -> jax.Array:
+    return swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+
+
+def _unembed(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
+    head = params["tok_embed"] if cfg.tie_word_embeddings else params["lm_head"]
+    return (x @ head.T).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Full forward (no cache): training and golden-logit tests
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cfg",))
+def forward_full(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                 positions: Optional[jax.Array] = None) -> jax.Array:
+    """Causal LM forward. tokens [B, T] -> logits [B, T, V] (fp32)."""
+    b, t = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    x = params["tok_embed"][tokens]
+    sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    seq_lens = jnp.full((b,), t, jnp.int32)
+
+    def body(x, lp):
+        xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
+        q, k, v = _qkv(xa, lp, cfg)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        attn = causal_attention(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
+        x = x + attn.reshape(b, t, -1) @ lp["wo"]
+        xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
+        x = x + _mlp_block(xm, lp)
+        return x, None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return _unembed(x, params, cfg)
+
+
+# ---------------------------------------------------------------------------
+# Prefill: prompt pass that populates the paged KV cache
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [B, T] padded; T % block_size == 0
+    cache: KVCache,           # donated
+    block_tables: jax.Array,  # [B, max_blocks] (padding rows -> TRASH_BLOCK)
+    seq_lens: jax.Array,      # [B] true prompt lengths
+) -> tuple[jax.Array, KVCache]:
+    """Returns (last-token logits [B, V] fp32, updated cache)."""
+    b, t = tokens.shape
+    if t % cache.block_size != 0:  # trace-time check: unaligned tails would be dropped
+        raise ValueError(f"prefill length {t} not a multiple of block_size {cache.block_size}")
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    x = params["tok_embed"][tokens]
+    sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+
+    def body(carry, xs):
+        x, kc, vc = carry
+        lp, li = xs
+        xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
+        q, k, v = _qkv(xa, lp, cfg)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        kc_l = kvc.write_prompt_kv(jax.lax.dynamic_index_in_dim(kc, li, 0, keepdims=False), k, block_tables)
+        vc_l = kvc.write_prompt_kv(jax.lax.dynamic_index_in_dim(vc, li, 0, keepdims=False), v, block_tables)
+        kc = jax.lax.dynamic_update_index_in_dim(kc, kc_l, li, 0)
+        vc = jax.lax.dynamic_update_index_in_dim(vc, vc_l, li, 0)
+        attn = causal_attention(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
+        x = x + attn.reshape(b, t, -1) @ lp["wo"]
+        xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
+        x = x + _mlp_block(xm, lp)
+        return (x, kc, vc), None
+
+    (x, kc, vc), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.take_along_axis(x, jnp.maximum(seq_lens - 1, 0)[:, None, None], axis=1)[:, 0]
+    return _unembed(last[:, None, :], params, cfg)[:, 0], KVCache(kc, vc)
+
+
+# ---------------------------------------------------------------------------
+# Decode: one token per sequence through the block tables
+# ---------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [B] current input token per sequence
+    cache: KVCache,           # donated
+    block_tables: jax.Array,  # [B, max_blocks]
+    positions: jax.Array,     # [B] position of `tokens` (== context_len so far)
+) -> tuple[jax.Array, KVCache]:
+    """Returns (next-token logits [B, V] fp32, updated cache).
+
+    Inactive batch lanes must have block_tables rows = TRASH_BLOCK and
+    position 0; their logits are garbage and ignored by the scheduler.
+    """
+    b = tokens.shape[0]
+    x = params["tok_embed"][tokens][:, None, :]  # [B, 1, D]
+    sin, cos = rope_sin_cos(positions[:, None], cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    ctx_lens = positions + 1
+
+    def body(carry, xs):
+        x, kc, vc = carry
+        lp, li = xs
+        xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
+        q, k, v = _qkv(xa, lp, cfg)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        kc_l = kvc.write_decode_kv(jax.lax.dynamic_index_in_dim(kc, li, 0, keepdims=False), k[:, 0], block_tables, positions)
+        vc_l = kvc.write_decode_kv(jax.lax.dynamic_index_in_dim(vc, li, 0, keepdims=False), v[:, 0], block_tables, positions)
+        kc = jax.lax.dynamic_update_index_in_dim(kc, kc_l, li, 0)
+        vc = jax.lax.dynamic_update_index_in_dim(vc, vc_l, li, 0)
+        # Paged attention (gather reference path; Pallas kernel swaps in on TPU).
+        k_all = kvc.gather_kv(kc_l, block_tables)
+        v_all = kvc.gather_kv(vc_l, block_tables)
+        attn = causal_attention(
+            q, k_all, v_all, q_positions=positions[:, None], kv_valid_len=ctx_lens
+        )
+        x = x + attn.reshape(b, 1, -1) @ lp["wo"]
+        xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
+        x = x + _mlp_block(xm, lp)
+        return (x, kc, vc), None
+
+    (x, kc, vc), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return _unembed(x, params, cfg)[:, 0], KVCache(kc, vc)

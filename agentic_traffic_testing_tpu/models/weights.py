@@ -1,0 +1,172 @@
+"""Weight loading: HF checkpoints -> stacked functional params.
+
+Two paths:
+  * `params_from_hf_state_dict` — in-memory conversion (golden tests convert a
+    locally-built tiny `transformers` model and diff logits).
+  * `load_params` — offline loader for a local HF model directory with
+    `*.safetensors` shards. The safetensors container is parsed directly
+    (8-byte header-length, JSON index, raw little-endian data) with numpy +
+    ml_dtypes — no torch in the serving path, no network.
+
+This replaces the reference's reliance on vLLM's internal HF weight loading
+(the reference never loads weights itself; vLLM does — reference:
+llm/serve_llm.py:343-402). Sharding of loaded params onto a TP mesh happens
+downstream in `parallel/sharding.py`.
+"""
+
+from __future__ import annotations
+
+import json
+import mmap
+import os
+import struct
+from typing import Callable, Iterator
+
+import jax.numpy as jnp
+import ml_dtypes
+import numpy as np
+
+from agentic_traffic_testing_tpu.models.config import ModelConfig
+
+_ST_DTYPES = {
+    "F64": np.float64,
+    "F32": np.float32,
+    "F16": np.float16,
+    "BF16": ml_dtypes.bfloat16,
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+def iter_safetensors(path: str) -> Iterator[tuple[str, np.ndarray]]:
+    """Yield (name, array) from one .safetensors file, zero-copy via mmap."""
+    with open(path, "rb") as f:
+        header_len = struct.unpack("<Q", f.read(8))[0]
+        header = json.loads(f.read(header_len))
+        mm = mmap.mmap(f.fileno(), 0, access=mmap.ACCESS_READ)
+        base = 8 + header_len
+        for name, info in header.items():
+            if name == "__metadata__":
+                continue
+            start, end = info["data_offsets"]
+            arr = np.frombuffer(
+                mm, dtype=_ST_DTYPES[info["dtype"]], count=int(np.prod(info["shape"], dtype=np.int64)) if info["shape"] else 1,
+                offset=base + start,
+            ).reshape(info["shape"])
+            yield name, arr
+
+
+def _hf_tensor_plan(cfg: ModelConfig) -> dict[str, tuple]:
+    """Map HF tensor name -> (dest, layer_idx, transpose?) for every tensor."""
+    plan: dict[str, tuple] = {
+        "model.embed_tokens.weight": (("tok_embed",), None, False),
+        "model.norm.weight": (("final_norm",), None, False),
+    }
+    if not cfg.tie_word_embeddings:
+        plan["lm_head.weight"] = (("lm_head",), None, False)
+    for i in range(cfg.num_layers):
+        p = f"model.layers.{i}."
+        plan[p + "input_layernorm.weight"] = (("layers", "ln_attn"), i, False)
+        plan[p + "post_attention_layernorm.weight"] = (("layers", "ln_mlp"), i, False)
+        plan[p + "self_attn.q_proj.weight"] = (("layers", "wq"), i, True)
+        plan[p + "self_attn.k_proj.weight"] = (("layers", "wk"), i, True)
+        plan[p + "self_attn.v_proj.weight"] = (("layers", "wv"), i, True)
+        plan[p + "self_attn.o_proj.weight"] = (("layers", "wo"), i, True)
+        plan[p + "mlp.gate_proj.weight"] = (("layers", "w_gate"), i, True)
+        plan[p + "mlp.up_proj.weight"] = (("layers", "w_up"), i, True)
+        plan[p + "mlp.down_proj.weight"] = (("layers", "w_down"), i, True)
+        if cfg.qkv_bias:
+            plan[p + "self_attn.q_proj.bias"] = (("layers", "bq"), i, False)
+            plan[p + "self_attn.k_proj.bias"] = (("layers", "bk"), i, False)
+            plan[p + "self_attn.v_proj.bias"] = (("layers", "bv"), i, False)
+    return plan
+
+
+def _alloc_stacked(cfg: ModelConfig, dtype) -> dict:
+    """Allocate numpy buffers matching `llama.init_params` schema."""
+    d, hd, f = cfg.hidden_size, cfg.head_dim_, cfg.intermediate_size
+    h, kh, L, v = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers, cfg.vocab_size
+    layers = {
+        "ln_attn": np.empty((L, d), dtype),
+        "ln_mlp": np.empty((L, d), dtype),
+        "wq": np.empty((L, d, h * hd), dtype),
+        "wk": np.empty((L, d, kh * hd), dtype),
+        "wv": np.empty((L, d, kh * hd), dtype),
+        "wo": np.empty((L, h * hd, d), dtype),
+        "w_gate": np.empty((L, d, f), dtype),
+        "w_up": np.empty((L, d, f), dtype),
+        "w_down": np.empty((L, f, d), dtype),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = np.empty((L, h * hd), dtype)
+        layers["bk"] = np.empty((L, kh * hd), dtype)
+        layers["bv"] = np.empty((L, kh * hd), dtype)
+    out = {
+        "tok_embed": np.empty((v, d), dtype),
+        "layers": layers,
+        "final_norm": np.empty((d,), dtype),
+    }
+    if not cfg.tie_word_embeddings:
+        out["lm_head"] = np.empty((v, d), dtype)
+    return out
+
+
+def _fill(params: dict, plan: dict, name: str, arr: np.ndarray, dtype) -> bool:
+    if name not in plan:
+        return False
+    dest, layer, transpose = plan[name]
+    a = arr.T if transpose else arr
+    tgt = params
+    for k in dest[:-1]:
+        tgt = tgt[k]
+    if layer is None:
+        tgt[dest[-1]][...] = a.astype(dtype)
+    else:
+        tgt[dest[-1]][layer] = a.astype(dtype)
+    return True
+
+
+def params_from_hf_state_dict(cfg: ModelConfig, state_dict: dict, dtype=np.float32) -> dict:
+    """Convert an HF state dict (numpy arrays) to stacked jax params."""
+    plan = _hf_tensor_plan(cfg)
+    params = _alloc_stacked(cfg, dtype)
+    seen = set()
+    for name, arr in state_dict.items():
+        if _fill(params, plan, name, np.asarray(arr), dtype):
+            seen.add(name)
+    missing = set(plan) - seen
+    if missing:
+        raise ValueError(f"missing tensors for {cfg.name}: {sorted(missing)[:8]}...")
+    return _to_jax(params)
+
+
+def load_params(model_dir: str, cfg: ModelConfig | None = None, dtype=jnp.bfloat16) -> tuple[ModelConfig, dict]:
+    """Load params from a local HF directory of safetensors shards."""
+    cfg = cfg or ModelConfig.from_local_dir(model_dir)
+    np_dtype = ml_dtypes.bfloat16 if dtype == jnp.bfloat16 else np.dtype(dtype)
+    plan = _hf_tensor_plan(cfg)
+    params = _alloc_stacked(cfg, np_dtype)
+    seen: set[str] = set()
+    shards = sorted(
+        os.path.join(model_dir, f) for f in os.listdir(model_dir) if f.endswith(".safetensors")
+    )
+    if not shards:
+        raise FileNotFoundError(f"no .safetensors shards under {model_dir}")
+    for shard in shards:
+        for name, arr in iter_safetensors(shard):
+            if _fill(params, plan, name, arr, np_dtype):
+                seen.add(name)
+    missing = set(plan) - seen
+    if missing:
+        raise ValueError(f"checkpoint incomplete: missing {sorted(missing)[:8]}...")
+    return cfg, _to_jax(params)
+
+
+def _to_jax(tree):
+    if isinstance(tree, dict):
+        return {k: _to_jax(v) for k, v in tree.items()}
+    return jnp.asarray(tree)

@@ -1,0 +1,15 @@
+"""Compute kernels.
+
+`jnp_ops` is the portable reference implementation (runs on CPU/TPU, used for
+tests and as the correctness oracle). `pallas/` holds hand-written TPU kernels
+for the hot paths (paged-attention decode, fused RMSNorm); `dispatch` picks the
+best available implementation per platform at runtime.
+"""
+
+from agentic_traffic_testing_tpu.ops.jnp_ops import (  # noqa: F401
+    apply_rope,
+    causal_attention,
+    rms_norm,
+    rope_sin_cos,
+    swiglu,
+)

@@ -1,0 +1,142 @@
+"""Reference ops in pure jax.numpy.
+
+These define the numerics the Pallas kernels must reproduce (the vLLM analog
+is the CUDA kernel set the reference testbed relies on via its `vllm` import —
+reference: llm/serve_llm.py:22-34 — which is out-of-tree there; here the ops
+are first-party).
+
+Conventions:
+  x        activations [..., D]
+  q        [B, T, H, hd]
+  k, v     [B, T, KH, hd]   (GQA: H = KH * q_per_kv)
+  All ops accumulate in float32 and cast back to the input dtype, matching
+  standard HF/vLLM numerics for bf16 serving.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
+    """RMSNorm: x / rms(x) * weight, computed in fp32 (HF LlamaRMSNorm numerics)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    y = x32 * jax.lax.rsqrt(var + eps)
+    # HF casts the normalized activations down first, then multiplies the
+    # weight in the activation dtype — order matters for bf16 parity.
+    return y.astype(dtype) * weight.astype(dtype)
+
+
+def _llama3_scale_inv_freq(inv_freq: jnp.ndarray, scaling: dict) -> jnp.ndarray:
+    """Llama-3.1 frequency-dependent RoPE rescaling (matches HF rope_utils)."""
+    factor = scaling["factor"]
+    low_freq_factor = scaling["low_freq_factor"]
+    high_freq_factor = scaling["high_freq_factor"]
+    original = scaling["original_max_position_embeddings"]
+
+    low_freq_wavelen = original / low_freq_factor
+    high_freq_wavelen = original / high_freq_factor
+    wavelen = 2.0 * math.pi / inv_freq
+
+    smooth = (original / wavelen - low_freq_factor) / (high_freq_factor - low_freq_factor)
+    smoothed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    out = jnp.where(wavelen > low_freq_wavelen, inv_freq / factor, inv_freq)
+    is_medium = (wavelen <= low_freq_wavelen) & (wavelen >= high_freq_wavelen)
+    return jnp.where(is_medium, smoothed, out)
+
+
+def rope_sin_cos(
+    positions: jax.Array,
+    head_dim: int,
+    theta: float,
+    scaling: Optional[dict] = None,
+) -> tuple[jax.Array, jax.Array]:
+    """sin/cos tables for rotary embedding.
+
+    positions: int array [...]; returns (sin, cos) of shape [..., head_dim]
+    in float32, NeoX/HF layout (frequencies duplicated over both halves).
+    """
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    if scaling is not None:
+        inv_freq = _llama3_scale_inv_freq(inv_freq, scaling)
+    freqs = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., hd/2]
+    emb = jnp.concatenate([freqs, freqs], axis=-1)               # [..., hd]
+    return jnp.sin(emb), jnp.cos(emb)
+
+
+def _rotate_half(x: jax.Array) -> jax.Array:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(x: jax.Array, sin: jax.Array, cos: jax.Array) -> jax.Array:
+    """Apply rotary embedding. x: [B, T, H, hd]; sin/cos: [B, T, hd] (fp32)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    sin = sin[..., None, :]  # broadcast over heads
+    cos = cos[..., None, :]
+    return (x32 * cos + _rotate_half(x32) * sin).astype(dtype)
+
+
+def repeat_kv(x: jax.Array, q_per_kv: int) -> jax.Array:
+    """[B, T, KH, hd] -> [B, T, KH*q_per_kv, hd] by head repetition (GQA)."""
+    if q_per_kv == 1:
+        return x
+    b, t, kh, hd = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, t, kh, q_per_kv, hd)).reshape(b, t, kh * q_per_kv, hd)
+
+
+def causal_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    q_positions: jax.Array,
+    kv_valid_len: jax.Array,
+    kv_positions: Optional[jax.Array] = None,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Masked causal attention with GQA, fp32 softmax.
+
+    q            [B, Tq, H, hd]
+    k, v         [B, Tk, KH, hd]
+    q_positions  [B, Tq] absolute position of each query token
+    kv_valid_len [B]     number of valid kv slots (padding beyond is masked)
+    kv_positions [B, Tk] absolute position of each kv slot (defaults to arange)
+    Returns [B, Tq, H, hd].
+
+    The mask admits kv j for query i iff  pos(j) <= pos(i)  and  j < valid_len.
+    This one signature covers full prefill (Tq == Tk) and single-token decode
+    (Tq == 1, Tk == padded cache length).
+    """
+    b, tq, h, hd = q.shape
+    kh = k.shape[2]
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+    k = repeat_kv(k, h // kh)
+    v = repeat_kv(v, h // kh)
+
+    if kv_positions is None:
+        kv_positions = jnp.broadcast_to(jnp.arange(k.shape[1], dtype=jnp.int32)[None, :], (b, k.shape[1]))
+
+    qf = q.astype(jnp.float32) * scale
+    logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
+    causal = kv_positions[:, None, None, :] <= q_positions[:, None, :, None]      # [B,1,Tq,Tk]
+    valid = jnp.arange(k.shape[1], dtype=jnp.int32)[None, None, None, :] < kv_valid_len[:, None, None, None]
+    logits = jnp.where(causal & valid, logits, jnp.float32(-1e30))
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
+    """SwiGLU MLP: down( silu(x@gate) * (x@up) ). Matmuls stay in activation dtype
+    so XLA maps them to the MXU in bf16."""
+    g = jax.nn.silu(x @ w_gate)
+    return (g * (x @ w_up)) @ w_down

@@ -1,0 +1,70 @@
+"""Batched token sampling: greedy / temperature / top-k / top-p.
+
+The reference backend defaults to near-greedy sampling (temperature 0.2,
+reference: llm/serve_llm.py:379,522) and lets each request override
+`temperature`/`max_tokens`. Here sampling is a single jitted function over the
+whole continuous batch, with *per-row* parameters and per-row PRNG keys so
+each request is independently seeded and reproducible regardless of which
+batch lanes it shares a step with.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = jnp.float32(-1e30)
+
+
+def _apply_top_k(logits: jax.Array, top_k: jax.Array) -> jax.Array:
+    """Mask logits below the per-row k-th largest. top_k<=0 disables."""
+    v = logits.shape[-1]
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    k = jnp.clip(top_k, 1, v)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)
+    keep = (logits >= kth) | (top_k[:, None] <= 0)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+def _apply_top_p(logits: jax.Array, top_p: jax.Array) -> jax.Array:
+    """Nucleus filtering per row. top_p>=1 disables."""
+    order = jnp.argsort(-logits, axis=-1)
+    sorted_logits = jnp.take_along_axis(logits, order, axis=-1)
+    probs = jax.nn.softmax(sorted_logits, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # Keep tokens whose cumulative prob *before* them is < p (always >=1 token).
+    keep_sorted = (cum - probs) < top_p[:, None]
+    keep = jnp.zeros_like(keep_sorted).at[
+        jnp.arange(logits.shape[0])[:, None], order
+    ].set(keep_sorted)
+    return jnp.where(keep, logits, NEG_INF)
+
+
+@jax.jit
+def sample(
+    logits: jax.Array,       # [B, V] fp32
+    keys: jax.Array,         # [B] uint32 pairs -> jax PRNG keys, per row
+    temperature: jax.Array,  # [B] fp32; <= 0 means greedy
+    top_k: jax.Array,        # [B] int32; <= 0 disables
+    top_p: jax.Array,        # [B] fp32; >= 1 disables
+) -> jax.Array:
+    """Sample one token per row. Greedy rows ignore the PRNG entirely."""
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    temp = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logits / temp[:, None]
+    scaled = _apply_top_k(scaled, top_k)
+    scaled = _apply_top_p(scaled, top_p)
+    # Gumbel-max with per-row keys => per-request reproducibility inside any batch.
+    gumbel = jax.vmap(lambda k: jax.random.gumbel(k, (logits.shape[-1],), jnp.float32))(keys)
+    sampled_tok = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+
+    return jnp.where(temperature > 0, sampled_tok, greedy_tok)
+
+
+def make_row_keys(seeds: jax.Array, steps: jax.Array) -> jax.Array:
+    """Derive per-row PRNG keys from (request_seed, decode_step) pairs."""
+    base = jax.vmap(jax.random.key)(seeds.astype(jnp.uint32))
+    return jax.vmap(jax.random.fold_in)(base, steps.astype(jnp.uint32))

@@ -1,0 +1,143 @@
+"""Paged KV cache: block-table layout + functional read/write ops.
+
+TPU-native analog of vLLM's block KV-cache manager, whose accounting the
+reference testbed reads and re-exports as Prometheus gauges
+(reference: llm/serve_llm.py:245-264, 410-502 and gauge defs :142-162).
+
+Layout (per model):
+    k_cache, v_cache : [L, num_blocks, block_size, KH, hd]
+    block_tables     : [max_seqs, max_blocks_per_seq] int32
+    context_lens     : [max_seqs] int32
+
+Block 0 is reserved as a *trash block*: padding rows of every block table point
+at it, so scatter-writes from padded lanes land harmlessly and reads from it
+are always masked out by `kv_valid_len`. Usable capacity is therefore
+`(num_blocks - 1) * block_size` tokens; the exported `llm_kv_cache_*` gauges
+report usable numbers.
+
+All functions here are pure and shape-static — they are called from inside
+jitted prefill/decode steps. Allocation policy (which blocks belong to which
+sequence) lives host-side in `block_allocator.py`.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.models.config import ModelConfig
+
+TRASH_BLOCK = 0
+
+
+class KVCache(NamedTuple):
+    """Stacked per-layer paged KV storage (a pytree; lives in HBM)."""
+
+    k: jax.Array  # [L, num_blocks, block_size, KH, hd]
+    v: jax.Array  # [L, num_blocks, block_size, KH, hd]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k.shape[1]
+
+    @property
+    def block_size(self) -> int:
+        return self.k.shape[2]
+
+    @property
+    def usable_tokens(self) -> int:
+        return (self.num_blocks - 1) * self.block_size
+
+
+def make_kv_cache(
+    cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
+) -> KVCache:
+    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim_)
+    return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def write_prompt_kv(
+    cache_l: jax.Array,
+    new: jax.Array,
+    block_tables: jax.Array,
+) -> jax.Array:
+    """Scatter a padded prompt's K (or V) into one layer's block pool.
+
+    cache_l      [num_blocks, bs, KH, hd]
+    new          [B, T, KH, hd] with T % bs == 0 (caller pads)
+    block_tables [B, max_blocks]; entries beyond each prompt's blocks = TRASH_BLOCK
+    """
+    nb_cache, bs, kh, hd = cache_l.shape
+    b, t, _, _ = new.shape
+    nb = t // bs
+    blocks = new.reshape(b * nb, bs, kh, hd)
+    idx = block_tables[:, :nb].reshape(b * nb)
+    # Duplicate trash-block indices race among themselves only; real blocks are unique.
+    return cache_l.at[idx].set(blocks, mode="drop", unique_indices=False)
+
+
+def write_decode_kv(
+    cache_l: jax.Array,
+    new: jax.Array,
+    block_tables: jax.Array,
+    positions: jax.Array,
+) -> jax.Array:
+    """Write one token per sequence into one layer's block pool.
+
+    cache_l      [num_blocks, bs, KH, hd]
+    new          [B, KH, hd]
+    block_tables [B, max_blocks]
+    positions    [B] absolute position being written (trash rows may point anywhere;
+                 caller sets their block table rows to TRASH_BLOCK)
+    """
+    nb_cache, bs, kh, hd = cache_l.shape
+    b = new.shape[0]
+    block_idx = jnp.take_along_axis(block_tables, (positions // bs)[:, None], axis=1)[:, 0]
+    flat_idx = block_idx * bs + positions % bs  # [B] into [(num_blocks*bs), KH, hd]
+    flat = cache_l.reshape(nb_cache * bs, kh, hd)
+    flat = flat.at[flat_idx].set(new, mode="drop")
+    return flat.reshape(nb_cache, bs, kh, hd)
+
+
+def gather_kv(cache_l: jax.Array, block_tables: jax.Array) -> jax.Array:
+    """Materialize each sequence's KV from one layer's pool (jnp reference path).
+
+    cache_l      [num_blocks, bs, KH, hd]
+    block_tables [B, max_blocks]
+    returns      [B, max_blocks*bs, KH, hd]
+
+    The Pallas paged-attention kernel replaces this gather on TPU; this path is
+    the correctness oracle and the CPU/test fallback.
+    """
+    nb_cache, bs, kh, hd = cache_l.shape
+    b, max_blocks = block_tables.shape
+    gathered = cache_l[block_tables.reshape(-1)]  # [B*max_blocks, bs, KH, hd]
+    return gathered.reshape(b, max_blocks * bs, kh, hd)
+
+
+def kv_cache_bytes(cfg: ModelConfig, num_blocks: int, block_size: int, dtype_bytes: int = 2) -> int:
+    return 2 * cfg.num_layers * num_blocks * block_size * cfg.num_kv_heads * cfg.head_dim_ * dtype_bytes
+
+
+def profile_num_blocks(
+    cfg: ModelConfig,
+    block_size: int,
+    hbm_bytes_free: int,
+    memory_utilization: float,
+    dtype_bytes: int = 2,
+    tp_size: int = 1,
+) -> int:
+    """Derive the block budget from free HBM, vLLM-profiling style.
+
+    The reference reads `num_gpu_blocks` off vLLM's cache config after its
+    profiling pass (reference: llm/serve_llm.py:245-264); here the equivalent
+    computation is explicit: blocks = utilization * free_hbm / bytes_per_block.
+    With tensor parallelism each chip holds KH/tp heads, so per-chip block
+    bytes shrink accordingly (min 1 head group).
+    """
+    kh_local = max(1, cfg.num_kv_heads // tp_size)
+    per_block = 2 * cfg.num_layers * block_size * kh_local * cfg.head_dim_ * dtype_bytes
+    budget = int(hbm_bytes_free * memory_utilization)
+    return max(0, budget // per_block)

@@ -1,0 +1,167 @@
+"""Tokenizers: HF-backed when weights/tokenizer files exist locally, byte-level
+fallback otherwise.
+
+The reference obtains its tokenizer from vLLM's engine
+(reference: llm/serve_llm.py:32-34, 614-622) and needs it for (a) chat
+templating, (b) token counting, (c) the token-level prompt-truncation
+guardrail (:812-844). All three work against this interface. The byte
+fallback makes the whole stack runnable in CI with no model assets — the
+analog of the reference's CPU fallback path (llm/hf_cpu_server.py).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Protocol
+
+
+class Tokenizer(Protocol):
+    vocab_size: int
+    bos_id: Optional[int]
+    eos_ids: tuple[int, ...]
+    pad_id: int
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]: ...
+    def decode(self, ids: list[int]) -> str: ...
+
+
+class ByteTokenizer:
+    """UTF-8 byte tokenizer with Llama-3-style special tokens.
+
+    ids 0..255 = raw bytes; specials above. Special-token *strings* (e.g.
+    "<|eot_id|>") are recognized in input text so Llama-3 chat-template
+    strings round-trip to single tokens, mirroring real tokenizer behavior.
+    """
+
+    SPECIALS = (
+        "<|begin_of_text|>",
+        "<|end_of_text|>",
+        "<|start_header_id|>",
+        "<|end_header_id|>",
+        "<|eot_id|>",
+        "<|pad|>",
+    )
+
+    def __init__(self) -> None:
+        self._special_ids = {s: 256 + i for i, s in enumerate(self.SPECIALS)}
+        self.vocab_size = 256 + len(self.SPECIALS)
+        self.bos_id = self._special_ids["<|begin_of_text|>"]
+        self.eos_ids = (
+            self._special_ids["<|end_of_text|>"],
+            self._special_ids["<|eot_id|>"],
+        )
+        self.pad_id = self._special_ids["<|pad|>"]
+        self.name = "byte-fallback"
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids: list[int] = [self.bos_id] if add_bos else []
+        i = 0
+        while i < len(text):
+            matched = False
+            if text[i] == "<":
+                for s, sid in self._special_ids.items():
+                    if text.startswith(s, i):
+                        ids.append(sid)
+                        i += len(s)
+                        matched = True
+                        break
+            if not matched:
+                ids.extend(text[i].encode("utf-8"))
+                i += 1
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        out: list[str] = []
+        buf = bytearray()
+        rev = {v: k for k, v in self._special_ids.items()}
+        for t in ids:
+            t = int(t)
+            if t < 256:
+                buf.append(t)
+            else:
+                if buf:
+                    out.append(buf.decode("utf-8", errors="replace"))
+                    buf.clear()
+                if t in rev and rev[t] not in ("<|pad|>",):
+                    out.append(rev[t])
+        if buf:
+            out.append(buf.decode("utf-8", errors="replace"))
+        return "".join(out)
+
+
+class HFTokenizer:
+    """Wrapper over a local HuggingFace tokenizer directory (offline)."""
+
+    def __init__(self, path: str) -> None:
+        from transformers import AutoTokenizer  # lazy; heavy import
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.vocab_size = len(self._tok)
+        self.bos_id = self._tok.bos_token_id
+        eos = self._tok.eos_token_id
+        eos_ids = [eos] if eos is not None else []
+        # Llama-3 instruct ends turns with <|eot_id|>, distinct from eos.
+        eot = self._tok.convert_tokens_to_ids("<|eot_id|>")
+        if isinstance(eot, int) and eot >= 0 and eot not in eos_ids:
+            eos_ids.append(eot)
+        self.eos_ids = tuple(eos_ids)
+        self.pad_id = self._tok.pad_token_id if self._tok.pad_token_id is not None else (eos or 0)
+        self.name = getattr(self._tok, "name_or_path", path)
+
+    def encode(self, text: str, add_bos: bool = False) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: list[dict]) -> Optional[str]:
+        try:
+            return self._tok.apply_chat_template(messages, tokenize=False, add_generation_prompt=True)
+        except Exception:
+            return None
+
+
+def load_tokenizer(model: str) -> Tokenizer:
+    """HF tokenizer if `model` is a local dir with tokenizer files, else bytes."""
+    if os.path.isdir(model) and any(
+        os.path.exists(os.path.join(model, f))
+        for f in ("tokenizer.json", "tokenizer.model", "tokenizer_config.json")
+    ):
+        return HFTokenizer(model)
+    return ByteTokenizer()
+
+
+class IncrementalDecoder:
+    """Streaming detokenizer: emits the longest stable decoded prefix.
+
+    Avoids emitting replacement chars for incomplete UTF-8/multibyte pieces by
+    holding back undecodable tails until more tokens arrive. Used by the
+    serving layer to stream output with correct TTFT semantics
+    (reference behavior: llm/serve_llm.py:546-558 streams per decode step).
+    """
+
+    # If this many tokens accumulate without resolving to valid text, flush
+    # anyway: the tail is a *genuine* invalid sequence, not a pending one.
+    MAX_PENDING = 16
+
+    def __init__(self, tok: Tokenizer) -> None:
+        self._tok = tok
+        self._ids: list[int] = []        # full id history (for .text())
+        self._pending: list[int] = []    # undecoded tail only — O(window) per push
+        self._emitted: list[str] = []
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(int(token_id))
+        self._pending.append(int(token_id))
+        text = self._tok.decode(self._pending)
+        if text.endswith("�") and len(self._pending) < self.MAX_PENDING:
+            return ""  # likely an incomplete multibyte sequence — hold back
+        self._pending.clear()
+        self._emitted.append(text)
+        return text
+
+    def text(self) -> str:
+        return "".join(self._emitted) + self._tok.decode(self._pending)

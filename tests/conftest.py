@@ -1,0 +1,27 @@
+"""Test harness config: force JAX onto a virtual 8-device CPU mesh.
+
+Multi-chip hardware is not available in CI; sharding/collective tests run on
+`xla_force_host_platform_device_count=8` CPU devices, per the multi-chip test
+strategy in SURVEY.md §4. Must run before the first `import jax` in any test.
+"""
+
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: the outer env pins the axon TPU tunnel
+# Subprocesses spawned by tests must not re-register the axon TPU plugin.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+# The axon sitecustomize calls register() at interpreter start, which pins
+# jax_platforms to "axon,cpu" regardless of JAX_PLATFORMS — undo that here,
+# before any backend is initialized.
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,58 @@
+"""Sampling op tests: greedy, temperature, top-k/top-p filtering, determinism."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.ops.sampling import make_row_keys, sample
+
+
+def _params(b, temp=0.0, top_k=0, top_p=1.0):
+    return (
+        jnp.full((b,), temp, jnp.float32),
+        jnp.full((b,), top_k, jnp.int32),
+        jnp.full((b,), top_p, jnp.float32),
+    )
+
+
+def test_greedy_picks_argmax():
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(4, 32)), jnp.float32)
+    keys = make_row_keys(jnp.arange(4), jnp.zeros(4, jnp.int32))
+    t, k, p = _params(4, temp=0.0)
+    out = sample(logits, keys, t, k, p)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_top_k_restricts_support():
+    rng = np.random.default_rng(1)
+    logits = jnp.asarray(rng.normal(size=(2, 64)), jnp.float32)
+    top2 = np.argsort(-np.asarray(logits), axis=-1)[:, :2]
+    t, k, p = _params(2, temp=1.5, top_k=2)
+    for step in range(20):
+        keys = make_row_keys(jnp.asarray([7, 8]), jnp.full((2,), step, jnp.int32))
+        out = np.asarray(sample(logits, keys, t, k, p))
+        for row in range(2):
+            assert out[row] in top2[row]
+
+
+def test_top_p_keeps_at_least_one():
+    logits = jnp.asarray(np.eye(3, 16) * 50.0, jnp.float32)  # near-delta rows + flat row
+    t, k, p = _params(3, temp=1.0, top_p=0.01)
+    keys = make_row_keys(jnp.arange(3), jnp.zeros(3, jnp.int32))
+    out = np.asarray(sample(logits, keys, t, k, p))
+    assert out[0] == 0 and out[1] == 1  # nucleus collapses to the argmax
+
+
+def test_per_row_determinism_is_batch_independent():
+    """A request's sampled token depends only on (seed, step), not batchmates."""
+    rng = np.random.default_rng(2)
+    row = rng.normal(size=(1, 128)).astype(np.float32)
+    big = np.concatenate([row, rng.normal(size=(5, 128)).astype(np.float32)])
+    t1, k1, p1 = _params(1, temp=0.9, top_k=40, top_p=0.95)
+    t6, k6, p6 = _params(6, temp=0.9, top_k=40, top_p=0.95)
+    for step in range(5):
+        keys1 = make_row_keys(jnp.asarray([42]), jnp.full((1,), step, jnp.int32))
+        keys6 = make_row_keys(jnp.asarray([42, 1, 2, 3, 4, 5]), jnp.full((6,), step, jnp.int32))
+        a = np.asarray(sample(jnp.asarray(row), keys1, t1, k1, p1))[0]
+        b = np.asarray(sample(jnp.asarray(big), keys6, t6, k6, p6))[0]
+        assert a == b
