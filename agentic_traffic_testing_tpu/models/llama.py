@@ -119,9 +119,8 @@ def _unembed(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("cfg",))
-def forward_full(params: Params, cfg: ModelConfig, tokens: jax.Array,
-                 positions: Optional[jax.Array] = None) -> jax.Array:
+def forward_full_impl(params: Params, cfg: ModelConfig, tokens: jax.Array,
+                      positions: Optional[jax.Array] = None) -> jax.Array:
     """Causal LM forward. tokens [B, T] -> logits [B, T, V] (fp32)."""
     b, t = tokens.shape
     if positions is None:
@@ -151,8 +150,7 @@ def forward_full(params: Params, cfg: ModelConfig, tokens: jax.Array,
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
-def prefill(
+def prefill_impl(
     params: Params,
     cfg: ModelConfig,
     tokens: jax.Array,        # [B, T] padded; T % block_size == 0
@@ -199,8 +197,7 @@ def prefill(
 # ---------------------------------------------------------------------------
 
 
-@partial(jax.jit, static_argnames=("cfg",), donate_argnums=(3,))
-def decode_step(
+def decode_step_impl(
     params: Params,
     cfg: ModelConfig,
     tokens: jax.Array,        # [B] current input token per sequence
@@ -246,3 +243,11 @@ def decode_step(
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return _unembed(x, params, cfg)[:, 0], KVCache(kc, vc)
+
+
+# Jitted conveniences (tests, simple offline use). The serving engine builds
+# its own fused jits from the *_impl functions (model step + on-device
+# sampling in one dispatch — see runtime/runner.py).
+forward_full = jax.jit(forward_full_impl, static_argnames=("cfg",))
+prefill = jax.jit(prefill_impl, static_argnames=("cfg",), donate_argnums=(3,))
+decode_step = jax.jit(decode_step_impl, static_argnames=("cfg",), donate_argnums=(3,))

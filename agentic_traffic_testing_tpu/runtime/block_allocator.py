@@ -1,0 +1,101 @@
+"""Host-side KV block allocator + per-sequence block tables.
+
+The device-side cache layout is `runtime/kv_cache.py`; this module owns the
+*policy*: which physical blocks belong to which sequence, free-list accounting,
+and the capacity numbers exported through the `llm_kv_cache_*` Prometheus
+gauges (mirroring what the reference reads off vLLM's cache config —
+reference: llm/serve_llm.py:245-264, 410-502).
+
+A C++ implementation of the same interface lives in `native/` (built as a
+CPython extension); this pure-Python version is the always-available fallback
+and the behavioral spec.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK
+
+
+class BlockAllocator:
+    """Free-list allocator over physical KV blocks.
+
+    Block ids run [1, num_blocks); block 0 is the shared trash block that
+    padding lanes write into (see kv_cache.py). LIFO reuse keeps recently
+    freed blocks hot in any downstream cache hierarchy.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (1 usable + trash), got {num_blocks}")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: list[int] = list(range(num_blocks - 1, TRASH_BLOCK, -1))
+
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free)
+
+    @property
+    def num_used_blocks(self) -> int:
+        return (self.num_blocks - 1) - len(self._free)
+
+    @property
+    def usable_tokens(self) -> int:
+        return (self.num_blocks - 1) * self.block_size
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def allocate(self, n: int) -> Optional[list[int]]:
+        """Allocate n blocks, or None (all-or-nothing) if unavailable."""
+        if n > len(self._free):
+            return None
+        taken = self._free[-n:] if n else []
+        del self._free[len(self._free) - n:]
+        return taken
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if not (TRASH_BLOCK < b < self.num_blocks):
+                raise ValueError(f"freeing invalid block id {b}")
+        self._free.extend(blocks)
+        if len(self._free) > self.num_blocks - 1:
+            raise RuntimeError("double free detected: free list exceeds capacity")
+
+
+class SequenceBlocks:
+    """Block-table bookkeeping for one sequence."""
+
+    def __init__(self, allocator: BlockAllocator) -> None:
+        self._alloc = allocator
+        self.blocks: list[int] = []
+
+    @property
+    def capacity_tokens(self) -> int:
+        return len(self.blocks) * self._alloc.block_size
+
+    def ensure_capacity(self, num_tokens: int) -> bool:
+        """Grow to hold num_tokens; False (and no change) if blocks ran out."""
+        need = self._alloc.blocks_needed(num_tokens) - len(self.blocks)
+        if need <= 0:
+            return True
+        got = self._alloc.allocate(need)
+        if got is None:
+            return False
+        self.blocks.extend(got)
+        return True
+
+    def release(self) -> None:
+        if self.blocks:
+            self._alloc.free(self.blocks)
+            self.blocks = []
+
+    def table_row(self, width: int) -> list[int]:
+        """Fixed-width block-table row, padded with the trash block."""
+        row = self.blocks[:width] + [TRASH_BLOCK] * max(0, width - len(self.blocks))
+        return row

@@ -1,0 +1,441 @@
+"""LLMEngine: continuous batching over jitted TPU steps.
+
+Replaces the vLLM `AsyncLLMEngine` the reference wraps (reference:
+llm/serve_llm.py:343-612) with a first-party engine:
+
+  host (Python)                       device (TPU, jitted)
+  ─────────────                       ────────────────────
+  Scheduler.plan()  ──────────────▶   fused prefill+sample   (one dispatch)
+  block allocation                    fused decode+sample    (one dispatch/step)
+  stop conditions, streaming  ◀────   sampled tokens [B] (async readback)
+
+Key TPU-driven design points:
+  * Decode advances entirely on device (DecodeState feeds itself); the host
+    only reads back the [B] sampled-token array, asynchronously, processing
+    it `pipeline_depth` steps behind the dispatch frontier. Stop conditions
+    are therefore detected with bounded lag; the scheduler pre-allocates
+    `decode_lookahead` KV slots so lagged steps never overrun a block table.
+  * Tokens sampled past a stop point are dropped at harvest time, so output
+    text is exact regardless of lag.
+  * Shapes are bucketed by the scheduler; each (batch, length) bucket
+    compiles once.
+
+TTFT semantics match the reference: `queue_wait_s` = request arrival →
+first token available on host (reference: llm/serve_llm.py:546-558).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+import uuid
+from collections import deque
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from agentic_traffic_testing_tpu.models.config import ModelConfig, resolve_config
+from agentic_traffic_testing_tpu.models.llama import init_params
+from agentic_traffic_testing_tpu.runtime.block_allocator import BlockAllocator
+from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK, make_kv_cache
+from agentic_traffic_testing_tpu.runtime.request import (
+    FinishReason,
+    Request,
+    RequestState,
+    SamplingParams,
+)
+from agentic_traffic_testing_tpu.runtime.runner import (
+    DecodeState,
+    ModelRunner,
+    SamplingArrays,
+)
+from agentic_traffic_testing_tpu.runtime.scheduler import (
+    DecodeBatch,
+    PrefillBatch,
+    Scheduler,
+    SchedulerConfig,
+)
+
+log = logging.getLogger("att_tpu.engine")
+
+
+@dataclasses.dataclass
+class EngineConfig:
+    """Env-compatible engine knobs (names mirror the reference's LLM_* envs —
+    reference: llm/serve_llm.py:52-82)."""
+
+    model: str = "tiny"
+    dtype: str = "bfloat16"
+    max_num_seqs: int = 12
+    max_num_batched_tokens: int = 8192
+    max_model_len: int = 4096
+    block_size: int = 16
+    num_blocks: Optional[int] = None       # None -> derive from HBM budget
+    memory_utilization: float = 0.90       # LLM_GPU_MEMORY_UTILIZATION analog
+    pipeline_depth: int = 2                # decode steps in flight before readback
+    seed: int = 0
+
+    def scheduler_config(self) -> SchedulerConfig:
+        return SchedulerConfig(
+            max_num_seqs=self.max_num_seqs,
+            max_num_batched_tokens=self.max_num_batched_tokens,
+            max_model_len=self.max_model_len,
+            block_size=self.block_size,
+            decode_lookahead=max(4, 2 * self.pipeline_depth),
+        )
+
+
+@dataclasses.dataclass
+class StepOutput:
+    """Per-request increment produced by Engine.step()."""
+
+    request: Request
+    new_token_ids: list[int]
+    finished: bool
+
+
+class _Inflight:
+    """A dispatched decode step whose sampled tokens are still on device."""
+
+    __slots__ = ("tokens", "requests")
+
+    def __init__(self, tokens: jax.Array, requests: list[Request]) -> None:
+        self.tokens = tokens
+        self.requests = requests
+
+
+class LLMEngine:
+    """Synchronous engine core; `serving/` wraps it in asyncio."""
+
+    def __init__(
+        self,
+        cfg: EngineConfig,
+        model_cfg: Optional[ModelConfig] = None,
+        params=None,
+        runner: Optional[ModelRunner] = None,
+    ) -> None:
+        self.cfg = cfg
+        self.model_cfg = model_cfg or resolve_config(cfg.model)
+        dtype = jnp.bfloat16 if cfg.dtype in ("bfloat16", "bf16") else jnp.float32
+        if runner is not None:
+            self.runner = runner
+        else:
+            if params is None:
+                log.warning("no checkpoint: random-initializing %s", self.model_cfg.name)
+                params = init_params(self.model_cfg, jax.random.key(cfg.seed), dtype=dtype)
+            self.runner = ModelRunner(self.model_cfg, params)
+
+        num_blocks = cfg.num_blocks or self._default_num_blocks()
+        self.cache = make_kv_cache(self.model_cfg, num_blocks, cfg.block_size, dtype)
+        self.allocator = BlockAllocator(num_blocks, cfg.block_size)
+        self.scheduler = Scheduler(cfg.scheduler_config(), self.allocator)
+        # Fixed block-table width: worst-case blocks for max_model_len.
+        self.table_width = -(-cfg.max_model_len // cfg.block_size)
+
+        self._inflight: deque[_Inflight] = deque()
+        self._decode_requests: list[Request] = []   # composition of device state
+        self._decode_state: Optional[DecodeState] = None
+        self._decode_tables: Optional[jax.Array] = None
+        self._decode_samp: Optional[SamplingArrays] = None
+        self._new_tokens: dict[str, list[int]] = {}
+        self._requests: dict[str, Request] = {}  # live (unreported-finish) requests
+        # Cumulative counters for metrics
+        self.num_steps = 0
+
+    def _default_num_blocks(self) -> int:
+        """Budget KV blocks from device memory, vLLM-profiling style."""
+        from agentic_traffic_testing_tpu.runtime.kv_cache import profile_num_blocks
+
+        try:
+            dev = jax.devices()[0]
+            stats = dev.memory_stats() or {}
+            limit = stats.get("bytes_limit", 0)
+            used = stats.get("bytes_in_use", 0)
+            free = max(0, limit - used)
+        except Exception:
+            free = 0
+        if free <= 0:
+            # No introspection (CPU tests): small fixed pool.
+            return 512
+        bytes_per = 2 if self.cfg.dtype in ("bfloat16", "bf16") else 4
+        n = profile_num_blocks(
+            self.model_cfg, self.cfg.block_size, free,
+            self.cfg.memory_utilization, bytes_per,
+        )
+        # Never exceed what max_num_seqs * max_model_len can actually use.
+        cap = self.cfg.max_num_seqs * self.table_width + 1
+        return max(2, min(n, cap))
+
+    # -- request API -------------------------------------------------------
+
+    def add_request(
+        self,
+        prompt_ids: list[int],
+        sampling: Optional[SamplingParams] = None,
+        request_id: Optional[str] = None,
+    ) -> Request:
+        req = Request(
+            request_id=request_id or uuid.uuid4().hex[:16],
+            prompt_ids=list(prompt_ids),
+            sampling=sampling or SamplingParams(),
+        )
+        self.scheduler.add_request(req)
+        self._requests[req.request_id] = req
+        return req
+
+    def abort_request(self, req: Request) -> None:
+        self._drain_all()
+        req.state = RequestState.ABORTED
+        req.finish_reason = FinishReason.ABORT
+        req.finish_time = time.monotonic()
+        self.scheduler.abort(req)
+        self._requests.pop(req.request_id, None)
+        self._new_tokens.pop(req.request_id, None)
+        self._invalidate_decode_state()
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_work() or bool(self._inflight)
+
+    # -- the step loop -----------------------------------------------------
+
+    def step(self) -> list[StepOutput]:
+        """Advance by one device dispatch (or drain); return request events."""
+        self.num_steps += 1
+
+        # Only tear the decode pipeline down for admission when the head of
+        # the waiting queue could actually be admitted — an unadmittable
+        # (KV-starved) waiter must not degrade decode to synchronous readback.
+        admission_possible = self.scheduler.can_admit_head() or bool(self.scheduler.failed)
+        if admission_possible or self._decode_state is None or not self._decode_requests:
+            # Composition may change: sync up, then let the scheduler decide.
+            self._drain_all()
+            self._plan_and_dispatch()
+        else:
+            self._dispatch_decode()
+
+        self._harvest(max_inflight=self.cfg.pipeline_depth)
+        return self._flush_events()
+
+    def _plan_and_dispatch(self) -> None:
+        """Plan against *current* (post-drain) state and run the step."""
+        plan = self.scheduler.plan()
+        self._fail_unservable()
+        if isinstance(plan, PrefillBatch):
+            self._run_prefill(plan)
+        elif isinstance(plan, DecodeBatch):
+            self._setup_decode(plan)
+            self._do_decode_dispatch()
+        else:
+            self._invalidate_decode_state()
+
+    def _fail_unservable(self) -> None:
+        for req in self.scheduler.failed:
+            self._finish(req, FinishReason.ERROR)
+            # _finish marks FINISHED; reflect the error state instead.
+            req.state = RequestState.ABORTED
+            self._new_tokens.setdefault(req.request_id, [])
+        self.scheduler.failed.clear()
+
+    # -- prefill -----------------------------------------------------------
+
+    def _run_prefill(self, plan: PrefillBatch) -> None:
+        reqs = plan.requests
+        b, t = plan.padded_batch, plan.padded_len
+        tokens = np.zeros((b, t), np.int32)
+        seq_lens = np.zeros((b,), np.int32)
+        tables = np.full((b, self.table_width), TRASH_BLOCK, np.int32)
+        steps = np.zeros((b,), np.int32)
+        for i, r in enumerate(reqs):
+            tokens[i, : r.num_prompt_tokens] = r.prompt_ids
+            seq_lens[i] = r.num_prompt_tokens
+            tables[i] = r.blocks.table_row(self.table_width)
+            steps[i] = r.sampling_step
+        samp = self._sampling_arrays(reqs, b)
+        state, self.cache, out = self.runner.prefill(
+            jnp.asarray(tokens), self.cache, jnp.asarray(tables),
+            jnp.asarray(seq_lens), samp, jnp.asarray(steps),
+        )
+        # Prefill readback is synchronous: it IS the first token (TTFT).
+        toks = np.asarray(jax.device_get(out))
+        now = time.monotonic()
+        for i, r in enumerate(reqs):
+            if r.first_token_time is None:
+                r.first_token_time = now
+            self._append_token(r, int(toks[i]))
+        # The new sequences join decode on the next step() via plan().
+        self._invalidate_decode_state()
+
+    # -- decode ------------------------------------------------------------
+
+    def _setup_decode(self, plan: DecodeBatch) -> None:
+        reqs = plan.requests
+        b = plan.padded_batch
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        steps = np.zeros((b,), np.int32)
+        tables = np.full((b, self.table_width), TRASH_BLOCK, np.int32)
+        for i, r in enumerate(reqs):
+            last = r.output_ids[-1] if r.output_ids else r.prompt_ids[-1]
+            tokens[i] = last
+            positions[i] = r.total_len - 1
+            steps[i] = r.sampling_step
+            tables[i] = r.blocks.table_row(self.table_width)
+        self._decode_requests = list(reqs)
+        self._decode_state = DecodeState(
+            tokens=jnp.asarray(tokens),
+            positions=jnp.asarray(positions),
+            steps=jnp.asarray(steps),
+        )
+        self._decode_tables = jnp.asarray(tables)
+        self._decode_samp = self._sampling_arrays(reqs, b)
+        self._decode_block_counts = [len(r.blocks.blocks) for r in reqs]
+
+    def _refresh_decode_tables(self) -> None:
+        """Re-upload block tables if any sequence grew into new blocks.
+
+        The DecodeState (tokens/positions) stays device-resident; only the
+        [B, W] table array is re-built. Without this, a sequence crossing a
+        block boundary mid-decode would silently write its KV into the trash
+        block (stale table row) and corrupt its own continuation.
+        """
+        counts = [len(r.blocks.blocks) for r in self._decode_requests]
+        if counts == self._decode_block_counts:
+            return
+        b = self._decode_tables.shape[0]
+        tables = np.full((b, self.table_width), TRASH_BLOCK, np.int32)
+        for i, r in enumerate(self._decode_requests):
+            tables[i] = r.blocks.table_row(self.table_width)
+        self._decode_tables = jnp.asarray(tables)
+        self._decode_block_counts = counts
+
+    def _dispatch_decode(self) -> None:
+        if self._decode_state is None:
+            return
+        # KV headroom for this step (may preempt; then state must be rebuilt).
+        plan = self.scheduler.plan()
+        if isinstance(plan, DecodeBatch) and plan.requests == self._decode_requests:
+            self._refresh_decode_tables()
+            self._do_decode_dispatch()
+            return
+        # Composition changed (preemption / drain-out): sync fully first.
+        self._drain_all()
+        if isinstance(plan, PrefillBatch):
+            # Not stale: plan() just admitted these requests and they hold
+            # their blocks regardless of what harvesting finished.
+            self._fail_unservable()
+            self._run_prefill(plan)
+            return
+        # A decode plan IS stale after draining — harvest may have finished
+        # members and released their blocks — so re-plan from current state.
+        self._plan_and_dispatch()
+
+    def _do_decode_dispatch(self) -> None:
+        self._decode_state, self.cache, out = self.runner.decode(
+            self.cache, self._decode_tables, self._decode_state, self._decode_samp
+        )
+        try:
+            out.copy_to_host_async()
+        except Exception:
+            pass
+        self._inflight.append(_Inflight(out, list(self._decode_requests)))
+
+    def _sampling_arrays(self, reqs: list[Request], padded: int) -> SamplingArrays:
+        temp = np.zeros((padded,), np.float32)
+        top_k = np.zeros((padded,), np.int32)
+        top_p = np.ones((padded,), np.float32)
+        seeds = np.zeros((padded,), np.int32)
+        for i, r in enumerate(reqs):
+            temp[i] = r.sampling.temperature
+            top_k[i] = r.sampling.top_k
+            top_p[i] = r.sampling.top_p
+            seeds[i] = r.sampling.seed
+        return SamplingArrays(
+            temperature=jnp.asarray(temp), top_k=jnp.asarray(top_k),
+            top_p=jnp.asarray(top_p), seeds=jnp.asarray(seeds),
+        )
+
+    # -- harvest / stop conditions ----------------------------------------
+
+    def _harvest(self, max_inflight: int) -> None:
+        while len(self._inflight) > max_inflight or (
+            self._inflight and self._any_request_gone(self._inflight[0])
+        ):
+            self._apply_inflight(self._inflight.popleft())
+
+    def _drain_all(self) -> None:
+        while self._inflight:
+            self._apply_inflight(self._inflight.popleft())
+
+    def _any_request_gone(self, inf: _Inflight) -> bool:
+        return any(r.is_finished() for r in inf.requests)
+
+    def _apply_inflight(self, inf: _Inflight) -> None:
+        toks = np.asarray(jax.device_get(inf.tokens))
+        now = time.monotonic()
+        for i, r in enumerate(inf.requests):
+            if r.is_finished() or r.state is not RequestState.RUNNING:
+                continue  # stopped at an earlier lagged step, or preempted
+            if r.first_token_time is None:
+                r.first_token_time = now
+            self._append_token(r, int(toks[i]))
+
+    def _append_token(self, r: Request, tok: int) -> None:
+        r.output_ids.append(tok)
+        r.sampling_step += 1
+        self._new_tokens.setdefault(r.request_id, []).append(tok)
+        eos_hit = (not r.sampling.ignore_eos) and (
+            tok in r.sampling.stop_token_ids
+        )
+        if eos_hit:
+            self._finish(r, FinishReason.STOP)
+        elif r.sampling_step >= r.sampling.max_tokens:
+            # sampling_step counts ALL generated tokens (it survives
+            # preemption, unlike len(output_ids)).
+            self._finish(r, FinishReason.LENGTH)
+        elif r.total_len >= self.cfg.max_model_len:
+            self._finish(r, FinishReason.LENGTH)
+
+    def _finish(self, r: Request, reason: FinishReason) -> None:
+        r.state = RequestState.FINISHED
+        r.finish_reason = reason
+        r.finish_time = time.monotonic()
+        self.scheduler.finish(r)
+        self._invalidate_decode_state()
+
+    def _invalidate_decode_state(self) -> None:
+        self._decode_state = None
+        self._decode_requests = []
+        self._decode_tables = None
+        self._decode_samp = None
+
+    def _flush_events(self) -> list[StepOutput]:
+        events = []
+        for rid, toks in self._new_tokens.items():
+            req = self._requests[rid]
+            events.append(StepOutput(request=req, new_token_ids=toks,
+                                     finished=req.is_finished()))
+            if req.is_finished():
+                del self._requests[rid]
+        self._new_tokens.clear()
+        return events
+
+    # -- offline convenience ----------------------------------------------
+
+    def generate(
+        self,
+        prompt_ids: list[int],
+        sampling: Optional[SamplingParams] = None,
+    ) -> Request:
+        """Blocking single-request generation (tests/CLI)."""
+        req = self.add_request(prompt_ids, sampling)
+        while not req.is_finished():
+            events = self.step()
+            if not events and not self.has_work():
+                break
+        return req
+
+    def kv_stats(self) -> dict:
+        return self.scheduler.kv_stats()

@@ -1,0 +1,90 @@
+"""Request/sampling datatypes shared by scheduler, engine and serving layer."""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import time
+from typing import Optional
+
+from agentic_traffic_testing_tpu.runtime.block_allocator import SequenceBlocks
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling knobs (reference default is near-greedy
+    temperature 0.2 — reference: llm/serve_llm.py:379,522)."""
+
+    max_tokens: int = 512
+    temperature: float = 0.2
+    top_k: int = 0          # <= 0 disables
+    top_p: float = 1.0      # >= 1 disables
+    seed: int = 0
+    stop_token_ids: tuple[int, ...] = ()
+    ignore_eos: bool = False
+
+
+class RequestState(enum.Enum):
+    WAITING = "waiting"
+    RUNNING = "running"
+    PREEMPTED = "preempted"
+    FINISHED = "finished"
+    ABORTED = "aborted"
+
+
+class FinishReason(enum.Enum):
+    STOP = "stop"          # hit an EOS/stop token
+    LENGTH = "length"      # max_tokens or max_model_len
+    ABORT = "abort"
+    ERROR = "error"        # unservable (e.g. can never fit the KV pool)
+
+
+@dataclasses.dataclass(eq=False)  # identity semantics: a request is not its field values
+class Request:
+    """One generation request moving through the continuous batch."""
+
+    request_id: str
+    prompt_ids: list[int]
+    sampling: SamplingParams
+    arrival_time: float = dataclasses.field(default_factory=time.monotonic)
+
+    state: RequestState = RequestState.WAITING
+    output_ids: list[int] = dataclasses.field(default_factory=list)
+    blocks: Optional[SequenceBlocks] = None
+    first_token_time: Optional[float] = None
+    finish_time: Optional[float] = None
+    finish_reason: Optional[FinishReason] = None
+    error: Optional[str] = None
+    # Scheduling bookkeeping
+    num_preemptions: int = 0
+    # Total tokens sampled so far, *surviving preemption* (preemption folds
+    # output_ids back into prompt_ids; sampling keys use (seed, sampling_step)
+    # so the regenerated continuation stays reproducible).
+    sampling_step: int = 0
+
+    def __post_init__(self) -> None:
+        # Preemption folds generated tokens into prompt_ids for recompute
+        # (scheduler.py); the user-visible boundary stays fixed here.
+        self.num_orig_prompt_tokens = len(self.prompt_ids)
+
+    @property
+    def generated_ids(self) -> list[int]:
+        """All tokens generated for this request, surviving preemption."""
+        return self.prompt_ids[self.num_orig_prompt_tokens:] + self.output_ids
+
+    @property
+    def num_prompt_tokens(self) -> int:
+        return len(self.prompt_ids)
+
+    @property
+    def total_len(self) -> int:
+        return len(self.prompt_ids) + len(self.output_ids)
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.first_token_time is None:
+            return None
+        return self.first_token_time - self.arrival_time
+
+    def is_finished(self) -> bool:
+        return self.state in (RequestState.FINISHED, RequestState.ABORTED)

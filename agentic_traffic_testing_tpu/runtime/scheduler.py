@@ -1,0 +1,297 @@
+"""Iteration-level continuous-batching scheduler.
+
+TPU-native rethink of the scheduling capability the reference delegates to
+vLLM's engine (`AsyncEngineArgs(max_num_seqs=…, max_num_batched_tokens=…)` —
+reference: llm/serve_llm.py:362-378; compose defaults 12/8192 —
+infra/docker-compose.distributed.yml:40-41). Differences driven by XLA:
+
+  * Every step must have a *statically bucketed* shape — batch sizes and
+    padded prefill lengths are rounded up to a small fixed ladder so the jit
+    cache stays bounded (SURVEY.md §7 "keeping jit recompilation bounded").
+  * The schedule itself is computed host-side in plain Python (cheap), only
+    the chosen step runs on device.
+
+Policy: prefill-priority admission (matches vLLM's default and preserves the
+TTFT semantics the testbed measures), LIFO preemption of the youngest running
+sequence when KV blocks run out, all-or-nothing block allocation.
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import dataclass, field
+from typing import Optional, Union
+
+from agentic_traffic_testing_tpu.runtime.block_allocator import BlockAllocator, SequenceBlocks
+from agentic_traffic_testing_tpu.runtime.request import Request, RequestState
+
+
+def pow2_buckets(lo: int, hi: int) -> list[int]:
+    out, v = [], lo
+    while v < hi:
+        out.append(v)
+        v *= 2
+    out.append(hi)
+    return out
+
+
+def bucket_up(n: int, buckets: list[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+@dataclass
+class PrefillBatch:
+    """One prefill step: same padded length for all members."""
+
+    requests: list[Request]
+    padded_len: int
+    padded_batch: int
+
+    @property
+    def token_budget(self) -> int:
+        return self.padded_len * len(self.requests)
+
+
+@dataclass
+class DecodeBatch:
+    """One decode step over every running sequence."""
+
+    requests: list[Request]
+    padded_batch: int
+
+
+StepPlan = Union[PrefillBatch, DecodeBatch, None]
+
+
+@dataclass
+class SchedulerConfig:
+    max_num_seqs: int = 12           # compose default (reference: docker-compose.distributed.yml:40)
+    max_num_batched_tokens: int = 8192
+    max_model_len: int = 4096
+    block_size: int = 16
+    # Extra tokens of KV headroom per running seq so the engine can pipeline
+    # a couple of speculative steps past a stop condition (see engine.py).
+    decode_lookahead: int = 4
+    min_prefill_bucket: int = 32
+
+    def __post_init__(self) -> None:
+        self.prefill_buckets = [
+            b for b in pow2_buckets(self.min_prefill_bucket, self.max_model_len)
+        ]
+        self.batch_buckets = pow2_buckets(1, self.max_num_seqs)
+
+
+class Scheduler:
+    """Owns the waiting queue, the running set, and block allocation."""
+
+    def __init__(self, cfg: SchedulerConfig, allocator: BlockAllocator) -> None:
+        assert allocator.block_size == cfg.block_size
+        self.cfg = cfg
+        self.allocator = allocator
+        self.waiting: collections.deque[Request] = collections.deque()
+        self.running: list[Request] = []
+        # Requests found unservable during planning (can never fit the pool);
+        # the engine drains this list and fails them upward.
+        self.failed: list[Request] = []
+        # Cumulative counters (exported by the serving layer)
+        self.num_preemptions = 0
+        self.num_scheduled_prefills = 0
+        self.num_scheduled_decodes = 0
+
+    # -- admission ---------------------------------------------------------
+
+    def add_request(self, req: Request) -> None:
+        if req.num_prompt_tokens == 0:
+            raise ValueError("empty prompt: nothing to prefill")
+        if req.num_prompt_tokens >= self.cfg.max_model_len:
+            raise ValueError(
+                f"prompt of {req.num_prompt_tokens} tokens >= max_model_len "
+                f"{self.cfg.max_model_len}; the serving layer must truncate first"
+            )
+        need = self.allocator.blocks_needed(
+            req.num_prompt_tokens + 1 + self.cfg.decode_lookahead
+        )
+        if need > self.allocator.num_blocks - 1:
+            raise ValueError(
+                f"prompt needs {need} KV blocks but the pool only has "
+                f"{self.allocator.num_blocks - 1}; raise num_blocks or shrink the prompt"
+            )
+        req.state = RequestState.WAITING
+        self.waiting.append(req)
+
+    def can_admit_head(self) -> bool:
+        """Cheap check: could plan() admit the head of the waiting queue right
+        now? Lets the engine keep its decode pipeline intact instead of
+        draining every step while a request waits for KV to free up."""
+        if not self.waiting:
+            return False
+        if len(self.running) >= self.cfg.max_num_seqs:
+            return False
+        head = self.waiting[0]
+        need = self.allocator.blocks_needed(
+            head.num_prompt_tokens + self.cfg.decode_lookahead
+        )
+        return self.allocator.can_allocate(need)
+
+    def abort(self, req: Request) -> None:
+        if req in self.running:
+            self.running.remove(req)
+        try:
+            self.waiting.remove(req)
+        except ValueError:
+            pass
+        self._release(req)
+
+    # -- planning ----------------------------------------------------------
+
+    def plan(self) -> StepPlan:
+        """Choose the next device step. Prefill-priority."""
+        pf = self._plan_prefill()
+        if pf is not None:
+            self.num_scheduled_prefills += 1
+            return pf
+        dec = self._plan_decode()
+        if dec is not None:
+            self.num_scheduled_decodes += 1
+        return dec
+
+    def has_work(self) -> bool:
+        return bool(self.waiting or self.running)
+
+    def _padded_prompt_len(self, req: Request) -> int:
+        n = bucket_up(req.num_prompt_tokens, self.cfg.prefill_buckets)
+        # Prefill writes whole blocks; keep the bucket block-aligned.
+        bs = self.cfg.block_size
+        return -(-n // bs) * bs
+
+    def _plan_prefill(self) -> Optional[PrefillBatch]:
+        """Admit waiting requests of one shared length bucket."""
+        if not self.waiting:
+            return None
+        batch: list[Request] = []
+        bucket_len = 0
+        while self.waiting:
+            req = self.waiting[0]
+            if len(self.running) + len(batch) >= self.cfg.max_num_seqs:
+                break
+            padded = self._padded_prompt_len(req)
+            cand_len = max(bucket_len, padded)
+            if batch and cand_len * (len(batch) + 1) > self.cfg.max_num_batched_tokens:
+                break
+            if batch and cand_len != bucket_len:
+                # Keep one shape per step: only batch prompts of the same bucket.
+                break
+            # All-or-nothing KV allocation: prompt + lookahead headroom.
+            need_tokens = req.num_prompt_tokens + self.cfg.decode_lookahead
+            blocks = SequenceBlocks(self.allocator)
+            if not blocks.ensure_capacity(need_tokens):
+                if not self.running and not batch:
+                    # The pool is completely idle and the head still cannot
+                    # fit (e.g. a preempted prompt grew past pool capacity):
+                    # it never will — fail it instead of wedging the queue.
+                    bad = self.waiting.popleft()
+                    bad.error = (
+                        f"sequence of {bad.num_prompt_tokens} tokens cannot fit "
+                        f"the KV pool ({self.allocator.usable_tokens} tokens)"
+                    )
+                    self.failed.append(bad)
+                    continue
+                break  # no KV room: let decode drain / preemption handle it
+            req.blocks = blocks
+            bucket_len = cand_len
+            batch.append(self.waiting.popleft())
+        if not batch:
+            return None
+        for r in batch:
+            r.state = RequestState.RUNNING
+            self.running.append(r)
+        return PrefillBatch(
+            requests=batch,
+            padded_len=bucket_len,
+            padded_batch=bucket_up(len(batch), self.cfg.batch_buckets),
+        )
+
+    def _plan_decode(self) -> Optional[DecodeBatch]:
+        """One token for every running sequence; preempt if KV runs out."""
+        if not self.running:
+            return None
+        # Grow each sequence's KV capacity for this step (+ lookahead).
+        # Victims are chosen LIFO (youngest arrival) — vLLM's policy, which
+        # protects the oldest requests' latency.
+        survivors: list[Request] = []
+        for req in sorted(self.running, key=lambda r: r.arrival_time):
+            if req.state is not RequestState.RUNNING:
+                continue  # already preempted as a victim earlier in this pass
+            while not self._ensure_decode_capacity(req):
+                victim = self._pick_victim(exclude=req)
+                if victim is None:
+                    # Nothing left to evict; this request itself must wait.
+                    self._preempt(req)
+                    req = None
+                    break
+                self._preempt(victim)
+                survivors = [r for r in survivors if r.state == RequestState.RUNNING]
+            if req is not None and req.state == RequestState.RUNNING:
+                survivors.append(req)
+        self.running = survivors
+        if not self.running:
+            return None
+        return DecodeBatch(
+            requests=list(self.running),
+            padded_batch=bucket_up(len(self.running), self.cfg.batch_buckets),
+        )
+
+    def _ensure_decode_capacity(self, req: Request) -> bool:
+        assert req.blocks is not None
+        return req.blocks.ensure_capacity(req.total_len + 1 + self.cfg.decode_lookahead)
+
+    def _pick_victim(self, exclude: Request) -> Optional[Request]:
+        cands = [r for r in self.running if r is not exclude and r.state == RequestState.RUNNING]
+        if not cands:
+            return None
+        return max(cands, key=lambda r: r.arrival_time)
+
+    def _preempt(self, req: Request) -> None:
+        """Evict to the waiting queue; its KV is recomputed on re-admission."""
+        self._release(req)
+        req.state = RequestState.PREEMPTED
+        req.num_preemptions += 1
+        self.num_preemptions += 1
+        # Re-admit with its generated tokens folded into the prompt so the
+        # recompute prefill reproduces the exact sequence so far.
+        req.prompt_ids = req.prompt_ids + req.output_ids
+        req.output_ids = []
+        req.state = RequestState.WAITING
+        self.waiting.appendleft(req)
+        if req in self.running:
+            self.running.remove(req)
+
+    # -- completion --------------------------------------------------------
+
+    def finish(self, req: Request) -> None:
+        if req in self.running:
+            self.running.remove(req)
+        self._release(req)
+
+    def _release(self, req: Request) -> None:
+        if req.blocks is not None:
+            req.blocks.release()
+            req.blocks = None
+
+    # -- accounting (Prometheus) ------------------------------------------
+
+    def kv_stats(self) -> dict:
+        a = self.allocator
+        return {
+            "num_blocks": a.num_blocks - 1,
+            "block_size": a.block_size,
+            "total_tokens": a.usable_tokens,
+            "used_blocks": a.num_used_blocks,
+            "free_blocks": a.num_free_blocks,
+            "num_waiting": len(self.waiting),
+            "num_running": len(self.running),
+            "num_preemptions": self.num_preemptions,
+        }

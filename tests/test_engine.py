@@ -1,0 +1,177 @@
+"""Continuous-batching engine tests.
+
+The hardest correctness surface of the rebuild (SURVEY.md §7 step 4):
+batching-invariance (a request's output must not depend on its batchmates),
+preemption + recompute, stop conditions under pipelined readback, KV block
+accounting. Greedy sampling + tiny fp32 model => deterministic oracles.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.models.config import PRESETS
+from agentic_traffic_testing_tpu.models.llama import init_params
+from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+from agentic_traffic_testing_tpu.runtime.request import FinishReason, SamplingParams
+from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+CFG = PRESETS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def runner():
+    params = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+    return ModelRunner(CFG, params)
+
+
+def make_engine(runner, **kw):
+    kw.setdefault("model", "tiny")
+    kw.setdefault("dtype", "float32")
+    kw.setdefault("max_model_len", 128)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_num_seqs", 4)
+    ecfg = EngineConfig(**kw)
+    return LLMEngine(ecfg, model_cfg=CFG, runner=runner)
+
+
+def greedy(max_tokens=8, **kw):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0, **kw)
+
+
+def run_all(engine, reqs):
+    for _ in range(10_000):
+        engine.step()
+        if all(r.is_finished() for r in reqs):
+            return
+        if not engine.has_work():
+            break
+    assert all(r.is_finished() for r in reqs), [r.state for r in reqs]
+
+
+def test_single_request_greedy(runner):
+    eng = make_engine(runner)
+    rng = np.random.default_rng(0)
+    req = eng.generate(rng.integers(0, CFG.vocab_size, 12).tolist(), greedy(10))
+    assert req.finish_reason == FinishReason.LENGTH
+    assert len(req.generated_ids) == 10
+    assert req.queue_wait_s is not None and req.queue_wait_s >= 0
+
+
+def test_batching_invariance(runner):
+    """Outputs identical whether a request runs alone or with 3 batchmates."""
+    rng = np.random.default_rng(1)
+    prompts = [rng.integers(0, CFG.vocab_size, n).tolist() for n in (5, 11, 17, 9)]
+
+    solo_outputs = []
+    for p in prompts:
+        eng = make_engine(runner)
+        solo_outputs.append(eng.generate(p, greedy(12)).generated_ids)
+
+    eng = make_engine(runner)
+    reqs = [eng.add_request(p, greedy(12)) for p in prompts]
+    run_all(eng, reqs)
+    for r, solo in zip(reqs, solo_outputs):
+        assert r.generated_ids == solo, "batched output diverged from solo run"
+
+
+def test_streaming_events_reconstruct_output(runner):
+    eng = make_engine(runner)
+    rng = np.random.default_rng(2)
+    req = eng.add_request(rng.integers(0, CFG.vocab_size, 7).tolist(), greedy(9))
+    seen = []
+    for _ in range(1000):
+        for ev in eng.step():
+            if ev.request is req:
+                seen.extend(ev.new_token_ids)
+        if req.is_finished() and not eng.has_work():
+            break
+    # Drain any trailing events
+    for ev in eng.step():
+        if ev.request is req:
+            seen.extend(ev.new_token_ids)
+    assert seen == req.generated_ids
+
+
+def test_stop_token_truncates(runner):
+    """Find the greedy continuation, then re-run with its 3rd token as a stop id."""
+    rng = np.random.default_rng(3)
+    prompt = rng.integers(0, CFG.vocab_size, 6).tolist()
+    eng = make_engine(runner)
+    free = eng.generate(prompt, greedy(8)).generated_ids
+    stop_tok = free[2]
+
+    eng = make_engine(runner)
+    req = eng.generate(prompt, greedy(8, stop_token_ids=(stop_tok,)))
+    assert req.finish_reason == FinishReason.STOP
+    assert req.generated_ids == free[:3], "must stop exactly at (and include) the stop token"
+
+
+def test_preemption_recompute_exact(runner):
+    """A KV pool too small for both requests forces preemption; outputs must
+    still match the solo oracles exactly."""
+    rng = np.random.default_rng(4)
+    p1 = rng.integers(0, CFG.vocab_size, 30).tolist()
+    p2 = rng.integers(0, CFG.vocab_size, 30).tolist()
+
+    solos = []
+    for p in (p1, p2):
+        eng = make_engine(runner)
+        solos.append(eng.generate(p, greedy(16)).generated_ids)
+
+    # 12 usable blocks * 8 = 96 tokens < two seqs' peak 2*(30+16+4) = 100:
+    # both admit (5 blocks each) but growth must preempt one.
+    eng = make_engine(runner, num_blocks=13)
+    reqs = [eng.add_request(p1, greedy(16)), eng.add_request(p2, greedy(16))]
+    run_all(eng, reqs)
+    assert [r.generated_ids for r in reqs] == solos
+    assert eng.scheduler.num_preemptions > 0, "KV pool was sized to force preemption"
+
+
+def test_max_model_len_stops_generation(runner):
+    eng = make_engine(runner, max_model_len=32)
+    rng = np.random.default_rng(5)
+    req = eng.generate(rng.integers(0, CFG.vocab_size, 20).tolist(), greedy(1000))
+    assert req.finish_reason == FinishReason.LENGTH
+    assert req.total_len <= 32
+
+
+def test_kv_blocks_all_freed_after_completion(runner):
+    eng = make_engine(runner)
+    rng = np.random.default_rng(6)
+    reqs = [eng.add_request(rng.integers(0, CFG.vocab_size, 9).tolist(), greedy(6))
+            for _ in range(3)]
+    run_all(eng, reqs)
+    stats = eng.kv_stats()
+    assert stats["used_blocks"] == 0, stats
+    assert stats["num_running"] == 0 and stats["num_waiting"] == 0
+
+
+def test_temperature_reproducible_across_batches(runner):
+    """Seeded sampling must give identical output solo vs batched."""
+    rng = np.random.default_rng(7)
+    p = rng.integers(0, CFG.vocab_size, 10).tolist()
+    sp = lambda: SamplingParams(max_tokens=10, temperature=0.8, top_k=20, seed=1234)
+
+    eng = make_engine(runner)
+    solo = eng.generate(p, sp()).generated_ids
+
+    eng = make_engine(runner)
+    other = [eng.add_request(rng.integers(0, CFG.vocab_size, 8).tolist(), greedy(10))
+             for _ in range(2)]
+    req = eng.add_request(p, sp())
+    run_all(eng, other + [req])
+    assert req.generated_ids == solo
+
+
+def test_more_requests_than_max_num_seqs(runner):
+    eng = make_engine(runner, max_num_seqs=2)
+    rng = np.random.default_rng(8)
+    reqs = [eng.add_request(rng.integers(0, CFG.vocab_size, 5).tolist(), greedy(5))
+            for _ in range(6)]
+    run_all(eng, reqs)
+    for r in reqs:
+        assert len(r.generated_ids) == 5
