@@ -120,11 +120,19 @@ def _unembed(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
 
 
 def forward_full_impl(params: Params, cfg: ModelConfig, tokens: jax.Array,
-                      positions: Optional[jax.Array] = None) -> jax.Array:
-    """Causal LM forward. tokens [B, T] -> logits [B, T, V] (fp32)."""
+                      positions: Optional[jax.Array] = None,
+                      attn_fn=None) -> jax.Array:
+    """Causal LM forward. tokens [B, T] -> logits [B, T, V] (fp32).
+
+    `attn_fn(q, k, v, q_positions=..., kv_valid_len=...)` overrides the
+    attention site — the sequence-parallel training path swaps in ring
+    attention (ops/ring_attention.py) here.
+    """
     b, t = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    if attn_fn is None:
+        attn_fn = causal_attention
     x = params["tok_embed"][tokens]
     sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     seq_lens = jnp.full((b,), t, jnp.int32)
@@ -134,7 +142,7 @@ def forward_full_impl(params: Params, cfg: ModelConfig, tokens: jax.Array,
         q, k, v = _qkv(xa, lp, cfg)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        attn = causal_attention(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
+        attn = attn_fn(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
         x = x + attn.reshape(b, t, -1) @ lp["wo"]
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         x = x + _mlp_block(xm, lp)

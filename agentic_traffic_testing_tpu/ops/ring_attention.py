@@ -1,0 +1,126 @@
+"""Ring attention: causal self-attention over a sequence-sharded mesh axis.
+
+The reference testbed has NO sequence parallelism — long context is handled by
+truncation only (reference: llm/serve_llm.py:812-844; SURVEY.md §5.7). The TPU
+rebuild makes long-context first-class: the sequence dim is sharded over the
+`sp` mesh axis and KV shards rotate around the ring via `lax.ppermute` (one
+ICI hop per step) while each chip accumulates its queries' attention with a
+streaming (flash-style) softmax. Peak memory per chip is O(T/sp), compute
+overlaps with the neighbor transfer, and the math is exact — identical logits
+to full causal attention.
+
+Layout inside shard_map (per chip):
+    q       [B, Tl, H, hd]    Tl = T / sp, global positions i*Tl..(i+1)*Tl
+    k, v    [B, Tl, KH, hd]   GQA repeats handled here
+The `tp` axis may additionally shard H/KH outside this function; the ring
+only communicates over `sp`.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from agentic_traffic_testing_tpu.ops.jnp_ops import repeat_kv
+
+NEG = jnp.float32(-1e30)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact causal attention over an `axis_name`-sharded sequence.
+
+    Must be called inside shard_map/pjit manual mode with `axis_name` bound.
+    Returns [B, Tl, H, hd] in q.dtype.
+    """
+    b, tl, h, hd = q.shape
+    kh = k.shape[2]
+    sp = jax.lax.axis_size(axis_name)
+    my = jax.lax.axis_index(axis_name)
+    scale = scale if scale is not None else 1.0 / math.sqrt(hd)
+
+    qf = q.astype(jnp.float32) * scale
+    q_pos = my * tl + jnp.arange(tl, dtype=jnp.int32)          # [Tl] global
+
+    perm = [(j, (j + 1) % sp) for j in range(sp)]
+
+    def accum(state, k_blk, v_blk, step):
+        """Fold one KV shard into the streaming softmax. k/v_blk are the raw
+        [B, Tl, KH, hd] shards (original dtype); GQA-repeat and fp32 cast
+        happen here so only the small raw shards ride the ring."""
+        m, l, acc = state
+        kf = repeat_kv(k_blk, h // kh).astype(jnp.float32)
+        vf = repeat_kv(v_blk, h // kh).astype(jnp.float32)
+        # After `step` rotations this chip holds the shard that started life
+        # on chip (my - step) mod sp.
+        src = (my - step) % sp
+        kv_pos = src * tl + jnp.arange(tl, dtype=jnp.int32)    # [Tl] global
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)         # [B,H,Tl,Tl]
+        mask = kv_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        logits = jnp.where(mask, logits, NEG)
+
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))        # [B,H,Tl]
+        # Rows with no unmasked kv yet keep m == NEG; exp(NEG - NEG) would be
+        # exp(0)=1 on garbage — gate the correction instead.
+        corr = jnp.where(m > NEG / 2, jnp.exp(m - m_new), 0.0)
+        p = jnp.exp(logits - m_new[..., None])
+        p = jnp.where(mask, p, 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vf)
+        return (m_new, l_new, acc_new)
+
+    def block(carry, step):
+        k_blk, v_blk, state = carry
+        state = accum(state, k_blk, v_blk, step)
+        k_nxt = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = jax.lax.ppermute(v_blk, axis_name, perm)
+        return (k_nxt, v_nxt, state), None
+
+    state0 = (
+        jnp.full((b, h, tl), NEG),
+        jnp.zeros((b, h, tl), jnp.float32),
+        jnp.zeros((b, h, tl, hd), jnp.float32),
+    )
+    # sp-1 (rotate, accumulate) rounds, then fold the last shard without the
+    # wasted final rotation.
+    (k_last, v_last, state), _ = jax.lax.scan(
+        block, (k, v, state0), jnp.arange(sp - 1, dtype=jnp.int32)
+    )
+    _, l, acc = accum(state, k_last, v_last, jnp.int32(sp - 1))
+    out = acc / jnp.maximum(l, 1e-30)[..., None]               # [B,H,Tl,hd]
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)           # [B,Tl,H,hd]
+
+
+def make_sp_attention(mesh: Mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
+                      tp_axis: str = "tp"):
+    """Wrap `ring_attention` in shard_map over a (dp, sp, tp) mesh.
+
+    Returns attn(q, k, v) for q [B, T, H, hd] / kv [B, T, KH, hd] with
+    B sharded on dp, T on sp, heads on tp. Positions are the implicit global
+    arange 0..T — callers with packed/offset sequences must NOT use this
+    (training/train.py's adapter documents the same restriction).
+    """
+    qs = P(dp_axis, sp_axis, tp_axis, None)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(qs, qs, qs),
+        out_specs=qs,
+        check_vma=False,
+    )
+    def attn(q, k, v):
+        return ring_attention(q, k, v, axis_name=sp_axis)
+
+    return attn

@@ -1,0 +1,83 @@
+"""Device-mesh construction for TPU slices.
+
+The reference testbed's only intra-model parallelism knob is vLLM's
+`tensor_parallel_size` backed by NCCL (reference: llm/config/llama-3.1-8b.yaml:2,
+SURVEY.md §2.3/§2.4). The TPU rebuild makes the mesh first-class: every
+parallelism axis is a named `jax.sharding.Mesh` dimension and all collectives
+are XLA collectives riding ICI (intra-slice) / DCN (cross-slice).
+
+Axis vocabulary (scaling-book convention):
+    dp  — data parallel (batch dim; gradient psum in training, request-level in serving)
+    sp  — sequence/context parallel (ring attention over ICI neighbors)
+    tp  — tensor parallel (head/feature dim; all-reduce after row-parallel matmuls)
+
+A serving deployment is usually `make_mesh(tp=N)`; training uses all three.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXIS_DP = "dp"
+AXIS_SP = "sp"
+AXIS_TP = "tp"
+MESH_AXES = (AXIS_DP, AXIS_SP, AXIS_TP)
+
+
+def make_mesh(
+    dp: int = 1,
+    sp: int = 1,
+    tp: int = 1,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a (dp, sp, tp) mesh over the first dp*sp*tp devices.
+
+    On real hardware, `jax.devices()` order follows the physical torus, so
+    the innermost axis (tp) lands on nearest ICI neighbors — the axis with
+    the most chatter (per-layer all-reduces) gets the shortest hops, then sp
+    (ring ppermutes), then dp (one psum per step).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    n = dp * sp * tp
+    if len(devices) < n:
+        raise ValueError(f"mesh ({dp},{sp},{tp}) needs {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(dp, sp, tp)
+    return Mesh(arr, MESH_AXES)
+
+
+def auto_mesh_shape(n_devices: int) -> tuple[int, int, int]:
+    """Factor a device count into a (dp, sp, tp) shape for dry runs.
+
+    Policy: give tp the largest power-of-two factor up to 4, then sp up to 2,
+    remainder to dp — exercises every axis once n_devices >= 8.
+    """
+    tp = 1
+    rem = n_devices
+    for cand in (4, 2):
+        if rem % cand == 0:
+            tp = cand
+            rem //= cand
+            break
+    sp = 2 if rem % 2 == 0 else 1
+    rem //= sp
+    return rem, sp, tp
+
+
+def single_axis_mesh(axis: str, n: Optional[int] = None,
+                     devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """A 1-axis mesh (e.g. pure-TP serving); other axes sized 1."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = n or len(devices)
+    sizes = {AXIS_DP: 1, AXIS_SP: 1, AXIS_TP: 1}
+    if axis not in sizes:
+        raise ValueError(f"unknown axis {axis!r}")
+    sizes[axis] = n
+    return make_mesh(sizes[AXIS_DP], sizes[AXIS_SP], sizes[AXIS_TP], devices)
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,94 @@
+"""Tensor-parallel sharding specs for the Llama parameter/cache pytrees.
+
+Megatron-style TP expressed as `PartitionSpec`s and left to XLA's SPMD
+partitioner (the scaling-book recipe: annotate, compile, let XLA insert the
+collectives over ICI). This replaces the NCCL tensor parallelism the reference
+delegates to vLLM (reference: llm/config/llama-3.1-8b.yaml:2,7-9; SURVEY.md §2.2).
+
+Layout (param schema from models/llama.py:init_params, stacked [L, ...]):
+    wq/wk/wv  [L, D, Hhd]  column-parallel -> shard output dim on `tp`
+    wo        [L, Hhd, D]  row-parallel    -> shard input  dim on `tp`
+                            (XLA inserts the all-reduce after x @ wo)
+    w_gate/up [L, D, F]    column-parallel
+    w_down    [L, F, D]    row-parallel
+    norms     [·, D]       replicated
+    tok_embed [V, D]       V-sharded when tied to lm_head (Megatron vocab-
+                            parallel), D-sharded otherwise (local gather)
+    lm_head   [V, D]       V-sharded -> logits arrive V-sharded; sampling's
+                            argmax/sort reductions run as XLA collectives
+    KV cache  [L, nb, bs, KH, hd] shard KV heads on `tp`
+
+Constraint: tp must divide num_kv_heads (KV-head sharding) and num_heads.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from agentic_traffic_testing_tpu.models.config import ModelConfig
+from agentic_traffic_testing_tpu.parallel.mesh import AXIS_TP
+from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
+
+
+def validate_tp(cfg: ModelConfig, tp: int) -> None:
+    if tp <= 1:
+        return
+    if cfg.num_kv_heads % tp or cfg.num_heads % tp:
+        raise ValueError(
+            f"tp={tp} must divide num_heads={cfg.num_heads} and "
+            f"num_kv_heads={cfg.num_kv_heads} ({cfg.name})"
+        )
+
+
+def param_pspecs(cfg: ModelConfig) -> dict:
+    """PartitionSpec pytree matching init_params(cfg)'s structure."""
+    layers = {
+        "ln_attn": P(None, None),
+        "ln_mlp": P(None, None),
+        "wq": P(None, None, AXIS_TP),
+        "wk": P(None, None, AXIS_TP),
+        "wv": P(None, None, AXIS_TP),
+        "wo": P(None, AXIS_TP, None),
+        "w_gate": P(None, None, AXIS_TP),
+        "w_up": P(None, None, AXIS_TP),
+        "w_down": P(None, AXIS_TP, None),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = P(None, AXIS_TP)
+        layers["bk"] = P(None, AXIS_TP)
+        layers["bv"] = P(None, AXIS_TP)
+    specs: dict = {
+        # Tied embeddings double as the lm_head -> must be vocab-sharded;
+        # untied embeddings shard D so the token gather stays chip-local.
+        "tok_embed": P(AXIS_TP, None) if cfg.tie_word_embeddings else P(None, AXIS_TP),
+        "layers": layers,
+        "final_norm": P(None),
+    }
+    if not cfg.tie_word_embeddings:
+        specs["lm_head"] = P(AXIS_TP, None)
+    return specs
+
+
+def kv_cache_pspecs() -> KVCache:
+    spec = P(None, None, None, AXIS_TP, None)
+    return KVCache(k=spec, v=spec)
+
+
+def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
+    """device_put a pytree onto the mesh under the given PartitionSpecs."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs,
+        is_leaf=lambda x: x is None,
+    )
+
+
+def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
+    validate_tp(cfg, mesh.shape[AXIS_TP])
+    return shard_pytree(params, param_pspecs(cfg), mesh)
+
+
+def shard_kv_cache(cache: KVCache, mesh: Mesh) -> KVCache:
+    return shard_pytree(cache, kv_cache_pspecs(), mesh)

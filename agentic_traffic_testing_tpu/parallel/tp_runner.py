@@ -1,0 +1,44 @@
+"""Tensor-parallel ModelRunner: same jitted step programs, sharded pytrees.
+
+The single-device runner's prefill/decode jits are mesh-agnostic; tensor
+parallelism enters purely through input shardings (params column/row-sharded,
+KV cache head-sharded). XLA's SPMD partitioner then emits the per-layer
+all-reduces over ICI — the role NCCL plays inside vLLM for the reference
+(reference: llm/config/llama-3.1-8b.yaml:2; SURVEY.md §2.4).
+
+Host-side batch arrays (tokens, block tables, sampling params) stay
+replicated: they are tiny, and every chip runs the identical program.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+from agentic_traffic_testing_tpu.models.config import ModelConfig
+from agentic_traffic_testing_tpu.parallel.mesh import AXIS_TP
+from agentic_traffic_testing_tpu.parallel.sharding import (
+    shard_kv_cache,
+    shard_params,
+    validate_tp,
+)
+from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
+from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+
+class TPRunner(ModelRunner):
+    """Runner whose params/cache live sharded on a `tp` mesh axis."""
+
+    def __init__(self, cfg: ModelConfig, params, mesh: Mesh) -> None:
+        validate_tp(cfg, mesh.shape[AXIS_TP])
+        self.mesh = mesh
+        params = shard_params(params, cfg, mesh)
+        super().__init__(cfg, params)
+
+    @property
+    def tp_size(self) -> int:
+        return self.mesh.shape[AXIS_TP]
+
+    def prepare_cache(self, cache: KVCache) -> KVCache:
+        """Shard a freshly allocated KV cache across KV heads."""
+        return shard_kv_cache(cache, self.mesh)

@@ -129,7 +129,9 @@ class LLMEngine:
             self.runner = ModelRunner(self.model_cfg, params)
 
         num_blocks = cfg.num_blocks or self._default_num_blocks()
-        self.cache = make_kv_cache(self.model_cfg, num_blocks, cfg.block_size, dtype)
+        self.cache = self.runner.prepare_cache(
+            make_kv_cache(self.model_cfg, num_blocks, cfg.block_size, dtype)
+        )
         self.allocator = BlockAllocator(num_blocks, cfg.block_size)
         self.scheduler = Scheduler(cfg.scheduler_config(), self.allocator)
         # Fixed block-table width: worst-case blocks for max_model_len.
@@ -164,6 +166,7 @@ class LLMEngine:
         n = profile_num_blocks(
             self.model_cfg, self.cfg.block_size, free,
             self.cfg.memory_utilization, bytes_per,
+            tp_size=self.runner.tp_size,
         )
         # Never exceed what max_num_seqs * max_model_len can actually use.
         cap = self.cfg.max_num_seqs * self.table_width + 1

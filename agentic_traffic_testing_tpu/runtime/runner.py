@@ -78,6 +78,13 @@ class ModelRunner:
             partial(_decode_sample_impl, cfg=cfg), donate_argnames=("cache",)
         )
 
+    #: chips the KV cache is sharded across (overridden by parallel/tp_runner.py)
+    tp_size: int = 1
+
+    def prepare_cache(self, cache: KVCache) -> KVCache:
+        """Hook for placing a freshly allocated cache (TP runner shards it)."""
+        return cache
+
     def prefill(self, tokens, cache, block_tables, seq_lens, samp, steps):
         """-> (DecodeState, cache, sampled_first_tokens [B])."""
         return self._prefill(self.params, tokens=tokens, cache=cache,

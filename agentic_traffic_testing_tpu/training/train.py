@@ -1,0 +1,128 @@
+"""Sharded causal-LM training step over a (dp, sp, tp) mesh.
+
+The reference testbed is inference-only (SURVEY.md §5.4: "no training
+anywhere"); the TPU framework ships training as a first-class capability so
+the same model/ops stack covers fine-tuning the models it serves. Design is
+the scaling-book recipe: pick a mesh, annotate param/batch shardings, let
+XLA's SPMD partitioner insert the collectives —
+    dp: gradient psum (batch dim sharded)
+    sp: ring attention over ICI (ops/ring_attention.py, exact causal)
+    tp: Megatron column/row param sharding (parallel/sharding.py), per-layer
+        all-reduce on the row-parallel matmul outputs
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from agentic_traffic_testing_tpu.models.config import ModelConfig
+from agentic_traffic_testing_tpu.models.llama import forward_full_impl, init_params
+from agentic_traffic_testing_tpu.ops.ring_attention import make_sp_attention
+from agentic_traffic_testing_tpu.parallel.mesh import AXIS_DP, AXIS_SP, AXIS_TP
+from agentic_traffic_testing_tpu.parallel.sharding import param_pspecs, shard_params
+
+
+def causal_lm_loss(
+    logits: jax.Array,   # [B, T, V] fp32
+    tokens: jax.Array,   # [B, T] int32
+    mask: jax.Array,     # [B, T] 1.0 on real tokens
+) -> jax.Array:
+    """Mean next-token cross-entropy over unmasked positions."""
+    targets = tokens[:, 1:]
+    lp = jax.nn.log_softmax(logits[:, :-1], axis=-1)
+    nll = -jnp.take_along_axis(lp, targets[..., None], axis=-1)[..., 0]
+    m = mask[:, 1:]
+    return jnp.sum(nll * m) / jnp.maximum(jnp.sum(m), 1.0)
+
+
+@dataclasses.dataclass
+class TrainStep:
+    """A jitted, mesh-sharded (loss, grads, update) step."""
+
+    step_fn: Any          # (params, opt_state, tokens, mask) -> (params, opt_state, loss)
+    optimizer: optax.GradientTransformation
+    mesh: Mesh
+
+    def __call__(self, params, opt_state, tokens, mask):
+        return self.step_fn(params, opt_state, tokens, mask)
+
+
+def make_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    remat: bool = True,
+) -> TrainStep:
+    """Build the jitted train step for `cfg` over `mesh`.
+
+    Batch layout: tokens/mask [B, T] sharded P(dp, sp); B % dp == 0 and
+    T % sp == 0. When sp > 1 the attention site runs ring attention via
+    shard_map; tp shards heads inside the same shard_map. `remat`
+    checkpoints the layer scan body — the standard HBM-for-FLOPs trade on
+    TPU for long sequences.
+    """
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+    sp = mesh.shape[AXIS_SP]
+    attn_fn = None
+    if sp > 1:
+        ring = make_sp_attention(mesh)
+
+        def attn_fn(q, k, v, *, q_positions=None, kv_valid_len=None):
+            # Ring attention derives positions from the global arange; this
+            # adapter is only valid for the contiguous full-sequence forward
+            # (loss_fn below never passes custom positions). kv_valid_len is
+            # the full T by construction there.
+            return ring(q, k, v)
+
+    def loss_fn(params, tokens, mask):
+        fwd = forward_full_impl
+        if remat:
+            fwd = jax.checkpoint(
+                partial(forward_full_impl, attn_fn=attn_fn), static_argnums=(1,)
+            )
+            logits = fwd(params, cfg, tokens)
+        else:
+            logits = fwd(params, cfg, tokens, attn_fn=attn_fn)
+        return causal_lm_loss(logits, tokens, mask)
+
+    batch_sharding = NamedSharding(mesh, P(AXIS_DP, AXIS_SP))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step_fn(params, opt_state, tokens, mask):
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_sharding)
+        mask = jax.lax.with_sharding_constraint(mask, batch_sharding)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return TrainStep(step_fn=step_fn, optimizer=optimizer, mesh=mesh)
+
+
+def init_train_state(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    seed: int = 0,
+    dtype=jnp.float32,
+):
+    """Random-init params sharded per TP specs + matching optimizer state.
+
+    `optax` inits moments with `zeros_like`, which preserves input sharding,
+    so the optimizer state lands sharded exactly like the params.
+    """
+    params = init_params(cfg, jax.random.key(seed), dtype=dtype)
+    params = shard_params(params, cfg, mesh)
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state
+
+
+def batch_pspec() -> P:
+    return P(AXIS_DP, AXIS_SP)

@@ -1,0 +1,89 @@
+#!/usr/bin/env python3
+"""Headline benchmark: continuous-batching decode throughput on one chip.
+
+Prints exactly one JSON line:
+    {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N}
+
+Workload: `BENCH_BATCH` (default 8) concurrent requests, 128-token prompts,
+64 decode steps each, greedy — the shape of the agent-b fan-out load the
+reference testbed generates (BASELINE.md §2 "Fan-out workload"). The model is
+the Llama-3.2-1B architecture (reference default family, randomly initialized
+— no weight downloads in this environment) in bf16.
+
+The reference publishes no measured numbers (BASELINE.md: "blank scoreboard"),
+so `vs_baseline` is the ratio against NOMINAL_BASELINE_TOKS_S — a fixed
+scoreboard constant standing in for a single-GPU vLLM figure on the same
+model class — to make round-over-round movement visible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+NOMINAL_BASELINE_TOKS_S = {
+    # Scoreboard constants (reference publishes none; see BASELINE.md §3).
+    "llama-3.2-1b": 2000.0,
+    "llama-3.2-3b": 1200.0,
+    "llama-3.1-8b": 600.0,
+    "debug-512": 2000.0,
+    "tiny": 2000.0,
+}
+
+
+def main() -> None:
+    import jax
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+    platform = jax.devices()[0].platform
+    default_model = "llama-3.2-1b" if platform == "tpu" else "debug-512"
+    model = os.environ.get("BENCH_MODEL", default_model)
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
+    decode_tokens = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
+
+    cfg = EngineConfig(
+        model=model,
+        dtype="bfloat16",
+        max_num_seqs=batch,
+        max_model_len=max(512, prompt_len + decode_tokens + 16),
+        num_blocks=None if platform == "tpu" else 1024,
+    )
+    engine = LLMEngine(cfg)
+    rng = np.random.default_rng(0)
+    vocab = engine.model_cfg.vocab_size
+
+    def run_batch() -> tuple[float, int]:
+        reqs = []
+        for _ in range(batch):
+            ids = rng.integers(10, vocab - 10, prompt_len).tolist()
+            reqs.append(engine.add_request(
+                ids, SamplingParams(temperature=0.0, max_tokens=decode_tokens,
+                                    ignore_eos=True)))
+        t0 = time.monotonic()
+        while engine.has_work() and not all(r.is_finished() for r in reqs):
+            engine.step()
+        dt = time.monotonic() - t0
+        toks = sum(len(r.output_ids) for r in reqs)
+        return dt, toks
+
+    run_batch()                 # warmup: compiles prefill + decode programs
+    dt, toks = run_batch()      # timed, steady-state
+    value = toks / dt
+    nominal = NOMINAL_BASELINE_TOKS_S.get(model, 2000.0)
+    print(json.dumps({
+        "metric": f"decode_throughput_{model}_bs{batch}_{platform}",
+        "value": round(value, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(value / nominal, 4),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
