@@ -52,19 +52,15 @@ def make_mesh(
 def auto_mesh_shape(n_devices: int) -> tuple[int, int, int]:
     """Factor a device count into a (dp, sp, tp) shape for dry runs.
 
-    Policy: give tp the largest power-of-two factor up to 4, then sp up to 2,
-    remainder to dp — exercises every axis once n_devices >= 8.
+    Policy: exercise every axis the count allows — tp=2 and sp=2 first
+    (collective-bearing axes), remainder to dp. tp stays small so it divides
+    the KV-head counts of even the tiny test configs.
     """
-    tp = 1
-    rem = n_devices
-    for cand in (4, 2):
-        if rem % cand == 0:
-            tp = cand
-            rem //= cand
-            break
-    sp = 2 if rem % 2 == 0 else 1
-    rem //= sp
-    return rem, sp, tp
+    if n_devices % 4 == 0:
+        return (n_devices // 4, 2, 2)
+    if n_devices % 2 == 0:
+        return (n_devices // 2, 1, 2)
+    return (n_devices, 1, 1)
 
 
 def single_axis_mesh(axis: str, n: Optional[int] = None,

@@ -1,0 +1,5 @@
+"""`python -m agentic_traffic_testing_tpu.serving` — run the LLM backend."""
+
+from agentic_traffic_testing_tpu.serving.server import main
+
+main()

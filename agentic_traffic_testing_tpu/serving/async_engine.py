@@ -1,0 +1,176 @@
+"""AsyncLLMEngine: asyncio façade over the synchronous continuous-batching
+engine, preserving streaming/TTFT semantics.
+
+The reference consumes vLLM's `AsyncLLMEngine` via `async for` over per-step
+outputs (reference: llm/serve_llm.py:527-605). Here the analog is explicit:
+one daemon thread owns the TPU dispatch loop (LLMEngine.step), requests enter
+through a thread-safe queue, and per-token events flow back to each waiting
+coroutine via `loop.call_soon_threadsafe`. The aiohttp event loop therefore
+never blocks on device work, and the engine thread never touches asyncio
+state directly.
+
+Design notes:
+  * One engine thread, not an executor pool — LLMEngine is intentionally
+    single-threaded (device order matters); serialization is the point.
+  * When idle, the thread parks on the submission queue (blocking get with
+    timeout) instead of spinning.
+  * `generate()` yields (new_token_ids, finished) increments; the HTTP layer
+    detokenizes incrementally and timestamps the first increment as TTFT.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+import queue
+import threading
+import uuid
+from typing import AsyncIterator, Callable, Optional
+
+from agentic_traffic_testing_tpu.runtime.engine import LLMEngine
+from agentic_traffic_testing_tpu.runtime.request import Request, SamplingParams
+
+log = logging.getLogger("att_tpu.async_engine")
+
+
+@dataclasses.dataclass
+class TokenEvent:
+    """One streamed increment for a request."""
+
+    new_token_ids: list[int]
+    finished: bool
+    request: Request
+
+
+class _Stream:
+    __slots__ = ("aq", "loop")
+
+    def __init__(self, loop: asyncio.AbstractEventLoop) -> None:
+        self.aq: asyncio.Queue = asyncio.Queue()
+        self.loop = loop
+
+    def push(self, ev: TokenEvent) -> bool:
+        """False if the client's event loop is gone (stream is dead)."""
+        try:
+            self.loop.call_soon_threadsafe(self.aq.put_nowait, ev)
+            return True
+        except RuntimeError:  # loop closed mid-generation
+            return False
+
+
+class AsyncLLMEngine:
+    """Threaded asyncio wrapper. Create, then `await start()`."""
+
+    def __init__(self, engine: LLMEngine,
+                 on_step: Optional[Callable[[int], None]] = None) -> None:
+        self.engine = engine
+        self._on_step = on_step          # per-step batch-size observer (metrics)
+        self._submit_q: queue.Queue = queue.Queue()
+        self._streams: dict[str, _Stream] = {}
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._run, name="engine-loop",
+                                        daemon=True)
+        self._started = False
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if not self._started:
+            self._started = True
+            self._thread.start()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._started:
+            self._thread.join(timeout=5)
+
+    # -- request API (event loop side) -------------------------------------
+
+    async def generate(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[TokenEvent]:
+        """Stream token increments for one request."""
+        rid = request_id or uuid.uuid4().hex[:16]
+        stream = _Stream(asyncio.get_running_loop())
+        self._submit_q.put((rid, list(prompt_ids), sampling, stream))
+        while True:
+            ev = await stream.aq.get()
+            yield ev
+            if ev.finished:
+                return
+
+    # -- engine thread ------------------------------------------------------
+
+    def _drain_submissions(self, block: bool) -> None:
+        timeout = 0.02 if block else None
+        while True:
+            try:
+                item = self._submit_q.get(block=block, timeout=timeout)
+            except queue.Empty:
+                return
+            block = False  # only the first get may block
+            rid, prompt_ids, sampling, stream = item
+            self._streams[rid] = stream
+            self.engine.add_request(prompt_ids, sampling, request_id=rid)
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._drain_submissions(block=not self.engine.has_work())
+            if not self.engine.has_work():
+                continue
+            try:
+                events = self.engine.step()
+            except Exception:
+                log.exception("engine step failed; failing all live requests")
+                self._fail_all()
+                continue
+            if self._on_step is not None and events:
+                self._on_step(sum(1 for e in events if e.new_token_ids))
+            for e in events:
+                stream = self._streams.get(e.request.request_id)
+                if stream is None:
+                    continue
+                alive = stream.push(
+                    TokenEvent(list(e.new_token_ids), e.finished, e.request))
+                if e.finished:
+                    del self._streams[e.request.request_id]
+                elif not alive:
+                    # Client loop is gone: stop paying for this generation.
+                    del self._streams[e.request.request_id]
+                    self.engine.abort_request(e.request)
+
+    def _fail_all(self) -> None:
+        """Abort every live request in the engine and notify its stream.
+
+        Both sides must be cleaned up: streams (so waiting coroutines get a
+        terminal event) AND engine state (so has_work() goes false — otherwise
+        the loop would re-raise the same step() exception forever).
+        """
+        from agentic_traffic_testing_tpu.runtime.request import (
+            FinishReason,
+            RequestState,
+        )
+
+        for rid, stream in list(self._streams.items()):
+            req = self.engine._requests.get(rid)
+            if req is not None:
+                try:
+                    self.engine.abort_request(req)
+                except Exception:
+                    log.exception("abort failed for %s", rid)
+            else:
+                req = Request(request_id=rid, prompt_ids=[], sampling=SamplingParams())
+            req.state = RequestState.ABORTED
+            req.finish_reason = FinishReason.ERROR
+            stream.push(TokenEvent([], True, req))
+        self._streams.clear()
+        # Belt and braces: anything still scheduled without a stream.
+        for req in list(self.engine._requests.values()):
+            try:
+                self.engine.abort_request(req)
+            except Exception:
+                log.exception("abort failed for %s", req.request_id)

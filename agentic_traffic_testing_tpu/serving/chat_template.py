@@ -1,0 +1,45 @@
+"""Chat templating for raw prompts.
+
+Mirrors the reference behavior (reference: llm/serve_llm.py:637-678): prefer
+the tokenizer's own chat template when available, otherwise construct the
+Llama-3 Instruct format manually. The manual format is also what the byte
+tokenizer round-trips through its special tokens, so the CI path exercises
+the same token structure real models see.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def build_messages(prompt: str, system_prompt: Optional[str],
+                   default_system_prompt: str) -> list[dict]:
+    messages = []
+    sys_prompt = system_prompt or default_system_prompt
+    if sys_prompt:
+        messages.append({"role": "system", "content": sys_prompt})
+    messages.append({"role": "user", "content": prompt})
+    return messages
+
+
+def llama3_format(messages: list[dict]) -> str:
+    """Manual Llama-3 Instruct format (reference fallback: serve_llm.py:672-678)."""
+    parts = ["<|begin_of_text|>"]
+    for msg in messages:
+        parts.append(
+            f"<|start_header_id|>{msg['role']}<|end_header_id|>\n\n{msg['content']}<|eot_id|>"
+        )
+    parts.append("<|start_header_id|>assistant<|end_header_id|>\n\n")
+    return "".join(parts)
+
+
+def apply_chat_template(tokenizer, prompt: str, system_prompt: Optional[str],
+                        default_system_prompt: str) -> str:
+    """Format a raw prompt for instruct-tuned generation."""
+    messages = build_messages(prompt, system_prompt, default_system_prompt)
+    tpl = getattr(tokenizer, "apply_chat_template", None)
+    if tpl is not None:
+        formatted = tpl(messages)
+        if formatted is not None:
+            return formatted
+    return llama3_format(messages)

@@ -1,0 +1,110 @@
+"""Server configuration: env catalog + CLI, mirroring the reference's LLM_*
+variables so compose files and agent-side guardrail math work unchanged
+(reference: llm/serve_llm.py:52-82 env reads, :1049-1104 CLI mirror;
+SURVEY.md §2.1/§5.6)."""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import os
+from typing import Optional
+
+
+def _env_bool(name: str, default: str = "1") -> bool:
+    return os.environ.get(name, default).lower() in ("1", "true", "yes", "on")
+
+
+DEFAULT_SYSTEM_PROMPT = (
+    "You are a helpful AI assistant. Provide clear, concise, and accurate responses."
+)
+
+
+@dataclasses.dataclass
+class ServerConfig:
+    """All serving knobs. Env names match the reference exactly."""
+
+    model: str = "tiny"                        # LLM_MODEL
+    dtype: str = "bfloat16"                    # LLM_DTYPE
+    max_num_seqs: int = 12                     # LLM_MAX_NUM_SEQS
+    max_num_batched_tokens: int = 8192         # LLM_MAX_NUM_BATCHED_TOKENS
+    memory_utilization: float = 0.90           # LLM_GPU_MEMORY_UTILIZATION (HBM here)
+    max_tokens: int = 512                      # LLM_MAX_TOKENS (completion default)
+    max_model_len: int = 4096                  # LLM_MAX_MODEL_LEN
+    safety_margin_tokens: int = 128            # LLM_PROMPT_SAFETY_MARGIN_TOKENS
+    temperature: float = 0.2                   # near-greedy reference default
+    metrics_enabled: bool = True               # LLM_METRICS_ENABLED
+    metrics_include_tokens: bool = True        # LLM_METRICS_INCLUDE_TOKENS
+    metrics_prefix: str = "llm"                # LLM_METRICS_PREFIX
+    apply_chat_template: bool = True           # LLM_APPLY_CHAT_TEMPLATE
+    default_system_prompt: str = DEFAULT_SYSTEM_PROMPT  # LLM_DEFAULT_SYSTEM_PROMPT
+    log_requests: bool = False                 # LOG_LLM_REQUESTS
+    log_max_chars: int = 500                   # LLM_LOG_MAX_CHARS
+    host: str = "0.0.0.0"                      # LLM_HOST
+    port: int = 8000                           # LLM_PORT
+    tp_size: int = 1                           # LLM_TP_SIZE (TPU-native knob)
+    num_blocks: Optional[int] = None           # LLM_NUM_BLOCKS (None -> HBM profile)
+    block_size: int = 16                       # LLM_BLOCK_SIZE
+    weights_path: Optional[str] = None         # LLM_WEIGHTS_PATH (local safetensors dir)
+
+    @classmethod
+    def from_env(cls) -> "ServerConfig":
+        c = cls()
+        c.model = os.environ.get("LLM_MODEL", c.model)
+        c.dtype = os.environ.get("LLM_DTYPE") or c.dtype
+        c.max_num_seqs = int(os.environ.get("LLM_MAX_NUM_SEQS") or c.max_num_seqs)
+        c.max_num_batched_tokens = int(
+            os.environ.get("LLM_MAX_NUM_BATCHED_TOKENS") or c.max_num_batched_tokens)
+        c.memory_utilization = float(
+            os.environ.get("LLM_GPU_MEMORY_UTILIZATION") or c.memory_utilization)
+        c.max_tokens = int(os.environ.get("LLM_MAX_TOKENS") or c.max_tokens)
+        c.max_model_len = int(os.environ.get("LLM_MAX_MODEL_LEN") or c.max_model_len)
+        c.safety_margin_tokens = int(
+            os.environ.get("LLM_PROMPT_SAFETY_MARGIN_TOKENS") or c.safety_margin_tokens)
+        c.temperature = float(os.environ.get("LLM_TEMPERATURE") or c.temperature)
+        c.metrics_enabled = _env_bool("LLM_METRICS_ENABLED")
+        c.metrics_include_tokens = _env_bool("LLM_METRICS_INCLUDE_TOKENS")
+        c.metrics_prefix = os.environ.get("LLM_METRICS_PREFIX", c.metrics_prefix)
+        c.apply_chat_template = _env_bool("LLM_APPLY_CHAT_TEMPLATE")
+        c.default_system_prompt = os.environ.get(
+            "LLM_DEFAULT_SYSTEM_PROMPT", c.default_system_prompt)
+        c.log_requests = _env_bool("LOG_LLM_REQUESTS", "0")
+        c.log_max_chars = int(os.environ.get("LLM_LOG_MAX_CHARS") or c.log_max_chars)
+        c.host = os.environ.get("LLM_HOST", c.host)
+        c.port = int(os.environ.get("LLM_PORT") or c.port)
+        c.tp_size = int(os.environ.get("LLM_TP_SIZE") or c.tp_size)
+        nb = os.environ.get("LLM_NUM_BLOCKS")
+        c.num_blocks = int(nb) if nb else None
+        c.block_size = int(os.environ.get("LLM_BLOCK_SIZE") or c.block_size)
+        c.weights_path = os.environ.get("LLM_WEIGHTS_PATH") or None
+        return c
+
+    @classmethod
+    def from_args(cls, argv: Optional[list[str]] = None) -> "ServerConfig":
+        """CLI flags override env (reference: llm/serve_llm.py:1049-1104)."""
+        c = cls.from_env()
+        p = argparse.ArgumentParser(description="TPU-native LLM serving backend")
+        p.add_argument("--model", default=c.model)
+        p.add_argument("--dtype", default=c.dtype)
+        p.add_argument("--max-num-seqs", type=int, default=c.max_num_seqs)
+        p.add_argument("--max-num-batched-tokens", type=int,
+                       default=c.max_num_batched_tokens)
+        p.add_argument("--memory-utilization", "--gpu-memory-utilization",
+                       type=float, dest="memory_utilization",
+                       default=c.memory_utilization)
+        p.add_argument("--max-tokens", type=int, default=c.max_tokens)
+        p.add_argument("--max-model-len", type=int, default=c.max_model_len)
+        p.add_argument("--temperature", type=float, default=c.temperature)
+        p.add_argument("--host", default=c.host)
+        p.add_argument("--port", type=int, default=c.port)
+        p.add_argument("--tp-size", type=int, default=c.tp_size)
+        p.add_argument("--num-blocks", type=int, default=c.num_blocks)
+        p.add_argument("--block-size", type=int, default=c.block_size)
+        p.add_argument("--weights-path", default=c.weights_path)
+        a = p.parse_args(argv)
+        for f in ("model", "dtype", "max_num_seqs", "max_num_batched_tokens",
+                  "memory_utilization", "max_tokens", "max_model_len",
+                  "temperature", "host", "port", "tp_size", "num_blocks",
+                  "block_size", "weights_path"):
+            setattr(c, f, getattr(a, f))
+        return c

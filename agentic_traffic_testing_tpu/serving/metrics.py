@@ -1,0 +1,122 @@
+"""Prometheus metric families for the LLM backend.
+
+Family names, label sets and bucket boundaries reproduce the reference's
+exactly (reference: llm/serve_llm.py:92-167) so the provisioned Grafana
+dashboard, scrape_metrics.py and every PromQL recipe in docs/monitoring.md
+work against the TPU backend unchanged. Metrics live in a per-instance
+CollectorRegistry so servers can be created repeatedly in one process
+(tests), unlike the reference's module-global registry.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import (
+    CONTENT_TYPE_LATEST,
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+LATENCY_BUCKETS = [0.5, 1.0, 2.5, 5.0, 10.0, 15.0, 20.0, 30.0, 45.0, 60.0, 90.0, 120.0, 180.0]
+BATCH_BUCKETS = [1, 2, 3, 4, 5, 6, 8, 10, 12, 16, 20, 32]
+INTERARRIVAL_BUCKETS = [0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0]
+
+
+class LLMMetrics:
+    """The `llm_*` family set (prefix configurable via LLM_METRICS_PREFIX)."""
+
+    content_type = CONTENT_TYPE_LATEST
+
+    def __init__(self, prefix: str = "llm", include_tokens: bool = True) -> None:
+        self.include_tokens = include_tokens
+        r = self.registry = CollectorRegistry()
+        self.requests_total = Counter(
+            f"{prefix}_requests_total", "Total LLM requests", ["status"], registry=r)
+        self.request_latency = Histogram(
+            f"{prefix}_request_latency_seconds", "End-to-end LLM request latency",
+            buckets=LATENCY_BUCKETS, registry=r)
+        self.queue_wait = Histogram(
+            f"{prefix}_queue_wait_seconds", "Enqueue to first token (TTFT proxy)",
+            buckets=LATENCY_BUCKETS, registry=r)
+        self.inflight = Gauge(
+            f"{prefix}_inflight_requests", "In-flight LLM requests", registry=r)
+        self.prompt_tokens = Counter(
+            f"{prefix}_prompt_tokens_total", "Total prompt tokens", registry=r)
+        self.completion_tokens = Counter(
+            f"{prefix}_completion_tokens_total", "Total completion tokens", registry=r)
+        self.batch_size = Histogram(
+            f"{prefix}_batch_size", "Number of requests batched together",
+            buckets=BATCH_BUCKETS, registry=r)
+        self.config_max_num_seqs = Gauge(
+            f"{prefix}_config_max_num_seqs",
+            "Configured max_num_seqs; -1 means default", registry=r)
+        self.config_max_num_batched_tokens = Gauge(
+            f"{prefix}_config_max_num_batched_tokens",
+            "Configured max_num_batched_tokens; -1 means default", registry=r)
+        self.config_gpu_memory_utilization = Gauge(
+            f"{prefix}_config_gpu_memory_utilization",
+            "Configured device memory utilization target (0-1)", registry=r)
+        self.config_max_tokens = Gauge(
+            f"{prefix}_config_max_tokens",
+            "Configured max tokens per generation (LLM_MAX_TOKENS)", registry=r)
+        self.kv_cache_num_gpu_blocks = Gauge(
+            f"{prefix}_kv_cache_num_gpu_blocks",
+            "KV cache: number of device blocks allocated; -1 means unknown",
+            registry=r)
+        self.kv_cache_block_size_tokens = Gauge(
+            f"{prefix}_kv_cache_block_size_tokens",
+            "KV cache: tokens per block; -1 means unknown", registry=r)
+        self.kv_cache_total_tokens = Gauge(
+            f"{prefix}_kv_cache_total_tokens",
+            "KV cache: total tokens available (num_blocks * block_size)",
+            registry=r)
+        self.kv_cache_est_max_concurrency = Gauge(
+            f"{prefix}_kv_cache_est_max_concurrency_at_max_model_len",
+            "Estimated max concurrent sequences limited by KV cache at max_model_len",
+            registry=r)
+        self.computed_max_concurrency = Gauge(
+            f"{prefix}_computed_max_concurrency",
+            "KV-cache-derived max concurrency: total_tokens / max_model_len",
+            registry=r)
+        self.interarrival = Histogram(
+            f"{prefix}_interarrival_seconds",
+            "Time between consecutive LLM request arrivals",
+            buckets=INTERARRIVAL_BUCKETS, registry=r)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+    def record_request(self, status: str, latency_s: float, queue_wait_s: float,
+                       prompt_tokens: Optional[int],
+                       completion_tokens: Optional[int]) -> None:
+        """One-stop per-request recording (reference: serve_llm.py:899-920)."""
+        self.requests_total.labels(status=status).inc()
+        self.request_latency.observe(latency_s)
+        self.queue_wait.observe(queue_wait_s)
+        if self.include_tokens:
+            if prompt_tokens:
+                self.prompt_tokens.inc(prompt_tokens)
+            if completion_tokens:
+                self.completion_tokens.inc(completion_tokens)
+
+    def set_config_gauges(self, *, max_num_seqs: int, max_num_batched_tokens: int,
+                          memory_utilization: float, max_tokens: int) -> None:
+        self.config_max_num_seqs.set(max_num_seqs)
+        self.config_max_num_batched_tokens.set(max_num_batched_tokens)
+        self.config_gpu_memory_utilization.set(memory_utilization)
+        self.config_max_tokens.set(max_tokens)
+
+    def set_kv_gauges(self, *, num_blocks: int, block_size: int,
+                      max_model_len: int, max_num_seqs: int) -> None:
+        """KV accounting in vLLM's terms (reference: serve_llm.py:245-264)."""
+        total = num_blocks * block_size
+        self.kv_cache_num_gpu_blocks.set(num_blocks)
+        self.kv_cache_block_size_tokens.set(block_size)
+        self.kv_cache_total_tokens.set(total)
+        by_len = total / max_model_len if max_model_len > 0 else -1
+        self.kv_cache_est_max_concurrency.set(round(by_len, 2))
+        self.computed_max_concurrency.set(round(min(by_len, max_num_seqs), 2))

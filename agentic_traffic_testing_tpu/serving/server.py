@@ -1,0 +1,426 @@
+"""TPU LLM backend HTTP server.
+
+Reproduces the reference backend's HTTP + metrics contract exactly
+(reference: llm/serve_llm.py:731-955; SURVEY.md §2.1) over the first-party
+continuous-batching engine:
+
+  POST /chat | /completion | /generate
+      {"prompt"|"input": str, "max_tokens"?, "system_prompt"?,
+       "skip_chat_template"?, "request_id"?}  (+ X-Request-ID, traceparent)
+   -> {"output": str, "meta": {request_id, latency_ms, queue_wait_s,
+       prompt_tokens, completion_tokens, total_tokens, otel{...}}}
+  GET /health | /ready | /live | /metrics
+
+Semantics preserved: TTFT == queue_wait_seconds measured enqueue -> first
+token; interarrival recorded under a lock at arrival; inflight gauge around
+the whole handler; token-level prompt truncation keeping the head; per-request
+START/PROGRESS/DONE logs with tok/s; near-greedy default sampling.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+from agentic_traffic_testing_tpu.runtime.request import FinishReason, SamplingParams
+from agentic_traffic_testing_tpu.serving.async_engine import AsyncLLMEngine
+from agentic_traffic_testing_tpu.serving.chat_template import apply_chat_template
+from agentic_traffic_testing_tpu.serving.config import ServerConfig
+from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
+from agentic_traffic_testing_tpu.utils.tokenizer import IncrementalDecoder, load_tokenizer
+from agentic_traffic_testing_tpu.utils.tracing import (
+    extract_context,
+    get_tracer,
+    span_metadata,
+)
+
+log = logging.getLogger("att_tpu.server")
+PROGRESS_INTERVAL_S = 2.0
+
+
+class LLMServer:
+    """Owns engine + tokenizer + metrics; handlers are bound methods."""
+
+    def __init__(self, cfg: ServerConfig, engine: Optional[LLMEngine] = None) -> None:
+        self.cfg = cfg
+        self.tokenizer = load_tokenizer(cfg.weights_path or cfg.model)
+        self.engine = engine or self._build_engine()
+        self.metrics = (
+            LLMMetrics(cfg.metrics_prefix, cfg.metrics_include_tokens)
+            if cfg.metrics_enabled else None
+        )
+        self.async_engine = AsyncLLMEngine(
+            self.engine,
+            on_step=(self.metrics.batch_size.observe if self.metrics else None),
+        )
+        self.tracer = get_tracer("llm-backend")
+        self._arrival_lock = asyncio.Lock()
+        self._inflight_lock = asyncio.Lock()
+        self._inflight = 0
+        self._last_arrival: Optional[float] = None
+        if self.metrics:
+            self.metrics.set_config_gauges(
+                max_num_seqs=cfg.max_num_seqs,
+                max_num_batched_tokens=cfg.max_num_batched_tokens,
+                memory_utilization=cfg.memory_utilization,
+                max_tokens=cfg.max_tokens,
+            )
+            self.metrics.set_kv_gauges(
+                num_blocks=self.engine.cache.num_blocks - 1,  # exclude trash block
+                block_size=self.engine.cache.block_size,
+                max_model_len=cfg.max_model_len,
+                max_num_seqs=cfg.max_num_seqs,
+            )
+
+    def _build_engine(self) -> LLMEngine:
+        c = self.cfg
+        ecfg = EngineConfig(
+            model=c.model, dtype=c.dtype, max_num_seqs=c.max_num_seqs,
+            max_num_batched_tokens=c.max_num_batched_tokens,
+            max_model_len=c.max_model_len, block_size=c.block_size,
+            num_blocks=c.num_blocks, memory_utilization=c.memory_utilization,
+        )
+        runner = None
+        params = None
+        model_cfg = None
+        if c.tp_size > 1:
+            from agentic_traffic_testing_tpu.models.config import resolve_config
+            from agentic_traffic_testing_tpu.models.llama import init_params
+            from agentic_traffic_testing_tpu.parallel.mesh import single_axis_mesh
+            from agentic_traffic_testing_tpu.parallel.tp_runner import TPRunner
+            import jax
+            import jax.numpy as jnp
+
+            model_cfg = resolve_config(c.model)
+            params = self._load_params(model_cfg)
+            if params is None:
+                dtype = jnp.bfloat16 if c.dtype in ("bfloat16", "bf16") else jnp.float32
+                params = init_params(model_cfg, jax.random.key(0), dtype=dtype)
+            runner = TPRunner(model_cfg, params, single_axis_mesh("tp", c.tp_size))
+            return LLMEngine(ecfg, model_cfg=model_cfg, runner=runner)
+        if c.weights_path:
+            from agentic_traffic_testing_tpu.models.config import resolve_config
+            model_cfg = resolve_config(c.weights_path)
+            params = self._load_params(model_cfg)
+        return LLMEngine(ecfg, model_cfg=model_cfg, params=params)
+
+    def _load_params(self, model_cfg):
+        if not self.cfg.weights_path:
+            return None
+        from agentic_traffic_testing_tpu.models.weights import load_params
+
+        try:
+            import jax.numpy as jnp
+
+            dtype = jnp.bfloat16 if self.cfg.dtype in ("bfloat16", "bf16") else jnp.float32
+            _, params = load_params(self.cfg.weights_path, model_cfg, dtype=dtype)
+            return params
+        except Exception:
+            log.exception("weight load failed for %s; random init", self.cfg.weights_path)
+            return None
+
+    # -- helpers ------------------------------------------------------------
+
+    def count_tokens(self, text: str) -> Optional[int]:
+        if not self.cfg.metrics_include_tokens:
+            return None
+        return len(self.tokenizer.encode(text)) if text else 0
+
+    def _prepare_prompt_ids(self, prompt: str, max_new_tokens: int,
+                            request_id: str) -> tuple[list[int], bool, Optional[int]]:
+        """Tokenize once, applying the token-level head-keeping truncation
+        guardrail (reference: serve_llm.py:812-844).
+
+        A templated prompt already begins with <|begin_of_text|>, so BOS is
+        only prepended for raw prompts (avoids the double-BOS the trained
+        format never sees).
+        """
+        add_bos = not prompt.startswith("<|begin_of_text|>")
+        ids = self.tokenizer.encode(prompt, add_bos=add_bos)
+        if self.cfg.max_model_len <= 0:
+            return ids, False, None
+        max_input = max(
+            1, self.cfg.max_model_len - max_new_tokens - self.cfg.safety_margin_tokens
+        )
+        if len(ids) <= max_input:
+            return ids, False, None
+        dropped = len(ids) - max_input
+        ids = ids[:max_input]
+        print(f"[llm] req={request_id} PROMPT_TRUNCATED "
+              f"original_tokens={len(ids) + dropped} kept={max_input} "
+              f"dropped={dropped}", flush=True)
+        return ids, True, dropped
+
+    def _log_prompt(self, source: str, prompt: str) -> None:
+        if not self.cfg.log_requests:
+            return
+        mx = max(self.cfg.log_max_chars, 0)
+        preview = prompt[:mx]
+        suffix = "" if len(prompt) <= mx else f"... [truncated {len(prompt) - mx} chars]"
+        print(f"[llm-request] source={source} prompt_len={len(prompt)} "
+              f"prompt={preview}{suffix}", flush=True)
+
+    # -- handlers -----------------------------------------------------------
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})
+
+    async def handle_metrics(self, request: web.Request) -> web.Response:
+        if self.metrics is None:
+            return web.json_response({"error": "Metrics disabled"}, status=503)
+        return web.Response(body=self.metrics.render(),
+                            headers={"Content-Type": self.metrics.content_type})
+
+    async def handle_chat(self, request: web.Request) -> web.Response:
+        ctx = extract_context(request.headers)
+        with self.tracer.start_as_current_span(
+            "llm.handle_request", context=ctx, kind=_server_kind()
+        ) as span:
+            start = time.monotonic()
+            async with self._arrival_lock:
+                if self._last_arrival is not None and self.metrics:
+                    self.metrics.interarrival.observe(start - self._last_arrival)
+                self._last_arrival = start
+            async with self._inflight_lock:
+                self._inflight += 1
+                current_inflight = self._inflight
+            if self.metrics:
+                self.metrics.inflight.inc()
+            span.set_attribute("app.path", request.path)
+
+            async def _done(dec: int = 1) -> None:
+                async with self._inflight_lock:
+                    self._inflight -= dec
+                if self.metrics:
+                    self.metrics.inflight.dec(dec)
+
+            # Everything between the inflight increment and the generate call
+            # is guarded: an early return or parse failure must restore the
+            # gauge, never leak it.
+            try:
+                try:
+                    data: Dict[str, Any] = await request.json()
+                except (json.JSONDecodeError, UnicodeDecodeError):
+                    await _done()
+                    return web.json_response({"error": "Invalid JSON"}, status=400)
+
+                prompt = data.get("prompt") or data.get("input")
+                if not isinstance(prompt, str) or not prompt:
+                    await _done()
+                    return web.json_response(
+                        {"error": "Missing 'prompt' field"}, status=400)
+
+                max_tokens = data.get("max_tokens")
+                try:
+                    max_tokens = int(max_tokens) if max_tokens is not None else None
+                except (TypeError, ValueError):
+                    max_tokens = None
+                effective_max = (max_tokens if max_tokens is not None
+                                 else self.cfg.max_tokens)
+
+                client_rid = (request.headers.get("X-Request-ID")
+                              or data.get("request_id"))
+                request_id = str(client_rid) if client_rid else str(uuid.uuid4())[:8]
+                span.set_attribute("app.request_id", request_id)
+
+                original_prompt = prompt
+                skip_template = bool(data.get("skip_chat_template", False))
+                if not skip_template and self.cfg.apply_chat_template:
+                    prompt = apply_chat_template(
+                        self.tokenizer, prompt, data.get("system_prompt"),
+                        self.cfg.default_system_prompt,
+                    )
+                prompt_ids, truncated, dropped = self._prepare_prompt_ids(
+                    prompt, effective_max, request_id)
+
+                span.set_attribute("app.prompt_length", len(original_prompt))
+                span.set_attribute("app.formatted_prompt_length", len(prompt))
+                span.set_attribute("app.chat_template_applied",
+                                   not skip_template and self.cfg.apply_chat_template)
+                span.set_attribute("app.prompt_truncated", truncated)
+                if dropped is not None:
+                    span.set_attribute("app.prompt_truncated_tokens", int(dropped))
+                self._log_prompt("http", original_prompt)
+
+                template_info = (
+                    " (templated)"
+                    if not skip_template and self.cfg.apply_chat_template else "")
+                trunc_info = f" [TRUNCATED -{dropped}tok]" if truncated else ""
+                print(f"[llm] req={request_id} START inflight={current_inflight} "
+                      f"prompt_len={len(original_prompt)}{template_info}{trunc_info}",
+                      flush=True)
+
+                try:
+                    temperature = float(data.get("temperature",
+                                                 self.cfg.temperature))
+                except (TypeError, ValueError):
+                    temperature = self.cfg.temperature
+                sampling = SamplingParams(
+                    max_tokens=max(1, effective_max),
+                    temperature=temperature,
+                    stop_token_ids=tuple(self.tokenizer.eos_ids),
+                    seed=hash(request_id) & 0x7FFFFFFF,
+                )
+            except web.HTTPException:
+                raise
+            except Exception as exc:
+                await _done()
+                log.exception("request parsing failed")
+                return web.json_response(
+                    {"error": f"Bad request: {exc}"}, status=400)
+
+            status = "success"
+            text = ""
+            queue_wait_s = 0.0
+            prompt_tokens = completion_tokens = None
+            try:
+                text, queue_wait_s, n_tokens = await self._generate(
+                    prompt_ids, sampling, request_id, span)
+                # prompt_ids is the exact sequence prefilled (incl. BOS) —
+                # the truthful accounting for KV/window budgeting.
+                prompt_tokens = (len(prompt_ids) if self.cfg.metrics_include_tokens
+                                 else None)
+                completion_tokens = (n_tokens if self.cfg.metrics_include_tokens
+                                     else None)
+                if prompt_tokens is not None:
+                    span.set_attribute("llm.prompt_tokens", prompt_tokens)
+                if completion_tokens is not None:
+                    span.set_attribute("llm.completion_tokens", completion_tokens)
+                    if prompt_tokens is not None:
+                        span.set_attribute("llm.total_tokens",
+                                           prompt_tokens + completion_tokens)
+            except Exception as exc:
+                status = "error"
+                await _done()
+                latency_s = time.monotonic() - start
+                log.exception("generation failed req=%s", request_id)
+                print(f"[llm] req={request_id} ERROR after "
+                      f"{int(latency_s * 1000)}ms: {exc}", flush=True)
+                if self.metrics:
+                    self.metrics.record_request(status, latency_s, queue_wait_s,
+                                                prompt_tokens, completion_tokens)
+                return web.json_response(
+                    {"error": f"Generation failed: {exc}"}, status=500)
+
+            async with self._inflight_lock:
+                self._inflight -= 1
+                remaining = self._inflight
+            if self.metrics:
+                self.metrics.inflight.dec()
+
+            latency_s = time.monotonic() - start
+            latency_ms = int(latency_s * 1000)
+            print(f"[llm] req={request_id} DONE latency={latency_ms}ms "
+                  f"prompt={prompt_tokens} completion={completion_tokens} "
+                  f"remaining={remaining}", flush=True)
+            if self.metrics:
+                self.metrics.record_request(status, latency_s, queue_wait_s,
+                                            prompt_tokens, completion_tokens)
+
+            meta: Dict[str, Any] = {
+                "request_id": request_id,
+                "latency_ms": latency_ms,
+                "queue_wait_s": round(queue_wait_s, 4),
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": completion_tokens,
+                "total_tokens": (prompt_tokens + completion_tokens
+                                 if prompt_tokens is not None
+                                 and completion_tokens is not None else None),
+                "otel": span_metadata(span),
+            }
+            return web.json_response({"output": text, "meta": meta})
+
+    async def _generate(self, prompt_ids: list[int], sampling: SamplingParams,
+                        request_id: str, span) -> tuple[str, float, int]:
+        """Consume the token stream; returns (text, queue_wait_s, n_tokens)."""
+        dec = IncrementalDecoder(self.tokenizer)
+        enqueue_t = time.monotonic()
+        first_token_t: Optional[float] = None
+        n_tokens = 0
+        last_progress = enqueue_t
+        ttft_span = self.tracer.start_span("llm.time_to_first_token")
+        finish_reason: Optional[FinishReason] = None
+        stop_set = set(sampling.stop_token_ids)
+        async for ev in self.async_engine.generate(prompt_ids, sampling, request_id):
+            now = time.monotonic()
+            if ev.new_token_ids and first_token_t is None:
+                first_token_t = now
+                ttft_span.end()
+            for t in ev.new_token_ids:
+                if t in stop_set:
+                    continue  # stop tokens never appear in the visible output
+                n_tokens += 1
+                dec.push(t)
+            if ev.finished:
+                finish_reason = ev.request.finish_reason
+                break
+            if now - last_progress >= PROGRESS_INTERVAL_S and first_token_t:
+                rate = n_tokens / max(now - first_token_t, 1e-6)
+                print(f"[llm] req={request_id} PROGRESS tokens={n_tokens} "
+                      f"tok/s={rate:.1f}", flush=True)
+                last_progress = now
+        if finish_reason is FinishReason.ERROR:
+            raise RuntimeError(ev.request.error or "request unservable "
+                               "(prompt cannot fit the KV cache)")
+        queue_wait_s = (first_token_t or time.monotonic()) - enqueue_t
+        return dec.text(), queue_wait_s, n_tokens
+
+    # -- app ----------------------------------------------------------------
+
+    def make_app(self, manage_engine: bool = True) -> web.Application:
+        """`manage_engine=False` leaves engine-thread lifecycle to the caller
+        (tests that build several apps over one server instance)."""
+        app = web.Application()
+        app.router.add_get("/health", self.handle_health)
+        app.router.add_get("/ready", self.handle_health)
+        app.router.add_get("/live", self.handle_health)
+        app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_post("/chat", self.handle_chat)
+        app.router.add_post("/completion", self.handle_chat)
+        app.router.add_post("/generate", self.handle_chat)
+
+        if manage_engine:
+            async def _start(app):
+                self.async_engine.start()
+
+            async def _stop(app):
+                self.async_engine.shutdown()
+
+            app.on_startup.append(_start)
+            app.on_cleanup.append(_stop)
+        return app
+
+
+def _server_kind():
+    try:
+        from opentelemetry.trace import SpanKind
+
+        return SpanKind.SERVER
+    except Exception:
+        return None
+
+
+def create_app(cfg: Optional[ServerConfig] = None,
+               engine: Optional[LLMEngine] = None) -> web.Application:
+    return LLMServer(cfg or ServerConfig.from_env(), engine=engine).make_app()
+
+
+def main(argv: Optional[list[str]] = None) -> None:
+    logging.basicConfig(level=logging.INFO)
+    cfg = ServerConfig.from_args(argv)
+    print(f"[llm] starting TPU backend model={cfg.model} dtype={cfg.dtype} "
+          f"tp={cfg.tp_size} max_num_seqs={cfg.max_num_seqs} "
+          f"max_model_len={cfg.max_model_len}", flush=True)
+    server = LLMServer(cfg)
+    web.run_app(server.make_app(), host=cfg.host, port=cfg.port)
+
+
+if __name__ == "__main__":
+    main()

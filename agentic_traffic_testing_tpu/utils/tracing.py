@@ -1,0 +1,121 @@
+"""OpenTelemetry helpers shared by the LLM server, agents and tools.
+
+Behavioral parity with the reference's two tracing modules
+(reference: llm/tracing.py:14-33, agents/common/tracing.py): init an OTLP HTTP
+exporter toward Jaeger when configured, propagate W3C context on every HTTP
+hop, and surface span ids into JSON responses so UIs can cross-link traces.
+Everything degrades to no-ops when the SDK or exporter is absent — the
+serving path must never depend on the observability plane being up.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Mapping, Optional
+
+try:  # the SDK is optional at runtime
+    from opentelemetry import propagate, trace
+    from opentelemetry.sdk.resources import Resource
+    from opentelemetry.sdk.trace import TracerProvider
+    from opentelemetry.sdk.trace.export import BatchSpanProcessor
+
+    _OTEL = True
+except Exception:  # pragma: no cover
+    _OTEL = False
+
+_initialized = False
+
+
+def init_tracer(service_name: Optional[str] = None) -> None:
+    """Install a TracerProvider once per process.
+
+    Exports OTLP/HTTP to `OTEL_EXPORTER_OTLP_ENDPOINT` (Jaeger all-in-one in
+    the compose stack) when that env var is set and the exporter package is
+    importable; otherwise spans stay in-process (still usable for ids).
+    """
+    global _initialized
+    if _initialized or not _OTEL:
+        return
+    _initialized = True
+    name = service_name or os.environ.get("OTEL_SERVICE_NAME", "llm-backend-tpu")
+    provider = TracerProvider(resource=Resource.create({"service.name": name}))
+    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+    if endpoint:
+        try:
+            from opentelemetry.exporter.otlp.proto.http.trace_exporter import (
+                OTLPSpanExporter,
+            )
+
+            provider.add_span_processor(BatchSpanProcessor(OTLPSpanExporter()))
+        except Exception:
+            pass
+    trace.set_tracer_provider(provider)
+
+
+def get_tracer(service_name: str):
+    """Tracer handle; no-op tracer when OTel is unavailable."""
+    if not _OTEL:
+        return _NoopTracer()
+    init_tracer(service_name)
+    return trace.get_tracer(service_name)
+
+
+def extract_context(headers: Mapping[str, str]):
+    """W3C traceparent extraction (reference: llm/serve_llm.py:739-746)."""
+    if not _OTEL:
+        return None
+    return propagate.extract(dict(headers))
+
+
+def inject_context(headers: Dict[str, str]) -> Dict[str, str]:
+    """Inject current span context into outgoing headers."""
+    if _OTEL:
+        propagate.inject(headers)
+    return headers
+
+
+def span_metadata(span: Any) -> Dict[str, Any]:
+    """Span ids/attributes as JSON-safe dict for response `meta.otel`
+    (reference: llm/serve_llm.py:690-712, agents/common/tracing.py)."""
+    meta: Dict[str, Any] = {}
+    try:
+        ctx = span.get_span_context()
+        meta["trace_id"] = f"{int(ctx.trace_id):032x}"
+        meta["span_id"] = f"{int(ctx.span_id):016x}"
+        meta["trace_flags"] = int(getattr(ctx, "trace_flags", 0))
+        meta["is_remote"] = bool(getattr(ctx, "is_remote", False))
+    except Exception:
+        pass
+    attrs: Dict[str, Any] = {}
+    for attr_name in ("attributes", "_attributes"):
+        raw = getattr(span, attr_name, None)
+        if isinstance(raw, dict) and raw:
+            attrs.update(raw)
+    if attrs:
+        meta["attributes"] = {k: v for k, v in attrs.items()}
+    return meta
+
+
+class _NoopSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *a):
+        return False
+
+    def set_attribute(self, *a, **k):
+        pass
+
+    def get_span_context(self):
+        raise RuntimeError("noop")
+
+    def end(self):
+        pass
+
+
+class _NoopTracer:
+    def start_as_current_span(self, *a, **k):
+        return _NoopSpan()
+
+    def start_span(self, *a, **k):
+        return _NoopSpan()

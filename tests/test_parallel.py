@@ -16,12 +16,6 @@ from agentic_traffic_testing_tpu.models.llama import forward_full, init_params
 from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
 from agentic_traffic_testing_tpu.ops.ring_attention import make_sp_attention
 from agentic_traffic_testing_tpu.parallel.mesh import auto_mesh_shape, make_mesh
-
-
-def test_auto_mesh_shape_covers_device_counts():
-    for n in (1, 2, 4, 8):
-        dp, sp, tp = auto_mesh_shape(n)
-        assert dp * sp * tp == n
 from agentic_traffic_testing_tpu.parallel.tp_runner import TPRunner
 from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
 from agentic_traffic_testing_tpu.runtime.request import SamplingParams
@@ -44,6 +38,12 @@ def tiny_params(tiny_cfg):
 
 def test_eight_cpu_devices_present():
     assert len(jax.devices()) == 8
+
+
+def test_auto_mesh_shape_covers_device_counts():
+    for n in (1, 2, 4, 8):
+        dp, sp, tp = auto_mesh_shape(n)
+        assert dp * sp * tp == n
 
 
 @pytest.mark.parametrize("dp,sp,tp", [(1, 4, 1), (2, 2, 2), (1, 8, 1)])

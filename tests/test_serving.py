@@ -1,0 +1,180 @@
+"""Golden contract tests for the LLM HTTP backend.
+
+Pin the request/response JSON shape, header handling, and Prometheus family
+names against the reference contract documented in SURVEY.md §2.1
+(reference: llm/serve_llm.py:731-955). These are the tests the reference
+never had — its verification was operational only (SURVEY.md §4).
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from agentic_traffic_testing_tpu.serving.config import ServerConfig
+from agentic_traffic_testing_tpu.serving.server import LLMServer
+
+# Every llm_* family the reference exports (SURVEY.md §2.1 metrics table).
+EXPECTED_METRIC_FAMILIES = [
+    "llm_requests_total",
+    "llm_request_latency_seconds",
+    "llm_queue_wait_seconds",
+    "llm_inflight_requests",
+    "llm_prompt_tokens_total",
+    "llm_completion_tokens_total",
+    "llm_batch_size",
+    "llm_config_max_num_seqs",
+    "llm_config_max_num_batched_tokens",
+    "llm_config_gpu_memory_utilization",
+    "llm_config_max_tokens",
+    "llm_kv_cache_num_gpu_blocks",
+    "llm_kv_cache_block_size_tokens",
+    "llm_kv_cache_total_tokens",
+    "llm_kv_cache_est_max_concurrency_at_max_model_len",
+    "llm_computed_max_concurrency",
+    "llm_interarrival_seconds",
+]
+
+
+@pytest.fixture(scope="module")
+def server():
+    cfg = ServerConfig(
+        model="tiny", dtype="float32", max_num_seqs=4, max_model_len=256,
+        num_blocks=128, max_tokens=16, temperature=0.0,
+    )
+    srv = LLMServer(cfg)
+    srv.async_engine.start()
+    yield srv
+    srv.async_engine.shutdown()
+
+
+def _run(server, coro_fn):
+    async def wrapper():
+        app = server.make_app(manage_engine=False)
+        async with TestClient(TestServer(app)) as client:
+            return await coro_fn(client)
+
+    return asyncio.run(wrapper())
+
+
+def test_health_ready_live(server):
+    async def go(client):
+        for path in ("/health", "/ready", "/live"):
+            resp = await client.get(path)
+            assert resp.status == 200
+            assert (await resp.json()) == {"status": "ok"}
+
+    _run(server, go)
+
+
+def test_chat_response_contract(server):
+    async def go(client):
+        resp = await client.post("/chat", json={"prompt": "Hello", "max_tokens": 4})
+        assert resp.status == 200
+        body = await resp.json()
+        assert isinstance(body["output"], str)
+        meta = body["meta"]
+        for key in ("request_id", "latency_ms", "queue_wait_s", "prompt_tokens",
+                    "completion_tokens", "total_tokens", "otel"):
+            assert key in meta, f"missing meta.{key}"
+        assert meta["completion_tokens"] >= 1
+        assert meta["total_tokens"] == meta["prompt_tokens"] + meta["completion_tokens"]
+        assert meta["queue_wait_s"] >= 0
+        return body
+
+    _run(server, go)
+
+
+def test_input_alias_and_request_id_header(server):
+    async def go(client):
+        resp = await client.post("/chat", json={"input": "Hi", "max_tokens": 2},
+                                 headers={"X-Request-ID": "my-req-42"})
+        body = await resp.json()
+        assert body["meta"]["request_id"] == "my-req-42"
+
+    _run(server, go)
+
+
+def test_completion_and_generate_aliases(server):
+    async def go(client):
+        for path in ("/completion", "/generate"):
+            resp = await client.post(path, json={"prompt": "x", "max_tokens": 2})
+            assert resp.status == 200, path
+
+    _run(server, go)
+
+
+def test_missing_prompt_400(server):
+    async def go(client):
+        resp = await client.post("/chat", json={"max_tokens": 4})
+        assert resp.status == 400
+        resp = await client.post("/chat", data=b"{not json",
+                                 headers={"Content-Type": "application/json"})
+        assert resp.status == 400
+
+    _run(server, go)
+
+
+def test_metrics_families_present(server):
+    async def go(client):
+        # Generate one request first so counters exist.
+        await client.post("/chat", json={"prompt": "hello", "max_tokens": 2})
+        resp = await client.get("/metrics")
+        assert resp.status == 200
+        text = (await resp.read()).decode()
+        for fam in EXPECTED_METRIC_FAMILIES:
+            assert fam in text, f"missing metric family {fam}"
+
+    _run(server, go)
+
+
+def test_prompt_truncation_guardrail(server):
+    """Over-long prompts are token-truncated (head kept), not rejected
+    (reference: llm/serve_llm.py:812-844)."""
+    async def go(client):
+        long_prompt = "word " * 2000   # byte tokenizer -> ~10k tokens >> 256
+        resp = await client.post("/chat", json={"prompt": long_prompt,
+                                                "max_tokens": 8})
+        assert resp.status == 200
+        body = await resp.json()
+        assert body["meta"]["prompt_tokens"] <= 256
+
+    _run(server, go)
+
+
+def test_skip_chat_template(server):
+    async def go(client):
+        resp = await client.post(
+            "/chat", json={"prompt": "raw", "skip_chat_template": True,
+                           "max_tokens": 2})
+        assert resp.status == 200
+
+    _run(server, go)
+
+
+def test_parallel_fanout_requests(server):
+    """5 concurrent requests (the agent-b fan-out shape) all succeed."""
+    async def go(client):
+        async def one(i):
+            resp = await client.post(
+                "/chat", json={"prompt": f"task {i}", "max_tokens": 4})
+            assert resp.status == 200
+            return (await resp.json())["meta"]["request_id"]
+
+        ids = await asyncio.gather(*[one(i) for i in range(5)])
+        assert len(set(ids)) == 5
+
+    _run(server, go)
+
+
+def test_kv_gauges_reflect_engine(server):
+    async def go(client):
+        resp = await client.get("/metrics")
+        text = (await resp.read()).decode()
+        num_blocks = server.engine.cache.num_blocks - 1
+        bs = server.engine.cache.block_size
+        assert f"llm_kv_cache_num_gpu_blocks {float(num_blocks)}" in text
+        assert f"llm_kv_cache_total_tokens {float(num_blocks * bs)}" in text
+
+    _run(server, go)
