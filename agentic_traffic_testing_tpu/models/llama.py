@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig
+from agentic_traffic_testing_tpu.ops.attention_backend import paged_decode_attention
 from agentic_traffic_testing_tpu.ops.jnp_ops import (
     apply_rope,
     causal_attention,
@@ -212,6 +213,7 @@ def decode_step_impl(
     cache: KVCache,           # donated
     block_tables: jax.Array,  # [B, max_blocks]
     positions: jax.Array,     # [B] position of `tokens` (== context_len so far)
+    attn_mode: Optional[str] = None,  # static; see ops/attention_backend.py
 ) -> tuple[jax.Array, KVCache]:
     """Returns (next-token logits [B, V] fp32, updated cache).
 
@@ -221,7 +223,6 @@ def decode_step_impl(
     b = tokens.shape[0]
     x = params["tok_embed"][tokens][:, None, :]  # [B, 1, D]
     sin, cos = rope_sin_cos(positions[:, None], cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
-    ctx_lens = positions + 1
 
     def body(carry, xs):
         x, kc, vc = carry
@@ -234,12 +235,10 @@ def decode_step_impl(
         vc_l = kvc.write_decode_kv(jax.lax.dynamic_index_in_dim(vc, li, 0, keepdims=False), v[:, 0], block_tables, positions)
         kc = jax.lax.dynamic_update_index_in_dim(kc, kc_l, li, 0)
         vc = jax.lax.dynamic_update_index_in_dim(vc, vc_l, li, 0)
-        # Paged attention (gather reference path; Pallas kernel swaps in on TPU).
-        k_all = kvc.gather_kv(kc_l, block_tables)
-        v_all = kvc.gather_kv(vc_l, block_tables)
-        attn = causal_attention(
-            q, k_all, v_all, q_positions=positions[:, None], kv_valid_len=ctx_lens
-        )
+        # Paged attention: Pallas kernel on TPU, jnp gather oracle on CPU
+        # (ops/attention_backend.py picks at trace time).
+        attn = paged_decode_attention(q, kc_l, vc_l, block_tables, positions,
+                                      mode=attn_mode)
         x = x + attn.reshape(b, 1, -1) @ lp["wo"]
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         x = x + _mlp_block(xm, lp)
@@ -258,4 +257,4 @@ def decode_step_impl(
 # sampling in one dispatch — see runtime/runner.py).
 forward_full = jax.jit(forward_full_impl, static_argnames=("cfg",))
 prefill = jax.jit(prefill_impl, static_argnames=("cfg",), donate_argnums=(3,))
-decode_step = jax.jit(decode_step_impl, static_argnames=("cfg",), donate_argnums=(3,))
+decode_step = jax.jit(decode_step_impl, static_argnames=("cfg", "attn_mode"), donate_argnums=(3,))

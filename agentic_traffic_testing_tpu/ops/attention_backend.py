@@ -1,0 +1,62 @@
+"""Decode-attention backend dispatch: Pallas kernel on TPU, jnp gather oracle
+elsewhere.
+
+Selected once at trace time (the choice is baked into the jitted decode
+program, like picking a kernel at engine build in the reference's vLLM
+backend). Override with ATT_TPU_ATTENTION:
+
+    auto     (default) pallas on TPU, gather on CPU/GPU
+    pallas   force the Pallas kernel (compiled)
+    interpret force the Pallas kernel in interpreter mode (CPU correctness)
+    gather   force the jnp gather reference path
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
+from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
+    paged_attention_decode,
+)
+from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
+
+
+def backend_choice() -> str:
+    mode = os.environ.get("ATT_TPU_ATTENTION", "auto")
+    if mode == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "gather"
+    return mode
+
+
+def paged_decode_attention(
+    q,             # [B, 1, H, hd]
+    k_pages,       # [KH, num_blocks, bs, hd] (one layer, heads-major)
+    v_pages,       # [KH, num_blocks, bs, hd]
+    block_tables,  # [B, max_blocks]
+    positions,     # [B] position of the query token (ctx_len - 1)
+    mode: str | None = None,
+):
+    """One-token paged attention over the block pool. Returns [B, 1, H, hd].
+
+    `mode` overrides the env/platform choice. The GSPMD tensor-parallel
+    runner passes "gather": a pallas_call has no SPMD partitioning rule, so
+    under a tp>1 mesh XLA would replicate (all-gather) the head-sharded page
+    pool onto every chip. A shard_map-wrapped kernel path can lift this later.
+    """
+    ctx_lens = positions + 1
+    if mode is None:
+        mode = backend_choice()
+    if mode in ("pallas", "interpret"):
+        out = paged_attention_decode(
+            q[:, 0], k_pages, v_pages, block_tables, ctx_lens,
+            interpret=(mode == "interpret"),
+        )
+        return out[:, None]
+    k_all = kvc.gather_kv(k_pages, block_tables)
+    v_all = kvc.gather_kv(v_pages, block_tables)
+    return causal_attention(
+        q, k_all, v_all, q_positions=positions[:, None], kv_valid_len=ctx_lens
+    )

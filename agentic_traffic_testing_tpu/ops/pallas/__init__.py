@@ -1,0 +1,8 @@
+"""Pallas TPU kernels (first-party analog of the CUDA kernel set the
+reference testbed pulls in via vLLM — reference: llm/serve_llm.py:22-34)."""
+
+from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
+    paged_attention_decode,
+)
+
+__all__ = ["paged_attention_decode"]

@@ -16,7 +16,7 @@ Layout (param schema from models/llama.py:init_params, stacked [L, ...]):
                             parallel), D-sharded otherwise (local gather)
     lm_head   [V, D]       V-sharded -> logits arrive V-sharded; sampling's
                             argmax/sort reductions run as XLA collectives
-    KV cache  [L, nb, bs, KH, hd] shard KV heads on `tp`
+    KV cache  [L, KH, nb, bs, hd] shard KV heads on `tp`
 
 Constraint: tp must divide num_kv_heads (KV-head sharding) and num_heads.
 """
@@ -73,7 +73,7 @@ def param_pspecs(cfg: ModelConfig) -> dict:
 
 
 def kv_cache_pspecs() -> KVCache:
-    spec = P(None, None, None, AXIS_TP, None)
+    spec = P(None, AXIS_TP, None, None, None)
     return KVCache(k=spec, v=spec)
 
 

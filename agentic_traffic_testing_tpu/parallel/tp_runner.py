@@ -29,6 +29,11 @@ from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
 class TPRunner(ModelRunner):
     """Runner whose params/cache live sharded on a `tp` mesh axis."""
 
+    # pallas_call has no SPMD partitioning rule: under GSPMD it would force an
+    # all-gather of the head-sharded page pool. Use the jnp gather path, which
+    # the partitioner shards cleanly (kernel-under-shard_map is future work).
+    attn_mode = "gather"
+
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh) -> None:
         validate_tp(cfg, mesh.shape[AXIS_TP])
         self.mesh = mesh

@@ -5,9 +5,15 @@ reference testbed reads and re-exports as Prometheus gauges
 (reference: llm/serve_llm.py:245-264, 410-502 and gauge defs :142-162).
 
 Layout (per model):
-    k_cache, v_cache : [L, num_blocks, block_size, KH, hd]
+    k_cache, v_cache : [L, KH, num_blocks, block_size, hd]
     block_tables     : [max_seqs, max_blocks_per_seq] int32
     context_lens     : [max_seqs] int32
+
+The pool is *heads-major* (KH before the block axis) so a single page of one
+KV head — the unit the Pallas paged-attention kernel streams HBM->VMEM — is a
+contiguous [block_size, hd] tile that satisfies Mosaic's (8, 128) tiling rule.
+This is the standard TPU paged-KV layout; the reference's GPU stack keeps
+heads innermost because CUDA warps gather per-token instead.
 
 Block 0 is reserved as a *trash block*: padding rows of every block table point
 at it, so scatter-writes from padded lanes land harmlessly and reads from it
@@ -35,16 +41,16 @@ TRASH_BLOCK = 0
 class KVCache(NamedTuple):
     """Stacked per-layer paged KV storage (a pytree; lives in HBM)."""
 
-    k: jax.Array  # [L, num_blocks, block_size, KH, hd]
-    v: jax.Array  # [L, num_blocks, block_size, KH, hd]
+    k: jax.Array  # [L, KH, num_blocks, block_size, hd]
+    v: jax.Array  # [L, KH, num_blocks, block_size, hd]
 
     @property
     def num_blocks(self) -> int:
-        return self.k.shape[1]
+        return self.k.shape[2]
 
     @property
     def block_size(self) -> int:
-        return self.k.shape[2]
+        return self.k.shape[3]
 
     @property
     def usable_tokens(self) -> int:
@@ -54,7 +60,7 @@ class KVCache(NamedTuple):
 def make_kv_cache(
     cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
 ) -> KVCache:
-    shape = (cfg.num_layers, num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim_)
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks, block_size, cfg.head_dim_)
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -65,17 +71,17 @@ def write_prompt_kv(
 ) -> jax.Array:
     """Scatter a padded prompt's K (or V) into one layer's block pool.
 
-    cache_l      [num_blocks, bs, KH, hd]
+    cache_l      [KH, num_blocks, bs, hd]
     new          [B, T, KH, hd] with T % bs == 0 (caller pads)
     block_tables [B, max_blocks]; entries beyond each prompt's blocks = TRASH_BLOCK
     """
-    nb_cache, bs, kh, hd = cache_l.shape
+    kh, nb_cache, bs, hd = cache_l.shape
     b, t, _, _ = new.shape
     nb = t // bs
-    blocks = new.reshape(b * nb, bs, kh, hd)
+    blocks = new.reshape(b * nb, bs, kh, hd).transpose(2, 0, 1, 3)  # [KH, B*nb, bs, hd]
     idx = block_tables[:, :nb].reshape(b * nb)
     # Duplicate trash-block indices race among themselves only; real blocks are unique.
-    return cache_l.at[idx].set(blocks, mode="drop", unique_indices=False)
+    return cache_l.at[:, idx].set(blocks, mode="drop", unique_indices=False)
 
 
 def write_decode_kv(
@@ -86,35 +92,35 @@ def write_decode_kv(
 ) -> jax.Array:
     """Write one token per sequence into one layer's block pool.
 
-    cache_l      [num_blocks, bs, KH, hd]
+    cache_l      [KH, num_blocks, bs, hd]
     new          [B, KH, hd]
     block_tables [B, max_blocks]
     positions    [B] absolute position being written (trash rows may point anywhere;
                  caller sets their block table rows to TRASH_BLOCK)
     """
-    nb_cache, bs, kh, hd = cache_l.shape
+    kh, nb_cache, bs, hd = cache_l.shape
     b = new.shape[0]
     block_idx = jnp.take_along_axis(block_tables, (positions // bs)[:, None], axis=1)[:, 0]
-    flat_idx = block_idx * bs + positions % bs  # [B] into [(num_blocks*bs), KH, hd]
-    flat = cache_l.reshape(nb_cache * bs, kh, hd)
-    flat = flat.at[flat_idx].set(new, mode="drop")
-    return flat.reshape(nb_cache, bs, kh, hd)
+    flat_idx = block_idx * bs + positions % bs  # [B] into [KH, (num_blocks*bs), hd]
+    flat = cache_l.reshape(kh, nb_cache * bs, hd)
+    flat = flat.at[:, flat_idx].set(new.transpose(1, 0, 2), mode="drop")
+    return flat.reshape(kh, nb_cache, bs, hd)
 
 
 def gather_kv(cache_l: jax.Array, block_tables: jax.Array) -> jax.Array:
     """Materialize each sequence's KV from one layer's pool (jnp reference path).
 
-    cache_l      [num_blocks, bs, KH, hd]
+    cache_l      [KH, num_blocks, bs, hd]
     block_tables [B, max_blocks]
     returns      [B, max_blocks*bs, KH, hd]
 
     The Pallas paged-attention kernel replaces this gather on TPU; this path is
     the correctness oracle and the CPU/test fallback.
     """
-    nb_cache, bs, kh, hd = cache_l.shape
+    kh, nb_cache, bs, hd = cache_l.shape
     b, max_blocks = block_tables.shape
-    gathered = cache_l[block_tables.reshape(-1)]  # [B*max_blocks, bs, KH, hd]
-    return gathered.reshape(b, max_blocks * bs, kh, hd)
+    gathered = cache_l[:, block_tables.reshape(-1)]  # [KH, B*max_blocks, bs, hd]
+    return gathered.reshape(kh, b, max_blocks * bs, hd).transpose(1, 2, 0, 3)
 
 
 def kv_cache_bytes(cfg: ModelConfig, num_blocks: int, block_size: int, dtype_bytes: int = 2) -> int:

@@ -56,9 +56,11 @@ def _prefill_sample_impl(params, cfg: ModelConfig, tokens, cache, block_tables,
 
 
 def _decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
-                        state: DecodeState, samp: SamplingArrays):
+                        state: DecodeState, samp: SamplingArrays,
+                        attn_mode=None):
     logits, cache = decode_step_impl(params, cfg, state.tokens, cache,
-                                     block_tables, state.positions)
+                                     block_tables, state.positions,
+                                     attn_mode=attn_mode)
     keys = make_row_keys(samp.seeds, state.steps)
     out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
     new_state = DecodeState(tokens=out, positions=state.positions + 1, steps=state.steps + 1)
@@ -75,11 +77,15 @@ class ModelRunner:
             partial(_prefill_sample_impl, cfg=cfg), donate_argnames=("cache",)
         )
         self._decode = jax.jit(
-            partial(_decode_sample_impl, cfg=cfg), donate_argnames=("cache",)
+            partial(_decode_sample_impl, cfg=cfg, attn_mode=self.attn_mode),
+            donate_argnames=("cache",),
         )
 
     #: chips the KV cache is sharded across (overridden by parallel/tp_runner.py)
     tp_size: int = 1
+    #: decode-attention implementation baked into the jit (None = auto;
+    #: the TP runner forces "gather" — see ops/attention_backend.py)
+    attn_mode: Optional[str] = None
 
     def prepare_cache(self, cache: KVCache) -> KVCache:
         """Hook for placing a freshly allocated cache (TP runner shards it)."""

@@ -1,0 +1,118 @@
+"""Pallas paged-attention decode kernel vs. the jnp gather oracle.
+
+Runs the kernel in interpreter mode on CPU (SURVEY.md §4: kernel unit tests
+diff Pallas against the reference jnp attention). The oracle is
+`gather_kv` + `causal_attention` — the exact math the serving decode step
+uses when ATT_TPU_ATTENTION=gather.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
+from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
+    paged_attention_decode,
+)
+from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK, gather_kv
+
+
+def _random_case(rng, *, b, h, kh, hd, bs, max_blocks, num_blocks, ctx_lens,
+                 dtype=jnp.float32):
+    q = jnp.asarray(rng.standard_normal((b, h, hd)), dtype)
+    k_pages = jnp.asarray(rng.standard_normal((kh, num_blocks, bs, hd)), dtype)
+    v_pages = jnp.asarray(rng.standard_normal((kh, num_blocks, bs, hd)), dtype)
+    bt = np.full((b, max_blocks), TRASH_BLOCK, np.int32)
+    nxt = 1
+    for i, ln in enumerate(ctx_lens):
+        n = -(-ln // bs)
+        bt[i, :n] = np.arange(nxt, nxt + n)
+        nxt += n
+    assert nxt <= num_blocks
+    return q, k_pages, v_pages, jnp.asarray(bt), jnp.asarray(ctx_lens, jnp.int32)
+
+
+def _oracle(q, k_pages, v_pages, bt, ctx_lens):
+    k_all = gather_kv(k_pages, bt)
+    v_all = gather_kv(v_pages, bt)
+    out = causal_attention(
+        q[:, None], k_all, v_all,
+        q_positions=(ctx_lens - 1)[:, None], kv_valid_len=ctx_lens,
+    )
+    return out[:, 0]
+
+
+@pytest.mark.parametrize(
+    "b,h,kh,hd,bs,ctx_lens",
+    [
+        (2, 4, 2, 64, 4, [5, 9]),          # GQA 2:1, ragged contexts
+        (3, 4, 4, 64, 8, [1, 8, 17]),      # MHA, boundary lengths
+        (1, 8, 1, 128, 4, [13]),           # MQA, hd=128
+        (4, 4, 2, 64, 4, [4, 1, 30, 12]),  # mixed, one lane nearly dead
+    ],
+)
+def test_kernel_matches_oracle(b, h, kh, hd, bs, ctx_lens):
+    rng = np.random.default_rng(42)
+    max_blocks = max(-(-ln // bs) for ln in ctx_lens) + 2
+    num_blocks = 1 + sum(-(-ln // bs) for ln in ctx_lens) + 2
+    q, kp, vp, bt, cl = _random_case(
+        rng, b=b, h=h, kh=kh, hd=hd, bs=bs, max_blocks=max_blocks,
+        num_blocks=num_blocks, ctx_lens=ctx_lens,
+    )
+    got = paged_attention_decode(q, kp, vp, bt, cl, interpret=True)
+    want = _oracle(q, kp, vp, bt, cl)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+def test_kernel_bf16_matches_oracle():
+    rng = np.random.default_rng(7)
+    q, kp, vp, bt, cl = _random_case(
+        rng, b=2, h=8, kh=2, hd=64, bs=8, max_blocks=4, num_blocks=8,
+        ctx_lens=[11, 23], dtype=jnp.bfloat16,
+    )
+    got = paged_attention_decode(q, kp, vp, bt, cl, interpret=True)
+    want = _oracle(q, kp, vp, bt, cl)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=2e-2, rtol=2e-2,
+    )
+
+
+def test_inactive_lane_is_finite():
+    """Dead lanes (ctx 1, trash table) must return finite garbage, not NaN."""
+    rng = np.random.default_rng(3)
+    q, kp, vp, bt, cl = _random_case(
+        rng, b=2, h=4, kh=2, hd=64, bs=4, max_blocks=3, num_blocks=6,
+        ctx_lens=[6, 1],
+    )
+    bt = bt.at[1].set(TRASH_BLOCK)
+    got = paged_attention_decode(q, kp, vp, bt, cl, interpret=True)
+    assert np.isfinite(np.asarray(got)).all()
+
+
+def test_decode_step_uses_kernel_when_forced(monkeypatch):
+    """End-to-end: forcing ATT_TPU_ATTENTION=interpret through the model's
+    decode step must reproduce the gather path's logits."""
+    monkeypatch.setenv("ATT_TPU_ATTENTION", "interpret")
+    import jax
+
+    from agentic_traffic_testing_tpu.models.config import PRESETS
+    from agentic_traffic_testing_tpu.models.llama import decode_step_impl, init_params, prefill
+    from agentic_traffic_testing_tpu.runtime.kv_cache import make_kv_cache
+
+    cfg = PRESETS["tiny"]
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 4)), jnp.int32)
+    bt = jnp.asarray([[1, 2, TRASH_BLOCK], [3, 4, TRASH_BLOCK]], jnp.int32)
+    cache = make_kv_cache(cfg, num_blocks=8, block_size=4, dtype=jnp.float32)
+    lens = jnp.asarray([4, 4], jnp.int32)
+    logits, cache = prefill(params, cfg, tokens, cache, bt, lens)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    got, _ = decode_step_impl(params, cfg, nxt, cache, bt, lens)
+    monkeypatch.setenv("ATT_TPU_ATTENTION", "gather")
+    want, _ = decode_step_impl(params, cfg, nxt, cache, bt, lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-3)
