@@ -1,0 +1,9 @@
+"""Agent services layer: the agentic workload that drives the TPU LLM backend.
+
+TPU-rebuild of the reference testbed's L7/L8 layers (reference: agents/ —
+SURVEY.md §2.5): Agent A (orchestrator service with three scenarios plus the
+AgentVerse 4-stage workflow engine), Agent B (worker replicas), and the shared
+telemetry/tracing/metrics-logging plumbing. Same HTTP surface, env vars, and
+JSONL file formats as the reference so its experiment runner, dashboards, and
+UIs work unchanged; implementation is asyncio/aiohttp first-party code.
+"""

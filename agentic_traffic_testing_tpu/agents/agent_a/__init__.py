@@ -1,0 +1,2 @@
+"""Agent A: orchestrator service + AgentVerse workflow engine
+(reference: agents/agent_a/ — SURVEY.md §2.5)."""

@@ -1,0 +1,526 @@
+"""AgentVerse workflow engine: recruit -> decide -> execute -> evaluate, iterate.
+
+Re-implementation of the reference's 4-stage orchestrator (reference:
+agents/agent_a/orchestrator.py:124-2108; paper mapping in
+docs/agent_verse_implementation.md) on asyncio:
+
+  Stage 1 recruit_experts        1 LLM call, JSON/markdown-robust parsing
+  Stage 2 collaborative_decision horizontal: round-table via agent-B /discuss,
+                                 early-stop on [CONSENSUS], then a synthesis
+                                 LLM call; vertical: solver plan via agent-B,
+                                 reviewers fan out in parallel, early-stop on
+                                 [APPROVED], bounded refinement iterations
+  Stage 3 execute_actions        per-expert assignments fan out to agent-B
+                                 /subtask concurrently (semaphore-capped)
+  Stage 4 evaluate_results       budget-trimmed rubric LLM call; the numeric
+                                 threshold — not the model's goal_achieved
+                                 bit — decides convergence
+  loop                           up to max_iterations, evaluator feedback
+                                 feeds the next iteration's solver; errors
+                                 return partial state instead of dying
+
+Every LLM round trip is tracked (request id, latency, tokens, otel ids) into
+`state.llm_calls` and mirrored to the progress callback as SSE-able events;
+the event vocabulary matches the reference UI's (SURVEY.md §2.9):
+iteration_start, stage_start, stage_complete, llm_request, llm_error,
+discussion_round, vertical_iteration, execution_result, iteration_complete,
+workflow_error, complete, error.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from agentic_traffic_testing_tpu.agents.agent_a import prompts
+from agentic_traffic_testing_tpu.agents.agent_a.parsing import (
+    parse_evaluation,
+    parse_experts,
+    parse_subtasks,
+)
+from agentic_traffic_testing_tpu.agents.common.llm_client import (
+    AgentHTTPClient,
+    LLMResult,
+    agent_b_urls,
+    cost_estimate_usd,
+)
+from agentic_traffic_testing_tpu.agents.common.telemetry import TelemetryLogger
+from agentic_traffic_testing_tpu.utils.tracing import get_tracer
+
+ProgressCallback = Callable[[str, Dict[str, Any]], None]
+
+CONSENSUS_TOKEN = "[CONSENSUS]"
+APPROVED_TOKEN = "[APPROVED]"
+DONE_TOKEN = "[DONE]"
+
+
+# --------------------------------------------------------------------------
+# State dataclasses (reference: orchestrator.py:124-198)
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class Expert:
+    name: str
+    expertise: str
+    responsibility: str = ""
+
+    def to_dict(self) -> Dict[str, str]:
+        return {"name": self.name, "expertise": self.expertise,
+                "responsibility": self.responsibility}
+
+
+@dataclass
+class RecruitmentResult:
+    experts: List[Expert] = field(default_factory=list)
+    raw: str = ""
+
+
+@dataclass
+class DecisionResult:
+    plan: str = ""
+    structure: str = "horizontal"
+    rounds: int = 0
+    consensus: bool = False
+    discussion: List[Dict[str, Any]] = field(default_factory=list)
+
+
+@dataclass
+class ExecutionResult:
+    outputs: List[Dict[str, Any]] = field(default_factory=list)
+
+    def combined_text(self) -> str:
+        parts = []
+        for o in self.outputs:
+            who = o.get("expert", "worker")
+            body = o.get("result") or o.get("error") or ""
+            parts.append(f"### {who}\n{body}")
+        return "\n\n".join(parts)
+
+
+@dataclass
+class EvaluationResult:
+    overall_score: float = 0.0
+    goal_achieved: bool = False
+    feedback: str = ""
+    scores: Dict[str, float] = field(default_factory=dict)
+    raw: str = ""
+
+
+@dataclass
+class AgentVerseState:
+    task: str
+    task_id: str
+    iteration: int = 0
+    recruitment: Optional[RecruitmentResult] = None
+    decision: Optional[DecisionResult] = None
+    execution: Optional[ExecutionResult] = None
+    evaluation: Optional[EvaluationResult] = None
+    final_output: str = ""
+    llm_calls: List[Dict[str, Any]] = field(default_factory=list)
+    iterations_log: List[Dict[str, Any]] = field(default_factory=list)
+    error: Optional[str] = None
+    started_at: float = field(default_factory=time.time)
+
+    def to_response(self) -> Dict[str, Any]:
+        prompt_tokens = sum(c.get("prompt_tokens", 0) for c in self.llm_calls)
+        completion_tokens = sum(c.get("completion_tokens", 0) for c in self.llm_calls)
+        resp: Dict[str, Any] = {
+            "task_id": self.task_id,
+            "task": self.task,
+            "final_output": self.final_output,
+            "iterations": self.iterations_log,
+            "iteration_count": self.iteration,
+            "experts": [e.to_dict() for e in
+                        (self.recruitment.experts if self.recruitment else [])],
+            "llm_calls": self.llm_calls,
+            "aggregates": {
+                "num_llm_calls": len(self.llm_calls),
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": completion_tokens,
+                "total_tokens": prompt_tokens + completion_tokens,
+                "total_latency_ms": round(sum(
+                    c.get("latency_ms", 0.0) for c in self.llm_calls), 2),
+                "cost_estimate_usd": round(
+                    cost_estimate_usd(prompt_tokens, completion_tokens), 6),
+                "wall_time_s": round(time.time() - self.started_at, 3),
+            },
+        }
+        if self.evaluation:
+            resp["evaluation"] = {
+                "overall_score": self.evaluation.overall_score,
+                "goal_achieved": self.evaluation.goal_achieved,
+                "feedback": self.evaluation.feedback,
+                "scores": self.evaluation.scores,
+            }
+        if self.error:
+            resp["error"] = self.error
+        return resp
+
+
+# --------------------------------------------------------------------------
+# Orchestrator
+# --------------------------------------------------------------------------
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class AgentVerseOrchestrator:
+    """One instance per service process; one `run_workflow` per task."""
+
+    def __init__(
+        self,
+        client: AgentHTTPClient,
+        telemetry: Optional[TelemetryLogger] = None,
+        *,
+        max_iterations: Optional[int] = None,
+        success_threshold: Optional[float] = None,
+        structure: Optional[str] = None,
+        num_experts: Optional[int] = None,
+    ) -> None:
+        self.client = client
+        self.telemetry = telemetry or TelemetryLogger("agent_a")
+        self.max_iterations = max_iterations or _env_int("AGENTVERSE_MAX_ITERATIONS", 3)
+        self.success_threshold = (success_threshold if success_threshold is not None
+                                  else float(os.environ.get("AGENTVERSE_SUCCESS_THRESHOLD", "70")))
+        self.structure = structure or os.environ.get("AGENTVERSE_STRUCTURE", "vertical")
+        self.num_experts = num_experts or _env_int("AGENTVERSE_NUM_EXPERTS", 3)
+        self.max_rounds = _env_int("AGENTVERSE_DISCUSSION_ROUNDS", 3)
+        self.max_vertical_iters = _env_int("AGENTVERSE_VERTICAL_ITERATIONS", 2)
+        self.max_workers = _env_int("MAX_PARALLEL_WORKERS", 5)
+        self.eval_max_tokens = _env_int("LLM_EVAL_MAX_TOKENS", 1024)
+        self.eval_max_prompt_chars = _env_int("EVAL_MAX_PROMPT_CHARS", 8000)
+        self.worker_urls = agent_b_urls()
+        self._sem = asyncio.Semaphore(self.max_workers)
+
+    # ------------------------------------------------------------- helpers
+    def _emit(self, cb: Optional[ProgressCallback], event: str,
+              payload: Dict[str, Any]) -> None:
+        if cb is not None:
+            try:
+                cb(event, payload)
+            except Exception:
+                pass  # a broken SSE client must not kill the workflow
+
+    async def _call_llm_tracked(
+        self, state: AgentVerseState, prompt: str, *, stage: str,
+        cb: Optional[ProgressCallback], max_tokens: Optional[int] = None,
+    ) -> LLMResult:
+        """LLM round trip + bookkeeping into state.llm_calls + SSE event."""
+        res = await self.client.call_llm(
+            prompt, task_id=state.task_id, max_tokens=max_tokens,
+            call_type="sub_call" if state.llm_calls else "root",
+        )
+        record = {
+            "request_id": res.request_id,
+            "stage": stage,
+            "iteration": state.iteration,
+            "latency_ms": round(res.latency_ms, 2),
+            "prompt_tokens": res.prompt_tokens,
+            "completion_tokens": res.completion_tokens,
+            "status": res.status,
+            "otel": res.meta.get("otel", {}),
+            "error": res.error,
+        }
+        state.llm_calls.append(record)
+        self._emit(cb, "llm_error" if res.error else "llm_request", record)
+        return res
+
+    async def _call_worker(self, state: AgentVerseState, idx: int, subtask: str,
+                           role: str, endpoint: str = "subtask") -> Dict[str, Any]:
+        url = self.worker_urls[idx % len(self.worker_urls)]
+        async with self._sem:
+            out = await self.client.call_agent_b(
+                url, subtask, role=role, task_id=state.task_id, endpoint=endpoint)
+        for meta_key in ("llm_meta",):
+            meta = out.get(meta_key) or {}
+            if meta:
+                state.llm_calls.append({
+                    "request_id": meta.get("request_id", ""),
+                    "stage": f"worker_{endpoint}",
+                    "iteration": state.iteration,
+                    "latency_ms": meta.get("latency_ms", 0.0),
+                    "prompt_tokens": meta.get("prompt_tokens", 0),
+                    "completion_tokens": meta.get("completion_tokens", 0),
+                    "status": 200 if "error" not in out else 502,
+                    "otel": meta.get("otel", out.get("otel", {})),
+                    "error": out.get("error"),
+                })
+        return out
+
+    # ------------------------------------------------------- Stage 1
+    async def recruit_experts(self, state: AgentVerseState,
+                              cb: Optional[ProgressCallback]) -> RecruitmentResult:
+        self._emit(cb, "stage_start", {"stage": "recruitment",
+                                       "iteration": state.iteration})
+        prompt = prompts.EXPERT_RECRUITMENT_PROMPT.format(
+            task=state.task, num_experts=self.num_experts)
+        res = await self._call_llm_tracked(state, prompt, stage="recruitment", cb=cb)
+        experts = [Expert(**e) for e in parse_experts(res.output, self.num_experts)]
+        result = RecruitmentResult(experts=experts, raw=res.output)
+        state.recruitment = result
+        self._emit(cb, "stage_complete", {
+            "stage": "recruitment", "iteration": state.iteration,
+            "experts": [e.to_dict() for e in experts]})
+        return result
+
+    # ------------------------------------------------------- Stage 2
+    async def collaborative_decision(self, state: AgentVerseState,
+                                     cb: Optional[ProgressCallback],
+                                     feedback: str = "") -> DecisionResult:
+        self._emit(cb, "stage_start", {"stage": "decision",
+                                       "iteration": state.iteration,
+                                       "structure": self.structure})
+        if self.structure == "horizontal":
+            result = await self._horizontal_discussion(state, cb)
+        else:
+            result = await self._vertical_decision(state, cb, feedback)
+        state.decision = result
+        self._emit(cb, "stage_complete", {
+            "stage": "decision", "iteration": state.iteration,
+            "structure": result.structure, "rounds": result.rounds,
+            "consensus": result.consensus,
+            "plan_preview": result.plan[:500]})
+        return result
+
+    async def _horizontal_discussion(self, state: AgentVerseState,
+                                     cb: Optional[ProgressCallback]) -> DecisionResult:
+        """Round-table: each expert speaks in turn (sequential — the point is
+        the traffic pattern of turn-taking), stop on [CONSENSUS]."""
+        experts = state.recruitment.experts if state.recruitment else []
+        history: List[Dict[str, Any]] = []
+        consensus = False
+        rounds_done = 0
+        for rnd in range(self.max_rounds):
+            rounds_done = rnd + 1
+            for i, ex in enumerate(experts):
+                transcript = "\n\n".join(
+                    f"{h['expert']}: {h['message']}" for h in history) or "(none yet)"
+                sub = prompts.HORIZONTAL_DISCUSSION_PROMPT.format(
+                    expert_name=ex.name, expertise=ex.expertise,
+                    task=state.task, discussion_history=transcript)
+                out = await self._call_worker(state, i, sub, ex.expertise,
+                                              endpoint="discuss")
+                message = out.get("result") or out.get("error") or ""
+                history.append({"round": rnd, "expert": ex.name, "message": message})
+                self._emit(cb, "discussion_round", {
+                    "iteration": state.iteration, "round": rnd,
+                    "expert": ex.name, "message": message[:500]})
+                if CONSENSUS_TOKEN in message:
+                    consensus = True
+                    break
+            if consensus:
+                break
+        transcript = "\n\n".join(f"{h['expert']}: {h['message']}" for h in history)
+        synth = await self._call_llm_tracked(
+            state,
+            prompts.SYNTHESIZE_DISCUSSION_PROMPT.format(
+                task=state.task, discussion_history=transcript[-self.eval_max_prompt_chars:]),
+            stage="decision_synthesis", cb=cb, max_tokens=2048)
+        return DecisionResult(plan=synth.output, structure="horizontal",
+                              rounds=rounds_done, consensus=consensus,
+                              discussion=history)
+
+    async def _vertical_decision(self, state: AgentVerseState,
+                                 cb: Optional[ProgressCallback],
+                                 feedback: str) -> DecisionResult:
+        """Solver proposes, reviewers critique in parallel, stop on approval."""
+        experts = state.recruitment.experts if state.recruitment else []
+        solver = experts[0] if experts else Expert("Lead Solver", "generalist")
+        reviewers = experts[1:] or [Expert("Reviewer", "generalist")]
+        feedback_section = (
+            f"\nEvaluator feedback from the previous iteration:\n{feedback}\n"
+            if feedback else "")
+        plan = ""
+        history: List[Dict[str, Any]] = []
+        approved = False
+        iters = 0
+        for vi in range(self.max_vertical_iters):
+            iters = vi + 1
+            solver_prompt = prompts.VERTICAL_SOLVER_PROMPT.format(
+                task=state.task, feedback_section=feedback_section)
+            if history:
+                critiques = "\n\n".join(
+                    f"{h['expert']}: {h['message']}" for h in history
+                    if h["round"] == vi - 1)
+                solver_prompt += ("\nReviewer critiques of your previous plan "
+                                  f"(address them):\n{critiques}\n")
+            out = await self._call_worker(state, 0, solver_prompt,
+                                          solver.expertise)
+            plan = out.get("result") or out.get("error") or ""
+            self._emit(cb, "vertical_iteration", {
+                "iteration": state.iteration, "vertical_round": vi,
+                "role": "solver", "plan_preview": plan[:500]})
+
+            review_tasks = [
+                self._call_worker(
+                    state, i + 1,
+                    prompts.VERTICAL_REVIEWER_PROMPT.format(
+                        expert_name=rv.name, expertise=rv.expertise,
+                        task=state.task, solution=plan),
+                    rv.expertise, endpoint="discuss")
+                for i, rv in enumerate(reviewers)
+            ]
+            reviews = await asyncio.gather(*review_tasks)
+            approvals = 0
+            for rv, out in zip(reviewers, reviews):
+                message = out.get("result") or out.get("error") or ""
+                history.append({"round": vi, "expert": rv.name, "message": message})
+                self._emit(cb, "vertical_iteration", {
+                    "iteration": state.iteration, "vertical_round": vi,
+                    "role": "reviewer", "expert": rv.name,
+                    "message": message[:500]})
+                if APPROVED_TOKEN in message:
+                    approvals += 1
+            if approvals == len(reviewers):
+                approved = True
+                break
+        return DecisionResult(plan=plan, structure="vertical", rounds=iters,
+                              consensus=approved, discussion=history)
+
+    # ------------------------------------------------------- Stage 3
+    async def execute_actions(self, state: AgentVerseState,
+                              cb: Optional[ProgressCallback]) -> ExecutionResult:
+        self._emit(cb, "stage_start", {"stage": "execution",
+                                       "iteration": state.iteration})
+        experts = state.recruitment.experts if state.recruitment else []
+        plan = state.decision.plan if state.decision else state.task
+        n = max(1, min(len(experts) or 1, self.max_workers))
+        assignments = parse_subtasks(plan, n)
+
+        async def run_one(i: int, ex: Expert, assignment: str) -> Dict[str, Any]:
+            sub = prompts.EXECUTION_PROMPT.format(
+                expert_name=ex.name, expertise=ex.expertise, task=state.task,
+                plan=plan[:self.eval_max_prompt_chars], assignment=assignment)
+            out = await self._call_worker(state, i, sub, ex.expertise)
+            entry = {"expert": ex.name, "assignment": assignment,
+                     "result": out.get("result", ""),
+                     "worker_url": out.get("worker_url")}
+            if out.get("error"):
+                entry["error"] = out["error"]
+            self._emit(cb, "execution_result", {
+                "iteration": state.iteration, "expert": ex.name,
+                "ok": "error" not in entry,
+                "result_preview": entry.get("result", "")[:300]})
+            return entry
+
+        pool = experts or [Expert("Worker", "generalist")]
+        outputs = await asyncio.gather(*[
+            run_one(i, pool[i % len(pool)], a) for i, a in enumerate(assignments)])
+        result = ExecutionResult(outputs=list(outputs))
+        state.execution = result
+        self._emit(cb, "stage_complete", {"stage": "execution",
+                                          "iteration": state.iteration,
+                                          "num_outputs": len(outputs)})
+        return result
+
+    # ------------------------------------------------------- Stage 4
+    def _budget_results_text(self, results_text: str, task: str, plan: str) -> str:
+        """Trim the *oldest* result content, keep the tail (reference keeps
+        the most recent work — orchestrator.py:627-821); char-budgeted
+        against EVAL_MAX_PROMPT_CHARS as the model-len guardrail proxy."""
+        budget = self.eval_max_prompt_chars - len(task) - min(len(plan), 2000)
+        if budget <= 0:
+            budget = 1000
+        if len(results_text) > budget:
+            results_text = "[...truncated...]\n" + results_text[-budget:]
+        return results_text
+
+    async def evaluate_results(self, state: AgentVerseState,
+                               cb: Optional[ProgressCallback]) -> EvaluationResult:
+        self._emit(cb, "stage_start", {"stage": "evaluation",
+                                       "iteration": state.iteration})
+        plan = state.decision.plan if state.decision else ""
+        results_text = state.execution.combined_text() if state.execution else ""
+        results_text = self._budget_results_text(results_text, state.task, plan)
+        prompt = prompts.EVALUATION_PROMPT.format(
+            task=state.task, plan=plan[:2000], results=results_text)
+        res = await self._call_llm_tracked(state, prompt, stage="evaluation",
+                                           cb=cb, max_tokens=self.eval_max_tokens)
+        parsed = parse_evaluation(res.output)
+        # The numeric threshold is the source of truth: a model claiming
+        # success below threshold iterates anyway, and vice versa
+        # (reference: orchestrator.py:1748-1760).
+        achieved = parsed["overall_score"] >= self.success_threshold
+        result = EvaluationResult(
+            overall_score=parsed["overall_score"], goal_achieved=achieved,
+            feedback=parsed["feedback"],
+            scores={k: parsed[k] for k in ("completeness", "correctness", "clarity")},
+            raw=res.output)
+        state.evaluation = result
+        self._emit(cb, "stage_complete", {
+            "stage": "evaluation", "iteration": state.iteration,
+            "overall_score": result.overall_score,
+            "goal_achieved": result.goal_achieved,
+            "feedback": result.feedback[:500]})
+        return result
+
+    # ------------------------------------------------------- final output
+    async def _generate_final_output(self, state: AgentVerseState,
+                                     cb: Optional[ProgressCallback]) -> str:
+        results_text = state.execution.combined_text() if state.execution else ""
+        results_text = self._budget_results_text(results_text, state.task, "")
+        feedback = state.evaluation.feedback if state.evaluation else ""
+        res = await self._call_llm_tracked(
+            state,
+            prompts.FINAL_SYNTHESIS_PROMPT.format(
+                task=state.task, results=results_text, feedback=feedback[:1000]),
+            stage="final_synthesis", cb=cb, max_tokens=4096)
+        return res.output
+
+    # ------------------------------------------------------- main loop
+    async def run_workflow(
+        self,
+        task: str,
+        task_id: Optional[str] = None,
+        progress_callback: Optional[ProgressCallback] = None,
+    ) -> AgentVerseState:
+        state = AgentVerseState(task=task, task_id=task_id or uuid.uuid4().hex[:12])
+        cb = progress_callback
+        tracer = get_tracer("agent_a")
+        self.telemetry.log("agentverse_started", task_id=state.task_id,
+                           scenario="agentverse")
+        try:
+            with tracer.start_as_current_span("orchestrator.run_workflow"):
+                feedback = ""
+                while state.iteration < self.max_iterations:
+                    self._emit(cb, "iteration_start",
+                               {"iteration": state.iteration})
+                    await self.recruit_experts(state, cb)
+                    await self.collaborative_decision(state, cb, feedback)
+                    await self.execute_actions(state, cb)
+                    evaluation = await self.evaluate_results(state, cb)
+                    state.iterations_log.append({
+                        "iteration": state.iteration,
+                        "overall_score": evaluation.overall_score,
+                        "goal_achieved": evaluation.goal_achieved,
+                        "feedback": evaluation.feedback,
+                        "plan": (state.decision.plan if state.decision else "")[:2000],
+                    })
+                    self._emit(cb, "iteration_complete", {
+                        "iteration": state.iteration,
+                        "overall_score": evaluation.overall_score,
+                        "goal_achieved": evaluation.goal_achieved})
+                    state.iteration += 1
+                    if evaluation.goal_achieved:
+                        break
+                    feedback = evaluation.feedback
+                state.final_output = await self._generate_final_output(state, cb)
+                self._emit(cb, "complete", {"task_id": state.task_id,
+                                            "iterations": state.iteration})
+        except Exception as e:  # partial state, never a dead request
+            state.error = f"{type(e).__name__}: {e}"
+            self._emit(cb, "workflow_error", {"error": state.error})
+        self.telemetry.log("agentverse_finished", task_id=state.task_id,
+                           scenario="agentverse", error=state.error,
+                           iterations=state.iteration)
+        return state

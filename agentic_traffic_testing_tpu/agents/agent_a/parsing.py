@@ -1,0 +1,161 @@
+"""Robust parsing of LLM output: fenced/loose JSON, lists, numbered subtasks.
+
+Small-model output is messy; the orchestrator must survive markdown fences,
+prose around JSON, trailing commas, and plain numbered lists (the reference
+hardens the same surface — agents/agent_a/orchestrator.py:511-625 and
+server.py:64-86). Every function here degrades to a usable fallback rather
+than raising.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, List, Optional
+
+_FENCE_RE = re.compile(r"```(?:json)?\s*(.*?)```", re.DOTALL)
+_LINE_ITEM_RE = re.compile(r"^\s*(?:\d+[.)]|[-*•])\s+(.*\S)\s*$")
+
+
+def _try_json(text: str) -> Optional[Any]:
+    try:
+        return json.loads(text)
+    except (json.JSONDecodeError, ValueError):
+        pass
+    # Tolerate trailing commas before a closing bracket/brace.
+    cleaned = re.sub(r",\s*([\]}])", r"\1", text)
+    try:
+        return json.loads(cleaned)
+    except (json.JSONDecodeError, ValueError):
+        return None
+
+
+def extract_json(text: str, expect: type = dict) -> Optional[Any]:
+    """Pull the first JSON value of type `expect` out of arbitrary LLM text.
+
+    Tries, in order: whole string, fenced blocks, first balanced {...} or
+    [...] span. Returns None when nothing parses.
+    """
+    if not text:
+        return None
+    for candidate in [text.strip(), *(m.strip() for m in _FENCE_RE.findall(text))]:
+        val = _try_json(candidate)
+        if isinstance(val, expect):
+            return val
+    opener, closer = ("[", "]") if expect is list else ("{", "}")
+    start = text.find(opener)
+    while start != -1:
+        depth = 0
+        in_str = False
+        esc = False
+        for i in range(start, len(text)):
+            c = text[i]
+            if esc:
+                esc = False
+            elif c == "\\":
+                esc = True
+            elif c == '"':
+                in_str = not in_str
+            elif not in_str:
+                if c == opener:
+                    depth += 1
+                elif c == closer:
+                    depth -= 1
+                    if depth == 0:
+                        val = _try_json(text[start:i + 1])
+                        if isinstance(val, expect):
+                            return val
+                        break
+        start = text.find(opener, start + 1)
+    return None
+
+
+def parse_list_items(text: str, max_items: int = 16) -> List[str]:
+    """Numbered/bulleted lines -> list of item strings (markdown fallback)."""
+    items = []
+    for line in text.splitlines():
+        m = _LINE_ITEM_RE.match(line)
+        if m:
+            items.append(m.group(1).strip())
+        if len(items) >= max_items:
+            break
+    return items
+
+
+def parse_subtasks(text: str, expected: int) -> List[str]:
+    """Planner output -> exactly `expected` subtask strings.
+
+    JSON array first, then numbered/bulleted lines, then paragraph split;
+    pads by reusing the raw text so a fan-out always has work to hand out
+    (the reference pads the same way — agent_a/server.py:64-86).
+    """
+    val = extract_json(text, expect=list)
+    subtasks: List[str] = []
+    if isinstance(val, list):
+        subtasks = [str(s).strip() for s in val if str(s).strip()]
+    if not subtasks:
+        subtasks = parse_list_items(text)
+    if not subtasks:
+        subtasks = [p.strip() for p in text.split("\n\n") if p.strip()]
+    if not subtasks:
+        subtasks = [text.strip() or "(empty plan)"]
+    if len(subtasks) > expected:
+        subtasks = subtasks[:expected]
+    while len(subtasks) < expected:
+        subtasks.append(subtasks[len(subtasks) % max(1, len(subtasks))])
+    return subtasks
+
+
+def parse_experts(text: str, num_experts: int) -> List[dict]:
+    """Recruitment output -> list of expert dicts with name/expertise/responsibility."""
+    val = extract_json(text, expect=list)
+    experts: List[dict] = []
+    if isinstance(val, list):
+        for item in val:
+            if isinstance(item, dict) and item.get("name"):
+                experts.append({
+                    "name": str(item.get("name")),
+                    "expertise": str(item.get("expertise", "generalist")),
+                    "responsibility": str(item.get("responsibility", "")),
+                })
+    if not experts:
+        for i, line in enumerate(parse_list_items(text, max_items=num_experts)):
+            name, _, rest = line.partition(":")
+            experts.append({"name": name.strip() or f"Expert {i + 1}",
+                            "expertise": rest.strip() or "generalist",
+                            "responsibility": rest.strip()})
+    if not experts:
+        experts = [{"name": f"Expert {i + 1}", "expertise": "generalist",
+                    "responsibility": "contribute to the task"}
+                   for i in range(num_experts)]
+    return experts[:num_experts]
+
+
+def parse_evaluation(text: str) -> dict:
+    """Evaluation output -> rubric dict; never raises.
+
+    Missing/broken JSON yields score 0 + goal_achieved False with the raw
+    text as feedback, so the workflow iterates instead of crashing (the
+    threshold comparison stays the source of truth downstream).
+    """
+    val = extract_json(text, expect=dict) or {}
+
+    def num(key: str) -> float:
+        try:
+            return max(0.0, min(100.0, float(val.get(key, 0))))
+        except (TypeError, ValueError):
+            return 0.0
+
+    scores = {k: num(k) for k in ("completeness", "correctness", "clarity")}
+    overall = val.get("overall_score")
+    try:
+        overall = max(0.0, min(100.0, float(overall)))
+    except (TypeError, ValueError):
+        overall = round(0.4 * scores["completeness"] + 0.4 * scores["correctness"]
+                        + 0.2 * scores["clarity"], 2)
+    return {
+        **scores,
+        "overall_score": overall,
+        "goal_achieved": bool(val.get("goal_achieved", False)),
+        "feedback": str(val.get("feedback") or text.strip()[:2000]),
+    }

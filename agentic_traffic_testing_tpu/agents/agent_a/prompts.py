@@ -1,0 +1,157 @@
+"""Prompt templates for the AgentVerse workflow stages.
+
+Covers the same eight stage prompts as the reference pack (reference:
+agents/agent_a/prompts.py:8-192 — recruitment, horizontal discussion,
+vertical solver/reviewer, execution, weighted-rubric evaluation, final
+synthesis, discussion synthesis); wording is original to this rebuild.
+All templates are `str.format` style.
+"""
+
+EXPERT_RECRUITMENT_PROMPT = """\
+You are assembling a team to solve a task.
+
+Task: {task}
+
+Propose {num_experts} experts whose combined skills cover the task. Answer
+with a JSON array only — no prose before or after — where each element is:
+{{"name": "<short role name>", "expertise": "<one-line specialty>",
+  "responsibility": "<what this expert will own for this task>"}}
+"""
+
+HORIZONTAL_DISCUSSION_PROMPT = """\
+You are {expert_name} ({expertise}) in a round-table discussion.
+
+Task: {task}
+
+Discussion so far:
+{discussion_history}
+
+Give your view in at most two short paragraphs: what the group's approach
+should be, and what you would change about the proposals above. If you
+believe the group has converged on a single workable plan, end your message
+with the exact token [CONSENSUS].
+"""
+
+SYNTHESIZE_DISCUSSION_PROMPT = """\
+You are the moderator of an expert discussion.
+
+Task: {task}
+
+Full discussion transcript:
+{discussion_history}
+
+Write the group's agreed plan as a concise, numbered list of concrete steps.
+Resolve any remaining disagreement yourself, choosing the stronger argument.
+Output the plan only.
+"""
+
+VERTICAL_SOLVER_PROMPT = """\
+You are the lead solver on a team.
+
+Task: {task}
+{feedback_section}
+Produce a complete, concrete solution plan: numbered steps, each specific
+enough that a specialist could execute it without asking questions. Output
+the plan only.
+"""
+
+VERTICAL_REVIEWER_PROMPT = """\
+You are {expert_name} ({expertise}), reviewing a proposed plan.
+
+Task: {task}
+
+Proposed plan:
+{solution}
+
+Assess the plan strictly from your specialty. List concrete flaws or risks,
+each with a one-line fix. If the plan is sound enough to execute as-is, reply
+with the exact token [APPROVED] followed by at most one sentence.
+"""
+
+EXECUTION_PROMPT = """\
+You are {expert_name} ({expertise}) executing your part of an agreed plan.
+
+Task: {task}
+
+Agreed plan:
+{plan}
+
+Your assignment: {assignment}
+
+Carry out your assignment now and return the concrete work product (text,
+analysis, code, or data as appropriate) — not a description of what you
+would do.
+"""
+
+EVALUATION_PROMPT = """\
+You are the quality gate for a team's work on a task.
+
+Task: {task}
+
+Agreed plan:
+{plan}
+
+Execution results:
+{results}
+
+Score the work with this weighted rubric (0-100 each):
+- completeness (weight 0.4): does the output cover everything the task asked?
+- correctness (weight 0.4): is the content accurate and internally consistent?
+- clarity (weight 0.2): could the requester use this output as-is?
+
+Answer with JSON only:
+{{"completeness": <0-100>, "correctness": <0-100>, "clarity": <0-100>,
+  "overall_score": <weighted 0-100>, "goal_achieved": <true|false>,
+  "feedback": "<what to improve next iteration, one short paragraph>"}}
+"""
+
+FINAL_SYNTHESIS_PROMPT = """\
+You are writing the final deliverable for a completed team task.
+
+Task: {task}
+
+Execution results from the team:
+{results}
+
+Evaluator feedback: {feedback}
+
+Write the final answer to the original task, integrating the team's results
+into one coherent response. Address the task directly; do not describe the
+team process.
+"""
+
+MULTI_HOP_PROGRESS_PROMPT = """\
+You are supervising a multi-step task.
+
+Task: {task}
+
+Work so far:
+{context}
+
+In one short paragraph: state whether the task is now complete. If it is
+not, give the single next instruction for the worker. If it is complete,
+start your reply with the exact token [DONE] and summarize the answer.
+"""
+
+PARALLEL_PLANNING_PROMPT = """\
+You are decomposing a task for parallel workers.
+
+Task: {task}
+
+Split the task into exactly {num_workers} independent subtasks that can run
+concurrently and together cover the whole task. Answer with a JSON array of
+{num_workers} strings only — each string one self-contained subtask.
+"""
+
+PARALLEL_SYNTHESIS_PROMPT = """\
+You are combining parallel workers' results into one answer.
+
+Task: {task}
+
+Worker results:
+{results}
+
+Write the final answer to the task using the results above. Merge overlaps,
+resolve contradictions in favor of the better-supported claim, and answer
+the task directly.
+"""

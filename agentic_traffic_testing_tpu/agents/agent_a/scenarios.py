@@ -1,0 +1,138 @@
+"""Classic (non-AgentVerse) scenarios behind POST /task.
+
+Traffic-shape parity with the reference's three scenarios
+(reference: agents/agent_a/server.py:441-797):
+
+  agentic_simple     one LLM call, no workers
+  agentic_multi_hop  up to 3 sequential agent-B turns, each followed by a
+                     progress-check LLM call; context window clamped to the
+                     most recent 2000 chars (server.py:781-783)
+  agentic_parallel   planning LLM call -> parse N subtasks -> concurrent
+                     agent-B fan-out (capped) -> synthesis LLM call
+
+Each returns (result_text, detail dict with per-step bookkeeping).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Any, Dict, List, Tuple
+
+from agentic_traffic_testing_tpu.agents.agent_a import prompts
+from agentic_traffic_testing_tpu.agents.agent_a.parsing import parse_subtasks
+from agentic_traffic_testing_tpu.agents.common.llm_client import (
+    AgentHTTPClient,
+    agent_b_urls,
+)
+
+MULTI_HOP_MAX_TURNS = 3
+MULTI_HOP_CONTEXT_CHARS = 2000
+DONE_TOKEN = "[DONE]"
+
+
+def _normalize_workers(requested: Any, cap: int) -> int:
+    """Clamp a client-requested worker count into [1, cap]."""
+    try:
+        n = int(requested)
+    except (TypeError, ValueError):
+        n = cap
+    return max(1, min(n, cap))
+
+
+async def run_simple(client: AgentHTTPClient, task: str, task_id: str,
+                     max_tokens: int) -> Tuple[str, Dict[str, Any]]:
+    res = await client.call_llm(task, task_id=task_id, max_tokens=max_tokens)
+    return res.output, {
+        "scenario": "agentic_simple",
+        "llm_calls": 1,
+        "steps": [{"type": "llm", "request_id": res.request_id,
+                   "latency_ms": res.latency_ms, "error": res.error}],
+        "prompt_tokens": res.prompt_tokens,
+        "completion_tokens": res.completion_tokens,
+    }
+
+
+async def run_multi_hop(client: AgentHTTPClient, task: str, task_id: str,
+                        max_tokens: int) -> Tuple[str, Dict[str, Any]]:
+    urls = agent_b_urls()
+    steps: List[Dict[str, Any]] = []
+    context = ""
+    instruction = task
+    answer = ""
+    pt = ct = 0
+    for turn in range(MULTI_HOP_MAX_TURNS):
+        worker = await client.call_agent_b(
+            urls[turn % len(urls)], instruction, task_id=task_id)
+        worker_out = worker.get("result") or worker.get("error") or ""
+        steps.append({"type": "agent_b", "turn": turn,
+                      "worker_url": worker.get("worker_url"),
+                      "error": worker.get("error")})
+        context = (context + f"\n[turn {turn}] {worker_out}")[-MULTI_HOP_CONTEXT_CHARS:]
+
+        check = await client.call_llm(
+            prompts.MULTI_HOP_PROGRESS_PROMPT.format(task=task, context=context),
+            task_id=task_id, max_tokens=max_tokens, call_type="verification")
+        pt += check.prompt_tokens
+        ct += check.completion_tokens
+        steps.append({"type": "llm_progress_check", "turn": turn,
+                      "request_id": check.request_id, "error": check.error})
+        answer = check.output
+        if DONE_TOKEN in check.output:
+            answer = check.output.replace(DONE_TOKEN, "", 1).strip()
+            break
+        instruction = check.output.strip() or instruction
+    return answer, {
+        "scenario": "agentic_multi_hop",
+        "turns": len([s for s in steps if s["type"] == "agent_b"]),
+        "steps": steps,
+        "prompt_tokens": pt,
+        "completion_tokens": ct,
+    }
+
+
+async def run_parallel(client: AgentHTTPClient, task: str, task_id: str,
+                       max_tokens: int, agent_count: Any = None
+                       ) -> Tuple[str, Dict[str, Any]]:
+    cap = int(os.environ.get("MAX_PARALLEL_WORKERS", "5"))
+    n = _normalize_workers(agent_count, cap)
+    urls = agent_b_urls()
+    steps: List[Dict[str, Any]] = []
+
+    plan = await client.call_llm(
+        prompts.PARALLEL_PLANNING_PROMPT.format(task=task, num_workers=n),
+        task_id=task_id, max_tokens=max_tokens)
+    steps.append({"type": "llm_planning", "request_id": plan.request_id,
+                  "error": plan.error})
+    subtasks = parse_subtasks(plan.output, n)
+
+    sem = asyncio.Semaphore(cap)
+
+    async def one(i: int, sub: str) -> Dict[str, Any]:
+        async with sem:
+            return await client.call_agent_b(
+                urls[i % len(urls)], sub, task_id=task_id)
+
+    workers = await asyncio.gather(*[one(i, s) for i, s in enumerate(subtasks)])
+    results_text = []
+    for i, (sub, out) in enumerate(zip(subtasks, workers)):
+        body = out.get("result") or out.get("error") or ""
+        results_text.append(f"### Worker {i + 1} ({sub[:80]})\n{body}")
+        steps.append({"type": "agent_b", "index": i,
+                      "worker_url": out.get("worker_url"),
+                      "error": out.get("error")})
+
+    synth = await client.call_llm(
+        prompts.PARALLEL_SYNTHESIS_PROMPT.format(
+            task=task, results="\n\n".join(results_text)[-8000:]),
+        task_id=task_id, max_tokens=max_tokens)
+    steps.append({"type": "llm_synthesis", "request_id": synth.request_id,
+                  "error": synth.error})
+    return synth.output, {
+        "scenario": "agentic_parallel",
+        "num_workers": n,
+        "subtasks": subtasks,
+        "steps": steps,
+        "prompt_tokens": plan.prompt_tokens + synth.prompt_tokens,
+        "completion_tokens": plan.completion_tokens + synth.completion_tokens,
+    }

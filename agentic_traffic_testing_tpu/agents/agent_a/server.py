@@ -1,0 +1,225 @@
+"""Agent A orchestrator HTTP service.
+
+Endpoint parity with the reference (reference: agents/agent_a/server.py:207-925):
+
+    POST /task            {"task": str, "scenario"?: "agentic_simple" |
+                           "agentic_multi_hop" | "agentic_parallel",
+                           "agent_count"?, "max_tokens"?}
+    POST /agentverse      {"task": str, "stream"?: bool, ...overrides} —
+                          SSE stream of workflow events when stream is true
+                          (or Accept: text/event-stream), else one JSON body
+    GET  /agentverse/{id} persisted run (logs/agentverse/<task_id>.json)
+    GET  /health
+
+Task aggregates in every /task response include llm call counts, token sums,
+latency and `cost_estimate_usd` (reference: server.py:853-907). AgentVerse
+runs persist to `logs/agentverse/<task_id>.json` (reference: server.py:171-205).
+SSE events come from the orchestrator thread-safely through an asyncio queue.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from aiohttp import web
+
+from agentic_traffic_testing_tpu.agents.agent_a import scenarios
+from agentic_traffic_testing_tpu.agents.agent_a.orchestrator import (
+    AgentVerseOrchestrator,
+)
+from agentic_traffic_testing_tpu.agents.common.llm_client import (
+    AgentHTTPClient,
+    cost_estimate_usd,
+)
+from agentic_traffic_testing_tpu.agents.common.telemetry import TelemetryLogger
+from agentic_traffic_testing_tpu.utils.tracing import (
+    extract_context,
+    get_tracer,
+    init_tracer,
+    span_metadata,
+)
+
+SCENARIOS = ("agentic_simple", "agentic_multi_hop", "agentic_parallel")
+
+
+class AgentAServer:
+    def __init__(self, agent_id: str = "agent_a") -> None:
+        self.agent_id = agent_id
+        self.telemetry = TelemetryLogger(agent_id)
+        self.client = AgentHTTPClient(agent_id)
+        self.default_max_tokens = int(os.environ.get("AGENT_A_MAX_TOKENS", "512"))
+        self.runs_dir = os.path.join(
+            os.environ.get("TELEMETRY_LOG_DIR", "logs"), "agentverse")
+
+    # ------------------------------------------------------------ /task
+    async def handle_task(self, request: web.Request) -> web.Response:
+        try:
+            body: Dict[str, Any] = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        task = body.get("task") or body.get("prompt") or ""
+        if not task:
+            return web.json_response({"error": "missing 'task'"}, status=400)
+        scenario = body.get("scenario", "agentic_simple")
+        if scenario not in SCENARIOS:
+            return web.json_response(
+                {"error": f"unknown scenario {scenario!r}",
+                 "scenarios": list(SCENARIOS)}, status=400)
+        task_id = (request.headers.get("X-Task-ID") or body.get("task_id")
+                   or uuid.uuid4().hex[:12])
+        max_tokens = int(body.get("max_tokens") or self.default_max_tokens)
+
+        ctx = extract_context(request.headers)
+        tracer = get_tracer(self.agent_id)
+        t0 = time.monotonic()
+        self.telemetry.log("task_received", task_id=task_id, scenario=scenario)
+        with tracer.start_as_current_span("agent_a.handle_task",
+                                          context=ctx) as span:
+            if scenario == "agentic_simple":
+                result, detail = await scenarios.run_simple(
+                    self.client, task, task_id, max_tokens)
+            elif scenario == "agentic_multi_hop":
+                result, detail = await scenarios.run_multi_hop(
+                    self.client, task, task_id, max_tokens)
+            else:
+                result, detail = await scenarios.run_parallel(
+                    self.client, task, task_id, max_tokens,
+                    agent_count=body.get("agent_count"))
+            wall_ms = (time.monotonic() - t0) * 1000.0
+            pt = detail.get("prompt_tokens", 0)
+            ct = detail.get("completion_tokens", 0)
+            payload = {
+                "task_id": task_id,
+                "scenario": scenario,
+                "result": result,
+                "detail": detail,
+                "aggregates": {
+                    "latency_ms": round(wall_ms, 2),
+                    "prompt_tokens": pt,
+                    "completion_tokens": ct,
+                    "total_tokens": pt + ct,
+                    "cost_estimate_usd": round(cost_estimate_usd(pt, ct), 6),
+                },
+                "otel": span_metadata(span),
+            }
+        self.telemetry.log("task_completed", task_id=task_id, scenario=scenario,
+                           latency_ms=round(wall_ms, 2))
+        return web.json_response(payload)
+
+    # ------------------------------------------------------ /agentverse
+    def _persist_run(self, task_id: str, response: Dict[str, Any]) -> None:
+        try:
+            os.makedirs(self.runs_dir, exist_ok=True)
+            with open(os.path.join(self.runs_dir, f"{task_id}.json"), "w",
+                      encoding="utf-8") as f:
+                json.dump(response, f, ensure_ascii=False, indent=2, default=str)
+        except OSError:
+            pass  # persistence is best-effort; the HTTP response is canonical
+
+    def _make_orchestrator(self, body: Dict[str, Any]) -> AgentVerseOrchestrator:
+        def opt_int(key: str) -> Optional[int]:
+            v = body.get(key)
+            return int(v) if v is not None else None
+
+        threshold = body.get("success_threshold")
+        return AgentVerseOrchestrator(
+            self.client, self.telemetry,
+            max_iterations=opt_int("max_iterations"),
+            success_threshold=float(threshold) if threshold is not None else None,
+            structure=body.get("structure"),
+            num_experts=opt_int("num_experts"),
+        )
+
+    async def handle_agentverse(self, request: web.Request) -> web.StreamResponse:
+        try:
+            body: Dict[str, Any] = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        task = body.get("task") or ""
+        if not task:
+            return web.json_response({"error": "missing 'task'"}, status=400)
+        task_id = body.get("task_id") or uuid.uuid4().hex[:12]
+        stream = bool(body.get("stream")) or (
+            "text/event-stream" in request.headers.get("Accept", ""))
+        orch = self._make_orchestrator(body)
+
+        if not stream:
+            state = await orch.run_workflow(task, task_id)
+            response = state.to_response()
+            self._persist_run(task_id, response)
+            return web.json_response(response,
+                                     status=200 if not state.error else 500)
+
+        # SSE: orchestrator callbacks may fire from any task; marshal through
+        # a queue owned by this handler's event loop (the reference guards
+        # interleaved writes with a threading.Lock — server.py:256-272).
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "Connection": "keep-alive",
+        })
+        await resp.prepare(request)
+        queue: asyncio.Queue = asyncio.Queue()
+        loop = asyncio.get_running_loop()
+
+        def cb(event: str, payload: Dict[str, Any]) -> None:
+            loop.call_soon_threadsafe(queue.put_nowait, (event, payload))
+
+        async def pump() -> None:
+            while True:
+                event, payload = await queue.get()
+                data = json.dumps({"event": event, **payload}, default=str)
+                await resp.write(f"event: {event}\ndata: {data}\n\n".encode())
+                if event in ("complete", "error", "workflow_error"):
+                    return
+
+        pump_task = asyncio.create_task(pump())
+        state = await orch.run_workflow(task, task_id, progress_callback=cb)
+        response = state.to_response()
+        self._persist_run(task_id, response)
+        try:
+            await asyncio.wait_for(pump_task, timeout=5.0)
+        except asyncio.TimeoutError:
+            pump_task.cancel()
+        final = json.dumps({"event": "result", **response}, default=str)
+        await resp.write(f"event: result\ndata: {final}\n\n".encode())
+        await resp.write_eof()
+        return resp
+
+    async def handle_get_run(self, request: web.Request) -> web.Response:
+        task_id = request.match_info["task_id"]
+        path = os.path.join(self.runs_dir, f"{task_id}.json")
+        if not os.path.isfile(path):
+            return web.json_response({"error": "not found",
+                                      "task_id": task_id}, status=404)
+        with open(path, encoding="utf-8") as f:
+            return web.json_response(json.load(f))
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "agent_id": self.agent_id,
+                                  "scenarios": list(SCENARIOS)})
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/task", self.handle_task)
+        app.router.add_post("/agentverse", self.handle_agentverse)
+        app.router.add_get("/agentverse/{task_id}", self.handle_get_run)
+        app.router.add_get("/health", self.handle_health)
+        app.on_cleanup.append(lambda _app: self.client.close())
+        return app
+
+
+def main() -> None:
+    init_tracer(os.environ.get("OTEL_SERVICE_NAME", "agent-a"))
+    server = AgentAServer()
+    port = int(os.environ.get("AGENT_PORT", "8101"))
+    web.run_app(server.build_app(), port=port, print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Agent B: worker replica service (reference: agents/agent_b/ — SURVEY.md §2.5)."""

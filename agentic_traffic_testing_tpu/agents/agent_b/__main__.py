@@ -1,0 +1,3 @@
+from agentic_traffic_testing_tpu.agents.agent_b.server import main
+
+main()

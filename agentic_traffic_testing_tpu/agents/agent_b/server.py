@@ -1,0 +1,120 @@
+"""Agent B worker: wraps a subtask in a role prompt and asks the LLM.
+
+HTTP surface parity with the reference worker (reference:
+agents/agent_b/server.py:62-215):
+
+    POST /subtask  {"subtask": str, "role"?: str, ...}
+    POST /discuss  same body; used by the AgentVerse horizontal stage
+    GET  /health
+
+Response carries the full round trip for upstream bookkeeping:
+    {"result": str, "agent_id": ..., "llm_prompt": ..., "llm_response": ...,
+     "llm_meta": {...}, "otel": {...}}
+
+Task/request identity arrives via `X-Task-ID` / `X-Request-ID` headers and is
+reused on the LLM hop so the whole call tree correlates in logs and traces.
+Implementation is aiohttp (the reference used ThreadingHTTPServer + sync
+httpx; the traffic shape — one LLM call per subtask — is identical).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict
+
+from aiohttp import web
+
+from agentic_traffic_testing_tpu.agents.common.llm_client import AgentHTTPClient
+from agentic_traffic_testing_tpu.agents.common.telemetry import TelemetryLogger
+from agentic_traffic_testing_tpu.utils.tracing import (
+    extract_context,
+    get_tracer,
+    init_tracer,
+    span_metadata,
+)
+
+DEFAULT_ROLE = "a capable specialist who completes the assigned subtask precisely"
+
+
+def build_worker_prompt(subtask: str, role: str) -> str:
+    return (
+        f"You are Agent B, {role}.\n"
+        "Complete the following subtask. Reply with the result only — no "
+        "preamble, no restating the task.\n\n"
+        f"Subtask: {subtask}"
+    )
+
+
+class AgentBServer:
+    def __init__(self, agent_id: str | None = None) -> None:
+        self.agent_id = agent_id or os.environ.get("AGENT_ID", "agent_b")
+        self.telemetry = TelemetryLogger(self.agent_id)
+        self.client = AgentHTTPClient(self.agent_id)
+        self.max_tokens = int(os.environ.get("AGENT_B_MAX_TOKENS", "512"))
+
+    async def handle_subtask(self, request: web.Request) -> web.Response:
+        return await self._handle(request, kind="subtask")
+
+    async def handle_discuss(self, request: web.Request) -> web.Response:
+        return await self._handle(request, kind="discuss")
+
+    async def _handle(self, request: web.Request, kind: str) -> web.Response:
+        try:
+            body: Dict[str, Any] = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        subtask = body.get("subtask") or body.get("message") or ""
+        if not subtask:
+            return web.json_response({"error": "missing 'subtask'"}, status=400)
+        role = body.get("role") or DEFAULT_ROLE
+        task_id = request.headers.get("X-Task-ID") or body.get("task_id")
+        request_id = request.headers.get("X-Request-ID")
+
+        ctx = extract_context(request.headers)
+        tracer = get_tracer(self.agent_id)
+        self.telemetry.log(f"{kind}_received", task_id=task_id,
+                           subtask_chars=len(subtask))
+        with tracer.start_as_current_span(
+            f"agent_b.handle_{kind}", context=ctx
+        ) as span:
+            prompt = build_worker_prompt(subtask, role)
+            res = await self.client.call_llm(
+                prompt, task_id=task_id, max_tokens=self.max_tokens,
+                call_type="sub_call", request_id=request_id,
+            )
+            self.telemetry.log(f"{kind}_completed", task_id=task_id,
+                               ok=res.ok, latency_ms=res.latency_ms)
+            payload = {
+                "result": res.output,
+                "agent_id": self.agent_id,
+                "llm_prompt": prompt,
+                "llm_response": res.output,
+                "llm_meta": res.meta,
+                "otel": span_metadata(span),
+            }
+            if not res.ok:
+                payload["error"] = res.error
+                return web.json_response(payload, status=502)
+            return web.json_response(payload)
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "agent_id": self.agent_id})
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/subtask", self.handle_subtask)
+        app.router.add_post("/discuss", self.handle_discuss)
+        app.router.add_get("/health", self.handle_health)
+        app.on_cleanup.append(lambda _app: self.client.close())
+        return app
+
+
+def main() -> None:
+    init_tracer(os.environ.get("OTEL_SERVICE_NAME", "agent-b"))
+    server = AgentBServer()
+    port = int(os.environ.get("AGENT_PORT", "8201"))
+    web.run_app(server.build_app(), port=port, print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,1 @@
+"""Shared agent plumbing: telemetry JSONL, per-LLM-call logging, HTTP clients."""

@@ -1,0 +1,186 @@
+"""Async HTTP clients for the LLM backend and Agent-B workers.
+
+The analog of the reference's `call_llm` / `call_agent_b` helpers
+(reference: agents/agent_a/main.py:17-50, agents/agent_b/main.py) as one
+shared aiohttp client: W3C trace context injected on every hop, request/task
+ids propagated via `X-Request-ID` / `X-Task-ID`, per-call rows written to
+`logs/llm_calls.jsonl`, and a cost estimate derived from token usage.
+
+Env surface (same names as the reference compose files):
+    LLM_SERVER_URL       default http://localhost:8000/chat
+    AGENT_B_URLS         comma-separated worker base URLs
+    LLM_COST_PER_1K_PROMPT_TOKENS / LLM_COST_PER_1K_COMPLETION_TOKENS
+    LLM_REQUEST_TIMEOUT_S
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+from agentic_traffic_testing_tpu.agents.common.metrics_logger import MetricsLogger
+from agentic_traffic_testing_tpu.utils.tracing import get_tracer, inject_context
+
+DEFAULT_LLM_URL = "http://localhost:8000/chat"
+
+
+def agent_b_urls() -> List[str]:
+    """Parse AGENT_B_URLS (comma separated); default one local worker."""
+    raw = os.environ.get("AGENT_B_URLS", "http://localhost:8201")
+    return [u.strip().rstrip("/") for u in raw.split(",") if u.strip()]
+
+
+def cost_estimate_usd(prompt_tokens: int, completion_tokens: int) -> float:
+    cp = float(os.environ.get("LLM_COST_PER_1K_PROMPT_TOKENS", "0.0005"))
+    cc = float(os.environ.get("LLM_COST_PER_1K_COMPLETION_TOKENS", "0.0015"))
+    return prompt_tokens / 1000.0 * cp + completion_tokens / 1000.0 * cc
+
+
+@dataclass
+class LLMResult:
+    """One LLM round trip, with everything upstream bookkeeping needs."""
+
+    output: str
+    meta: Dict[str, Any] = field(default_factory=dict)
+    request_id: str = ""
+    latency_ms: float = 0.0
+    prompt_tokens: int = 0
+    completion_tokens: int = 0
+    status: int = 0
+    error: Optional[str] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.error is None
+
+
+class AgentHTTPClient:
+    """One shared session per service process (connection reuse matters:
+    TCP handshakes are part of what the testbed measures)."""
+
+    def __init__(self, agent_id: str, llm_url: Optional[str] = None,
+                 metrics: Optional[MetricsLogger] = None) -> None:
+        self.agent_id = agent_id
+        self.llm_url = (llm_url or os.environ.get("LLM_SERVER_URL", DEFAULT_LLM_URL))
+        self.metrics = metrics or MetricsLogger(agent_id)
+        self.timeout_s = float(os.environ.get("LLM_REQUEST_TIMEOUT_S", "300"))
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    async def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=self.timeout_s)
+            )
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    # ---------------------------------------------------------------- LLM
+    async def call_llm(
+        self,
+        prompt: str,
+        *,
+        task_id: Optional[str] = None,
+        max_tokens: Optional[int] = None,
+        system_prompt: Optional[str] = None,
+        call_type: str = "root",
+        parent_call_id: Optional[str] = None,
+        request_id: Optional[str] = None,
+    ) -> LLMResult:
+        """POST /chat on the LLM backend (contract: SURVEY.md §2.1)."""
+        request_id = request_id or uuid.uuid4().hex[:16]
+        headers = {"X-Request-ID": request_id}
+        if task_id:
+            headers["X-Task-ID"] = task_id
+        inject_context(headers)
+        body: Dict[str, Any] = {"prompt": prompt, "request_id": request_id}
+        if max_tokens is not None:
+            body["max_tokens"] = max_tokens
+        if system_prompt is not None:
+            body["system_prompt"] = system_prompt
+
+        tracer = get_tracer(self.agent_id)
+        t0 = time.monotonic()
+        started_ms = int(time.time() * 1000)
+        sess = await self.session()
+        try:
+            with tracer.start_as_current_span(f"{self.agent_id}.call_llm"):
+                async with sess.post(self.llm_url, json=body, headers=headers) as resp:
+                    status = resp.status
+                    data = await resp.json(content_type=None)
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+            latency = (time.monotonic() - t0) * 1000.0
+            self.metrics.log_call(task_id=task_id, call_type=call_type,
+                                  parent_call_id=parent_call_id, call_id=request_id,
+                                  latency_ms=latency, started_at_ms=started_ms,
+                                  error=f"{type(e).__name__}: {e}")
+            return LLMResult(output="", request_id=request_id, latency_ms=latency,
+                             error=f"{type(e).__name__}: {e}")
+
+        latency = (time.monotonic() - t0) * 1000.0
+        meta = data.get("meta", {}) if isinstance(data, dict) else {}
+        out = data.get("output", "") if isinstance(data, dict) else ""
+        err = None if status == 200 else f"http {status}: {str(data)[:200]}"
+        pt = int(meta.get("prompt_tokens") or 0)
+        ct = int(meta.get("completion_tokens") or 0)
+        self.metrics.log_call(
+            task_id=task_id, call_type=call_type, parent_call_id=parent_call_id,
+            call_id=request_id, model_name=meta.get("model"),
+            prompt_tokens=pt, completion_tokens=ct, total_tokens=pt + ct,
+            latency_ms=latency, started_at_ms=started_ms,
+            finished_at_ms=int(time.time() * 1000), http_status=status, error=err,
+        )
+        return LLMResult(output=out, meta=meta, request_id=request_id,
+                         latency_ms=latency, prompt_tokens=pt,
+                         completion_tokens=ct, status=status, error=err)
+
+    # ------------------------------------------------------------ Agent B
+    async def call_agent_b(
+        self,
+        url: str,
+        subtask: str,
+        *,
+        role: Optional[str] = None,
+        task_id: Optional[str] = None,
+        endpoint: str = "subtask",
+        extra: Optional[Dict[str, Any]] = None,
+        request_id: Optional[str] = None,
+    ) -> Dict[str, Any]:
+        """POST /subtask (or /discuss) on one worker; returns its JSON body.
+
+        On transport error returns {"error": ...} so fan-outs stay alive
+        per-worker (reference behavior: agent_a/server.py:600-623).
+        """
+        request_id = request_id or uuid.uuid4().hex[:16]
+        headers = {"X-Request-ID": request_id}
+        if task_id:
+            headers["X-Task-ID"] = task_id
+        inject_context(headers)
+        body: Dict[str, Any] = {"subtask": subtask}
+        if role:
+            body["role"] = role
+        if extra:
+            body.update(extra)
+        sess = await self.session()
+        tracer = get_tracer(self.agent_id)
+        try:
+            with tracer.start_as_current_span(f"{self.agent_id}.call_agent_b"):
+                async with sess.post(f"{url}/{endpoint}", json=body,
+                                     headers=headers) as resp:
+                    data = await resp.json(content_type=None)
+                    if resp.status != 200:
+                        return {"error": f"http {resp.status}",
+                                "detail": data, "worker_url": url}
+                    if isinstance(data, dict):
+                        data.setdefault("worker_url", url)
+                    return data
+        except (aiohttp.ClientError, asyncio.TimeoutError, ValueError) as e:
+            return {"error": f"{type(e).__name__}: {e}", "worker_url": url}

@@ -1,0 +1,130 @@
+"""MCP client manager: stdio subprocess transport, multi-server.
+
+The analog of the reference's `MCPClientManager` over the official SDK
+(reference: agents/common/mcp_client.py:1-138). Speaks the same
+newline-delimited JSON-RPC framing as tools/mcp_rpc.py, so agent↔tool calls
+cross a real process/pipe boundary exactly like the reference's stdio MCP
+sessions. Async core + `run_sync` convenience, same as the reference.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sys
+from typing import Any, Dict, List, Optional
+
+from agentic_traffic_testing_tpu.tools.mcp_rpc import PROTOCOL_VERSION
+
+
+class MCPServerProcess:
+    """One stdio MCP server subprocess + JSON-RPC session."""
+
+    def __init__(self, name: str, command: List[str]) -> None:
+        self.name = name
+        self.command = command
+        self.proc: Optional[asyncio.subprocess.Process] = None
+        self._msg_id = 0
+        self._lock = asyncio.Lock()
+
+    async def start(self) -> None:
+        self.proc = await asyncio.create_subprocess_exec(
+            *self.command,
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL,
+        )
+        init = await self.request("initialize", {
+            "protocolVersion": PROTOCOL_VERSION,
+            "clientInfo": {"name": "att-tpu-agent", "version": "0.1"},
+            "capabilities": {},
+        })
+        await self.notify("notifications/initialized", {})
+        self.server_info = init.get("serverInfo", {})
+
+    async def request(self, method: str, params: Dict[str, Any],
+                      timeout: float = 30.0) -> Dict[str, Any]:
+        assert self.proc is not None and self.proc.stdin and self.proc.stdout
+        async with self._lock:  # one in-flight request per server
+            self._msg_id += 1
+            msg = {"jsonrpc": "2.0", "id": self._msg_id,
+                   "method": method, "params": params}
+            self.proc.stdin.write((json.dumps(msg) + "\n").encode())
+            await self.proc.stdin.drain()
+            line = await asyncio.wait_for(self.proc.stdout.readline(), timeout)
+        if not line:
+            raise RuntimeError(f"mcp server {self.name} closed its pipe")
+        reply = json.loads(line)
+        if "error" in reply:
+            raise RuntimeError(f"mcp {self.name} {method}: {reply['error']}")
+        return reply.get("result", {})
+
+    async def notify(self, method: str, params: Dict[str, Any]) -> None:
+        assert self.proc is not None and self.proc.stdin
+        msg = {"jsonrpc": "2.0", "method": method, "params": params}
+        self.proc.stdin.write((json.dumps(msg) + "\n").encode())
+        await self.proc.stdin.drain()
+
+    async def stop(self) -> None:
+        if self.proc is not None and self.proc.returncode is None:
+            self.proc.terminate()
+            try:
+                await asyncio.wait_for(self.proc.wait(), 5.0)
+            except asyncio.TimeoutError:
+                self.proc.kill()
+
+
+DEFAULT_SERVERS = {
+    "coding": [sys.executable, "-m",
+               "agentic_traffic_testing_tpu.tools.mcp_servers.coding_server"],
+    "finance": [sys.executable, "-m",
+                "agentic_traffic_testing_tpu.tools.mcp_servers.finance_server"],
+    "maps": [sys.executable, "-m",
+             "agentic_traffic_testing_tpu.tools.mcp_servers.maps_server"],
+}
+
+
+class MCPClientManager:
+    """Connect to several stdio MCP servers; route tool calls by server name."""
+
+    def __init__(self, servers: Optional[Dict[str, List[str]]] = None) -> None:
+        self.configs = servers or DEFAULT_SERVERS
+        self.servers: Dict[str, MCPServerProcess] = {}
+
+    async def connect_all(self) -> None:
+        for name, cmd in self.configs.items():
+            srv = MCPServerProcess(name, cmd)
+            await srv.start()
+            self.servers[name] = srv
+
+    async def list_tools(self, server: Optional[str] = None) -> Dict[str, List[dict]]:
+        names = [server] if server else list(self.servers)
+        out = {}
+        for n in names:
+            res = await self.servers[n].request("tools/list", {})
+            out[n] = res.get("tools", [])
+        return out
+
+    async def call_tool(self, server: str, tool: str,
+                        arguments: Dict[str, Any]) -> str:
+        res = await self.servers[server].request(
+            "tools/call", {"name": tool, "arguments": arguments})
+        parts = [c.get("text", "") for c in res.get("content", [])
+                 if c.get("type") == "text"]
+        text = "\n".join(parts)
+        if res.get("isError"):
+            raise RuntimeError(f"tool {server}.{tool} failed: {text}")
+        return text
+
+    async def read_resource(self, server: str, uri: str) -> str:
+        res = await self.servers[server].request("resources/read", {"uri": uri})
+        return "\n".join(c.get("text", "") for c in res.get("contents", []))
+
+    async def close_all(self) -> None:
+        for srv in self.servers.values():
+            await srv.stop()
+        self.servers.clear()
+
+    def run_sync(self, coro):
+        """Convenience for sync callers (reference keeps the same helper)."""
+        return asyncio.run(coro)

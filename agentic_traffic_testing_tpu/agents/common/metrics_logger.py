@@ -1,0 +1,74 @@
+"""Per-LLM-call JSONL log (`logs/llm_calls.jsonl`).
+
+Schema parity with the reference's "Phase 0.1" MetricsLogger
+(reference: agents/common/metrics_logger.py:16-80): one JSON line per LLM
+call with call/task/agent identity, the call-tree edge (parent_call_id,
+call_type), token counts, latency, model name, wall-clock bounds, HTTP
+status, and error. `scripts/experiment/correlate_metrics.py` joins these
+windows against Prometheus TCP metrics — both testbeds' files are
+interchangeable inputs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+CALL_TYPES = ("root", "sub_call", "tool_call", "verification")
+
+
+class MetricsLogger:
+    """Append-only writer for the per-call schema; thread/async safe."""
+
+    def __init__(self, agent_id: str, log_dir: Optional[str] = None) -> None:
+        self.agent_id = agent_id
+        self.log_dir = log_dir or os.environ.get("TELEMETRY_LOG_DIR", "logs")
+        self._lock = threading.Lock()
+        self._path = os.path.join(self.log_dir, "llm_calls.jsonl")
+
+    def log_call(
+        self,
+        *,
+        task_id: Optional[str],
+        call_type: str = "root",
+        parent_call_id: Optional[str] = None,
+        call_id: Optional[str] = None,
+        model_name: Optional[str] = None,
+        prompt_tokens: Optional[int] = None,
+        completion_tokens: Optional[int] = None,
+        total_tokens: Optional[int] = None,
+        latency_ms: Optional[float] = None,
+        started_at_ms: Optional[int] = None,
+        finished_at_ms: Optional[int] = None,
+        http_status: Optional[int] = None,
+        error: Optional[str] = None,
+        **extra: Any,
+    ) -> str:
+        call_id = call_id or uuid.uuid4().hex[:16]
+        now_ms = int(time.time() * 1000)
+        row = {
+            "call_id": call_id,
+            "task_id": task_id,
+            "agent_id": self.agent_id,
+            "parent_call_id": parent_call_id,
+            "call_type": call_type if call_type in CALL_TYPES else "sub_call",
+            "model_name": model_name,
+            "prompt_tokens": prompt_tokens,
+            "completion_tokens": completion_tokens,
+            "total_tokens": total_tokens,
+            "latency_ms": latency_ms,
+            "started_at_ms": started_at_ms or now_ms,
+            "finished_at_ms": finished_at_ms or now_ms,
+            "http_status": http_status,
+            "error": error,
+        }
+        row.update(extra)
+        with self._lock:
+            os.makedirs(self.log_dir, exist_ok=True)
+            with open(self._path, "a", encoding="utf-8") as f:
+                f.write(json.dumps(row, ensure_ascii=False, default=str) + "\n")
+        return call_id

@@ -1,0 +1,58 @@
+"""App-event JSONL telemetry: one line per lifecycle event.
+
+File format parity with the reference's `TelemetryLogger`
+(reference: agents/common/telemetry.py:31-70): events land in
+`logs/<node>_<agent>.log` as JSON objects carrying
+task_id/agent_id/tool_call_id/event_type/timestamp_ms/scenario plus free-form
+extras, so the traffic-analysis join tooling (scripts/traffic/analyze_traffic)
+reads either testbed's logs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, Optional
+
+
+@dataclass
+class TelemetryEvent:
+    event_type: str
+    task_id: Optional[str] = None
+    agent_id: Optional[str] = None
+    tool_call_id: Optional[str] = None
+    scenario: Optional[str] = None
+    timestamp_ms: int = field(default_factory=lambda: int(time.time() * 1000))
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def to_json(self) -> str:
+        d = asdict(self)
+        d.update(d.pop("extra"))
+        return json.dumps(d, ensure_ascii=False, default=str)
+
+
+class TelemetryLogger:
+    """Append-only JSONL writer, safe across threads and asyncio tasks."""
+
+    def __init__(self, agent_id: str, node: Optional[str] = None,
+                 log_dir: Optional[str] = None) -> None:
+        self.agent_id = agent_id
+        self.node = node or os.environ.get("NODE_NAME", "local")
+        self.log_dir = log_dir or os.environ.get("TELEMETRY_LOG_DIR", "logs")
+        self._lock = threading.Lock()
+        self._path = os.path.join(self.log_dir, f"{self.node}_{self.agent_id}.log")
+
+    def log(self, event_type: str, **kwargs: Any) -> TelemetryEvent:
+        known = {k: kwargs.pop(k, None)
+                 for k in ("task_id", "tool_call_id", "scenario")}
+        ev = TelemetryEvent(event_type=event_type, agent_id=self.agent_id,
+                            extra=kwargs, **known)
+        line = ev.to_json()
+        with self._lock:
+            os.makedirs(self.log_dir, exist_ok=True)
+            with open(self._path, "a", encoding="utf-8") as f:
+                f.write(line + "\n")
+        return ev

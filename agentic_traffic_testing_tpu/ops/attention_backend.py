@@ -24,8 +24,14 @@ from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
 from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
 
 
+VALID_MODES = ("auto", "pallas", "interpret", "gather")
+
+
 def backend_choice() -> str:
     mode = os.environ.get("ATT_TPU_ATTENTION", "auto")
+    if mode not in VALID_MODES:
+        raise ValueError(
+            f"ATT_TPU_ATTENTION={mode!r} invalid; choose one of {VALID_MODES}")
     if mode == "auto":
         return "pallas" if jax.default_backend() == "tpu" else "gather"
     return mode

@@ -1,0 +1,2 @@
+"""Tools layer: MCP servers, HTTP tool DB, OpenAI-compatible proxy
+(reference: tools/ — SURVEY.md §2.6)."""

@@ -1,0 +1,153 @@
+"""Minimal MCP (Model Context Protocol) over stdio: server base + framing.
+
+The reference's tool servers use the official `mcp` FastMCP SDK (reference:
+tools/mcp_servers/*.py); that SDK is not available in this environment, so
+the wire protocol is implemented first-party: newline-delimited JSON-RPC 2.0
+on stdin/stdout with the MCP methods the testbed exercises —
+
+    initialize, notifications/initialized, tools/list, tools/call,
+    resources/list, resources/read
+
+`MCPToolServer` is the FastMCP-shaped base: register tools with
+`@server.tool()` and resources with `@server.resource(uri)`, then
+`server.run()` blocks on stdio. The in-repo client
+(agents/common/mcp_client.py) speaks the same framing over a subprocess, so
+agent↔tool traffic has the same process/pipe boundaries as the reference.
+"""
+
+from __future__ import annotations
+
+import inspect
+import json
+import sys
+import traceback
+from typing import Any, Callable, Dict, List, Optional
+
+PROTOCOL_VERSION = "2024-11-05"
+
+
+def _py_type_to_schema(annotation: Any) -> Dict[str, Any]:
+    mapping = {int: "integer", float: "number", str: "string", bool: "boolean",
+               list: "array", dict: "object"}
+    return {"type": mapping.get(annotation, "string")}
+
+
+class MCPToolServer:
+    """Register tools/resources, serve JSON-RPC over stdio."""
+
+    def __init__(self, name: str, version: str = "0.1.0") -> None:
+        self.name = name
+        self.version = version
+        self._tools: Dict[str, Dict[str, Any]] = {}
+        self._resources: Dict[str, Dict[str, Any]] = {}
+
+    # ----------------------------------------------------------- registry
+    def tool(self, description: Optional[str] = None) -> Callable:
+        def deco(fn: Callable) -> Callable:
+            sig = inspect.signature(fn)
+            props = {}
+            required = []
+            for pname, param in sig.parameters.items():
+                props[pname] = _py_type_to_schema(param.annotation)
+                if param.default is inspect.Parameter.empty:
+                    required.append(pname)
+            self._tools[fn.__name__] = {
+                "fn": fn,
+                "spec": {
+                    "name": fn.__name__,
+                    "description": description or (fn.__doc__ or "").strip(),
+                    "inputSchema": {"type": "object", "properties": props,
+                                    "required": required},
+                },
+            }
+            return fn
+        return deco
+
+    def resource(self, uri: str, description: str = "") -> Callable:
+        def deco(fn: Callable) -> Callable:
+            self._resources[uri] = {
+                "fn": fn,
+                "spec": {"uri": uri, "name": fn.__name__,
+                         "description": description or (fn.__doc__ or "").strip(),
+                         "mimeType": "text/plain"},
+            }
+            return fn
+        return deco
+
+    # ----------------------------------------------------------- dispatch
+    def handle(self, msg: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        method = msg.get("method", "")
+        msg_id = msg.get("id")
+        params = msg.get("params") or {}
+
+        def ok(result: Any) -> Dict[str, Any]:
+            return {"jsonrpc": "2.0", "id": msg_id, "result": result}
+
+        def err(code: int, message: str) -> Dict[str, Any]:
+            return {"jsonrpc": "2.0", "id": msg_id,
+                    "error": {"code": code, "message": message}}
+
+        if method == "initialize":
+            return ok({
+                "protocolVersion": PROTOCOL_VERSION,
+                "capabilities": {"tools": {}, "resources": {}},
+                "serverInfo": {"name": self.name, "version": self.version},
+            })
+        if method.startswith("notifications/"):
+            return None  # notifications carry no response
+        if method == "tools/list":
+            return ok({"tools": [t["spec"] for t in self._tools.values()]})
+        if method == "tools/call":
+            name = params.get("name")
+            tool = self._tools.get(name)
+            if tool is None:
+                return err(-32602, f"unknown tool {name!r}")
+            try:
+                result = tool["fn"](**(params.get("arguments") or {}))
+                text = result if isinstance(result, str) else json.dumps(
+                    result, ensure_ascii=False, default=str)
+                return ok({"content": [{"type": "text", "text": text}],
+                           "isError": False})
+            except Exception as e:
+                return ok({"content": [{"type": "text",
+                                        "text": f"{type(e).__name__}: {e}"}],
+                           "isError": True})
+        if method == "resources/list":
+            return ok({"resources": [r["spec"] for r in self._resources.values()]})
+        if method == "resources/read":
+            uri = params.get("uri")
+            res = self._resources.get(uri)
+            if res is None:
+                return err(-32602, f"unknown resource {uri!r}")
+            try:
+                text = res["fn"]()
+                if not isinstance(text, str):
+                    text = json.dumps(text, ensure_ascii=False, default=str)
+                return ok({"contents": [{"uri": uri, "mimeType": "text/plain",
+                                         "text": text}]})
+            except Exception as e:
+                return err(-32603, f"{type(e).__name__}: {e}")
+        if msg_id is None:
+            return None
+        return err(-32601, f"method not found: {method}")
+
+    # ----------------------------------------------------------- stdio loop
+    def run(self, stdin=None, stdout=None) -> None:
+        stdin = stdin or sys.stdin
+        stdout = stdout or sys.stdout
+        for line in stdin:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                msg = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            try:
+                reply = self.handle(msg)
+            except Exception:
+                traceback.print_exc(file=sys.stderr)
+                continue
+            if reply is not None:
+                stdout.write(json.dumps(reply, ensure_ascii=False) + "\n")
+                stdout.flush()

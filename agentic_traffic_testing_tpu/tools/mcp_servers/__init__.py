@@ -1,0 +1,1 @@
+"""Stdio MCP tool servers: coding, finance, maps (reference: tools/mcp_servers/)."""

@@ -1,0 +1,61 @@
+"""Coding MCP server: sandboxed python execution + complexity analysis.
+
+Tool parity with the reference coding server (reference:
+tools/mcp_servers/coding_server.py:22-58): `execute_python_code` runs a
+snippet in a subprocess with a 10 s timeout; `analyze_code_complexity`
+returns crude line/branch counts; one snippet resource.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+from agentic_traffic_testing_tpu.tools.mcp_rpc import MCPToolServer
+
+server = MCPToolServer("coding")
+
+EXEC_TIMEOUT_S = 10
+
+
+@server.tool("Execute a Python code snippet in an isolated subprocess "
+             f"({EXEC_TIMEOUT_S}s timeout); returns stdout/stderr/returncode.")
+def execute_python_code(code: str) -> dict:
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-I", "-c", code],
+            capture_output=True, text=True, timeout=EXEC_TIMEOUT_S,
+        )
+        return {"stdout": proc.stdout[-4000:], "stderr": proc.stderr[-4000:],
+                "returncode": proc.returncode}
+    except subprocess.TimeoutExpired:
+        return {"stdout": "", "stderr": f"timeout after {EXEC_TIMEOUT_S}s",
+                "returncode": -1}
+
+
+@server.tool("Rough complexity metrics for a Python snippet: lines, "
+             "branches, defs, max nesting depth.")
+def analyze_code_complexity(code: str) -> dict:
+    lines = [l for l in code.splitlines() if l.strip() and not l.strip().startswith("#")]
+    branches = sum(l.strip().startswith(("if ", "elif ", "for ", "while ",
+                                         "except", "case "))
+                   for l in lines)
+    defs = sum(l.strip().startswith(("def ", "class ", "async def "))
+               for l in lines)
+    depth = max((len(l) - len(l.lstrip())) // 4 for l in lines) if lines else 0
+    return {"loc": len(lines), "branches": branches, "definitions": defs,
+            "max_nesting_depth": depth,
+            "cyclomatic_estimate": branches + 1}
+
+
+@server.resource("snippets://examples", "Starter snippets for common tasks")
+def example_snippets() -> str:
+    return json.dumps({
+        "fibonacci": "def fib(n):\n    a, b = 0, 1\n    for _ in range(n):\n        a, b = b, a + b\n    return a",
+        "csv_sum": "import csv, sys\nprint(sum(float(r[1]) for r in csv.reader(sys.stdin)))",
+    })
+
+
+if __name__ == "__main__":
+    server.run()

@@ -1,0 +1,63 @@
+"""Finance MCP server: synthetic stock quotes + portfolio math.
+
+Tool parity with the reference finance server (reference:
+tools/mcp_servers/finance_server.py:18-103): deterministic base prices with
+bounded pseudo-noise, portfolio valuation, and an indices resource. All data
+is synthetic by design — the testbed measures traffic, not truth.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+
+from agentic_traffic_testing_tpu.tools.mcp_rpc import MCPToolServer
+
+server = MCPToolServer("finance")
+
+BASE_PRICES = {
+    "ACME": 184.20, "GLOBEX": 96.75, "INITECH": 42.10, "UMBRELLA": 310.55,
+    "STARK": 512.00, "WAYNE": 276.40, "TYRELL": 133.33, "WONKA": 88.88,
+}
+
+INDICES = {
+    "SYN500": {"level": 5234.1, "constituents": list(BASE_PRICES)},
+    "TECH100": {"level": 18321.7, "constituents": ["STARK", "TYRELL", "INITECH"]},
+}
+
+
+@server.tool("Synthetic quote for a ticker: base price plus bounded noise.")
+def get_stock_price(symbol: str) -> dict:
+    sym = symbol.upper()
+    base = BASE_PRICES.get(sym)
+    if base is None:
+        return {"symbol": sym, "error": "unknown symbol",
+                "known": sorted(BASE_PRICES)}
+    noise = random.uniform(-0.02, 0.02)
+    return {"symbol": sym, "price": round(base * (1 + noise), 2),
+            "currency": "USD", "synthetic": True}
+
+
+@server.tool("Value a portfolio given parallel lists of symbols and share "
+             "counts; returns per-position and total value.")
+def calculate_portfolio_value(symbols: list, shares: list) -> dict:
+    positions = []
+    total = 0.0
+    for sym, n in zip(symbols, shares):
+        quote = get_stock_price(str(sym))
+        price = quote.get("price", 0.0)
+        value = round(price * float(n), 2)
+        positions.append({"symbol": quote["symbol"], "shares": n,
+                          "price": price, "value": value})
+        total += value
+    return {"positions": positions, "total_value": round(total, 2),
+            "currency": "USD", "synthetic": True}
+
+
+@server.resource("finance://indices", "Synthetic market index catalog")
+def index_catalog() -> str:
+    return json.dumps(INDICES)
+
+
+if __name__ == "__main__":
+    server.run()

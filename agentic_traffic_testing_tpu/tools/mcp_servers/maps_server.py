@@ -1,0 +1,64 @@
+"""Maps MCP server: synthetic geocoding + Haversine distance.
+
+Tool parity with the reference maps server (reference:
+tools/mcp_servers/maps_server.py:16-108): a fixed city gazetteer, geocoding
+lookups, great-circle distance, and a catalog resource.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+
+from agentic_traffic_testing_tpu.tools.mcp_rpc import MCPToolServer
+
+server = MCPToolServer("maps")
+
+GAZETTEER = {
+    "madrid": (40.4168, -3.7038),
+    "paris": (48.8566, 2.3522),
+    "berlin": (52.5200, 13.4050),
+    "london": (51.5074, -0.1278),
+    "rome": (41.9028, 12.4964),
+    "lisbon": (38.7223, -9.1393),
+    "vienna": (48.2082, 16.3738),
+    "amsterdam": (52.3676, 4.9041),
+}
+
+EARTH_RADIUS_KM = 6371.0
+
+
+@server.tool("Geocode a city name from the synthetic gazetteer.")
+def geocode_location(location: str) -> dict:
+    key = location.strip().lower()
+    coords = GAZETTEER.get(key)
+    if coords is None:
+        return {"location": location, "error": "unknown location",
+                "known": sorted(GAZETTEER)}
+    return {"location": location, "lat": coords[0], "lon": coords[1],
+            "synthetic": True}
+
+
+@server.tool("Great-circle (Haversine) distance in km between two cities.")
+def calculate_distance(origin: str, destination: str) -> dict:
+    a = geocode_location(origin)
+    b = geocode_location(destination)
+    if "error" in a or "error" in b:
+        return {"error": "unknown location",
+                "origin": a, "destination": b}
+    la1, lo1, la2, lo2 = map(math.radians,
+                             [a["lat"], a["lon"], b["lat"], b["lon"]])
+    h = (math.sin((la2 - la1) / 2) ** 2
+         + math.cos(la1) * math.cos(la2) * math.sin((lo2 - lo1) / 2) ** 2)
+    km = 2 * EARTH_RADIUS_KM * math.asin(math.sqrt(h))
+    return {"origin": origin, "destination": destination,
+            "distance_km": round(km, 1)}
+
+
+@server.resource("maps://catalog", "Cities available in the synthetic gazetteer")
+def catalog() -> str:
+    return json.dumps(sorted(GAZETTEER))
+
+
+if __name__ == "__main__":
+    server.run()

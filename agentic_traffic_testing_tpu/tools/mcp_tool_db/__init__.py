@@ -1,0 +1,1 @@
+"""HTTP MCP-style DB tool (reference: tools/mcp_tool_db/)."""

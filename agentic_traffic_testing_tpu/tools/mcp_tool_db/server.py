@@ -1,0 +1,79 @@
+"""HTTP "MCP-style" database tool: deterministic echo records.
+
+Contract parity with the reference tool DB (reference:
+tools/mcp_tool_db/server.py:14-98): `POST /query {"query": str}` returns a
+deterministic record derived from the query, and every call emits
+tool_request/tool_response telemetry events keyed by `X-Task-ID` /
+`X-Tool-Call-ID` so traffic joins work. Determinism is the point: the
+experiment layer needs reproducible tool responses.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+import uuid
+from typing import Any, Dict
+
+from aiohttp import web
+
+from agentic_traffic_testing_tpu.agents.common.telemetry import TelemetryLogger
+
+
+def deterministic_record(query: str) -> Dict[str, Any]:
+    digest = hashlib.sha256(query.encode()).hexdigest()
+    return {
+        "id": digest[:12],
+        "query": query,
+        "rows": [
+            {"key": f"k{digest[i:i + 2]}", "value": int(digest[i:i + 4], 16)}
+            for i in (0, 4, 8)
+        ],
+        "row_count": 3,
+        "deterministic": True,
+    }
+
+
+class ToolDBServer:
+    def __init__(self) -> None:
+        self.telemetry = TelemetryLogger("mcp_tool_db")
+
+    async def handle_query(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response({"error": "invalid json"}, status=400)
+        query = body.get("query") or ""
+        if not query:
+            return web.json_response({"error": "missing 'query'"}, status=400)
+        task_id = request.headers.get("X-Task-ID") or body.get("task_id")
+        tool_call_id = (request.headers.get("X-Tool-Call-ID")
+                        or uuid.uuid4().hex[:12])
+        self.telemetry.log("tool_request", task_id=task_id,
+                           tool_call_id=tool_call_id, query_chars=len(query))
+        t0 = time.monotonic()
+        record = deterministic_record(query)
+        self.telemetry.log("tool_response", task_id=task_id,
+                           tool_call_id=tool_call_id,
+                           latency_ms=round((time.monotonic() - t0) * 1000, 3))
+        return web.json_response({"result": record,
+                                  "tool_call_id": tool_call_id})
+
+    async def handle_health(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok", "tool": "mcp_tool_db"})
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/query", self.handle_query)
+        app.router.add_get("/health", self.handle_health)
+        return app
+
+
+def main() -> None:
+    port = int(os.environ.get("TOOL_DB_PORT", "8301"))
+    web.run_app(ToolDBServer().build_app(), port=port, print=None)
+
+
+if __name__ == "__main__":
+    main()

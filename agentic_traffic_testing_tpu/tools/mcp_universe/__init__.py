@@ -1,0 +1,1 @@
+"""MCP-Universe benchmark adapters (reference: tools/mcp_universe/)."""

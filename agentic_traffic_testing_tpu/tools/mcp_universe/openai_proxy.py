@@ -1,0 +1,132 @@
+"""OpenAI-compatible proxy: /v1/chat/completions -> local /chat.
+
+Contract parity with the reference proxy (reference:
+tools/mcp_universe/openai_proxy.py:46-155), which lets OpenAI-SDK consumers
+(the MCP-Universe benchmark) run against the local TPU backend:
+
+  * messages[] flattened to a "[ROLE]\\n<content>" prompt, system first
+  * `max_tokens`/`max_completion_tokens` forwarded
+  * response shaped as a chat.completion object; usage mirrors the local
+    backend's real token counts when present (the reference returns nulls —
+    tools/mcp_universe/openai_proxy.py:132-136 — real counts are a superset)
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Any, Dict, List
+
+import aiohttp
+from aiohttp import web
+
+DEFAULT_BACKEND = "http://localhost:8000/chat"
+
+
+def flatten_messages(messages: List[Dict[str, Any]]) -> str:
+    """OpenAI messages[] -> single role-tagged prompt string."""
+    parts = []
+    for m in messages:
+        role = str(m.get("role", "user")).upper()
+        content = m.get("content", "")
+        if isinstance(content, list):  # content-part arrays
+            content = "\n".join(p.get("text", "") for p in content
+                                if isinstance(p, dict))
+        parts.append(f"[{role}]\n{content}")
+    return "\n\n".join(parts)
+
+
+class OpenAIProxy:
+    def __init__(self, backend_url: str | None = None) -> None:
+        self.backend_url = backend_url or os.environ.get(
+            "LLM_SERVER_URL", DEFAULT_BACKEND)
+        self._session: aiohttp.ClientSession | None = None
+
+    async def session(self) -> aiohttp.ClientSession:
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=600))
+        return self._session
+
+    async def handle_chat_completions(self, request: web.Request) -> web.Response:
+        try:
+            body = await request.json()
+        except Exception:
+            return web.json_response(
+                {"error": {"message": "invalid json", "type": "invalid_request_error"}},
+                status=400)
+        messages = body.get("messages") or []
+        if not messages:
+            return web.json_response(
+                {"error": {"message": "messages required",
+                           "type": "invalid_request_error"}}, status=400)
+        prompt = flatten_messages(messages)
+        max_tokens = body.get("max_tokens") or body.get("max_completion_tokens")
+        payload: Dict[str, Any] = {"prompt": prompt, "skip_chat_template": True}
+        if max_tokens:
+            payload["max_tokens"] = int(max_tokens)
+
+        sess = await self.session()
+        try:
+            async with sess.post(self.backend_url, json=payload) as resp:
+                data = await resp.json(content_type=None)
+                if resp.status != 200:
+                    return web.json_response(
+                        {"error": {"message": str(data)[:300],
+                                   "type": "upstream_error"}}, status=502)
+        except aiohttp.ClientError as e:
+            return web.json_response(
+                {"error": {"message": f"{type(e).__name__}: {e}",
+                           "type": "upstream_error"}}, status=502)
+
+        meta = data.get("meta", {})
+        usage = {
+            "prompt_tokens": meta.get("prompt_tokens"),
+            "completion_tokens": meta.get("completion_tokens"),
+            "total_tokens": meta.get("total_tokens"),
+        }
+        return web.json_response({
+            "id": f"chatcmpl-{uuid.uuid4().hex[:24]}",
+            "object": "chat.completion",
+            "created": int(time.time()),
+            "model": body.get("model", "local-tpu"),
+            "choices": [{
+                "index": 0,
+                "message": {"role": "assistant",
+                            "content": data.get("output", "")},
+                "finish_reason": "stop",
+            }],
+            "usage": usage,
+        })
+
+    async def handle_models(self, request: web.Request) -> web.Response:
+        return web.json_response({
+            "object": "list",
+            "data": [{"id": "local-tpu", "object": "model",
+                      "created": 0, "owned_by": "local"}],
+        })
+
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_post("/v1/chat/completions", self.handle_chat_completions)
+        app.router.add_get("/v1/models", self.handle_models)
+        async def health(_request: web.Request) -> web.Response:
+            return web.json_response({"status": "ok"})
+
+        app.router.add_get("/health", health)
+        app.on_cleanup.append(lambda _app: self._close())
+        return app
+
+    async def _close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+
+def main() -> None:
+    port = int(os.environ.get("OPENAI_PROXY_PORT", "8400"))
+    web.run_app(OpenAIProxy().build_app(), port=port, print=None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,279 @@
+"""Agents layer: scenario flows, AgentVerse workflow, SSE, parsing.
+
+Strategy per SURVEY.md §4: the LLM backend is faked in-process with the real
+/chat JSON contract (the analog of the reference's CPU fallback server), and
+real Agent A + Agent B aiohttp apps run against it on ephemeral ports — the
+whole L7/L8 call tree executes, with no model and no network egress.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+from aiohttp import ClientSession, web
+
+from agentic_traffic_testing_tpu.agents.agent_a.parsing import (
+    extract_json,
+    parse_evaluation,
+    parse_experts,
+    parse_subtasks,
+)
+
+# --------------------------------------------------------------------------
+# Fake LLM backend: recognizes each stage's prompt shape and answers usefully
+# --------------------------------------------------------------------------
+
+EXPERTS_JSON = json.dumps([
+    {"name": "Analyst", "expertise": "analysis", "responsibility": "analyze"},
+    {"name": "Builder", "expertise": "building", "responsibility": "build"},
+    {"name": "Reviewer", "expertise": "review", "responsibility": "review"},
+])
+EVAL_JSON = json.dumps({
+    "completeness": 90, "correctness": 85, "clarity": 80,
+    "overall_score": 86, "goal_achieved": True, "feedback": "solid work",
+})
+
+
+async def fake_llm_handler(request: web.Request) -> web.Response:
+    body = await request.json()
+    prompt = body.get("prompt", "")
+    if "Propose" in prompt and "experts" in prompt:
+        out = EXPERTS_JSON
+    elif "weighted rubric" in prompt:
+        out = EVAL_JSON
+    elif "independent subtasks" in prompt:
+        out = json.dumps(["subtask one", "subtask two", "subtask three"])
+    elif "supervising a multi-step task" in prompt:
+        out = "[DONE] the task is finished: 42"
+    else:
+        out = f"ok({len(prompt)} chars)"
+    return web.json_response({
+        "output": out,
+        "meta": {
+            "request_id": body.get("request_id", "r"),
+            "latency_ms": 1.0, "queue_wait_s": 0.0,
+            "prompt_tokens": max(1, len(prompt) // 4),
+            "completion_tokens": max(1, len(out) // 4),
+            "total_tokens": 2,
+            "otel": {"trace_id": "t", "span_id": "s"},
+        },
+    })
+
+
+class Stack:
+    """Fake LLM + agent B + agent A running on ephemeral localhost ports."""
+
+    def __init__(self, tmpdir: str) -> None:
+        self.tmpdir = tmpdir
+        self.runners = []
+        self.agent_a_url = ""
+        self.agent_b_url = ""
+        self.llm_url = ""
+
+    async def _start(self, app: web.Application) -> str:
+        runner = web.AppRunner(app)
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        self.runners.append(runner)
+        port = runner.addresses[0][1]
+        return f"http://127.0.0.1:{port}"
+
+    async def __aenter__(self) -> "Stack":
+        os.environ["TELEMETRY_LOG_DIR"] = self.tmpdir
+        llm_app = web.Application()
+        llm_app.router.add_post("/chat", fake_llm_handler)
+        self.llm_url = await self._start(llm_app)
+        os.environ["LLM_SERVER_URL"] = f"{self.llm_url}/chat"
+
+        from agentic_traffic_testing_tpu.agents.agent_b.server import AgentBServer
+        self.agent_b_url = await self._start(AgentBServer("agent_b_test").build_app())
+        os.environ["AGENT_B_URLS"] = self.agent_b_url
+
+        from agentic_traffic_testing_tpu.agents.agent_a.server import AgentAServer
+        self.agent_a_url = await self._start(AgentAServer().build_app())
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        for runner in self.runners:
+            await runner.cleanup()
+
+
+@pytest.fixture()
+def stack_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("TELEMETRY_LOG_DIR", str(tmp_path))
+    monkeypatch.setenv("AGENTVERSE_MAX_ITERATIONS", "2")
+    monkeypatch.setenv("AGENTVERSE_VERTICAL_ITERATIONS", "1")
+    return str(tmp_path)
+
+
+# --------------------------------------------------------------------------
+# HTTP flow tests
+# --------------------------------------------------------------------------
+
+
+def test_agent_b_subtask_contract(stack_env):
+    async def run():
+        async with Stack(stack_env) as s, ClientSession() as http:
+            async with http.post(f"{s.agent_b_url}/subtask",
+                                 json={"subtask": "add 2+2", "role": "math"},
+                                 headers={"X-Task-ID": "t1"}) as resp:
+                assert resp.status == 200
+                data = await resp.json()
+        assert data["result"].startswith("ok(")
+        assert "llm_prompt" in data and "llm_meta" in data and "otel" in data
+        assert data["agent_id"] == "agent_b_test"
+    asyncio.run(run())
+
+
+def test_task_scenarios(stack_env):
+    async def run():
+        results = {}
+        async with Stack(stack_env) as s, ClientSession() as http:
+            for scenario in ("agentic_simple", "agentic_multi_hop",
+                             "agentic_parallel"):
+                async with http.post(f"{s.agent_a_url}/task",
+                                     json={"task": "compute the answer",
+                                           "scenario": scenario,
+                                           "agent_count": 3}) as resp:
+                    assert resp.status == 200, scenario
+                    results[scenario] = await resp.json()
+        simple = results["agentic_simple"]
+        assert simple["result"].startswith("ok(")
+        assert simple["aggregates"]["total_tokens"] > 0
+        assert simple["aggregates"]["cost_estimate_usd"] >= 0
+
+        hop = results["agentic_multi_hop"]
+        assert "42" in hop["result"]
+        assert hop["detail"]["turns"] == 1  # [DONE] on first progress check
+
+        par = results["agentic_parallel"]
+        assert par["detail"]["num_workers"] == 3
+        assert len(par["detail"]["subtasks"]) == 3
+        types = [st["type"] for st in par["detail"]["steps"]]
+        assert types.count("agent_b") == 3
+        assert "llm_planning" in types and "llm_synthesis" in types
+    asyncio.run(run())
+
+
+def test_task_rejects_bad_input(stack_env):
+    async def run():
+        async with Stack(stack_env) as s, ClientSession() as http:
+            async with http.post(f"{s.agent_a_url}/task",
+                                 json={"scenario": "agentic_simple"}) as resp:
+                assert resp.status == 400
+            async with http.post(f"{s.agent_a_url}/task",
+                                 json={"task": "x", "scenario": "nope"}) as resp:
+                assert resp.status == 400
+    asyncio.run(run())
+
+
+def test_agentverse_workflow_and_persistence(stack_env):
+    async def run():
+        async with Stack(stack_env) as s, ClientSession() as http:
+            async with http.post(f"{s.agent_a_url}/agentverse",
+                                 json={"task": "design a plan",
+                                       "structure": "vertical"}) as resp:
+                assert resp.status == 200
+                data = await resp.json()
+            assert data["final_output"]
+            assert data["iteration_count"] == 1  # eval scores 86 >= 70
+            assert data["evaluation"]["goal_achieved"] is True
+            assert len(data["experts"]) == 3
+            assert data["aggregates"]["num_llm_calls"] == len(data["llm_calls"])
+            assert data["aggregates"]["cost_estimate_usd"] > 0
+
+            # Persistence + retrieval endpoint
+            async with http.get(
+                    f"{s.agent_a_url}/agentverse/{data['task_id']}") as resp:
+                assert resp.status == 200
+                persisted = await resp.json()
+            assert persisted["task_id"] == data["task_id"]
+
+            # llm_calls.jsonl written with the Phase-0.1 schema fields
+            path = os.path.join(stack_env, "llm_calls.jsonl")
+            rows = [json.loads(l) for l in open(path)]
+            assert rows and {"call_id", "task_id", "agent_id", "call_type",
+                             "latency_ms"} <= set(rows[0])
+    asyncio.run(run())
+
+
+def test_agentverse_sse_event_stream(stack_env):
+    async def run():
+        async with Stack(stack_env) as s, ClientSession() as http:
+            async with http.post(f"{s.agent_a_url}/agentverse",
+                                 json={"task": "stream me", "stream": True,
+                                       "structure": "horizontal"}) as resp:
+                assert resp.status == 200
+                assert resp.headers["Content-Type"].startswith("text/event-stream")
+                raw = (await resp.read()).decode()
+        events = [json.loads(line[len("data: "):])
+                  for line in raw.splitlines() if line.startswith("data: ")]
+        names = [e["event"] for e in events]
+        for expected in ("stage_start", "stage_complete", "discussion_round",
+                         "complete", "result"):
+            assert expected in names, f"missing {expected} in {names}"
+        assert names.index("complete") < names.index("result")
+        final = events[names.index("result")]
+        assert final["final_output"]
+    asyncio.run(run())
+
+
+def test_worker_failure_keeps_fanout_alive(stack_env):
+    """One dead worker URL must degrade, not kill, agentic_parallel."""
+    async def run():
+        async with Stack(stack_env) as s, ClientSession() as http:
+            os.environ["AGENT_B_URLS"] = (
+                f"{s.agent_b_url},http://127.0.0.1:9")  # port 9: refused
+            async with http.post(f"{s.agent_a_url}/task",
+                                 json={"task": "resilience", "max_tokens": 64,
+                                       "scenario": "agentic_parallel",
+                                       "agent_count": 2}) as resp:
+                assert resp.status == 200
+                data = await resp.json()
+        steps = [st for st in data["detail"]["steps"] if st["type"] == "agent_b"]
+        errors = [st for st in steps if st.get("error")]
+        assert len(steps) == 2 and len(errors) == 1
+        assert data["result"]  # synthesis still ran
+    asyncio.run(run())
+
+
+# --------------------------------------------------------------------------
+# Parsing unit tests
+# --------------------------------------------------------------------------
+
+
+def test_extract_json_variants():
+    assert extract_json('{"a": 1}') == {"a": 1}
+    assert extract_json('```json\n{"a": 1}\n```') == {"a": 1}
+    assert extract_json('noise before {"a": 1, } noise after') == {"a": 1}
+    assert extract_json('Here: [1, 2, 3] done', expect=list) == [1, 2, 3]
+    assert extract_json("no json here") is None
+    assert extract_json('nested {"a": {"b": [1]}} x')["a"]["b"] == [1]
+
+
+def test_parse_subtasks_fallbacks():
+    assert parse_subtasks('["a", "b"]', 2) == ["a", "b"]
+    assert parse_subtasks("1. first\n2. second\n3. third", 2) == ["first", "second"]
+    assert parse_subtasks("- only one", 3) == ["only one"] * 3
+    assert parse_subtasks("free text", 1) == ["free text"]
+
+
+def test_parse_experts_fallbacks():
+    ex = parse_experts(EXPERTS_JSON, 3)
+    assert [e["name"] for e in ex] == ["Analyst", "Builder", "Reviewer"]
+    ex = parse_experts("1. Chemist: molecules\n2. Poet: verse", 2)
+    assert ex[0]["name"] == "Chemist" and ex[1]["expertise"] == "verse"
+    ex = parse_experts("garbage", 2)
+    assert len(ex) == 2 and ex[0]["name"] == "Expert 1"
+
+
+def test_parse_evaluation_robustness():
+    good = parse_evaluation(EVAL_JSON)
+    assert good["overall_score"] == 86 and good["goal_achieved"] is True
+    broken = parse_evaluation("the work is fine I guess")
+    assert broken["overall_score"] == 0.0 and broken["goal_achieved"] is False
+    assert "fine" in broken["feedback"]
+    partial = parse_evaluation('{"completeness": 100, "correctness": 50, "clarity": 100}')
+    assert partial["overall_score"] == pytest.approx(0.4 * 100 + 0.4 * 50 + 0.2 * 100)

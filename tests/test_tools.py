@@ -1,0 +1,184 @@
+"""Tools layer: MCP stdio servers through the real client, tool DB, proxy.
+
+The MCP tests drive all three stdio servers through MCPClientManager over
+real subprocess pipes — the analog of the reference's smoke script
+(reference: scripts/experiment/test_mcp_servers.py:23-63) promoted to pytest.
+"""
+
+import asyncio
+import json
+import os
+
+import pytest
+from aiohttp import ClientSession, web
+
+from agentic_traffic_testing_tpu.agents.common.mcp_client import MCPClientManager
+from agentic_traffic_testing_tpu.tools.mcp_rpc import MCPToolServer
+from agentic_traffic_testing_tpu.tools.mcp_tool_db.server import (
+    ToolDBServer,
+    deterministic_record,
+)
+from agentic_traffic_testing_tpu.tools.mcp_universe.openai_proxy import (
+    OpenAIProxy,
+    flatten_messages,
+)
+
+
+# ------------------------------------------------------------------ mcp_rpc
+
+
+def test_mcp_server_dispatch_inline():
+    srv = MCPToolServer("t")
+
+    @srv.tool("add")
+    def add(a: int, b: int) -> dict:
+        return {"sum": a + b}
+
+    @srv.resource("t://r", "res")
+    def res() -> str:
+        return "hello"
+
+    init = srv.handle({"jsonrpc": "2.0", "id": 1, "method": "initialize",
+                       "params": {}})
+    assert init["result"]["serverInfo"]["name"] == "t"
+    assert srv.handle({"jsonrpc": "2.0", "method":
+                       "notifications/initialized"}) is None
+    tools = srv.handle({"jsonrpc": "2.0", "id": 2, "method": "tools/list"})
+    spec = tools["result"]["tools"][0]
+    assert spec["name"] == "add"
+    assert spec["inputSchema"]["required"] == ["a", "b"]
+    call = srv.handle({"jsonrpc": "2.0", "id": 3, "method": "tools/call",
+                       "params": {"name": "add", "arguments": {"a": 2, "b": 3}}})
+    assert json.loads(call["result"]["content"][0]["text"]) == {"sum": 5}
+    bad = srv.handle({"jsonrpc": "2.0", "id": 4, "method": "tools/call",
+                      "params": {"name": "add", "arguments": {"a": 2}}})
+    assert bad["result"]["isError"] is True
+    read = srv.handle({"jsonrpc": "2.0", "id": 5, "method": "resources/read",
+                       "params": {"uri": "t://r"}})
+    assert read["result"]["contents"][0]["text"] == "hello"
+    missing = srv.handle({"jsonrpc": "2.0", "id": 6, "method": "nope"})
+    assert missing["error"]["code"] == -32601
+
+
+def test_mcp_servers_over_stdio(tmp_path, monkeypatch):
+    """All three tool servers, through real subprocess pipes."""
+    monkeypatch.setenv("TELEMETRY_LOG_DIR", str(tmp_path))
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+
+    async def run():
+        mgr = MCPClientManager()
+        await mgr.connect_all()
+        try:
+            tools = await mgr.list_tools()
+            assert set(tools) == {"coding", "finance", "maps"}
+            assert {t["name"] for t in tools["coding"]} == {
+                "execute_python_code", "analyze_code_complexity"}
+
+            out = await mgr.call_tool("coding", "execute_python_code",
+                                      {"code": "print(6*7)"})
+            assert json.loads(out)["stdout"].strip() == "42"
+
+            out = await mgr.call_tool("finance", "get_stock_price",
+                                      {"symbol": "acme"})
+            quote = json.loads(out)
+            assert quote["symbol"] == "ACME" and quote["synthetic"]
+            assert abs(quote["price"] - 184.20) / 184.20 <= 0.021
+
+            out = await mgr.call_tool(
+                "maps", "calculate_distance",
+                {"origin": "madrid", "destination": "paris"})
+            dist = json.loads(out)["distance_km"]
+            assert 1000 < dist < 1100  # great-circle MAD-PAR ~1054 km
+
+            cat = await mgr.read_resource("maps", "maps://catalog")
+            assert "madrid" in cat
+        finally:
+            await mgr.close_all()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------------ tool db
+
+
+def test_tool_db_deterministic(tmp_path, monkeypatch):
+    monkeypatch.setenv("TELEMETRY_LOG_DIR", str(tmp_path))
+    assert deterministic_record("q1") == deterministic_record("q1")
+    assert deterministic_record("q1") != deterministic_record("q2")
+
+    async def run():
+        runner = web.AppRunner(ToolDBServer().build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        try:
+            async with ClientSession() as http:
+                async with http.post(f"http://127.0.0.1:{port}/query",
+                                     json={"query": "select x"},
+                                     headers={"X-Task-ID": "t9"}) as resp:
+                    assert resp.status == 200
+                    data = await resp.json()
+            assert data["result"]["row_count"] == 3
+            log = os.path.join(str(tmp_path), "local_mcp_tool_db.log")
+            events = [json.loads(l)["event_type"] for l in open(log)]
+            assert events[-2:] == ["tool_request", "tool_response"]
+        finally:
+            await runner.cleanup()
+
+    asyncio.run(run())
+
+
+# ------------------------------------------------------------------- proxy
+
+
+def test_flatten_messages():
+    prompt = flatten_messages([
+        {"role": "system", "content": "be brief"},
+        {"role": "user", "content": [{"type": "text", "text": "hi"}]},
+    ])
+    assert prompt == "[SYSTEM]\nbe brief\n\n[USER]\nhi"
+
+
+def test_openai_proxy_end_to_end(tmp_path):
+    async def run():
+        async def fake_chat(request: web.Request) -> web.Response:
+            body = await request.json()
+            assert body.get("skip_chat_template") is True
+            return web.json_response({
+                "output": "proxied!",
+                "meta": {"prompt_tokens": 7, "completion_tokens": 2,
+                         "total_tokens": 9},
+            })
+
+        llm_app = web.Application()
+        llm_app.router.add_post("/chat", fake_chat)
+        llm_runner = web.AppRunner(llm_app)
+        await llm_runner.setup()
+        llm_site = web.TCPSite(llm_runner, "127.0.0.1", 0)
+        await llm_site.start()
+        llm_port = llm_runner.addresses[0][1]
+
+        proxy = OpenAIProxy(backend_url=f"http://127.0.0.1:{llm_port}/chat")
+        runner = web.AppRunner(proxy.build_app())
+        await runner.setup()
+        site = web.TCPSite(runner, "127.0.0.1", 0)
+        await site.start()
+        port = runner.addresses[0][1]
+        try:
+            async with ClientSession() as http:
+                async with http.post(
+                        f"http://127.0.0.1:{port}/v1/chat/completions",
+                        json={"model": "m", "max_tokens": 16,
+                              "messages": [{"role": "user", "content": "hi"}]},
+                ) as resp:
+                    assert resp.status == 200
+                    data = await resp.json()
+            assert data["object"] == "chat.completion"
+            assert data["choices"][0]["message"]["content"] == "proxied!"
+            assert data["usage"]["total_tokens"] == 9
+        finally:
+            await runner.cleanup()
+            await llm_runner.cleanup()
+
+    asyncio.run(run())
