@@ -241,20 +241,19 @@ class AgentVerseOrchestrator:
         async with self._sem:
             out = await self.client.call_agent_b(
                 url, subtask, role=role, task_id=state.task_id, endpoint=endpoint)
-        for meta_key in ("llm_meta",):
-            meta = out.get(meta_key) or {}
-            if meta:
-                state.llm_calls.append({
-                    "request_id": meta.get("request_id", ""),
-                    "stage": f"worker_{endpoint}",
-                    "iteration": state.iteration,
-                    "latency_ms": meta.get("latency_ms", 0.0),
-                    "prompt_tokens": meta.get("prompt_tokens", 0),
-                    "completion_tokens": meta.get("completion_tokens", 0),
-                    "status": 200 if "error" not in out else 502,
-                    "otel": meta.get("otel", out.get("otel", {})),
-                    "error": out.get("error"),
-                })
+        meta = out.get("llm_meta") or {}
+        if meta:
+            state.llm_calls.append({
+                "request_id": meta.get("request_id", ""),
+                "stage": f"worker_{endpoint}",
+                "iteration": state.iteration,
+                "latency_ms": meta.get("latency_ms", 0.0),
+                "prompt_tokens": meta.get("prompt_tokens", 0),
+                "completion_tokens": meta.get("completion_tokens", 0),
+                "status": 200 if "error" not in out else 502,
+                "otel": meta.get("otel", out.get("otel", {})),
+                "error": out.get("error"),
+            })
         return out
 
     # ------------------------------------------------------- Stage 1

@@ -101,8 +101,9 @@ def parse_subtasks(text: str, expected: int) -> List[str]:
         subtasks = [text.strip() or "(empty plan)"]
     if len(subtasks) > expected:
         subtasks = subtasks[:expected]
-    while len(subtasks) < expected:
-        subtasks.append(subtasks[len(subtasks) % max(1, len(subtasks))])
+    base = list(subtasks)
+    while len(subtasks) < expected:  # pad by cycling the parsed items
+        subtasks.append(base[(len(subtasks) - len(base)) % len(base)])
     return subtasks
 
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import json
 import os
+import re
 import time
 import uuid
 from typing import Any, Dict, Optional
@@ -45,6 +46,18 @@ from agentic_traffic_testing_tpu.utils.tracing import (
 )
 
 SCENARIOS = ("agentic_simple", "agentic_multi_hop", "agentic_parallel")
+
+# Task ids become filenames under the runs dir — constrain them hard so
+# neither the persistence write nor GET /agentverse/{id} can traverse paths.
+_TASK_ID_RE = re.compile(r"^[A-Za-z0-9_.-]{1,64}$")
+
+
+def safe_task_id(candidate: Optional[str]) -> Optional[str]:
+    """Return the id if filesystem-safe, else None."""
+    if (candidate and _TASK_ID_RE.match(candidate)
+            and not candidate.startswith(".")):
+        return candidate
+    return None
 
 
 class AgentAServer:
@@ -70,9 +83,14 @@ class AgentAServer:
             return web.json_response(
                 {"error": f"unknown scenario {scenario!r}",
                  "scenarios": list(SCENARIOS)}, status=400)
-        task_id = (request.headers.get("X-Task-ID") or body.get("task_id")
+        task_id = (safe_task_id(request.headers.get("X-Task-ID"))
+                   or safe_task_id(body.get("task_id"))
                    or uuid.uuid4().hex[:12])
-        max_tokens = int(body.get("max_tokens") or self.default_max_tokens)
+        try:
+            max_tokens = int(body.get("max_tokens") or self.default_max_tokens)
+        except (TypeError, ValueError):
+            return web.json_response({"error": "max_tokens must be an int"},
+                                     status=400)
 
         ctx = extract_context(request.headers)
         tracer = get_tracer(self.agent_id)
@@ -143,10 +161,14 @@ class AgentAServer:
         task = body.get("task") or ""
         if not task:
             return web.json_response({"error": "missing 'task'"}, status=400)
-        task_id = body.get("task_id") or uuid.uuid4().hex[:12]
+        task_id = safe_task_id(body.get("task_id")) or uuid.uuid4().hex[:12]
         stream = bool(body.get("stream")) or (
             "text/event-stream" in request.headers.get("Accept", ""))
-        orch = self._make_orchestrator(body)
+        try:
+            orch = self._make_orchestrator(body)
+        except (TypeError, ValueError) as e:
+            return web.json_response(
+                {"error": f"bad workflow override: {e}"}, status=400)
 
         if not stream:
             state = await orch.run_workflow(task, task_id)
@@ -192,7 +214,9 @@ class AgentAServer:
         return resp
 
     async def handle_get_run(self, request: web.Request) -> web.Response:
-        task_id = request.match_info["task_id"]
+        task_id = safe_task_id(request.match_info["task_id"])
+        if task_id is None:
+            return web.json_response({"error": "invalid task id"}, status=400)
         path = os.path.join(self.runs_dir, f"{task_id}.json")
         if not os.path.isfile(path):
             return web.json_response({"error": "not found",

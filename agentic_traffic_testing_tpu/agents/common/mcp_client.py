@@ -47,14 +47,30 @@ class MCPServerProcess:
         assert self.proc is not None and self.proc.stdin and self.proc.stdout
         async with self._lock:  # one in-flight request per server
             self._msg_id += 1
-            msg = {"jsonrpc": "2.0", "id": self._msg_id,
+            msg_id = self._msg_id
+            msg = {"jsonrpc": "2.0", "id": msg_id,
                    "method": method, "params": params}
             self.proc.stdin.write((json.dumps(msg) + "\n").encode())
             await self.proc.stdin.drain()
-            line = await asyncio.wait_for(self.proc.stdout.readline(), timeout)
-        if not line:
-            raise RuntimeError(f"mcp server {self.name} closed its pipe")
-        reply = json.loads(line)
+            deadline = asyncio.get_running_loop().time() + timeout
+            while True:
+                # Match replies by id: a reply to a previously timed-out
+                # request may still be queued in the pipe — discard it
+                # instead of mis-attributing it to this call.
+                remaining = deadline - asyncio.get_running_loop().time()
+                if remaining <= 0:
+                    raise asyncio.TimeoutError(
+                        f"mcp {self.name} {method}: no reply in {timeout}s")
+                line = await asyncio.wait_for(
+                    self.proc.stdout.readline(), remaining)
+                if not line:
+                    raise RuntimeError(f"mcp server {self.name} closed its pipe")
+                try:
+                    reply = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if reply.get("id") == msg_id:
+                    break
         if "error" in reply:
             raise RuntimeError(f"mcp {self.name} {method}: {reply['error']}")
         return reply.get("result", {})

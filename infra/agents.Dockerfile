@@ -1,0 +1,16 @@
+# Agent/tool services image (reference: agents/Dockerfile — python slim +
+# iproute2 so tc netem works inside the container).
+FROM python:3.12-slim
+
+WORKDIR /app
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        iproute2 curl ca-certificates && rm -rf /var/lib/apt/lists/*
+
+COPY requirements-agents.txt .
+RUN pip install --no-cache-dir -r requirements-agents.txt
+
+COPY agentic_traffic_testing_tpu/ agentic_traffic_testing_tpu/
+COPY scripts/ scripts/
+
+ENV TELEMETRY_LOG_DIR=/logs
+CMD ["python3", "-m", "agentic_traffic_testing_tpu.agents.agent_a"]

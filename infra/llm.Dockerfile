@@ -1,0 +1,18 @@
+# TPU LLM backend image. Replaces the reference's nvidia/cuda base +
+# vllm pip install (reference: llm/Dockerfile:1-28) with a plain Python base
+# + jax[tpu]; on a TPU VM the libtpu device is passed through by compose.
+FROM python:3.12-slim
+
+WORKDIR /app
+RUN apt-get update && apt-get install -y --no-install-recommends \
+        curl ca-certificates && rm -rf /var/lib/apt/lists/*
+
+COPY requirements-tpu.txt .
+# jax[tpu] pulls libtpu from the Google releases index on TPU VMs.
+RUN pip install --no-cache-dir -r requirements-tpu.txt
+
+COPY agentic_traffic_testing_tpu/ agentic_traffic_testing_tpu/
+
+ENV LLM_PORT=8000
+EXPOSE 8000
+CMD ["python3", "-m", "agentic_traffic_testing_tpu.serving"]

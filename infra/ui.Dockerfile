@@ -1,0 +1,8 @@
+# Static UI server (reference: ui/Dockerfile — python http.server on :3000).
+FROM python:3.12-slim
+WORKDIR /srv
+COPY ui/ .
+# Contract packs + workflow examples, fetched by the UIs at ../templates/.
+COPY agentic_traffic_testing_tpu/agents/templates/ templates/
+EXPOSE 3000
+CMD ["python3", "-m", "http.server", "3000"]

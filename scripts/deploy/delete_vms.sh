@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Destroy the Vagrant VMs (reference: scripts/deploy/delete_vms.sh).
+set -u
+INFRA="$(cd "$(dirname "${BASH_SOURCE[0]}")/../../infra" && pwd)"
+command -v vagrant >/dev/null || { echo "vagrant required" >&2; exit 2; }
+cd "$INFRA" && vagrant destroy -f

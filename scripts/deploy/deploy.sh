@@ -1,0 +1,72 @@
+#!/usr/bin/env bash
+# Testbed deployment dispatcher (reference: scripts/deploy/deploy.sh:20-354).
+#
+# Usage: deploy.sh [single|distributed|multi-vm]   (default: $DEPLOYMENT_MODE)
+set -u
+
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO_ROOT="$(cd "$SCRIPT_DIR/../.." && pwd)"
+INFRA="$REPO_ROOT/infra"
+
+# Load .env (compose also reads it; scripts need the URLs too).
+if [ -f "$INFRA/.env" ]; then
+  set -a; . "$INFRA/.env"; set +a
+fi
+MODE="${1:-${DEPLOYMENT_MODE:-distributed}}"
+ENABLE_MONITORING="${ENABLE_MONITORING:-1}"
+ENABLE_NETWORK_EMULATION="${ENABLE_NETWORK_EMULATION:-0}"
+
+command -v docker >/dev/null || { echo "docker required" >&2; exit 2; }
+
+wait_for_llm() {
+  local url="${LLM_HEALTH_URL:-http://localhost:8000/health}"
+  echo "[deploy] waiting for LLM backend at $url (first jit compile is slow)"
+  for _ in $(seq 1 120); do
+    if curl -fsS -m 5 "$url" >/dev/null 2>&1; then
+      echo "[deploy] LLM backend healthy"
+      return 0
+    fi
+    sleep 5
+  done
+  echo "[deploy] LLM backend did not become healthy" >&2
+  return 1
+}
+
+start_monitoring() {
+  echo "[deploy] starting monitoring stack"
+  docker compose -f "$INFRA/docker-compose.monitoring.yml" up -d
+  # Host-side TCP collector over the inter-agent bridge.
+  nohup bash "$SCRIPT_DIR/../monitoring/run_tcpdump.sh" \
+      > /tmp/tcp_collector.log 2>&1 &
+  echo "[deploy] tcp collector started (log: /tmp/tcp_collector.log)"
+}
+
+case "$MODE" in
+  single)
+    docker compose -f "$INFRA/docker-compose.yml" up --build -d
+    ;;
+  distributed)
+    docker compose -f "$INFRA/docker-compose.distributed.yml" up --build -d
+    ;;
+  multi-vm)
+    bash "$SCRIPT_DIR/deploy_vms.sh"
+    exit $?
+    ;;
+  *)
+    echo "unknown mode: $MODE (single|distributed|multi-vm)" >&2
+    exit 2
+    ;;
+esac
+
+[ "$ENABLE_MONITORING" = "1" ] && start_monitoring
+
+bash "$SCRIPT_DIR/../fetch_endpoints.sh" || true
+wait_for_llm || true
+python3 "$SCRIPT_DIR/../monitoring/health_check.py" || true
+
+if [ "$ENABLE_NETWORK_EMULATION" = "1" ]; then
+  bash "$SCRIPT_DIR/../traffic/apply_network_emulation.sh" apply \
+    "${NETEM_DELAY_MS:-10}" "${NETEM_JITTER_MS:-2}" "${NETEM_LOSS_PCT:-0}"
+fi
+
+echo "[deploy] done (mode=$MODE)"

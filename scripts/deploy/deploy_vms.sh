@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Bring up the 4-VM Vagrant topology and deploy per-node services over SSH
+# (reference: scripts/deploy/deploy_vms.sh + deploy.sh:120-186).
+set -u
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+INFRA="$(cd "$SCRIPT_DIR/../../infra" && pwd)"
+
+command -v vagrant >/dev/null || { echo "vagrant required" >&2; exit 2; }
+cd "$INFRA"
+vagrant up
+
+# Per-node role deployment: each VM runs the single-mode compose restricted
+# to its role's services.
+declare -A ROLES=(
+  [agent-a-node]="agent-a ui"
+  [agent-b-node]="agent-b"
+  [llm-node]="llm-backend-tpu"
+  [tools-node]="mcp-tool-db"
+)
+for node in "${!ROLES[@]}"; do
+  echo "[vms] deploying ${ROLES[$node]} on $node"
+  vagrant ssh "$node" -c \
+    "cd /vagrant && docker compose -f docker-compose.yml up -d ${ROLES[$node]}" \
+    || echo "[vms] $node deploy failed" >&2
+done
+echo "[vms] multi-vm deployment complete"

@@ -1,0 +1,6 @@
+#!/usr/bin/env bash
+# Uninstall + redeploy (reference: scripts/deploy/reset_testbed.sh).
+set -eu
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+bash "$SCRIPT_DIR/uninstall_testbed.sh" -y
+bash "$SCRIPT_DIR/deploy.sh" "${1:-}"

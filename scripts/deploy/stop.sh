@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Stop all testbed containers, keep volumes/images (reference:
+# scripts/deploy/stop.sh).
+set -u
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+INFRA="$(cd "$SCRIPT_DIR/../../infra" && pwd)"
+
+pkill -f tcp_metrics_collector.py 2>/dev/null || true
+for f in docker-compose.monitoring.yml docker-compose.distributed.yml docker-compose.yml; do
+  [ -f "$INFRA/$f" ] && docker compose -f "$INFRA/$f" down 2>/dev/null
+done
+echo "[stop] testbed stopped (volumes preserved)"

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Full removal: containers, volumes, networks, logs (reference:
+# scripts/deploy/uninstall_testbed.sh). Asks first unless -y.
+set -u
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO_ROOT="$(cd "$SCRIPT_DIR/../.." && pwd)"
+INFRA="$REPO_ROOT/infra"
+
+if [ "${1:-}" != "-y" ]; then
+  printf "Remove ALL testbed containers, volumes and logs? [y/N] "
+  read -r ans
+  [ "$ans" = "y" ] || { echo "aborted"; exit 1; }
+fi
+
+pkill -f tcp_metrics_collector.py 2>/dev/null || true
+for f in docker-compose.monitoring.yml docker-compose.distributed.yml docker-compose.yml; do
+  [ -f "$INFRA/$f" ] && docker compose -f "$INFRA/$f" down -v --rmi local 2>/dev/null
+done
+rm -rf "$REPO_ROOT/logs" "$REPO_ROOT/data/experiments"
+echo "[uninstall] removed containers, volumes, logs"

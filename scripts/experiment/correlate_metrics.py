@@ -1,0 +1,154 @@
+#!/usr/bin/env python3
+"""Join per-task app metrics (llm_calls.jsonl) with per-task TCP metrics.
+
+Rebuild of the reference correlator (reference:
+scripts/experiment/correlate_metrics.py:118-406): for each task id found in
+`logs/llm_calls.jsonl`, compute its time window, run Prometheus `increase()`
+queries over that window for the TCP edges involving the LLM and the agents,
+and emit one CSV row per task joining the app view (calls, tokens, latency)
+with the network view (bytes to/from the LLM, agent A->B bytes, SYN counts,
+RTT quantiles).
+
+Output: data/correlated.csv
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import sys
+import urllib.parse
+import urllib.request
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+TCP_QUERIES = {
+    "tcp_bytes_to_llm":
+        'sum(increase(tcp_bytes_total{{dst_service="llm_backend"}}[{w}s] @ {end}))',
+    "tcp_bytes_from_llm":
+        'sum(increase(tcp_bytes_total{{src_service="llm_backend"}}[{w}s] @ {end}))',
+    "tcp_bytes_a_to_b":
+        'sum(increase(tcp_bytes_total{{src_service="agent_a",dst_service=~"agent_b.*"}}[{w}s] @ {end}))',
+    "tcp_syn_count":
+        'sum(increase(tcp_syn_total[{w}s] @ {end}))',
+    "tcp_rtt_p50_s":
+        'histogram_quantile(0.5, sum(increase(tcp_rtt_handshake_seconds_bucket[{w}s] @ {end})) by (le))',
+    "tcp_rtt_p95_s":
+        'histogram_quantile(0.95, sum(increase(tcp_rtt_handshake_seconds_bucket[{w}s] @ {end})) by (le))',
+}
+
+
+def query_scalar(prom_url: str, expr: str) -> Optional[float]:
+    params = urllib.parse.urlencode({"query": expr})
+    try:
+        with urllib.request.urlopen(f"{prom_url}/api/v1/query?{params}",
+                                    timeout=15) as resp:
+            payload = json.loads(resp.read())
+        result = payload.get("data", {}).get("result", [])
+        if not result:
+            return None
+        return float(result[0]["value"][1])
+    except Exception as e:
+        print(f"[correlate] query failed ({e}): {expr[:90]}", file=sys.stderr)
+        return None
+
+
+def load_calls(path: str) -> Dict[str, List[dict]]:
+    """llm_calls.jsonl -> {task_id: [rows]} (rows without task ids dropped)."""
+    tasks: Dict[str, List[dict]] = defaultdict(list)
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except json.JSONDecodeError:
+                continue
+            tid = row.get("task_id")
+            if tid:
+                tasks[str(tid)].append(row)
+    return tasks
+
+
+def task_window(rows: List[dict], pad_s: float) -> Optional[Dict[str, float]]:
+    starts = [r.get("started_at_ms") for r in rows if r.get("started_at_ms")]
+    ends = [r.get("finished_at_ms") for r in rows if r.get("finished_at_ms")]
+    if not starts or not ends:
+        return None
+    start = min(starts) / 1000.0 - pad_s
+    end = max(ends) / 1000.0 + pad_s
+    return {"start": start, "end": end, "window_s": max(1.0, end - start)}
+
+
+def build_app_row(task_id: str, rows: List[dict]) -> Dict[str, Any]:
+    def total(key: str) -> float:
+        return sum(r.get(key) or 0 for r in rows)
+
+    errors = sum(1 for r in rows if r.get("error"))
+    return {
+        "task_id": task_id,
+        "num_llm_calls": len(rows),
+        "num_errors": errors,
+        "prompt_tokens": int(total("prompt_tokens")),
+        "completion_tokens": int(total("completion_tokens")),
+        "total_tokens": int(total("total_tokens")),
+        "total_latency_ms": round(total("latency_ms"), 2),
+        "agents": ",".join(sorted({str(r.get("agent_id")) for r in rows})),
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--calls", default=os.path.join(
+        os.environ.get("TELEMETRY_LOG_DIR", "logs"), "llm_calls.jsonl"))
+    ap.add_argument("--prometheus",
+                    default=os.environ.get("PROMETHEUS_URL",
+                                           "http://localhost:9090"))
+    ap.add_argument("--out", default="data/correlated.csv")
+    ap.add_argument("--pad-s", type=float, default=2.0,
+                    help="window padding around first/last call")
+    ap.add_argument("--no-prometheus", action="store_true",
+                    help="emit app columns only (offline mode)")
+    args = ap.parse_args(argv)
+
+    if not os.path.isfile(args.calls):
+        print(f"[correlate] no calls file at {args.calls}", file=sys.stderr)
+        return 1
+    tasks = load_calls(args.calls)
+    if not tasks:
+        print("[correlate] no task ids found", file=sys.stderr)
+        return 1
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    fieldnames = ["task_id", "num_llm_calls", "num_errors", "prompt_tokens",
+                  "completion_tokens", "total_tokens", "total_latency_ms",
+                  "agents", "window_start", "window_end", "window_s",
+                  *TCP_QUERIES.keys()]
+    n = 0
+    with open(args.out, "w", newline="", encoding="utf-8") as f:
+        writer = csv.DictWriter(f, fieldnames=fieldnames)
+        writer.writeheader()
+        for task_id, rows in sorted(tasks.items()):
+            row = build_app_row(task_id, rows)
+            window = task_window(rows, args.pad_s)
+            if window:
+                row.update({"window_start": round(window["start"], 3),
+                            "window_end": round(window["end"], 3),
+                            "window_s": round(window["window_s"], 3)})
+                if not args.no_prometheus:
+                    for col, template in TCP_QUERIES.items():
+                        expr = template.format(w=int(window["window_s"]),
+                                               end=f"{window['end']:.3f}")
+                        row[col] = query_scalar(args.prometheus.rstrip("/"),
+                                                expr)
+            writer.writerow(row)
+            n += 1
+    print(f"[correlate] wrote {n} task rows -> {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# Experiment watchdog (reference: scripts/experiment/monitor_experiment.sh):
+# if the newest experiment has no DONE marker and no live runner process,
+# restart run_experiment.sh in resume mode (-c) on that directory.
+set -u
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO_ROOT="$(cd "$SCRIPT_DIR/../.." && pwd)"
+EXPERIMENTS_DIR="${EXPERIMENTS_DIR:-$REPO_ROOT/data/experiments}"
+
+latest="$(ls -dt "$EXPERIMENTS_DIR"/*_agentverse 2>/dev/null | head -1)"
+[ -n "$latest" ] || exit 0
+[ -f "$latest/DONE" ] && exit 0
+
+if pgrep -f "run_experiment.sh" >/dev/null 2>&1; then
+  exit 0  # still running
+fi
+
+echo "[watchdog] $(date -Is) detected crashed experiment $latest — resuming"
+nohup "$SCRIPT_DIR/run_experiment.sh" -c "$latest" \
+  >> "${LOG:-/tmp/agentic_experiment.log}" 2>&1 &

@@ -1,0 +1,314 @@
+#!/usr/bin/env python3
+"""Experiment plots + interarrival-time distribution analysis.
+
+Rebuild of the reference plotter (reference:
+scripts/experiment/plot_results.py — row plots :1-627, response-derived
+arrivals :628-693, distribution fitting :866-901, descriptives :904-936,
+interpretation :938-974):
+
+  * Grafana-style PNG per metric group from the scraped metrics.csv
+  * Interarrival-time (IAT) histogram + ECDF from per-run response.json
+    LLM-call timestamps
+  * Distribution fitting by MLE — expon, weibull_min, lognorm, gamma,
+    pareto — ranked by AIC/BIC with KS statistics
+  * Descriptives: CV, lag-k autocorrelation, Ljung-Box portmanteau test
+  * A plain-English interpretation block (burstiness, memorylessness)
+
+Outputs land in --out-dir: plots/*.png + iat_analysis.json + iat_report.txt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import glob
+import json
+import math
+import os
+import sys
+from collections import defaultdict
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+import matplotlib
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+
+from scipy import stats  # noqa: E402
+
+FIT_DISTRIBUTIONS = {
+    "expon": stats.expon,
+    "weibull": stats.weibull_min,
+    "lognorm": stats.lognorm,
+    "gamma": stats.gamma,
+    "pareto": stats.pareto,
+}
+
+
+# --------------------------------------------------------------------------
+# Arrival extraction
+# --------------------------------------------------------------------------
+
+
+def arrivals_from_responses(run_dirs: List[str]) -> List[float]:
+    """Collect LLM-call start timestamps (ms) from persisted responses.
+
+    Accepts both /task payloads (detail.steps) and /agentverse payloads
+    (llm_calls with started_at via the metrics log schema); falls back to
+    logs/llm_calls.jsonl rows when response files carry no timestamps.
+    """
+    ts: List[float] = []
+    for d in run_dirs:
+        for path in glob.glob(os.path.join(d, "response.json")):
+            try:
+                with open(path, encoding="utf-8") as f:
+                    data = json.load(f)
+            except (OSError, json.JSONDecodeError):
+                continue
+            for call in data.get("llm_calls") or []:
+                t = call.get("started_at_ms") or call.get("started_at")
+                if t:
+                    ts.append(float(t))
+    return sorted(ts)
+
+
+def arrivals_from_calls_log(path: str) -> List[float]:
+    ts = []
+    if os.path.isfile(path):
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    row = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if row.get("started_at_ms"):
+                    ts.append(float(row["started_at_ms"]))
+    return sorted(ts)
+
+
+def interarrival_seconds(arrivals_ms: List[float]) -> np.ndarray:
+    arr = np.asarray(arrivals_ms, dtype=float) / 1000.0
+    iat = np.diff(arr)
+    return iat[iat > 0]
+
+
+# --------------------------------------------------------------------------
+# Fitting + descriptives (reference :866-936)
+# --------------------------------------------------------------------------
+
+
+def fit_distributions(iat: np.ndarray) -> List[Dict[str, Any]]:
+    out = []
+    n = len(iat)
+    for name, dist in FIT_DISTRIBUTIONS.items():
+        try:
+            if name in ("expon", "pareto"):
+                params = dist.fit(iat, floc=0)
+            else:
+                params = dist.fit(iat)
+            ll = float(np.sum(dist.logpdf(iat, *params)))
+            k = len(params)
+            ks_stat, ks_p = stats.kstest(iat, dist.cdf, args=params)
+            out.append({
+                "distribution": name,
+                "params": [round(float(p), 6) for p in params],
+                "log_likelihood": round(ll, 2),
+                "aic": round(2 * k - 2 * ll, 2),
+                "bic": round(k * math.log(n) - 2 * ll, 2),
+                "ks_stat": round(float(ks_stat), 4),
+                "ks_pvalue": round(float(ks_p), 6),
+            })
+        except Exception as e:
+            out.append({"distribution": name, "error": f"{type(e).__name__}: {e}"})
+    ranked = sorted([o for o in out if "aic" in o], key=lambda o: o["aic"])
+    for i, o in enumerate(ranked):
+        o["aic_rank"] = i + 1
+    return out
+
+
+def autocorrelation(x: np.ndarray, max_lag: int) -> List[float]:
+    x = x - x.mean()
+    denom = float(np.dot(x, x))
+    if denom == 0:
+        return [0.0] * max_lag
+    return [float(np.dot(x[:-k], x[k:]) / denom) for k in range(1, max_lag + 1)]
+
+
+def ljung_box(x: np.ndarray, lags: int) -> Dict[str, float]:
+    n = len(x)
+    acf = autocorrelation(x, lags)
+    q = n * (n + 2) * sum(r * r / (n - k)
+                          for k, r in enumerate(acf, start=1))
+    p = 1.0 - stats.chi2.cdf(q, lags)
+    return {"q_stat": round(float(q), 3), "p_value": round(float(p), 6),
+            "lags": lags}
+
+
+def descriptives(iat: np.ndarray) -> Dict[str, Any]:
+    mean = float(iat.mean())
+    std = float(iat.std(ddof=1)) if len(iat) > 1 else 0.0
+    lags = min(10, max(1, len(iat) // 5))
+    return {
+        "n": int(len(iat)),
+        "mean_s": round(mean, 4),
+        "std_s": round(std, 4),
+        "cv": round(std / mean, 4) if mean else None,
+        "p50_s": round(float(np.percentile(iat, 50)), 4),
+        "p95_s": round(float(np.percentile(iat, 95)), 4),
+        "min_s": round(float(iat.min()), 5),
+        "max_s": round(float(iat.max()), 4),
+        "acf": [round(a, 4) for a in autocorrelation(iat, lags)],
+        "ljung_box": ljung_box(iat, lags),
+    }
+
+
+def interpret(desc: Dict[str, Any], fits: List[Dict[str, Any]]) -> str:
+    """Plain-English reading of the arrival process (reference :938-974)."""
+    lines = []
+    cv = desc.get("cv")
+    if cv is None:
+        return "Not enough interarrival samples to characterize the process."
+    if cv < 0.8:
+        lines.append(f"CV={cv}: arrivals are MORE regular than Poisson — "
+                     "consistent with a closed loop pacing itself on LLM latency.")
+    elif cv <= 1.2:
+        lines.append(f"CV={cv}: arrivals look approximately Poisson "
+                     "(memoryless) at this aggregation.")
+    else:
+        lines.append(f"CV={cv}: arrivals are BURSTY (overdispersed) — "
+                     "agent fan-outs inject clustered request trains.")
+    lb = desc.get("ljung_box", {})
+    if lb.get("p_value", 1.0) < 0.05:
+        lines.append(f"Ljung-Box p={lb['p_value']}: interarrivals are "
+                     "autocorrelated — the process has memory (workflow "
+                     "structure leaks into timing).")
+    else:
+        lines.append(f"Ljung-Box p={lb.get('p_value')}: no significant "
+                     "autocorrelation detected.")
+    ranked = [f for f in fits if f.get("aic_rank") == 1]
+    if ranked:
+        best = ranked[0]
+        lines.append(f"Best-fit distribution by AIC: {best['distribution']} "
+                     f"(KS={best['ks_stat']}, p={best['ks_pvalue']}).")
+        if best["distribution"] != "expon":
+            lines.append("A non-exponential best fit means simple Poisson "
+                         "traffic generators will NOT reproduce this load.")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# Plots
+# --------------------------------------------------------------------------
+
+
+def plot_iat(iat: np.ndarray, fits: List[Dict[str, Any]], out_dir: str) -> None:
+    os.makedirs(os.path.join(out_dir, "plots"), exist_ok=True)
+    fig, axes = plt.subplots(1, 2, figsize=(11, 4))
+    axes[0].hist(iat, bins=min(40, max(5, len(iat) // 3)), density=True,
+                 alpha=0.6, label="observed")
+    xs = np.linspace(iat.min(), np.percentile(iat, 99), 200)
+    for fit in fits:
+        if fit.get("aic_rank") in (1, 2):
+            dist = FIT_DISTRIBUTIONS[fit["distribution"]]
+            axes[0].plot(xs, dist.pdf(xs, *fit["params"]),
+                         label=f"{fit['distribution']} (AIC#{fit['aic_rank']})")
+    axes[0].set_title("Interarrival time density")
+    axes[0].set_xlabel("seconds")
+    axes[0].legend(fontsize=8)
+
+    sorted_iat = np.sort(iat)
+    ecdf = np.arange(1, len(iat) + 1) / len(iat)
+    axes[1].step(sorted_iat, ecdf, where="post")
+    axes[1].set_title("Interarrival ECDF")
+    axes[1].set_xlabel("seconds")
+    fig.tight_layout()
+    fig.savefig(os.path.join(out_dir, "plots", "interarrival.png"), dpi=120)
+    plt.close(fig)
+
+
+def plot_metric_rows(metrics_csv: str, out_dir: str) -> int:
+    """One PNG per panel from the scraped CSV (panel,expr,labels,ts,value)."""
+    series: Dict[str, Dict[str, List]] = defaultdict(lambda: defaultdict(list))
+    with open(metrics_csv, newline="", encoding="utf-8") as f:
+        for row in csv.DictReader(f):
+            try:
+                ts, val = float(row["ts"]), float(row["value"])
+            except (ValueError, KeyError):
+                continue
+            series[row["panel"]][row["labels"]].append((ts, val))
+    made = 0
+    for panel, by_label in series.items():
+        fig, ax = plt.subplots(figsize=(9, 3.5))
+        for labels, points in by_label.items():
+            points.sort()
+            xs = [p[0] - points[0][0] for p in points]
+            ys = [p[1] for p in points]
+            ax.plot(xs, ys, label=labels[:60] if labels != "{}" else None)
+        ax.set_title(panel)
+        ax.set_xlabel("seconds into window")
+        if any(l != "{}" for l in by_label):
+            ax.legend(fontsize=7)
+        fig.tight_layout()
+        safe = "".join(c if c.isalnum() else "_" for c in panel)[:60]
+        fig.savefig(os.path.join(out_dir, "plots", f"{safe}.png"), dpi=110)
+        plt.close(fig)
+        made += 1
+    return made
+
+
+# --------------------------------------------------------------------------
+# Main
+# --------------------------------------------------------------------------
+
+
+def analyse_iat_distributions(arrivals_ms: List[float], out_dir: str) -> Optional[dict]:
+    iat = interarrival_seconds(arrivals_ms)
+    if len(iat) < 5:
+        print(f"[plot] only {len(iat)} interarrivals; skipping analysis",
+              file=sys.stderr)
+        return None
+    fits = fit_distributions(iat)
+    desc = descriptives(iat)
+    report = interpret(desc, fits)
+    analysis = {"descriptives": desc, "fits": fits, "interpretation": report}
+    with open(os.path.join(out_dir, "iat_analysis.json"), "w",
+              encoding="utf-8") as f:
+        json.dump(analysis, f, indent=2)
+    with open(os.path.join(out_dir, "iat_report.txt"), "w",
+              encoding="utf-8") as f:
+        f.write(report + "\n")
+    plot_iat(iat, fits, out_dir)
+    return analysis
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--experiment-dir", required=True,
+                    help="dir containing run subdirs + metrics.csv")
+    ap.add_argument("--calls-log", default=os.path.join(
+        os.environ.get("TELEMETRY_LOG_DIR", "logs"), "llm_calls.jsonl"))
+    ap.add_argument("--out-dir", default=None)
+    args = ap.parse_args(argv)
+
+    out_dir = args.out_dir or args.experiment_dir
+    os.makedirs(os.path.join(out_dir, "plots"), exist_ok=True)
+
+    run_dirs = [d for d in glob.glob(os.path.join(args.experiment_dir, "*"))
+                if os.path.isdir(d)]
+    arrivals = arrivals_from_responses(run_dirs)
+    if len(arrivals) < 6:
+        arrivals = arrivals_from_calls_log(args.calls_log)
+    analysis = analyse_iat_distributions(arrivals, out_dir)
+    if analysis:
+        print(analysis["interpretation"])
+
+    metrics_csv = os.path.join(args.experiment_dir, "metrics.csv")
+    if os.path.isfile(metrics_csv):
+        n = plot_metric_rows(metrics_csv, out_dir)
+        print(f"[plot] {n} metric panels plotted", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

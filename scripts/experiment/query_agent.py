@@ -1,0 +1,65 @@
+#!/usr/bin/env python3
+"""One-shot CLI client for Agent A / Agent B (reference:
+scripts/experiment/query_agent.py).
+
+Examples:
+    query_agent.py --task "summarize X" --scenario agentic_parallel
+    query_agent.py --agent b --subtask "add 2+2"
+    query_agent.py --task "plan it" --agentverse
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import urllib.request
+
+
+def post(url: str, body: dict, timeout: float) -> dict:
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--agent", choices=["a", "b"], default="a")
+    ap.add_argument("--task", help="task text (agent a)")
+    ap.add_argument("--subtask", help="subtask text (agent b)")
+    ap.add_argument("--scenario", default="agentic_simple")
+    ap.add_argument("--agentverse", action="store_true")
+    ap.add_argument("--agent-count", type=int, default=None)
+    ap.add_argument("--max-tokens", type=int, default=None)
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args()
+
+    if args.agent == "b":
+        url = os.environ.get("AGENT_B_URLS",
+                             "http://localhost:8201").split(",")[0].rstrip("/")
+        if not args.subtask:
+            ap.error("--subtask required with --agent b")
+        out = post(f"{url}/subtask", {"subtask": args.subtask}, args.timeout)
+    else:
+        url = os.environ.get("AGENT_A_URL", "http://localhost:8101").rstrip("/")
+        if not args.task:
+            ap.error("--task required with --agent a")
+        if args.agentverse:
+            out = post(f"{url}/agentverse", {"task": args.task}, args.timeout)
+        else:
+            body = {"task": args.task, "scenario": args.scenario}
+            if args.agent_count:
+                body["agent_count"] = args.agent_count
+            if args.max_tokens:
+                body["max_tokens"] = args.max_tokens
+            out = post(f"{url}/task", body, args.timeout)
+    json.dump(out, sys.stdout, indent=2, ensure_ascii=False)
+    print()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,31 @@
+#!/usr/bin/env bash
+# Long-running aggregated experiment with watchdog (reference:
+# scripts/experiment/run_aggregated_experiment.sh): kills stale runs, waits a
+# stabilization period, launches run_experiment.sh detached under nohup, and
+# installs the cron watchdog that resumes it after crashes.
+set -u
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+ITERATIONS="${1:-5}"
+STABILIZE_S="${STABILIZE_S:-300}"
+LOG="${LOG:-/tmp/agentic_experiment.log}"
+
+echo "[agg] stopping stale experiment processes"
+pkill -f "run_experiment.sh" 2>/dev/null || true
+
+echo "[agg] stabilizing for ${STABILIZE_S}s (let metrics settle)"
+sleep "$STABILIZE_S"
+
+echo "[agg] launching run_experiment.sh -n $ITERATIONS (log: $LOG)"
+nohup "$SCRIPT_DIR/run_experiment.sh" -n "$ITERATIONS" >> "$LOG" 2>&1 &
+EXP_PID=$!
+echo "[agg] pid $EXP_PID"
+
+# Install the watchdog cron (every 10 min) unless already present.
+WATCHDOG="$SCRIPT_DIR/monitor_experiment.sh"
+if command -v crontab >/dev/null 2>&1; then
+  ( crontab -l 2>/dev/null | grep -v monitor_experiment.sh
+    echo "*/10 * * * * $WATCHDOG >> $LOG 2>&1" ) | crontab -
+  echo "[agg] watchdog cron installed"
+else
+  echo "[agg] crontab unavailable; run $WATCHDOG periodically by hand"
+fi

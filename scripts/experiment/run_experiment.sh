@@ -1,0 +1,163 @@
+#!/usr/bin/env bash
+# Batch AgentVerse experiment runner with resume support.
+#
+# Rebuild of the reference runner (reference:
+# scripts/experiment/run_experiment.sh:12-580): loads tasks from the workflow
+# template's example_tasks, POSTs each to Agent A /agentverse, persists
+# response.json/meta.json per run, scrapes Prometheus per-run and in
+# aggregate, and renders plots. Crash-resumable: position is reconstructed
+# from runs.jsonl on `-c`.
+#
+# Usage:
+#   run_experiment.sh -n 3                 # 3 iterations over all tasks
+#   run_experiment.sh -n 3 -t plan-city-network   # one task only
+#   run_experiment.sh -c <experiment_dir>  # resume an interrupted batch
+set -u
+
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO_ROOT="$(cd "$SCRIPT_DIR/../.." && pwd)"
+TEMPLATE="${TEMPLATE:-$REPO_ROOT/agentic_traffic_testing_tpu/agents/templates/agentverse_workflow.json}"
+AGENT_A_URL="${AGENT_A_URL:-http://localhost:8101}"
+EXPERIMENTS_DIR="${EXPERIMENTS_DIR:-$REPO_ROOT/data/experiments}"
+WAIT_AFTER_RUN="${WAIT_AFTER_RUN:-5}"
+REQUEST_TIMEOUT="${REQUEST_TIMEOUT:-600}"
+SCRAPE="${SCRAPE:-1}"
+
+ITERATIONS=1
+TASK_FILTER=""
+RESUME_DIR=""
+
+usage() { grep '^#' "$0" | sed 's/^# \{0,1\}//'; exit 1; }
+
+while getopts "n:t:c:h" opt; do
+  case "$opt" in
+    n) ITERATIONS="$OPTARG" ;;
+    t) TASK_FILTER="$OPTARG" ;;
+    c) RESUME_DIR="$OPTARG" ;;
+    h|*) usage ;;
+  esac
+done
+
+command -v curl >/dev/null || { echo "curl required" >&2; exit 2; }
+command -v python3 >/dev/null || { echo "python3 required" >&2; exit 2; }
+
+# ---------------------------------------------------------------- task list
+load_tasks_from_template() {
+  python3 - "$TEMPLATE" "$TASK_FILTER" <<'EOF'
+import json, sys
+tmpl, flt = sys.argv[1], sys.argv[2]
+with open(tmpl) as f:
+    tasks = json.load(f)["example_tasks"]
+for t in tasks:
+    if not flt or t["task_id"] == flt:
+        print(json.dumps(t))
+EOF
+}
+
+# ---------------------------------------------------------------- experiment dir
+if [ -n "$RESUME_DIR" ]; then
+  EXP_DIR="$RESUME_DIR"
+  [ -d "$EXP_DIR" ] || { echo "no such experiment dir: $EXP_DIR" >&2; exit 2; }
+  ITERATIONS="$(cat "$EXP_DIR/iterations.txt" 2>/dev/null || echo "$ITERATIONS")"
+  echo "[exp] resuming $EXP_DIR (iterations=$ITERATIONS)"
+else
+  STAMP="$(date +%Y%m%d_%H%M%S)"
+  EXP_DIR="$EXPERIMENTS_DIR/${STAMP}_agentverse"
+  mkdir -p "$EXP_DIR"
+  echo "$ITERATIONS" > "$EXP_DIR/iterations.txt"
+  echo "[exp] new experiment -> $EXP_DIR"
+fi
+RUNS_JSONL="$EXP_DIR/runs.jsonl"
+SUMMARY="$EXP_DIR/summary.txt"
+touch "$RUNS_JSONL"
+
+already_done() {  # $1 = run key "iter/task_id"
+  grep -q "\"run_key\": \"$1\"" "$RUNS_JSONL" 2>/dev/null
+}
+
+# ---------------------------------------------------------------- one run
+send_agentverse_request() {  # $1 iter  $2 task_id  $3 task json
+  local iter="$1" task_id="$2" task_json="$3"
+  local run_key="${iter}/${task_id}"
+  local run_dir="$EXP_DIR/$(date +%s)_${task_id}_${iter}"
+  mkdir -p "$run_dir"
+  local t0 t1 status
+  t0="$(date +%s.%N)"
+  status="$(curl -s -m "$REQUEST_TIMEOUT" -o "$run_dir/response.json" \
+      -w '%{http_code}' -X POST "$AGENT_A_URL/agentverse" \
+      -H 'Content-Type: application/json' \
+      -d "$(python3 -c 'import json,sys; t=json.loads(sys.argv[1]); print(json.dumps({"task": t["task"], "task_id": t["task_id"]+"-i'"$iter"'"}))' "$task_json")")"
+  t1="$(date +%s.%N)"
+  python3 - "$run_dir" "$run_key" "$status" "$t0" "$t1" <<'EOF'
+import json, sys
+run_dir, run_key, status, t0, t1 = sys.argv[1:6]
+meta = {"run_key": run_key, "http_status": int(status or 0),
+        "started": float(t0), "finished": float(t1),
+        "wall_s": round(float(t1) - float(t0), 3)}
+try:
+    with open(f"{run_dir}/response.json") as f:
+        resp = json.load(f)
+    ev = resp.get("evaluation", {})
+    meta.update(task_id=resp.get("task_id"),
+                iterations=resp.get("iteration_count"),
+                score=ev.get("overall_score"),
+                goal_achieved=ev.get("goal_achieved"),
+                llm_calls=(resp.get("aggregates") or {}).get("num_llm_calls"))
+except Exception as e:
+    meta["parse_error"] = str(e)
+with open(f"{run_dir}/meta.json", "w") as f:
+    json.dump(meta, f, indent=2)
+print(json.dumps(meta))
+EOF
+}
+
+# ---------------------------------------------------------------- loop
+TASKS="$(load_tasks_from_template)"
+[ -n "$TASKS" ] || { echo "no tasks matched" >&2; exit 2; }
+TOTAL=0; OK=0; SKIPPED=0
+
+for iter in $(seq 1 "$ITERATIONS"); do
+  while IFS= read -r task_json; do
+    task_id="$(python3 -c 'import json,sys; print(json.loads(sys.argv[1])["task_id"])' "$task_json")"
+    run_key="${iter}/${task_id}"
+    if already_done "$run_key"; then
+      SKIPPED=$((SKIPPED+1)); continue
+    fi
+    echo "[exp] run $run_key"
+    TOTAL=$((TOTAL+1))
+    window_start="$(date +%s)"
+    meta_line="$(send_agentverse_request "$iter" "$task_id" "$task_json")"
+    echo "$meta_line" >> "$RUNS_JSONL"
+    http_status="$(python3 -c 'import json,sys; print(json.loads(sys.argv[1])["http_status"])' "$meta_line")"
+    [ "$http_status" = "200" ] && OK=$((OK+1))
+    sleep "$WAIT_AFTER_RUN"   # let metrics propagate before the window closes
+    if [ "$SCRAPE" = "1" ]; then
+      last_run_dir="$(ls -dt "$EXP_DIR"/*_"$task_id"_"$iter" 2>/dev/null | head -1)"
+      python3 "$SCRIPT_DIR/scrape_metrics.py" \
+        --start "$window_start" --end "$(date +%s)" \
+        --out "$last_run_dir/metrics.csv" 2>/dev/null || true
+    fi
+  done <<< "$TASKS"
+done
+
+# ---------------------------------------------------------------- finalize
+finalize_experiment() {
+  {
+    echo "experiment: $EXP_DIR"
+    echo "finished:   $(date -Is)"
+    echo "runs total=$TOTAL ok=$OK skipped(resume)=$SKIPPED"
+  } > "$SUMMARY"
+  if [ "$SCRAPE" = "1" ]; then
+    first="$(python3 -c 'import json,sys
+rows=[json.loads(l) for l in open(sys.argv[1])]
+print(min(r["started"] for r in rows) if rows else "")' "$RUNS_JSONL")"
+    if [ -n "$first" ]; then
+      python3 "$SCRIPT_DIR/scrape_metrics.py" --start "$first" \
+        --end "$(date +%s)" --out "$EXP_DIR/metrics.csv" 2>/dev/null || true
+    fi
+  fi
+  python3 "$SCRIPT_DIR/plot_results.py" --experiment-dir "$EXP_DIR" || true
+  echo DONE > "$EXP_DIR/DONE"
+  cat "$SUMMARY"
+}
+finalize_experiment

@@ -1,0 +1,73 @@
+#!/usr/bin/env python3
+"""Discover and run MCP-Universe benchmark modules against the local backend
+through the OpenAI proxy (reference: scripts/experiment/run_mcp_universe.py:41-166).
+
+The benchmark suite itself is an external checkout (env MCP_UNIVERSE_DIR);
+this driver injects PYTHONPATH, points the OpenAI SDK at the local proxy,
+discovers test modules per domain, and runs them, collecting pass/fail.
+Without a checkout it lists what it would run and exits 0 — the testbed
+remains self-contained.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+from typing import List
+
+DOMAINS = ["browser_automation", "financial_analysis", "location_navigation",
+           "multi_server", "repository_management", "web_search"]
+
+
+def discover_benchmarks(universe_dir: str, domains: List[str]) -> List[str]:
+    found = []
+    for domain in domains:
+        base = os.path.join(universe_dir, "tests", domain)
+        if not os.path.isdir(base):
+            continue
+        for name in sorted(os.listdir(base)):
+            if name.startswith("test_") and name.endswith(".py"):
+                found.append(os.path.join(base, name))
+    return found
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--universe-dir",
+                    default=os.environ.get("MCP_UNIVERSE_DIR", ""))
+    ap.add_argument("--proxy-url",
+                    default=os.environ.get("OPENAI_PROXY_URL",
+                                           "http://localhost:8400/v1"))
+    ap.add_argument("--domains", nargs="*", default=DOMAINS)
+    args = ap.parse_args()
+
+    if not args.universe_dir or not os.path.isdir(args.universe_dir):
+        print("[mcp-universe] no benchmark checkout (set MCP_UNIVERSE_DIR); "
+              f"would run domains: {', '.join(args.domains)}")
+        return 0
+
+    modules = discover_benchmarks(args.universe_dir, args.domains)
+    if not modules:
+        print("[mcp-universe] no test modules discovered", file=sys.stderr)
+        return 1
+
+    env = dict(os.environ,
+               PYTHONPATH=args.universe_dir + os.pathsep
+               + os.environ.get("PYTHONPATH", ""),
+               OPENAI_BASE_URL=args.proxy_url,
+               OPENAI_API_KEY=os.environ.get("OPENAI_API_KEY", "local"))
+    failures = 0
+    for mod in modules:
+        print(f"[mcp-universe] running {os.path.relpath(mod, args.universe_dir)}")
+        proc = subprocess.run([sys.executable, "-m", "pytest", "-x", "-q", mod],
+                              env=env)
+        if proc.returncode != 0:
+            failures += 1
+    print(f"[mcp-universe] {len(modules) - failures}/{len(modules)} modules passed")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

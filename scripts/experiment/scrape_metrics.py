@@ -1,0 +1,125 @@
+#!/usr/bin/env python3
+"""Scrape Prometheus over a time window into metrics.csv — dashboard-as-schema.
+
+Rebuild of the reference scraper (reference:
+scripts/experiment/scrape_metrics.py:34-219): the set of PromQL expressions
+is read out of the Grafana dashboard JSON (every panel target), so whatever
+the dashboard shows is exactly what experiments record — one schema, zero
+drift. Falls back to a built-in core expression list when the dashboard file
+is absent.
+
+Output CSV: one row per (expr, series, timestamp): expr,panel,labels,ts,value
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import json
+import os
+import sys
+import time
+import urllib.parse
+import urllib.request
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+DEFAULT_DASHBOARD = os.path.join(
+    os.path.dirname(__file__), "..", "..", "infra", "monitoring", "grafana",
+    "dashboards", "agentic-traffic.json")
+
+CORE_EXPRS = [
+    ("LLM request rate", 'sum(rate(llm_requests_total[30s]))'),
+    ("LLM p50 latency", 'histogram_quantile(0.5, sum(rate(llm_request_latency_seconds_bucket[1m])) by (le))'),
+    ("LLM p95 latency", 'histogram_quantile(0.95, sum(rate(llm_request_latency_seconds_bucket[1m])) by (le))'),
+    ("TTFT p50", 'histogram_quantile(0.5, sum(rate(llm_queue_wait_seconds_bucket[1m])) by (le))'),
+    ("Prompt tok/s", 'sum(rate(llm_prompt_tokens_total[1m]))'),
+    ("Completion tok/s", 'sum(rate(llm_completion_tokens_total[1m]))'),
+    ("Inflight", 'llm_inflight_requests'),
+    ("Mean interarrival", '1 / sum(rate(llm_requests_total[30s]))'),
+    ("KV cache tokens", 'llm_kv_cache_total_tokens'),
+    ("TCP bytes to LLM", 'sum(rate(tcp_bytes_total{dst_service="llm_backend"}[1m]))'),
+    ("TCP RTT p95 a->llm", 'histogram_quantile(0.95, sum(rate(tcp_rtt_handshake_seconds_bucket{src_service="agent_a",dst_service="llm_backend"}[5m])) by (le))'),
+]
+
+
+def load_dashboard_panels(path: str) -> List[Tuple[str, str]]:
+    """Walk the Grafana dashboard JSON; return (panel_title, expr) pairs."""
+    with open(path, encoding="utf-8") as f:
+        dash = json.load(f)
+    pairs: List[Tuple[str, str]] = []
+
+    def walk(panels: Iterable[Dict[str, Any]]) -> None:
+        for p in panels or []:
+            title = p.get("title", "?")
+            for t in p.get("targets") or []:
+                expr = t.get("expr")
+                if expr:
+                    pairs.append((title, expr))
+            walk(p.get("panels"))
+
+    walk(dash.get("panels") or dash.get("dashboard", {}).get("panels"))
+    return pairs
+
+
+def query_range(prom_url: str, expr: str, start: float, end: float,
+                step: str) -> List[Dict[str, Any]]:
+    params = urllib.parse.urlencode({
+        "query": expr, "start": f"{start:.3f}", "end": f"{end:.3f}",
+        "step": step})
+    url = f"{prom_url}/api/v1/query_range?{params}"
+    with urllib.request.urlopen(url, timeout=30) as resp:
+        payload = json.loads(resp.read())
+    if payload.get("status") != "success":
+        raise RuntimeError(f"prometheus error for {expr!r}: {payload}")
+    return payload["data"]["result"]
+
+
+def scrape_to_csv(prom_url: str, pairs: List[Tuple[str, str]], start: float,
+                  end: float, step: str, out_path: str) -> int:
+    rows = 0
+    with open(out_path, "w", newline="", encoding="utf-8") as f:
+        writer = csv.writer(f)
+        writer.writerow(["panel", "expr", "labels", "ts", "value"])
+        for panel, expr in pairs:
+            try:
+                series = query_range(prom_url, expr, start, end, step)
+            except Exception as e:
+                print(f"[scrape] skip {expr!r}: {e}", file=sys.stderr)
+                continue
+            for s in series:
+                labels = json.dumps(s.get("metric", {}), sort_keys=True)
+                for ts, value in s.get("values", []):
+                    writer.writerow([panel, expr, labels, ts, value])
+                    rows += 1
+    return rows
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--prometheus",
+                    default=os.environ.get("PROMETHEUS_URL",
+                                           "http://localhost:9090"))
+    ap.add_argument("--dashboard", default=DEFAULT_DASHBOARD)
+    ap.add_argument("--start", type=float, default=None,
+                    help="unix ts (default: now - 15m)")
+    ap.add_argument("--end", type=float, default=None)
+    ap.add_argument("--step", default="5s")
+    ap.add_argument("--out", default="metrics.csv")
+    args = ap.parse_args(argv)
+
+    end = args.end or time.time()
+    start = args.start or end - 900
+    if os.path.isfile(args.dashboard):
+        pairs = load_dashboard_panels(args.dashboard)
+        print(f"[scrape] {len(pairs)} exprs from dashboard", file=sys.stderr)
+    else:
+        pairs = CORE_EXPRS
+        print("[scrape] dashboard not found, using core exprs", file=sys.stderr)
+    rows = scrape_to_csv(args.prometheus.rstrip("/"), pairs, start, end,
+                         args.step, args.out)
+    print(f"[scrape] wrote {rows} rows -> {args.out}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

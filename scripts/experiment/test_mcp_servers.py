@@ -1,0 +1,63 @@
+#!/usr/bin/env python3
+"""Smoke-exercise the three stdio MCP servers through the real client
+(reference: scripts/experiment/test_mcp_servers.py:23-63). CI covers the
+same path in tests/test_tools.py; this script is the operator-facing probe.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+from agentic_traffic_testing_tpu.agents.common.mcp_client import (  # noqa: E402
+    MCPClientManager,
+)
+
+
+async def main() -> int:
+    mgr = MCPClientManager()
+    print("[mcp-smoke] connecting to coding/finance/maps servers...")
+    await mgr.connect_all()
+    failures = 0
+    try:
+        for server, tools in (await mgr.list_tools()).items():
+            print(f"  {server}: {[t['name'] for t in tools]}")
+        checks = [
+            ("coding", "execute_python_code", {"code": "print(2**10)"},
+             lambda o: json.loads(o)["stdout"].strip() == "1024"),
+            ("coding", "analyze_code_complexity",
+             {"code": "def f():\n    if 1:\n        return 2"},
+             lambda o: json.loads(o)["definitions"] == 1),
+            ("finance", "get_stock_price", {"symbol": "STARK"},
+             lambda o: json.loads(o)["synthetic"] is True),
+            ("finance", "calculate_portfolio_value",
+             {"symbols": ["ACME", "WAYNE"], "shares": [10, 2]},
+             lambda o: json.loads(o)["total_value"] > 0),
+            ("maps", "geocode_location", {"location": "berlin"},
+             lambda o: abs(json.loads(o)["lat"] - 52.52) < 0.01),
+            ("maps", "calculate_distance",
+             {"origin": "rome", "destination": "london"},
+             lambda o: 1300 < json.loads(o)["distance_km"] < 1600),
+        ]
+        for server, tool, arguments, check in checks:
+            try:
+                out = await mgr.call_tool(server, tool, arguments)
+                ok = check(out)
+            except Exception as e:
+                ok, out = False, f"{type(e).__name__}: {e}"
+            print(f"  [{'PASS' if ok else 'FAIL'}] {server}.{tool}")
+            if not ok:
+                print(f"         -> {out[:200]}")
+                failures += 1
+    finally:
+        await mgr.close_all()
+    print(f"[mcp-smoke] {'all green' if not failures else f'{failures} failures'}")
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main()))

@@ -1,0 +1,46 @@
+#!/usr/bin/env bash
+# Dynamic endpoint summary from live containers (reference:
+# scripts/fetch_endpoints.sh:1-338): prints every service URL an operator
+# needs, derived from docker compose ps, with static fallbacks.
+set -u
+
+have_docker() { command -v docker >/dev/null 2>&1; }
+
+port_of() {  # $1 container fragment, $2 internal port, $3 fallback
+  if have_docker; then
+    local p
+    p="$(docker ps --filter "name=$1" --format '{{.Ports}}' 2>/dev/null \
+        | grep -oE "0\.0\.0\.0:[0-9]+->$2/tcp" | head -1 | sed -E 's/.*:([0-9]+)->.*/\1/')"
+    [ -n "$p" ] && { echo "$p"; return; }
+  fi
+  echo "$3"
+}
+
+LLM_PORT="$(port_of llm-backend 8000 8000)"
+A_PORT="$(port_of agent-a 8101 8101)"
+B_PORT="$(port_of agent-b 8201 8201)"
+DB_PORT="$(port_of mcp-tool-db 8301 8301)"
+PROXY_PORT="$(port_of openai-proxy 8400 8400)"
+UI_PORT="$(port_of ui 3000 3000)"
+
+cat <<EOF
+================= testbed endpoints =================
+LLM backend   http://localhost:${LLM_PORT}   (/chat /health /metrics)
+Agent A       http://localhost:${A_PORT}   (/task /agentverse /health)
+Agent B       http://localhost:${B_PORT}   (/subtask /discuss /health)
+Tool DB       http://localhost:${DB_PORT}   (/query)
+OpenAI proxy  http://localhost:${PROXY_PORT}   (/v1/chat/completions)
+Chat UI       http://localhost:${UI_PORT}/chat/
+AgentVerse UI http://localhost:${UI_PORT}/agentverse/
+Prometheus    http://localhost:9090
+Grafana       http://localhost:3001   (anonymous viewer)
+Jaeger        http://localhost:16686
+TCP metrics   http://localhost:9100/metrics
+Mapping exp.  http://localhost:9101/metrics
+=====================================================
+EOF
+
+if have_docker; then
+  echo "running containers:"
+  docker ps --format '  {{.Names}}\t{{.Status}}' 2>/dev/null || true
+fi

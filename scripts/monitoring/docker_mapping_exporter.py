@@ -1,0 +1,162 @@
+#!/usr/bin/env python3
+"""Docker network/container/IP mapping -> Prometheus gauges (:9101).
+
+Rebuild of the reference exporter (reference:
+scripts/monitoring/docker_mapping_exporter.py:28-193). Talks to the Docker
+Engine API over the unix socket with the standard library only, and exports
+three always-1 gauge families whose *labels* carry the mapping; dashboards
+join them onto tcp_*/container_* series with PromQL `group_left`:
+
+    docker_network_mapping{network,subnet,driver} 1
+    docker_container_mapping{container,image,status,network} 1
+    docker_ip_mapping{ip,container,network} 1
+
+Mappings are cached for 10 s to keep /metrics cheap under 2 s scrapes.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import os
+import socket
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List
+
+DOCKER_SOCKET = os.environ.get("DOCKER_SOCKET", "/var/run/docker.sock")
+CACHE_TTL_S = 10.0
+
+
+class DockerSocketConnection(http.client.HTTPConnection):
+    """HTTP over the Docker unix socket (no external deps)."""
+
+    def __init__(self, path: str = DOCKER_SOCKET) -> None:
+        super().__init__("localhost")
+        self.unix_path = path
+
+    def connect(self) -> None:
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.settimeout(5.0)
+        sock.connect(self.unix_path)
+        self.sock = sock
+
+
+def docker_get(path: str) -> Any:
+    conn = DockerSocketConnection()
+    try:
+        conn.request("GET", path)
+        resp = conn.getresponse()
+        if resp.status != 200:
+            raise RuntimeError(f"docker api {path}: http {resp.status}")
+        return json.loads(resp.read())
+    finally:
+        conn.close()
+
+
+def get_docker_mappings() -> Dict[str, List[Dict[str, str]]]:
+    """One pass over /networks and /containers/json -> three label sets."""
+    networks = []
+    ips = []
+    containers = []
+
+    for net in docker_get("/networks"):
+        subnets = ",".join(c.get("Subnet", "")
+                           for c in (net.get("IPAM") or {}).get("Config") or [])
+        networks.append({"network": net.get("Name", "?"),
+                         "subnet": subnets,
+                         "driver": net.get("Driver", "?")})
+
+    for c in docker_get("/containers/json?all=1"):
+        name = (c.get("Names") or ["/?"])[0].lstrip("/")
+        nets = (c.get("NetworkSettings") or {}).get("Networks") or {}
+        if not nets:
+            containers.append({"container": name,
+                               "image": c.get("Image", "?"),
+                               "status": c.get("State", "?"),
+                               "network": ""})
+        for net_name, net in nets.items():
+            containers.append({"container": name,
+                               "image": c.get("Image", "?"),
+                               "status": c.get("State", "?"),
+                               "network": net_name})
+            ip = net.get("IPAddress") or ""
+            if ip:
+                ips.append({"ip": ip, "container": name,
+                            "network": net_name})
+
+    return {"networks": networks, "containers": containers, "ips": ips}
+
+
+_cache: Dict[str, Any] = {"ts": 0.0, "data": None, "error": None}
+_cache_lock = threading.Lock()
+
+
+def cached_mappings() -> Dict[str, Any]:
+    with _cache_lock:
+        now = time.time()
+        if _cache["data"] is None or now - _cache["ts"] > CACHE_TTL_S:
+            try:
+                _cache["data"] = get_docker_mappings()
+                _cache["error"] = None
+            except Exception as e:
+                _cache["error"] = f"{type(e).__name__}: {e}"
+                _cache["data"] = _cache["data"] or {
+                    "networks": [], "containers": [], "ips": []}
+            _cache["ts"] = now
+        return {"data": _cache["data"], "error": _cache["error"]}
+
+
+def _labels(d: Dict[str, str]) -> str:
+    return ",".join(f'{k}="{str(v).replace(chr(34), "")}"'
+                    for k, v in sorted(d.items()))
+
+
+def generate_metrics() -> str:
+    state = cached_mappings()
+    data = state["data"]
+    lines = [
+        "# TYPE docker_network_mapping gauge",
+        *[f"docker_network_mapping{{{_labels(n)}}} 1" for n in data["networks"]],
+        "# TYPE docker_container_mapping gauge",
+        *[f"docker_container_mapping{{{_labels(c)}}} 1" for c in data["containers"]],
+        "# TYPE docker_ip_mapping gauge",
+        *[f"docker_ip_mapping{{{_labels(i)}}} 1" for i in data["ips"]],
+        "# TYPE docker_mapping_up gauge",
+        f"docker_mapping_up {0 if state['error'] else 1}",
+    ]
+    return "\n".join(lines) + "\n"
+
+
+class Handler(BaseHTTPRequestHandler):
+    def do_GET(self):  # noqa: N802
+        if self.path not in ("/metrics", "/"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = generate_metrics().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):
+        pass
+
+
+def main() -> int:
+    port = int(os.environ.get("DOCKER_MAPPING_PORT", "9101"))
+    server = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    print(f"[docker-mapping] serving /metrics on :{port}", file=sys.stderr)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

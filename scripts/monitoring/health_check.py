@@ -1,0 +1,219 @@
+#!/usr/bin/env python3
+"""End-to-end testbed health check.
+
+Rebuild of the reference checker (reference:
+scripts/monitoring/health_check.py:222-491): probes every layer and — the
+part that matters — exercises the agent -> LLM critical path with a real
+task, classifying failures (connection refused vs DNS vs 502 vs timeout) so
+an operator can tell *which* hop is broken.
+
+Checks, in order:
+    1. LLM backend /health + a real POST /chat round trip
+    2. Agent A /health, Agent B replicas /health
+    3. Critical path: POST /task (agentic_simple) through Agent A to the LLM
+    4. Tool DB /query determinism
+    5. Observability: Prometheus targets, TCP collector, mapping exporter
+
+Exit code 0 = all required checks green; 1 otherwise. `--json` for machines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import socket
+import sys
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def env_url(name: str, default: str) -> str:
+    return os.environ.get(name, default).rstrip("/")
+
+
+def classify_error(e: Exception) -> str:
+    if isinstance(e, urllib.error.HTTPError):
+        return f"http_{e.code}"
+    if isinstance(e, urllib.error.URLError):
+        reason = e.reason
+        if isinstance(reason, socket.gaierror):
+            return "dns_failure"
+        if isinstance(reason, ConnectionRefusedError):
+            return "connection_refused"
+        if isinstance(reason, socket.timeout) or isinstance(reason, TimeoutError):
+            return "timeout"
+        return f"unreachable:{type(reason).__name__}"
+    if isinstance(e, socket.timeout):
+        return "timeout"
+    return f"{type(e).__name__}"
+
+
+def http_json(url: str, body: Optional[dict] = None, timeout: float = 10.0,
+              headers: Optional[dict] = None) -> Tuple[int, Any]:
+    data = json.dumps(body).encode() if body is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json", **(headers or {})})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read() or b"{}")
+
+
+class Check:
+    def __init__(self, name: str, required: bool = True) -> None:
+        self.name = name
+        self.required = required
+        self.ok = False
+        self.detail: Dict[str, Any] = {}
+
+    def record(self, ok: bool, **detail: Any) -> None:
+        self.ok = ok
+        self.detail = detail
+
+    def row(self) -> Dict[str, Any]:
+        return {"check": self.name, "ok": self.ok,
+                "required": self.required, **self.detail}
+
+
+def check_llm(checks: List[Check], llm_url: str, timeout: float) -> None:
+    c = Check("llm.health")
+    checks.append(c)
+    try:
+        status, body = http_json(f"{llm_url}/health", timeout=timeout)
+        c.record(status == 200, status=status, body=body)
+    except Exception as e:
+        c.record(False, error=classify_error(e))
+
+    c = Check("llm.chat_roundtrip")
+    checks.append(c)
+    try:
+        t0 = time.monotonic()
+        status, body = http_json(
+            f"{llm_url}/chat",
+            {"prompt": "health probe", "max_tokens": 4},
+            timeout=max(timeout, 60.0))
+        meta = body.get("meta", {})
+        c.record(status == 200 and "output" in body,
+                 status=status, latency_ms=round((time.monotonic() - t0) * 1e3, 1),
+                 completion_tokens=meta.get("completion_tokens"))
+    except Exception as e:
+        c.record(False, error=classify_error(e))
+
+
+def discover_agent_endpoints() -> Dict[str, str]:
+    """Agent URLs from env (compose injects them), reference-compatible names."""
+    eps = {"agent_a": env_url("AGENT_A_URL", "http://localhost:8101")}
+    for i, url in enumerate(os.environ.get(
+            "AGENT_B_URLS", "http://localhost:8201").split(",")):
+        url = url.strip().rstrip("/")
+        if url:
+            eps[f"agent_b_{i + 1}" if i else "agent_b"] = url
+    return eps
+
+
+def check_agents(checks: List[Check], agents: Dict[str, str],
+                 timeout: float) -> None:
+    for name, url in agents.items():
+        c = Check(f"{name}.health")
+        checks.append(c)
+        try:
+            status, body = http_json(f"{url}/health", timeout=timeout)
+            c.record(status == 200, status=status,
+                     agent_id=body.get("agent_id"))
+        except Exception as e:
+            c.record(False, error=classify_error(e))
+
+
+def check_agent_to_llm_connectivity(checks: List[Check], agent_a_url: str,
+                                    timeout: float) -> None:
+    """The critical path: a real scenario through Agent A down to the LLM."""
+    c = Check("critical_path.agent_a_to_llm")
+    checks.append(c)
+    try:
+        t0 = time.monotonic()
+        status, body = http_json(
+            f"{agent_a_url}/task",
+            {"task": "reply with one word", "scenario": "agentic_simple",
+             "max_tokens": 4},
+            timeout=max(timeout, 120.0))
+        steps = (body.get("detail") or {}).get("steps") or []
+        step_err = next((s.get("error") for s in steps if s.get("error")), None)
+        c.record(status == 200 and not step_err, status=status,
+                 latency_ms=round((time.monotonic() - t0) * 1e3, 1),
+                 step_error=step_err,
+                 tokens=(body.get("aggregates") or {}).get("total_tokens"))
+    except Exception as e:
+        c.record(False, error=classify_error(e))
+
+
+def check_tool_db(checks: List[Check], url: str, timeout: float) -> None:
+    c = Check("tool_db.query", required=False)
+    checks.append(c)
+    try:
+        _, one = http_json(f"{url}/query", {"query": "probe"}, timeout=timeout)
+        _, two = http_json(f"{url}/query", {"query": "probe"}, timeout=timeout)
+        c.record(one.get("result") == two.get("result"),
+                 deterministic=one.get("result") == two.get("result"))
+    except Exception as e:
+        c.record(False, error=classify_error(e))
+
+
+def check_observability(checks: List[Check], timeout: float) -> None:
+    probes = {
+        "prometheus": env_url("PROMETHEUS_URL", "http://localhost:9090")
+        + "/-/ready",
+        "tcp_collector": env_url("TCP_COLLECTOR_URL", "http://localhost:9100")
+        + "/metrics",
+        "docker_mapping": env_url("DOCKER_MAPPING_URL", "http://localhost:9101")
+        + "/metrics",
+    }
+    for name, url in probes.items():
+        c = Check(f"observability.{name}", required=False)
+        checks.append(c)
+        try:
+            req = urllib.request.Request(url)
+            with urllib.request.urlopen(req, timeout=timeout) as resp:
+                c.record(resp.status == 200, status=resp.status)
+        except Exception as e:
+            c.record(False, error=classify_error(e))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--timeout", type=float, default=10.0)
+    ap.add_argument("--skip-observability", action="store_true")
+    args = ap.parse_args(argv)
+
+    llm_url = env_url("LLM_SERVER_URL", "http://localhost:8000")
+    # LLM_SERVER_URL conventionally includes /chat; strip for /health.
+    if llm_url.endswith("/chat"):
+        llm_url = llm_url[: -len("/chat")]
+    agents = discover_agent_endpoints()
+
+    checks: List[Check] = []
+    check_llm(checks, llm_url, args.timeout)
+    check_agents(checks, agents, args.timeout)
+    check_agent_to_llm_connectivity(checks, agents["agent_a"], args.timeout)
+    check_tool_db(checks,
+                  env_url("TOOL_DB_URL", "http://localhost:8301"), args.timeout)
+    if not args.skip_observability:
+        check_observability(checks, args.timeout)
+
+    required_ok = all(c.ok for c in checks if c.required)
+    if args.json:
+        print(json.dumps({"ok": required_ok,
+                          "checks": [c.row() for c in checks]}, indent=2))
+    else:
+        for c in checks:
+            mark = "PASS" if c.ok else ("FAIL" if c.required else "warn")
+            detail = " ".join(f"{k}={v}" for k, v in c.detail.items())
+            print(f"[{mark:4s}] {c.name:35s} {detail}")
+        print(f"\noverall: {'HEALTHY' if required_ok else 'UNHEALTHY'}")
+    return 0 if required_ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Human-readable docker network / bridge / IP maps (reference:
+# scripts/monitoring/print_network_mappings.sh:1-78).
+set -u
+command -v docker >/dev/null || { echo "docker required" >&2; exit 2; }
+
+echo "== networks (name -> bridge, subnet) =="
+docker network ls --format '{{.ID}} {{.Name}}' | while read -r id name; do
+  subnet="$(docker network inspect "$id" \
+    --format '{{range .IPAM.Config}}{{.Subnet}} {{end}}' 2>/dev/null)"
+  echo "  $name -> br-${id:0:12}  $subnet"
+done
+
+echo
+echo "== containers (name -> network: ip) =="
+docker ps --format '{{.Names}}' | while read -r c; do
+  docker inspect "$c" --format \
+    '{{range $net, $cfg := .NetworkSettings.Networks}}  {{$.Name}} -> {{$net}}: {{$cfg.IPAddress}}{{"\n"}}{{end}}' \
+    2>/dev/null
+done
+
+echo "== host bridges =="
+ls /sys/class/net/ 2>/dev/null | grep '^br-' | sed 's/^/  /' || echo "  (none)"

@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Host-side capture loop: pipe tcpdump into the TCP metrics collector
+# (reference: scripts/monitoring/run_tcpdump.sh:1-54). Kills any stale :9100
+# listener first so redeploys don't stack collectors.
+set -u
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+PORT="${TCP_COLLECTOR_PORT:-9100}"
+IFACE="${TCP_CAPTURE_IFACE:-any}"
+
+# Kill a stale collector holding the port.
+if command -v fuser >/dev/null 2>&1; then
+  fuser -k "${PORT}/tcp" 2>/dev/null || true
+else
+  pkill -f "tcp_metrics_collector.py" 2>/dev/null || true
+fi
+sleep 1
+
+SUDO=""
+[ "$(id -u)" != "0" ] && command -v sudo >/dev/null && SUDO="sudo"
+
+echo "[run_tcpdump] capturing on $IFACE -> collector :$PORT"
+exec $SUDO tcpdump -tt -n -l -i "$IFACE" tcp 2>/dev/null \
+  | python3 "$SCRIPT_DIR/tcp_metrics_collector.py" --read-stdin --port "$PORT"

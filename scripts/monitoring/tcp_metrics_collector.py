@@ -1,0 +1,351 @@
+#!/usr/bin/env python3
+"""tcpdump -> Prometheus TCP metrics exporter (L2 capture plane).
+
+Rebuild of the reference collector (reference:
+scripts/monitoring/tcp_metrics_collector.py:43-568): parse `tcpdump -tt -n`
+lines from a live subprocess or stdin, track per-flow state, pair SYN with
+SYN-ACK for handshake RTT, and serve hand-rolled Prometheus text on :9100.
+
+Metric families (names unchanged so the Grafana dashboard and scraper work
+against either testbed):
+
+    tcp_packets_total{src_service,dst_service}
+    tcp_bytes_total{src_service,dst_service}
+    tcp_syn_total{src_service,dst_service}
+    tcp_rtt_handshake_seconds{src_service,dst_service} (histogram)
+    tcp_active_flows
+    tcp_flow_duration_seconds (histogram, on flow expiry)
+
+Service names come from an IP->service map (env-overridable, defaults match
+the compose IP plan in infra/.env.example). Unknown IPs map to "external".
+
+Usage:
+    tcp_metrics_collector.py --interface br-inter_agent   # spawns tcpdump
+    sudo tcpdump -tt -n -i any tcp | tcp_metrics_collector.py --read-stdin
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import threading
+import time
+from dataclasses import dataclass, field
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Iterable, List, Optional, Tuple
+
+# ---------------------------------------------------------------------------
+# IP -> service mapping (env-overridable; defaults = compose static IP plan)
+# ---------------------------------------------------------------------------
+
+DEFAULT_IP_MAP = {
+    "172.23.0.10": "agent_a",
+    "172.23.0.11": "agent_b",
+    "172.23.0.12": "agent_b_2",
+    "172.23.0.13": "agent_b_3",
+    "172.23.0.14": "agent_b_4",
+    "172.23.0.15": "agent_b_5",
+    "172.23.0.20": "llm_backend",
+    "172.23.0.30": "mcp_tool_db",
+    "172.23.0.40": "ui",
+}
+
+
+def load_ip_map() -> Dict[str, str]:
+    raw = os.environ.get("TCP_COLLECTOR_IP_MAP")
+    if raw:
+        try:
+            return {str(k): str(v) for k, v in json.loads(raw).items()}
+        except json.JSONDecodeError:
+            print(f"[tcp-collector] bad TCP_COLLECTOR_IP_MAP, using defaults",
+                  file=sys.stderr)
+    return dict(DEFAULT_IP_MAP)
+
+
+# ---------------------------------------------------------------------------
+# tcpdump line parsing
+# ---------------------------------------------------------------------------
+
+# `tcpdump -tt -n`:  1690000000.123456 IP 172.23.0.10.52344 > 172.23.0.20.8000:
+#                    Flags [S], seq ..., length 0
+_PACKET_RE = re.compile(
+    r"^(?P<ts>\d+\.\d+)\s+IP6?\s+"
+    r"(?P<src>[\da-fA-F.:]+)\.(?P<sport>\d+)\s+>\s+"
+    r"(?P<dst>[\da-fA-F.:]+)\.(?P<dport>\d+):\s+"
+    r"Flags\s+\[(?P<flags>[^\]]*)\]"
+    r"(?:.*?\blength\s+(?P<length>\d+))?"
+)
+
+
+@dataclass
+class Packet:
+    ts: float
+    src: str
+    sport: int
+    dst: str
+    dport: int
+    flags: str
+    length: int
+
+
+def parse_line(line: str) -> Optional[Packet]:
+    m = _PACKET_RE.match(line)
+    if not m:
+        return None
+    return Packet(
+        ts=float(m.group("ts")),
+        src=m.group("src"), sport=int(m.group("sport")),
+        dst=m.group("dst"), dport=int(m.group("dport")),
+        flags=m.group("flags"),
+        length=int(m.group("length") or 0),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Flow tracking + metrics
+# ---------------------------------------------------------------------------
+
+RTT_BUCKETS = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+               0.25, 0.5, 1.0, 2.5]
+DURATION_BUCKETS = [0.01, 0.05, 0.1, 0.5, 1, 5, 15, 30, 60, 120, 300]
+FLOW_IDLE_TIMEOUT_S = 120.0
+
+
+@dataclass
+class FlowState:
+    first_ts: float
+    last_ts: float
+    packets: int = 0
+    bytes: int = 0
+    syn_ts: Optional[float] = None   # pending SYN awaiting SYN-ACK
+
+
+class Histogram:
+    """Minimal fixed-bucket histogram (hand-rolled text rendering, like the
+    reference's — no prometheus_client dependency for the host collector)."""
+
+    def __init__(self, buckets: List[float]) -> None:
+        self.buckets = buckets
+        self.counts = [0] * (len(buckets) + 1)
+        self.total = 0.0
+        self.n = 0
+
+    def observe(self, v: float) -> None:
+        self.n += 1
+        self.total += v
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+    def render(self, name: str, labels: str) -> Iterable[str]:
+        cum = 0
+        for b, c in zip(self.buckets, self.counts):
+            cum += c
+            yield f'{name}_bucket{{{labels},le="{b}"}} {cum}'
+        cum += self.counts[-1]
+        yield f'{name}_bucket{{{labels},le="+Inf"}} {cum}'
+        yield f'{name}_sum{{{labels}}} {self.total:.6f}'
+        yield f'{name}_count{{{labels}}} {cum}'
+
+
+class TCPMetrics:
+    """All collector state; one lock shared by the packet thread and the
+    /metrics renderer (reference keeps the same split — :138, 224, 312)."""
+
+    def __init__(self, ip_map: Dict[str, str]) -> None:
+        self.ip_map = ip_map
+        self.lock = threading.Lock()
+        self.packets: Dict[Tuple[str, str], int] = {}
+        self.bytes: Dict[Tuple[str, str], int] = {}
+        self.syns: Dict[Tuple[str, str], int] = {}
+        self.rtt: Dict[Tuple[str, str], Histogram] = {}
+        self.flow_duration = Histogram(DURATION_BUCKETS)
+        self.flows: Dict[Tuple[str, int, str, int], FlowState] = {}
+        self.parse_errors = 0
+        self.started = time.time()
+
+    def service(self, ip: str) -> str:
+        return self.ip_map.get(ip, "external")
+
+    # ------------------------------------------------------------ ingest
+    def process_packet(self, pkt: Packet) -> None:
+        src_svc, dst_svc = self.service(pkt.src), self.service(pkt.dst)
+        edge = (src_svc, dst_svc)
+        fkey = (pkt.src, pkt.sport, pkt.dst, pkt.dport)
+        rkey = (pkt.dst, pkt.dport, pkt.src, pkt.sport)
+        is_syn = "S" in pkt.flags and "." not in pkt.flags  # SYN, not SYN-ACK
+        is_synack = "S" in pkt.flags and "." in pkt.flags
+
+        with self.lock:
+            self.packets[edge] = self.packets.get(edge, 0) + 1
+            self.bytes[edge] = self.bytes.get(edge, 0) + pkt.length
+            flow = self.flows.get(fkey)
+            if flow is None:
+                flow = self.flows[fkey] = FlowState(first_ts=pkt.ts,
+                                                    last_ts=pkt.ts)
+            flow.packets += 1
+            flow.bytes += pkt.length
+            flow.last_ts = pkt.ts
+
+            if is_syn:
+                self.syns[edge] = self.syns.get(edge, 0) + 1
+                flow.syn_ts = pkt.ts
+            elif is_synack:
+                # RTT = SYN-ACK time minus the reverse flow's pending SYN.
+                rev = self.flows.get(rkey)
+                if rev is not None and rev.syn_ts is not None:
+                    rtt = pkt.ts - rev.syn_ts
+                    rev.syn_ts = None
+                    if 0 <= rtt < 10:
+                        redge = (self.service(pkt.dst), self.service(pkt.src))
+                        hist = self.rtt.get(redge)
+                        if hist is None:
+                            hist = self.rtt[redge] = Histogram(RTT_BUCKETS)
+                        hist.observe(rtt)
+
+    def expire_idle_flows(self, now: Optional[float] = None) -> int:
+        now = now or time.time()
+        expired = 0
+        with self.lock:
+            for key, flow in list(self.flows.items()):
+                if now - flow.last_ts > FLOW_IDLE_TIMEOUT_S:
+                    self.flow_duration.observe(flow.last_ts - flow.first_ts)
+                    del self.flows[key]
+                    expired += 1
+        return expired
+
+    # ------------------------------------------------------------ render
+    def render(self) -> str:
+        out: List[str] = []
+        with self.lock:
+            out.append("# TYPE tcp_packets_total counter")
+            for (s, d), v in sorted(self.packets.items()):
+                out.append(f'tcp_packets_total{{src_service="{s}",dst_service="{d}"}} {v}')
+            out.append("# TYPE tcp_bytes_total counter")
+            for (s, d), v in sorted(self.bytes.items()):
+                out.append(f'tcp_bytes_total{{src_service="{s}",dst_service="{d}"}} {v}')
+            out.append("# TYPE tcp_syn_total counter")
+            for (s, d), v in sorted(self.syns.items()):
+                out.append(f'tcp_syn_total{{src_service="{s}",dst_service="{d}"}} {v}')
+            out.append("# TYPE tcp_rtt_handshake_seconds histogram")
+            for (s, d), hist in sorted(self.rtt.items()):
+                out.extend(hist.render(
+                    "tcp_rtt_handshake_seconds",
+                    f'src_service="{s}",dst_service="{d}"'))
+            out.append("# TYPE tcp_flow_duration_seconds histogram")
+            out.extend(self.flow_duration.render("tcp_flow_duration_seconds",
+                                                 'scope="all"'))
+            out.append("# TYPE tcp_active_flows gauge")
+            out.append(f"tcp_active_flows {len(self.flows)}")
+            out.append("# TYPE tcp_collector_parse_errors_total counter")
+            out.append(f"tcp_collector_parse_errors_total {self.parse_errors}")
+            out.append("# TYPE tcp_collector_uptime_seconds gauge")
+            out.append(f"tcp_collector_uptime_seconds {time.time() - self.started:.1f}")
+        return "\n".join(out) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Ingest loops + HTTP server
+# ---------------------------------------------------------------------------
+
+
+def reader_loop(metrics: TCPMetrics, stream) -> None:
+    for line in stream:
+        if isinstance(line, bytes):
+            line = line.decode(errors="replace")
+        pkt = parse_line(line)
+        if pkt is not None:
+            metrics.process_packet(pkt)
+        elif line.strip() and "listening on" not in line:
+            metrics.parse_errors += 1
+
+
+def expiry_loop(metrics: TCPMetrics, interval_s: float = 10.0) -> None:
+    """Dedicated timer: flows must keep expiring (and flushing into the
+    duration histogram) after capture goes quiet, when the reader loop is
+    blocked on the pipe."""
+    while True:
+        time.sleep(interval_s)
+        metrics.expire_idle_flows()
+
+
+def spawn_tcpdump(interface: str) -> subprocess.Popen:
+    cmd = ["tcpdump", "-tt", "-n", "-l", "-i", interface, "tcp"]
+    return subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.DEVNULL)
+
+
+class MetricsHandler(BaseHTTPRequestHandler):
+    metrics: TCPMetrics = None  # injected
+
+    def do_GET(self):  # noqa: N802
+        if self.path not in ("/metrics", "/"):
+            self.send_response(404)
+            self.end_headers()
+            return
+        body = self.metrics.render().encode()
+        self.send_response(200)
+        self.send_header("Content-Type", "text/plain; version=0.0.4")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--port", type=int,
+                    default=int(os.environ.get("TCP_COLLECTOR_PORT", "9100")))
+    ap.add_argument("--interface", default=None,
+                    help="spawn tcpdump on this interface")
+    ap.add_argument("--read-stdin", action="store_true",
+                    help="parse tcpdump output piped to stdin")
+    args = ap.parse_args(argv)
+
+    metrics = TCPMetrics(load_ip_map())
+    MetricsHandler.metrics = metrics
+
+    if args.read_stdin:
+        stream = sys.stdin
+        proc = None
+    elif args.interface:
+        proc = spawn_tcpdump(args.interface)
+        stream = proc.stdout
+    else:
+        ap.error("one of --interface or --read-stdin is required")
+        return 2
+
+    threading.Thread(target=reader_loop, args=(metrics, stream),
+                     daemon=True).start()
+    threading.Thread(target=expiry_loop, args=(metrics,), daemon=True).start()
+
+    server = ThreadingHTTPServer(("0.0.0.0", args.port), MetricsHandler)
+    print(f"[tcp-collector] serving /metrics on :{args.port}", file=sys.stderr)
+
+    def shutdown(*_):
+        # shutdown() must come from another thread: the handler runs on the
+        # main thread, which serve_forever() owns — calling it here deadlocks.
+        threading.Thread(target=server.shutdown, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, shutdown)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if proc is not None:
+            proc.terminate()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

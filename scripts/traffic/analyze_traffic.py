@@ -1,0 +1,248 @@
+#!/usr/bin/env python3
+"""Offline pcap analysis: per-flow stats, time series, telemetry join.
+
+Rebuild of the reference analyzer (reference:
+scripts/traffic/analyze_traffic.py:67-421), which used scapy; this version
+parses the classic libpcap format first-party (struct unpacking of the
+global header, per-record headers, and Ethernet/IPv4/TCP headers) — no
+capture dependencies, reads what `tcpdump -w` writes.
+
+Outputs: per-flow CSV, per-second connections/bytes CSV, and an optional
+join against telemetry JSONL event windows.
+"""
+
+from __future__ import annotations
+
+import argparse
+import csv
+import glob
+import json
+import os
+import struct
+import sys
+from collections import defaultdict
+from dataclasses import dataclass, field
+from typing import Dict, Iterator, List, Optional, Tuple
+
+PCAP_MAGIC_LE = 0xA1B2C3D4
+PCAP_MAGIC_LE_NS = 0xA1B23C4D
+LINKTYPE_ETHERNET = 1
+LINKTYPE_LINUX_SLL = 113
+LINKTYPE_RAW = 101
+
+
+@dataclass
+class PcapPacket:
+    ts: float
+    src: str
+    dst: str
+    sport: int
+    dport: int
+    flags: int
+    payload_len: int
+    wire_len: int
+
+    @property
+    def is_syn(self) -> bool:
+        return bool(self.flags & 0x02) and not (self.flags & 0x10)
+
+    @property
+    def is_fin_or_rst(self) -> bool:
+        return bool(self.flags & 0x05)
+
+
+def read_pcap(path: str) -> Iterator[PcapPacket]:
+    """Yield TCP packets from a classic-format pcap file."""
+    with open(path, "rb") as f:
+        header = f.read(24)
+        if len(header) < 24:
+            return
+        magic = struct.unpack("<I", header[:4])[0]
+        if magic in (PCAP_MAGIC_LE, PCAP_MAGIC_LE_NS):
+            endian, ns = "<", magic == PCAP_MAGIC_LE_NS
+        else:
+            magic_be = struct.unpack(">I", header[:4])[0]
+            if magic_be in (PCAP_MAGIC_LE, PCAP_MAGIC_LE_NS):
+                endian, ns = ">", magic_be == PCAP_MAGIC_LE_NS
+            else:
+                raise ValueError(f"{path}: not a classic pcap (magic {magic:#x})")
+        linktype = struct.unpack(f"{endian}I", header[20:24])[0]
+
+        while True:
+            rec = f.read(16)
+            if len(rec) < 16:
+                return
+            ts_s, ts_frac, incl, orig = struct.unpack(f"{endian}IIII", rec)
+            data = f.read(incl)
+            if len(data) < incl:
+                return
+            ts = ts_s + ts_frac / (1e9 if ns else 1e6)
+            pkt = parse_frame(data, linktype, ts, orig)
+            if pkt is not None:
+                yield pkt
+
+
+def parse_frame(data: bytes, linktype: int, ts: float,
+                wire_len: int) -> Optional[PcapPacket]:
+    if linktype == LINKTYPE_ETHERNET:
+        if len(data) < 14:
+            return None
+        ethertype = struct.unpack("!H", data[12:14])[0]
+        if ethertype != 0x0800:  # IPv4 only
+            return None
+        ip = data[14:]
+    elif linktype == LINKTYPE_LINUX_SLL:
+        if len(data) < 16:
+            return None
+        if struct.unpack("!H", data[14:16])[0] != 0x0800:
+            return None
+        ip = data[16:]
+    elif linktype == LINKTYPE_RAW:
+        ip = data
+    else:
+        return None
+
+    if len(ip) < 20 or (ip[0] >> 4) != 4 or ip[9] != 6:  # v4 + TCP
+        return None
+    ihl = (ip[0] & 0xF) * 4
+    total_len = struct.unpack("!H", ip[2:4])[0]
+    src = ".".join(str(b) for b in ip[12:16])
+    dst = ".".join(str(b) for b in ip[16:20])
+    tcp = ip[ihl:]
+    if len(tcp) < 14:
+        return None
+    sport, dport = struct.unpack("!HH", tcp[:4])
+    data_off = (tcp[12] >> 4) * 4
+    flags = tcp[13]
+    payload_len = max(0, total_len - ihl - data_off)
+    return PcapPacket(ts=ts, src=src, dst=dst, sport=sport, dport=dport,
+                      flags=flags, payload_len=payload_len, wire_len=wire_len)
+
+
+# --------------------------------------------------------------------------
+# Flow accounting
+# --------------------------------------------------------------------------
+
+
+@dataclass
+class FlowStats:
+    first_ts: float
+    last_ts: float
+    packets: int = 0
+    bytes: int = 0
+    payload_bytes: int = 0
+    syns: int = 0
+    fins_rsts: int = 0
+
+    @property
+    def duration_s(self) -> float:
+        return self.last_ts - self.first_ts
+
+
+FlowKey = Tuple[str, int, str, int]
+
+
+def canonical(pkt: PcapPacket) -> Tuple[FlowKey, bool]:
+    """Direction-collapsed flow key + whether pkt goes in canonical direction."""
+    a = (pkt.src, pkt.sport, pkt.dst, pkt.dport)
+    b = (pkt.dst, pkt.dport, pkt.src, pkt.sport)
+    return (a, True) if a <= b else (b, False)
+
+
+def analyze_pcap(paths: List[str]) -> Tuple[Dict[FlowKey, FlowStats],
+                                            Dict[int, Dict[str, int]]]:
+    flows: Dict[FlowKey, FlowStats] = {}
+    per_second: Dict[int, Dict[str, int]] = defaultdict(
+        lambda: {"packets": 0, "bytes": 0, "new_connections": 0})
+    for path in paths:
+        for pkt in read_pcap(path):
+            key, _ = canonical(pkt)
+            st = flows.get(key)
+            if st is None:
+                st = flows[key] = FlowStats(first_ts=pkt.ts, last_ts=pkt.ts)
+            st.packets += 1
+            st.bytes += pkt.wire_len
+            st.payload_bytes += pkt.payload_len
+            st.last_ts = max(st.last_ts, pkt.ts)
+            sec = per_second[int(pkt.ts)]
+            sec["packets"] += 1
+            sec["bytes"] += pkt.wire_len
+            if pkt.is_syn:
+                st.syns += 1
+                sec["new_connections"] += 1
+            if pkt.is_fin_or_rst:
+                st.fins_rsts += 1
+    return flows, dict(per_second)
+
+
+def load_telemetry_windows(log_dir: str) -> List[dict]:
+    """Task windows from telemetry JSONL (task_received .. task_completed)."""
+    events = []
+    for path in glob.glob(os.path.join(log_dir, "*_agent_a.log")):
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                try:
+                    events.append(json.loads(line))
+                except json.JSONDecodeError:
+                    continue
+    windows: Dict[str, dict] = {}
+    for ev in events:
+        tid = ev.get("task_id")
+        if not tid:
+            continue
+        w = windows.setdefault(tid, {"task_id": tid})
+        if ev.get("event_type") == "task_received":
+            w["start_ms"] = ev.get("timestamp_ms")
+        elif ev.get("event_type") == "task_completed":
+            w["end_ms"] = ev.get("timestamp_ms")
+    return [w for w in windows.values() if "start_ms" in w and "end_ms" in w]
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("pcaps", nargs="+", help="pcap file(s) from tcpdump -w")
+    ap.add_argument("--out-dir", default="data/traffic")
+    ap.add_argument("--telemetry-dir",
+                    default=os.environ.get("TELEMETRY_LOG_DIR", "logs"))
+    args = ap.parse_args()
+
+    flows, per_second = analyze_pcap(args.pcaps)
+    os.makedirs(args.out_dir, exist_ok=True)
+
+    with open(os.path.join(args.out_dir, "flows.csv"), "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["src", "sport", "dst", "dport", "packets", "bytes",
+                    "payload_bytes", "syns", "fins_rsts", "duration_s"])
+        for (src, sport, dst, dport), st in sorted(flows.items()):
+            w.writerow([src, sport, dst, dport, st.packets, st.bytes,
+                        st.payload_bytes, st.syns, st.fins_rsts,
+                        round(st.duration_s, 6)])
+
+    with open(os.path.join(args.out_dir, "per_second.csv"), "w", newline="") as f:
+        w = csv.writer(f)
+        w.writerow(["ts", "packets", "bytes", "new_connections"])
+        for sec in sorted(per_second):
+            row = per_second[sec]
+            w.writerow([sec, row["packets"], row["bytes"],
+                        row["new_connections"]])
+
+    windows = load_telemetry_windows(args.telemetry_dir)
+    if windows:
+        with open(os.path.join(args.out_dir, "task_windows.csv"), "w",
+                  newline="") as f:
+            w = csv.writer(f)
+            w.writerow(["task_id", "start_ms", "end_ms", "bytes_in_window"])
+            for win in windows:
+                s, e = win["start_ms"] / 1000.0, win["end_ms"] / 1000.0
+                total = sum(r["bytes"] for sec, r in per_second.items()
+                            if s <= sec <= e)
+                w.writerow([win["task_id"], win["start_ms"], win["end_ms"],
+                            total])
+
+    print(f"[traffic] {len(flows)} flows, {len(per_second)} seconds, "
+          f"{len(windows)} task windows -> {args.out_dir}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Apply/remove tc netem delay/jitter/loss inside agent containers.
+# Rebuild of the reference netem hook (reference:
+# scripts/traffic/apply_network_emulation.sh:48-161). Containers need
+# NET_ADMIN (the compose files grant it to agents).
+#
+# Usage:
+#   apply_network_emulation.sh apply   [delay_ms [jitter_ms [loss_pct]]]
+#   apply_network_emulation.sh remove
+#   apply_network_emulation.sh status
+set -u
+
+ACTION="${1:-status}"
+DELAY_MS="${2:-${NETEM_DELAY_MS:-10}}"
+JITTER_MS="${3:-${NETEM_JITTER_MS:-2}}"
+LOSS_PCT="${4:-${NETEM_LOSS_PCT:-0}}"
+CONTAINERS="${NETEM_CONTAINERS:-agent-a agent-b agent-b-2 agent-b-3 agent-b-4 agent-b-5}"
+DEV="${NETEM_DEV:-eth0}"
+
+command -v docker >/dev/null 2>&1 || { echo "docker required" >&2; exit 2; }
+
+apply_netem() {  # $1 container
+  local spec="delay ${DELAY_MS}ms ${JITTER_MS}ms"
+  if [ "${LOSS_PCT%.*}" != "0" ] && [ -n "$LOSS_PCT" ] && [ "$LOSS_PCT" != "0" ]; then
+    spec="$spec loss ${LOSS_PCT}%"
+  fi
+  docker exec "$1" tc qdisc replace dev "$DEV" root netem $spec 2>/dev/null \
+    && echo "[netem] $1: $spec" \
+    || echo "[netem] $1: FAILED (running? NET_ADMIN? iproute2?)" >&2
+}
+
+for c in $CONTAINERS; do
+  docker inspect "$c" >/dev/null 2>&1 || continue
+  case "$ACTION" in
+    apply)  apply_netem "$c" ;;
+    remove) docker exec "$c" tc qdisc del dev "$DEV" root 2>/dev/null \
+              && echo "[netem] $c: removed" \
+              || echo "[netem] $c: nothing to remove" ;;
+    status) echo "[netem] $c: $(docker exec "$c" tc qdisc show dev "$DEV" 2>/dev/null || echo unreachable)" ;;
+    *) echo "unknown action $ACTION" >&2; exit 2 ;;
+  esac
+done

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Launch BCC TCP tracing tools (tcpconnect/tcplife/tcpretrans) on the host,
+# one log each (reference: scripts/traffic/collect_metrics.sh). BCC is an
+# optional host dependency; missing tools are reported and skipped.
+set -u
+OUT_DIR="${1:-data/bcc}"
+DURATION="${2:-60}"
+mkdir -p "$OUT_DIR"
+
+run_tool() {  # $1 tool name
+  local tool="$1"
+  local path
+  path="$(command -v "$tool" || command -v "/usr/share/bcc/tools/$tool" || true)"
+  if [ -z "$path" ]; then
+    echo "[bcc] $tool not installed, skipping"
+    return
+  fi
+  echo "[bcc] $tool -> $OUT_DIR/$tool.log (${DURATION}s)"
+  timeout "$DURATION" sudo "$path" > "$OUT_DIR/$tool.log" 2>&1 &
+}
+
+run_tool tcpconnect
+run_tool tcplife
+run_tool tcpretrans
+run_tool tcprtt
+wait
+echo "[bcc] done -> $OUT_DIR"

@@ -1,0 +1,62 @@
+#!/usr/bin/env bash
+# Capture inter-agent traffic to a pcap (+ optional docker stats sidecar).
+# Rebuild of the reference capture script (reference:
+# scripts/traffic/collect_traffic.sh:1-306, find_bridge_interface :104).
+#
+# Usage: collect_traffic.sh [-d seconds] [-o out_dir] [-i interface] [-s]
+set -u
+
+DURATION=60
+OUT_DIR="data/traffic"
+IFACE=""
+DOCKER_STATS=0
+
+while getopts "d:o:i:sh" opt; do
+  case "$opt" in
+    d) DURATION="$OPTARG" ;;
+    o) OUT_DIR="$OPTARG" ;;
+    i) IFACE="$OPTARG" ;;
+    s) DOCKER_STATS=1 ;;
+    h|*) grep '^#' "$0" | sed 's/^# \{0,1\}//'; exit 1 ;;
+  esac
+done
+
+find_bridge_interface() {
+  # The inter-agent compose network is named inter_agent_network; docker
+  # names its bridge br-<12-char network id>.
+  if command -v docker >/dev/null 2>&1; then
+    local net_id
+    net_id="$(docker network ls --filter name=inter_agent -q | head -1)"
+    if [ -n "$net_id" ]; then
+      echo "br-${net_id:0:12}"
+      return 0
+    fi
+  fi
+  # Fallback: first br-* interface, else any.
+  ls /sys/class/net/ 2>/dev/null | grep '^br-' | head -1 || echo any
+}
+
+[ -n "$IFACE" ] || IFACE="$(find_bridge_interface)"
+mkdir -p "$OUT_DIR"
+STAMP="$(date +%Y%m%d_%H%M%S)"
+PCAP="$OUT_DIR/capture_${STAMP}.pcap"
+
+echo "[capture] interface=$IFACE duration=${DURATION}s -> $PCAP"
+timeout "$DURATION" tcpdump -i "$IFACE" -w "$PCAP" tcp 2>/dev/null &
+TCPDUMP_PID=$!
+
+if [ "$DOCKER_STATS" = "1" ] && command -v docker >/dev/null 2>&1; then
+  STATS="$OUT_DIR/docker_stats_${STAMP}.jsonl"
+  echo "[capture] docker stats -> $STATS"
+  ( end=$((SECONDS + DURATION))
+    while [ $SECONDS -lt $end ]; do
+      docker stats --no-stream --format '{{json .}}' 2>/dev/null
+      sleep 2
+    done ) > "$STATS" &
+fi
+
+wait "$TCPDUMP_PID" 2>/dev/null || true
+
+SIZE="$(stat -c%s "$PCAP" 2>/dev/null || echo 0)"
+echo "[capture] done ($SIZE bytes)"
+echo "[capture] analyze with: python3 scripts/traffic/analyze_traffic.py $PCAP"

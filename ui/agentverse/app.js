@@ -1,0 +1,148 @@
+/* AgentVerse live client: POST /agentverse with stream:true, parse the SSE
+ * body incrementally (fetch + ReadableStream — EventSource can't POST), and
+ * render stages/events/calls. Falls back to the non-streaming JSON response
+ * when streaming fails (parity with reference streaming.js fallback). */
+
+const $ = (id) => document.getElementById(id);
+const STAGES = ["recruitment", "decision", "execution", "evaluation"];
+
+function endpointBase() {
+  const v = $("endpoint").value.trim();
+  return v ? v.replace(/\/+$/, "") : `http://${location.hostname}:8101`;
+}
+
+function setStatus(cls, text) {
+  const el = $("status");
+  el.className = `status ${cls}`;
+  el.textContent = text;
+}
+
+function resetPanels() {
+  $("stages").innerHTML = STAGES.map(
+    (s) => `<div class="stage" id="stage-${s}"><h4>${s}</h4>
+            <div class="detail">waiting…</div></div>`).join("");
+  $("events").innerHTML = "";
+  $("calls").querySelector("tbody").innerHTML = "";
+  $("final").textContent = "…";
+}
+
+function logEvent(name, payload) {
+  const div = document.createElement("div");
+  const brief = JSON.stringify(payload).slice(0, 220);
+  div.innerHTML = `<span class="evt">${name}</span> ${brief}`;
+  $("events").prepend(div);
+}
+
+function onEvent(ev) {
+  const name = ev.event;
+  logEvent(name, ev);
+  if (name === "stage_start") {
+    const el = $(`stage-${ev.stage}`);
+    if (el) { el.classList.add("active");
+              el.querySelector(".detail").textContent = "running…"; }
+  } else if (name === "stage_complete") {
+    const el = $(`stage-${ev.stage}`);
+    if (el) {
+      el.classList.remove("active");
+      el.classList.add("done");
+      const d = {...ev}; delete d.event; delete d.stage;
+      el.querySelector(".detail").textContent =
+        Object.entries(d).map(([k, v]) =>
+          `${k}: ${typeof v === "string" ? v.slice(0, 120) : JSON.stringify(v)}`
+        ).join("\n");
+    }
+  } else if (name === "llm_request" || name === "llm_error") {
+    const tr = document.createElement("tr");
+    tr.innerHTML = `<td>${ev.stage ?? ""}</td><td>${ev.iteration ?? ""}</td>
+      <td>${ev.latency_ms ?? ""}</td><td>${ev.prompt_tokens ?? ""}</td>
+      <td>${ev.completion_tokens ?? ""}</td>
+      <td>${ev.error ? "ERR" : ev.status}</td>`;
+    $("calls").querySelector("tbody").appendChild(tr);
+  } else if (name === "iteration_start") {
+    STAGES.forEach((s) => $(`stage-${s}`)?.classList.remove("done"));
+  } else if (name === "result") {
+    $("final").textContent = ev.final_output || ev.error || "(no output)";
+    setStatus(ev.error ? "error" : "done", ev.error ? "error" : "done");
+  } else if (name === "workflow_error" || name === "error") {
+    setStatus("error", "error");
+  }
+}
+
+async function runStreaming(task) {
+  const resp = await fetch(`${endpointBase()}/agentverse`, {
+    method: "POST",
+    headers: {"Content-Type": "application/json",
+              "Accept": "text/event-stream"},
+    body: JSON.stringify({task, stream: true,
+                          structure: $("structure").value}),
+  });
+  if (!resp.ok || !resp.body) throw new Error(`http ${resp.status}`);
+  const reader = resp.body.getReader();
+  const decoder = new TextDecoder();
+  let buf = "";
+  for (;;) {
+    const {done, value} = await reader.read();
+    if (done) break;
+    buf += decoder.decode(value, {stream: true});
+    let idx;
+    while ((idx = buf.indexOf("\n\n")) >= 0) {
+      const chunk = buf.slice(0, idx);
+      buf = buf.slice(idx + 2);
+      const dataLine = chunk.split("\n").find((l) => l.startsWith("data: "));
+      if (dataLine) {
+        try { onEvent(JSON.parse(dataLine.slice(6))); } catch { /* partial */ }
+      }
+    }
+  }
+}
+
+async function runFallback(task) {
+  logEvent("info", {note: "streaming unavailable, falling back to JSON"});
+  const resp = await fetch(`${endpointBase()}/agentverse`, {
+    method: "POST",
+    headers: {"Content-Type": "application/json"},
+    body: JSON.stringify({task, structure: $("structure").value}),
+  });
+  const data = await resp.json();
+  (data.llm_calls || []).forEach((c) => onEvent({event: "llm_request", ...c}));
+  onEvent({event: "result", ...data});
+}
+
+async function run() {
+  const task = $("task").value.trim();
+  if (!task) return;
+  $("runBtn").disabled = true;
+  resetPanels();
+  setStatus("running", "running");
+  try {
+    await runStreaming(task);
+  } catch (err) {
+    try { await runFallback(task); }
+    catch (err2) {
+      setStatus("error", "error");
+      logEvent("error", {error: String(err2)});
+    }
+  } finally {
+    $("runBtn").disabled = false;
+  }
+}
+
+async function loadExamples() {
+  try {
+    const resp = await fetch("../templates/agentverse_workflow.json");
+    const tmpl = await resp.json();
+    for (const t of tmpl.example_tasks || []) {
+      const opt = document.createElement("option");
+      opt.value = t.task;
+      opt.textContent = t.task_id;
+      $("example").appendChild(opt);
+    }
+  } catch { /* UI works without examples */ }
+}
+
+$("runBtn").addEventListener("click", run);
+$("task").addEventListener("keydown", (e) => { if (e.key === "Enter") run(); });
+$("example").addEventListener("change", (e) => {
+  if (e.target.value) $("task").value = e.target.value;
+});
+loadExamples();
