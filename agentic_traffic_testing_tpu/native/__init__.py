@@ -1,0 +1,253 @@
+"""ctypes bindings for the C++ runtime core (block pool / scheduler hot path).
+
+Exposes `NativeBlockAllocator` + `NativeSequenceBlocks`, drop-in replacements
+for the pure-Python pair in `runtime/block_allocator.py` (same interface,
+bit-exact free-list semantics — verified by tests/test_native.py), plus two
+batch entry points the engine uses on the per-step hot path:
+
+  * `fill_tables(seqs, width, out)` — build the [B, W] int32 block-table
+    array shipped to the TPU in ONE native call.
+  * `decode_capacity_pass(seqs, needs)` — grow every running sequence's KV
+    for the next decode step, LIFO-preempting under pressure (the policy in
+    runtime/scheduler.py::_plan_decode), in one native call.
+
+Loading policy: try the prebuilt `libatt_native.so`; if stale/missing, build
+it with g++ (one-time, ~1 s). If the toolchain is unavailable the package
+still works — callers fall back to the Python implementation. Set
+`ATT_TPU_NATIVE=0` to force the fallback.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+from typing import Optional, Sequence
+
+import numpy as np
+
+log = logging.getLogger("att_tpu.native")
+
+TRASH_BLOCK = 0
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i32, i64, vp = ctypes.c_int32, ctypes.c_int64, ctypes.c_void_p
+    pi32 = ctypes.POINTER(ctypes.c_int32)
+    pi64 = ctypes.POINTER(ctypes.c_int64)
+    pu8 = ctypes.POINTER(ctypes.c_uint8)
+    sig = {
+        "att_pool_create": ([i32, i32], vp),
+        "att_pool_destroy": ([vp], None),
+        "att_pool_free_blocks": ([vp], i32),
+        "att_pool_num_blocks": ([vp], i32),
+        "att_pool_block_size": ([vp], i32),
+        "att_pool_allocate": ([vp, i32, pi32], i32),
+        "att_pool_free": ([vp, pi32, i32], i32),
+        "att_seq_create": ([vp], i64),
+        "att_seq_release": ([vp, i64], i32),
+        "att_seq_num_blocks": ([vp, i64], i32),
+        "att_seq_ensure": ([vp, i64, i32], i32),
+        "att_seq_get_blocks": ([vp, i64, pi32, i32], i32),
+        "att_seq_table_row": ([vp, i64, i32, pi32], i32),
+        "att_fill_tables": ([vp, pi64, i32, i32, pi32], i32),
+        "att_decode_capacity_pass": ([vp, pi64, pi32, i32, pu8], i32),
+    }
+    for name, (argtypes, restype) in sig.items():
+        fn = getattr(lib, name)
+        fn.argtypes = argtypes
+        fn.restype = restype
+    return lib
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _load_attempted
+    if _lib is not None or _load_attempted:
+        return _lib
+    _load_attempted = True
+    if os.environ.get("ATT_TPU_NATIVE", "1") == "0":
+        return None
+    try:
+        from agentic_traffic_testing_tpu.native.build import build
+
+        _lib = _bind(ctypes.CDLL(build()))
+    except Exception as exc:  # no toolchain / sandboxed build: Python fallback
+        log.warning("native runtime core unavailable (%s); using Python fallback", exc)
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return load() is not None
+
+
+def _as_i32_ptr(arr: np.ndarray) -> "ctypes.POINTER(ctypes.c_int32)":
+    return arr.ctypes.data_as(ctypes.POINTER(ctypes.c_int32))
+
+
+class NativeSequenceBlocks:
+    """Block-table bookkeeping for one sequence, backed by the C++ pool."""
+
+    __slots__ = ("_alloc", "_sid", "_released", "_num_blocks")
+
+    def __init__(self, allocator: "NativeBlockAllocator") -> None:
+        self._alloc = allocator
+        self._sid = allocator._lib.att_seq_create(allocator._h)
+        self._released = False
+        self._num_blocks = 0  # host-side mirror; avoids an FFI call per len()
+
+    @property
+    def blocks(self) -> list[int]:
+        if self._released:
+            return []
+        out = np.empty((max(1, self._num_blocks),), np.int32)
+        n = self._alloc._lib.att_seq_get_blocks(
+            self._alloc._h, self._sid, _as_i32_ptr(out), out.shape[0]
+        )
+        return [] if n <= 0 else out[:n].tolist()
+
+    @property
+    def num_blocks(self) -> int:
+        return 0 if self._released else self._num_blocks
+
+    @property
+    def capacity_tokens(self) -> int:
+        return self.num_blocks * self._alloc.block_size
+
+    def ensure_capacity(self, num_tokens: int) -> bool:
+        if self._released:
+            raise RuntimeError("sequence already released")
+        ok = self._alloc._lib.att_seq_ensure(self._alloc._h, self._sid, num_tokens)
+        if ok < 0:
+            raise RuntimeError(f"unknown native sequence {self._sid}")
+        if ok == 1:
+            self._num_blocks = max(
+                self._num_blocks, self._alloc.blocks_needed(num_tokens)
+            )
+        return ok == 1
+
+    def release(self) -> None:
+        if not self._released:
+            self._alloc._lib.att_seq_release(self._alloc._h, self._sid)
+            self._mark_released()
+
+    def _mark_released(self) -> None:
+        """Native side already freed the blocks (e.g. preemption pass)."""
+        self._released = True
+        self._num_blocks = 0
+
+    def table_row(self, width: int) -> list[int]:
+        out = np.empty((width,), np.int32)
+        if self._released:
+            out[:] = TRASH_BLOCK
+        else:
+            rc = self._alloc._lib.att_seq_table_row(
+                self._alloc._h, self._sid, width, _as_i32_ptr(out)
+            )
+            if rc != 0:
+                raise RuntimeError(f"unknown native sequence {self._sid}")
+        return out.tolist()
+
+
+class NativeBlockAllocator:
+    """Drop-in for runtime.block_allocator.BlockAllocator, C++-backed."""
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        if num_blocks < 2:
+            raise ValueError(f"need >= 2 blocks (1 usable + trash), got {num_blocks}")
+        lib = load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self._lib = lib
+        self._h = lib.att_pool_create(num_blocks, block_size)
+        if not self._h:
+            raise ValueError(f"invalid pool config ({num_blocks}, {block_size})")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+
+    def __del__(self) -> None:
+        h = getattr(self, "_h", None)
+        if h:
+            self._lib.att_pool_destroy(h)
+            self._h = None
+
+    @property
+    def num_free_blocks(self) -> int:
+        return self._lib.att_pool_free_blocks(self._h)
+
+    @property
+    def num_used_blocks(self) -> int:
+        return (self.num_blocks - 1) - self.num_free_blocks
+
+    @property
+    def usable_tokens(self) -> int:
+        return (self.num_blocks - 1) * self.block_size
+
+    def blocks_needed(self, num_tokens: int) -> int:
+        return -(-num_tokens // self.block_size)
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= self.num_free_blocks
+
+    def allocate(self, n: int) -> Optional[list[int]]:
+        out = np.empty((max(1, n),), np.int32)
+        got = self._lib.att_pool_allocate(self._h, n, _as_i32_ptr(out))
+        if got < 0:
+            return None
+        return out[:got].tolist()
+
+    def free(self, blocks: list[int]) -> None:
+        arr = np.asarray(blocks, np.int32)
+        rc = self._lib.att_pool_free(self._h, _as_i32_ptr(arr), len(blocks))
+        if rc == -1:
+            raise ValueError("freeing invalid block id")
+        if rc == -2:
+            raise RuntimeError("double free detected: free list exceeds capacity")
+
+    # -- engine/scheduler hot-path entry points ----------------------------
+
+    def new_sequence(self) -> NativeSequenceBlocks:
+        return NativeSequenceBlocks(self)
+
+    def fill_tables(
+        self, seqs: Sequence[NativeSequenceBlocks], width: int, out: np.ndarray
+    ) -> None:
+        """Fill the row-major [len(seqs), width] int32 array in one call."""
+        assert out.dtype == np.int32 and out.flags["C_CONTIGUOUS"]
+        sids = np.asarray([s._sid for s in seqs], np.int64)
+        rc = self._lib.att_fill_tables(
+            self._h,
+            sids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(seqs), width, _as_i32_ptr(out),
+        )
+        if rc != 0:
+            raise RuntimeError("fill_tables: unknown native sequence")
+
+    def decode_capacity_pass(
+        self, seqs: Sequence[NativeSequenceBlocks], needs: Sequence[int]
+    ) -> list[bool]:
+        """Grow each sequence (oldest first) to needs[i] tokens; LIFO-preempt
+        under pressure. Returns keep flags; preempted sequences are released
+        natively and marked so their Python wrappers become inert."""
+        n = len(seqs)
+        sids = np.asarray([s._sid for s in seqs], np.int64)
+        needs_arr = np.asarray(needs, np.int32)
+        keep = np.zeros((n,), np.uint8)
+        rc = self._lib.att_decode_capacity_pass(
+            self._h,
+            sids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            _as_i32_ptr(needs_arr), n,
+            keep.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        )
+        if rc != 0:
+            raise RuntimeError("decode_capacity_pass: unknown native sequence")
+        for s, k, need in zip(seqs, keep, needs_arr):
+            if not k:
+                s._mark_released()
+            else:
+                s._num_blocks = max(s._num_blocks, self.blocks_needed(int(need)))
+        return [bool(k) for k in keep]

@@ -1,0 +1,43 @@
+"""Build the native runtime core (`libatt_native.so`) with the system g++.
+
+Invoked automatically on first import of `agentic_traffic_testing_tpu.native`
+(a one-time ~1 s compile, cached next to the source), or explicitly:
+
+    python -m agentic_traffic_testing_tpu.native.build
+
+No external build deps: plain g++ -O2 -shared -fPIC. The library has no
+third-party includes, so this works on any host with a C++17 toolchain.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+SRC = os.path.join(_HERE, "src", "att_native.cpp")
+LIB = os.path.join(_HERE, "libatt_native.so")
+
+
+def needs_build() -> bool:
+    if not os.path.exists(LIB):
+        return True
+    return os.path.getmtime(SRC) > os.path.getmtime(LIB)
+
+
+def build(verbose: bool = False) -> str:
+    """Compile if stale; returns the .so path. Raises on compiler failure."""
+    if not needs_build():
+        return LIB
+    cxx = os.environ.get("CXX", "g++")
+    cmd = [cxx, "-O2", "-shared", "-fPIC", "-std=c++17", "-o", LIB, SRC]
+    if verbose:
+        print("[native] " + " ".join(cmd), file=sys.stderr)
+    subprocess.run(cmd, check=True, capture_output=not verbose)
+    return LIB
+
+
+if __name__ == "__main__":
+    build(verbose=True)
+    print(LIB)

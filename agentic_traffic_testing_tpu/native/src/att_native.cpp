@@ -1,0 +1,218 @@
+// Native runtime core: paged-KV block pool + sequence block tables +
+// the per-decode-step capacity/preemption pass.
+//
+// This is the host-side hot path of the continuous-batching engine: every
+// decode step grows each running sequence's block table, rebuilds the
+// [batch, width] int32 block-table array shipped to the TPU, and (under KV
+// pressure) picks LIFO preemption victims. The reference delegates all of
+// this to vLLM's C++/CUDA engine internals (reference: llm/serve_llm.py's
+// AsyncEngineArgs / cache_config reads); here it is a first-party library.
+//
+// Semantics are BIT-EXACT with the pure-Python fallback in
+// runtime/block_allocator.py and runtime/scheduler.py::_plan_decode —
+// including free-list ordering — so the two paths are interchangeable and
+// cross-checked by tests/test_native.py.
+//
+// Build: g++ -O2 -shared -fPIC -std=c++17 (see ../build.py). No external
+// dependencies; the Python side binds via ctypes.
+
+#include <cstddef>
+#include <cstdint>
+#include <unordered_map>
+#include <vector>
+
+using std::size_t;
+
+namespace {
+
+constexpr int32_t kTrashBlock = 0;  // block 0 is the shared padding sink
+
+struct Pool {
+  int32_t num_blocks = 0;
+  int32_t block_size = 0;
+  // Free list mirrors the Python fallback exactly: initialized to
+  // [num_blocks-1, ..., 1]; allocate(n) takes the LAST n in list order;
+  // free(blocks) appends in argument order.
+  std::vector<int32_t> free_list;
+  std::unordered_map<int64_t, std::vector<int32_t>> seqs;
+  int64_t next_sid = 1;
+};
+
+int32_t blocks_needed(const Pool& p, int32_t num_tokens) {
+  return (num_tokens + p.block_size - 1) / p.block_size;
+}
+
+// Allocate n blocks (all-or-nothing), appending to `out` in Python order.
+bool alloc_into(Pool& p, int32_t n, std::vector<int32_t>& out) {
+  if (n > static_cast<int32_t>(p.free_list.size())) return false;
+  const size_t start = p.free_list.size() - static_cast<size_t>(n);
+  out.insert(out.end(), p.free_list.begin() + start, p.free_list.end());
+  p.free_list.resize(start);
+  return true;
+}
+
+void free_blocks(Pool& p, const std::vector<int32_t>& blocks) {
+  p.free_list.insert(p.free_list.end(), blocks.begin(), blocks.end());
+}
+
+bool seq_ensure(Pool& p, std::vector<int32_t>& blocks, int32_t num_tokens) {
+  const int32_t need = blocks_needed(p, num_tokens) -
+                       static_cast<int32_t>(blocks.size());
+  if (need <= 0) return true;
+  return alloc_into(p, need, blocks);
+}
+
+}  // namespace
+
+extern "C" {
+
+void* att_pool_create(int32_t num_blocks, int32_t block_size) {
+  if (num_blocks < 2 || block_size < 1) return nullptr;
+  Pool* p = new Pool;
+  p->num_blocks = num_blocks;
+  p->block_size = block_size;
+  p->free_list.reserve(static_cast<size_t>(num_blocks) - 1);
+  for (int32_t b = num_blocks - 1; b > kTrashBlock; --b)
+    p->free_list.push_back(b);
+  return p;
+}
+
+void att_pool_destroy(void* h) { delete static_cast<Pool*>(h); }
+
+int32_t att_pool_free_blocks(void* h) {
+  return static_cast<int32_t>(static_cast<Pool*>(h)->free_list.size());
+}
+
+int32_t att_pool_num_blocks(void* h) {
+  return static_cast<Pool*>(h)->num_blocks;
+}
+
+int32_t att_pool_block_size(void* h) {
+  return static_cast<Pool*>(h)->block_size;
+}
+
+// Raw pool ops (used by the allocator-compatible wrapper).
+// Returns number of blocks written to out (n on success, -1 on failure).
+int32_t att_pool_allocate(void* h, int32_t n, int32_t* out) {
+  Pool& p = *static_cast<Pool*>(h);
+  std::vector<int32_t> got;
+  if (!alloc_into(p, n, got)) return -1;
+  for (size_t i = 0; i < got.size(); ++i) out[i] = got[i];
+  return static_cast<int32_t>(got.size());
+}
+
+// Returns 0 on success; -1 on invalid id; -2 on double-free overflow.
+int32_t att_pool_free(void* h, const int32_t* blocks, int32_t n) {
+  Pool& p = *static_cast<Pool*>(h);
+  for (int32_t i = 0; i < n; ++i)
+    if (blocks[i] <= kTrashBlock || blocks[i] >= p.num_blocks) return -1;
+  p.free_list.insert(p.free_list.end(), blocks, blocks + n);
+  if (p.free_list.size() > static_cast<size_t>(p.num_blocks) - 1) return -2;
+  return 0;
+}
+
+// -- sequences -------------------------------------------------------------
+
+int64_t att_seq_create(void* h) {
+  Pool& p = *static_cast<Pool*>(h);
+  const int64_t sid = p.next_sid++;
+  p.seqs.emplace(sid, std::vector<int32_t>{});
+  return sid;
+}
+
+// Free the sequence's blocks and delete it. Idempotent via the map lookup.
+int32_t att_seq_release(void* h, int64_t sid) {
+  Pool& p = *static_cast<Pool*>(h);
+  auto it = p.seqs.find(sid);
+  if (it == p.seqs.end()) return -1;
+  free_blocks(p, it->second);
+  p.seqs.erase(it);
+  return 0;
+}
+
+int32_t att_seq_num_blocks(void* h, int64_t sid) {
+  Pool& p = *static_cast<Pool*>(h);
+  auto it = p.seqs.find(sid);
+  if (it == p.seqs.end()) return -1;
+  return static_cast<int32_t>(it->second.size());
+}
+
+// Grow to hold num_tokens. 1 = ok, 0 = no room (state unchanged), -1 = bad sid.
+int32_t att_seq_ensure(void* h, int64_t sid, int32_t num_tokens) {
+  Pool& p = *static_cast<Pool*>(h);
+  auto it = p.seqs.find(sid);
+  if (it == p.seqs.end()) return -1;
+  return seq_ensure(p, it->second, num_tokens) ? 1 : 0;
+}
+
+// Copy block ids into out (capacity cap); returns count or -1.
+int32_t att_seq_get_blocks(void* h, int64_t sid, int32_t* out, int32_t cap) {
+  Pool& p = *static_cast<Pool*>(h);
+  auto it = p.seqs.find(sid);
+  if (it == p.seqs.end()) return -1;
+  const auto& blocks = it->second;
+  const int32_t n = static_cast<int32_t>(blocks.size());
+  for (int32_t i = 0; i < n && i < cap; ++i) out[i] = blocks[i];
+  return n;
+}
+
+// Fixed-width table row padded with the trash block.
+int32_t att_seq_table_row(void* h, int64_t sid, int32_t width, int32_t* out) {
+  Pool& p = *static_cast<Pool*>(h);
+  auto it = p.seqs.find(sid);
+  if (it == p.seqs.end()) return -1;
+  const auto& blocks = it->second;
+  const int32_t n = static_cast<int32_t>(blocks.size());
+  int32_t i = 0;
+  for (; i < n && i < width; ++i) out[i] = blocks[i];
+  for (; i < width; ++i) out[i] = kTrashBlock;
+  return 0;
+}
+
+// Batched row fill: out is a row-major [n, width] int32 buffer. One call per
+// device step instead of n Python-level row builds.
+int32_t att_fill_tables(void* h, const int64_t* sids, int32_t n, int32_t width,
+                        int32_t* out) {
+  for (int32_t i = 0; i < n; ++i)
+    if (att_seq_table_row(h, sids[i], width, out + static_cast<int64_t>(i) * width) != 0)
+      return -1;
+  return 0;
+}
+
+// -- decode capacity / preemption pass --------------------------------------
+//
+// Sequences are given OLDEST-FIRST (arrival order). For each still-running
+// sequence, grow its KV to needs[i]; under pressure, evict the YOUNGEST
+// still-running other sequence (LIFO — vLLM's policy, protects the oldest
+// requests' latency). A preempted sequence's blocks are freed and the
+// sequence is deleted; out_keep[i] = 1 kept, 0 preempted.
+// Mirrors runtime/scheduler.py::Scheduler._plan_decode exactly.
+int32_t att_decode_capacity_pass(void* h, const int64_t* sids,
+                                 const int32_t* needs, int32_t n,
+                                 uint8_t* out_keep) {
+  Pool& p = *static_cast<Pool*>(h);
+  for (int32_t i = 0; i < n; ++i) {
+    auto it = p.seqs.find(sids[i]);
+    if (it == p.seqs.end()) return -1;
+    out_keep[i] = 1;
+  }
+  for (int32_t i = 0; i < n; ++i) {
+    if (!out_keep[i]) continue;  // already evicted as a victim
+    auto& blocks = p.seqs.find(sids[i])->second;
+    while (!seq_ensure(p, blocks, needs[i])) {
+      int32_t victim = -1;
+      for (int32_t j = n - 1; j >= 0; --j)  // youngest still-kept, not self
+        if (j != i && out_keep[j]) { victim = j; break; }
+      if (victim < 0) {
+        att_seq_release(h, sids[i]);  // nothing to evict: preempt self
+        out_keep[i] = 0;
+        break;
+      }
+      att_seq_release(h, sids[victim]);
+      out_keep[victim] = 0;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -67,6 +67,9 @@ class BlockAllocator:
         if len(self._free) > self.num_blocks - 1:
             raise RuntimeError("double free detected: free list exceeds capacity")
 
+    def new_sequence(self) -> "SequenceBlocks":
+        return SequenceBlocks(self)
+
 
 class SequenceBlocks:
     """Block-table bookkeeping for one sequence."""
@@ -74,6 +77,10 @@ class SequenceBlocks:
     def __init__(self, allocator: BlockAllocator) -> None:
         self._alloc = allocator
         self.blocks: list[int] = []
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.blocks)
 
     @property
     def capacity_tokens(self) -> int:
@@ -99,3 +106,23 @@ class SequenceBlocks:
         """Fixed-width block-table row, padded with the trash block."""
         row = self.blocks[:width] + [TRASH_BLOCK] * max(0, width - len(self.blocks))
         return row
+
+
+def make_block_allocator(num_blocks: int, block_size: int, native: Optional[bool] = None):
+    """Allocator factory: C++ core when available, Python fallback otherwise.
+
+    `native=None` (default) auto-selects: the `native/` C++ library if it
+    loads (honoring ATT_TPU_NATIVE=0), else this module's pure-Python
+    implementation. Both are bit-exact interchangeable (tests/test_native.py).
+    """
+    if native is not False:
+        try:
+            from agentic_traffic_testing_tpu import native as native_mod
+
+            if native_mod.available():
+                return native_mod.NativeBlockAllocator(num_blocks, block_size)
+        except (ImportError, RuntimeError):
+            pass
+        if native is True:
+            raise RuntimeError("native block allocator requested but unavailable")
+    return BlockAllocator(num_blocks, block_size)

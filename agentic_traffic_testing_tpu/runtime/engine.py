@@ -39,7 +39,7 @@ import numpy as np
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig, resolve_config
 from agentic_traffic_testing_tpu.models.llama import init_params
-from agentic_traffic_testing_tpu.runtime.block_allocator import BlockAllocator
+from agentic_traffic_testing_tpu.runtime.block_allocator import make_block_allocator
 from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK, make_kv_cache
 from agentic_traffic_testing_tpu.runtime.request import (
     FinishReason,
@@ -77,6 +77,9 @@ class EngineConfig:
     memory_utilization: float = 0.90       # LLM_GPU_MEMORY_UTILIZATION analog
     pipeline_depth: int = 2                # decode steps in flight before readback
     seed: int = 0
+    # None = auto (C++ native/ core if it builds, Python otherwise);
+    # True/False force one implementation.
+    native_allocator: Optional[bool] = None
 
     def scheduler_config(self) -> SchedulerConfig:
         return SchedulerConfig(
@@ -132,7 +135,8 @@ class LLMEngine:
         self.cache = self.runner.prepare_cache(
             make_kv_cache(self.model_cfg, num_blocks, cfg.block_size, dtype)
         )
-        self.allocator = BlockAllocator(num_blocks, cfg.block_size)
+        self.allocator = make_block_allocator(num_blocks, cfg.block_size,
+                                              native=cfg.native_allocator)
         self.scheduler = Scheduler(cfg.scheduler_config(), self.allocator)
         # Fixed block-table width: worst-case blocks for max_model_len.
         self.table_width = -(-cfg.max_model_len // cfg.block_size)
@@ -242,6 +246,19 @@ class LLMEngine:
             self._new_tokens.setdefault(req.request_id, [])
         self.scheduler.failed.clear()
 
+    def _fill_tables(self, reqs: list[Request], tables: np.ndarray) -> None:
+        """Build block-table rows for reqs into tables[:len(reqs)].
+
+        One native call when the C++ core backs the allocator; otherwise a
+        Python row loop. Rows beyond len(reqs) stay trash-padded.
+        """
+        fill = getattr(self.allocator, "fill_tables", None)
+        if fill is not None and reqs:
+            fill([r.blocks for r in reqs], self.table_width, tables[: len(reqs)])
+        else:
+            for i, r in enumerate(reqs):
+                tables[i] = r.blocks.table_row(self.table_width)
+
     # -- prefill -----------------------------------------------------------
 
     def _run_prefill(self, plan: PrefillBatch) -> None:
@@ -254,8 +271,8 @@ class LLMEngine:
         for i, r in enumerate(reqs):
             tokens[i, : r.num_prompt_tokens] = r.prompt_ids
             seq_lens[i] = r.num_prompt_tokens
-            tables[i] = r.blocks.table_row(self.table_width)
             steps[i] = r.sampling_step
+        self._fill_tables(reqs, tables)
         samp = self._sampling_arrays(reqs, b)
         state, self.cache, out = self.runner.prefill(
             jnp.asarray(tokens), self.cache, jnp.asarray(tables),
@@ -285,7 +302,7 @@ class LLMEngine:
             tokens[i] = last
             positions[i] = r.total_len - 1
             steps[i] = r.sampling_step
-            tables[i] = r.blocks.table_row(self.table_width)
+        self._fill_tables(reqs, tables)
         self._decode_requests = list(reqs)
         self._decode_state = DecodeState(
             tokens=jnp.asarray(tokens),
@@ -294,7 +311,7 @@ class LLMEngine:
         )
         self._decode_tables = jnp.asarray(tables)
         self._decode_samp = self._sampling_arrays(reqs, b)
-        self._decode_block_counts = [len(r.blocks.blocks) for r in reqs]
+        self._decode_block_counts = [r.blocks.num_blocks for r in reqs]
 
     def _refresh_decode_tables(self) -> None:
         """Re-upload block tables if any sequence grew into new blocks.
@@ -304,13 +321,12 @@ class LLMEngine:
         block boundary mid-decode would silently write its KV into the trash
         block (stale table row) and corrupt its own continuation.
         """
-        counts = [len(r.blocks.blocks) for r in self._decode_requests]
+        counts = [r.blocks.num_blocks for r in self._decode_requests]
         if counts == self._decode_block_counts:
             return
         b = self._decode_tables.shape[0]
         tables = np.full((b, self.table_width), TRASH_BLOCK, np.int32)
-        for i, r in enumerate(self._decode_requests):
-            tables[i] = r.blocks.table_row(self.table_width)
+        self._fill_tables(self._decode_requests, tables)
         self._decode_tables = jnp.asarray(tables)
         self._decode_block_counts = counts
 

@@ -22,7 +22,7 @@ import collections
 from dataclasses import dataclass, field
 from typing import Optional, Union
 
-from agentic_traffic_testing_tpu.runtime.block_allocator import BlockAllocator, SequenceBlocks
+from agentic_traffic_testing_tpu.runtime.block_allocator import BlockAllocator
 from agentic_traffic_testing_tpu.runtime.request import Request, RequestState
 
 
@@ -186,8 +186,12 @@ class Scheduler:
                 break
             # All-or-nothing KV allocation: prompt + lookahead headroom.
             need_tokens = req.num_prompt_tokens + self.cfg.decode_lookahead
-            blocks = SequenceBlocks(self.allocator)
+            blocks = self.allocator.new_sequence()
             if not blocks.ensure_capacity(need_tokens):
+                # Unregister the empty sequence: the native allocator tracks
+                # it C++-side until released, so dropping the wrapper without
+                # this would leak one registry entry per failed admission.
+                blocks.release()
                 if not self.running and not batch:
                     # The pool is completely idle and the head still cannot
                     # fit (e.g. a preempted prompt grew past pool capacity):
@@ -221,6 +225,26 @@ class Scheduler:
         # Grow each sequence's KV capacity for this step (+ lookahead).
         # Victims are chosen LIFO (youngest arrival) — vLLM's policy, which
         # protects the oldest requests' latency.
+        native_pass = getattr(self.allocator, "decode_capacity_pass", None)
+        if native_pass is not None:
+            # One C++ call does the whole grow/evict pass (native/ core);
+            # preempted wrappers come back released, so _preempt's release
+            # is a no-op and only the queue bookkeeping runs here.
+            ordered = sorted(self.running, key=lambda r: r.arrival_time)
+            needs = [r.total_len + 1 + self.cfg.decode_lookahead for r in ordered]
+            keep = native_pass([r.blocks for r in ordered], needs)
+            # Requeue victims youngest-first (the order LIFO eviction picks
+            # them), matching the fallback loop's appendleft sequence.
+            for req, kept in reversed(list(zip(ordered, keep))):
+                if not kept:
+                    self._preempt(req)
+            self.running = [r for r, k in zip(ordered, keep) if k]
+            if not self.running:
+                return None
+            return DecodeBatch(
+                requests=list(self.running),
+                padded_batch=bucket_up(len(self.running), self.cfg.batch_buckets),
+            )
         survivors: list[Request] = []
         for req in sorted(self.running, key=lambda r: r.arrival_time):
             if req.state is not RequestState.RUNNING:

@@ -21,7 +21,8 @@ command -v docker >/dev/null 2>&1 || { echo "docker required" >&2; exit 2; }
 
 apply_netem() {  # $1 container
   local spec="delay ${DELAY_MS}ms ${JITTER_MS}ms"
-  if [ "${LOSS_PCT%.*}" != "0" ] && [ -n "$LOSS_PCT" ] && [ "$LOSS_PCT" != "0" ]; then
+  # awk comparison keeps fractional rates (e.g. 0.5) — string/integer tests drop them
+  if [ -n "$LOSS_PCT" ] && awk "BEGIN{exit !($LOSS_PCT > 0)}" 2>/dev/null; then
     spec="$spec loss ${LOSS_PCT}%"
   fi
   docker exec "$1" tc qdisc replace dev "$DEV" root netem $spec 2>/dev/null \

@@ -175,3 +175,25 @@ def test_more_requests_than_max_num_seqs(runner):
     run_all(eng, reqs)
     for r in reqs:
         assert len(r.generated_ids) == 5
+
+
+def test_native_allocator_engine_parity(runner):
+    """End-to-end generation identical under the C++ and Python allocators."""
+    from agentic_traffic_testing_tpu import native as native_mod
+
+    if not native_mod.available():
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, CFG.vocab_size, n).tolist() for n in (6, 13, 21)]
+
+    outs = {}
+    for use_native in (False, True):
+        # Small pool forces block growth + preemption machinery through
+        # whichever allocator backs the run.
+        eng = make_engine(runner, num_blocks=24, native_allocator=use_native)
+        reqs = [eng.add_request(p, greedy(16)) for p in prompts]
+        run_all(eng, reqs)
+        outs[use_native] = [r.generated_ids for r in reqs]
+        kind = type(eng.allocator).__name__
+        assert ("Native" in kind) == use_native, kind
+    assert outs[False] == outs[True]
