@@ -27,14 +27,25 @@ def needs_build() -> bool:
 
 
 def build(verbose: bool = False) -> str:
-    """Compile if stale; returns the .so path. Raises on compiler failure."""
+    """Compile if stale; returns the .so path. Raises on compiler failure.
+
+    Compiles to a temp path and os.replace()s into place: atomic for readers
+    (a concurrent dlopen sees old or new, never half-written) and never
+    rewrites the inode a live process has mapped.
+    """
     if not needs_build():
         return LIB
     cxx = os.environ.get("CXX", "g++")
-    cmd = [cxx, "-O2", "-shared", "-fPIC", "-std=c++17", "-o", LIB, SRC]
+    tmp = f"{LIB}.{os.getpid()}.tmp"
+    cmd = [cxx, "-O2", "-shared", "-fPIC", "-std=c++17", "-o", tmp, SRC]
     if verbose:
         print("[native] " + " ".join(cmd), file=sys.stderr)
-    subprocess.run(cmd, check=True, capture_output=not verbose)
+    try:
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+        os.replace(tmp, LIB)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
     return LIB
 
 

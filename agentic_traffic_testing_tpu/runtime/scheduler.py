@@ -225,12 +225,12 @@ class Scheduler:
         # Grow each sequence's KV capacity for this step (+ lookahead).
         # Victims are chosen LIFO (youngest arrival) — vLLM's policy, which
         # protects the oldest requests' latency.
+        ordered = sorted(self.running, key=lambda r: r.arrival_time)
         native_pass = getattr(self.allocator, "decode_capacity_pass", None)
         if native_pass is not None:
             # One C++ call does the whole grow/evict pass (native/ core);
             # preempted wrappers come back released, so _preempt's release
             # is a no-op and only the queue bookkeeping runs here.
-            ordered = sorted(self.running, key=lambda r: r.arrival_time)
             needs = [r.total_len + 1 + self.cfg.decode_lookahead for r in ordered]
             keep = native_pass([r.blocks for r in ordered], needs)
             # Requeue victims youngest-first (the order LIFO eviction picks
@@ -238,28 +238,23 @@ class Scheduler:
             for req, kept in reversed(list(zip(ordered, keep))):
                 if not kept:
                     self._preempt(req)
-            self.running = [r for r, k in zip(ordered, keep) if k]
-            if not self.running:
-                return None
-            return DecodeBatch(
-                requests=list(self.running),
-                padded_batch=bucket_up(len(self.running), self.cfg.batch_buckets),
-            )
-        survivors: list[Request] = []
-        for req in sorted(self.running, key=lambda r: r.arrival_time):
-            if req.state is not RequestState.RUNNING:
-                continue  # already preempted as a victim earlier in this pass
-            while not self._ensure_decode_capacity(req):
-                victim = self._pick_victim(exclude=req)
-                if victim is None:
-                    # Nothing left to evict; this request itself must wait.
-                    self._preempt(req)
-                    req = None
-                    break
-                self._preempt(victim)
-                survivors = [r for r in survivors if r.state == RequestState.RUNNING]
-            if req is not None and req.state == RequestState.RUNNING:
-                survivors.append(req)
+            survivors = [r for r, k in zip(ordered, keep) if k]
+        else:
+            survivors = []
+            for req in ordered:
+                if req.state is not RequestState.RUNNING:
+                    continue  # already preempted as a victim earlier in this pass
+                while not self._ensure_decode_capacity(req):
+                    victim = self._pick_victim(ordered, exclude=req)
+                    if victim is None:
+                        # Nothing left to evict; this request itself must wait.
+                        self._preempt(req)
+                        req = None
+                        break
+                    self._preempt(victim)
+                    survivors = [r for r in survivors if r.state == RequestState.RUNNING]
+                if req is not None and req.state == RequestState.RUNNING:
+                    survivors.append(req)
         self.running = survivors
         if not self.running:
             return None
@@ -272,11 +267,14 @@ class Scheduler:
         assert req.blocks is not None
         return req.blocks.ensure_capacity(req.total_len + 1 + self.cfg.decode_lookahead)
 
-    def _pick_victim(self, exclude: Request) -> Optional[Request]:
-        cands = [r for r in self.running if r is not exclude and r.state == RequestState.RUNNING]
-        if not cands:
-            return None
-        return max(cands, key=lambda r: r.arrival_time)
+    def _pick_victim(self, ordered: list[Request], exclude: Request) -> Optional[Request]:
+        """Youngest still-running other request. Scans the arrival-sorted list
+        from the back so equal arrival_times break the same way as the C++
+        pass (last index wins) — keeps the two paths trace-identical."""
+        for r in reversed(ordered):
+            if r is not exclude and r.state == RequestState.RUNNING:
+                return r
+        return None
 
     def _preempt(self, req: Request) -> None:
         """Evict to the waiting queue; its KV is recomputed on re-admission."""

@@ -1,0 +1,183 @@
+"""CPU fallback LLM server — the no-TPU drop-in for the `/chat` contract.
+
+The analog of the reference's `llm/hf_cpu_server.py` (reference:
+llm/hf_cpu_server.py:34-94): a minimal threading HTTP server that answers
+`POST /chat|/generate|/completion` with `{"output": ...}` using a
+torch/transformers CPU pipeline, so every agent, script, and experiment runs
+on a machine with no accelerator at all. Differences from the reference:
+
+  * `LLM_MODEL=tiny` (default) builds a tiny random-weight Llama-class model
+    in-process instead of pulling from the HF hub — CI and air-gapped hosts
+    need no network. Any other value is treated as a HF model id/path.
+  * Responses include the same `meta` block the main TPU backend returns
+    (request_id, latency_ms, token counts), so clients that read meta fields
+    (agents/common/llm_client.py) work identically against either backend.
+  * `GET /health|/ready|/live` respond 200 so compose healthchecks and
+    `wait_for_llm` gating work unchanged (reference: scripts/deploy/deploy.sh).
+
+Run: `python -m agentic_traffic_testing_tpu.serving.cpu_server`
+Env: LLM_MODEL, LLM_MAX_TOKENS, HOST/LLM_HOST, PORT/LLM_PORT.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+def _build_tiny():
+    """Local random-weight Llama-class model: offline-friendly test backend."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from agentic_traffic_testing_tpu.utils.tokenizer import load_tokenizer
+
+    torch.manual_seed(0)
+    cfg = LlamaConfig(
+        vocab_size=512, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=512,
+    )
+    model = LlamaForCausalLM(cfg).eval()
+    byte_tok = load_tokenizer("byte-fallback")
+
+    class TinyPipe:
+        """pipeline()-shaped wrapper over the byte tokenizer + tiny model."""
+
+        def __call__(self, prompt: str, max_new_tokens: int = 16, **_):
+            ids = byte_tok.encode(prompt, add_bos=True)[-256:]
+            inp = torch.tensor([ids], dtype=torch.long)
+            with torch.no_grad():
+                out = model.generate(
+                    inp, max_new_tokens=max_new_tokens, do_sample=False,
+                    pad_token_id=0,
+                )
+            text = byte_tok.decode(out[0, len(ids):].tolist())
+            return [{"generated_text": prompt + text,
+                     "prompt_tokens": len(ids),
+                     "completion_tokens": int(out.shape[1]) - len(ids)}]
+
+    return TinyPipe()
+
+
+def _build_hf(model_name: str):
+    import torch
+    from transformers import AutoModelForCausalLM, AutoTokenizer, pipeline
+
+    token = os.environ.get("HF_TOKEN") or os.environ.get("HUGGINGFACE_HUB_TOKEN")
+    tok = AutoTokenizer.from_pretrained(model_name, token=token)
+    model = AutoModelForCausalLM.from_pretrained(
+        model_name, torch_dtype=torch.float32, token=token
+    )
+    return pipeline("text-generation", model=model, tokenizer=tok, device=-1)
+
+
+_pipe = None
+_pipe_lock = threading.Lock()
+
+
+def get_pipeline():
+    global _pipe
+    with _pipe_lock:
+        if _pipe is None:
+            model = os.environ.get("LLM_MODEL") or os.environ.get("MODEL_NAME", "tiny")
+            _pipe = _build_tiny() if model in ("tiny", "debug-512") else _build_hf(model)
+        return _pipe
+
+
+class CPUFallbackHandler(BaseHTTPRequestHandler):
+    server_version = "att-tpu-cpu-fallback"
+
+    def log_message(self, fmt, *args):  # quiet unless asked
+        if os.environ.get("LOG_LLM_REQUESTS", "0") == "1":
+            super().log_message(fmt, *args)
+
+    def _json(self, status: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:
+        if self.path in ("/health", "/ready", "/live"):
+            self._json(200, {"status": "ok", "backend": "cpu-fallback"})
+        else:
+            self._json(404, {"error": "Not found"})
+
+    def do_POST(self) -> None:
+        if self.path not in ("/chat", "/generate", "/completion"):
+            self._json(404, {"error": "Not found"})
+            return
+        n = int(self.headers.get("Content-Length", "0") or 0)
+        try:
+            data = json.loads(self.rfile.read(n).decode() or "{}")
+        except json.JSONDecodeError:
+            self._json(400, {"error": "Invalid JSON"})
+            return
+        prompt = data.get("prompt") or data.get("input")
+        if not isinstance(prompt, str) or not prompt:
+            self._json(400, {"error": "Missing 'prompt' field"})
+            return
+        default_max = int(os.environ.get("LLM_MAX_TOKENS", "512"))
+        raw_max = data.get("max_tokens", data.get("max_new_tokens"))
+        try:
+            # Explicit 0 is honored (generate nothing); only absent/invalid
+            # values fall back to the default.
+            max_tokens = default_max if raw_max is None else max(0, int(raw_max))
+        except (TypeError, ValueError):
+            max_tokens = default_max
+        request_id = (data.get("request_id") or self.headers.get("X-Request-ID")
+                      or uuid.uuid4().hex[:8])
+
+        if max_tokens == 0:
+            self._json(200, {"output": "", "meta": {
+                "request_id": request_id, "latency_ms": 0, "queue_wait_s": 0.0,
+                "prompt_tokens": max(1, len(prompt) // 4),
+                "completion_tokens": 0,
+                "total_tokens": max(1, len(prompt) // 4), "otel": {},
+            }})
+            return
+
+        start = time.monotonic()
+        out = get_pipeline()(prompt, max_new_tokens=max_tokens)[0]
+        latency_ms = int((time.monotonic() - start) * 1000)
+        text = out["generated_text"]
+        completion = text[len(prompt):] if text.startswith(prompt) else text
+        p_tok = out.get("prompt_tokens", max(1, len(prompt) // 4))
+        c_tok = out.get("completion_tokens", max(1, len(completion) // 4))
+        self._json(200, {
+            "output": completion,
+            "meta": {
+                "request_id": request_id,
+                "latency_ms": latency_ms,
+                "queue_wait_s": 0.0,
+                "prompt_tokens": p_tok,
+                "completion_tokens": c_tok,
+                "total_tokens": p_tok + c_tok,
+                "otel": {},
+            },
+        })
+
+
+def run() -> None:
+    host = os.environ.get("LLM_HOST") or os.environ.get("HOST", "0.0.0.0")
+    port = int(os.environ.get("LLM_PORT") or os.environ.get("PORT", "8000"))
+    server = ThreadingHTTPServer((host, port), CPUFallbackHandler)
+    print(f"[cpu-fallback] serving {os.environ.get('LLM_MODEL', 'tiny')} "
+          f"on http://{host}:{port}", flush=True)
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+
+
+if __name__ == "__main__":
+    run()

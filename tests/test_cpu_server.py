@@ -1,0 +1,83 @@
+"""Contract tests for the CPU fallback server (hf_cpu_server analog).
+
+Verifies the no-accelerator drop-in speaks the same `/chat` JSON contract as
+the main TPU backend (SURVEY.md §2.1): request field aliases, meta block,
+health endpoints, and error shapes — using the offline tiny model.
+"""
+
+import json
+import threading
+import urllib.request
+from http.server import ThreadingHTTPServer
+
+import pytest
+
+from agentic_traffic_testing_tpu.serving.cpu_server import CPUFallbackHandler
+
+
+@pytest.fixture(scope="module")
+def base_url():
+    server = ThreadingHTTPServer(("127.0.0.1", 0), CPUFallbackHandler)
+    t = threading.Thread(target=server.serve_forever, daemon=True)
+    t.start()
+    yield f"http://127.0.0.1:{server.server_address[1]}"
+    server.shutdown()
+
+
+def post(url, payload, headers=None):
+    req = urllib.request.Request(
+        url, json.dumps(payload).encode(),
+        {"Content-Type": "application/json", **(headers or {})},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_health_endpoints(base_url):
+    for path in ("/health", "/ready", "/live"):
+        with urllib.request.urlopen(base_url + path, timeout=10) as resp:
+            assert resp.status == 200
+            assert json.loads(resp.read())["status"] == "ok"
+
+
+def test_chat_contract(base_url):
+    status, body = post(base_url + "/chat", {"prompt": "Hello", "max_tokens": 4})
+    assert status == 200
+    assert isinstance(body["output"], str)
+    meta = body["meta"]
+    for key in ("request_id", "latency_ms", "queue_wait_s", "prompt_tokens",
+                "completion_tokens", "total_tokens", "otel"):
+        assert key in meta
+    assert meta["total_tokens"] == meta["prompt_tokens"] + meta["completion_tokens"]
+    assert meta["completion_tokens"] <= 4 + 1
+
+
+def test_input_alias_and_request_id(base_url):
+    status, body = post(
+        base_url + "/generate", {"input": "hi", "max_tokens": 2},
+        headers={"X-Request-ID": "req-xyz"},
+    )
+    assert status == 200
+    assert body["meta"]["request_id"] == "req-xyz"
+
+
+def test_error_shapes(base_url):
+    status, body = post(base_url + "/chat", {"max_tokens": 2})
+    assert status == 400 and "error" in body
+    status, _ = post(base_url + "/nope", {"prompt": "x"})
+    assert status == 404
+    req = urllib.request.Request(
+        base_url + "/chat", b"{not json", {"Content-Type": "application/json"}
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=10)
+    assert ei.value.code == 400
+
+
+def test_deterministic_greedy(base_url):
+    _, a = post(base_url + "/chat", {"prompt": "abc", "max_tokens": 6})
+    _, b = post(base_url + "/chat", {"prompt": "abc", "max_tokens": 6})
+    assert a["output"] == b["output"]

@@ -184,3 +184,23 @@ def drive(scheduler_alloc_native: bool, seed: int):
 def test_scheduler_trace_parity(seed):
     """Identical plan/preemption/accounting traces from both allocators."""
     assert drive(False, seed) == drive(True, seed)
+
+
+def test_tie_break_parity_equal_arrivals():
+    """Equal arrival_times must evict the same victim on both paths."""
+    traces = {}
+    for use_native in (False, True):
+        alloc = make_block_allocator(12, 4, native=use_native)  # 11 usable
+        sched = make_sched(alloc)
+        reqs = [req(f"r{i}", 12, arrival=5) for i in range(3)]  # all tied
+        for r in reqs:
+            sched.add_request(r)
+        sigs = []
+        for _ in range(12):
+            plan = sched.plan()
+            sigs.append(plan_sig(plan))
+            if isinstance(plan, DecodeBatch):
+                for r in plan.requests:
+                    r.output_ids.append(0)
+        traces[use_native] = sigs
+    assert traces[False] == traces[True]
