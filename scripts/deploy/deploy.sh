@@ -34,7 +34,9 @@ wait_for_llm() {
 
 start_monitoring() {
   echo "[deploy] starting monitoring stack"
-  docker compose -f "$INFRA/docker-compose.monitoring.yml" up -d
+  local mon="docker-compose.monitoring.yml"
+  [ "$MODE" = "distributed" ] && mon="docker-compose.monitoring.distributed.yml"
+  docker compose -f "$INFRA/$mon" up -d
   # Host-side TCP collector over the inter-agent bridge.
   nohup bash "$SCRIPT_DIR/../monitoring/run_tcpdump.sh" \
       > /tmp/tcp_collector.log 2>&1 &

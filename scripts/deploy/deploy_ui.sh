@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# UI deploy helper (reference: scripts/deploy/deploy_ui.sh). Builds and
+# (re)starts only the static UI container against an already-running testbed —
+# the fast path when iterating on ui/ without touching agents or the backend.
+set -euo pipefail
+
+SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
+REPO_ROOT="$(cd "$SCRIPT_DIR/../.." && pwd)"
+INFRA="$REPO_ROOT/infra"
+
+if [ -f "$INFRA/.env" ]; then set -a; . "$INFRA/.env"; set +a; fi
+MODE="${1:-${DEPLOYMENT_MODE:-distributed}}"
+
+case "$MODE" in
+  single)      COMPOSE="$INFRA/docker-compose.yml" ;;
+  distributed) COMPOSE="$INFRA/docker-compose.distributed.yml" ;;
+  *) echo "unknown mode: $MODE (single|distributed)" >&2; exit 2 ;;
+esac
+
+docker compose -f "$COMPOSE" up --build -d ui
+echo "[deploy] UI at http://localhost:${UI_PORT:-3000} (chat: /chat/, agentverse: /agentverse/)"

@@ -6,7 +6,8 @@ SCRIPT_DIR="$(cd "$(dirname "${BASH_SOURCE[0]}")" && pwd)"
 INFRA="$(cd "$SCRIPT_DIR/../../infra" && pwd)"
 
 pkill -f tcp_metrics_collector.py 2>/dev/null || true
-for f in docker-compose.monitoring.yml docker-compose.distributed.yml docker-compose.yml; do
+for f in docker-compose.monitoring.yml docker-compose.monitoring.distributed.yml \
+         docker-compose.distributed.yml docker-compose.yml; do
   [ -f "$INFRA/$f" ] && docker compose -f "$INFRA/$f" down 2>/dev/null
 done
 echo "[stop] testbed stopped (volumes preserved)"

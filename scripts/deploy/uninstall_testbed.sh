@@ -13,7 +13,8 @@ if [ "${1:-}" != "-y" ]; then
 fi
 
 pkill -f tcp_metrics_collector.py 2>/dev/null || true
-for f in docker-compose.monitoring.yml docker-compose.distributed.yml docker-compose.yml; do
+for f in docker-compose.monitoring.yml docker-compose.monitoring.distributed.yml \
+         docker-compose.distributed.yml docker-compose.yml; do
   [ -f "$INFRA/$f" ] && docker compose -f "$INFRA/$f" down -v --rmi local 2>/dev/null
 done
 rm -rf "$REPO_ROOT/logs" "$REPO_ROOT/data/experiments"

@@ -1,0 +1,25 @@
+#!/usr/bin/env bash
+# Forward the testbed's UI/observability ports from a remote host over SSH,
+# killing any stale forwards first (reference: scripts/dev/forward_clean_ssh.sh).
+#
+# Usage: forward_clean_ssh.sh <user@host> [extra ssh args...]
+set -euo pipefail
+
+[ $# -ge 1 ] || { echo "usage: $0 <user@host> [ssh args...]" >&2; exit 2; }
+TARGET="$1"; shift
+
+# UI 3000, Grafana 3001, Prometheus 9090, Jaeger 16686, agent-a 8101, LLM 8000.
+PORTS=(3000 3001 9090 16686 8101 8000)
+
+# Kill stale forwards for these ports (previous runs that lost their TTY).
+for p in "${PORTS[@]}"; do
+  pids=$(pgrep -f "ssh .*-L ${p}:localhost:${p}" || true)
+  [ -n "$pids" ] && { echo "[dev] killing stale forward for :$p ($pids)"; kill $pids || true; }
+done
+
+ARGS=()
+for p in "${PORTS[@]}"; do ARGS+=(-L "${p}:localhost:${p}"); done
+
+echo "[dev] forwarding ${PORTS[*]} from $TARGET (Ctrl-C to stop)"
+exec ssh -N -o ServerAliveInterval=30 -o ExitOnForwardFailure=yes \
+  "${ARGS[@]}" "$@" "$TARGET"

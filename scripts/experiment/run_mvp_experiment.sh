@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Legacy MVP scenario driver (reference: scripts/experiment/run_mvp_experiment.sh).
+# Fires N /task requests per scenario against Agent A — smoke-level load
+# without the AgentVerse machinery. Superseded by run_experiment.sh for real
+# experiments; kept for quick backend/agent shakeouts.
+set -u
+
+AGENT_A_URL="${AGENT_A_URL:-http://localhost:8101}"
+N="${1:-3}"
+SCENARIOS=(${SCENARIOS:-agentic_simple agentic_parallel})
+OUT_DIR="${OUT_DIR:-data/mvp/$(date +%Y%m%d_%H%M%S)}"
+mkdir -p "$OUT_DIR"
+
+echo "[mvp] $N iterations x scenarios: ${SCENARIOS[*]} -> $OUT_DIR"
+ok=0; fail=0
+for i in $(seq 1 "$N"); do
+  for sc in "${SCENARIOS[@]}"; do
+    out="$OUT_DIR/run_${i}_${sc}.json"
+    status=$(curl -s -m 300 -o "$out" -w "%{http_code}" \
+      -H "Content-Type: application/json" \
+      -d "{\"task\": \"Summarize the tradeoffs of paged attention (run $i)\", \"scenario\": \"$sc\"}" \
+      "$AGENT_A_URL/task" || echo 000)
+    if [ "$status" = 200 ]; then
+      ok=$((ok+1)); echo "[mvp] $i/$sc ok"
+    else
+      fail=$((fail+1)); echo "[mvp] $i/$sc FAILED http=$status" >&2
+    fi
+    sleep "${WAIT_BETWEEN_RUNS:-2}"
+  done
+done
+
+echo "[mvp] done: $ok ok, $fail failed (outputs in $OUT_DIR)"
+[ "$fail" = 0 ]

@@ -1,0 +1,32 @@
+#!/usr/bin/env bash
+# Host prerequisite: Docker CE + compose plugin (reference: scripts/setup/install_docker.sh).
+# Debian/Ubuntu only; idempotent.
+set -euo pipefail
+
+if command -v docker >/dev/null 2>&1; then
+  echo "[setup] docker already installed: $(docker --version)"
+else
+  echo "[setup] installing Docker CE from download.docker.com"
+  sudo apt-get update
+  sudo apt-get install -y ca-certificates curl gnupg
+  sudo install -m 0755 -d /etc/apt/keyrings
+  DISTRO="$(. /etc/os-release && echo "$ID")"   # ubuntu or debian
+  curl -fsSL "https://download.docker.com/linux/$DISTRO/gpg" \
+    | sudo gpg --dearmor -o /etc/apt/keyrings/docker.gpg
+  sudo chmod a+r /etc/apt/keyrings/docker.gpg
+  echo "deb [arch=$(dpkg --print-architecture) signed-by=/etc/apt/keyrings/docker.gpg] \
+https://download.docker.com/linux/$DISTRO $(. /etc/os-release && echo "$VERSION_CODENAME") stable" \
+    | sudo tee /etc/apt/sources.list.d/docker.list >/dev/null
+  sudo apt-get update
+  sudo apt-get install -y docker-ce docker-ce-cli containerd.io \
+    docker-buildx-plugin docker-compose-plugin
+fi
+
+# Rootless use for the invoking user.
+if ! id -nG "$USER" | grep -qw docker; then
+  sudo usermod -aG docker "$USER"
+  echo "[setup] added $USER to the docker group (re-login to take effect)"
+fi
+
+docker compose version || { echo "[setup] compose plugin missing" >&2; exit 1; }
+echo "[setup] docker ready"

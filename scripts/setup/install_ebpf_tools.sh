@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Host prerequisite: BCC tools + bpftrace for kernel-level TCP observation
+# (reference: scripts/setup/install_ebpf_tools.sh). Used by
+# scripts/traffic/collect_metrics.sh (tcpconnect/tcplife/tcprtt/tcpretrans)
+# and the optional ebpf_exporter programs in infra/monitoring/ebpf_exporter/.
+set -euo pipefail
+
+echo "[setup] installing BCC tools + bpftrace (requires kernel headers)"
+sudo apt-get update
+sudo apt-get install -y bpfcc-tools bpftrace "linux-headers-$(uname -r)" || {
+  echo "[setup] exact headers unavailable; trying generic" >&2
+  sudo apt-get install -y bpfcc-tools bpftrace linux-headers-generic
+}
+
+# Smoke: one-shot tracepoint probe proves the toolchain can attach.
+if sudo timeout 5 bpftrace -e 'tracepoint:sock:inet_sock_set_state { exit(); }' \
+     >/dev/null 2>&1; then
+  echo "[setup] eBPF toolchain functional"
+else
+  echo "[setup] WARNING: could not attach a probe (container/VM without CAP_BPF?)" >&2
+fi

@@ -1,0 +1,67 @@
+"""Infra-plane validity: shell syntax, compose/config YAML, dashboard JSON.
+
+The reference has no tests for its ops plane (SURVEY.md §4); these pin the
+files that deploy/measure the testbed so a bad edit fails CI, not a deploy.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import subprocess
+
+import pytest
+import yaml
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+SCRIPTS = sorted((REPO / "scripts").rglob("*.sh"))
+COMPOSE_FILES = sorted((REPO / "infra").glob("docker-compose*.yml"))
+SERVING_CONFIGS = sorted(
+    (REPO / "agentic_traffic_testing_tpu" / "serving" / "configs").glob("*.yaml"))
+
+
+@pytest.mark.parametrize("script", SCRIPTS, ids=lambda p: str(p.relative_to(REPO)))
+def test_shell_syntax(script):
+    subprocess.run(["bash", "-n", str(script)], check=True)
+
+
+@pytest.mark.parametrize("compose", COMPOSE_FILES, ids=lambda p: p.name)
+def test_compose_parses(compose):
+    doc = yaml.safe_load(compose.read_text())
+    assert doc.get("services"), f"{compose.name}: no services"
+
+
+def test_monitoring_composes_cover_observability_plane():
+    for name in ("docker-compose.monitoring.yml",
+                 "docker-compose.monitoring.distributed.yml"):
+        doc = yaml.safe_load((REPO / "infra" / name).read_text())
+        for svc in ("prometheus", "grafana", "cadvisor", "docker-mapping-exporter"):
+            assert svc in doc["services"], f"{name}: missing {svc}"
+
+
+def test_serving_configs_match_server_config_fields():
+    import dataclasses
+
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.serving.config import ServerConfig
+
+    fields = {f.name for f in dataclasses.fields(ServerConfig)}
+    assert SERVING_CONFIGS, "no serving config profiles found"
+    for path in SERVING_CONFIGS:
+        doc = yaml.safe_load(path.read_text())
+        unknown = set(doc) - fields
+        assert not unknown, f"{path.name}: unknown keys {unknown}"
+        resolve_config(doc["model"])  # every profile names a known architecture
+
+
+def test_grafana_dashboard_json():
+    dash = json.loads((REPO / "infra" / "monitoring" / "grafana" / "dashboards"
+                       / "agentic-traffic.json").read_text())
+    assert dash.get("uid") == "agentic-traffic-testbed"
+    assert dash.get("panels") or dash.get("rows")
+
+
+def test_prometheus_scrapes_llm_backend():
+    doc = yaml.safe_load((REPO / "infra" / "monitoring" / "prometheus.yml").read_text())
+    jobs = {j["job_name"] for j in doc["scrape_configs"]}
+    assert "llm-backend" in jobs
