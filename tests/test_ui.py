@@ -1,0 +1,73 @@
+"""UI↔server contract pins (no JS runtime in CI — structural checks).
+
+The AgentVerse UI is plain-script modules; these tests keep the parts that
+must agree with the Python side from drifting: module wiring, element ids,
+the SSE event vocabulary, and the example-task catalog.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+UI = REPO / "ui" / "agentverse"
+ORCH = (REPO / "agentic_traffic_testing_tpu" / "agents" / "agent_a"
+        / "orchestrator.py").read_text()
+
+MODULES = ["utils.js", "config.js", "ui-state.js", "streaming.js",
+           "renderers.js", "app.js"]
+
+
+def test_index_loads_all_modules_in_order():
+    html = (UI / "index.html").read_text()
+    srcs = re.findall(r'<script src="([^"]+)"', html)
+    assert srcs == MODULES
+
+
+def test_all_modules_exist():
+    for m in MODULES:
+        assert (UI / m).exists(), m
+
+
+def test_js_element_ids_exist_in_html():
+    html = (UI / "index.html").read_text()
+    html_ids = set(re.findall(r'id="([^"]+)"', html))
+    js = "".join((UI / m).read_text() for m in MODULES)
+    for used in set(re.findall(r'\$\("([^"]+)"\)', js)):
+        if used.startswith("stage-"):
+            continue  # generated per-stage at runtime
+        assert used in html_ids, f"JS references #{used}, missing from index.html"
+
+
+def test_ui_state_covers_orchestrator_event_vocabulary():
+    emitted = set(re.findall(r'_emit\(cb,\s*"(\w+)"', ORCH))
+    emitted |= {"llm_request", "llm_error"}  # emitted via a variable expression
+    ui_state = (UI / "ui-state.js").read_text()
+    handled = set(re.findall(r'case "(\w+)":', ui_state))
+    missing = emitted - handled
+    assert not missing, f"ui-state.js does not handle events: {missing}"
+
+
+def test_example_tasks_in_sync_with_template():
+    tmpl = json.loads((REPO / "agentic_traffic_testing_tpu" / "agents"
+                       / "templates" / "agentverse_workflow.json").read_text())
+    config_js = (UI / "config.js").read_text()
+    for task in tmpl["example_tasks"]:
+        assert task["task_id"] in config_js, (
+            f"config.js fallback misses example task {task['task_id']}")
+
+
+def test_streaming_module_handles_result_frame_and_fallback():
+    streaming = (UI / "streaming.js").read_text()
+    assert '"result"' in streaming or "=== \"result\"" in streaming
+    assert "runNonStreaming" in streaming  # non-streaming fallback exists
+
+
+def test_renderers_use_actual_event_fields():
+    renderers = (UI / "renderers.js").read_text()
+    # Fields the orchestrator actually emits (not invented ones).
+    for field in ("plan_preview", "vertical_round", "result_preview",
+                  "overall_score", "expertise", "responsibility"):
+        assert field in renderers, f"renderers.js missing server field {field}"

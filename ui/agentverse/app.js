@@ -1,14 +1,13 @@
-/* AgentVerse live client: POST /agentverse with stream:true, parse the SSE
- * body incrementally (fetch + ReadableStream — EventSource can't POST), and
- * render stages/events/calls. Falls back to the non-streaming JSON response
- * when streaming fails (parity with reference streaming.js fallback). */
+/* AgentVerse live UI entrypoint (parity: reference ui/agentverse/app.js).
+ * Wires the form to streaming.js, folds events through RunState
+ * (ui-state.js) and repaints via renderers.js. Modules are plain scripts
+ * loaded in order by index.html — same structure as the reference UI. */
 
-const $ = (id) => document.getElementById(id);
-const STAGES = ["recruitment", "decision", "execution", "evaluation"];
+let state = new RunState();
 
 function endpointBase() {
   const v = $("endpoint").value.trim();
-  return v ? v.replace(/\/+$/, "") : `http://${location.hostname}:8101`;
+  return v ? v.replace(/\/+$/, "") : AGENTVERSE_DEFAULT_ENDPOINT;
 }
 
 function setStatus(cls, text) {
@@ -17,132 +16,102 @@ function setStatus(cls, text) {
   el.textContent = text;
 }
 
-function resetPanels() {
-  $("stages").innerHTML = STAGES.map(
-    (s) => `<div class="stage" id="stage-${s}"><h4>${s}</h4>
-            <div class="detail">waiting…</div></div>`).join("");
-  $("events").innerHTML = "";
-  $("calls").querySelector("tbody").innerHTML = "";
-  $("final").textContent = "…";
-}
-
-function logEvent(name, payload) {
-  const div = document.createElement("div");
-  const brief = JSON.stringify(payload).slice(0, 220);
-  div.innerHTML = `<span class="evt">${name}</span> ${brief}`;
-  $("events").prepend(div);
-}
-
-function onEvent(ev) {
-  const name = ev.event;
-  logEvent(name, ev);
-  if (name === "stage_start") {
-    const el = $(`stage-${ev.stage}`);
-    if (el) { el.classList.add("active");
-              el.querySelector(".detail").textContent = "running…"; }
-  } else if (name === "stage_complete") {
-    const el = $(`stage-${ev.stage}`);
-    if (el) {
-      el.classList.remove("active");
-      el.classList.add("done");
-      const d = {...ev}; delete d.event; delete d.stage;
-      el.querySelector(".detail").textContent =
-        Object.entries(d).map(([k, v]) =>
-          `${k}: ${typeof v === "string" ? v.slice(0, 120) : JSON.stringify(v)}`
-        ).join("\n");
-    }
-  } else if (name === "llm_request" || name === "llm_error") {
-    const tr = document.createElement("tr");
-    tr.innerHTML = `<td>${ev.stage ?? ""}</td><td>${ev.iteration ?? ""}</td>
-      <td>${ev.latency_ms ?? ""}</td><td>${ev.prompt_tokens ?? ""}</td>
-      <td>${ev.completion_tokens ?? ""}</td>
-      <td>${ev.error ? "ERR" : ev.status}</td>`;
-    $("calls").querySelector("tbody").appendChild(tr);
-  } else if (name === "iteration_start") {
-    STAGES.forEach((s) => $(`stage-${s}`)?.classList.remove("done"));
-  } else if (name === "result") {
-    $("final").textContent = ev.final_output || ev.error || "(no output)";
-    setStatus(ev.error ? "error" : "done", ev.error ? "error" : "done");
-  } else if (name === "workflow_error" || name === "error") {
-    setStatus("error", "error");
-  }
-}
-
-async function runStreaming(task) {
-  const resp = await fetch(`${endpointBase()}/agentverse`, {
-    method: "POST",
-    headers: {"Content-Type": "application/json",
-              "Accept": "text/event-stream"},
-    body: JSON.stringify({task, stream: true,
-                          structure: $("structure").value}),
-  });
-  if (!resp.ok || !resp.body) throw new Error(`http ${resp.status}`);
-  const reader = resp.body.getReader();
-  const decoder = new TextDecoder();
-  let buf = "";
-  for (;;) {
-    const {done, value} = await reader.read();
-    if (done) break;
-    buf += decoder.decode(value, {stream: true});
-    let idx;
-    while ((idx = buf.indexOf("\n\n")) >= 0) {
-      const chunk = buf.slice(0, idx);
-      buf = buf.slice(idx + 2);
-      const dataLine = chunk.split("\n").find((l) => l.startsWith("data: "));
-      if (dataLine) {
-        try { onEvent(JSON.parse(dataLine.slice(6))); } catch { /* partial */ }
-      }
-    }
-  }
-}
-
-async function runFallback(task) {
-  logEvent("info", {note: "streaming unavailable, falling back to JSON"});
-  const resp = await fetch(`${endpointBase()}/agentverse`, {
-    method: "POST",
-    headers: {"Content-Type": "application/json"},
-    body: JSON.stringify({task, structure: $("structure").value}),
-  });
-  const data = await resp.json();
-  (data.llm_calls || []).forEach((c) => onEvent({event: "llm_request", ...c}));
-  onEvent({event: "result", ...data});
+function iterTabHandler(ev) {
+  const btn = ev.target.closest(".iter-tab");
+  if (!btn) return;
+  state.currentIteration = Number(btn.dataset.iter);
+  renderAll(state);
 }
 
 async function run() {
   const task = $("task").value.trim();
-  if (!task) return;
+  if (!task) { setStatus("error", "enter a task"); return; }
+  state = new RunState();
+  renderAll(state);
+  setStatus("running", "running…");
   $("runBtn").disabled = true;
-  resetPanels();
-  setStatus("running", "running");
+
+  const body = {
+    task,
+    structure: $("structure").value,
+    num_experts: Number($("agents").value || WORKFLOW_DEFAULTS.agent_count),
+    max_iterations: Number($("iters").value || WORKFLOW_DEFAULTS.max_iterations),
+  };
+
   try {
-    await runStreaming(task);
-  } catch (err) {
-    try { await runFallback(task); }
-    catch (err2) {
-      setStatus("error", "error");
-      logEvent("error", {error: String(err2)});
+    const { streamed, final } = await runWorkflow(
+      `${endpointBase()}/agentverse`, body,
+      (ev) => { state.apply(ev); renderFor(state, ev.event); });
+    if (final) {
+      // Streamed runs already folded every event; only take the summary
+      // fields from the result frame. The non-streaming path folds the
+      // whole response (it saw no events).
+      if (streamed) state.applyResultSummary(final);
+      else state.applyFinalResponse(final);
     }
+    renderAll(state);
+    setStatus(state.error ? "error" : "done",
+              state.error ? "workflow error" :
+              streamed ? "done (streamed)" : "done (non-streaming)");
+    if (state.taskId) $("taskId").value = state.taskId;
+  } catch (err) {
+    setStatus("error", String(err));
   } finally {
     $("runBtn").disabled = false;
   }
 }
 
-async function loadExamples() {
+/* Reload a persisted run by task id (GET /agentverse/<id>) — the server
+ * keeps every workflow at logs/agentverse/<task_id>.json. */
+async function loadRun() {
+  const id = $("taskId").value.trim();
+  if (!id) return;
+  setStatus("running", `loading ${id}…`);
   try {
-    const resp = await fetch("../templates/agentverse_workflow.json");
-    const tmpl = await resp.json();
-    for (const t of tmpl.example_tasks || []) {
-      const opt = document.createElement("option");
-      opt.value = t.task;
-      opt.textContent = t.task_id;
-      $("example").appendChild(opt);
-    }
-  } catch { /* UI works without examples */ }
+    const resp = await fetchRun(endpointBase(), id);
+    state = new RunState();
+    state.applyFinalResponse(resp);
+    renderAll(state);
+    setStatus("done", `loaded ${id}`);
+  } catch (err) {
+    setStatus("error", `load failed: ${err}`);
+  }
 }
 
-$("runBtn").addEventListener("click", run);
-$("task").addEventListener("keydown", (e) => { if (e.key === "Enter") run(); });
-$("example").addEventListener("change", (e) => {
-  if (e.target.value) $("task").value = e.target.value;
-});
-loadExamples();
+/* Prefer live examples from the served template; fall back to config.js. */
+async function loadExamples() {
+  const sel = $("example");
+  let tasks = EXAMPLE_TASKS;
+  try {
+    const resp = await fetch("../templates/agentverse_workflow.json");
+    if (resp.ok) {
+      const tmpl = await resp.json();
+      if (tmpl.example_tasks?.length) tasks = tmpl.example_tasks;
+    }
+  } catch { /* static fallback */ }
+  for (const t of tasks) {
+    const opt = document.createElement("option");
+    opt.value = t.task;
+    opt.textContent = t.task_id;
+    sel.appendChild(opt);
+  }
+}
+
+function init() {
+  loadExamples();
+  $("example").addEventListener("change", (e) => {
+    if (e.target.value) $("task").value = e.target.value;
+  });
+  $("structure").value = WORKFLOW_DEFAULTS.structure;
+  $("agents").value = WORKFLOW_DEFAULTS.agent_count;
+  $("iters").value = WORKFLOW_DEFAULTS.max_iterations;
+  $("runBtn").addEventListener("click", run);
+  $("loadBtn").addEventListener("click", loadRun);
+  $("iterations").addEventListener("click", iterTabHandler);
+  $("task").addEventListener("keydown", (e) => {
+    if (e.key === "Enter" && (e.metaKey || e.ctrlKey)) run();
+  });
+  renderAll(state);
+}
+
+document.addEventListener("DOMContentLoaded", init);

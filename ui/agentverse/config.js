@@ -1,0 +1,35 @@
+/* Static UI config (parity: reference ui/agentverse/config.js).
+ * EXAMPLE_TASKS mirrors agents/templates/agentverse_workflow.json
+ * example_tasks — the same set the experiment runner uses, so UI runs and
+ * batch runs exercise identical workloads. */
+
+const AGENTVERSE_DEFAULT_ENDPOINT = `http://${location.hostname}:8101`;
+
+const EXAMPLE_TASKS = [
+  {
+    task_id: "plan-city-network",
+    task: "Design a monitoring plan for a mid-size city's public WiFi network: what to measure, where to place probes, and how to detect degradations early.",
+  },
+  {
+    task_id: "compare-storage",
+    task: "Compare three approaches for storing time-series metrics at 1M points/second (columnar files, purpose-built TSDB, object storage with index) and recommend one with justification.",
+  },
+  {
+    task_id: "incident-runbook",
+    task: "Write an incident runbook for elevated p95 latency in a microservice behind a load balancer, covering triage steps, likely causes, and rollback criteria.",
+  },
+  {
+    task_id: "summarize-tradeoffs",
+    task: "Explain the trade-offs between request-level batching and token-level continuous batching for LLM serving, and when each wins.",
+  },
+  {
+    task_id: "capacity-estimate",
+    task: "Estimate the KV-cache memory needed to serve 32 concurrent chats at 8k context on an 8B-parameter transformer in bf16, showing the arithmetic.",
+  },
+];
+
+const WORKFLOW_DEFAULTS = {
+  structure: "vertical",
+  agent_count: 3,
+  max_iterations: 3,
+};

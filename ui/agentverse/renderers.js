@@ -1,0 +1,156 @@
+/* DOM renderers (parity: reference ui/agentverse/renderers.js).
+ * Pure state -> DOM functions; app.js calls renderAll after every event. */
+
+const STAGE_ORDER = ["recruitment", "decision", "execution", "evaluation"];
+
+function renderStages(state) {
+  const iter = state.iterations.get(state.currentIteration);
+  $("stages").innerHTML = STAGE_ORDER.map((name) => {
+    const st = iter?.stages.get(name);
+    const cls = st ? st.status : "pending";
+    return `<div class="stage ${cls}" id="stage-${name}">
+      <h4>${name}</h4>
+      <div class="detail">${st ? renderStageDetail(name, st.detail) : "waiting…"}</div>
+    </div>`;
+  }).join("");
+}
+
+function renderStageDetail(name, d) {
+  if (!d) return "";
+  if (name === "recruitment" && d.experts) {
+    return d.experts.map((e) =>
+      `<div class="expert"><strong>${escapeHtml(e.name ?? "expert")}
+         ${e.expertise ? " · " + escapeHtml(e.expertise) : ""}</strong>
+       <span>${escapeHtml(truncate(e.responsibility ?? e.description ?? "", 140))}</span></div>`).join("");
+  }
+  if (name === "decision" && (d.plan || d.structure)) {
+    return `${d.structure ? `<em>${escapeHtml(d.structure)}</em> ` : ""}
+            ${escapeHtml(truncate(d.plan ?? "", 280))}`;
+  }
+  if (name === "evaluation" && (d.score != null || d.overall_score != null)) {
+    const score = d.score ?? d.overall_score;
+    const ok = d.goal_achieved ? "achieved" : "not achieved";
+    return `<span class="score">${escapeHtml(String(score))}/100</span> — goal ${ok}
+            <div>${escapeHtml(truncate(d.feedback ?? "", 200))}</div>`;
+  }
+  const brief = Object.entries(d)
+    .filter(([k]) => !["event", "stage", "iteration"].includes(k))
+    .map(([k, v]) => `${k}: ${escapeHtml(truncate(
+      typeof v === "string" ? v : JSON.stringify(v), 110))}`);
+  return brief.slice(0, 4).join("<br>");
+}
+
+function renderIterations(state) {
+  const el = $("iterations");
+  if (!el) return;
+  const parts = [];
+  for (const [n, iter] of [...state.iterations.entries()].sort((a, b) => a[0] - b[0])) {
+    const score = state.scores.find((s) => s.iteration === n);
+    const active = n === state.currentIteration ? "active" : "";
+    parts.push(`<button class="iter-tab ${active}" data-iter="${n}">
+      iter ${n}${score ? ` · ${score.score}` : ""}</button>`);
+  }
+  el.innerHTML = parts.join("");
+}
+
+function renderDiscussion(state) {
+  const iter = state.iterations.get(state.currentIteration);
+  const el = $("discussion");
+  if (!el) return;
+  const rows = [];
+  for (const t of iter?.discussion ?? []) {
+    const msg = t.message ?? "";
+    rows.push(`<div class="turn">
+      <span class="who">R${t.round ?? "?"} · ${escapeHtml(t.expert ?? "expert")}</span>
+      <span>${escapeHtml(truncate(msg, 400))}</span>
+      ${msg.includes("[CONSENSUS]") ? '<span class="tag">[CONSENSUS]</span>' : ""}</div>`);
+  }
+  for (const v of iter?.vertical ?? []) {
+    const text = v.plan_preview ?? v.message ?? "";
+    rows.push(`<div class="turn vertical">
+      <span class="who">v${v.vertical_round ?? "?"} · ${escapeHtml(v.role ?? "")}
+        ${v.expert ? " · " + escapeHtml(v.expert) : ""}</span>
+      <span>${escapeHtml(truncate(text, 400))}</span>
+      ${String(text).includes("[APPROVED]") ? '<span class="tag">[APPROVED]</span>' : ""}</div>`);
+  }
+  for (const x of iter?.executions ?? []) {
+    rows.push(`<div class="turn exec">
+      <span class="who">exec · ${escapeHtml(x.expert ?? "")}</span>
+      <span>${escapeHtml(truncate(x.result_preview ?? x.result ?? "", 400))}</span>
+      ${x.ok === false ? '<span class="tag err">ERR</span>' : ""}</div>`);
+  }
+  el.innerHTML = rows.length ? rows.join("") : '<div class="muted">no turns yet</div>';
+}
+
+function renderCalls(state) {
+  const rows = state.calls.map((c) => `<tr class="${c.error ? "err" : ""}">
+    <td>${escapeHtml(c.stage ?? "")}</td>
+    <td>${c.iteration ?? ""}</td>
+    <td>${escapeHtml(truncate(c.request_id ?? "", 10))}</td>
+    <td>${fmtMs(c.latency_ms)}</td>
+    <td>${fmtNum(c.prompt_tokens)}</td>
+    <td>${fmtNum(c.completion_tokens)}</td>
+    <td>${c.error ? "ERR" : escapeHtml(String(c.status ?? "ok"))}</td></tr>`);
+  $("calls").querySelector("tbody").innerHTML = rows.join("");
+}
+
+function renderTotals(state) {
+  const el = $("totals");
+  if (!el) return;
+  const t = state.totals;
+  el.innerHTML = `
+    <span><b>${fmtNum(t.calls)}</b> calls</span>
+    <span><b>${fmtNum(t.errors)}</b> errors</span>
+    <span><b>${fmtNum(t.prompt_tokens)}</b> prompt tok</span>
+    <span><b>${fmtNum(t.completion_tokens)}</b> compl tok</span>
+    <span><b>${fmtMs(t.latency_ms)}</b> cumulative latency</span>
+    <span><b>${fmtUsd(t.cost_usd || null)}</b> est. cost</span>`;
+}
+
+function renderEvents(state) {
+  $("events").innerHTML = state.events.slice(0, 120).map((e) =>
+    `<div><span class="ts">${e.at}</span>
+     <span class="evt">${escapeHtml(e.event)}</span>
+     ${escapeHtml(truncate(JSON.stringify(e), 200))}</div>`).join("");
+}
+
+function renderFinal(state) {
+  if (state.error) {
+    $("final").textContent = `workflow error: ${state.error}`;
+    $("final").classList.add("error");
+  } else if (state.finalOutput) {
+    $("final").textContent = state.finalOutput;
+    $("final").classList.remove("error");
+  }
+}
+
+function renderAll(state) {
+  renderStages(state);
+  renderIterations(state);
+  renderDiscussion(state);
+  renderCalls(state);
+  renderTotals(state);
+  renderEvents(state);
+  renderFinal(state);
+}
+
+/* Repaint only the panels an event can affect — renderAll on every SSE
+ * event is O(run length) DOM work per event and janks long runs. */
+const EVENT_PANELS = {
+  iteration_start: [renderIterations, renderStages],
+  iteration_complete: [renderIterations],
+  stage_start: [renderStages],
+  stage_complete: [renderStages, renderIterations],
+  discussion_round: [renderDiscussion],
+  vertical_iteration: [renderDiscussion],
+  execution_result: [renderDiscussion],
+  llm_request: [renderCalls, renderTotals],
+  llm_error: [renderCalls, renderTotals],
+};
+
+function renderFor(state, eventName) {
+  const panels = EVENT_PANELS[eventName];
+  if (!panels) { renderAll(state); return; }   // complete/error/unknown
+  for (const fn of panels) fn(state);
+  renderEvents(state);
+}

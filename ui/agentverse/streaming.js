@@ -1,0 +1,96 @@
+/* SSE client (parity: reference ui/agentverse/streaming.js).
+ *
+ * The orchestrator streams `event: <name>\ndata: <json>\n\n` frames over a
+ * POST response, so EventSource (GET-only) can't be used — we parse the
+ * fetch ReadableStream incrementally. If streaming is unavailable (proxy
+ * buffering, older server), runWorkflow falls back to one non-streaming
+ * POST and folds the final JSON through RunState.applyFinalResponse. */
+
+async function streamWorkflow(url, body, onEvent) {
+  const resp = await fetch(url, {
+    method: "POST",
+    headers: { "Content-Type": "application/json", Accept: "text/event-stream" },
+    body: JSON.stringify({ ...body, stream: true }),
+  });
+  if (!resp.ok) throw new Error(`HTTP ${resp.status}`);
+  const ctype = resp.headers.get("Content-Type") || "";
+  if (!ctype.includes("text/event-stream")) {
+    // Server answered with a plain JSON body — treat as non-streaming.
+    return { streamed: false, final: await resp.json() };
+  }
+
+  const reader = resp.body.getReader();
+  const decoder = new TextDecoder();
+  let buf = "";
+  let finalPayload = null;
+
+  const dispatch = (frame) => {
+    let event = "message";
+    const dataLines = [];
+    for (const line of frame.split("\n")) {
+      if (line.startsWith("event:")) event = line.slice(6).trim();
+      else if (line.startsWith("data:")) dataLines.push(line.slice(5).trim());
+    }
+    if (!dataLines.length) return;
+    let payload;
+    try {
+      payload = JSON.parse(dataLines.join("\n"));
+    } catch {
+      payload = { raw: dataLines.join("\n") };
+    }
+    if (event === "result") finalPayload = payload;
+    else {
+      // A render bug on one event must not abort the stream (that would
+      // trigger the fallback re-POST and re-run the whole workflow).
+      try { onEvent({ event, ...payload }); }
+      catch (err) { console.error("event handler failed:", err, payload); }
+    }
+  };
+
+  for (;;) {
+    const { value, done } = await reader.read();
+    if (done) break;
+    buf += decoder.decode(value, { stream: true });
+    let idx;
+    while ((idx = buf.indexOf("\n\n")) >= 0) {
+      const frame = buf.slice(0, idx);
+      buf = buf.slice(idx + 2);
+      if (frame.trim()) dispatch(frame);
+    }
+  }
+  if (buf.trim()) dispatch(buf);
+  return { streamed: true, final: finalPayload };
+}
+
+async function runNonStreaming(url, body) {
+  const resp = await fetch(url, {
+    method: "POST",
+    headers: { "Content-Type": "application/json" },
+    body: JSON.stringify({ ...body, stream: false }),
+  });
+  // A failed workflow returns HTTP 500 *with* the full partial state
+  // (iterations, llm_calls, error) — render it rather than discarding.
+  try {
+    return await resp.json();
+  } catch {
+    throw new Error(`HTTP ${resp.status}`);
+  }
+}
+
+/* Try streaming; on transport failure fall back to the blocking request.
+ * Returns {streamed, final}; events (streaming mode only) go to onEvent. */
+async function runWorkflow(url, body, onEvent) {
+  try {
+    return await streamWorkflow(url, body, onEvent);
+  } catch (err) {
+    console.warn("SSE failed, falling back to non-streaming:", err);
+    const final = await runNonStreaming(url, body);
+    return { streamed: false, final };
+  }
+}
+
+async function fetchRun(base, taskId) {
+  const resp = await fetch(`${base}/agentverse/${encodeURIComponent(taskId)}`);
+  if (!resp.ok) throw new Error(`HTTP ${resp.status}`);
+  return resp.json();
+}

@@ -1,0 +1,134 @@
+/* Run-state store (parity: reference ui/agentverse/ui-state.js).
+ * One RunState per workflow run; apply(event) folds the SSE stream into a
+ * render-ready structure: per-iteration stages, discussion transcript,
+ * execution results, the LLM call ledger and running totals. */
+
+class RunState {
+  constructor() {
+    this.events = [];            // raw event log (latest first, capped)
+    this.iterations = new Map(); // iter -> {stages: Map, discussion: [], vertical: [], executions: []}
+    this.calls = [];             // llm_request / llm_error records
+    this.totals = {
+      calls: 0, errors: 0, prompt_tokens: 0, completion_tokens: 0,
+      latency_ms: 0, cost_usd: 0,
+    };
+    this.currentIteration = 1;
+    this.taskId = null;
+    this.finalOutput = null;
+    this.error = null;
+    this.done = false;
+    this.scores = [];            // evaluation score per iteration
+  }
+
+  _iter(n) {
+    const k = n ?? this.currentIteration;
+    if (!this.iterations.has(k)) {
+      this.iterations.set(k, {
+        stages: new Map(), discussion: [], vertical: [], executions: [],
+      });
+    }
+    return this.iterations.get(k);
+  }
+
+  apply(ev) {
+    this.events.unshift({ at: clockNow(), ...ev });
+    if (this.events.length > 400) this.events.pop();
+    const name = ev.event;
+    const it = ev.iteration ?? this.currentIteration;
+
+    switch (name) {
+      case "iteration_start":
+        this.currentIteration = ev.iteration ?? this.currentIteration;
+        this._iter(this.currentIteration);
+        break;
+      case "stage_start":
+        this._iter(it).stages.set(ev.stage, { status: "running", detail: ev });
+        break;
+      case "stage_complete": {
+        const d = { ...ev };
+        delete d.event;
+        this._iter(it).stages.set(ev.stage, { status: "done", detail: d });
+        if (ev.stage === "evaluation" && ev.overall_score != null) {
+          this.scores.push({ iteration: it, score: ev.overall_score });
+        }
+        break;
+      }
+      case "discussion_round":
+        this._iter(it).discussion.push(ev);
+        break;
+      case "vertical_iteration":
+        this._iter(it).vertical.push(ev);
+        break;
+      case "execution_result":
+        this._iter(it).executions.push(ev);
+        break;
+      case "llm_request":
+      case "llm_error": {
+        this.calls.push(ev);
+        this.totals.calls += 1;
+        if (name === "llm_error" || ev.error) this.totals.errors += 1;
+        this.totals.prompt_tokens += Number(ev.prompt_tokens || 0);
+        this.totals.completion_tokens += Number(ev.completion_tokens || 0);
+        this.totals.latency_ms += Number(ev.latency_ms || 0);
+        if (ev.cost_estimate_usd != null) {
+          this.totals.cost_usd += Number(ev.cost_estimate_usd);
+        }
+        break;
+      }
+      case "iteration_complete":
+        break;
+      case "complete":
+        this.done = true;
+        this.taskId = ev.task_id ?? this.taskId;
+        break;
+      case "workflow_error":
+      case "error":
+        this.error = ev.error ?? "unknown error";
+        break;
+    }
+  }
+
+  /* Take only the summary fields from the final result frame of a streamed
+   * run — every per-call/per-stage record was already folded live, so
+   * re-applying resp.llm_calls here would double-count. */
+  applyResultSummary(resp) {
+    this.taskId = resp.task_id ?? this.taskId;
+    this.finalOutput = resp.final_output || this.finalOutput;
+    if (resp.error) this.error = resp.error;
+    if (resp.aggregates?.cost_estimate_usd != null) {
+      this.totals.cost_usd = resp.aggregates.cost_estimate_usd;
+    }
+    this.done = true;
+  }
+
+  /* Fold a non-streaming /agentverse JSON response (AgentVerseState
+   * .to_response shape) into the same state — the fallback path when SSE is
+   * unavailable (reference streaming.js non-streaming mode). */
+  applyFinalResponse(resp) {
+    this.taskId = resp.task_id ?? this.taskId;
+    this.finalOutput = resp.final_output || null;
+    if (resp.error) this.error = resp.error;
+    for (const r of resp.llm_calls ?? []) {
+      this.apply({ event: r.error ? "llm_error" : "llm_request", ...r });
+    }
+    for (const itn of resp.iterations ?? []) {
+      const n = itn.iteration ?? 0;   // orchestrator iterations are 0-based
+      this._iter(n).stages.set("evaluation", { status: "done", detail: itn });
+      if (itn.overall_score != null) {
+        this.scores.push({ iteration: n, score: itn.overall_score });
+      }
+    }
+    const keys = [...this.iterations.keys()];
+    const first = keys.length ? Math.min(...keys) : 0;
+    if (resp.experts?.length) {
+      this._iter(first).stages.set("recruitment", {
+        status: "done", detail: { experts: resp.experts },
+      });
+    }
+    this.currentIteration = keys.length ? Math.max(...keys) : first;
+    if (resp.aggregates) {
+      this.totals.cost_usd = resp.aggregates.cost_estimate_usd ?? this.totals.cost_usd;
+    }
+    this.done = true;
+  }
+}

@@ -1,0 +1,32 @@
+/* Shared helpers (parity: reference ui/agentverse/utils.js). */
+
+const $ = (id) => document.getElementById(id);
+
+function escapeHtml(s) {
+  return String(s ?? "").replace(/[&<>"']/g, (c) => ({
+    "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;", "'": "&#39;",
+  }[c]));
+}
+
+function truncate(s, n) {
+  s = String(s ?? "");
+  return s.length > n ? s.slice(0, n - 1) + "…" : s;
+}
+
+function fmtMs(ms) {
+  if (ms == null || ms === "") return "—";
+  const n = Number(ms);
+  return n >= 1000 ? (n / 1000).toFixed(1) + " s" : Math.round(n) + " ms";
+}
+
+function fmtNum(n) {
+  return n == null ? "—" : Number(n).toLocaleString();
+}
+
+function fmtUsd(x) {
+  return x == null ? "—" : "$" + Number(x).toFixed(4);
+}
+
+function clockNow() {
+  return new Date().toLocaleTimeString();
+}
