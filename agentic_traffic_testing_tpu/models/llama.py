@@ -182,10 +182,10 @@ def prefill_impl(
         q, k, v = _qkv(xa, lp, cfg)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        kc_l = kvc.write_prompt_kv(jax.lax.dynamic_index_in_dim(kc, li, 0, keepdims=False), k, block_tables)
-        vc_l = kvc.write_prompt_kv(jax.lax.dynamic_index_in_dim(vc, li, 0, keepdims=False), v, block_tables)
-        kc = jax.lax.dynamic_update_index_in_dim(kc, kc_l, li, 0)
-        vc = jax.lax.dynamic_update_index_in_dim(vc, vc_l, li, 0)
+        # Chained DUS into the full pool: in-place on TPU, where a scatter
+        # would copy the pool per layer (see write_prompt_kv_full docstring).
+        kc = kvc.write_prompt_kv_full(kc, li, k, block_tables)
+        vc = kvc.write_prompt_kv_full(vc, li, v, block_tables)
         attn = causal_attention(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
         x = x + attn.reshape(b, t, -1) @ lp["wo"]
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
@@ -231,14 +231,15 @@ def decode_step_impl(
         q, k, v = _qkv(xa, lp, cfg)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        kc_l = kvc.write_decode_kv(jax.lax.dynamic_index_in_dim(kc, li, 0, keepdims=False), k[:, 0], block_tables, positions)
-        vc_l = kvc.write_decode_kv(jax.lax.dynamic_index_in_dim(vc, li, 0, keepdims=False), v[:, 0], block_tables, positions)
-        kc = jax.lax.dynamic_update_index_in_dim(kc, kc_l, li, 0)
-        vc = jax.lax.dynamic_update_index_in_dim(vc, vc_l, li, 0)
-        # Paged attention: Pallas kernel on TPU, jnp gather oracle on CPU
+        # Chained DUS into the full pool: in-place on TPU, where a scatter
+        # would copy the pool per layer (see write_decode_kv_full docstring).
+        kc = kvc.write_decode_kv_full(kc, li, k[:, 0], block_tables, positions)
+        vc = kvc.write_decode_kv_full(vc, li, v[:, 0], block_tables, positions)
+        # Paged attention straight off the stacked pool: Pallas kernel on TPU
+        # (layer indirection in its DMA index_map), jnp gather oracle on CPU
         # (ops/attention_backend.py picks at trace time).
-        attn = paged_decode_attention(q, kc_l, vc_l, block_tables, positions,
-                                      mode=attn_mode)
+        attn = paged_decode_attention(q, kc, vc, block_tables, positions,
+                                      mode=attn_mode, layer=li)
         x = x + attn.reshape(b, 1, -1) @ lp["wo"]
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         x = x + _mlp_block(xm, lp)

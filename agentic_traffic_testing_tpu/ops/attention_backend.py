@@ -26,41 +26,64 @@ from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
 
 VALID_MODES = ("auto", "pallas", "interpret", "gather")
 
+# Below this padded KV length (max_blocks * block_size), the jnp gather path
+# beats the Pallas kernel on TPU: the kernel's one-page-per-grid-step DMAs
+# (~2 KB each) pay ~2-3 us of grid overhead per page, while the gather's
+# materialized [B, kv_len, KH, hd] stays small. Measured crossover on v5e
+# with Llama-3.2-1B shapes; see bench notes in the r1 commit history.
+GATHER_CUTOVER_TOKENS = 2048
 
-def backend_choice() -> str:
+
+def backend_choice(padded_kv_len: int | None = None) -> str:
     mode = os.environ.get("ATT_TPU_ATTENTION", "auto")
     if mode not in VALID_MODES:
         raise ValueError(
             f"ATT_TPU_ATTENTION={mode!r} invalid; choose one of {VALID_MODES}")
     if mode == "auto":
-        return "pallas" if jax.default_backend() == "tpu" else "gather"
+        if jax.default_backend() != "tpu":
+            return "gather"
+        if padded_kv_len is not None and padded_kv_len <= GATHER_CUTOVER_TOKENS:
+            return "gather"
+        return "pallas"
     return mode
 
 
 def paged_decode_attention(
     q,             # [B, 1, H, hd]
-    k_pages,       # [KH, num_blocks, bs, hd] (one layer, heads-major)
-    v_pages,       # [KH, num_blocks, bs, hd]
+    k_pages,       # [KH, nb, bs, hd] (one layer) or [L, KH, nb, bs, hd] stacked
+    v_pages,       # same shape as k_pages
     block_tables,  # [B, max_blocks]
     positions,     # [B] position of the query token (ctx_len - 1)
     mode: str | None = None,
+    layer=None,    # scalar i32, required when pages are stacked (5D)
 ):
     """One-token paged attention over the block pool. Returns [B, 1, H, hd].
+
+    The decode scan passes the FULL stacked pool + `layer`: the Pallas path
+    folds the layer indirection into its DMA index_map (no per-layer slice is
+    ever materialized); the gather path slices the layer first — that copy is
+    cheap on CPU and keeps the KH-sharded gather well-partitioned under TP.
 
     `mode` overrides the env/platform choice. The GSPMD tensor-parallel
     runner passes "gather": a pallas_call has no SPMD partitioning rule, so
     under a tp>1 mesh XLA would replicate (all-gather) the head-sharded page
     pool onto every chip. A shard_map-wrapped kernel path can lift this later.
     """
+    if k_pages.ndim == 5 and layer is None:
+        raise ValueError("stacked (5D) pages require a layer index")
     ctx_lens = positions + 1
     if mode is None:
-        mode = backend_choice()
+        mode = backend_choice(block_tables.shape[1] * k_pages.shape[-2])
     if mode in ("pallas", "interpret"):
         out = paged_attention_decode(
             q[:, 0], k_pages, v_pages, block_tables, ctx_lens,
+            layer=(layer if k_pages.ndim == 5 else None),
             interpret=(mode == "interpret"),
         )
         return out[:, None]
+    if k_pages.ndim == 5:
+        k_pages = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
+        v_pages = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
     k_all = kvc.gather_kv(k_pages, block_tables)
     v_all = kvc.gather_kv(v_pages, block_tables)
     return causal_attention(

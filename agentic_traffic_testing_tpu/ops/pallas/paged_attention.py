@@ -52,26 +52,29 @@ _MIN_SUBLANES = 8
 
 
 def _decode_kernel(
-    # scalar prefetch
-    block_tables_ref,  # [B, max_blocks] i32 (SMEM)
-    ctx_lens_ref,      # [B, 1] i32 (SMEM)
-    # pipelined inputs
-    q_ref,             # [1, 1, qpk, hd]
-    k_ref,             # [1, 1, bs, hd]
-    v_ref,             # [1, 1, bs, hd]
-    # output
-    o_ref,             # [1, 1, qpk, hd]
-    # scratch (persists across the innermost grid dim)
-    m_ref,             # [qpk_pad, 128] f32 running max
-    l_ref,             # [qpk_pad, 128] f32 running denominator
-    acc_ref,           # [qpk_pad, hd]  f32 running numerator
-    *,
+    *refs,
     scale: float,
+    stacked: bool,
 ):
+    """Kernel body; `refs` layout depends on whether the KV operand is the
+    full stacked [L, ...] pool (`stacked`, +1 leading layer-prefetch ref and
+    a 5D page block) or a single layer's 4D pool.
+
+    Ref order: [layer_ref?], block_tables_ref [B, max_blocks] (SMEM),
+    ctx_lens_ref [B, 1] (SMEM), q_ref [1,1,qpk,hd], k_ref/v_ref page block,
+    o_ref [1,1,qpk,hd], then VMEM scratch m/l/acc (persist across the
+    innermost grid dim).
+    """
+    if stacked:
+        (_, ctx_lens_ref, q_ref, k_ref, v_ref, o_ref,
+         m_ref, l_ref, acc_ref) = refs[1:]
+    else:
+        (_, ctx_lens_ref, q_ref, k_ref, v_ref, o_ref,
+         m_ref, l_ref, acc_ref) = refs
     b = pl.program_id(0)
     j = pl.program_id(2)
     last_j = pl.num_programs(2) - 1
-    bs = k_ref.shape[2]
+    bs, hd = k_ref.shape[-2], k_ref.shape[-1]
     qpk = q_ref.shape[2]
     ctx = ctx_lens_ref[b, 0]
 
@@ -84,7 +87,7 @@ def _decode_kernel(
     @pl.when(j * bs < ctx)
     def _step():
         q = q_ref[0, 0].astype(jnp.float32) * scale          # [qpk, hd]
-        k = k_ref[0, 0].astype(jnp.float32)                  # [bs, hd]
+        k = k_ref[...].reshape(bs, hd).astype(jnp.float32)   # [bs, hd]
         s = jax.lax.dot_general(                             # [qpk, bs]
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -99,7 +102,7 @@ def _decode_kernel(
         p = jnp.exp(s - m_new)                               # [qpk, bs]
         l_new = l_ref[:qpk, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
 
-        v = v_ref[0, 0].astype(jnp.float32)                  # [bs, hd]
+        v = v_ref[...].reshape(bs, hd).astype(jnp.float32)   # [bs, hd]
         pv = jax.lax.dot_general(                            # [qpk, hd]
             p, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -119,17 +122,25 @@ def _decode_kernel(
 )
 def paged_attention_decode(
     q: jax.Array,             # [B, H, hd]
-    k_pages: jax.Array,       # [KH, num_blocks, bs, hd]
-    v_pages: jax.Array,       # [KH, num_blocks, bs, hd]
+    k_pages: jax.Array,       # [KH, num_blocks, bs, hd] or [L, KH, nb, bs, hd]
+    v_pages: jax.Array,       # same shape as k_pages
     block_tables: jax.Array,  # [B, max_blocks] i32
     ctx_lens: jax.Array,      # [B] i32
     *,
+    layer: jax.Array | None = None,  # scalar i32, required for 5D stacked pages
     scale: float | None = None,
     interpret: bool = False,
 ) -> jax.Array:
-    """Single-token paged attention. Returns [B, H, hd] in q.dtype."""
+    """Single-token paged attention. Returns [B, H, hd] in q.dtype.
+
+    5D `k_pages`/`v_pages` is the FULL stacked per-layer pool plus a `layer`
+    scalar: the layer indirection then also happens in the BlockSpec
+    index_map (layer rides scalar prefetch), so the per-layer slice is never
+    materialized — the decode scan passes the whole carry straight in.
+    """
     b, h, hd = q.shape
-    kh, num_blocks, bs, _ = k_pages.shape
+    stacked = k_pages.ndim == 5
+    kh, bs = k_pages.shape[-4], k_pages.shape[-2]
     max_blocks = block_tables.shape[1]
     qpk = h // kh
     if scale is None:
@@ -138,21 +149,42 @@ def paged_attention_decode(
 
     q_r = q.reshape(b, kh, qpk, hd)
 
-    def q_map(bi, hi, ji, bt, cl):
-        return (bi, hi, 0, 0)
+    if stacked:
+        if layer is None:
+            raise ValueError("stacked (5D) pages require a layer index")
+        layer_arr = jnp.asarray(layer, jnp.int32).reshape(1)
 
-    def kv_map(bi, hi, ji, bt, cl):
-        # Page indirection happens here, pre-DMA; trash pages repeat index 0
-        # so their copies are elided after the first.
-        return (hi, bt[bi, ji], 0, 0)
+        def q_map(bi, hi, ji, lay, bt, cl):
+            return (bi, hi, 0, 0)
+
+        def kv_map(bi, hi, ji, lay, bt, cl):
+            # Layer + page indirection pre-DMA; trash pages repeat index 0 so
+            # their copies are elided after the first.
+            return (lay[0], hi, bt[bi, ji], 0, 0)
+
+        num_prefetch = 3
+        kv_block = (1, 1, 1, bs, hd)
+        prefetch_args = (layer_arr,)
+    else:
+        def q_map(bi, hi, ji, bt, cl):
+            return (bi, hi, 0, 0)
+
+        def kv_map(bi, hi, ji, bt, cl):
+            # Page indirection happens here, pre-DMA; trash pages repeat
+            # index 0 so their copies are elided after the first.
+            return (hi, bt[bi, ji], 0, 0)
+
+        num_prefetch = 2
+        kv_block = (1, 1, bs, hd)
+        prefetch_args = ()
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=num_prefetch,
         grid=(b, kh, max_blocks),
         in_specs=[
             pl.BlockSpec((1, 1, qpk, hd), q_map),
-            pl.BlockSpec((1, 1, bs, hd), kv_map),
-            pl.BlockSpec((1, 1, bs, hd), kv_map),
+            pl.BlockSpec(kv_block, kv_map),
+            pl.BlockSpec(kv_block, kv_map),
         ],
         out_specs=pl.BlockSpec((1, 1, qpk, hd), q_map),
         scratch_shapes=[
@@ -163,13 +195,13 @@ def paged_attention_decode(
     )
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale),
+        functools.partial(_decode_kernel, scale=scale, stacked=stacked),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, qpk, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
-    )(block_tables.astype(jnp.int32), ctx_lens.astype(jnp.int32)[:, None],
-      q_r, k_pages, v_pages)
+    )(*prefetch_args, block_tables.astype(jnp.int32),
+      ctx_lens.astype(jnp.int32)[:, None], q_r, k_pages, v_pages)
     return out.reshape(b, h, hd)

@@ -50,18 +50,35 @@ def sample(
     top_k: jax.Array,        # [B] int32; <= 0 disables
     top_p: jax.Array,        # [B] fp32; >= 1 disables
 ) -> jax.Array:
-    """Sample one token per row. Greedy rows ignore the PRNG entirely."""
+    """Sample one token per row. Greedy rows ignore the PRNG entirely.
+
+    Hot-path structure: the top-k/top-p filters need full-vocab sorts
+    (~tens of ms at Llama vocab on one chip — comparable to the model step
+    itself), so each filter sits behind a `lax.cond` and only runs when some
+    row actually enables it. The common testbed paths — greedy, and plain
+    temperature sampling (reference default temperature 0.2 with both filters
+    disabled, reference: llm/serve_llm.py:379,522) — never sort.
+    """
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
-    temp = jnp.where(temperature > 0, temperature, 1.0)
-    scaled = logits / temp[:, None]
-    scaled = _apply_top_k(scaled, top_k)
-    scaled = _apply_top_p(scaled, top_p)
-    # Gumbel-max with per-row keys => per-request reproducibility inside any batch.
-    gumbel = jax.vmap(lambda k: jax.random.gumbel(k, (logits.shape[-1],), jnp.float32))(keys)
-    sampled_tok = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+    def sampled() -> jax.Array:
+        temp = jnp.where(temperature > 0, temperature, 1.0)
+        scaled = logits / temp[:, None]
+        scaled = jax.lax.cond(
+            jnp.any(top_k > 0), lambda x: _apply_top_k(x, top_k), lambda x: x, scaled
+        )
+        scaled = jax.lax.cond(
+            jnp.any(top_p < 1.0), lambda x: _apply_top_p(x, top_p), lambda x: x, scaled
+        )
+        # Gumbel-max with per-row keys => per-request reproducibility inside
+        # any batch.
+        gumbel = jax.vmap(
+            lambda k: jax.random.gumbel(k, (logits.shape[-1],), jnp.float32)
+        )(keys)
+        tok = jnp.argmax(scaled + gumbel, axis=-1).astype(jnp.int32)
+        return jnp.where(temperature > 0, tok, greedy_tok)
 
-    return jnp.where(temperature > 0, sampled_tok, greedy_tok)
+    return jax.lax.cond(jnp.all(temperature <= 0), lambda: greedy_tok, sampled)
 
 
 def make_row_keys(seeds: jax.Array, steps: jax.Array) -> jax.Array:

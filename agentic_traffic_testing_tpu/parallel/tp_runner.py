@@ -34,11 +34,12 @@ class TPRunner(ModelRunner):
     # the partitioner shards cleanly (kernel-under-shard_map is future work).
     attn_mode = "gather"
 
-    def __init__(self, cfg: ModelConfig, params, mesh: Mesh) -> None:
+    def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
+                 decode_steps: int = 1) -> None:
         validate_tp(cfg, mesh.shape[AXIS_TP])
         self.mesh = mesh
         params = shard_params(params, cfg, mesh)
-        super().__init__(cfg, params)
+        super().__init__(cfg, params, decode_steps=decode_steps)
 
     @property
     def tp_size(self) -> int:

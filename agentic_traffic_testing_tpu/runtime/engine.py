@@ -6,8 +6,8 @@ llm/serve_llm.py:343-612) with a first-party engine:
   host (Python)                       device (TPU, jitted)
   ─────────────                       ────────────────────
   Scheduler.plan()  ──────────────▶   fused prefill+sample   (one dispatch)
-  block allocation                    fused decode+sample    (one dispatch/step)
-  stop conditions, streaming  ◀────   sampled tokens [B] (async readback)
+  block allocation                    fused K-step decode+sample (one dispatch)
+  stop conditions, streaming  ◀────   sampled tokens [B, K] (async readback)
 
 Key TPU-driven design points:
   * Decode advances entirely on device (DecodeState feeds itself); the host
@@ -75,19 +75,31 @@ class EngineConfig:
     block_size: int = 16
     num_blocks: Optional[int] = None       # None -> derive from HBM budget
     memory_utilization: float = 0.90       # LLM_GPU_MEMORY_UTILIZATION analog
-    pipeline_depth: int = 2                # decode steps in flight before readback
+    pipeline_depth: int = 2                # decode dispatches in flight before readback
+    # Model steps fused into ONE decode dispatch (lax.scan on device). The
+    # sampled token feeds the next step without host involvement, so dispatch
+    # round-trip cost is amortized K×. None -> auto: 8 on TPU (dispatch-latency
+    # bound), 1 elsewhere (keeps CPU tests step-exact by default).
+    decode_steps: Optional[int] = None
     seed: int = 0
     # None = auto (C++ native/ core if it builds, Python otherwise);
     # True/False force one implementation.
     native_allocator: Optional[bool] = None
 
-    def scheduler_config(self) -> SchedulerConfig:
+    def resolved_decode_steps(self, platform: str) -> int:
+        if self.decode_steps is not None:
+            return max(1, self.decode_steps)
+        return 8 if platform == "tpu" else 1
+
+    def scheduler_config(self, decode_steps: int = 1) -> SchedulerConfig:
+        # Lookahead must cover every KV write a lagged in-flight dispatch can
+        # make: (pipeline_depth unharvested + 1 dispatching) × decode_steps.
         return SchedulerConfig(
             max_num_seqs=self.max_num_seqs,
             max_num_batched_tokens=self.max_num_batched_tokens,
             max_model_len=self.max_model_len,
             block_size=self.block_size,
-            decode_lookahead=max(4, 2 * self.pipeline_depth),
+            decode_lookahead=max(4, (self.pipeline_depth + 1) * decode_steps),
         )
 
 
@@ -123,13 +135,17 @@ class LLMEngine:
         self.cfg = cfg
         self.model_cfg = model_cfg or resolve_config(cfg.model)
         dtype = jnp.bfloat16 if cfg.dtype in ("bfloat16", "bf16") else jnp.float32
+        platform = jax.devices()[0].platform
+        decode_steps = cfg.resolved_decode_steps(platform)
         if runner is not None:
             self.runner = runner
+            decode_steps = runner.decode_steps
         else:
             if params is None:
                 log.warning("no checkpoint: random-initializing %s", self.model_cfg.name)
                 params = init_params(self.model_cfg, jax.random.key(cfg.seed), dtype=dtype)
-            self.runner = ModelRunner(self.model_cfg, params)
+            self.runner = ModelRunner(self.model_cfg, params,
+                                      decode_steps=decode_steps)
 
         num_blocks = cfg.num_blocks or self._default_num_blocks()
         self.cache = self.runner.prepare_cache(
@@ -137,7 +153,7 @@ class LLMEngine:
         )
         self.allocator = make_block_allocator(num_blocks, cfg.block_size,
                                               native=cfg.native_allocator)
-        self.scheduler = Scheduler(cfg.scheduler_config(), self.allocator)
+        self.scheduler = Scheduler(cfg.scheduler_config(decode_steps), self.allocator)
         # Fixed block-table width: worst-case blocks for max_model_len.
         self.table_width = -(-cfg.max_model_len // cfg.block_size)
 
@@ -392,14 +408,17 @@ class LLMEngine:
         return any(r.is_finished() for r in inf.requests)
 
     def _apply_inflight(self, inf: _Inflight) -> None:
-        toks = np.asarray(jax.device_get(inf.tokens))
+        toks = np.asarray(jax.device_get(inf.tokens))  # [B, decode_steps]
         now = time.monotonic()
         for i, r in enumerate(inf.requests):
             if r.is_finished() or r.state is not RequestState.RUNNING:
                 continue  # stopped at an earlier lagged step, or preempted
             if r.first_token_time is None:
                 r.first_token_time = now
-            self._append_token(r, int(toks[i]))
+            for tok in toks[i]:
+                self._append_token(r, int(tok))
+                if r.is_finished():
+                    break  # device tokens past the stop point are dropped
 
     def _append_token(self, r: Request, tok: int) -> None:
         r.output_ids.append(tok)

@@ -1,12 +1,12 @@
 """ModelRunner: fused device dispatches for the serving engine.
 
-Each scheduled step is ONE device dispatch: model forward + on-device
-sampling, with the sampled token fed straight back as the next step's input
-without touching the host. This matters doubly on TPU: (a) XLA fuses the
-sampling epilogue into the decode program; (b) host↔device round trips are
-the dominant per-step cost at small batch (observed ~10-100 ms through the
-axon tunnel vs ~ms of compute), so the engine only *reads back* a [B] int32
-token array — asynchronously, with a configurable lag (engine.py).
+Each scheduled step is ONE device dispatch: `decode_steps` fused model steps
++ on-device sampling, with each sampled token fed straight back as the next
+step's input without touching the host. This matters doubly on TPU: (a) XLA
+fuses the sampling epilogue into the decode program; (b) host↔device round
+trips are expensive at small batch (observed ~10-100 ms through the axon
+tunnel vs ~ms of compute), so the engine only *reads back* a [B, decode_steps]
+int32 token array — asynchronously, with a configurable lag (engine.py).
 
 The vLLM analog is the streaming `engine.generate` hot loop the reference
 consumes (reference: llm/serve_llm.py:527-605); there the engine process owns
@@ -57,27 +57,43 @@ def _prefill_sample_impl(params, cfg: ModelConfig, tokens, cache, block_tables,
 
 def _decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
                         state: DecodeState, samp: SamplingArrays,
-                        attn_mode=None):
-    logits, cache = decode_step_impl(params, cfg, state.tokens, cache,
-                                     block_tables, state.positions,
-                                     attn_mode=attn_mode)
-    keys = make_row_keys(samp.seeds, state.steps)
-    out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
-    new_state = DecodeState(tokens=out, positions=state.positions + 1, steps=state.steps + 1)
-    return new_state, cache, out
+                        num_steps: int = 1, attn_mode=None):
+    """`num_steps` fused decode steps in ONE dispatch (lax.scan on device).
+
+    The sampled token feeds the next step without leaving the device, so the
+    host pays one dispatch round trip per `num_steps` tokens — the decisive
+    lever when dispatch latency (not compute) bounds small-batch decode.
+    Returns tokens [B, num_steps]; tokens sampled past a request's stop point
+    are dropped host-side at harvest (engine.py), so output text is exact.
+    """
+
+    def body(carry, _):
+        st, cache = carry
+        logits, cache = decode_step_impl(params, cfg, st.tokens, cache,
+                                         block_tables, st.positions,
+                                         attn_mode=attn_mode)
+        keys = make_row_keys(samp.seeds, st.steps)
+        out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
+        new_st = DecodeState(tokens=out, positions=st.positions + 1, steps=st.steps + 1)
+        return (new_st, cache), out
+
+    (state, cache), toks = jax.lax.scan(body, (state, cache), None, length=num_steps)
+    return state, cache, toks.T  # [B, num_steps]
 
 
 class ModelRunner:
     """Single-device runner. Owns the jitted step programs (not the cache)."""
 
-    def __init__(self, cfg: ModelConfig, params) -> None:
+    def __init__(self, cfg: ModelConfig, params, decode_steps: int = 1) -> None:
         self.cfg = cfg
         self.params = params
+        self.decode_steps = max(1, int(decode_steps))
         self._prefill = jax.jit(
             partial(_prefill_sample_impl, cfg=cfg), donate_argnames=("cache",)
         )
         self._decode = jax.jit(
-            partial(_decode_sample_impl, cfg=cfg, attn_mode=self.attn_mode),
+            partial(_decode_sample_impl, cfg=cfg, num_steps=self.decode_steps,
+                    attn_mode=self.attn_mode),
             donate_argnames=("cache",),
         )
 
@@ -98,7 +114,9 @@ class ModelRunner:
                              samp=samp, steps=steps)
 
     def decode(self, cache, block_tables, state, samp):
-        """-> (DecodeState, cache, sampled_tokens [B]). One fused dispatch."""
+        """-> (DecodeState, cache, sampled_tokens [B, decode_steps]).
+
+        One fused dispatch covering `decode_steps` model steps."""
         return self._decode(self.params, cache=cache, block_tables=block_tables,
                             state=state, samp=samp)
 

@@ -86,6 +86,7 @@ class LLMServer:
             max_num_batched_tokens=c.max_num_batched_tokens,
             max_model_len=c.max_model_len, block_size=c.block_size,
             num_blocks=c.num_blocks, memory_utilization=c.memory_utilization,
+            decode_steps=c.decode_steps,
         )
         runner = None
         params = None
@@ -103,7 +104,10 @@ class LLMServer:
             if params is None:
                 dtype = jnp.bfloat16 if c.dtype in ("bfloat16", "bf16") else jnp.float32
                 params = init_params(model_cfg, jax.random.key(0), dtype=dtype)
-            runner = TPRunner(model_cfg, params, single_axis_mesh("tp", c.tp_size))
+            runner = TPRunner(
+                model_cfg, params, single_axis_mesh("tp", c.tp_size),
+                decode_steps=ecfg.resolved_decode_steps(jax.devices()[0].platform),
+            )
             return LLMEngine(ecfg, model_cfg=model_cfg, runner=runner)
         if c.weights_path:
             from agentic_traffic_testing_tpu.models.config import resolve_config
