@@ -30,6 +30,7 @@ import jax.numpy as jnp
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig
 from agentic_traffic_testing_tpu.ops.attention_backend import paged_decode_attention
+from agentic_traffic_testing_tpu.ops.kv_writer import write_prompt_pages
 from agentic_traffic_testing_tpu.ops.jnp_ops import (
     apply_rope,
     causal_attention,
@@ -54,7 +55,13 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         (bq/bk/bv [L, ...] when cfg.qkv_bias — the Qwen2 variant)
         w_gate [L, D, F]; w_up [L, D, F]; w_down [L, F, D]
       final_norm [D]
-      lm_head    [V, D]  (absent when cfg.tie_word_embeddings)
+      unembed    [D, V]  (== tok_embed.T when cfg.tie_word_embeddings)
+
+    The unembed projection is stored PRE-TRANSPOSED as [D, V]: feeding a
+    [V, D] matrix to `x @ head.T` makes XLA materialize the ~0.5 GB transpose
+    on every decode step (measured ~6 ms/step on v5e at Llama vocab). Tied
+    configs trade one extra copy of the embedding table in HBM for that; the
+    tie is enforced at init/load time (training treats them as independent).
     """
     d, hd, f = cfg.hidden_size, cfg.head_dim_, cfg.intermediate_size
     h, kh, L, v = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers, cfg.vocab_size
@@ -83,8 +90,9 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         "layers": layers,
         "final_norm": jnp.ones((d,), dtype),
     }
-    if not cfg.tie_word_embeddings:
-        params["lm_head"] = w(next(keys), (v, d))
+    params["unembed"] = (
+        params["tok_embed"].T if cfg.tie_word_embeddings else w(next(keys), (d, v))
+    )
     return params
 
 
@@ -111,8 +119,7 @@ def _mlp_block(x: jax.Array, lp: dict) -> jax.Array:
 
 
 def _unembed(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
-    head = params["tok_embed"] if cfg.tie_word_embeddings else params["lm_head"]
-    return (x @ head.T).astype(jnp.float32)
+    return (x @ params["unembed"]).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -166,36 +173,42 @@ def prefill_impl(
     cache: KVCache,           # donated
     block_tables: jax.Array,  # [B, max_blocks] (padding rows -> TRASH_BLOCK)
     seq_lens: jax.Array,      # [B] true prompt lengths
+    kv_writer_mode: Optional[str] = None,  # static; see ops/kv_writer.py
 ) -> tuple[jax.Array, KVCache]:
-    """Returns (last-token logits [B, V] fp32, updated cache)."""
+    """Returns (last-token logits [B, V] fp32, updated cache).
+
+    KV-pool population is deferred: the layer scan emits each layer's K/V
+    (head-major, lane-padded to the pool's page width) as scan outputs, and
+    ONE bulk write lands every page afterwards (ops/kv_writer.py) — keeping
+    page writes out of the layer scan stops them serializing against layer
+    compute (~3x prefill win on v5e). Attention uses the in-register K/V, so
+    numerics don't depend on the pool at all here.
+    """
     b, t = tokens.shape
     if t % cache.block_size != 0:  # trace-time check: unaligned tails would be dropped
         raise ValueError(f"prefill length {t} not a multiple of block_size {cache.block_size}")
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
     x = params["tok_embed"][tokens]
     sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    hd, hdp = cfg.head_dim_, cache.k.shape[-1]
 
-    def body(carry, xs):
-        x, kc, vc = carry
-        lp, li = xs
+    def body(x, lp):
         xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
         q, k, v = _qkv(xa, lp, cfg)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        # Chained DUS into the full pool: in-place on TPU, where a scatter
-        # would copy the pool per layer (see write_prompt_kv_full docstring).
-        kc = kvc.write_prompt_kv_full(kc, li, k, block_tables)
-        vc = kvc.write_prompt_kv_full(vc, li, v, block_tables)
         attn = causal_attention(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
         x = x + attn.reshape(b, t, -1) @ lp["wo"]
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         x = x + _mlp_block(xm, lp)
-        return (x, kc, vc), None
+        pad = ((0, 0), (0, 0), (0, 0), (0, hdp - hd))
+        k_pages = jnp.pad(k.transpose(0, 2, 1, 3), pad)  # [B, KH, T, hdp]
+        v_pages = jnp.pad(v.transpose(0, 2, 1, 3), pad)
+        return x, (k_pages.astype(cache.k.dtype), v_pages.astype(cache.v.dtype))
 
-    (x, kc, vc), _ = jax.lax.scan(
-        body, (x, cache.k, cache.v),
-        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
-    )
+    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    kc, vc = write_prompt_pages(cache.k, cache.v, ks, vs, block_tables,
+                                mode=kv_writer_mode)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jnp.take_along_axis(x, jnp.maximum(seq_lens - 1, 0)[:, None, None], axis=1)[:, 0]
     return _unembed(last[:, None, :], params, cfg)[:, 0], KVCache(kc, vc)
@@ -257,5 +270,6 @@ def decode_step_impl(
 # its own fused jits from the *_impl functions (model step + on-device
 # sampling in one dispatch — see runtime/runner.py).
 forward_full = jax.jit(forward_full_impl, static_argnames=("cfg",))
-prefill = jax.jit(prefill_impl, static_argnames=("cfg",), donate_argnums=(3,))
+prefill = jax.jit(prefill_impl, static_argnames=("cfg", "kv_writer_mode"),
+                  donate_argnums=(3,))
 decode_step = jax.jit(decode_step_impl, static_argnames=("cfg", "attn_mode"), donate_argnums=(3,))

@@ -67,7 +67,8 @@ def _hf_tensor_plan(cfg: ModelConfig) -> dict[str, tuple]:
         "model.norm.weight": (("final_norm",), None, False),
     }
     if not cfg.tie_word_embeddings:
-        plan["lm_head.weight"] = (("lm_head",), None, False)
+        # Stored pre-transposed [D, V]; see models/llama.py init_params note.
+        plan["lm_head.weight"] = (("unembed",), None, True)
     for i in range(cfg.num_layers):
         p = f"model.layers.{i}."
         plan[p + "input_layernorm.weight"] = (("layers", "ln_attn"), i, False)
@@ -109,9 +110,8 @@ def _alloc_stacked(cfg: ModelConfig, dtype) -> dict:
         "tok_embed": np.empty((v, d), dtype),
         "layers": layers,
         "final_norm": np.empty((d,), dtype),
+        "unembed": np.empty((d, v), dtype),
     }
-    if not cfg.tie_word_embeddings:
-        out["lm_head"] = np.empty((v, d), dtype)
     return out
 
 
@@ -141,6 +141,8 @@ def params_from_hf_state_dict(cfg: ModelConfig, state_dict: dict, dtype=np.float
     missing = set(plan) - seen
     if missing:
         raise ValueError(f"missing tensors for {cfg.name}: {sorted(missing)[:8]}...")
+    if cfg.tie_word_embeddings:
+        params["unembed"][...] = params["tok_embed"].T
     return _to_jax(params)
 
 
@@ -163,6 +165,8 @@ def load_params(model_dir: str, cfg: ModelConfig | None = None, dtype=jnp.bfloat
     missing = set(plan) - seen
     if missing:
         raise ValueError(f"checkpoint incomplete: missing {sorted(missing)[:8]}...")
+    if cfg.tie_word_embeddings:
+        params["unembed"][...] = params["tok_embed"].T
     return cfg, _to_jax(params)
 
 

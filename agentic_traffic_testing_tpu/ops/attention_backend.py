@@ -1,14 +1,18 @@
-"""Decode-attention backend dispatch: Pallas kernel on TPU, jnp gather oracle
-elsewhere.
+"""Decode-attention backend dispatch: Pallas DMA kernel on TPU, jnp gather
+oracle elsewhere.
 
 Selected once at trace time (the choice is baked into the jitted decode
 program, like picking a kernel at engine build in the reference's vLLM
 backend). Override with ATT_TPU_ATTENTION:
 
-    auto     (default) pallas on TPU, gather on CPU/GPU
-    pallas   force the Pallas kernel (compiled)
-    interpret force the Pallas kernel in interpreter mode (CPU correctness)
-    gather   force the jnp gather reference path
+    auto      (default) dma on TPU, gather on CPU/GPU
+    dma       grid-(B,KH) kernel, double-buffered manual page DMA
+    pallas    v1 kernel, one BlockSpec pipeline step per page (slower at
+              short context: ~2-3 us grid overhead per 2 KB page)
+    interpret v1 kernel in interpreter mode (CPU correctness tests; the dma
+              kernel's interpret path is exercised directly in
+              tests/test_pallas_paged_attention.py)
+    gather    jnp gather reference path (forced by the GSPMD TP runner)
 """
 
 from __future__ import annotations
@@ -20,31 +24,21 @@ import jax
 from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
 from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
     paged_attention_decode,
+    paged_attention_decode_dma,
 )
 from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
 
 
-VALID_MODES = ("auto", "pallas", "interpret", "gather")
-
-# Below this padded KV length (max_blocks * block_size), the jnp gather path
-# beats the Pallas kernel on TPU: the kernel's one-page-per-grid-step DMAs
-# (~2 KB each) pay ~2-3 us of grid overhead per page, while the gather's
-# materialized [B, kv_len, KH, hd] stays small. Measured crossover on v5e
-# with Llama-3.2-1B shapes; see bench notes in the r1 commit history.
-GATHER_CUTOVER_TOKENS = 2048
+VALID_MODES = ("auto", "dma", "pallas", "interpret", "gather")
 
 
-def backend_choice(padded_kv_len: int | None = None) -> str:
+def backend_choice() -> str:
     mode = os.environ.get("ATT_TPU_ATTENTION", "auto")
     if mode not in VALID_MODES:
         raise ValueError(
             f"ATT_TPU_ATTENTION={mode!r} invalid; choose one of {VALID_MODES}")
     if mode == "auto":
-        if jax.default_backend() != "tpu":
-            return "gather"
-        if padded_kv_len is not None and padded_kv_len <= GATHER_CUTOVER_TOKENS:
-            return "gather"
-        return "pallas"
+        return "dma" if jax.default_backend() == "tpu" else "gather"
     return mode
 
 
@@ -73,19 +67,24 @@ def paged_decode_attention(
         raise ValueError("stacked (5D) pages require a layer index")
     ctx_lens = positions + 1
     if mode is None:
-        mode = backend_choice(block_tables.shape[1] * k_pages.shape[-2])
+        mode = backend_choice()
+    lay = layer if k_pages.ndim == 5 else None
+    if mode == "dma":
+        return paged_attention_decode_dma(
+            q[:, 0], k_pages, v_pages, block_tables, ctx_lens, layer=lay,
+        )[:, None]
     if mode in ("pallas", "interpret"):
         out = paged_attention_decode(
             q[:, 0], k_pages, v_pages, block_tables, ctx_lens,
-            layer=(layer if k_pages.ndim == 5 else None),
-            interpret=(mode == "interpret"),
+            layer=lay, interpret=(mode == "interpret"),
         )
         return out[:, None]
     if k_pages.ndim == 5:
         k_pages = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
         v_pages = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
-    k_all = kvc.gather_kv(k_pages, block_tables)
-    v_all = kvc.gather_kv(v_pages, block_tables)
+    hd = q.shape[-1]  # pool lanes may be padded wider (kv_cache.phys_head_dim)
+    k_all = kvc.gather_kv(k_pages, block_tables)[..., :hd]
+    v_all = kvc.gather_kv(v_pages, block_tables)[..., :hd]
     return causal_attention(
         q, k_all, v_all, q_positions=positions[:, None], kv_valid_len=ctx_lens
     )

@@ -1,0 +1,74 @@
+"""Prompt-page KV-writer dispatch for the prefill step.
+
+The layer scan collects every layer's K/V (lane-padded, head-major) and one
+bulk write lands them in the paged pool afterwards. Deferring the writes out
+of the layer scan was the big win on v5e (~300 ms -> ~110 ms for an 8×128
+prefill): page writes no longer serialize against layer compute.
+
+Two writers:
+  * `dus` (default): lax.scan over layers of chained dynamic_update_slice —
+    in-place after the first update, shards cleanly under GSPMD TP.
+  * `pallas`: one async DMA per page (ops/pallas/kv_write.py). Measured
+    SLOWER than the DUS chain on v5e (strided HBM->HBM DMAs, ~3x) — kept as
+    an opt-in because the balance may flip on other topologies/page sizes.
+
+Override with ATT_TPU_KV_WRITER: auto | pallas | interpret | dus.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.ops.pallas.kv_write import write_prompt_kv_pallas
+from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
+
+VALID_MODES = ("auto", "pallas", "interpret", "dus")
+
+
+def writer_choice() -> str:
+    mode = os.environ.get("ATT_TPU_KV_WRITER", "auto")
+    if mode not in VALID_MODES:
+        raise ValueError(
+            f"ATT_TPU_KV_WRITER={mode!r} invalid; choose one of {VALID_MODES}")
+    if mode == "auto":
+        return "dus"
+    return mode
+
+
+def write_prompt_pages(
+    pool_k: jax.Array,        # [L, KH, NB, bs, hdp]
+    pool_v: jax.Array,
+    new_k: jax.Array,         # [L, B, KH, T, hdp] (lane-padded, head-major)
+    new_v: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks]
+    mode: str | None = None,
+) -> tuple[jax.Array, jax.Array]:
+    """Write every prompt page of every layer into the pool."""
+    if mode is None:
+        mode = writer_choice()
+    if mode in ("pallas", "interpret"):
+        return write_prompt_kv_pallas(
+            new_k, new_v, pool_k, pool_v, block_tables,
+            interpret=(mode == "interpret"),
+        )
+
+    # DUS-chain fallback: scan over layers, one chained-DUS pass per layer
+    # (kv_cache.write_prompt_kv_full) — in-place, just serialized.
+    def body(carry, xs):
+        kc, vc = carry
+        k_l, v_l, li = xs
+        k_bt = k_l.transpose(0, 2, 1, 3)  # [B, T, KH, hdp]
+        v_bt = v_l.transpose(0, 2, 1, 3)
+        kc = kvc.write_prompt_kv_full(kc, li, k_bt, block_tables)
+        vc = kvc.write_prompt_kv_full(vc, li, v_bt, block_tables)
+        return (kc, vc), None
+
+    L = new_k.shape[0]
+    (pool_k, pool_v), _ = jax.lax.scan(
+        body, (pool_k, pool_v),
+        (new_k, new_v, jnp.arange(L, dtype=jnp.int32)),
+    )
+    return pool_k, pool_v

@@ -1,0 +1,123 @@
+"""Pallas TPU bulk KV-cache prompt writer.
+
+Prefill must land B×(T/bs) pages into the paged pool. Doing that with
+chained `dynamic_update_slice` serializes every page write behind the
+previous one (XLA cannot prove the destinations disjoint) — measured ~200 ms
+for an 8×128-token prompt batch on v5e, dwarfing the prefill matmuls. A
+scatter is no better: XLA:TPU lowers it as copy-the-pool-then-update.
+
+This kernel does what the hardware wants: one grid program per (layer,
+sequence) issues an async DMA per page straight from the [L, B, KH, T, hdp]
+prompt K/V (HBM) into the pool (HBM, aliased in/out so the write is in
+place), then waits. Pages of different programs are disjoint by
+construction (the allocator hands each sequence distinct blocks; padding
+lanes all point at the trash block, where last-writer-wins is harmless).
+
+The vLLM analog is the CUDA `reshape_and_cache` kernel family the reference
+uses through its vllm dependency (SURVEY.md §2.2 "paged-attention CUDA
+kernels + block KV-cache manager").
+
+Layout notes:
+  * `new_k`/`new_v` come in already head-major and lane-padded:
+    [L, B, KH, T, hdp] with hdp = kv_cache.phys_head_dim(head_dim) — the
+    pool's page lanes — so every DMA is a tile-aligned [KH, bs, hdp] window
+    (Mosaic cannot DMA sub-lane-width slices).
+  * T % block_size == 0 (the scheduler's prefill buckets are block-aligned).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _write_kernel(
+    bt_ref,        # [B, max_blocks] i32 (SMEM, scalar prefetch)
+    new_k_ref,     # [L, B, KH, T, hdp] (ANY/HBM)
+    new_v_ref,     # [L, B, KH, T, hdp] (ANY/HBM)
+    pool_k_in,     # [L, KH, NB, bs, hdp] (ANY/HBM, aliased to out)
+    pool_v_in,
+    pool_k_out,
+    pool_v_out,
+    sem_k,
+    sem_v,
+    *,
+    block_size: int,
+    num_pages: int,
+):
+    del pool_k_in, pool_v_in  # the aliased output refs are the pool
+    li = pl.program_id(0)
+    b = pl.program_id(1)
+    bs = block_size
+
+    def page_copy(j, new_ref, pool_ref, sem):
+        blk = bt_ref[b, j]
+        return pltpu.make_async_copy(
+            new_ref.at[li, b, :, pl.ds(j * bs, bs), :],
+            pool_ref.at[li, :, blk, :, :],
+            sem,
+        )
+
+    for j in range(num_pages):  # static unroll: issue all page DMAs ...
+        page_copy(j, new_k_ref, pool_k_out, sem_k).start()
+        page_copy(j, new_v_ref, pool_v_out, sem_v).start()
+    for j in range(num_pages):  # ... then drain them
+        page_copy(j, new_k_ref, pool_k_out, sem_k).wait()
+        page_copy(j, new_v_ref, pool_v_out, sem_v).wait()
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def write_prompt_kv_pallas(
+    new_k: jax.Array,         # [L, B, KH, T, hdp]
+    new_v: jax.Array,         # [L, B, KH, T, hdp]
+    pool_k: jax.Array,        # [L, KH, NB, bs, hdp] (donated by caller's jit)
+    pool_v: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks] i32
+    *,
+    interpret: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Write every prompt page into the pool in place; returns the pools."""
+    L, b, kh, t, hdp = new_k.shape
+    bs = pool_k.shape[3]
+    if t % bs:
+        raise ValueError(f"prompt length {t} not a multiple of block_size {bs}")
+    if hdp != pool_k.shape[4]:
+        raise ValueError(f"lane-padded head dim {hdp} != pool lanes {pool_k.shape[4]}")
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(L, b),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=[
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()),
+            pltpu.SemaphoreType.DMA(()),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_write_kernel, block_size=bs, num_pages=t // bs),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct(pool_k.shape, pool_k.dtype),
+            jax.ShapeDtypeStruct(pool_v.shape, pool_v.dtype),
+        ],
+        # Operand numbering includes the scalar-prefetch arg: bt=0, new_k=1,
+        # new_v=2, pool_k=3, pool_v=4.
+        input_output_aliases={3: 0, 4: 1},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), new_k, new_v, pool_k, pool_v)

@@ -117,6 +117,178 @@ def _decode_kernel(
         o_ref[0, 0] = (acc_ref[:qpk, :] / l).astype(o_ref.dtype)
 
 
+def _dma_decode_kernel(
+    *refs,
+    scale: float,
+    pages_per_chunk: int,
+    stacked: bool,
+):
+    """Decode kernel v2: one grid program per (sequence, kv-head), pages
+    streamed from the HBM pool by explicit double-buffered DMA.
+
+    v1 (above) pays one grid/pipeline step per page: at 2 KB pages that is
+    ~2-3 us of step overhead each, which dominates short-context decode. Here
+    the grid is just (B, KH); each program walks its sequence's block list in
+    chunks of `pages_per_chunk`, issuing the next chunk's page DMAs while the
+    MXU works on the current one (flash-attention online softmax across
+    chunks, fp32 accumulation, values carried through a fori_loop).
+
+    Ref order: [layer_ref?], block_tables_ref [B, W] (SMEM), ctx_lens_ref
+    [B, 1] (SMEM), q_ref [1,1,qpk,hd] (VMEM), k_hbm/v_hbm (ANY: the full pool,
+    4D or stacked 5D), o_ref [1,1,qpk,hd], k_buf/v_buf [2, CP*bs, hd] VMEM
+    scratch, sems DMA-semaphore array [2, 2].
+    """
+    if stacked:
+        layer_ref = refs[0]
+        (bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
+         k_buf, v_buf, sems) = refs[1:]
+    else:
+        layer_ref = None
+        (bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
+         k_buf, v_buf, sems) = refs
+    b = pl.program_id(0)
+    h = pl.program_id(1)
+    cp = pages_per_chunk
+    bs = k_buf.shape[1] // cp
+    hd = k_buf.shape[2]
+    qpk = q_ref.shape[2]
+    w = bt_ref.shape[1]
+    ctx = cl_ref[b, 0]
+    n_pages = jax.lax.div(ctx + bs - 1, bs)
+    n_chunks = jax.lax.div(n_pages + cp - 1, cp)
+
+    def page_copy(ci, p, slot, kv_hbm, buf, sem_col):
+        """Descriptor for page p of chunk ci into buf[slot]; start+wait pair."""
+        pi = jnp.minimum(ci * cp + p, w - 1)
+        blk = bt_ref[b, pi]
+        src = (kv_hbm.at[layer_ref[0], h, blk]
+               if stacked else kv_hbm.at[h, blk])
+        return pltpu.make_async_copy(
+            src, buf.at[slot, pl.ds(p * bs, bs), :], sems.at[slot, sem_col]
+        )
+
+    def issue(ci, slot):
+        for p in range(cp):  # static unroll; CP DMAs per kv per chunk
+            page_copy(ci, p, slot, k_hbm, k_buf, 0).start()
+            page_copy(ci, p, slot, v_hbm, v_buf, 1).start()
+
+    def wait(ci, slot):
+        for p in range(cp):
+            page_copy(ci, p, slot, k_hbm, k_buf, 0).wait()
+            page_copy(ci, p, slot, v_hbm, v_buf, 1).wait()
+
+    issue(0, 0)
+    q = q_ref[0, 0].astype(jnp.float32) * scale                  # [qpk, hd]
+
+    def chunk_step(ci, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < n_chunks)
+        def _prefetch():
+            issue(ci + 1, jax.lax.rem(ci + 1, 2))
+
+        wait(ci, slot)
+        k = k_buf[slot].astype(jnp.float32)                      # [cp*bs, hd]
+        v = v_buf[slot].astype(jnp.float32)
+        s = jax.lax.dot_general(                                 # [qpk, cp*bs]
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        pos = ci * cp * bs + jax.lax.broadcasted_iota(jnp.int32, (qpk, cp * bs), 1)
+        s = jnp.where(pos < ctx, s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        p_ = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p_, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((qpk, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((qpk, 1), jnp.float32)
+    a0 = jnp.zeros((qpk, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_chunks, chunk_step, (m0, l0, a0))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "pages_per_chunk", "interpret")
+)
+def paged_attention_decode_dma(
+    q: jax.Array,             # [B, H, hd]
+    k_pages: jax.Array,       # [KH, nb, bs, hd] or [L, KH, nb, bs, hd]
+    v_pages: jax.Array,       # same shape as k_pages
+    block_tables: jax.Array,  # [B, max_blocks] i32
+    ctx_lens: jax.Array,      # [B] i32
+    *,
+    layer: jax.Array | None = None,
+    scale: float | None = None,
+    pages_per_chunk: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode paged attention, DMA-pipelined variant (see _dma_decode_kernel)."""
+    b, h, hd = q.shape
+    stacked = k_pages.ndim == 5
+    if stacked and layer is None:
+        raise ValueError("stacked (5D) pages require a layer index")
+    kh, bs, hd_page = k_pages.shape[-4], k_pages.shape[-2], k_pages.shape[-1]
+    max_blocks = block_tables.shape[1]
+    qpk = h // kh
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    cp = min(pages_per_chunk, max_blocks)
+
+    q_r = q.reshape(b, kh, qpk, hd)
+    if hd_page != hd:
+        # Pool lanes are padded (kv_cache.phys_head_dim); zero-pad q so the
+        # pad lanes contribute nothing to scores, slice them off the output.
+        q_r = jnp.pad(q_r, ((0, 0), (0, 0), (0, 0), (0, hd_page - hd)))
+        hd = hd_page
+    if stacked:
+        def q_map(bi, hi, lay, bt, cl):
+            return (bi, hi, 0, 0)
+        prefetch_args = (jnp.asarray(layer, jnp.int32).reshape(1),)
+    else:
+        def q_map(bi, hi, bt, cl):
+            return (bi, hi, 0, 0)
+        prefetch_args = ()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2 + len(prefetch_args),
+        grid=(b, kh),
+        in_specs=[
+            pl.BlockSpec((1, 1, qpk, hd), q_map),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, 1, qpk, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((2, cp * bs, hd), k_pages.dtype),
+            pltpu.VMEM((2, cp * bs, hd), k_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _dma_decode_kernel, scale=scale, pages_per_chunk=cp,
+            stacked=stacked,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, qpk, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel"),
+        ),
+        interpret=interpret,
+    )(*prefetch_args, block_tables.astype(jnp.int32),
+      ctx_lens.astype(jnp.int32)[:, None], q_r, k_pages, v_pages)
+    return out.reshape(b, h, hd)[..., : q.shape[-1]]
+
+
 @functools.partial(
     jax.jit, static_argnames=("scale", "interpret")
 )
@@ -140,7 +312,7 @@ def paged_attention_decode(
     """
     b, h, hd = q.shape
     stacked = k_pages.ndim == 5
-    kh, bs = k_pages.shape[-4], k_pages.shape[-2]
+    kh, bs, hd_page = k_pages.shape[-4], k_pages.shape[-2], k_pages.shape[-1]
     max_blocks = block_tables.shape[1]
     qpk = h // kh
     if scale is None:
@@ -148,6 +320,11 @@ def paged_attention_decode(
     qpk_pad = max(qpk, _MIN_SUBLANES)
 
     q_r = q.reshape(b, kh, qpk, hd)
+    if hd_page != hd:
+        # Pool lanes are padded (kv_cache.phys_head_dim); zero-pad q so the
+        # pad lanes contribute nothing to scores, slice them off the output.
+        q_r = jnp.pad(q_r, ((0, 0), (0, 0), (0, 0), (0, hd_page - hd)))
+        hd = hd_page
 
     if stacked:
         if layer is None:
@@ -204,4 +381,4 @@ def paged_attention_decode(
         interpret=interpret,
     )(*prefetch_args, block_tables.astype(jnp.int32),
       ctx_lens.astype(jnp.int32)[:, None], q_r, k_pages, v_pages)
-    return out.reshape(b, h, hd)
+    return out.reshape(b, h, hd)[..., : q.shape[-1]]

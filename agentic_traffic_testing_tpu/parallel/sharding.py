@@ -12,9 +12,9 @@ Layout (param schema from models/llama.py:init_params, stacked [L, ...]):
     w_gate/up [L, D, F]    column-parallel
     w_down    [L, F, D]    row-parallel
     norms     [·, D]       replicated
-    tok_embed [V, D]       V-sharded when tied to lm_head (Megatron vocab-
-                            parallel), D-sharded otherwise (local gather)
-    lm_head   [V, D]       V-sharded -> logits arrive V-sharded; sampling's
+    tok_embed [V, D]       D-sharded (the token gather stays chip-local;
+                            XLA all-gathers the small [B,T,D] activations)
+    unembed   [D, V]       V-sharded -> logits arrive V-sharded; sampling's
                             argmax/sort reductions run as XLA collectives
     KV cache  [L, KH, nb, bs, hd] shard KV heads on `tp`
 
@@ -61,14 +61,11 @@ def param_pspecs(cfg: ModelConfig) -> dict:
         layers["bk"] = P(None, AXIS_TP)
         layers["bv"] = P(None, AXIS_TP)
     specs: dict = {
-        # Tied embeddings double as the lm_head -> must be vocab-sharded;
-        # untied embeddings shard D so the token gather stays chip-local.
-        "tok_embed": P(AXIS_TP, None) if cfg.tie_word_embeddings else P(None, AXIS_TP),
+        "tok_embed": P(None, AXIS_TP),
         "layers": layers,
         "final_norm": P(None),
+        "unembed": P(None, AXIS_TP),
     }
-    if not cfg.tie_word_embeddings:
-        specs["lm_head"] = P(AXIS_TP, None)
     return specs
 
 

@@ -30,9 +30,11 @@ class TPRunner(ModelRunner):
     """Runner whose params/cache live sharded on a `tp` mesh axis."""
 
     # pallas_call has no SPMD partitioning rule: under GSPMD it would force an
-    # all-gather of the head-sharded page pool. Use the jnp gather path, which
-    # the partitioner shards cleanly (kernel-under-shard_map is future work).
+    # all-gather of the head-sharded page pool. Use the jnp gather path and
+    # the DUS page writer, which the partitioner shards cleanly
+    # (kernel-under-shard_map is future work).
     attn_mode = "gather"
+    kv_writer_mode = "dus"
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1) -> None:

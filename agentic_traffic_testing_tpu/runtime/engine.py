@@ -183,6 +183,16 @@ class LLMEngine:
             # No introspection (CPU tests): small fixed pool.
             return 512
         bytes_per = 2 if self.cfg.dtype in ("bfloat16", "bf16") else 4
+        # Reserve room for prefill's per-layer K/V scan outputs (llama.py
+        # prefill_impl defers pool writes; the transient peaks at one full
+        # prefill bucket, B*T <= max_num_batched_tokens, lane-padded).
+        from agentic_traffic_testing_tpu.runtime.kv_cache import phys_head_dim
+
+        transient = (2 * self.model_cfg.num_layers
+                     * self.cfg.max_num_batched_tokens
+                     * self.model_cfg.num_kv_heads
+                     * phys_head_dim(self.model_cfg.head_dim_) * bytes_per)
+        free = max(0, free - transient)
         n = profile_num_blocks(
             self.model_cfg, self.cfg.block_size, free,
             self.cfg.memory_utilization, bytes_per,

@@ -37,6 +37,18 @@ from agentic_traffic_testing_tpu.models.config import ModelConfig
 
 TRASH_BLOCK = 0
 
+# TPU lane width: the last dim of a page is padded up to this so pages are
+# tile-aligned. The tiled HBM layout pads head_dim < 128 to 128 lanes
+# physically ANYWAY, so storing the pad explicitly costs no extra memory —
+# and it makes a page a legal DMA source for the Pallas decode kernel
+# (Mosaic cannot slice a sub-lane-width window out of an HBM memref).
+PAGE_LANES = 128
+
+
+def phys_head_dim(head_dim: int) -> int:
+    """Physical (lane-aligned) page head dim for a logical head dim."""
+    return -(-head_dim // PAGE_LANES) * PAGE_LANES
+
 
 class KVCache(NamedTuple):
     """Stacked per-layer paged KV storage (a pytree; lives in HBM)."""
@@ -60,7 +72,10 @@ class KVCache(NamedTuple):
 def make_kv_cache(
     cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
 ) -> KVCache:
-    shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks, block_size, cfg.head_dim_)
+    """Pages store `phys_head_dim(head_dim)` lanes; the pad lanes stay zero
+    (writers only touch [..., :head_dim]) and consumers slice or mask them."""
+    shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks, block_size,
+             phys_head_dim(cfg.head_dim_))
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
 
 
@@ -99,8 +114,8 @@ def write_prompt_kv_full(
     updates alias in place after the first, so the whole prompt write costs
     one pool copy per dispatch instead of 2·L.
     """
-    _, kh, _, bs, hd = cache.shape
-    b, t, _, _ = new.shape
+    _, kh, _, bs, _ = cache.shape
+    b, t, _, hd = new.shape  # logical head dim; pool lanes may be padded wider
     zero = jnp.int32(0)
     tiles = new.transpose(0, 2, 1, 3)  # [B, KH, T, hd]
 
@@ -155,8 +170,8 @@ def write_decode_kv_full(
     `dynamic_update_slice` (see `write_prompt_kv_full` for why not scatter).
     Trash lanes (block table row = TRASH_BLOCK) land in the trash block.
     """
-    _, kh, _, bs, hd = cache.shape
-    b = new.shape[0]
+    _, kh, _, bs, _ = cache.shape
+    b, _, hd = new.shape  # logical head dim; pool lanes may be padded wider
     zero = jnp.int32(0)
     for i in range(b):
         blk = block_tables[i, positions[i] // bs]  # OOB positions clamp -> trash/own tail
@@ -184,7 +199,8 @@ def gather_kv(cache_l: jax.Array, block_tables: jax.Array) -> jax.Array:
 
 
 def kv_cache_bytes(cfg: ModelConfig, num_blocks: int, block_size: int, dtype_bytes: int = 2) -> int:
-    return 2 * cfg.num_layers * num_blocks * block_size * cfg.num_kv_heads * cfg.head_dim_ * dtype_bytes
+    return (2 * cfg.num_layers * num_blocks * block_size * cfg.num_kv_heads
+            * phys_head_dim(cfg.head_dim_) * dtype_bytes)
 
 
 def profile_num_blocks(
@@ -204,6 +220,7 @@ def profile_num_blocks(
     bytes shrink accordingly (min 1 head group).
     """
     kh_local = max(1, cfg.num_kv_heads // tp_size)
-    per_block = 2 * cfg.num_layers * block_size * kh_local * cfg.head_dim_ * dtype_bytes
+    per_block = (2 * cfg.num_layers * block_size * kh_local
+                 * phys_head_dim(cfg.head_dim_) * dtype_bytes)
     budget = int(hbm_bytes_free * memory_utilization)
     return max(0, budget // per_block)

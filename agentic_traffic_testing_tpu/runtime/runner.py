@@ -47,8 +47,10 @@ class DecodeState(NamedTuple):
 
 
 def _prefill_sample_impl(params, cfg: ModelConfig, tokens, cache, block_tables,
-                         seq_lens, samp: SamplingArrays, steps):
-    logits, cache = prefill_impl(params, cfg, tokens, cache, block_tables, seq_lens)
+                         seq_lens, samp: SamplingArrays, steps,
+                         kv_writer_mode=None):
+    logits, cache = prefill_impl(params, cfg, tokens, cache, block_tables,
+                                 seq_lens, kv_writer_mode=kv_writer_mode)
     keys = make_row_keys(samp.seeds, steps)
     out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
     state = DecodeState(tokens=out, positions=seq_lens, steps=steps + 1)
@@ -89,7 +91,9 @@ class ModelRunner:
         self.params = params
         self.decode_steps = max(1, int(decode_steps))
         self._prefill = jax.jit(
-            partial(_prefill_sample_impl, cfg=cfg), donate_argnames=("cache",)
+            partial(_prefill_sample_impl, cfg=cfg,
+                    kv_writer_mode=self.kv_writer_mode),
+            donate_argnames=("cache",),
         )
         self._decode = jax.jit(
             partial(_decode_sample_impl, cfg=cfg, num_steps=self.decode_steps,
@@ -102,6 +106,9 @@ class ModelRunner:
     #: decode-attention implementation baked into the jit (None = auto;
     #: the TP runner forces "gather" — see ops/attention_backend.py)
     attn_mode: Optional[str] = None
+    #: prompt-page KV writer baked into the prefill jit (None = auto;
+    #: the TP runner forces "dus" — see ops/kv_writer.py)
+    kv_writer_mode: Optional[str] = None
 
     def prepare_cache(self, cache: KVCache) -> KVCache:
         """Hook for placing a freshly allocated cache (TP runner shards it)."""

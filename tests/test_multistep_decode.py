@@ -85,11 +85,12 @@ def test_stop_token_mid_block(params):
     rng = np.random.default_rng(2)
     prompt = rng.integers(0, CFG.vocab_size, 6).tolist()
     free = oracle(params, prompt, greedy(12))
-    stop_tok = free[5]  # position 5: inside the second K=4 block
+    stop_tok = free[5]
+    cut = free.index(stop_tok)  # first occurrence is where generation stops
     eng = make_engine(params, decode_steps=4)
     req = eng.generate(prompt, greedy(12, stop_token_ids=(stop_tok,)))
     assert req.finish_reason == FinishReason.STOP
-    assert req.generated_ids == free[:6]
+    assert req.generated_ids == free[: cut + 1]
 
 
 def test_batched_multistep_matches_solo(params):

@@ -1,7 +1,9 @@
-"""Pallas paged-attention decode kernel vs. the jnp gather oracle.
+"""Pallas paged-attention decode kernels vs. the jnp gather oracle.
 
-Runs the kernel in interpreter mode on CPU (SURVEY.md §4: kernel unit tests
-diff Pallas against the reference jnp attention). The oracle is
+Runs BOTH kernels — v1 (one BlockSpec pipeline step per page) and the DMA
+variant (the TPU-default production path: grid (B, KH), double-buffered
+manual page DMA) — in interpreter mode on CPU (SURVEY.md §4: kernel unit
+tests diff Pallas against the reference jnp attention). The oracle is
 `gather_kv` + `causal_attention` — the exact math the serving decode step
 uses when ATT_TPU_ATTENTION=gather.
 """
@@ -15,8 +17,19 @@ import jax.numpy as jnp
 from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
 from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
     paged_attention_decode,
+    paged_attention_decode_dma,
 )
 from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK, gather_kv
+
+KERNELS = {
+    "v1": paged_attention_decode,
+    "dma": paged_attention_decode_dma,
+}
+
+
+def kernel_params(fn):
+    """Parametrize a test over both kernel entry points."""
+    return pytest.mark.parametrize("kernel", KERNELS.values(), ids=KERNELS)(fn)
 
 
 def _random_case(rng, *, b, h, kh, hd, bs, max_blocks, num_blocks, ctx_lens,
@@ -44,6 +57,7 @@ def _oracle(q, k_pages, v_pages, bt, ctx_lens):
     return out[:, 0]
 
 
+@kernel_params
 @pytest.mark.parametrize(
     "b,h,kh,hd,bs,ctx_lens",
     [
@@ -53,7 +67,7 @@ def _oracle(q, k_pages, v_pages, bt, ctx_lens):
         (4, 4, 2, 64, 4, [4, 1, 30, 12]),  # mixed, one lane nearly dead
     ],
 )
-def test_kernel_matches_oracle(b, h, kh, hd, bs, ctx_lens):
+def test_kernel_matches_oracle(kernel, b, h, kh, hd, bs, ctx_lens):
     rng = np.random.default_rng(42)
     max_blocks = max(-(-ln // bs) for ln in ctx_lens) + 2
     num_blocks = 1 + sum(-(-ln // bs) for ln in ctx_lens) + 2
@@ -61,18 +75,46 @@ def test_kernel_matches_oracle(b, h, kh, hd, bs, ctx_lens):
         rng, b=b, h=h, kh=kh, hd=hd, bs=bs, max_blocks=max_blocks,
         num_blocks=num_blocks, ctx_lens=ctx_lens,
     )
-    got = paged_attention_decode(q, kp, vp, bt, cl, interpret=True)
+    got = kernel(q, kp, vp, bt, cl, interpret=True)
     want = _oracle(q, kp, vp, bt, cl)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
 
 
-def test_kernel_bf16_matches_oracle():
+@kernel_params
+def test_kernel_stacked_padded_pool(kernel):
+    """The serving layout: stacked [L, ...] pool with lane-padded pages
+    (kv_cache.phys_head_dim) and a layer scalar — the exact operands the
+    decode scan passes on TPU."""
+    rng = np.random.default_rng(11)
+    L, kh, hd, hdp, bs = 3, 2, 64, 128, 4
+    b, h = 2, 4
+    ctx_lens = [5, 9]
+    max_blocks = 4
+    num_blocks = 8
+    q, kp, vp, bt, cl = _random_case(
+        rng, b=b, h=h, kh=kh, hd=hd, bs=bs, max_blocks=max_blocks,
+        num_blocks=num_blocks, ctx_lens=ctx_lens,
+    )
+    kp5 = jnp.zeros((L, kh, num_blocks, bs, hdp), kp.dtype)
+    vp5 = jnp.zeros((L, kh, num_blocks, bs, hdp), vp.dtype)
+    li = 1
+    kp5 = kp5.at[li, ..., :hd].set(kp)
+    vp5 = vp5.at[li, ..., :hd].set(vp)
+    # Garbage in the pad lanes must not leak into the output.
+    kp5 = kp5.at[li, ..., hd:].set(99.0)
+    got = kernel(q, kp5, vp5, bt, cl, layer=jnp.int32(li), interpret=True)
+    want = _oracle(q, kp, vp, bt, cl)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=2e-5, rtol=2e-5)
+
+
+@kernel_params
+def test_kernel_bf16_matches_oracle(kernel):
     rng = np.random.default_rng(7)
     q, kp, vp, bt, cl = _random_case(
         rng, b=2, h=8, kh=2, hd=64, bs=8, max_blocks=4, num_blocks=8,
         ctx_lens=[11, 23], dtype=jnp.bfloat16,
     )
-    got = paged_attention_decode(q, kp, vp, bt, cl, interpret=True)
+    got = kernel(q, kp, vp, bt, cl, interpret=True)
     want = _oracle(q, kp, vp, bt, cl)
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32),
@@ -80,7 +122,8 @@ def test_kernel_bf16_matches_oracle():
     )
 
 
-def test_inactive_lane_is_finite():
+@kernel_params
+def test_inactive_lane_is_finite(kernel):
     """Dead lanes (ctx 1, trash table) must return finite garbage, not NaN."""
     rng = np.random.default_rng(3)
     q, kp, vp, bt, cl = _random_case(
@@ -88,7 +131,7 @@ def test_inactive_lane_is_finite():
         ctx_lens=[6, 1],
     )
     bt = bt.at[1].set(TRASH_BLOCK)
-    got = paged_attention_decode(q, kp, vp, bt, cl, interpret=True)
+    got = kernel(q, kp, vp, bt, cl, interpret=True)
     assert np.isfinite(np.asarray(got)).all()
 
 
