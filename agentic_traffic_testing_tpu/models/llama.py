@@ -29,6 +29,7 @@ import jax
 import jax.numpy as jnp
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig
+from agentic_traffic_testing_tpu.models.quant import QTensor, dense, embed_lookup
 from agentic_traffic_testing_tpu.ops.attention_backend import paged_decode_attention
 from agentic_traffic_testing_tpu.ops.kv_writer import write_prompt_pages
 from agentic_traffic_testing_tpu.ops.jnp_ops import (
@@ -96,13 +97,64 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
     return params
 
 
+def init_params_quantized(cfg: ModelConfig, seed: int = 0,
+                          dtype=jnp.bfloat16) -> Params:
+    """Random-init DIRECTLY in int8 (checkpoint-free benches/tests of big
+    configs: an 8B in bf16 alone overflows one v5e chip's HBM, and even a
+    host-side fp32 init of it costs minutes of RNG + tunnel transfer).
+    Weights are uniform int8 with a constant per-tensor scale chosen so the
+    dequantized std matches init_params' 0.02 — statistically equivalent for
+    perf work, never materialized in float anywhere."""
+    import numpy as np
+
+    d, hd, f = cfg.hidden_size, cfg.head_dim_, cfg.intermediate_size
+    h, kh, L, v = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers, cfg.vocab_size
+    rng = np.random.default_rng(seed)
+    # uniform[-127,127] has std ~73.3; scale it back to weight std 0.02.
+    SCALE = np.float32(0.02 / 73.3)
+
+    def qw(shape, axis=-2):
+        q = rng.integers(-127, 128, size=shape, dtype=np.int8)
+        sshape = list(shape)
+        sshape[axis] = 1
+        return QTensor(q=jnp.asarray(q),
+                       scale=jnp.full(sshape, SCALE, jnp.float32))
+
+    layers: dict = {
+        "ln_attn": jnp.ones((L, d), dtype),
+        "ln_mlp": jnp.ones((L, d), dtype),
+        "wq": qw((L, d, h * hd)),
+        "wk": qw((L, d, kh * hd)),
+        "wv": qw((L, d, kh * hd)),
+        "wo": qw((L, h * hd, d)),
+        "w_gate": qw((L, d, f)),
+        "w_up": qw((L, d, f)),
+        "w_down": qw((L, f, d)),
+    }
+    if cfg.qkv_bias:
+        layers["bq"] = jnp.zeros((L, h * hd), dtype)
+        layers["bk"] = jnp.zeros((L, kh * hd), dtype)
+        layers["bv"] = jnp.zeros((L, kh * hd), dtype)
+    params: Params = {
+        "tok_embed": qw((v, d)),
+        "layers": layers,
+        "final_norm": jnp.ones((d,), dtype),
+    }
+    if cfg.tie_word_embeddings:
+        te = params["tok_embed"]
+        params["unembed"] = QTensor(q=te.q.T, scale=jnp.full((1, v), SCALE, jnp.float32))
+    else:
+        params["unembed"] = qw((d, v))
+    return params
+
+
 def _qkv(x: jax.Array, lp: dict, cfg: ModelConfig):
     """Project hidden states to q/k/v heads. x: [B, T, D]."""
     b, t, _ = x.shape
     h, kh, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim_
-    q = x @ lp["wq"]
-    k = x @ lp["wk"]
-    v = x @ lp["wv"]
+    q = dense(x, lp["wq"])
+    k = dense(x, lp["wk"])
+    v = dense(x, lp["wv"])
     if cfg.qkv_bias:
         q = q + lp["bq"]
         k = k + lp["bk"]
@@ -119,7 +171,7 @@ def _mlp_block(x: jax.Array, lp: dict) -> jax.Array:
 
 
 def _unembed(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
-    return (x @ params["unembed"]).astype(jnp.float32)
+    return dense(x, params["unembed"]).astype(jnp.float32)
 
 
 # ---------------------------------------------------------------------------
@@ -141,7 +193,7 @@ def forward_full_impl(params: Params, cfg: ModelConfig, tokens: jax.Array,
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
     if attn_fn is None:
         attn_fn = causal_attention
-    x = params["tok_embed"][tokens]
+    x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)
     sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     seq_lens = jnp.full((b,), t, jnp.int32)
 
@@ -151,7 +203,7 @@ def forward_full_impl(params: Params, cfg: ModelConfig, tokens: jax.Array,
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
         attn = attn_fn(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
-        x = x + attn.reshape(b, t, -1) @ lp["wo"]
+        x = x + dense(attn.reshape(b, t, -1), lp["wo"])
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         x = x + _mlp_block(xm, lp)
         return x, None
@@ -188,7 +240,7 @@ def prefill_impl(
     if t % cache.block_size != 0:  # trace-time check: unaligned tails would be dropped
         raise ValueError(f"prefill length {t} not a multiple of block_size {cache.block_size}")
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
-    x = params["tok_embed"][tokens]
+    x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)
     sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     hd, hdp = cfg.head_dim_, cache.k.shape[-1]
 
@@ -198,7 +250,7 @@ def prefill_impl(
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
         attn = causal_attention(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
-        x = x + attn.reshape(b, t, -1) @ lp["wo"]
+        x = x + dense(attn.reshape(b, t, -1), lp["wo"])
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         x = x + _mlp_block(xm, lp)
         pad = ((0, 0), (0, 0), (0, 0), (0, hdp - hd))
@@ -234,7 +286,7 @@ def decode_step_impl(
     position 0; their logits are garbage and ignored by the scheduler.
     """
     b = tokens.shape[0]
-    x = params["tok_embed"][tokens][:, None, :]  # [B, 1, D]
+    x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)[:, None, :]  # [B, 1, D]
     sin, cos = rope_sin_cos(positions[:, None], cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
 
     def body(carry, xs):
@@ -253,7 +305,7 @@ def decode_step_impl(
         # (ops/attention_backend.py picks at trace time).
         attn = paged_decode_attention(q, kc, vc, block_tables, positions,
                                       mode=attn_mode, layer=li)
-        x = x + attn.reshape(b, 1, -1) @ lp["wo"]
+        x = x + dense(attn.reshape(b, 1, -1), lp["wo"])
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         x = x + _mlp_block(xm, lp)
         return (x, kc, vc), None

@@ -1,0 +1,124 @@
+"""Weight-only int8 quantization for serving.
+
+Why this exists: the rebuild's north-star model (Llama-3-8B, BASELINE.md §3)
+is ~16 GiB of bf16 weights — it does not fit a single v5e chip's HBM next to
+a KV pool. Per-channel symmetric int8 halves the weight footprint (and the
+weight-streaming bandwidth) with ~0.4% RMS logit error on Llama-scale
+matrices, which greedy agent workloads tolerate. The reference has no analog
+in-tree — quantization lives inside its vLLM dependency (`--quantization`
+engine args); here it is first-party.
+
+Scheme: for a weight W[..., K, N] contracted over K, each output column n
+gets scale[n] = max|W[..., n]| / 127; stored as int8 q plus an fp32 scale
+(scale bytes are ~1/K of the weight — negligible). The matmul runs
+`x @ q.astype(bf16) * scale` — XLA fuses the upcast into the dot's operand
+read (HBM traffic stays int8) and the scale into the epilogue. Norm weights
+and biases stay bf16 (negligible bytes).
+
+`QTensor` is a pytree node, so quantized params ride `lax.scan` xs, jit
+arguments, and checkpoints exactly like raw arrays. Tensor-parallel sharding
+of QTensor params is not wired up yet (the TP runner rejects the combo).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class QTensor(NamedTuple):
+    """Per-output-channel symmetric int8 weight: value ~= q * scale."""
+
+    q: jax.Array      # int8, same shape as the original weight
+    scale: jax.Array  # f32 [..., 1, N] broadcastable over the contraction dim
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def logical_dtype(self):
+        return self.scale.dtype
+
+
+DenseW = Union[jax.Array, QTensor]
+
+
+def _quantize_array_impl(w: jax.Array, axis: int) -> QTensor:
+    amax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=axis, keepdims=True)
+    scale = jnp.where(amax > 0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return QTensor(q=q, scale=scale.astype(jnp.float32))
+
+
+# Jitted so XLA fuses the fp32 upcasts into the reduce/round passes — eager
+# mode would materialize two full fp32 copies of the leaf, blowing the HBM
+# headroom this feature exists to create (an 8B leaf is ~3.7 GiB bf16).
+quantize_array = functools.partial(
+    jax.jit(_quantize_array_impl, static_argnames=("axis",)), axis=-2
+)
+
+
+def dense(x: jax.Array, w: DenseW) -> jax.Array:
+    """x @ w for raw or quantized weights (contraction over x's last dim)."""
+    if isinstance(w, QTensor):
+        y = x @ w.q.astype(x.dtype)
+        return y * jnp.squeeze(w.scale, axis=-2).astype(x.dtype)
+    return x @ w
+
+
+def embed_lookup(w: DenseW, ids: jax.Array, dtype=None) -> jax.Array:
+    """Row gather from an embedding table ([V, D], quantized per column).
+
+    `dtype` sets the activation dtype for the quantized path (callers pass
+    the model's serving dtype, e.g. final_norm's); raw tables ignore it.
+    """
+    if isinstance(w, QTensor):
+        rows = w.q[ids].astype(w.scale.dtype)
+        out = rows * jnp.squeeze(w.scale, axis=-2)
+        return out.astype(dtype if dtype is not None else jnp.bfloat16)
+    return w[ids]
+
+
+# Param-dict leaves that carry the model's FLOPs/bytes; everything else
+# (norms, biases) stays in the original dtype.
+_QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
+
+
+def quantize_params(params: dict, delete_originals: bool = False) -> dict:
+    """Quantize a llama.init_params-schema dict leaf-by-leaf.
+
+    `delete_originals=True` frees each bf16 leaf as soon as its int8 copy
+    exists, bounding peak HBM at (int8 total + one bf16 leaf) — required to
+    quantize an 8B model in place on a 16 GiB chip.
+    """
+    def free(w) -> None:
+        if delete_originals and hasattr(w, "delete"):
+            w.delete()  # numpy leaves (host-streamed loads) have no .delete
+
+    out: dict[str, Any] = {}
+    layers_in = params["layers"]
+    layers_out: dict[str, Any] = {}
+    for key, w in layers_in.items():
+        if key in _QUANT_LAYER_KEYS:
+            layers_out[key] = quantize_array(jnp.asarray(w))
+            free(w)
+        else:
+            layers_out[key] = jnp.asarray(w)
+    for key, w in params.items():
+        if key == "layers":
+            continue
+        if key in ("tok_embed", "unembed"):
+            out[key] = quantize_array(jnp.asarray(w))
+            free(w)
+        else:
+            out[key] = jnp.asarray(w)
+    out["layers"] = layers_out
+    return out
+
+
+def is_quantized(params: dict) -> bool:
+    return isinstance(params.get("unembed"), QTensor)

@@ -146,8 +146,19 @@ def params_from_hf_state_dict(cfg: ModelConfig, state_dict: dict, dtype=np.float
     return _to_jax(params)
 
 
-def load_params(model_dir: str, cfg: ModelConfig | None = None, dtype=jnp.bfloat16) -> tuple[ModelConfig, dict]:
-    """Load params from a local HF directory of safetensors shards."""
+def load_params(
+    model_dir: str,
+    cfg: ModelConfig | None = None,
+    dtype=jnp.bfloat16,
+    quantization: str | None = None,
+) -> tuple[ModelConfig, dict]:
+    """Load params from a local HF directory of safetensors shards.
+
+    With `quantization="int8"` the bf16 tree stays host-side and is
+    quantized leaf-by-leaf onto the device (models/quant.py) — the full-
+    precision model never occupies HBM, which is what lets Llama-3-8B load
+    on a single 16 GiB chip.
+    """
     cfg = cfg or ModelConfig.from_local_dir(model_dir)
     np_dtype = ml_dtypes.bfloat16 if dtype == jnp.bfloat16 else np.dtype(dtype)
     plan = _hf_tensor_plan(cfg)
@@ -167,6 +178,12 @@ def load_params(model_dir: str, cfg: ModelConfig | None = None, dtype=jnp.bfloat
         raise ValueError(f"checkpoint incomplete: missing {sorted(missing)[:8]}...")
     if cfg.tie_word_embeddings:
         params["unembed"][...] = params["tok_embed"].T
+    if quantization == "int8":
+        from agentic_traffic_testing_tpu.models.quant import quantize_params
+
+        return cfg, quantize_params(params)
+    if quantization:
+        raise ValueError(f"unknown quantization {quantization!r}")
     return cfg, _to_jax(params)
 
 

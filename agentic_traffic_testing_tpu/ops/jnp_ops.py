@@ -135,8 +135,11 @@ def causal_attention(
     return out.astype(q.dtype)
 
 
-def swiglu(x: jax.Array, w_gate: jax.Array, w_up: jax.Array, w_down: jax.Array) -> jax.Array:
-    """SwiGLU MLP: down( silu(x@gate) * (x@up) ). Matmuls stay in activation dtype
-    so XLA maps them to the MXU in bf16."""
-    g = jax.nn.silu(x @ w_gate)
-    return (g * (x @ w_up)) @ w_down
+def swiglu(x: jax.Array, w_gate, w_up, w_down) -> jax.Array:
+    """SwiGLU MLP: down( silu(x@gate) * (x@up) ). Matmuls stay in activation
+    dtype so XLA maps them to the MXU in bf16. Weights may be raw arrays or
+    int8 QTensors (models/quant.dense handles both)."""
+    from agentic_traffic_testing_tpu.models.quant import dense
+
+    g = jax.nn.silu(dense(x, w_gate))
+    return dense(g * dense(x, w_up), w_down)

@@ -82,9 +82,20 @@ class EngineConfig:
     # bound), 1 elsewhere (keeps CPU tests step-exact by default).
     decode_steps: Optional[int] = None
     seed: int = 0
+    # Weight-only quantization: None (serve in `dtype`) or "int8"
+    # (models/quant.py — halves weight HBM so Llama-3-8B fits one v5e chip).
+    quantization: Optional[str] = None
     # None = auto (C++ native/ core if it builds, Python otherwise);
     # True/False force one implementation.
     native_allocator: Optional[bool] = None
+
+    def __post_init__(self) -> None:
+        # Fail fast: a typo'd scheme must not silently serve full-precision
+        # (or, behind a broad except in the server's weight loader, random)
+        # weights.
+        if self.quantization not in (None, "int8"):
+            raise ValueError(
+                f"unknown quantization {self.quantization!r}; supported: int8")
 
     def resolved_decode_steps(self, platform: str) -> int:
         if self.decode_steps is not None:
@@ -143,7 +154,17 @@ class LLMEngine:
         else:
             if params is None:
                 log.warning("no checkpoint: random-initializing %s", self.model_cfg.name)
-                params = init_params(self.model_cfg, jax.random.key(cfg.seed), dtype=dtype)
+                if cfg.quantization == "int8":
+                    from agentic_traffic_testing_tpu.models.llama import init_params_quantized
+
+                    params = init_params_quantized(self.model_cfg, cfg.seed, dtype=dtype)
+                else:
+                    params = init_params(self.model_cfg, jax.random.key(cfg.seed), dtype=dtype)
+            elif cfg.quantization == "int8":
+                from agentic_traffic_testing_tpu.models.quant import is_quantized, quantize_params
+
+                if not is_quantized(params):
+                    params = quantize_params(params, delete_originals=True)
             self.runner = ModelRunner(self.model_cfg, params,
                                       decode_steps=decode_steps)
 

@@ -43,6 +43,7 @@ class ServerConfig:
     host: str = "0.0.0.0"                      # LLM_HOST
     port: int = 8000                           # LLM_PORT
     tp_size: int = 1                           # LLM_TP_SIZE (TPU-native knob)
+    quantization: Optional[str] = None         # LLM_QUANTIZATION ("int8" | unset)
     decode_steps: Optional[int] = None         # LLM_DECODE_STEPS (None -> auto)
     num_blocks: Optional[int] = None           # LLM_NUM_BLOCKS (None -> HBM profile)
     block_size: int = 16                       # LLM_BLOCK_SIZE
@@ -74,6 +75,7 @@ class ServerConfig:
         c.host = os.environ.get("LLM_HOST", c.host)
         c.port = int(os.environ.get("LLM_PORT") or c.port)
         c.tp_size = int(os.environ.get("LLM_TP_SIZE") or c.tp_size)
+        c.quantization = os.environ.get("LLM_QUANTIZATION") or None
         ds = os.environ.get("LLM_DECODE_STEPS")
         c.decode_steps = int(ds) if ds else None
         nb = os.environ.get("LLM_NUM_BLOCKS")
@@ -101,6 +103,7 @@ class ServerConfig:
         p.add_argument("--host", default=c.host)
         p.add_argument("--port", type=int, default=c.port)
         p.add_argument("--tp-size", type=int, default=c.tp_size)
+        p.add_argument("--quantization", default=c.quantization)
         p.add_argument("--decode-steps", type=int, default=c.decode_steps)
         p.add_argument("--num-blocks", type=int, default=c.num_blocks)
         p.add_argument("--block-size", type=int, default=c.block_size)
@@ -108,7 +111,7 @@ class ServerConfig:
         a = p.parse_args(argv)
         for f in ("model", "dtype", "max_num_seqs", "max_num_batched_tokens",
                   "memory_utilization", "max_tokens", "max_model_len",
-                  "temperature", "host", "port", "tp_size", "decode_steps",
-                  "num_blocks", "block_size", "weights_path"):
+                  "temperature", "host", "port", "tp_size", "quantization",
+                  "decode_steps", "num_blocks", "block_size", "weights_path"):
             setattr(c, f, getattr(a, f))
         return c

@@ -86,12 +86,17 @@ class LLMServer:
             max_num_batched_tokens=c.max_num_batched_tokens,
             max_model_len=c.max_model_len, block_size=c.block_size,
             num_blocks=c.num_blocks, memory_utilization=c.memory_utilization,
-            decode_steps=c.decode_steps,
+            decode_steps=c.decode_steps, quantization=c.quantization,
         )
         runner = None
         params = None
         model_cfg = None
         if c.tp_size > 1:
+            if c.quantization:
+                raise NotImplementedError(
+                    "tensor-parallel serving of int8-quantized params is not "
+                    "wired up yet (QTensor leaves need their own PartitionSpecs)"
+                )
             from agentic_traffic_testing_tpu.models.config import resolve_config
             from agentic_traffic_testing_tpu.models.llama import init_params
             from agentic_traffic_testing_tpu.parallel.mesh import single_axis_mesh
@@ -124,7 +129,8 @@ class LLMServer:
             import jax.numpy as jnp
 
             dtype = jnp.bfloat16 if self.cfg.dtype in ("bfloat16", "bf16") else jnp.float32
-            _, params = load_params(self.cfg.weights_path, model_cfg, dtype=dtype)
+            _, params = load_params(self.cfg.weights_path, model_cfg, dtype=dtype,
+                                    quantization=self.cfg.quantization)
             return params
         except Exception:
             log.exception("weight load failed for %s; random init", self.cfg.weights_path)

@@ -1,0 +1,113 @@
+"""Weight-only int8 quantization (models/quant.py).
+
+Motivation: Llama-3-8B bf16 (~16 GiB) does not fit one v5e chip; int8
+weight-only is the capacity path for the north-star config (BASELINE.md §3).
+These tests pin (a) the per-channel quantizer's reconstruction error, (b)
+logits parity of the quantized model against the full-precision one, and
+(c) the engine running end-to-end on quantized params (QTensor leaves riding
+the layer scan and jit boundaries).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.models.config import PRESETS
+from agentic_traffic_testing_tpu.models.llama import (
+    forward_full_impl,
+    init_params,
+    init_params_quantized,
+)
+from agentic_traffic_testing_tpu.models.quant import (
+    QTensor,
+    dense,
+    embed_lookup,
+    is_quantized,
+    quantize_array,
+    quantize_params,
+)
+from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+CFG = PRESETS["tiny"]
+
+
+def test_quantize_array_reconstruction():
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((64, 48)), jnp.float32)
+    qt = quantize_array(w)
+    assert qt.q.dtype == jnp.int8 and qt.scale.shape == (1, 48)
+    recon = qt.q.astype(jnp.float32) * qt.scale
+    err = float(jnp.max(jnp.abs(recon - w)))
+    # Per-column symmetric int8: worst case one half-step of the column scale.
+    assert err <= float(jnp.max(qt.scale)) * 0.51, err
+
+
+def test_dense_and_embed_match_full_precision():
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.standard_normal((4, 64)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((64, 32)), jnp.float32)
+    want = x @ w
+    got = dense(x, quantize_array(w))
+    assert float(jnp.max(jnp.abs(got - want))) / float(jnp.max(jnp.abs(want))) < 0.05
+
+    emb = jnp.asarray(rng.standard_normal((50, 16)), jnp.float32)
+    ids = jnp.asarray([0, 7, 49])
+    got_rows = embed_lookup(quantize_array(emb), ids).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got_rows), np.asarray(emb[ids]),
+                               atol=0.05, rtol=0.2)
+
+
+def test_quantized_logits_track_full_precision():
+    params = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+    qparams = quantize_params(params)
+    assert is_quantized(qparams)
+    rng = np.random.default_rng(2)
+    tokens = jnp.asarray(rng.integers(0, CFG.vocab_size, (2, 12)), jnp.int32)
+    full = np.asarray(forward_full_impl(params, CFG, tokens)).ravel()
+    quant = np.asarray(forward_full_impl(qparams, CFG, tokens)).ravel()
+    corr = np.corrcoef(full, quant)[0, 1]
+    assert corr > 0.995, corr
+
+
+def test_engine_end_to_end_quantized():
+    ecfg = EngineConfig(model="tiny", dtype="float32", quantization="int8",
+                        max_model_len=128, block_size=8, num_blocks=64,
+                        max_num_seqs=4)
+    eng = LLMEngine(ecfg, model_cfg=CFG)
+    rng = np.random.default_rng(3)
+    reqs = [eng.add_request(rng.integers(0, CFG.vocab_size, n).tolist(),
+                            SamplingParams(max_tokens=8, temperature=0.0))
+            for n in (5, 11)]
+    for _ in range(10_000):
+        eng.step()
+        if all(r.is_finished() for r in reqs):
+            break
+        if not eng.has_work():
+            break
+    for r in reqs:
+        assert r.is_finished()
+        assert len(r.generated_ids) >= 1
+        assert all(0 <= t < CFG.vocab_size for t in r.generated_ids)
+
+
+def test_unknown_quantization_fails_fast():
+    with pytest.raises(ValueError, match="unknown quantization"):
+        EngineConfig(model="tiny", quantization="int4")
+
+
+def test_init_params_quantized_schema():
+    params = init_params_quantized(CFG, seed=0)
+    assert is_quantized(params)
+    assert isinstance(params["layers"]["wq"], QTensor)
+    assert params["layers"]["wq"].q.dtype == jnp.int8
+    assert not isinstance(params["layers"]["ln_attn"], QTensor)
+    # Tied config: unembed reconstruction matches tok_embed.T reconstruction.
+    if CFG.tie_word_embeddings:
+        te = params["tok_embed"]
+        ue = params["unembed"]
+        r1 = (te.q.astype(jnp.float32) * te.scale).T
+        r2 = ue.q.astype(jnp.float32) * ue.scale
+        np.testing.assert_allclose(np.asarray(r1), np.asarray(r2), atol=0.02)
