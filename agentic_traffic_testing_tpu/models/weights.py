@@ -159,6 +159,8 @@ def load_params(
     precision model never occupies HBM, which is what lets Llama-3-8B load
     on a single 16 GiB chip.
     """
+    if quantization not in (None, "int8"):  # before the multi-GiB shard read
+        raise ValueError(f"unknown quantization {quantization!r}")
     cfg = cfg or ModelConfig.from_local_dir(model_dir)
     np_dtype = ml_dtypes.bfloat16 if dtype == jnp.bfloat16 else np.dtype(dtype)
     plan = _hf_tensor_plan(cfg)
@@ -182,8 +184,6 @@ def load_params(
         from agentic_traffic_testing_tpu.models.quant import quantize_params
 
         return cfg, quantize_params(params)
-    if quantization:
-        raise ValueError(f"unknown quantization {quantization!r}")
     return cfg, _to_jax(params)
 
 

@@ -21,6 +21,8 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from agentic_traffic_testing_tpu.models.quant import dense
+
 
 def rms_norm(x: jax.Array, weight: jax.Array, eps: float = 1e-5) -> jax.Array:
     """RMSNorm: x / rms(x) * weight, computed in fp32 (HF LlamaRMSNorm numerics)."""
@@ -139,7 +141,5 @@ def swiglu(x: jax.Array, w_gate, w_up, w_down) -> jax.Array:
     """SwiGLU MLP: down( silu(x@gate) * (x@up) ). Matmuls stay in activation
     dtype so XLA maps them to the MXU in bf16. Weights may be raw arrays or
     int8 QTensors (models/quant.dense handles both)."""
-    from agentic_traffic_testing_tpu.models.quant import dense
-
     g = jax.nn.silu(dense(x, w_gate))
     return dense(g * dense(x, w_up), w_down)

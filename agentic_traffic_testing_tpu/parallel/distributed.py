@@ -1,0 +1,110 @@
+"""Multi-host bootstrap: `jax.distributed` over DCN, collectives over ICI.
+
+The reference testbed's multi-node story is SSH + per-node docker compose
+with NCCL confined inside vLLM (reference: scripts/deploy/deploy.sh:120-186;
+SURVEY.md §2.4). The TPU equivalent is jax.distributed: every host in a
+multi-host slice (or multi-slice deployment) runs the same program, calls
+`initialize()` against a shared coordinator, and from then on
+`jax.devices()` spans the whole fleet — a `Mesh` laid out over it routes
+per-layer all-reduces over ICI within a slice and only crosses DCN on axes
+that span slices (the scaling-book recipe).
+
+Environment contract (mirrors the testbed's env-first config style,
+SURVEY.md §5.6):
+
+    ATT_COORDINATOR_ADDRESS   host:port of process 0 (unset -> single-host)
+    ATT_NUM_PROCESSES         total process count
+    ATT_PROCESS_ID            this process's index (0-based)
+    ATT_LOCAL_DEVICE_IDS      optional comma list restricting local devices
+
+On TPU pods all three can usually be omitted even when multi-host —
+jax.distributed auto-discovers from the TPU runtime — so
+`maybe_initialize()` also honors a bare ATT_MULTIHOST=1 switch.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+log = logging.getLogger("att_tpu.distributed")
+
+_initialized = False
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def maybe_initialize() -> bool:
+    """Initialize jax.distributed from the environment if configured.
+
+    Returns True when running as part of a multi-process fleet. Safe to call
+    more than once and from single-host runs (no-op there). Must run BEFORE
+    the first touch of jax.devices() in the process.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    coord = os.environ.get("ATT_COORDINATOR_ADDRESS")
+    auto = os.environ.get("ATT_MULTIHOST", "").lower() in ("1", "true", "yes")
+    if not coord and not auto:
+        return False
+
+    import jax
+
+    kwargs: dict = {}
+    if coord:
+        kwargs["coordinator_address"] = coord
+        # num_processes/process_id are optional for jax on TPU pods (runtime
+        # auto-detect); pass them only when the operator sets them so a
+        # coordinator-only config still works.
+        nproc = os.environ.get("ATT_NUM_PROCESSES")
+        pid = os.environ.get("ATT_PROCESS_ID")
+        if (nproc is None) != (pid is None):
+            raise ValueError(
+                "set both ATT_NUM_PROCESSES and ATT_PROCESS_ID (or neither "
+                "for TPU-runtime auto-detect)")
+        if nproc is not None:
+            kwargs["num_processes"] = int(nproc)
+            kwargs["process_id"] = int(pid)
+        local = os.environ.get("ATT_LOCAL_DEVICE_IDS")
+        if local:
+            kwargs["local_device_ids"] = [int(x) for x in local.split(",")]
+    jax.distributed.initialize(**kwargs)
+    _initialized = True
+    log.info(
+        "jax.distributed up: process %d/%d, %d local / %d global devices",
+        jax.process_index(), jax.process_count(),
+        jax.local_device_count(), jax.device_count(),
+    )
+    return True
+
+
+def process_info() -> dict:
+    """Identity block for logs/metrics (shape mirrors the testbed's
+    node/agent identity fields, agents/common/telemetry.py)."""
+    import jax
+
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+        "distributed": _initialized,
+    }
+
+
+def global_mesh_devices(n: Optional[int] = None):
+    """Devices for a fleet-wide mesh, ICI-contiguous first.
+
+    `jax.devices()` on a multi-host slice orders by (process, local torus),
+    which is exactly the layout `parallel.mesh.make_mesh` wants: the
+    innermost mesh axis lands on same-host ICI neighbors, outer axes cross
+    hosts (DCN) as rarely as possible.
+    """
+    import jax
+
+    devices = jax.devices()
+    return devices[: n or len(devices)]

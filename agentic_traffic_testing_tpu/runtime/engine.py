@@ -164,7 +164,10 @@ class LLMEngine:
                 from agentic_traffic_testing_tpu.models.quant import is_quantized, quantize_params
 
                 if not is_quantized(params):
-                    params = quantize_params(params, delete_originals=True)
+                    # No delete_originals: the caller still owns these arrays
+                    # (memory-critical loads pre-quantize in weights.py /
+                    # init_params_quantized instead).
+                    params = quantize_params(params)
             self.runner = ModelRunner(self.model_cfg, params,
                                       decode_steps=decode_steps)
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import os
 import time
 import uuid
 from typing import Any, Dict, Optional
@@ -65,6 +66,7 @@ class LLMServer:
         self._inflight_lock = asyncio.Lock()
         self._inflight = 0
         self._last_arrival: Optional[float] = None
+        self._profiling_dir: Optional[str] = None
         if self.metrics:
             self.metrics.set_config_gauges(
                 max_num_seqs=cfg.max_num_seqs,
@@ -187,6 +189,47 @@ class LLMServer:
             return web.json_response({"error": "Metrics disabled"}, status=503)
         return web.Response(body=self.metrics.render(),
                             headers={"Content-Type": self.metrics.content_type})
+
+    async def handle_profile_start(self, request: web.Request) -> web.Response:
+        """Start a jax.profiler trace (device + host timelines) — the
+        TPU-idiomatic equivalent of the GPU-side profilers the reference
+        stack lacks entirely (SURVEY.md §5.1). View with TensorBoard or
+        xprof against the written directory."""
+        try:
+            body = await request.json()
+        except Exception:
+            body = {}
+        if not isinstance(body, dict):
+            body = {}
+        log_dir = body.get("log_dir") or os.environ.get(
+            "LLM_PROFILE_DIR", "/tmp/att_tpu_profile")
+        if self._profiling_dir is not None:
+            return web.json_response(
+                {"error": f"profiling already active -> {self._profiling_dir}"},
+                status=409)
+        try:
+            import jax
+
+            jax.profiler.start_trace(log_dir)
+        except Exception as exc:  # pragma: no cover - backend-specific
+            return web.json_response({"error": str(exc)}, status=500)
+        self._profiling_dir = log_dir
+        return web.json_response({"status": "profiling", "log_dir": log_dir})
+
+    async def handle_profile_stop(self, request: web.Request) -> web.Response:
+        if self._profiling_dir is None:
+            return web.json_response({"error": "profiling not active"}, status=409)
+        import jax
+
+        try:
+            jax.profiler.stop_trace()
+        except Exception as exc:  # pragma: no cover
+            # Keep _profiling_dir set: a transient failure (e.g. unwritable
+            # log dir) stays retryable via another /profile/stop instead of
+            # wedging the profiler until restart.
+            return web.json_response({"error": str(exc)}, status=500)
+        log_dir, self._profiling_dir = self._profiling_dir, None
+        return web.json_response({"status": "stopped", "log_dir": log_dir})
 
     async def handle_chat(self, request: web.Request) -> web.Response:
         ctx = extract_context(request.headers)
@@ -392,6 +435,8 @@ class LLMServer:
         app.router.add_get("/ready", self.handle_health)
         app.router.add_get("/live", self.handle_health)
         app.router.add_get("/metrics", self.handle_metrics)
+        app.router.add_post("/profile/start", self.handle_profile_start)
+        app.router.add_post("/profile/stop", self.handle_profile_stop)
         app.router.add_post("/chat", self.handle_chat)
         app.router.add_post("/completion", self.handle_chat)
         app.router.add_post("/generate", self.handle_chat)
@@ -424,6 +469,11 @@ def create_app(cfg: Optional[ServerConfig] = None,
 
 def main(argv: Optional[list[str]] = None) -> None:
     logging.basicConfig(level=logging.INFO)
+    # Multi-host fleets must join jax.distributed before first device touch
+    # (no-op unless ATT_COORDINATOR_ADDRESS / ATT_MULTIHOST is set).
+    from agentic_traffic_testing_tpu.parallel.distributed import maybe_initialize
+
+    maybe_initialize()
     cfg = ServerConfig.from_args(argv)
     print(f"[llm] starting TPU backend model={cfg.model} dtype={cfg.dtype} "
           f"tp={cfg.tp_size} max_num_seqs={cfg.max_num_seqs} "

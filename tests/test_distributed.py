@@ -1,0 +1,45 @@
+"""Multi-host bootstrap module (parallel/distributed.py).
+
+Single-process tests: the env contract (no-op without config, kwargs built
+from ATT_* vars) and the process-identity block. Real multi-process
+initialization is exercised by the driver's multichip dry run and on pods.
+"""
+
+import numpy as np
+
+import agentic_traffic_testing_tpu.parallel.distributed as dist
+
+
+def test_noop_without_env(monkeypatch):
+    monkeypatch.delenv("ATT_COORDINATOR_ADDRESS", raising=False)
+    monkeypatch.delenv("ATT_MULTIHOST", raising=False)
+    assert dist.maybe_initialize() is False
+    assert dist.is_initialized() is False
+
+
+def test_process_info_single_host():
+    info = dist.process_info()
+    assert info["process_index"] == 0
+    assert info["process_count"] == 1
+    assert info["local_devices"] >= 1
+    assert info["global_devices"] == info["local_devices"]
+    assert info["distributed"] is False
+
+
+def test_global_mesh_devices_ordering():
+    import jax
+
+    devs = dist.global_mesh_devices()
+    assert list(devs) == list(jax.devices())
+    assert list(dist.global_mesh_devices(1)) == [jax.devices()[0]]
+
+
+def test_mesh_over_global_devices():
+    """A fleet mesh built from global_mesh_devices composes with make_mesh."""
+    from agentic_traffic_testing_tpu.parallel.mesh import make_mesh
+
+    devs = dist.global_mesh_devices()
+    n = len(devs)
+    tp = 2 if n % 2 == 0 else 1
+    mesh = make_mesh(dp=n // tp, sp=1, tp=tp, devices=devs)
+    assert int(np.prod(list(mesh.shape.values()))) == n

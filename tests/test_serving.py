@@ -178,3 +178,28 @@ def test_kv_gauges_reflect_engine(server):
         assert f"llm_kv_cache_total_tokens {float(num_blocks * bs)}" in text
 
     _run(server, go)
+
+
+def test_profile_endpoints(server, tmp_path):
+    """jax.profiler trace start/stop round-trip (SURVEY.md §5.1: the
+    TPU-idiomatic profiling the reference stack lacks)."""
+    async def go(client):
+        log_dir = str(tmp_path / "trace")
+        resp = await client.post("/profile/start", json={"log_dir": log_dir})
+        assert resp.status == 200
+        assert (await resp.json())["log_dir"] == log_dir
+        # Double-start must 409, not crash the profiler.
+        resp = await client.post("/profile/start", json={"log_dir": log_dir})
+        assert resp.status == 409
+        resp = await client.post("/profile/stop")
+        assert resp.status == 200
+        # Stop without an active trace must 409.
+        resp = await client.post("/profile/stop")
+        assert resp.status == 409
+        return log_dir
+
+    log_dir = _run(server, go)
+    import os
+
+    assert os.path.isdir(log_dir), "profiler wrote nothing"
+
