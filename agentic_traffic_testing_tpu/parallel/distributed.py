@@ -69,9 +69,11 @@ def maybe_initialize() -> bool:
         if nproc is not None:
             kwargs["num_processes"] = int(nproc)
             kwargs["process_id"] = int(pid)
-        local = os.environ.get("ATT_LOCAL_DEVICE_IDS")
-        if local:
-            kwargs["local_device_ids"] = [int(x) for x in local.split(",")]
+    # Device restriction applies in auto-detect (ATT_MULTIHOST) mode too,
+    # e.g. two processes per host each claiming half the chips.
+    local = os.environ.get("ATT_LOCAL_DEVICE_IDS")
+    if local:
+        kwargs["local_device_ids"] = [int(x) for x in local.split(",")]
     jax.distributed.initialize(**kwargs)
     _initialized = True
     log.info(
@@ -107,4 +109,8 @@ def global_mesh_devices(n: Optional[int] = None):
     import jax
 
     devices = jax.devices()
-    return devices[: n or len(devices)]
+    if n is None:
+        return devices
+    if not 1 <= n <= len(devices):
+        raise ValueError(f"need 1 <= n <= {len(devices)}, got {n}")
+    return devices[:n]

@@ -36,6 +36,20 @@ from agentic_traffic_testing_tpu.serving.chat_template import apply_chat_templat
 from agentic_traffic_testing_tpu.serving.config import ServerConfig
 from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
 from agentic_traffic_testing_tpu.utils.tokenizer import IncrementalDecoder, load_tokenizer
+
+# The jax profiler is PROCESS-global (one trace per process), so the active
+# trace dir is module state, not LLMServer state — two server instances in
+# one process must see the same 409 contract.
+_profile_dir: Optional[str] = None
+
+
+def _active_profile_dir() -> Optional[str]:
+    return _profile_dir
+
+
+def _set_active_profile_dir(d: Optional[str]) -> None:
+    global _profile_dir
+    _profile_dir = d
 from agentic_traffic_testing_tpu.utils.tracing import (
     extract_context,
     get_tracer,
@@ -66,7 +80,6 @@ class LLMServer:
         self._inflight_lock = asyncio.Lock()
         self._inflight = 0
         self._last_arrival: Optional[float] = None
-        self._profiling_dir: Optional[str] = None
         if self.metrics:
             self.metrics.set_config_gauges(
                 max_num_seqs=cfg.max_num_seqs,
@@ -203,32 +216,39 @@ class LLMServer:
             body = {}
         log_dir = body.get("log_dir") or os.environ.get(
             "LLM_PROFILE_DIR", "/tmp/att_tpu_profile")
-        if self._profiling_dir is not None:
+        if _active_profile_dir() is not None:
             return web.json_response(
-                {"error": f"profiling already active -> {self._profiling_dir}"},
+                {"error": f"profiling already active -> {_active_profile_dir()}"},
                 status=409)
         try:
             import jax
 
-            jax.profiler.start_trace(log_dir)
+            # Off the event loop: trace setup can do real I/O, and /chat
+            # latency measurement must not stall behind it.
+            await asyncio.get_running_loop().run_in_executor(
+                None, jax.profiler.start_trace, log_dir)
         except Exception as exc:  # pragma: no cover - backend-specific
             return web.json_response({"error": str(exc)}, status=500)
-        self._profiling_dir = log_dir
+        _set_active_profile_dir(log_dir)
         return web.json_response({"status": "profiling", "log_dir": log_dir})
 
     async def handle_profile_stop(self, request: web.Request) -> web.Response:
-        if self._profiling_dir is None:
+        log_dir = _active_profile_dir()
+        if log_dir is None:
             return web.json_response({"error": "profiling not active"}, status=409)
         import jax
 
         try:
-            jax.profiler.stop_trace()
+            # stop_trace serializes the collected trace (can be 100s of MB);
+            # run it off the event loop so in-flight requests don't stall.
+            await asyncio.get_running_loop().run_in_executor(
+                None, jax.profiler.stop_trace)
         except Exception as exc:  # pragma: no cover
-            # Keep _profiling_dir set: a transient failure (e.g. unwritable
+            # Keep the active dir set: a transient failure (e.g. unwritable
             # log dir) stays retryable via another /profile/stop instead of
             # wedging the profiler until restart.
             return web.json_response({"error": str(exc)}, status=500)
-        log_dir, self._profiling_dir = self._profiling_dir, None
+        _set_active_profile_dir(None)
         return web.json_response({"status": "stopped", "log_dir": log_dir})
 
     async def handle_chat(self, request: web.Request) -> web.Response:
