@@ -218,6 +218,31 @@ def forward_full_impl(params: Params, cfg: ModelConfig, tokens: jax.Array,
 # ---------------------------------------------------------------------------
 
 
+def _prefill_layer_body(x, lp, li, cfg: ModelConfig, sin, cos, attn_site, cache):
+    """Shared layer body for full and chunked prefill.
+
+    `attn_site(q, k, v, layer_index)` supplies the attention (full prefill
+    attends in-register; chunked prefill additionally gathers prior pages).
+    Emits the layer's K/V as lane-padded, head-major page tiles so the caller
+    can bulk-write them post-scan (ops/kv_writer.py). Keeping ONE body keeps
+    chunked and unchunked prefill numerics identical by construction.
+    """
+    b, t = x.shape[:2]
+    hd, hdp = cfg.head_dim_, cache.k.shape[-1]
+    xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
+    q, k, v = _qkv(xa, lp, cfg)
+    q = apply_rope(q, sin, cos)
+    k = apply_rope(k, sin, cos)
+    attn = attn_site(q, k, v, li)
+    x = x + dense(attn.reshape(b, t, -1), lp["wo"])
+    xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
+    x = x + _mlp_block(xm, lp)
+    pad = ((0, 0), (0, 0), (0, 0), (0, hdp - hd))
+    k_pages = jnp.pad(k.transpose(0, 2, 1, 3), pad)  # [B, KH, T, hdp]
+    v_pages = jnp.pad(v.transpose(0, 2, 1, 3), pad)
+    return x, (k_pages.astype(cache.k.dtype), v_pages.astype(cache.v.dtype))
+
+
 def prefill_impl(
     params: Params,
     cfg: ModelConfig,
@@ -242,27 +267,99 @@ def prefill_impl(
     positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
     x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)
     sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
-    hd, hdp = cfg.head_dim_, cache.k.shape[-1]
 
-    def body(x, lp):
-        xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
-        q, k, v = _qkv(xa, lp, cfg)
-        q = apply_rope(q, sin, cos)
-        k = apply_rope(k, sin, cos)
-        attn = causal_attention(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
-        x = x + dense(attn.reshape(b, t, -1), lp["wo"])
-        xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
-        x = x + _mlp_block(xm, lp)
-        pad = ((0, 0), (0, 0), (0, 0), (0, hdp - hd))
-        k_pages = jnp.pad(k.transpose(0, 2, 1, 3), pad)  # [B, KH, T, hdp]
-        v_pages = jnp.pad(v.transpose(0, 2, 1, 3), pad)
-        return x, (k_pages.astype(cache.k.dtype), v_pages.astype(cache.v.dtype))
+    def attn_site(q, k, v, lp_index):
+        return causal_attention(q, k, v, q_positions=positions,
+                                kv_valid_len=seq_lens)
 
-    x, (ks, vs) = jax.lax.scan(body, x, params["layers"])
+    def body(x, xs):
+        lp, li = xs
+        return _prefill_layer_body(x, lp, li, cfg, sin, cos, attn_site, cache)
+
+    x, (ks, vs) = jax.lax.scan(
+        body, x, (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
     kc, vc = write_prompt_pages(cache.k, cache.v, ks, vs, block_tables,
                                 mode=kv_writer_mode)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jnp.take_along_axis(x, jnp.maximum(seq_lens - 1, 0)[:, None, None], axis=1)[:, 0]
+    return _unembed(last[:, None, :], params, cfg)[:, 0], KVCache(kc, vc)
+
+
+def prefill_chunk_impl(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [1, C] one chunk of one prompt; C % block_size == 0
+    cache: KVCache,           # donated
+    block_tables: jax.Array,  # [1, max_blocks]
+    chunk_start: jax.Array,   # scalar i32 — absolute position of tokens[0, 0]
+    chunk_len: jax.Array,     # scalar i32 — real (unpadded) tokens in this chunk
+    kv_writer_mode: Optional[str] = None,
+) -> tuple[jax.Array, KVCache]:
+    """One chunk of a chunked prefill. Returns (last-chunk-token logits
+    [1, V] fp32 — meaningful only on the final chunk — and the updated cache).
+
+    Chunked prefill bounds the compiled prefill bucket and the per-step
+    latency for long prompts (the reference envelope allows max_model_len up
+    to 11000): each chunk attends to the previously-written pages (validity:
+    slot < chunk_start) plus itself causally, then its pages are bulk-written
+    with the table-column offset chunk_start // block_size. The capability
+    lives inside vLLM for the reference (enable_chunked_prefill); here it is
+    first-party.
+    """
+    b, c = tokens.shape
+    if b != 1:
+        raise ValueError("chunked prefill runs one sequence per step")
+    bs = cache.block_size
+    if c % bs != 0:
+        raise ValueError(f"chunk length {c} not a multiple of block_size {bs}")
+    w = block_tables.shape[1]
+    positions = chunk_start + jnp.arange(c, dtype=jnp.int32)[None]  # [1, C]
+    x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)
+    sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    hd = cfg.head_dim_
+
+    # KV geometry: [prior pages (gathered, valid below chunk_start)] ++
+    # [this chunk in-register (causal via positions, valid below chunk_len)].
+    # Callers bound `w` to a bucketed prior width (engine._run_chunk), so
+    # early chunks don't pay attention over max_model_len worth of slots.
+    page_positions = jnp.arange(w * bs, dtype=jnp.int32)[None]
+    kv_positions = jnp.concatenate([page_positions, positions], axis=1)
+    kv_mask = jnp.concatenate(
+        [page_positions < chunk_start,
+         jnp.arange(c, dtype=jnp.int32)[None] < chunk_len], axis=1)
+
+    def attn_site(q, k, v, li):
+        k_prior = kvc.gather_kv(
+            jax.lax.dynamic_index_in_dim(cache.k, li, 0, keepdims=False),
+            block_tables)[..., :hd].astype(k.dtype)
+        v_prior = kvc.gather_kv(
+            jax.lax.dynamic_index_in_dim(cache.v, li, 0, keepdims=False),
+            block_tables)[..., :hd].astype(v.dtype)
+        return causal_attention(
+            q, jnp.concatenate([k_prior, k], axis=1),
+            jnp.concatenate([v_prior, v], axis=1),
+            q_positions=positions, kv_positions=kv_positions,
+            kv_valid_mask=kv_mask,
+        )
+
+    def body(x, xs):
+        lp, li = xs
+        return _prefill_layer_body(x, lp, li, cfg, sin, cos, attn_site, cache)
+
+    x, (ks, vs) = jax.lax.scan(
+        body, x, (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
+    # The chunk offset is a traced scalar, which only the DUS writer supports
+    # — remap the (env- or caller-chosen) pallas/interpret writer to it.
+    from agentic_traffic_testing_tpu.ops.kv_writer import writer_choice
+
+    mode = kv_writer_mode or writer_choice()
+    kc, vc = write_prompt_pages(
+        cache.k, cache.v, ks, vs, block_tables,
+        mode=("dus" if mode in ("pallas", "interpret") else mode),
+        first_block=chunk_start // bs,
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.take_along_axis(x, jnp.maximum(chunk_len - 1, 0)[None, None, None], axis=1)[:, 0]
     return _unembed(last[:, None, :], params, cfg)[:, 0], KVCache(kc, vc)
 
 

@@ -101,22 +101,27 @@ def causal_attention(
     v: jax.Array,
     *,
     q_positions: jax.Array,
-    kv_valid_len: jax.Array,
+    kv_valid_len: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
+    kv_valid_mask: Optional[jax.Array] = None,
     scale: Optional[float] = None,
 ) -> jax.Array:
     """Masked causal attention with GQA, fp32 softmax.
 
-    q            [B, Tq, H, hd]
-    k, v         [B, Tk, KH, hd]
-    q_positions  [B, Tq] absolute position of each query token
-    kv_valid_len [B]     number of valid kv slots (padding beyond is masked)
-    kv_positions [B, Tk] absolute position of each kv slot (defaults to arange)
+    q             [B, Tq, H, hd]
+    k, v          [B, Tk, KH, hd]
+    q_positions   [B, Tq] absolute position of each query token
+    kv_valid_len  [B]     number of valid kv slots (padding beyond is masked)
+    kv_positions  [B, Tk] absolute position of each kv slot (defaults to arange)
+    kv_valid_mask [B, Tk] explicit per-slot validity (chunked prefill: the
+                  prior-pages region and the in-register chunk have different
+                  validity rules). Exactly one of kv_valid_len/kv_valid_mask.
     Returns [B, Tq, H, hd].
 
-    The mask admits kv j for query i iff  pos(j) <= pos(i)  and  j < valid_len.
-    This one signature covers full prefill (Tq == Tk) and single-token decode
-    (Tq == 1, Tk == padded cache length).
+    The mask admits kv j for query i iff  pos(j) <= pos(i)  and  j valid.
+    This one signature covers full prefill (Tq == Tk), single-token decode
+    (Tq == 1, Tk == padded cache length) and chunked prefill (Tq == chunk,
+    Tk == pages + chunk).
     """
     b, tq, h, hd = q.shape
     kh = k.shape[2]
@@ -126,12 +131,18 @@ def causal_attention(
 
     if kv_positions is None:
         kv_positions = jnp.broadcast_to(jnp.arange(k.shape[1], dtype=jnp.int32)[None, :], (b, k.shape[1]))
+    if (kv_valid_len is None) == (kv_valid_mask is None):
+        raise ValueError("pass exactly one of kv_valid_len / kv_valid_mask")
+    if kv_valid_mask is None:
+        kv_valid_mask = (
+            jnp.arange(k.shape[1], dtype=jnp.int32)[None, :]
+            < kv_valid_len[:, None]
+        )
 
     qf = q.astype(jnp.float32) * scale
     logits = jnp.einsum("bqhd,bkhd->bhqk", qf, k.astype(jnp.float32))
     causal = kv_positions[:, None, None, :] <= q_positions[:, None, :, None]      # [B,1,Tq,Tk]
-    valid = jnp.arange(k.shape[1], dtype=jnp.int32)[None, None, None, :] < kv_valid_len[:, None, None, None]
-    logits = jnp.where(causal & valid, logits, jnp.float32(-1e30))
+    logits = jnp.where(causal & kv_valid_mask[:, None, None, :], logits, jnp.float32(-1e30))
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(q.dtype)

@@ -45,11 +45,15 @@ def write_prompt_pages(
     new_v: jax.Array,
     block_tables: jax.Array,  # [B, max_blocks]
     mode: str | None = None,
+    first_block=0,            # scalar: table column of token 0 (chunked prefill)
 ) -> tuple[jax.Array, jax.Array]:
     """Write every prompt page of every layer into the pool."""
     if mode is None:
         mode = writer_choice()
     if mode in ("pallas", "interpret"):
+        if not (isinstance(first_block, int) and first_block == 0):
+            raise NotImplementedError(
+                "pallas prompt writer has no chunk offset; use the dus writer")
         return write_prompt_kv_pallas(
             new_k, new_v, pool_k, pool_v, block_tables,
             interpret=(mode == "interpret"),
@@ -62,8 +66,8 @@ def write_prompt_pages(
         k_l, v_l, li = xs
         k_bt = k_l.transpose(0, 2, 1, 3)  # [B, T, KH, hdp]
         v_bt = v_l.transpose(0, 2, 1, 3)
-        kc = kvc.write_prompt_kv_full(kc, li, k_bt, block_tables)
-        vc = kvc.write_prompt_kv_full(vc, li, v_bt, block_tables)
+        kc = kvc.write_prompt_kv_full(kc, li, k_bt, block_tables, first_block)
+        vc = kvc.write_prompt_kv_full(vc, li, v_bt, block_tables, first_block)
         return (kc, vc), None
 
     L = new_k.shape[0]

@@ -53,6 +53,7 @@ from agentic_traffic_testing_tpu.runtime.runner import (
     SamplingArrays,
 )
 from agentic_traffic_testing_tpu.runtime.scheduler import (
+    ChunkPrefill,
     DecodeBatch,
     PrefillBatch,
     Scheduler,
@@ -81,6 +82,9 @@ class EngineConfig:
     # round-trip cost is amortized K×. None -> auto: 8 on TPU (dispatch-latency
     # bound), 1 elsewhere (keeps CPU tests step-exact by default).
     decode_steps: Optional[int] = None
+    # Prompts longer than this prefill in fixed chunks (bounded bucket +
+    # per-step latency); 0/None disables chunking.
+    prefill_chunk_tokens: Optional[int] = 2048
     seed: int = 0
     # Weight-only quantization: None (serve in `dtype`) or "int8"
     # (models/quant.py — halves weight HBM so Llama-3-8B fits one v5e chip).
@@ -111,6 +115,7 @@ class EngineConfig:
             max_model_len=self.max_model_len,
             block_size=self.block_size,
             decode_lookahead=max(4, (self.pipeline_depth + 1) * decode_steps),
+            prefill_chunk_tokens=self.prefill_chunk_tokens or None,
         )
 
 
@@ -180,6 +185,12 @@ class LLMEngine:
         self.scheduler = Scheduler(cfg.scheduler_config(decode_steps), self.allocator)
         # Fixed block-table width: worst-case blocks for max_model_len.
         self.table_width = -(-cfg.max_model_len // cfg.block_size)
+        # Chunked prefill attends over a bucketed prior-page width, not the
+        # full table, so early chunks of a long prompt don't pay attention
+        # over max_model_len worth of slots (pow2 ladder -> bounded compiles).
+        from agentic_traffic_testing_tpu.runtime.scheduler import pow2_buckets
+
+        self._chunk_width_buckets = pow2_buckets(4, self.table_width)
 
         self._inflight: deque[_Inflight] = deque()
         self._decode_requests: list[Request] = []   # composition of device state
@@ -265,7 +276,9 @@ class LLMEngine:
         # Only tear the decode pipeline down for admission when the head of
         # the waiting queue could actually be admitted — an unadmittable
         # (KV-starved) waiter must not degrade decode to synchronous readback.
-        admission_possible = self.scheduler.can_admit_head() or bool(self.scheduler.failed)
+        admission_possible = (self.scheduler.can_admit_head()
+                              or self.scheduler.has_pending_chunk()
+                              or bool(self.scheduler.failed))
         if admission_possible or self._decode_state is None or not self._decode_requests:
             # Composition may change: sync up, then let the scheduler decide.
             self._drain_all()
@@ -282,6 +295,8 @@ class LLMEngine:
         self._fail_unservable()
         if isinstance(plan, PrefillBatch):
             self._run_prefill(plan)
+        elif isinstance(plan, ChunkPrefill):
+            self._run_chunk(plan)
         elif isinstance(plan, DecodeBatch):
             self._setup_decode(plan)
             self._do_decode_dispatch()
@@ -332,10 +347,41 @@ class LLMEngine:
         toks = np.asarray(jax.device_get(out))
         now = time.monotonic()
         for i, r in enumerate(reqs):
+            r.num_computed_tokens = r.num_prompt_tokens
             if r.first_token_time is None:
                 r.first_token_time = now
             self._append_token(r, int(toks[i]))
         # The new sequences join decode on the next step() via plan().
+        self._invalidate_decode_state()
+
+    def _run_chunk(self, plan: ChunkPrefill) -> None:
+        """One chunk of a chunked prefill (single long prompt, solo)."""
+        r = plan.request
+        c = plan.padded_len
+        tokens = np.zeros((1, c), np.int32)
+        chunk = r.prompt_ids[plan.chunk_start : plan.chunk_start + plan.chunk_len]
+        tokens[0, : len(chunk)] = chunk
+        tables = np.full((1, self.table_width), TRASH_BLOCK, np.int32)
+        self._fill_tables([r], tables)
+        from agentic_traffic_testing_tpu.runtime.scheduler import bucket_up
+
+        need_cols = -(-(plan.chunk_start + c) // self.cfg.block_size)
+        tables = tables[:, : bucket_up(need_cols, self._chunk_width_buckets)]
+        samp = self._sampling_arrays([r], 1)
+        self.cache, out = self.runner.prefill_chunk(
+            jnp.asarray(tokens), self.cache, jnp.asarray(tables),
+            jnp.int32(plan.chunk_start), jnp.int32(plan.chunk_len),
+            samp, jnp.asarray([r.sampling_step], jnp.int32),
+        )
+        r.num_computed_tokens += plan.chunk_len
+        if plan.is_final:
+            # Synchronous readback: this sample IS the first token (TTFT).
+            toks = np.asarray(jax.device_get(out))
+            now = time.monotonic()
+            if r.first_token_time is None:
+                r.first_token_time = now
+            self._append_token(r, int(toks[0]))
+        # Intermediate chunk samples stay on device and are simply dropped.
         self._invalidate_decode_state()
 
     # -- decode ------------------------------------------------------------

@@ -104,6 +104,7 @@ def write_prompt_kv_full(
     layer: jax.Array,         # scalar i32 — layer being written
     new: jax.Array,           # [B, T, KH, hd] with T % bs == 0
     block_tables: jax.Array,  # [B, max_blocks]
+    first_block=0,            # scalar: table column of new[:, 0:bs] (chunked prefill)
 ) -> jax.Array:
     """Write a padded prompt's K (or V) into the FULL stacked pool, one
     `dynamic_update_slice` per (sequence, block).
@@ -128,7 +129,8 @@ def write_prompt_kv_full(
                 tiles, (i, 0, j * bs, 0), (1, kh, bs, hd)
             ).reshape(1, kh, 1, bs, hd)
             cache = jax.lax.dynamic_update_slice(
-                cache, upd, (layer, zero, block_tables[i, j], zero, zero)
+                cache, upd,
+                (layer, zero, block_tables[i, j + first_block], zero, zero)
             )
         return cache, None
 

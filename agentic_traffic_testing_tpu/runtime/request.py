@@ -57,6 +57,9 @@ class Request:
     error: Optional[str] = None
     # Scheduling bookkeeping
     num_preemptions: int = 0
+    # Prompt tokens already prefilled into the KV pool (chunked prefill:
+    # advances chunk by chunk; == num_prompt_tokens once decodable).
+    num_computed_tokens: int = 0
     # Total tokens sampled so far, *surviving preemption* (preemption folds
     # output_ids back into prompt_ids; sampling keys use (seed, sampling_step)
     # so the regenerated continuation stays reproducible).
@@ -88,3 +91,9 @@ class Request:
 
     def is_finished(self) -> bool:
         return self.state in (RequestState.FINISHED, RequestState.ABORTED)
+
+    @property
+    def is_prefilling(self) -> bool:
+        """Mid-chunked-prefill: holds KV blocks but is not yet decodable."""
+        return (self.state is RequestState.RUNNING
+                and self.num_computed_tokens < self.num_prompt_tokens)

@@ -24,7 +24,11 @@ import jax
 import jax.numpy as jnp
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig
-from agentic_traffic_testing_tpu.models.llama import decode_step_impl, prefill_impl
+from agentic_traffic_testing_tpu.models.llama import (
+    decode_step_impl,
+    prefill_chunk_impl,
+    prefill_impl,
+)
 from agentic_traffic_testing_tpu.ops.sampling import make_row_keys, sample
 from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
 
@@ -55,6 +59,20 @@ def _prefill_sample_impl(params, cfg: ModelConfig, tokens, cache, block_tables,
     out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
     state = DecodeState(tokens=out, positions=seq_lens, steps=steps + 1)
     return state, cache, out
+
+
+def _prefill_chunk_sample_impl(params, cfg: ModelConfig, tokens, cache,
+                               block_tables, chunk_start, chunk_len,
+                               samp: SamplingArrays, steps,
+                               kv_writer_mode=None):
+    """One chunk of a chunked prefill + sampling of the chunk's last token
+    (the sample only matters on the final chunk; earlier chunks discard it)."""
+    logits, cache = prefill_chunk_impl(params, cfg, tokens, cache,
+                                       block_tables, chunk_start, chunk_len,
+                                       kv_writer_mode=kv_writer_mode)
+    keys = make_row_keys(samp.seeds, steps)
+    out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
+    return cache, out
 
 
 def _decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
@@ -95,6 +113,11 @@ class ModelRunner:
                     kv_writer_mode=self.kv_writer_mode),
             donate_argnames=("cache",),
         )
+        self._prefill_chunk = jax.jit(
+            partial(_prefill_chunk_sample_impl, cfg=cfg,
+                    kv_writer_mode=self.kv_writer_mode),
+            donate_argnames=("cache",),
+        )
         self._decode = jax.jit(
             partial(_decode_sample_impl, cfg=cfg, num_steps=self.decode_steps,
                     attn_mode=self.attn_mode),
@@ -119,6 +142,14 @@ class ModelRunner:
         return self._prefill(self.params, tokens=tokens, cache=cache,
                              block_tables=block_tables, seq_lens=seq_lens,
                              samp=samp, steps=steps)
+
+    def prefill_chunk(self, tokens, cache, block_tables, chunk_start,
+                      chunk_len, samp, steps):
+        """-> (cache, sampled_last_chunk_tokens [1])."""
+        return self._prefill_chunk(
+            self.params, tokens=tokens, cache=cache, block_tables=block_tables,
+            chunk_start=chunk_start, chunk_len=chunk_len, samp=samp, steps=steps,
+        )
 
     def decode(self, cache, block_tables, state, samp):
         """-> (DecodeState, cache, sampled_tokens [B, decode_steps]).

@@ -63,7 +63,21 @@ class DecodeBatch:
     padded_batch: int
 
 
-StepPlan = Union[PrefillBatch, DecodeBatch, None]
+@dataclass
+class ChunkPrefill:
+    """One chunk of one long prompt (chunked prefill; request runs alone)."""
+
+    request: Request
+    chunk_start: int   # absolute position of the chunk's first token
+    chunk_len: int     # real tokens in this chunk (<= padded_len)
+    padded_len: int    # compiled chunk bucket (block-aligned)
+
+    @property
+    def is_final(self) -> bool:
+        return self.chunk_start + self.chunk_len >= self.request.num_prompt_tokens
+
+
+StepPlan = Union[PrefillBatch, DecodeBatch, ChunkPrefill, None]
 
 
 @dataclass
@@ -76,8 +90,17 @@ class SchedulerConfig:
     # a couple of speculative steps past a stop condition (see engine.py).
     decode_lookahead: int = 4
     min_prefill_bucket: int = 32
+    # Prompts longer than this prefill in fixed chunks of this many tokens
+    # (one compiled bucket instead of one per long-prompt length; bounded
+    # per-step latency). None disables chunking.
+    prefill_chunk_tokens: Optional[int] = 2048
 
     def __post_init__(self) -> None:
+        if self.prefill_chunk_tokens is not None:
+            c = min(self.prefill_chunk_tokens, self.max_num_batched_tokens,
+                    self.max_model_len)
+            self.prefill_chunk_tokens = max(self.block_size,
+                                            c - c % self.block_size)
         self.prefill_buckets = [
             b for b in pow2_buckets(self.min_prefill_bucket, self.max_model_len)
         ]
@@ -131,10 +154,30 @@ class Scheduler:
         if len(self.running) >= self.cfg.max_num_seqs:
             return False
         head = self.waiting[0]
+        # Same formula as admission (prompt + first decode slot + lookahead):
+        # a mismatch here makes the engine tear down its decode pipeline every
+        # step for a head that _plan_prefill then refuses.
         need = self.allocator.blocks_needed(
-            head.num_prompt_tokens + self.cfg.decode_lookahead
+            head.num_prompt_tokens + 1 + self.cfg.decode_lookahead
         )
         return self.allocator.can_allocate(need)
+
+    def has_pending_chunk(self) -> bool:
+        """A running request is mid-chunked-prefill (its next chunk should be
+        planned before any decode)."""
+        return any(r.is_prefilling for r in self.running)
+
+    def _needs_chunking(self, req: Request) -> bool:
+        c = self.cfg.prefill_chunk_tokens
+        return c is not None and req.num_prompt_tokens > c
+
+    def _next_chunk(self, req: Request) -> ChunkPrefill:
+        c = self.cfg.prefill_chunk_tokens
+        start = req.num_computed_tokens
+        return ChunkPrefill(
+            request=req, chunk_start=start,
+            chunk_len=min(c, req.num_prompt_tokens - start), padded_len=c,
+        )
 
     def abort(self, req: Request) -> None:
         if req in self.running:
@@ -167,14 +210,40 @@ class Scheduler:
         bs = self.cfg.block_size
         return -(-n // bs) * bs
 
-    def _plan_prefill(self) -> Optional[PrefillBatch]:
-        """Admit waiting requests of one shared length bucket."""
+    def _plan_prefill(self) -> Union[PrefillBatch, ChunkPrefill, None]:
+        """Admit waiting requests of one shared length bucket, or continue /
+        start a chunked prefill (long prompts run alone, chunk by chunk)."""
+        for r in self.running:  # in-flight chunked prompt finishes first
+            if r.is_prefilling:
+                return self._next_chunk(r)
         if not self.waiting:
             return None
+        head = self.waiting[0]
+        if self._needs_chunking(head):
+            if len(self.running) >= self.cfg.max_num_seqs:
+                return None
+            need_tokens = head.num_prompt_tokens + 1 + self.cfg.decode_lookahead
+            blocks = self.allocator.new_sequence()
+            if not blocks.ensure_capacity(need_tokens):
+                blocks.release()
+                if not self.running:
+                    bad = self.waiting.popleft()
+                    bad.error = (
+                        f"sequence of {bad.num_prompt_tokens} tokens cannot fit "
+                        f"the KV pool ({self.allocator.usable_tokens} tokens)"
+                    )
+                    self.failed.append(bad)
+                return None  # no KV room: let decode drain / preemption handle it
+            head.blocks = blocks
+            head.state = RequestState.RUNNING
+            self.running.append(self.waiting.popleft())
+            return self._next_chunk(head)
         batch: list[Request] = []
         bucket_len = 0
         while self.waiting:
             req = self.waiting[0]
+            if self._needs_chunking(req):
+                break  # a long prompt starts its own (solo) plan next step
             if len(self.running) + len(batch) >= self.cfg.max_num_seqs:
                 break
             padded = self._padded_prompt_len(req)
@@ -184,8 +253,9 @@ class Scheduler:
             if batch and cand_len != bucket_len:
                 # Keep one shape per step: only batch prompts of the same bucket.
                 break
-            # All-or-nothing KV allocation: prompt + lookahead headroom.
-            need_tokens = req.num_prompt_tokens + self.cfg.decode_lookahead
+            # All-or-nothing KV allocation: prompt + first decode slot +
+            # lookahead headroom (keep in sync with can_admit_head).
+            need_tokens = req.num_prompt_tokens + 1 + self.cfg.decode_lookahead
             blocks = self.allocator.new_sequence()
             if not blocks.ensure_capacity(need_tokens):
                 # Unregister the empty sequence: the native allocator tracks
@@ -222,6 +292,10 @@ class Scheduler:
         """One token for every running sequence; preempt if KV runs out."""
         if not self.running:
             return None
+        # plan() only reaches here once no chunked prefill is pending:
+        # _plan_prefill returns the next chunk for any mid-prefill request.
+        assert not any(r.is_prefilling for r in self.running), (
+            "decode planned while a chunked prefill is in flight")
         # Grow each sequence's KV capacity for this step (+ lookahead).
         # Victims are chosen LIFO (youngest arrival) — vLLM's policy, which
         # protects the oldest requests' latency.
@@ -256,11 +330,11 @@ class Scheduler:
                 if req is not None and req.state == RequestState.RUNNING:
                     survivors.append(req)
         self.running = survivors
-        if not self.running:
+        if not survivors:
             return None
         return DecodeBatch(
-            requests=list(self.running),
-            padded_batch=bucket_up(len(self.running), self.cfg.batch_buckets),
+            requests=list(survivors),
+            padded_batch=bucket_up(len(survivors), self.cfg.batch_buckets),
         )
 
     def _ensure_decode_capacity(self, req: Request) -> bool:
@@ -281,6 +355,7 @@ class Scheduler:
         self._release(req)
         req.state = RequestState.PREEMPTED
         req.num_preemptions += 1
+        req.num_computed_tokens = 0  # chunked-prefill progress is in the blocks
         self.num_preemptions += 1
         # Re-admit with its generated tokens folded into the prompt so the
         # recompute prefill reproduces the exact sequence so far.

@@ -45,6 +45,7 @@ class ServerConfig:
     tp_size: int = 1                           # LLM_TP_SIZE (TPU-native knob)
     quantization: Optional[str] = None         # LLM_QUANTIZATION ("int8" | unset)
     decode_steps: Optional[int] = None         # LLM_DECODE_STEPS (None -> auto)
+    prefill_chunk_tokens: int = 2048           # LLM_PREFILL_CHUNK_TOKENS (0 = off)
     num_blocks: Optional[int] = None           # LLM_NUM_BLOCKS (None -> HBM profile)
     block_size: int = 16                       # LLM_BLOCK_SIZE
     weights_path: Optional[str] = None         # LLM_WEIGHTS_PATH (local safetensors dir)
@@ -78,6 +79,8 @@ class ServerConfig:
         c.quantization = os.environ.get("LLM_QUANTIZATION") or None
         ds = os.environ.get("LLM_DECODE_STEPS")
         c.decode_steps = int(ds) if ds else None
+        c.prefill_chunk_tokens = int(
+            os.environ.get("LLM_PREFILL_CHUNK_TOKENS") or c.prefill_chunk_tokens)
         nb = os.environ.get("LLM_NUM_BLOCKS")
         c.num_blocks = int(nb) if nb else None
         c.block_size = int(os.environ.get("LLM_BLOCK_SIZE") or c.block_size)
@@ -105,6 +108,8 @@ class ServerConfig:
         p.add_argument("--tp-size", type=int, default=c.tp_size)
         p.add_argument("--quantization", default=c.quantization)
         p.add_argument("--decode-steps", type=int, default=c.decode_steps)
+        p.add_argument("--prefill-chunk-tokens", type=int,
+                       default=c.prefill_chunk_tokens)
         p.add_argument("--num-blocks", type=int, default=c.num_blocks)
         p.add_argument("--block-size", type=int, default=c.block_size)
         p.add_argument("--weights-path", default=c.weights_path)
@@ -112,6 +117,7 @@ class ServerConfig:
         for f in ("model", "dtype", "max_num_seqs", "max_num_batched_tokens",
                   "memory_utilization", "max_tokens", "max_model_len",
                   "temperature", "host", "port", "tp_size", "quantization",
-                  "decode_steps", "num_blocks", "block_size", "weights_path"):
+                  "decode_steps", "prefill_chunk_tokens", "num_blocks",
+                  "block_size", "weights_path"):
             setattr(c, f, getattr(a, f))
         return c

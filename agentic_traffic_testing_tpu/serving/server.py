@@ -102,6 +102,7 @@ class LLMServer:
             max_model_len=c.max_model_len, block_size=c.block_size,
             num_blocks=c.num_blocks, memory_utilization=c.memory_utilization,
             decode_steps=c.decode_steps, quantization=c.quantization,
+            prefill_chunk_tokens=c.prefill_chunk_tokens,
         )
         runner = None
         params = None
