@@ -108,13 +108,179 @@ class SequenceBlocks:
         return row
 
 
-def make_block_allocator(num_blocks: int, block_size: int, native: Optional[bool] = None):
+class PrefixCachingAllocator(BlockAllocator):
+    """Free-list allocator with content-addressed block reuse.
+
+    vLLM-style automatic prefix caching (the reference can reach it through
+    vLLM's --enable-prefix-caching; here it is first-party): every FULL
+    prompt block is indexed by hash(parent_hash, its tokens). A new request
+    shares the longest chain of already-computed blocks (refcounted) and
+    only computes its suffix — which rides the chunked-prefill machinery
+    (scheduler.ChunkPrefill with chunk_start = cached tokens). This is the
+    agentic testbed's own traffic shape: AgentVerse stages and agent-b
+    workers resend near-identical system/context prefixes all day.
+
+    Lifecycle: a released block whose content is indexed parks in an LRU
+    "evictable" pool — still reusable by content, reclaimed (and unindexed)
+    only when fresh allocations need it. Shared/indexed blocks are never
+    written: writes always target blocks past the cached prefix.
+    """
+
+    def __init__(self, num_blocks: int, block_size: int) -> None:
+        super().__init__(num_blocks, block_size)
+        self._index: dict[int, int] = {}      # chain-hash -> block id
+        self._block_key: dict[int, int] = {}  # block id -> chain-hash
+        self._refcount: dict[int, int] = {}   # live users of a shared block
+        # LRU of refcount-0 indexed blocks (dict preserves insertion order).
+        self._evictable: dict[int, None] = {}
+        self.hit_tokens = 0
+        self.query_tokens = 0
+
+    # -- capacity (evictable blocks count as available) ---------------------
+
+    @property
+    def num_free_blocks(self) -> int:
+        return len(self._free) + len(self._evictable)
+
+    @property
+    def num_used_blocks(self) -> int:
+        return (self.num_blocks - 1) - self.num_free_blocks
+
+    def can_allocate(self, n: int) -> bool:
+        return n <= self.num_free_blocks
+
+    def allocate(self, n: int) -> Optional[list[int]]:
+        if n > self.num_free_blocks:
+            return None
+        taken: list[int] = []
+        take_free = min(n, len(self._free))
+        if take_free:
+            taken = self._free[-take_free:]
+            del self._free[len(self._free) - take_free:]
+        while len(taken) < n:  # reclaim LRU cached blocks, dropping their index
+            blk = next(iter(self._evictable))
+            del self._evictable[blk]
+            self._unindex(blk)
+            taken.append(blk)
+        for blk in taken:
+            # Explicit ownership count: sharers via match_prefix stack on top
+            # of this 1 (an implicit owner count would let a sharer's release
+            # drive the count to 0 while the computing owner still decodes).
+            self._refcount[blk] = 1
+        return taken
+
+    def _unindex(self, blk: int) -> None:
+        key = self._block_key.pop(blk, None)
+        if key is not None and self._index.get(key) == blk:
+            del self._index[key]
+
+    def free(self, blocks: list[int]) -> None:
+        """Release a sequence's blocks: shared ones decref, indexed ones park
+        in the evictable LRU, plain ones return to the free list."""
+        for b in blocks:
+            if not (TRASH_BLOCK < b < self.num_blocks):
+                raise ValueError(f"freeing invalid block id {b}")
+            rc = self._refcount.get(b, 1) - 1
+            if rc > 0:
+                self._refcount[b] = rc
+                continue
+            self._refcount.pop(b, None)
+            if b in self._block_key:
+                self._evictable[b] = None  # most-recently-used position
+            else:
+                self._free.append(b)
+        if len(self._free) + len(self._evictable) > self.num_blocks - 1:
+            raise RuntimeError("double free detected: free list exceeds capacity")
+
+    # -- content addressing -------------------------------------------------
+
+    def _chain_keys(self, prompt_ids: list[int], max_blocks: int) -> list[int]:
+        keys, parent = [], 0
+        bs = self.block_size
+        for i in range(max_blocks):
+            parent = hash((parent, tuple(prompt_ids[i * bs:(i + 1) * bs])))
+            keys.append(parent)
+        return keys
+
+    def _matchable_blocks(self, prompt_ids: list[int]) -> int:
+        # Only FULL blocks are addressable, and at least one prompt token
+        # must remain to compute (its logits seed the first sampled token).
+        return (len(prompt_ids) - 1) // self.block_size
+
+    def probe_prefix(self, prompt_ids: list[int]) -> int:
+        """Cached-token count a match would yield; no state changes."""
+        cached = 0
+        for key in self._chain_keys(prompt_ids, self._matchable_blocks(prompt_ids)):
+            if key not in self._index:
+                break
+            cached += self.block_size
+        return cached
+
+    def match_prefix(self, prompt_ids: list[int]) -> tuple["SequenceBlocks", int]:
+        """Acquire the longest cached block chain for this prompt.
+
+        Returns (sequence holding the shared blocks, cached token count).
+        The caller grows the sequence with plain blocks for the suffix and
+        MUST release it on failure paths (refcounts are already taken)."""
+        seq = SequenceBlocks(self)
+        cached = 0
+        for key in self._chain_keys(prompt_ids, self._matchable_blocks(prompt_ids)):
+            blk = self._index.get(key)
+            if blk is None:
+                break
+            self._refcount[blk] = self._refcount.get(blk, 0) + 1
+            self._evictable.pop(blk, None)
+            seq.blocks.append(blk)
+            cached += self.block_size
+        return seq, cached
+
+    def record_prefix_stats(self, query_tokens: int, hit_tokens: int) -> None:
+        """Hit-rate accounting, called once per SUCCESSFUL admission (counting
+        inside match_prefix would inflate the rate on every KV-starved retry)."""
+        self.query_tokens += query_tokens
+        self.hit_tokens += hit_tokens
+
+    def register_computed(self, seq: "SequenceBlocks", prompt_ids: list[int]) -> None:
+        """Index this sequence's full prompt blocks for future sharing.
+
+        Called once the prompt's pages are written (dispatch order guarantees
+        any later reader's dispatch sees them). First writer wins: keys that
+        already map to another block keep their canonical block."""
+        full = len(prompt_ids) // self.block_size
+        for i, key in enumerate(self._chain_keys(prompt_ids, full)):
+            if i >= len(seq.blocks):
+                break
+            blk = seq.blocks[i]
+            if key in self._index:
+                continue
+            if blk in self._block_key:  # already indexed under its own key
+                continue
+            self._index[key] = blk
+            self._block_key[blk] = key
+
+    def kv_extra_stats(self) -> dict:
+        return {
+            "prefix_cache_hit_tokens": self.hit_tokens,
+            "prefix_cache_query_tokens": self.query_tokens,
+            "prefix_cache_indexed_blocks": len(self._index),
+        }
+
+
+def make_block_allocator(num_blocks: int, block_size: int,
+                         native: Optional[bool] = None,
+                         prefix_caching: bool = False):
     """Allocator factory: C++ core when available, Python fallback otherwise.
 
     `native=None` (default) auto-selects: the `native/` C++ library if it
     loads (honoring ATT_TPU_NATIVE=0), else this module's pure-Python
     implementation. Both are bit-exact interchangeable (tests/test_native.py).
+    `prefix_caching=True` selects the content-addressed Python allocator (no
+    native equivalent yet).
     """
+    if prefix_caching:
+        if native is True:
+            raise RuntimeError("prefix caching has no native allocator yet")
+        return PrefixCachingAllocator(num_blocks, block_size)
     if native is not False:
         try:
             from agentic_traffic_testing_tpu import native as native_mod

@@ -85,6 +85,9 @@ class EngineConfig:
     # Prompts longer than this prefill in fixed chunks (bounded bucket +
     # per-step latency); 0/None disables chunking.
     prefill_chunk_tokens: Optional[int] = 2048
+    # Content-addressed reuse of full prompt blocks (vLLM automatic-prefix-
+    # caching analog); cached requests prefill only their suffix.
+    prefix_caching: bool = False
     seed: int = 0
     # Weight-only quantization: None (serve in `dtype`) or "int8"
     # (models/quant.py — halves weight HBM so Llama-3-8B fits one v5e chip).
@@ -181,7 +184,8 @@ class LLMEngine:
             make_kv_cache(self.model_cfg, num_blocks, cfg.block_size, dtype)
         )
         self.allocator = make_block_allocator(num_blocks, cfg.block_size,
-                                              native=cfg.native_allocator)
+                                              native=cfg.native_allocator,
+                                              prefix_caching=cfg.prefix_caching)
         self.scheduler = Scheduler(cfg.scheduler_config(decode_steps), self.allocator)
         # Fixed block-table width: worst-case blocks for max_model_len.
         self.table_width = -(-cfg.max_model_len // cfg.block_size)
@@ -348,11 +352,20 @@ class LLMEngine:
         now = time.monotonic()
         for i, r in enumerate(reqs):
             r.num_computed_tokens = r.num_prompt_tokens
+            self._register_prefix(r)
             if r.first_token_time is None:
                 r.first_token_time = now
             self._append_token(r, int(toks[i]))
         # The new sequences join decode on the next step() via plan().
         self._invalidate_decode_state()
+
+    def _register_prefix(self, r: Request) -> None:
+        """Index this prompt's full blocks for prefix reuse (no-op unless the
+        prefix-caching allocator is active and the request still holds its
+        blocks — _append_token may have finished+released it already)."""
+        register = getattr(self.allocator, "register_computed", None)
+        if register is not None and r.blocks is not None:
+            register(r.blocks, r.prompt_ids)
 
     def _run_chunk(self, plan: ChunkPrefill) -> None:
         """One chunk of a chunked prefill (single long prompt, solo)."""
@@ -375,6 +388,7 @@ class LLMEngine:
         )
         r.num_computed_tokens += plan.chunk_len
         if plan.is_final:
+            self._register_prefix(r)
             # Synchronous readback: this sample IS the first token (TTFT).
             toks = np.asarray(jax.device_get(out))
             now = time.monotonic()

@@ -154,13 +154,17 @@ class Scheduler:
         if len(self.running) >= self.cfg.max_num_seqs:
             return False
         head = self.waiting[0]
-        # Same formula as admission (prompt + first decode slot + lookahead):
-        # a mismatch here makes the engine tear down its decode pipeline every
-        # step for a head that _plan_prefill then refuses.
+        # Same formula as admission (prompt + first decode slot + lookahead,
+        # minus any cached prefix match_prefix would supply): a mismatch here
+        # makes the engine tear down its decode pipeline every step for a
+        # head that _plan_prefill then refuses — or, with the cache discount
+        # missing, never admit a cache-hit request whose suffix would fit.
+        # (Slightly optimistic when the matched blocks are themselves in the
+        # evictable pool; _plan_prefill just declines that step.)
         need = self.allocator.blocks_needed(
             head.num_prompt_tokens + 1 + self.cfg.decode_lookahead
-        )
-        return self.allocator.can_allocate(need)
+        ) - self._probe_cached(head) // self.cfg.block_size
+        return self.allocator.can_allocate(max(0, need))
 
     def has_pending_chunk(self) -> bool:
         """A running request is mid-chunked-prefill (its next chunk should be
@@ -171,13 +175,48 @@ class Scheduler:
         c = self.cfg.prefill_chunk_tokens
         return c is not None and req.num_prompt_tokens > c
 
+    def _probe_cached(self, req: Request) -> int:
+        """Prefix-cache hit size (tokens) admission would get; 0 without a
+        prefix-caching allocator."""
+        probe = getattr(self.allocator, "probe_prefix", None)
+        return probe(req.prompt_ids) if probe else 0
+
+    def _acquire_blocks(self, req: Request, need_tokens: int):
+        """All-or-nothing block acquisition, honoring any cached prefix.
+
+        Returns (blocks, cached_tokens) or (None, 0) if the pool can't hold
+        the request right now."""
+        match = getattr(self.allocator, "match_prefix", None)
+        if match is not None:
+            blocks, cached = match(req.prompt_ids)
+        else:
+            blocks, cached = self.allocator.new_sequence(), 0
+        if not blocks.ensure_capacity(need_tokens):
+            blocks.release()
+            return None, 0
+        if match is not None:
+            self.allocator.record_prefix_stats(req.num_prompt_tokens, cached)
+        return blocks, cached
+
     def _next_chunk(self, req: Request) -> ChunkPrefill:
-        c = self.cfg.prefill_chunk_tokens
         start = req.num_computed_tokens
-        return ChunkPrefill(
-            request=req, chunk_start=start,
-            chunk_len=min(c, req.num_prompt_tokens - start), padded_len=c,
-        )
+        remaining = req.num_prompt_tokens - start
+        c = self.cfg.prefill_chunk_tokens
+        real = remaining if c is None else min(c, remaining)
+        # Bucket the compiled chunk length (a cache-hit suffix is usually far
+        # shorter than the full chunk size), block-aligned, and clamped so
+        # chunk_start + padded never exceeds the block table — the padded
+        # tail's page writes would otherwise clamp onto the last real block
+        # and destroy its KV.
+        bs = self.cfg.block_size
+        table_tokens = -(-self.cfg.max_model_len // bs) * bs
+        padded = bucket_up(real, self.cfg.prefill_buckets)
+        padded = -(-padded // bs) * bs
+        if c is not None:
+            padded = min(padded, c)
+        padded = min(padded, table_tokens - start)
+        return ChunkPrefill(request=req, chunk_start=start, chunk_len=real,
+                            padded_len=max(padded, bs))
 
     def abort(self, req: Request) -> None:
         if req in self.running:
@@ -219,13 +258,18 @@ class Scheduler:
         if not self.waiting:
             return None
         head = self.waiting[0]
-        if self._needs_chunking(head):
+        # Long prompts AND cache-hit prompts admit solo on the chunk path: a
+        # cached request prefills only its suffix (chunk_start = cached
+        # tokens), which a batched same-bucket prefill cannot express.
+        # Probe cost is O(prompt) hashing — done for the HEAD only; later
+        # queue entries are re-examined when they reach the head (a cached
+        # request slipping into a batch is correct, it just recomputes).
+        if self._needs_chunking(head) or self._probe_cached(head) > 0:
             if len(self.running) >= self.cfg.max_num_seqs:
                 return None
             need_tokens = head.num_prompt_tokens + 1 + self.cfg.decode_lookahead
-            blocks = self.allocator.new_sequence()
-            if not blocks.ensure_capacity(need_tokens):
-                blocks.release()
+            blocks, cached = self._acquire_blocks(head, need_tokens)
+            if blocks is None:
                 if not self.running:
                     bad = self.waiting.popleft()
                     bad.error = (
@@ -235,6 +279,7 @@ class Scheduler:
                     self.failed.append(bad)
                 return None  # no KV room: let decode drain / preemption handle it
             head.blocks = blocks
+            head.num_computed_tokens = cached
             head.state = RequestState.RUNNING
             self.running.append(self.waiting.popleft())
             return self._next_chunk(head)
@@ -243,7 +288,7 @@ class Scheduler:
         while self.waiting:
             req = self.waiting[0]
             if self._needs_chunking(req):
-                break  # a long prompt starts its own (solo) plan next step
+                break  # solo (chunk-path) admission starts its own plan next step
             if len(self.running) + len(batch) >= self.cfg.max_num_seqs:
                 break
             padded = self._padded_prompt_len(req)
@@ -256,12 +301,8 @@ class Scheduler:
             # All-or-nothing KV allocation: prompt + first decode slot +
             # lookahead headroom (keep in sync with can_admit_head).
             need_tokens = req.num_prompt_tokens + 1 + self.cfg.decode_lookahead
-            blocks = self.allocator.new_sequence()
-            if not blocks.ensure_capacity(need_tokens):
-                # Unregister the empty sequence: the native allocator tracks
-                # it C++-side until released, so dropping the wrapper without
-                # this would leak one registry entry per failed admission.
-                blocks.release()
+            blocks, _ = self._acquire_blocks(req, need_tokens)
+            if blocks is None:
                 if not self.running and not batch:
                     # The pool is completely idle and the head still cannot
                     # fit (e.g. a preempted prompt grew past pool capacity):
@@ -381,6 +422,13 @@ class Scheduler:
     # -- accounting (Prometheus) ------------------------------------------
 
     def kv_stats(self) -> dict:
+        a = self.allocator
+        extra = getattr(a, "kv_extra_stats", None)
+        if extra is not None:
+            return {**self._base_kv_stats(), **extra()}
+        return self._base_kv_stats()
+
+    def _base_kv_stats(self) -> dict:
         a = self.allocator
         return {
             "num_blocks": a.num_blocks - 1,

@@ -46,6 +46,7 @@ class ServerConfig:
     quantization: Optional[str] = None         # LLM_QUANTIZATION ("int8" | unset)
     decode_steps: Optional[int] = None         # LLM_DECODE_STEPS (None -> auto)
     prefill_chunk_tokens: int = 2048           # LLM_PREFILL_CHUNK_TOKENS (0 = off)
+    prefix_caching: bool = False               # LLM_PREFIX_CACHING
     num_blocks: Optional[int] = None           # LLM_NUM_BLOCKS (None -> HBM profile)
     block_size: int = 16                       # LLM_BLOCK_SIZE
     weights_path: Optional[str] = None         # LLM_WEIGHTS_PATH (local safetensors dir)
@@ -81,6 +82,7 @@ class ServerConfig:
         c.decode_steps = int(ds) if ds else None
         c.prefill_chunk_tokens = int(
             os.environ.get("LLM_PREFILL_CHUNK_TOKENS") or c.prefill_chunk_tokens)
+        c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
         nb = os.environ.get("LLM_NUM_BLOCKS")
         c.num_blocks = int(nb) if nb else None
         c.block_size = int(os.environ.get("LLM_BLOCK_SIZE") or c.block_size)
@@ -110,6 +112,8 @@ class ServerConfig:
         p.add_argument("--decode-steps", type=int, default=c.decode_steps)
         p.add_argument("--prefill-chunk-tokens", type=int,
                        default=c.prefill_chunk_tokens)
+        p.add_argument("--enable-prefix-caching", dest="prefix_caching",
+                       action="store_true", default=c.prefix_caching)
         p.add_argument("--num-blocks", type=int, default=c.num_blocks)
         p.add_argument("--block-size", type=int, default=c.block_size)
         p.add_argument("--weights-path", default=c.weights_path)
@@ -117,7 +121,7 @@ class ServerConfig:
         for f in ("model", "dtype", "max_num_seqs", "max_num_batched_tokens",
                   "memory_utilization", "max_tokens", "max_model_len",
                   "temperature", "host", "port", "tp_size", "quantization",
-                  "decode_steps", "prefill_chunk_tokens", "num_blocks",
-                  "block_size", "weights_path"):
+                  "decode_steps", "prefill_chunk_tokens", "prefix_caching",
+                  "num_blocks", "block_size", "weights_path"):
             setattr(c, f, getattr(a, f))
         return c

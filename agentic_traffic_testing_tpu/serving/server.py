@@ -103,6 +103,7 @@ class LLMServer:
             num_blocks=c.num_blocks, memory_utilization=c.memory_utilization,
             decode_steps=c.decode_steps, quantization=c.quantization,
             prefill_chunk_tokens=c.prefill_chunk_tokens,
+            prefix_caching=c.prefix_caching,
         )
         runner = None
         params = None
