@@ -128,7 +128,10 @@ class PrefixCachingAllocator(BlockAllocator):
 
     def __init__(self, num_blocks: int, block_size: int) -> None:
         super().__init__(num_blocks, block_size)
-        self._index: dict[int, int] = {}      # chain-hash -> block id
+        # chain-hash -> (block id, block tokens). The tokens are compared on
+        # every lookup: a 64-bit hash collision must degrade to a cache miss,
+        # never serve another prompt's KV (cross-request content leakage).
+        self._index: dict[int, tuple[int, tuple[int, ...]]] = {}
         self._block_key: dict[int, int] = {}  # block id -> chain-hash
         self._refcount: dict[int, int] = {}   # live users of a shared block
         # LRU of refcount-0 indexed blocks (dict preserves insertion order).
@@ -171,8 +174,10 @@ class PrefixCachingAllocator(BlockAllocator):
 
     def _unindex(self, blk: int) -> None:
         key = self._block_key.pop(blk, None)
-        if key is not None and self._index.get(key) == blk:
-            del self._index[key]
+        if key is not None:
+            entry = self._index.get(key)
+            if entry is not None and entry[0] == blk:
+                del self._index[key]
 
     def free(self, blocks: list[int]) -> None:
         """Release a sequence's blocks: shared ones decref, indexed ones park
@@ -194,10 +199,15 @@ class PrefixCachingAllocator(BlockAllocator):
 
     # -- content addressing -------------------------------------------------
 
-    def _chain_keys(self, prompt_ids: list[int], max_blocks: int) -> list[int]:
+    def chain_keys(self, prompt_ids: list[int]) -> list[int]:
+        """Chained content hashes for every FULL block of this prompt.
+
+        O(prompt) hashing — callers memoize per request (see
+        scheduler/engine's use of `request_chain_keys`) so probing the same
+        waiting head every engine step doesn't re-hash its whole prompt."""
         keys, parent = [], 0
         bs = self.block_size
-        for i in range(max_blocks):
+        for i in range(len(prompt_ids) // bs):
             parent = hash((parent, tuple(prompt_ids[i * bs:(i + 1) * bs])))
             keys.append(parent)
         return keys
@@ -207,55 +217,70 @@ class PrefixCachingAllocator(BlockAllocator):
         # must remain to compute (its logits seed the first sampled token).
         return (len(prompt_ids) - 1) // self.block_size
 
-    def probe_prefix(self, prompt_ids: list[int]) -> int:
+    def _lookup(self, key: int, tokens: tuple[int, ...]) -> Optional[int]:
+        entry = self._index.get(key)
+        if entry is None or entry[1] != tokens:  # hash collision -> miss
+            return None
+        return entry[0]
+
+    def probe_prefix(self, prompt_ids: list[int],
+                     keys: Optional[list[int]] = None) -> int:
         """Cached-token count a match would yield; no state changes."""
+        bs = self.block_size
+        keys = keys if keys is not None else self.chain_keys(prompt_ids)
         cached = 0
-        for key in self._chain_keys(prompt_ids, self._matchable_blocks(prompt_ids)):
-            if key not in self._index:
+        for i in range(self._matchable_blocks(prompt_ids)):
+            if self._lookup(keys[i], tuple(prompt_ids[i * bs:(i + 1) * bs])) is None:
                 break
-            cached += self.block_size
+            cached += bs
         return cached
 
-    def match_prefix(self, prompt_ids: list[int]) -> tuple["SequenceBlocks", int]:
+    def match_prefix(self, prompt_ids: list[int],
+                     keys: Optional[list[int]] = None) -> tuple["SequenceBlocks", int]:
         """Acquire the longest cached block chain for this prompt.
 
         Returns (sequence holding the shared blocks, cached token count).
         The caller grows the sequence with plain blocks for the suffix and
         MUST release it on failure paths (refcounts are already taken)."""
+        bs = self.block_size
+        keys = keys if keys is not None else self.chain_keys(prompt_ids)
         seq = SequenceBlocks(self)
         cached = 0
-        for key in self._chain_keys(prompt_ids, self._matchable_blocks(prompt_ids)):
-            blk = self._index.get(key)
+        for i in range(self._matchable_blocks(prompt_ids)):
+            blk = self._lookup(keys[i], tuple(prompt_ids[i * bs:(i + 1) * bs]))
             if blk is None:
                 break
             self._refcount[blk] = self._refcount.get(blk, 0) + 1
             self._evictable.pop(blk, None)
             seq.blocks.append(blk)
-            cached += self.block_size
+            cached += bs
         return seq, cached
 
     def record_prefix_stats(self, query_tokens: int, hit_tokens: int) -> None:
-        """Hit-rate accounting, called once per SUCCESSFUL admission (counting
-        inside match_prefix would inflate the rate on every KV-starved retry)."""
+        """Hit-rate accounting: call once per admission that actually APPLIES
+        the cached prefix (counting inside match_prefix would inflate the
+        rate on KV-starved retries and on batch-path full recomputes)."""
         self.query_tokens += query_tokens
         self.hit_tokens += hit_tokens
 
-    def register_computed(self, seq: "SequenceBlocks", prompt_ids: list[int]) -> None:
+    def register_computed(self, seq: "SequenceBlocks", prompt_ids: list[int],
+                          keys: Optional[list[int]] = None) -> None:
         """Index this sequence's full prompt blocks for future sharing.
 
         Called once the prompt's pages are written (dispatch order guarantees
         any later reader's dispatch sees them). First writer wins: keys that
         already map to another block keep their canonical block."""
-        full = len(prompt_ids) // self.block_size
-        for i, key in enumerate(self._chain_keys(prompt_ids, full)):
-            if i >= len(seq.blocks):
-                break
+        bs = self.block_size
+        keys = keys if keys is not None else self.chain_keys(prompt_ids)
+        full = len(prompt_ids) // bs
+        for i in range(min(full, len(seq.blocks))):
+            key = keys[i]
             blk = seq.blocks[i]
             if key in self._index:
                 continue
             if blk in self._block_key:  # already indexed under its own key
                 continue
-            self._index[key] = blk
+            self._index[key] = (blk, tuple(prompt_ids[i * bs:(i + 1) * bs]))
             self._block_key[blk] = key
 
     def kv_extra_stats(self) -> dict:
@@ -264,6 +289,21 @@ class PrefixCachingAllocator(BlockAllocator):
             "prefix_cache_query_tokens": self.query_tokens,
             "prefix_cache_indexed_blocks": len(self._index),
         }
+
+
+def request_chain_keys(allocator, req) -> Optional[list[int]]:
+    """Memoized chain keys for a request's current prompt (invalidated by
+    length change — preemption only ever appends tokens). None when the
+    allocator has no content addressing."""
+    if not isinstance(allocator, PrefixCachingAllocator):
+        return None
+    n = req.num_prompt_tokens
+    memo = req.prefix_keys_cache
+    if memo is not None and memo[0] == n:
+        return memo[1]
+    keys = allocator.chain_keys(req.prompt_ids)
+    req.prefix_keys_cache = (n, keys)
+    return keys
 
 
 def make_block_allocator(num_blocks: int, block_size: int,

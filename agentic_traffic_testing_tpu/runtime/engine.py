@@ -365,7 +365,12 @@ class LLMEngine:
         blocks — _append_token may have finished+released it already)."""
         register = getattr(self.allocator, "register_computed", None)
         if register is not None and r.blocks is not None:
-            register(r.blocks, r.prompt_ids)
+            from agentic_traffic_testing_tpu.runtime.block_allocator import (
+                request_chain_keys,
+            )
+
+            register(r.blocks, r.prompt_ids,
+                     keys=request_chain_keys(self.allocator, r))
 
     def _run_chunk(self, plan: ChunkPrefill) -> None:
         """One chunk of a chunked prefill (single long prompt, solo)."""

@@ -60,6 +60,9 @@ class Request:
     # Prompt tokens already prefilled into the KV pool (chunked prefill:
     # advances chunk by chunk; == num_prompt_tokens once decodable).
     num_computed_tokens: int = 0
+    # Memoized (prompt_len, chain_keys) for prefix caching — see
+    # block_allocator.request_chain_keys.
+    prefix_keys_cache: Optional[tuple] = None
     # Total tokens sampled so far, *surviving preemption* (preemption folds
     # output_ids back into prompt_ids; sampling keys use (seed, sampling_step)
     # so the regenerated continuation stays reproducible).

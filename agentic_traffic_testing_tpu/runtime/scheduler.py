@@ -22,7 +22,10 @@ import collections
 from dataclasses import dataclass, field
 from typing import Optional, Union
 
-from agentic_traffic_testing_tpu.runtime.block_allocator import BlockAllocator
+from agentic_traffic_testing_tpu.runtime.block_allocator import (
+    BlockAllocator,
+    request_chain_keys,
+)
 from agentic_traffic_testing_tpu.runtime.request import Request, RequestState
 
 
@@ -177,25 +180,26 @@ class Scheduler:
 
     def _probe_cached(self, req: Request) -> int:
         """Prefix-cache hit size (tokens) admission would get; 0 without a
-        prefix-caching allocator."""
-        probe = getattr(self.allocator, "probe_prefix", None)
-        return probe(req.prompt_ids) if probe else 0
+        prefix-caching allocator. Chain keys are memoized per request, so the
+        per-step re-probe of a waiting head is a dict walk, not a re-hash."""
+        keys = request_chain_keys(self.allocator, req)
+        if keys is None:
+            return 0
+        return self.allocator.probe_prefix(req.prompt_ids, keys)
 
     def _acquire_blocks(self, req: Request, need_tokens: int):
         """All-or-nothing block acquisition, honoring any cached prefix.
 
         Returns (blocks, cached_tokens) or (None, 0) if the pool can't hold
         the request right now."""
-        match = getattr(self.allocator, "match_prefix", None)
-        if match is not None:
-            blocks, cached = match(req.prompt_ids)
+        keys = request_chain_keys(self.allocator, req)
+        if keys is not None:
+            blocks, cached = self.allocator.match_prefix(req.prompt_ids, keys)
         else:
             blocks, cached = self.allocator.new_sequence(), 0
         if not blocks.ensure_capacity(need_tokens):
             blocks.release()
             return None, 0
-        if match is not None:
-            self.allocator.record_prefix_stats(req.num_prompt_tokens, cached)
         return blocks, cached
 
     def _next_chunk(self, req: Request) -> ChunkPrefill:
@@ -280,6 +284,9 @@ class Scheduler:
                 return None  # no KV room: let decode drain / preemption handle it
             head.blocks = blocks
             head.num_computed_tokens = cached
+            record = getattr(self.allocator, "record_prefix_stats", None)
+            if record is not None:  # hit tokens are actually applied here
+                record(head.num_prompt_tokens, cached)
             head.state = RequestState.RUNNING
             self.running.append(self.waiting.popleft())
             return self._next_chunk(head)
@@ -287,8 +294,12 @@ class Scheduler:
         bucket_len = 0
         while self.waiting:
             req = self.waiting[0]
-            if self._needs_chunking(req):
-                break  # solo (chunk-path) admission starts its own plan next step
+            if self._needs_chunking(req) or self._probe_cached(req) > 0:
+                # Solo (chunk-path) admission when it reaches the head: a
+                # batched prefill would REWRITE the shared prefix blocks
+                # (from a different compiled bucket -> bitwise-different bf16
+                # KV under a live sharer). Probe is memoized per request.
+                break
             if len(self.running) + len(batch) >= self.cfg.max_num_seqs:
                 break
             padded = self._padded_prompt_len(req)
@@ -301,7 +312,12 @@ class Scheduler:
             # All-or-nothing KV allocation: prompt + first decode slot +
             # lookahead headroom (keep in sync with can_admit_head).
             need_tokens = req.num_prompt_tokens + 1 + self.cfg.decode_lookahead
-            blocks, _ = self._acquire_blocks(req, need_tokens)
+            blocks, cached = self._acquire_blocks(req, need_tokens)
+            if blocks is not None and cached > 0:
+                # The index changed between probe and match (rare): never
+                # batch-prefill over shared blocks — retry as head next plan.
+                blocks.release()
+                break
             if blocks is None:
                 if not self.running and not batch:
                     # The pool is completely idle and the head still cannot

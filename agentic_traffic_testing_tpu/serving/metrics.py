@@ -86,9 +86,25 @@ class LLMMetrics:
             f"{prefix}_interarrival_seconds",
             "Time between consecutive LLM request arrivals",
             buckets=INTERARRIVAL_BUCKETS, registry=r)
+        # Additive (no reference analog): prefix-cache effectiveness.
+        self.prefix_cache_hit_tokens = Gauge(
+            f"{prefix}_prefix_cache_hit_tokens_total",
+            "Prompt tokens served from the prefix cache (cumulative)",
+            registry=r)
+        self.prefix_cache_query_tokens = Gauge(
+            f"{prefix}_prefix_cache_query_tokens_total",
+            "Prompt tokens offered to the prefix cache (cumulative)",
+            registry=r)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
+
+    def set_prefix_cache_stats(self, stats: dict) -> None:
+        """Refresh cache-effectiveness gauges from engine kv_stats (called on
+        scrape; no-op for the non-prefix-caching allocator)."""
+        if "prefix_cache_hit_tokens" in stats:
+            self.prefix_cache_hit_tokens.set(stats["prefix_cache_hit_tokens"])
+            self.prefix_cache_query_tokens.set(stats["prefix_cache_query_tokens"])
 
     def record_request(self, status: str, latency_s: float, queue_wait_s: float,
                        prompt_tokens: Optional[int],

@@ -202,6 +202,7 @@ class LLMServer:
     async def handle_metrics(self, request: web.Request) -> web.Response:
         if self.metrics is None:
             return web.json_response({"error": "Metrics disabled"}, status=503)
+        self.metrics.set_prefix_cache_stats(self.engine.kv_stats())
         return web.Response(body=self.metrics.render(),
                             headers={"Content-Type": self.metrics.content_type})
 
