@@ -199,18 +199,21 @@ class PrefixCachingAllocator(BlockAllocator):
 
     # -- content addressing -------------------------------------------------
 
-    def chain_keys(self, prompt_ids: list[int]) -> list[int]:
-        """Chained content hashes for every FULL block of this prompt.
+    def chain_keys(self, prompt_ids: list[int]) -> tuple[list[int], list[tuple]]:
+        """(chained content hashes, per-block token tuples) for every FULL
+        block of this prompt.
 
-        O(prompt) hashing — callers memoize per request (see
-        scheduler/engine's use of `request_chain_keys`) so probing the same
-        waiting head every engine step doesn't re-hash its whole prompt."""
-        keys, parent = [], 0
+        O(prompt) hashing + tuple building — callers memoize per request
+        (see `request_chain_keys`) so probing the same waiting head every
+        engine step is dict lookups, not re-hashing or re-slicing."""
+        keys, toks, parent = [], [], 0
         bs = self.block_size
         for i in range(len(prompt_ids) // bs):
-            parent = hash((parent, tuple(prompt_ids[i * bs:(i + 1) * bs])))
+            t = tuple(prompt_ids[i * bs:(i + 1) * bs])
+            parent = hash((parent, t))
             keys.append(parent)
-        return keys
+            toks.append(t)
+        return keys, toks
 
     def _matchable_blocks(self, prompt_ids: list[int]) -> int:
         # Only FULL blocks are addressable, and at least one prompt token
@@ -224,30 +227,31 @@ class PrefixCachingAllocator(BlockAllocator):
         return entry[0]
 
     def probe_prefix(self, prompt_ids: list[int],
-                     keys: Optional[list[int]] = None) -> int:
+                     keys: Optional[tuple[list[int], list[tuple]]] = None) -> int:
         """Cached-token count a match would yield; no state changes."""
         bs = self.block_size
-        keys = keys if keys is not None else self.chain_keys(prompt_ids)
+        ks, toks = keys if keys is not None else self.chain_keys(prompt_ids)
         cached = 0
         for i in range(self._matchable_blocks(prompt_ids)):
-            if self._lookup(keys[i], tuple(prompt_ids[i * bs:(i + 1) * bs])) is None:
+            if self._lookup(ks[i], toks[i]) is None:
                 break
             cached += bs
         return cached
 
     def match_prefix(self, prompt_ids: list[int],
-                     keys: Optional[list[int]] = None) -> tuple["SequenceBlocks", int]:
+                     keys: Optional[tuple[list[int], list[tuple]]] = None,
+                     ) -> tuple["SequenceBlocks", int]:
         """Acquire the longest cached block chain for this prompt.
 
         Returns (sequence holding the shared blocks, cached token count).
         The caller grows the sequence with plain blocks for the suffix and
         MUST release it on failure paths (refcounts are already taken)."""
         bs = self.block_size
-        keys = keys if keys is not None else self.chain_keys(prompt_ids)
+        ks, toks = keys if keys is not None else self.chain_keys(prompt_ids)
         seq = SequenceBlocks(self)
         cached = 0
         for i in range(self._matchable_blocks(prompt_ids)):
-            blk = self._lookup(keys[i], tuple(prompt_ids[i * bs:(i + 1) * bs]))
+            blk = self._lookup(ks[i], toks[i])
             if blk is None:
                 break
             self._refcount[blk] = self._refcount.get(blk, 0) + 1
@@ -271,16 +275,16 @@ class PrefixCachingAllocator(BlockAllocator):
         any later reader's dispatch sees them). First writer wins: keys that
         already map to another block keep their canonical block."""
         bs = self.block_size
-        keys = keys if keys is not None else self.chain_keys(prompt_ids)
+        ks, toks = keys if keys is not None else self.chain_keys(prompt_ids)
         full = len(prompt_ids) // bs
         for i in range(min(full, len(seq.blocks))):
-            key = keys[i]
+            key = ks[i]
             blk = seq.blocks[i]
             if key in self._index:
                 continue
             if blk in self._block_key:  # already indexed under its own key
                 continue
-            self._index[key] = (blk, tuple(prompt_ids[i * bs:(i + 1) * bs]))
+            self._index[key] = (blk, toks[i])
             self._block_key[blk] = key
 
     def kv_extra_stats(self) -> dict:
@@ -291,10 +295,10 @@ class PrefixCachingAllocator(BlockAllocator):
         }
 
 
-def request_chain_keys(allocator, req) -> Optional[list[int]]:
-    """Memoized chain keys for a request's current prompt (invalidated by
-    length change — preemption only ever appends tokens). None when the
-    allocator has no content addressing."""
+def request_chain_keys(allocator, req):
+    """Memoized (chain keys, block token tuples) for a request's current
+    prompt (invalidated by length change — preemption only ever appends
+    tokens). None when the allocator has no content addressing."""
     if not isinstance(allocator, PrefixCachingAllocator):
         return None
     n = req.num_prompt_tokens

@@ -39,7 +39,10 @@ import numpy as np
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig, resolve_config
 from agentic_traffic_testing_tpu.models.llama import init_params
-from agentic_traffic_testing_tpu.runtime.block_allocator import make_block_allocator
+from agentic_traffic_testing_tpu.runtime.block_allocator import (
+    make_block_allocator,
+    request_chain_keys,
+)
 from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK, make_kv_cache
 from agentic_traffic_testing_tpu.runtime.request import (
     FinishReason,
@@ -365,10 +368,6 @@ class LLMEngine:
         blocks — _append_token may have finished+released it already)."""
         register = getattr(self.allocator, "register_computed", None)
         if register is not None and r.blocks is not None:
-            from agentic_traffic_testing_tpu.runtime.block_allocator import (
-                request_chain_keys,
-            )
-
             register(r.blocks, r.prompt_ids,
                      keys=request_chain_keys(self.allocator, r))
 
