@@ -379,12 +379,48 @@ def decode_step_impl(
 ) -> tuple[jax.Array, KVCache]:
     """Returns (next-token logits [B, V] fp32, updated cache).
 
+    The S=1 case of `verify_step_impl` below — one shared layer body keeps
+    plain and speculative decode numerics identical by construction
+    (paged_decode_attention special-cases S=1, so the compiled program keeps
+    the original single-query shapes).
+
     Inactive batch lanes must have block_tables rows = TRASH_BLOCK and
     position 0; their logits are garbage and ignored by the scheduler.
     """
-    b = tokens.shape[0]
-    x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)[:, None, :]  # [B, 1, D]
-    sin, cos = rope_sin_cos(positions[:, None], cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    logits, cache = verify_step_impl(params, cfg, tokens[:, None], cache,
+                                     block_tables, positions,
+                                     attn_mode=attn_mode)
+    return logits[:, 0], cache
+
+
+def verify_step_impl(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [B, S] input tokens: [last accepted, draft 1..S-1]
+    cache: KVCache,           # donated
+    block_tables: jax.Array,  # [B, max_blocks]
+    positions: jax.Array,     # [B] position of tokens[:, 0]
+    attn_mode: Optional[str] = None,
+) -> tuple[jax.Array, KVCache]:
+    """Speculative-verify step: S tokens per sequence in one pass.
+
+    Returns (logits [B, S, V] fp32 — position i scores the token FOLLOWING
+    tokens[:, i] — and the updated cache). The draft-token KV is written at
+    positions+i before attention; rejected drafts leave garbage KV beyond the
+    accepted prefix, which the next decode/verify step overwrites in place
+    (its write range starts exactly at the first rejected slot). The CUDA
+    analog of this capability lives inside vLLM's spec-decode workers for
+    the reference (never in-tree); here it is one more jitted step sharing
+    the decode layer body.
+    """
+    b, s = tokens.shape
+    pos_grid = positions[:, None] + jnp.arange(s, dtype=jnp.int32)[None]  # [B, S]
+    x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)
+    sin, cos = rope_sin_cos(pos_grid, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
+    # Draft positions past the block table's capacity must NOT write (the
+    # table lookup would clamp onto the row's last real block and corrupt
+    # live context for this step's kept tokens) — route them to trash.
+    capacity = block_tables.shape[1] * cache.block_size
 
     def body(carry, xs):
         x, kc, vc = carry
@@ -393,16 +429,20 @@ def decode_step_impl(
         q, k, v = _qkv(xa, lp, cfg)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        # Chained DUS into the full pool: in-place on TPU, where a scatter
-        # would copy the pool per layer (see write_decode_kv_full docstring).
-        kc = kvc.write_decode_kv_full(kc, li, k[:, 0], block_tables, positions)
-        vc = kvc.write_decode_kv_full(vc, li, v[:, 0], block_tables, positions)
+        for i in range(s):  # S is small and static; chained DUS stays in place
+            # Chained DUS into the full pool: in-place on TPU, where a scatter
+            # would copy the pool per layer (see write_decode_kv_full).
+            ok = (positions + i) < capacity
+            kc = kvc.write_decode_kv_full(kc, li, k[:, i], block_tables,
+                                          positions + i, valid=ok)
+            vc = kvc.write_decode_kv_full(vc, li, v[:, i], block_tables,
+                                          positions + i, valid=ok)
         # Paged attention straight off the stacked pool: Pallas kernel on TPU
         # (layer indirection in its DMA index_map), jnp gather oracle on CPU
         # (ops/attention_backend.py picks at trace time).
         attn = paged_decode_attention(q, kc, vc, block_tables, positions,
                                       mode=attn_mode, layer=li)
-        x = x + dense(attn.reshape(b, 1, -1), lp["wo"])
+        x = x + dense(attn.reshape(b, s, -1), lp["wo"])
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         x = x + _mlp_block(xm, lp)
         return (x, kc, vc), None
@@ -412,7 +452,7 @@ def decode_step_impl(
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    return _unembed(x, params, cfg)[:, 0], KVCache(kc, vc)
+    return _unembed(x, params, cfg), KVCache(kc, vc)
 
 
 # Jitted conveniences (tests, simple offline use). The serving engine builds

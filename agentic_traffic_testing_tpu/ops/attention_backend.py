@@ -20,6 +20,7 @@ from __future__ import annotations
 import os
 
 import jax
+import jax.numpy as jnp
 
 from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
 from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
@@ -43,15 +44,19 @@ def backend_choice() -> str:
 
 
 def paged_decode_attention(
-    q,             # [B, 1, H, hd]
+    q,             # [B, S, H, hd] — S=1 decode, S>1 speculative verify
     k_pages,       # [KH, nb, bs, hd] (one layer) or [L, KH, nb, bs, hd] stacked
     v_pages,       # same shape as k_pages
     block_tables,  # [B, max_blocks]
-    positions,     # [B] position of the query token (ctx_len - 1)
+    positions,     # [B] position of query token 0 (ctx_len - 1)
     mode: str | None = None,
     layer=None,    # scalar i32, required when pages are stacked (5D)
 ):
-    """One-token paged attention over the block pool. Returns [B, 1, H, hd].
+    """S-token paged attention over the block pool. Returns [B, S, H, hd].
+
+    S > 1 is the speculative-verify shape: query token s sits at position
+    positions + s and its KV (and its predecessors') is already written in
+    the pool, so token s validly attends to slots < positions + 1 + s.
 
     The decode scan passes the FULL stacked pool + `layer`: the Pallas path
     folds the layer indirection into its DMA index_map (no per-layer slice is
@@ -65,26 +70,30 @@ def paged_decode_attention(
     """
     if k_pages.ndim == 5 and layer is None:
         raise ValueError("stacked (5D) pages require a layer index")
+    s = q.shape[1]
     ctx_lens = positions + 1
     if mode is None:
         mode = backend_choice()
     lay = layer if k_pages.ndim == 5 else None
     if mode == "dma":
-        return paged_attention_decode_dma(
-            q[:, 0], k_pages, v_pages, block_tables, ctx_lens, layer=lay,
-        )[:, None]
+        out = paged_attention_decode_dma(
+            q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
+            ctx_lens, layer=lay,
+        )
+        return out[:, None] if s == 1 else out
     if mode in ("pallas", "interpret"):
         out = paged_attention_decode(
-            q[:, 0], k_pages, v_pages, block_tables, ctx_lens,
-            layer=lay, interpret=(mode == "interpret"),
+            q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
+            ctx_lens, layer=lay, interpret=(mode == "interpret"),
         )
-        return out[:, None]
+        return out[:, None] if s == 1 else out
     if k_pages.ndim == 5:
         k_pages = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
         v_pages = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
     hd = q.shape[-1]  # pool lanes may be padded wider (kv_cache.phys_head_dim)
     k_all = kvc.gather_kv(k_pages, block_tables)[..., :hd]
     v_all = kvc.gather_kv(v_pages, block_tables)[..., :hd]
+    q_positions = positions[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
     return causal_attention(
-        q, k_all, v_all, q_positions=positions[:, None], kv_valid_len=ctx_lens
+        q, k_all, v_all, q_positions=q_positions, kv_valid_len=positions + s
     )

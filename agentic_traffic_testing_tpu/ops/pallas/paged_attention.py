@@ -55,6 +55,8 @@ def _decode_kernel(
     *refs,
     scale: float,
     stacked: bool,
+    q_per_seq: int = 1,
+    queries_per_kv: int = 1,
 ):
     """Kernel body; `refs` layout depends on whether the KV operand is the
     full stacked [L, ...] pool (`stacked`, +1 leading layer-prefetch ref and
@@ -64,6 +66,12 @@ def _decode_kernel(
     ctx_lens_ref [B, 1] (SMEM), q_ref [1,1,qpk,hd], k_ref/v_ref page block,
     o_ref [1,1,qpk,hd], then VMEM scratch m/l/acc (persist across the
     innermost grid dim).
+
+    `q_per_seq` (S) > 1 is the speculative-verify layout: the q tile holds
+    S consecutive query tokens per kv head, row s*queries_per_kv + g being
+    query token s of GQA group member g. ctx_lens stays the context of query
+    token 0; token s additionally sees slots up to ctx + s - 1 (its own KV
+    was written pre-attention by the verify step).
     """
     if stacked:
         (_, ctx_lens_ref, q_ref, k_ref, v_ref, o_ref,
@@ -84,7 +92,7 @@ def _decode_kernel(
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    @pl.when(j * bs < ctx)
+    @pl.when(j * bs < ctx + (q_per_seq - 1))
     def _step():
         q = q_ref[0, 0].astype(jnp.float32) * scale          # [qpk, hd]
         k = k_ref[...].reshape(bs, hd).astype(jnp.float32)   # [bs, hd]
@@ -93,7 +101,8 @@ def _decode_kernel(
             preferred_element_type=jnp.float32,
         )
         pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (qpk, bs), 1)
-        s = jnp.where(pos < ctx, s, _NEG_INF)
+        row_off = jax.lax.broadcasted_iota(jnp.int32, (qpk, bs), 0) // queries_per_kv
+        s = jnp.where(pos < ctx + row_off, s, _NEG_INF)
 
         m_prev = m_ref[:qpk, 0:1]
         m_cur = jnp.max(s, axis=-1, keepdims=True)           # [qpk, 1]
@@ -122,6 +131,8 @@ def _dma_decode_kernel(
     scale: float,
     pages_per_chunk: int,
     stacked: bool,
+    q_per_seq: int = 1,
+    queries_per_kv: int = 1,
 ):
     """Decode kernel v2: one grid program per (sequence, kv-head), pages
     streamed from the HBM pool by explicit double-buffered DMA.
@@ -154,7 +165,9 @@ def _dma_decode_kernel(
     qpk = q_ref.shape[2]
     w = bt_ref.shape[1]
     ctx = cl_ref[b, 0]
-    n_pages = jax.lax.div(ctx + bs - 1, bs)
+    # Verify layout (q_per_seq > 1): query token s also sees its own /
+    # predecessors' freshly written slots up to ctx + s - 1.
+    n_pages = jax.lax.div(ctx + (q_per_seq - 1) + bs - 1, bs)
     n_chunks = jax.lax.div(n_pages + cp - 1, cp)
 
     def page_copy(ci, p, slot, kv_hbm, buf, sem_col):
@@ -196,7 +209,9 @@ def _dma_decode_kernel(
             preferred_element_type=jnp.float32,
         )
         pos = ci * cp * bs + jax.lax.broadcasted_iota(jnp.int32, (qpk, cp * bs), 1)
-        s = jnp.where(pos < ctx, s, _NEG_INF)
+        row_off = (jax.lax.broadcasted_iota(jnp.int32, (qpk, cp * bs), 0)
+                   // queries_per_kv)
+        s = jnp.where(pos < ctx + row_off, s, _NEG_INF)
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
         alpha = jnp.exp(m - m_new)
@@ -219,30 +234,46 @@ def _dma_decode_kernel(
     jax.jit, static_argnames=("scale", "pages_per_chunk", "interpret")
 )
 def paged_attention_decode_dma(
-    q: jax.Array,             # [B, H, hd]
+    q: jax.Array,             # [B, H, hd] or [B, S, H, hd] (verify: S queries/seq)
     k_pages: jax.Array,       # [KH, nb, bs, hd] or [L, KH, nb, bs, hd]
     v_pages: jax.Array,       # same shape as k_pages
     block_tables: jax.Array,  # [B, max_blocks] i32
-    ctx_lens: jax.Array,      # [B] i32
+    ctx_lens: jax.Array,      # [B] i32 — context of query token 0 (positions+1)
     *,
     layer: jax.Array | None = None,
     scale: float | None = None,
     pages_per_chunk: int = 8,
     interpret: bool = False,
 ) -> jax.Array:
-    """Decode paged attention, DMA-pipelined variant (see _dma_decode_kernel)."""
-    b, h, hd = q.shape
+    """Decode paged attention, DMA-pipelined variant (see _dma_decode_kernel).
+
+    4D q is the speculative-verify layout: S consecutive query tokens per
+    sequence, token s at position ctx_lens - 1 + s with its KV already in the
+    pool; returns [B, S, H, hd]."""
+    multi = q.ndim == 4
+    if multi:
+        b, s_q, h, hd = q.shape
+    else:
+        b, h, hd = q.shape
+        s_q = 1
     stacked = k_pages.ndim == 5
     if stacked and layer is None:
         raise ValueError("stacked (5D) pages require a layer index")
     kh, bs, hd_page = k_pages.shape[-4], k_pages.shape[-2], k_pages.shape[-1]
     max_blocks = block_tables.shape[1]
     qpk = h // kh
+    rows = s_q * qpk
     if scale is None:
         scale = 1.0 / math.sqrt(hd)
     cp = min(pages_per_chunk, max_blocks)
 
-    q_r = q.reshape(b, kh, qpk, hd)
+    if multi:
+        # row s*qpk + g = query token s, GQA group member g (matches the
+        # kernel's row_off = row // qpk position offsets).
+        q_r = q.reshape(b, s_q, kh, qpk, hd).transpose(0, 2, 1, 3, 4)
+        q_r = q_r.reshape(b, kh, rows, hd)
+    else:
+        q_r = q.reshape(b, kh, rows, hd)
     if hd_page != hd:
         # Pool lanes are padded (kv_cache.phys_head_dim); zero-pad q so the
         # pad lanes contribute nothing to scores, slice them off the output.
@@ -261,11 +292,11 @@ def paged_attention_decode_dma(
         num_scalar_prefetch=2 + len(prefetch_args),
         grid=(b, kh),
         in_specs=[
-            pl.BlockSpec((1, 1, qpk, hd), q_map),
+            pl.BlockSpec((1, 1, rows, hd), q_map),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
-        out_specs=pl.BlockSpec((1, 1, qpk, hd), q_map),
+        out_specs=pl.BlockSpec((1, 1, rows, hd), q_map),
         scratch_shapes=[
             pltpu.VMEM((2, cp * bs, hd), k_pages.dtype),
             pltpu.VMEM((2, cp * bs, hd), k_pages.dtype),
@@ -276,16 +307,19 @@ def paged_attention_decode_dma(
     out = pl.pallas_call(
         functools.partial(
             _dma_decode_kernel, scale=scale, pages_per_chunk=cp,
-            stacked=stacked,
+            stacked=stacked, q_per_seq=s_q, queries_per_kv=qpk,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kh, qpk, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel"),
         ),
         interpret=interpret,
     )(*prefetch_args, block_tables.astype(jnp.int32),
       ctx_lens.astype(jnp.int32)[:, None], q_r, k_pages, v_pages)
+    if multi:
+        out = out.reshape(b, kh, s_q, qpk, hd).transpose(0, 2, 1, 3, 4)
+        return out.reshape(b, s_q, h, hd)[..., : q.shape[-1]]
     return out.reshape(b, h, hd)[..., : q.shape[-1]]
 
 
@@ -310,16 +344,26 @@ def paged_attention_decode(
     index_map (layer rides scalar prefetch), so the per-layer slice is never
     materialized — the decode scan passes the whole carry straight in.
     """
-    b, h, hd = q.shape
+    multi = q.ndim == 4
+    if multi:
+        b, s_q, h, hd = q.shape
+    else:
+        b, h, hd = q.shape
+        s_q = 1
     stacked = k_pages.ndim == 5
     kh, bs, hd_page = k_pages.shape[-4], k_pages.shape[-2], k_pages.shape[-1]
     max_blocks = block_tables.shape[1]
     qpk = h // kh
+    rows = s_q * qpk
     if scale is None:
         scale = 1.0 / math.sqrt(hd)
-    qpk_pad = max(qpk, _MIN_SUBLANES)
+    rows_pad = max(rows, _MIN_SUBLANES)
 
-    q_r = q.reshape(b, kh, qpk, hd)
+    if multi:
+        q_r = q.reshape(b, s_q, kh, qpk, hd).transpose(0, 2, 1, 3, 4)
+        q_r = q_r.reshape(b, kh, rows, hd)
+    else:
+        q_r = q.reshape(b, kh, rows, hd)
     if hd_page != hd:
         # Pool lanes are padded (kv_cache.phys_head_dim); zero-pad q so the
         # pad lanes contribute nothing to scores, slice them off the output.
@@ -359,26 +403,30 @@ def paged_attention_decode(
         num_scalar_prefetch=num_prefetch,
         grid=(b, kh, max_blocks),
         in_specs=[
-            pl.BlockSpec((1, 1, qpk, hd), q_map),
+            pl.BlockSpec((1, 1, rows, hd), q_map),
             pl.BlockSpec(kv_block, kv_map),
             pl.BlockSpec(kv_block, kv_map),
         ],
-        out_specs=pl.BlockSpec((1, 1, qpk, hd), q_map),
+        out_specs=pl.BlockSpec((1, 1, rows, hd), q_map),
         scratch_shapes=[
-            pltpu.VMEM((qpk_pad, _STAT_LANES), jnp.float32),
-            pltpu.VMEM((qpk_pad, _STAT_LANES), jnp.float32),
-            pltpu.VMEM((qpk_pad, hd), jnp.float32),
+            pltpu.VMEM((rows_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((rows_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((rows_pad, hd), jnp.float32),
         ],
     )
 
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, scale=scale, stacked=stacked),
+        functools.partial(_decode_kernel, scale=scale, stacked=stacked,
+                          q_per_seq=s_q, queries_per_kv=qpk),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kh, qpk, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(*prefetch_args, block_tables.astype(jnp.int32),
       ctx_lens.astype(jnp.int32)[:, None], q_r, k_pages, v_pages)
+    if multi:
+        out = out.reshape(b, kh, s_q, qpk, hd).transpose(0, 2, 1, 3, 4)
+        return out.reshape(b, s_q, h, hd)[..., : q.shape[-1]]
     return out.reshape(b, h, hd)[..., : q.shape[-1]]

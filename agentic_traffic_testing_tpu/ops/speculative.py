@@ -1,0 +1,104 @@
+"""N-gram (prompt-lookup) speculative decoding: device-side helpers.
+
+Agentic traffic is highly self-repetitive — workers quote the task, the
+orchestrator quotes the workers, JSON keys and role contracts recur verbatim
+(reference workload: agents/agent_a/orchestrator.py stages re-feed each
+other's outputs as prompts). Prompt-lookup speculation exploits that without
+any draft model: propose the γ tokens that followed the most recent earlier
+occurrence of the current trailing n-gram, then verify all γ+1 positions in
+one model step (models/llama.py `verify_step_impl`).
+
+Everything here runs INSIDE the fused decode scan on device
+(runtime/runner.py): the token history rides in the scan carry, so
+speculation adds zero host round trips — the decisive constraint on this
+hardware, where a dispatch costs ~3 ms through the tunnel.
+
+Acceptance is sample-and-compare, which is exactly unbiased: position i's
+emitted token is ALWAYS the target-distribution sample at that position; the
+draft only decides whether positions after i can be kept (their context was
+right) or must be discarded (their context was wrong). Output is therefore
+bit-identical with speculation on or off whenever the step math itself is
+(fp32 CPU tests pin this). Under bf16 on TPU the [B, S]-shaped verify step
+can round differently from the [B, 1] decode step (different XLA fusions),
+so near-tied argmaxes may occasionally diverge — the standard numerics
+caveat of every speculative-decoding implementation, not a bias.
+
+The reference gets the equivalent capability (spec-decode workers) from
+inside the vLLM dependency (reference: llm/serve_llm.py:22-34); here it is
+first-party and TPU-shaped.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def propose_ngram(
+    history: jax.Array,    # [B, L] i32 token history (prompt + accepted output)
+    positions: jax.Array,  # [B] index of the last valid token in each row
+    num_drafts: int,       # γ — draft tokens to propose (static)
+    ngram: int,            # n — trailing n-gram length to match (static)
+) -> jax.Array:
+    """Propose `num_drafts` continuation tokens per sequence. Returns [B, γ].
+
+    Finds the LATEST index j < positions where history[j-n+1 .. j] equals the
+    trailing n-gram history[p-n+1 .. p], and proposes history[j+1 .. j+γ]
+    (clamped into known history). No match → the last token repeated, which
+    costs nothing extra: verification still emits ≥ 1 real token per step and
+    the extra positions ride the memory-bound model step for free.
+
+    Vectorized as n shifted equality maps over the whole row — O(B·L·n)
+    vector ops, trivial against a model step.
+    """
+    b, l = history.shape
+    idx = jnp.arange(l, dtype=jnp.int32)
+    match = jnp.ones((b, l), bool)
+    for t in range(ngram):  # static, small
+        suffix_tok = jnp.take_along_axis(
+            history, jnp.maximum(positions - t, 0)[:, None], axis=1)  # [B, 1]
+        eq = history == suffix_tok
+        if t:
+            # candidate end-index j draws this factor from history[j - t]
+            eq = jnp.pad(eq, ((0, 0), (t, 0)))[:, :l]
+        match = match & eq
+    valid = (idx[None] >= ngram - 1) & (idx[None] < positions[:, None])
+    valid = valid & (positions[:, None] >= ngram)  # row long enough at all
+    cand = jnp.where(match & valid, idx[None], -1)
+    best = jnp.max(cand, axis=1)                        # [B]; -1 when no match
+    start = jnp.where(best >= 0, best + 1, positions)
+    offs = start[:, None] + jnp.arange(num_drafts, dtype=jnp.int32)[None]
+    offs = jnp.minimum(offs, positions[:, None])        # only propose known tokens
+    return jnp.take_along_axis(history, offs, axis=1)
+
+
+def accept_counts(sampled: jax.Array, drafts: jax.Array) -> jax.Array:
+    """Emitted-token count per row. sampled [B, S], drafts [B, S-1] → [B] in [1, S].
+
+    Row semantics: sampled[i] is the target sample following input i (input 0
+    is the last accepted token, inputs 1.. are the drafts). The emitted run is
+    sampled[0 .. a] where a is the longest prefix with sampled[i] == drafts[i]
+    — those drafts gave later positions the right context; the first mismatch
+    position is still emitted (its own context was right), everything after it
+    is discarded.
+    """
+    matches = (sampled[:, :-1] == drafts).astype(jnp.int32)
+    acc = jnp.cumprod(matches, axis=1)
+    return 1 + jnp.sum(acc, axis=1)
+
+
+def update_history(
+    history: jax.Array,     # [B, L]
+    new_tokens: jax.Array,  # [B, S] this step's sampled tokens (incl. discarded)
+    positions: jax.Array,   # [B] index of the last PREVIOUSLY accepted token
+) -> jax.Array:
+    """Write the step's samples at history[positions+1 ...]. Discarded-tail
+    slots hold garbage, but they sit at indices > the new last-token index, so
+    proposal never reads them before the next step overwrites them. Near the
+    buffer end the DUS start clamps to L - S (shifting writes onto valid
+    history): that can only degrade proposal quality for a request that is
+    about to hit max_model_len anyway — emitted tokens are never affected.
+    """
+    return jax.vmap(
+        lambda h, t, p: jax.lax.dynamic_update_slice(h, t, (p + 1,))
+    )(history, new_tokens, positions)

@@ -37,11 +37,13 @@ class TPRunner(ModelRunner):
     kv_writer_mode = "dus"
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
-                 decode_steps: int = 1) -> None:
+                 decode_steps: int = 1, spec_tokens: int = 0,
+                 spec_ngram: int = 3) -> None:
         validate_tp(cfg, mesh.shape[AXIS_TP])
         self.mesh = mesh
         params = shard_params(params, cfg, mesh)
-        super().__init__(cfg, params, decode_steps=decode_steps)
+        super().__init__(cfg, params, decode_steps=decode_steps,
+                         spec_tokens=spec_tokens, spec_ngram=spec_ngram)
 
     @property
     def tp_size(self) -> int:

@@ -54,6 +54,7 @@ from agentic_traffic_testing_tpu.runtime.runner import (
     DecodeState,
     ModelRunner,
     SamplingArrays,
+    SpecDecodeState,
 )
 from agentic_traffic_testing_tpu.runtime.scheduler import (
     ChunkPrefill,
@@ -98,6 +99,13 @@ class EngineConfig:
     # None = auto (C++ native/ core if it builds, Python otherwise);
     # True/False force one implementation.
     native_allocator: Optional[bool] = None
+    # Speculative decoding: None (off) or "ngram" (draft-model-free
+    # prompt-lookup speculation — ops/speculative.py). Each fused decode
+    # iteration then verifies spec_tokens drafts + 1 in one model step;
+    # greedy output is bit-identical to non-speculative decode.
+    speculation: Optional[str] = None
+    spec_tokens: int = 3   # γ — drafts verified per step
+    spec_ngram: int = 3    # trailing n-gram length matched against history
 
     def __post_init__(self) -> None:
         # Fail fast: a typo'd scheme must not silently serve full-precision
@@ -106,6 +114,18 @@ class EngineConfig:
         if self.quantization not in (None, "int8"):
             raise ValueError(
                 f"unknown quantization {self.quantization!r}; supported: int8")
+        if self.speculation not in (None, "ngram"):
+            raise ValueError(
+                f"unknown speculation {self.speculation!r}; supported: ngram")
+        if self.speculation and self.spec_tokens < 1:
+            raise ValueError("spec_tokens must be >= 1 when speculation is on")
+
+    @property
+    def effective_spec_tokens(self) -> int:
+        """Drafts per verify step, 0 when speculation is off — the ONE gate
+        every runner-construction site uses (a future mode added to the
+        validator only needs handling here)."""
+        return self.spec_tokens if self.speculation == "ngram" else 0
 
     def resolved_decode_steps(self, platform: str) -> int:
         if self.decode_steps is not None:
@@ -135,13 +155,19 @@ class StepOutput:
 
 
 class _Inflight:
-    """A dispatched decode step whose sampled tokens are still on device."""
+    """A dispatched decode step whose sampled tokens are still on device.
 
-    __slots__ = ("tokens", "requests")
+    `counts` is None for plain decode (every token row is fully emitted);
+    for speculative decode it is the [B, K] per-iteration emitted-token
+    counts matching tokens [B, K, spec_tokens+1]."""
 
-    def __init__(self, tokens: jax.Array, requests: list[Request]) -> None:
+    __slots__ = ("tokens", "requests", "counts")
+
+    def __init__(self, tokens: jax.Array, requests: list[Request],
+                 counts: Optional[jax.Array] = None) -> None:
         self.tokens = tokens
         self.requests = requests
+        self.counts = counts
 
 
 class LLMEngine:
@@ -179,8 +205,11 @@ class LLMEngine:
                     # (memory-critical loads pre-quantize in weights.py /
                     # init_params_quantized instead).
                     params = quantize_params(params)
-            self.runner = ModelRunner(self.model_cfg, params,
-                                      decode_steps=decode_steps)
+            self.runner = ModelRunner(
+                self.model_cfg, params, decode_steps=decode_steps,
+                spec_tokens=cfg.effective_spec_tokens,
+                spec_ngram=cfg.spec_ngram,
+            )
 
         num_blocks = cfg.num_blocks or self._default_num_blocks()
         self.cache = self.runner.prepare_cache(
@@ -189,7 +218,12 @@ class LLMEngine:
         self.allocator = make_block_allocator(num_blocks, cfg.block_size,
                                               native=cfg.native_allocator,
                                               prefix_caching=cfg.prefix_caching)
-        self.scheduler = Scheduler(cfg.scheduler_config(decode_steps), self.allocator)
+        # Per-dispatch KV growth bounds the scheduler's lookahead: every fused
+        # iteration can emit up to spec_tokens+1 tokens (and writes draft KV
+        # that far ahead) when speculation is on.
+        spec = getattr(self.runner, "spec_tokens", 0)
+        self.scheduler = Scheduler(
+            cfg.scheduler_config(decode_steps * (1 + spec)), self.allocator)
         # Fixed block-table width: worst-case blocks for max_model_len.
         self.table_width = -(-cfg.max_model_len // cfg.block_size)
         # Chunked prefill attends over a bucketed prior-page width, not the
@@ -208,6 +242,10 @@ class LLMEngine:
         self._requests: dict[str, Request] = {}  # live (unreported-finish) requests
         # Cumulative counters for metrics
         self.num_steps = 0
+        # Speculation acceptance accounting (live request lanes only):
+        # emitted/iters = mean tokens per verify step in [1, spec_tokens+1].
+        self.spec_iters = 0
+        self.spec_emitted = 0
 
     def _default_num_blocks(self) -> int:
         """Budget KV blocks from device memory, vLLM-profiling style."""
@@ -418,11 +456,26 @@ class LLMEngine:
             steps[i] = r.sampling_step
         self._fill_tables(reqs, tables)
         self._decode_requests = list(reqs)
-        self._decode_state = DecodeState(
-            tokens=jnp.asarray(tokens),
-            positions=jnp.asarray(positions),
-            steps=jnp.asarray(steps),
-        )
+        if getattr(self.runner, "spec_tokens", 0) > 0:
+            # Token history for n-gram proposal rides in the decode state; one
+            # [B, table_tokens] host upload per composition change (~KBs).
+            hist_len = self.table_width * self.cfg.block_size
+            history = np.zeros((b, hist_len), np.int32)
+            for i, r in enumerate(reqs):
+                ids = r.prompt_ids + r.output_ids
+                history[i, : len(ids)] = ids
+            self._decode_state = SpecDecodeState(
+                tokens=jnp.asarray(tokens),
+                positions=jnp.asarray(positions),
+                steps=jnp.asarray(steps),
+                history=jnp.asarray(history),
+            )
+        else:
+            self._decode_state = DecodeState(
+                tokens=jnp.asarray(tokens),
+                positions=jnp.asarray(positions),
+                steps=jnp.asarray(steps),
+            )
         self._decode_tables = jnp.asarray(tables)
         self._decode_samp = self._sampling_arrays(reqs, b)
         self._decode_block_counts = [r.blocks.num_blocks for r in reqs]
@@ -466,14 +519,21 @@ class LLMEngine:
         self._plan_and_dispatch()
 
     def _do_decode_dispatch(self) -> None:
-        self._decode_state, self.cache, out = self.runner.decode(
+        result = self.runner.decode(
             self.cache, self._decode_tables, self._decode_state, self._decode_samp
         )
-        try:
-            out.copy_to_host_async()
-        except Exception:
-            pass
-        self._inflight.append(_Inflight(out, list(self._decode_requests)))
+        counts = None
+        if getattr(self.runner, "spec_tokens", 0) > 0:
+            self._decode_state, self.cache, out, counts = result
+        else:
+            self._decode_state, self.cache, out = result
+        for arr in (out,) if counts is None else (out, counts):
+            try:
+                arr.copy_to_host_async()
+            except Exception:
+                pass
+        self._inflight.append(
+            _Inflight(out, list(self._decode_requests), counts))
 
     def _sampling_arrays(self, reqs: list[Request], padded: int) -> SamplingArrays:
         temp = np.zeros((padded,), np.float32)
@@ -506,17 +566,36 @@ class LLMEngine:
         return any(r.is_finished() for r in inf.requests)
 
     def _apply_inflight(self, inf: _Inflight) -> None:
-        toks = np.asarray(jax.device_get(inf.tokens))  # [B, decode_steps]
+        # Plain decode: tokens [B, K], every entry emitted. Speculative:
+        # tokens [B, K, spec+1] with counts [B, K] — only the first
+        # counts[b, k] entries of iteration k were accepted on device.
+        toks = np.asarray(jax.device_get(inf.tokens))
+        counts = (None if inf.counts is None
+                  else np.asarray(jax.device_get(inf.counts)))
         now = time.monotonic()
         for i, r in enumerate(inf.requests):
             if r.is_finished() or r.state is not RequestState.RUNNING:
                 continue  # stopped at an earlier lagged step, or preempted
             if r.first_token_time is None:
                 r.first_token_time = now
-            for tok in toks[i]:
-                self._append_token(r, int(tok))
-                if r.is_finished():
-                    break  # device tokens past the stop point are dropped
+            if counts is None:
+                for tok in toks[i]:
+                    self._append_token(r, int(tok))
+                    if r.is_finished():
+                        break  # device tokens past the stop point are dropped
+            else:
+                # Acceptance gauges count only consumed iterations and kept
+                # tokens — post-stop garbage rows would otherwise dominate
+                # the ratio for short completions at large decode_steps.
+                for k in range(toks.shape[1]):
+                    if r.is_finished():
+                        break
+                    self.spec_iters += 1
+                    for tok in toks[i, k, : counts[i, k]]:
+                        self._append_token(r, int(tok))
+                        self.spec_emitted += 1
+                        if r.is_finished():
+                            break
 
     def _append_token(self, r: Request, tok: int) -> None:
         r.output_ids.append(tok)

@@ -167,16 +167,28 @@ def write_decode_kv_full(
     new: jax.Array,           # [B, KH, hd]
     block_tables: jax.Array,  # [B, max_blocks]
     positions: jax.Array,     # [B] absolute position being written
+    valid=None,               # [B] bool — False routes the write to the trash block
 ) -> jax.Array:
     """One-token-per-sequence write into the FULL stacked pool via chained
     `dynamic_update_slice` (see `write_prompt_kv_full` for why not scatter).
     Trash lanes (block table row = TRASH_BLOCK) land in the trash block.
+
+    `valid=False` lanes also land in the trash block. Speculative verify
+    passes `positions + i < table capacity` here: an over-capacity position's
+    table lookup would CLAMP to the row's last real block and overwrite live
+    KV that the same step's attention still reads for kept tokens — routing
+    to trash keeps every kept token's context intact. (Plain decode's only
+    over-capacity writes come from overrun iterations whose tokens are all
+    dropped host-side, so its clamp was harmless; it gains the same masking
+    for free via the shared layer body.)
     """
     _, kh, _, bs, _ = cache.shape
     b, _, hd = new.shape  # logical head dim; pool lanes may be padded wider
     zero = jnp.int32(0)
     for i in range(b):
-        blk = block_tables[i, positions[i] // bs]  # OOB positions clamp -> trash/own tail
+        blk = block_tables[i, positions[i] // bs]  # OOB positions clamp; see above
+        if valid is not None:
+            blk = jnp.where(valid[i], blk, TRASH_BLOCK)
         upd = new[i].reshape(1, kh, 1, 1, hd)
         cache = jax.lax.dynamic_update_slice(
             cache, upd, (layer, zero, blk, positions[i] % bs, zero)

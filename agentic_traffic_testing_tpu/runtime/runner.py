@@ -28,8 +28,14 @@ from agentic_traffic_testing_tpu.models.llama import (
     decode_step_impl,
     prefill_chunk_impl,
     prefill_impl,
+    verify_step_impl,
 )
 from agentic_traffic_testing_tpu.ops.sampling import make_row_keys, sample
+from agentic_traffic_testing_tpu.ops.speculative import (
+    accept_counts,
+    propose_ngram,
+    update_history,
+)
 from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
 
 
@@ -48,6 +54,20 @@ class DecodeState(NamedTuple):
     tokens: jax.Array     # [B] i32 — input token for the next step
     positions: jax.Array  # [B] i32 — position of `tokens`
     steps: jax.Array      # [B] i32 — per-request sampling step (PRNG stream)
+
+
+class SpecDecodeState(NamedTuple):
+    """DecodeState + the token history n-gram speculation proposes from.
+
+    `history[b, :positions[b]+1]` is the sequence so far (prompt + accepted
+    output); it advances on device with the accepted samples each step, so
+    proposal/verify/accept all stay inside the fused scan.
+    """
+
+    tokens: jax.Array     # [B] i32 — last accepted token
+    positions: jax.Array  # [B] i32 — its position
+    steps: jax.Array      # [B] i32 — per-request sampling step (PRNG stream)
+    history: jax.Array    # [B, L] i32 — token history buffer
 
 
 def _prefill_sample_impl(params, cfg: ModelConfig, tokens, cache, block_tables,
@@ -101,13 +121,68 @@ def _decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
     return state, cache, toks.T  # [B, num_steps]
 
 
+def _spec_decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
+                             state: SpecDecodeState, samp: SamplingArrays,
+                             num_steps: int = 1, spec_tokens: int = 3,
+                             ngram: int = 3, attn_mode=None):
+    """`num_steps` fused n-gram-speculative steps in ONE dispatch.
+
+    Each scan iteration: propose γ=spec_tokens drafts from the device-resident
+    history (ops/speculative.py), verify all γ+1 positions in one model step
+    (verify_step_impl), sample every position with its own (seed, step) PRNG
+    key, keep the longest draft-consistent prefix. Emits per iteration the
+    full sample row [B, γ+1] plus the per-lane emitted count m ∈ [1, γ+1];
+    the host drops the discarded tail at harvest exactly like it drops
+    post-stop tokens. Returns (state, cache, tokens [B, K, γ+1], counts [B, K]).
+
+    Sampling-step keys advance by m per lane, so emitted token t of a request
+    uses the same key as non-speculative decode would — output is identical
+    with speculation on or off, up to step-shape numerics (bit-exact in fp32;
+    see ops/speculative.py on the bf16 caveat).
+    """
+    s = spec_tokens + 1
+    # Flattened per-(lane, position) sampling params; row order matches
+    # logits.reshape(B*S, V): row = lane*S + position.
+    temp_f = jnp.repeat(samp.temperature, s)
+    topk_f = jnp.repeat(samp.top_k, s)
+    topp_f = jnp.repeat(samp.top_p, s)
+    seeds_f = jnp.repeat(samp.seeds, s)
+    offs = jnp.arange(s, dtype=jnp.int32)
+
+    def body(carry, _):
+        st, cache = carry
+        drafts = propose_ngram(st.history, st.positions, spec_tokens, ngram)
+        inputs = jnp.concatenate([st.tokens[:, None], drafts], axis=1)  # [B, S]
+        logits, cache = verify_step_impl(params, cfg, inputs, cache,
+                                         block_tables, st.positions,
+                                         attn_mode=attn_mode)
+        b = inputs.shape[0]
+        steps_f = (st.steps[:, None] + offs[None]).reshape(-1)
+        keys = make_row_keys(seeds_f, steps_f)
+        toks = sample(logits.reshape(b * s, -1), keys,
+                      temp_f, topk_f, topp_f).reshape(b, s)
+        m = accept_counts(toks, drafts)                                 # [B]
+        last = jnp.take_along_axis(toks, (m - 1)[:, None], axis=1)[:, 0]
+        hist = update_history(st.history, toks, st.positions)
+        new_st = SpecDecodeState(tokens=last, positions=st.positions + m,
+                                 steps=st.steps + m, history=hist)
+        return (new_st, cache), (toks, m)
+
+    (state, cache), (toks, counts) = jax.lax.scan(
+        body, (state, cache), None, length=num_steps)
+    return state, cache, toks.transpose(1, 0, 2), counts.T  # [B,K,S], [B,K]
+
+
 class ModelRunner:
     """Single-device runner. Owns the jitted step programs (not the cache)."""
 
-    def __init__(self, cfg: ModelConfig, params, decode_steps: int = 1) -> None:
+    def __init__(self, cfg: ModelConfig, params, decode_steps: int = 1,
+                 spec_tokens: int = 0, spec_ngram: int = 3) -> None:
         self.cfg = cfg
         self.params = params
         self.decode_steps = max(1, int(decode_steps))
+        self.spec_tokens = max(0, int(spec_tokens))
+        self.spec_ngram = max(1, int(spec_ngram))
         self._prefill = jax.jit(
             partial(_prefill_sample_impl, cfg=cfg,
                     kv_writer_mode=self.kv_writer_mode),
@@ -118,11 +193,20 @@ class ModelRunner:
                     kv_writer_mode=self.kv_writer_mode),
             donate_argnames=("cache",),
         )
-        self._decode = jax.jit(
-            partial(_decode_sample_impl, cfg=cfg, num_steps=self.decode_steps,
-                    attn_mode=self.attn_mode),
-            donate_argnames=("cache",),
-        )
+        if self.spec_tokens > 0:
+            self._decode = jax.jit(
+                partial(_spec_decode_sample_impl, cfg=cfg,
+                        num_steps=self.decode_steps,
+                        spec_tokens=self.spec_tokens, ngram=self.spec_ngram,
+                        attn_mode=self.attn_mode),
+                donate_argnames=("cache",),
+            )
+        else:
+            self._decode = jax.jit(
+                partial(_decode_sample_impl, cfg=cfg, num_steps=self.decode_steps,
+                        attn_mode=self.attn_mode),
+                donate_argnames=("cache",),
+            )
 
     #: chips the KV cache is sharded across (overridden by parallel/tp_runner.py)
     tp_size: int = 1
@@ -152,9 +236,13 @@ class ModelRunner:
         )
 
     def decode(self, cache, block_tables, state, samp):
-        """-> (DecodeState, cache, sampled_tokens [B, decode_steps]).
+        """One fused dispatch covering `decode_steps` model steps.
 
-        One fused dispatch covering `decode_steps` model steps."""
+        Non-speculative (spec_tokens == 0): state is a DecodeState; returns
+        (DecodeState, cache, tokens [B, decode_steps]).
+        Speculative: state is a SpecDecodeState; returns (SpecDecodeState,
+        cache, tokens [B, decode_steps, spec_tokens+1], counts
+        [B, decode_steps]) — the engine keeps counts[b, k] tokens of row k."""
         return self._decode(self.params, cache=cache, block_tables=block_tables,
                             state=state, samp=samp)
 

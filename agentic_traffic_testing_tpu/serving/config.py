@@ -50,6 +50,9 @@ class ServerConfig:
     num_blocks: Optional[int] = None           # LLM_NUM_BLOCKS (None -> HBM profile)
     block_size: int = 16                       # LLM_BLOCK_SIZE
     weights_path: Optional[str] = None         # LLM_WEIGHTS_PATH (local safetensors dir)
+    speculation: Optional[str] = None          # LLM_SPECULATION ("ngram" | unset)
+    spec_tokens: int = 3                       # LLM_SPEC_TOKENS (drafts/step)
+    spec_ngram: int = 3                        # LLM_SPEC_NGRAM (match length)
 
     @classmethod
     def from_env(cls) -> "ServerConfig":
@@ -87,6 +90,9 @@ class ServerConfig:
         c.num_blocks = int(nb) if nb else None
         c.block_size = int(os.environ.get("LLM_BLOCK_SIZE") or c.block_size)
         c.weights_path = os.environ.get("LLM_WEIGHTS_PATH") or None
+        c.speculation = os.environ.get("LLM_SPECULATION") or None
+        c.spec_tokens = int(os.environ.get("LLM_SPEC_TOKENS") or c.spec_tokens)
+        c.spec_ngram = int(os.environ.get("LLM_SPEC_NGRAM") or c.spec_ngram)
         return c
 
     @classmethod
@@ -117,11 +123,16 @@ class ServerConfig:
         p.add_argument("--num-blocks", type=int, default=c.num_blocks)
         p.add_argument("--block-size", type=int, default=c.block_size)
         p.add_argument("--weights-path", default=c.weights_path)
+        p.add_argument("--speculation", default=c.speculation,
+                       help="'ngram' enables prompt-lookup speculative decoding")
+        p.add_argument("--spec-tokens", type=int, default=c.spec_tokens)
+        p.add_argument("--spec-ngram", type=int, default=c.spec_ngram)
         a = p.parse_args(argv)
         for f in ("model", "dtype", "max_num_seqs", "max_num_batched_tokens",
                   "memory_utilization", "max_tokens", "max_model_len",
                   "temperature", "host", "port", "tp_size", "quantization",
                   "decode_steps", "prefill_chunk_tokens", "prefix_caching",
-                  "num_blocks", "block_size", "weights_path"):
+                  "num_blocks", "block_size", "weights_path",
+                  "speculation", "spec_tokens", "spec_ngram"):
             setattr(c, f, getattr(a, f))
         return c

@@ -95,6 +95,16 @@ class LLMMetrics:
             f"{prefix}_prefix_cache_query_tokens_total",
             "Prompt tokens offered to the prefix cache (cumulative)",
             registry=r)
+        # Additive (no reference analog): speculative-decoding acceptance.
+        # emitted/iters = mean tokens kept per verify step, in [1, spec+1].
+        self.spec_emitted_tokens = Gauge(
+            f"{prefix}_spec_emitted_tokens_total",
+            "Tokens emitted by speculative verify steps (cumulative)",
+            registry=r)
+        self.spec_verify_iters = Gauge(
+            f"{prefix}_spec_verify_iters_total",
+            "Speculative verify iterations run (cumulative, live lanes)",
+            registry=r)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -105,6 +115,12 @@ class LLMMetrics:
         if "prefix_cache_hit_tokens" in stats:
             self.prefix_cache_hit_tokens.set(stats["prefix_cache_hit_tokens"])
             self.prefix_cache_query_tokens.set(stats["prefix_cache_query_tokens"])
+
+    def set_spec_stats(self, *, emitted: int, iters: int) -> None:
+        """Refresh speculation-acceptance gauges (called on scrape; zeros
+        until a speculative engine has decoded something)."""
+        self.spec_emitted_tokens.set(emitted)
+        self.spec_verify_iters.set(iters)
 
     def record_request(self, status: str, latency_s: float, queue_wait_s: float,
                        prompt_tokens: Optional[int],

@@ -104,6 +104,8 @@ class LLMServer:
             decode_steps=c.decode_steps, quantization=c.quantization,
             prefill_chunk_tokens=c.prefill_chunk_tokens,
             prefix_caching=c.prefix_caching,
+            speculation=c.speculation, spec_tokens=c.spec_tokens,
+            spec_ngram=c.spec_ngram,
         )
         runner = None
         params = None
@@ -129,6 +131,8 @@ class LLMServer:
             runner = TPRunner(
                 model_cfg, params, single_axis_mesh("tp", c.tp_size),
                 decode_steps=ecfg.resolved_decode_steps(jax.devices()[0].platform),
+                spec_tokens=ecfg.effective_spec_tokens,
+                spec_ngram=ecfg.spec_ngram,
             )
             return LLMEngine(ecfg, model_cfg=model_cfg, runner=runner)
         if c.weights_path:
@@ -203,6 +207,8 @@ class LLMServer:
         if self.metrics is None:
             return web.json_response({"error": "Metrics disabled"}, status=503)
         self.metrics.set_prefix_cache_stats(self.engine.kv_stats())
+        self.metrics.set_spec_stats(emitted=self.engine.spec_emitted,
+                                    iters=self.engine.spec_iters)
         return web.Response(body=self.metrics.render(),
                             headers={"Content-Type": self.metrics.content_type})
 

@@ -89,6 +89,8 @@ class Stack:
             env["LLM_QUANTIZATION"] = a.quantization
         if a.prefix_caching:
             env["LLM_PREFIX_CACHING"] = "1"
+        if a.speculation:
+            env["LLM_SPECULATION"] = a.speculation
         self.spawn("agentic_traffic_testing_tpu.serving", env, "llm")
         self.wait_healthy(f"http://127.0.0.1:{BASE_LLM}/health",
                           a.llm_start_timeout, "llm-backend")
@@ -241,6 +243,7 @@ def to_markdown(rows: list[dict], args) -> str:
         "## " + (f"{args.model}"
                  + (f" ({args.quantization})" if args.quantization else " (bf16)")
                  + (" + prefix caching" if args.prefix_caching else "")
+                 + (f" + {args.speculation} speculation" if args.speculation else "")
                  + " — single TPU v5e chip"),
         "",
         "| scenario | key metrics |",
@@ -258,6 +261,8 @@ def main() -> None:
     ap.add_argument("--dtype", default="bfloat16")
     ap.add_argument("--quantization", default="")
     ap.add_argument("--prefix-caching", action="store_true")
+    ap.add_argument("--speculation", default="",
+                    help="'ngram' serves with prompt-lookup speculative decoding")
     ap.add_argument("--max-model-len", type=int, default=2048)
     ap.add_argument("--max-tokens", type=int, default=128)
     ap.add_argument("--agent-max-tokens", type=int, default=128)
