@@ -376,6 +376,8 @@ def decode_step_impl(
     block_tables: jax.Array,  # [B, max_blocks]
     positions: jax.Array,     # [B] position of `tokens` (== context_len so far)
     attn_mode: Optional[str] = None,  # static; see ops/attention_backend.py
+    attn_mesh=None,           # static Mesh + axis for attn_mode="shard_dma"
+    attn_axis: Optional[str] = None,
 ) -> tuple[jax.Array, KVCache]:
     """Returns (next-token logits [B, V] fp32, updated cache).
 
@@ -389,7 +391,8 @@ def decode_step_impl(
     """
     logits, cache = verify_step_impl(params, cfg, tokens[:, None], cache,
                                      block_tables, positions,
-                                     attn_mode=attn_mode)
+                                     attn_mode=attn_mode, attn_mesh=attn_mesh,
+                                     attn_axis=attn_axis)
     return logits[:, 0], cache
 
 
@@ -401,6 +404,8 @@ def verify_step_impl(
     block_tables: jax.Array,  # [B, max_blocks]
     positions: jax.Array,     # [B] position of tokens[:, 0]
     attn_mode: Optional[str] = None,
+    attn_mesh=None,           # static Mesh + axis for attn_mode="shard_dma"
+    attn_axis: Optional[str] = None,
 ) -> tuple[jax.Array, KVCache]:
     """Speculative-verify step: S tokens per sequence in one pass.
 
@@ -441,7 +446,8 @@ def verify_step_impl(
         # (layer indirection in its DMA index_map), jnp gather oracle on CPU
         # (ops/attention_backend.py picks at trace time).
         attn = paged_decode_attention(q, kc, vc, block_tables, positions,
-                                      mode=attn_mode, layer=li)
+                                      mode=attn_mode, layer=li,
+                                      mesh=attn_mesh, axis=attn_axis)
         x = x + dense(attn.reshape(b, s, -1), lp["wo"])
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         x = x + _mlp_block(xm, lp)

@@ -12,7 +12,13 @@ backend). Override with ATT_TPU_ATTENTION:
     interpret v1 kernel in interpreter mode (CPU correctness tests; the dma
               kernel's interpret path is exercised directly in
               tests/test_pallas_paged_attention.py)
-    gather    jnp gather reference path (forced by the GSPMD TP runner)
+    gather    jnp gather reference path (the GSPMD TP runner's CPU fallback)
+
+A sixth mode, "shard_dma" (the dma kernel wrapped in jax.shard_map over the
+TP axis, each chip running on its local KV-head shard of the page pool), is
+caller-only: it needs a mesh + axis, so it cannot be selected through
+ATT_TPU_ATTENTION — the TP runner picks it explicitly (ATT_TP_ATTENTION
+overrides there).
 """
 
 from __future__ import annotations
@@ -30,14 +36,17 @@ from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
 from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
 
 
-VALID_MODES = ("auto", "dma", "pallas", "interpret", "gather")
+VALID_MODES = ("auto", "dma", "pallas", "interpret", "gather", "shard_dma")
 
 
 def backend_choice() -> str:
     mode = os.environ.get("ATT_TPU_ATTENTION", "auto")
-    if mode not in VALID_MODES:
+    # shard_dma is caller-only (needs mesh + axis, which the env path cannot
+    # supply) — rejecting it here fails at startup instead of at trace time.
+    if mode not in VALID_MODES or mode == "shard_dma":
         raise ValueError(
-            f"ATT_TPU_ATTENTION={mode!r} invalid; choose one of {VALID_MODES}")
+            f"ATT_TPU_ATTENTION={mode!r} invalid; choose one of "
+            f"{tuple(m for m in VALID_MODES if m != 'shard_dma')}")
     if mode == "auto":
         return "dma" if jax.default_backend() == "tpu" else "gather"
     return mode
@@ -51,6 +60,8 @@ def paged_decode_attention(
     positions,     # [B] position of query token 0 (ctx_len - 1)
     mode: str | None = None,
     layer=None,    # scalar i32, required when pages are stacked (5D)
+    mesh=None,     # jax Mesh, required for mode="shard_dma"
+    axis=None,     # mesh axis name the heads/pool are sharded on (e.g. "tp")
 ):
     """S-token paged attention over the block pool. Returns [B, S, H, hd].
 
@@ -63,10 +74,12 @@ def paged_decode_attention(
     ever materialized); the gather path slices the layer first — that copy is
     cheap on CPU and keeps the KH-sharded gather well-partitioned under TP.
 
-    `mode` overrides the env/platform choice. The GSPMD tensor-parallel
-    runner passes "gather": a pallas_call has no SPMD partitioning rule, so
-    under a tp>1 mesh XLA would replicate (all-gather) the head-sharded page
-    pool onto every chip. A shard_map-wrapped kernel path can lift this later.
+    `mode` overrides the env/platform choice. A pallas_call has no SPMD
+    partitioning rule, so under a tp>1 mesh plain GSPMD would replicate
+    (all-gather) the head-sharded page pool onto every chip; the TP runner
+    therefore passes mode="shard_dma" (+ mesh/axis) on TPU — the dma kernel
+    under jax.shard_map, per-chip on its local KV-head shard — and "gather"
+    off-TPU, where the jnp path keeps virtual-mesh tests fast.
     """
     if k_pages.ndim == 5 and layer is None:
         raise ValueError("stacked (5D) pages require a layer index")
@@ -75,6 +88,9 @@ def paged_decode_attention(
     if mode is None:
         mode = backend_choice()
     lay = layer if k_pages.ndim == 5 else None
+    if mode == "shard_dma":
+        return _shard_dma_attention(q, k_pages, v_pages, block_tables,
+                                    ctx_lens, lay, mesh, axis)
     if mode == "dma":
         out = paged_attention_decode_dma(
             q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
@@ -97,3 +113,47 @@ def paged_decode_attention(
     return causal_attention(
         q, k_all, v_all, q_positions=q_positions, kv_valid_len=positions + s
     )
+
+
+def _shard_dma_attention(q, k_pages, v_pages, block_tables, ctx_lens, layer,
+                         mesh, axis):
+    """The DMA kernel under `jax.shard_map` over the head-sharding mesh axis.
+
+    A pallas_call has no SPMD partitioning rule, so under plain GSPMD the TP
+    runner had to fall back to the jnp gather path (which reads the full
+    bucketed table width per layer). shard_map instead hands each chip its
+    local KV-head shard of the page pool and q, and the kernel runs
+    unchanged with grid (B, KH/tp) — no collective is needed inside: the
+    attention output is head-local, and the all-reduce happens where it
+    always did, in the row-parallel `wo` matmul outside this call.
+
+    Tables/ctx_lens/layer are replicated; the pool's block-id space is the
+    (unsharded) nb axis, so global block ids stay valid on every shard.
+    Interpret mode engages automatically off-TPU so the same path is
+    CPU-testable on a virtual mesh (SURVEY.md §4).
+    """
+    if mesh is None or axis is None:
+        raise ValueError("mode='shard_dma' requires mesh and axis")
+    if layer is None:
+        raise ValueError("shard_dma expects the stacked (5D) page pool")
+    s = q.shape[1]
+    interpret = jax.default_backend() != "tpu"
+    from jax.sharding import PartitionSpec as P
+
+    qspec = P(None, None, axis, None)
+    kvspec = P(None, axis, None, None, None)
+
+    def local(q_l, k_l, v_l, bt, cl, lay):
+        out = paged_attention_decode_dma(
+            q_l[:, 0] if s == 1 else q_l, k_l, v_l, bt, cl,
+            layer=lay, interpret=interpret,
+        )
+        return out[:, None] if s == 1 else out
+
+    return jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(qspec, kvspec, kvspec, P(None, None), P(None), P()),
+        out_specs=qspec,
+        check_vma=False,
+    )(q, k_pages, v_pages, block_tables, ctx_lens,
+      jnp.asarray(layer, jnp.int32))

@@ -12,6 +12,8 @@ replicated: they are tiny, and every chip runs the identical program.
 
 from __future__ import annotations
 
+import os
+
 import jax
 from jax.sharding import Mesh
 
@@ -29,11 +31,13 @@ from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
 class TPRunner(ModelRunner):
     """Runner whose params/cache live sharded on a `tp` mesh axis."""
 
-    # pallas_call has no SPMD partitioning rule: under GSPMD it would force an
-    # all-gather of the head-sharded page pool. Use the jnp gather path and
-    # the DUS page writer, which the partitioner shards cleanly
-    # (kernel-under-shard_map is future work).
-    attn_mode = "gather"
+    # A pallas_call has no SPMD partitioning rule, so decode attention cannot
+    # ride plain GSPMD. On TPU the DMA kernel runs under jax.shard_map with
+    # each chip holding its KV-head shard of the page pool ("shard_dma");
+    # off-TPU the jnp gather path keeps CPU-mesh tests fast (shard_dma there
+    # interprets the kernel — correct but slow; ATT_TP_ATTENTION overrides
+    # for targeted tests). Page writes stay on the DUS writer, which the
+    # partitioner shards cleanly.
     kv_writer_mode = "dus"
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
@@ -41,6 +45,16 @@ class TPRunner(ModelRunner):
                  spec_ngram: int = 3) -> None:
         validate_tp(cfg, mesh.shape[AXIS_TP])
         self.mesh = mesh
+        mode = os.environ.get("ATT_TP_ATTENTION")
+        if mode is None:
+            mode = "shard_dma" if jax.default_backend() == "tpu" else "gather"
+        if mode not in ("shard_dma", "gather"):
+            raise ValueError(
+                f"ATT_TP_ATTENTION={mode!r} invalid; choose shard_dma|gather")
+        self.attn_mode = mode
+        if mode == "shard_dma":
+            self.attn_mesh = mesh
+            self.attn_axis = AXIS_TP
         params = shard_params(params, cfg, mesh)
         super().__init__(cfg, params, decode_steps=decode_steps,
                          spec_tokens=spec_tokens, spec_ngram=spec_ngram)

@@ -97,7 +97,8 @@ def _prefill_chunk_sample_impl(params, cfg: ModelConfig, tokens, cache,
 
 def _decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
                         state: DecodeState, samp: SamplingArrays,
-                        num_steps: int = 1, attn_mode=None):
+                        num_steps: int = 1, attn_mode=None, attn_mesh=None,
+                        attn_axis=None):
     """`num_steps` fused decode steps in ONE dispatch (lax.scan on device).
 
     The sampled token feeds the next step without leaving the device, so the
@@ -111,7 +112,9 @@ def _decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
         st, cache = carry
         logits, cache = decode_step_impl(params, cfg, st.tokens, cache,
                                          block_tables, st.positions,
-                                         attn_mode=attn_mode)
+                                         attn_mode=attn_mode,
+                                         attn_mesh=attn_mesh,
+                                         attn_axis=attn_axis)
         keys = make_row_keys(samp.seeds, st.steps)
         out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
         new_st = DecodeState(tokens=out, positions=st.positions + 1, steps=st.steps + 1)
@@ -124,7 +127,8 @@ def _decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
 def _spec_decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
                              state: SpecDecodeState, samp: SamplingArrays,
                              num_steps: int = 1, spec_tokens: int = 3,
-                             ngram: int = 3, attn_mode=None):
+                             ngram: int = 3, attn_mode=None, attn_mesh=None,
+                             attn_axis=None):
     """`num_steps` fused n-gram-speculative steps in ONE dispatch.
 
     Each scan iteration: propose γ=spec_tokens drafts from the device-resident
@@ -155,7 +159,9 @@ def _spec_decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
         inputs = jnp.concatenate([st.tokens[:, None], drafts], axis=1)  # [B, S]
         logits, cache = verify_step_impl(params, cfg, inputs, cache,
                                          block_tables, st.positions,
-                                         attn_mode=attn_mode)
+                                         attn_mode=attn_mode,
+                                         attn_mesh=attn_mesh,
+                                         attn_axis=attn_axis)
         b = inputs.shape[0]
         steps_f = (st.steps[:, None] + offs[None]).reshape(-1)
         keys = make_row_keys(seeds_f, steps_f)
@@ -198,21 +204,27 @@ class ModelRunner:
                 partial(_spec_decode_sample_impl, cfg=cfg,
                         num_steps=self.decode_steps,
                         spec_tokens=self.spec_tokens, ngram=self.spec_ngram,
-                        attn_mode=self.attn_mode),
+                        attn_mode=self.attn_mode, attn_mesh=self.attn_mesh,
+                        attn_axis=self.attn_axis),
                 donate_argnames=("cache",),
             )
         else:
             self._decode = jax.jit(
                 partial(_decode_sample_impl, cfg=cfg, num_steps=self.decode_steps,
-                        attn_mode=self.attn_mode),
+                        attn_mode=self.attn_mode, attn_mesh=self.attn_mesh,
+                        attn_axis=self.attn_axis),
                 donate_argnames=("cache",),
             )
 
     #: chips the KV cache is sharded across (overridden by parallel/tp_runner.py)
     tp_size: int = 1
     #: decode-attention implementation baked into the jit (None = auto;
-    #: the TP runner forces "gather" — see ops/attention_backend.py)
+    #: the TP runner picks "shard_dma" on TPU / "gather" elsewhere —
+    #: see ops/attention_backend.py)
     attn_mode: Optional[str] = None
+    #: mesh + head-sharding axis for attn_mode="shard_dma" (TP runner sets)
+    attn_mesh = None
+    attn_axis: Optional[str] = None
     #: prompt-page KV writer baked into the prefill jit (None = auto;
     #: the TP runner forces "dus" — see ops/kv_writer.py)
     kv_writer_mode: Optional[str] = None

@@ -73,6 +73,43 @@ def test_tp_engine_matches_single_device(tiny_cfg, tiny_params):
     assert ref.output_ids == tp.output_ids
 
 
+def test_tp_shard_dma_matches_gather(tiny_cfg, tiny_params, monkeypatch):
+    """The shard_map-wrapped DMA kernel (TPU default for TP; interpret mode
+    here on the CPU mesh) must reproduce the GSPMD gather path's greedy
+    decode exactly."""
+    ecfg = EngineConfig(model="tiny", dtype="float32", num_blocks=64,
+                        max_model_len=128)
+    prompt = list(range(7, 27))
+    samp = SamplingParams(temperature=0.0, max_tokens=6)
+
+    monkeypatch.delenv("ATT_TP_ATTENTION", raising=False)
+    ref_runner = TPRunner(tiny_cfg, tiny_params, make_mesh(tp=2))
+    assert ref_runner.attn_mode == "gather"  # CPU default
+    ref = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=ref_runner).generate(prompt, samp)
+
+    monkeypatch.setenv("ATT_TP_ATTENTION", "shard_dma")
+    runner = TPRunner(tiny_cfg, tiny_params, make_mesh(tp=2))
+    assert runner.attn_mode == "shard_dma"
+    got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
+def test_tp_shard_dma_speculative(tiny_cfg, tiny_params, monkeypatch):
+    """Multi-query verify under shard_map: TP=2 + ngram speculation matches
+    the single-device speculative engine."""
+    ecfg = EngineConfig(model="tiny", dtype="float32", num_blocks=64,
+                        max_model_len=128, speculation="ngram", spec_tokens=2)
+    prompt = [5, 6, 7, 8] * 5
+    samp = SamplingParams(temperature=0.0, max_tokens=6, ignore_eos=True)
+
+    ref = LLMEngine(ecfg, model_cfg=tiny_cfg, params=tiny_params).generate(prompt, samp)
+
+    monkeypatch.setenv("ATT_TP_ATTENTION", "shard_dma")
+    runner = TPRunner(tiny_cfg, tiny_params, make_mesh(tp=2), spec_tokens=2)
+    got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
 def test_tp_forward_logits_match(tiny_cfg, tiny_params):
     """Full forward under TP sharding reproduces single-device logits."""
     from agentic_traffic_testing_tpu.parallel.sharding import shard_params
