@@ -467,4 +467,13 @@ def verify_step_impl(
 forward_full = jax.jit(forward_full_impl, static_argnames=("cfg",))
 prefill = jax.jit(prefill_impl, static_argnames=("cfg", "kv_writer_mode"),
                   donate_argnums=(3,))
-decode_step = jax.jit(decode_step_impl, static_argnames=("cfg", "attn_mode"), donate_argnums=(3,))
+decode_step = jax.jit(
+    decode_step_impl,
+    static_argnames=("cfg", "attn_mode", "attn_mesh", "attn_axis"),
+    donate_argnums=(3,),
+)
+verify_step = jax.jit(
+    verify_step_impl,
+    static_argnames=("cfg", "attn_mode", "attn_mesh", "attn_axis"),
+    donate_argnums=(3,),
+)

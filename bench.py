@@ -48,12 +48,14 @@ def main() -> None:
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
     decode_tokens = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
 
+    ds = os.environ.get("BENCH_DECODE_STEPS")
     cfg = EngineConfig(
         model=model,
         dtype="bfloat16",
         max_num_seqs=batch,
         max_model_len=max(512, prompt_len + decode_tokens + 16),
         num_blocks=None if platform == "tpu" else 1024,
+        decode_steps=int(ds) if ds else None,
     )
     engine = LLMEngine(cfg)
     rng = np.random.default_rng(0)

@@ -11,7 +11,7 @@ import numpy as np
 import optax
 import pytest
 
-from agentic_traffic_testing_tpu.models.config import resolve_config
+from agentic_traffic_testing_tpu.models.config import ModelConfig, resolve_config
 from agentic_traffic_testing_tpu.models.llama import forward_full, init_params
 from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
 from agentic_traffic_testing_tpu.ops.ring_attention import make_sp_attention
@@ -108,6 +108,31 @@ def test_tp_shard_dma_speculative(tiny_cfg, tiny_params, monkeypatch):
     runner = TPRunner(tiny_cfg, tiny_params, make_mesh(tp=2), spec_tokens=2)
     got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(prompt, samp)
     assert got.output_ids == ref.output_ids
+
+
+def test_tp8_70b_shape_engine_decode(monkeypatch):
+    """The TP=8 north-star sharding (Llama-3-70B: 64 heads / 8 KV heads over
+    8 chips — serving/configs/llama-3-70b-tp8.yaml) exercised shape-faithfully
+    on the 8-device CPU mesh with a scaled-down config: 8 KV heads shard to
+    ONE kv head per chip, the hardest GQA split. Runs both TP attention
+    paths; greedy tokens must match the single-device engine exactly."""
+    monkeypatch.delenv("ATT_TP_ATTENTION", raising=False)
+    cfg = ModelConfig(
+        name="70b-shape", vocab_size=512, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=16, num_kv_heads=8, head_dim=8,
+    )
+    params = init_params(cfg, jax.random.key(1), dtype=jnp.float32)
+    ecfg = EngineConfig(model="tiny", dtype="float32", num_blocks=64,
+                        max_model_len=128)
+    prompt = list(range(3, 23))
+    samp = SamplingParams(temperature=0.0, max_tokens=6)
+
+    ref = LLMEngine(ecfg, model_cfg=cfg, params=params).generate(prompt, samp)
+    for mode in ("gather", "shard_dma"):
+        monkeypatch.setenv("ATT_TP_ATTENTION", mode)
+        runner = TPRunner(cfg, params, make_mesh(tp=8))
+        got = LLMEngine(ecfg, model_cfg=cfg, runner=runner).generate(prompt, samp)
+        assert got.output_ids == ref.output_ids, mode
 
 
 def test_tp_forward_logits_match(tiny_cfg, tiny_params):
