@@ -179,6 +179,27 @@ def _unembed(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
 # ---------------------------------------------------------------------------
 
 
+def decoder_layer(x: jax.Array, lp: dict, cfg: ModelConfig, sin, cos,
+                  positions: jax.Array, seq_lens: jax.Array,
+                  attn_fn=None) -> jax.Array:
+    """One full (cache-free) decoder layer on x [B, T, D] -> [B, T, D].
+
+    The shared body behind `forward_full_impl`'s layer scan and the
+    pipeline-parallel stage stacks (parallel/pipeline.py), so pipelined and
+    plain forwards are numerically identical by construction."""
+    b, t = x.shape[:2]
+    if attn_fn is None:
+        attn_fn = causal_attention
+    xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
+    q, k, v = _qkv(xa, lp, cfg)
+    q = apply_rope(q, sin, cos)
+    k = apply_rope(k, sin, cos)
+    attn = attn_fn(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
+    x = x + dense(attn.reshape(b, t, -1), lp["wo"])
+    xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
+    return x + _mlp_block(xm, lp)
+
+
 def forward_full_impl(params: Params, cfg: ModelConfig, tokens: jax.Array,
                       positions: Optional[jax.Array] = None,
                       attn_fn=None) -> jax.Array:
@@ -191,22 +212,12 @@ def forward_full_impl(params: Params, cfg: ModelConfig, tokens: jax.Array,
     b, t = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
-    if attn_fn is None:
-        attn_fn = causal_attention
     x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)
     sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     seq_lens = jnp.full((b,), t, jnp.int32)
 
     def body(x, lp):
-        xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
-        q, k, v = _qkv(xa, lp, cfg)
-        q = apply_rope(q, sin, cos)
-        k = apply_rope(k, sin, cos)
-        attn = attn_fn(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
-        x = x + dense(attn.reshape(b, t, -1), lp["wo"])
-        xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
-        x = x + _mlp_block(xm, lp)
-        return x, None
+        return decoder_layer(x, lp, cfg, sin, cos, positions, seq_lens, attn_fn), None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)

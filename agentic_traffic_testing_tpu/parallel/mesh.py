@@ -8,10 +8,12 @@ are XLA collectives riding ICI (intra-slice) / DCN (cross-slice).
 
 Axis vocabulary (scaling-book convention):
     dp  — data parallel (batch dim; gradient psum in training, request-level in serving)
+    pp  — pipeline parallel (layer-stack stages; GPipe microbatch handoffs
+          over ICI ppermutes — parallel/pipeline.py)
     sp  — sequence/context parallel (ring attention over ICI neighbors)
     tp  — tensor parallel (head/feature dim; all-reduce after row-parallel matmuls)
 
-A serving deployment is usually `make_mesh(tp=N)`; training uses all three.
+A serving deployment is usually `make_mesh(tp=N)`; training composes them.
 """
 
 from __future__ import annotations
@@ -23,29 +25,36 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_DP = "dp"
+AXIS_PP = "pp"
 AXIS_SP = "sp"
 AXIS_TP = "tp"
-MESH_AXES = (AXIS_DP, AXIS_SP, AXIS_TP)
+MESH_AXES = (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP)
 
 
 def make_mesh(
     dp: int = 1,
     sp: int = 1,
     tp: int = 1,
+    pp: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
-    """Build a (dp, sp, tp) mesh over the first dp*sp*tp devices.
+    """Build a (dp, pp, sp, tp) mesh over the first dp*pp*sp*tp devices.
 
     On real hardware, `jax.devices()` order follows the physical torus, so
     the innermost axis (tp) lands on nearest ICI neighbors — the axis with
     the most chatter (per-layer all-reduces) gets the shortest hops, then sp
-    (ring ppermutes), then dp (one psum per step).
+    (ring ppermutes), then pp (one activation handoff per stage per
+    microbatch), then dp (one psum per step). Axes default to 1, so existing
+    (dp, sp, tp) callers are unchanged — PartitionSpecs simply never mention
+    `pp` unless pipeline stages are in play.
     """
     devices = list(devices if devices is not None else jax.devices())
-    n = dp * sp * tp
+    n = dp * sp * tp * pp
     if len(devices) < n:
-        raise ValueError(f"mesh ({dp},{sp},{tp}) needs {n} devices, have {len(devices)}")
-    arr = np.array(devices[:n]).reshape(dp, sp, tp)
+        raise ValueError(
+            f"mesh (dp={dp},pp={pp},sp={sp},tp={tp}) needs {n} devices, "
+            f"have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(dp, pp, sp, tp)
     return Mesh(arr, MESH_AXES)
 
 
@@ -68,11 +77,12 @@ def single_axis_mesh(axis: str, n: Optional[int] = None,
     """A 1-axis mesh (e.g. pure-TP serving); other axes sized 1."""
     devices = list(devices if devices is not None else jax.devices())
     n = n or len(devices)
-    sizes = {AXIS_DP: 1, AXIS_SP: 1, AXIS_TP: 1}
+    sizes = {AXIS_DP: 1, AXIS_PP: 1, AXIS_SP: 1, AXIS_TP: 1}
     if axis not in sizes:
         raise ValueError(f"unknown axis {axis!r}")
     sizes[axis] = n
-    return make_mesh(sizes[AXIS_DP], sizes[AXIS_SP], sizes[AXIS_TP], devices)
+    return make_mesh(dp=sizes[AXIS_DP], sp=sizes[AXIS_SP], tp=sizes[AXIS_TP],
+                     pp=sizes[AXIS_PP], devices=devices)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

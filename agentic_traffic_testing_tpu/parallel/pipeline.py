@@ -1,0 +1,190 @@
+"""GPipe-style pipeline parallelism over the `pp` mesh axis.
+
+The stacked-[L, ...] parameter layout (models/llama.py) makes pipeline
+stages a SHARDING, not a refactor: splitting the layer stack across chips is
+`P('pp', ...)` on the leading layer axis, and each chip's shard IS its
+stage's weights. The schedule runs inside `jax.shard_map(axis_names={'pp'})`
+— manual over `pp` only, so tensor parallelism (Megatron PartitionSpecs on
+the trailing dims) and data parallelism (batch dim) keep riding GSPMD
+*inside* each stage untouched.
+
+Schedule (classic GPipe, M microbatches over P stages, M + P - 1 ticks):
+
+    tick t:  stage 0 injects microbatch t (while t < M); every stage runs
+             its local layer stack; activations ppermute one hop to the
+             next stage over ICI; the last stage banks the finished
+             microbatch t-(P-1). Bubble fraction = (P-1)/(M+P-1).
+
+The last stage's banked activations are psum-broadcast over `pp` (every
+other stage contributes zeros), so embedding, final norm/unembed, and the
+loss all stay in plain GSPMD outside the shard_map. Backward differentiates
+straight through the schedule: ppermute transposes to the reverse
+ppermute, the psum to a broadcast, and each stage's weight gradients stay
+chip-local — no hand-written backward pass.
+
+The reference testbed has no pipeline parallelism anywhere (vLLM-internal
+only, never configured — SURVEY.md §2.3); this is a capability extension of
+the TPU rebuild, sized for models past TP=8's reach (Llama-3-70B+ across
+hosts: tp over ICI inside a host, pp over DCN between hosts).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from agentic_traffic_testing_tpu.models.config import ModelConfig
+from agentic_traffic_testing_tpu.models.llama import decoder_layer, init_params
+from agentic_traffic_testing_tpu.models.quant import dense, embed_lookup
+from agentic_traffic_testing_tpu.ops.jnp_ops import rms_norm, rope_sin_cos
+from agentic_traffic_testing_tpu.parallel.mesh import (
+    AXIS_DP,
+    AXIS_PP,
+    AXIS_SP,
+)
+from agentic_traffic_testing_tpu.parallel.sharding import (
+    param_pspecs,
+    shard_pytree,
+)
+
+
+def pp_param_pspecs(cfg: ModelConfig) -> dict:
+    """TP specs (parallel/sharding.py) with the leading layer axis of every
+    stacked weight additionally sharded over `pp` — chip (p_i, t_j) holds
+    stage i's layers, TP-shard j. Embedding/norms/unembed stay pp-replicated
+    (stage 0 / last stage use them; they are small next to the stack)."""
+    specs = param_pspecs(cfg)
+    specs["layers"] = {
+        k: P(AXIS_PP, *tuple(s)[1:]) for k, s in specs["layers"].items()
+    }
+    return specs
+
+
+def make_pp_pipeline(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
+                     remat: bool = True):
+    """Build pipeline(local_layers, x_mb) -> activations, shard_mapped over pp.
+
+    x_mb: [M, mb, T, D] microbatched embeddings, pp-replicated (dp sharding
+    of the mb dim keeps riding GSPMD — `pp` is the only manual axis here).
+    Returns the post-stack activations in the same layout.
+    """
+    pp = mesh.shape[AXIS_PP]
+    m = num_microbatches
+
+    @partial(jax.shard_map, mesh=mesh, axis_names={AXIS_PP},
+             in_specs=(P(AXIS_PP), P()), out_specs=P(), check_vma=False)
+    def pipeline(local_layers, x_mb):
+        p = jax.lax.axis_index(AXIS_PP)
+        mb, t = x_mb.shape[1], x_mb.shape[2]
+        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None],
+                                     (mb, t))
+        seq_lens = jnp.full((mb,), t, jnp.int32)
+        sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta,
+                                cfg.rope_scaling)
+
+        def run_stage(x):
+            def body(x, lp):
+                return decoder_layer(x, lp, cfg, sin, cos, positions,
+                                     seq_lens), None
+            x, _ = jax.lax.scan(body, x, local_layers)
+            return x
+
+        if remat:
+            run_stage = jax.checkpoint(run_stage)
+
+        perm = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def tick(carry, tk):
+            x_cur, out = carry
+            # Stage 0 injects microbatch tk; warm-up/drain ticks past M just
+            # recycle the last one — their results are never banked.
+            inject = x_mb[jnp.minimum(tk, m - 1)]
+            x_in = jnp.where(p == 0, inject, x_cur)
+            y = run_stage(x_in)
+            # Last stage banks finished microbatch tk-(pp-1); other stages
+            # (and warm-up ticks) rewrite the slot with its current value.
+            slot = jnp.clip(tk - (pp - 1), 0, m - 1)
+            prev = jax.lax.dynamic_index_in_dim(out, slot, 0, keepdims=False)
+            take = (tk >= pp - 1) & (p == pp - 1)
+            out = jax.lax.dynamic_update_index_in_dim(
+                out, jnp.where(take, y, prev), slot, 0)
+            x_next = jax.lax.ppermute(y, AXIS_PP, perm)
+            return (x_next, out), None
+
+        (x_last, out), _ = jax.lax.scan(
+            tick, (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb)),
+            jnp.arange(m + pp - 1, dtype=jnp.int32))
+        # Only the last stage banked anything; everyone else holds zeros, so
+        # one psum broadcasts the result and the loss stays in GSPMD outside.
+        return jax.lax.psum(out, AXIS_PP)
+
+    return pipeline
+
+
+def make_pp_train_step(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer: Optional[optax.GradientTransformation] = None,
+    num_microbatches: int = 2,
+    remat: bool = True,
+):
+    """Pipelined analog of training/train.py:make_train_step over a
+    (dp, pp, tp) mesh. Composes with dp (batch dim, GSPMD) and tp (Megatron
+    specs inside each stage, GSPMD); sp must be 1 — ring attention partitions
+    the sequence the schedule's activations don't (future work).
+    Requires cfg.num_layers % pp == 0 and batch % num_microbatches == 0."""
+    from agentic_traffic_testing_tpu.training.train import causal_lm_loss
+
+    pp = mesh.shape[AXIS_PP]
+    if mesh.shape[AXIS_SP] != 1:
+        raise ValueError("pipeline training requires sp=1 (ring attention "
+                         "and pp stages are not composed yet)")
+    if cfg.num_layers % pp:
+        raise ValueError(
+            f"num_layers={cfg.num_layers} not divisible by pp={pp}")
+    optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
+    m = num_microbatches
+    pipeline = make_pp_pipeline(cfg, mesh, m, remat=remat)
+    batch_sharding = NamedSharding(mesh, P(AXIS_DP, None))
+
+    def loss_fn(params, tokens, mask):
+        b, t = tokens.shape
+        x = embed_lookup(params["tok_embed"], tokens,
+                         dtype=params["final_norm"].dtype)
+        h = pipeline(params["layers"], x.reshape(m, b // m, t, -1))
+        h = rms_norm(h.reshape(b, t, -1), params["final_norm"],
+                     cfg.rms_norm_eps)
+        logits = dense(h, params["unembed"]).astype(jnp.float32)
+        return causal_lm_loss(logits, tokens, mask)
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step_fn(params, opt_state, tokens, mask):
+        if tokens.shape[0] % m:
+            raise ValueError(f"batch {tokens.shape[0]} % microbatches {m} != 0")
+        tokens = jax.lax.with_sharding_constraint(tokens, batch_sharding)
+        mask = jax.lax.with_sharding_constraint(mask, batch_sharding)
+        loss, grads = jax.value_and_grad(loss_fn)(params, tokens, mask)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return step_fn
+
+
+def init_pp_train_state(
+    cfg: ModelConfig,
+    mesh: Mesh,
+    optimizer: optax.GradientTransformation,
+    seed: int = 0,
+    dtype=jnp.float32,
+):
+    """init_train_state with the layer stack additionally pp-sharded."""
+    params = init_params(cfg, jax.random.key(seed), dtype=dtype)
+    params = shard_pytree(params, pp_param_pspecs(cfg), mesh)
+    opt_state = jax.jit(optimizer.init)(params)
+    return params, opt_state
