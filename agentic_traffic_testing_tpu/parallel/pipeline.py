@@ -50,6 +50,7 @@ from agentic_traffic_testing_tpu.parallel.mesh import (
 from agentic_traffic_testing_tpu.parallel.sharding import (
     param_pspecs,
     shard_pytree,
+    validate_tp,
 )
 
 
@@ -138,9 +139,11 @@ def make_pp_train_step(
     specs inside each stage, GSPMD); sp must be 1 — ring attention partitions
     the sequence the schedule's activations don't (future work).
     Requires cfg.num_layers % pp == 0 and batch % num_microbatches == 0."""
+    from agentic_traffic_testing_tpu.parallel.mesh import AXIS_TP
     from agentic_traffic_testing_tpu.training.train import causal_lm_loss
 
     pp = mesh.shape[AXIS_PP]
+    validate_tp(cfg, mesh.shape[AXIS_TP])  # same guard as the plain path
     if mesh.shape[AXIS_SP] != 1:
         raise ValueError("pipeline training requires sp=1 (ring attention "
                          "and pp stages are not composed yet)")
@@ -173,7 +176,9 @@ def make_pp_train_step(
         params = optax.apply_updates(params, updates)
         return params, opt_state, loss
 
-    return step_fn
+    from agentic_traffic_testing_tpu.training.train import TrainStep
+
+    return TrainStep(step_fn=step_fn, optimizer=optimizer, mesh=mesh)
 
 
 def init_pp_train_state(

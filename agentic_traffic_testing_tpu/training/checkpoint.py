@@ -1,0 +1,90 @@
+"""Sharded training checkpoint/resume via orbax.
+
+The reference testbed has no model checkpointing at all — weights come from
+the HF hub and the only resume machinery is experiment-level (SURVEY.md
+§5.4); the TPU rebuild ships training as a first-class capability
+(training/train.py), so it gets the idiomatic TPU persistence layer to
+match: orbax saves each chip's shard of the (params, opt_state) pytrees and
+restores them straight onto the target mesh sharding — no host-side
+gather/scatter of a 70B state dict.
+
+Layout on disk: `<dir>/<step>/{params,opt_state}` managed by an orbax
+CheckpointManager (bounded retention, atomic finalization, latest-step
+discovery), the same pattern the experiment runner relies on for its own
+resume (`runs.jsonl` + summary — scripts/experiment/run_experiment.sh).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import orbax.checkpoint as ocp
+
+
+@dataclasses.dataclass
+class TrainCheckpointer:
+    """Bounded-retention checkpoint manager for (step, params, opt_state)."""
+
+    directory: str
+    max_to_keep: int = 3
+
+    def __post_init__(self) -> None:
+        self._mngr = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                max_to_keep=self.max_to_keep, create=True),
+            item_names=("params", "opt_state"),
+        )
+
+    def save(self, step: int, params: Any, opt_state: Any,
+             wait: bool = False) -> None:
+        """Save one step (async by default; `wait` forces completion)."""
+        self._mngr.save(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardSave(params),
+                opt_state=ocp.args.StandardSave(opt_state),
+            ),
+        )
+        if wait:
+            self._mngr.wait_until_finished()
+
+    def latest_step(self) -> Optional[int]:
+        return self._mngr.latest_step()
+
+    def restore(self, params_like: Any, opt_state_like: Any,
+                step: Optional[int] = None):
+        """Restore (params, opt_state) at `step` (default: latest).
+
+        `*_like` are pytrees of jax.Arrays OR jax.ShapeDtypeStruct with
+        `.sharding` set — each leaf is restored directly onto that sharding,
+        so a checkpoint written from one mesh can be reloaded onto another
+        (e.g. tp=8 -> dp=2,tp=4) without materializing the full state on any
+        single host.
+        """
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints under {self.directory}")
+        restored = self._mngr.restore(
+            step,
+            args=ocp.args.Composite(
+                params=ocp.args.StandardRestore(_abstract(params_like)),
+                opt_state=ocp.args.StandardRestore(_abstract(opt_state_like)),
+            ),
+        )
+        return step, restored.params, restored.opt_state
+
+    def close(self) -> None:
+        self._mngr.wait_until_finished()
+        self._mngr.close()
+
+
+def _abstract(tree: Any) -> Any:
+    """Pytree of ShapeDtypeStructs carrying the target shardings."""
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        return x
+    return jax.tree_util.tree_map(leaf, tree)
