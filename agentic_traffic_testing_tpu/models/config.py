@@ -65,6 +65,14 @@ class ModelConfig:
     tie_word_embeddings: bool = False
     qkv_bias: bool = False             # True for Qwen2.x
     dtype: str = "bfloat16"
+    # Mixture-of-experts (Mixtral variant): 0 = dense SwiGLU MLP. When > 0,
+    # each layer's MLP is num_experts expert SwiGLUs with top-k routing
+    # (models/moe.py); intermediate_size is the per-expert hidden width.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # Dispatch capacity per expert = ceil(k * T / E * capacity_factor);
+    # tokens routed past it are dropped (standard GShard/Switch behavior).
+    moe_capacity_factor: float = 2.0
 
     @property
     def head_dim_(self) -> int:
@@ -79,6 +87,8 @@ class ModelConfig:
         d, hd = self.hidden_size, self.head_dim_
         attn = d * (self.num_heads * hd) + 2 * d * (self.num_kv_heads * hd) + (self.num_heads * hd) * d
         mlp = 3 * d * self.intermediate_size
+        if self.num_experts:
+            mlp = self.num_experts * mlp + d * self.num_experts  # + router
         norms = 2 * d
         per_layer = attn + mlp + norms
         emb = self.vocab_size * d
@@ -106,6 +116,8 @@ class ModelConfig:
             max_position_embeddings=cfg.get("max_position_embeddings", 8192),
             tie_word_embeddings=cfg.get("tie_word_embeddings", False),
             qkv_bias=cfg.get("model_type") == "qwen2",
+            num_experts=cfg.get("num_local_experts", 0),
+            num_experts_per_tok=cfg.get("num_experts_per_tok", 2),
         )
 
     @staticmethod
@@ -154,6 +166,15 @@ PRESETS: dict[str, ModelConfig] = {
         num_layers=28, num_heads=28, num_kv_heads=4, rope_theta=1000000.0,
         max_position_embeddings=32768, qkv_bias=True,
     ),
+    "tiny-moe": ModelConfig(
+        name="tiny-moe", num_experts=4, num_experts_per_tok=2,
+    ),
+    "mixtral-8x7b": ModelConfig(
+        name="mixtral-8x7b", vocab_size=32000, hidden_size=4096,
+        intermediate_size=14336, num_layers=32, num_heads=32, num_kv_heads=8,
+        head_dim=128, rope_theta=1000000.0, rms_norm_eps=1e-5,
+        max_position_embeddings=32768, num_experts=8, num_experts_per_tok=2,
+    ),
 }
 
 
@@ -164,6 +185,7 @@ _HF_ALIASES = {
     "meta-llama/meta-llama-3-70b-instruct": "llama-3-70b",
     "meta-llama/llama-3.3-70b-instruct": "llama-3-70b",
     "qwen/qwen2.5-7b-instruct": "qwen2.5-7b",
+    "mistralai/mixtral-8x7b-instruct-v0.1": "mixtral-8x7b",
 }
 
 

@@ -78,10 +78,17 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
         "wk": w(next(keys), (L, d, kh * hd)),
         "wv": w(next(keys), (L, d, kh * hd)),
         "wo": w(next(keys), (L, h * hd, d)),
-        "w_gate": w(next(keys), (L, d, f)),
-        "w_up": w(next(keys), (L, d, f)),
-        "w_down": w(next(keys), (L, f, d)),
     }
+    if cfg.num_experts:
+        from agentic_traffic_testing_tpu.models.moe import init_moe_layer_weights
+
+        layers.update(init_moe_layer_weights(next(keys), cfg, dtype))
+    else:
+        layers.update({
+            "w_gate": w(next(keys), (L, d, f)),
+            "w_up": w(next(keys), (L, d, f)),
+            "w_down": w(next(keys), (L, f, d)),
+        })
     if cfg.qkv_bias:
         layers["bq"] = jnp.zeros((L, h * hd), dtype)
         layers["bk"] = jnp.zeros((L, kh * hd), dtype)
@@ -106,6 +113,11 @@ def init_params_quantized(cfg: ModelConfig, seed: int = 0,
     dequantized std matches init_params' 0.02 — statistically equivalent for
     perf work, never materialized in float anywhere."""
     import numpy as np
+
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "int8 weight quantization is not wired up for MoE configs yet "
+            "(the expert einsums need a quantized contraction)")
 
     d, hd, f = cfg.hidden_size, cfg.head_dim_, cfg.intermediate_size
     h, kh, L, v = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers, cfg.vocab_size
@@ -166,8 +178,15 @@ def _qkv(x: jax.Array, lp: dict, cfg: ModelConfig):
     )
 
 
-def _mlp_block(x: jax.Array, lp: dict) -> jax.Array:
-    return swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"])
+def _mlp_block(x: jax.Array, lp: dict, cfg: ModelConfig):
+    """Dense SwiGLU or sparse MoE by weight schema. Returns (y, aux-loss);
+    aux is 0 for dense and the Switch load-balance term for MoE (training
+    adds it to the objective, the serving paths drop it)."""
+    if "w_router" in lp:
+        from agentic_traffic_testing_tpu.models.moe import moe_mlp
+
+        return moe_mlp(x, lp, cfg)
+    return swiglu(x, lp["w_gate"], lp["w_up"], lp["w_down"]), jnp.float32(0.0)
 
 
 def _unembed(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
@@ -181,12 +200,13 @@ def _unembed(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
 
 def decoder_layer(x: jax.Array, lp: dict, cfg: ModelConfig, sin, cos,
                   positions: jax.Array, seq_lens: jax.Array,
-                  attn_fn=None) -> jax.Array:
-    """One full (cache-free) decoder layer on x [B, T, D] -> [B, T, D].
+                  attn_fn=None) -> tuple[jax.Array, jax.Array]:
+    """One full (cache-free) decoder layer: x [B, T, D] -> ([B, T, D], aux).
 
     The shared body behind `forward_full_impl`'s layer scan and the
     pipeline-parallel stage stacks (parallel/pipeline.py), so pipelined and
-    plain forwards are numerically identical by construction."""
+    plain forwards are numerically identical by construction. `aux` is the
+    layer's MoE load-balance term (0 for dense layers)."""
     b, t = x.shape[:2]
     if attn_fn is None:
         attn_fn = causal_attention
@@ -197,13 +217,16 @@ def decoder_layer(x: jax.Array, lp: dict, cfg: ModelConfig, sin, cos,
     attn = attn_fn(q, k, v, q_positions=positions, kv_valid_len=seq_lens)
     x = x + dense(attn.reshape(b, t, -1), lp["wo"])
     xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
-    return x + _mlp_block(xm, lp)
+    y, aux = _mlp_block(xm, lp, cfg)
+    return x + y, aux
 
 
 def forward_full_impl(params: Params, cfg: ModelConfig, tokens: jax.Array,
                       positions: Optional[jax.Array] = None,
-                      attn_fn=None) -> jax.Array:
-    """Causal LM forward. tokens [B, T] -> logits [B, T, V] (fp32).
+                      attn_fn=None, with_aux: bool = False):
+    """Causal LM forward. tokens [B, T] -> logits [B, T, V] (fp32), or
+    (logits, aux) with `with_aux` (summed MoE load-balance terms — the
+    training objective's extra term for MoE configs; 0 for dense).
 
     `attn_fn(q, k, v, q_positions=..., kv_valid_len=...)` overrides the
     attention site — the sequence-parallel training path swaps in ring
@@ -217,11 +240,12 @@ def forward_full_impl(params: Params, cfg: ModelConfig, tokens: jax.Array,
     seq_lens = jnp.full((b,), t, jnp.int32)
 
     def body(x, lp):
-        return decoder_layer(x, lp, cfg, sin, cos, positions, seq_lens, attn_fn), None
+        return decoder_layer(x, lp, cfg, sin, cos, positions, seq_lens, attn_fn)
 
-    x, _ = jax.lax.scan(body, x, params["layers"])
+    x, aux = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    return _unembed(x, params, cfg)
+    logits = _unembed(x, params, cfg)
+    return (logits, jnp.sum(aux)) if with_aux else logits
 
 
 # ---------------------------------------------------------------------------
@@ -247,7 +271,8 @@ def _prefill_layer_body(x, lp, li, cfg: ModelConfig, sin, cos, attn_site, cache)
     attn = attn_site(q, k, v, li)
     x = x + dense(attn.reshape(b, t, -1), lp["wo"])
     xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
-    x = x + _mlp_block(xm, lp)
+    y, _ = _mlp_block(xm, lp, cfg)  # serving paths drop the MoE aux term
+    x = x + y
     pad = ((0, 0), (0, 0), (0, 0), (0, hdp - hd))
     k_pages = jnp.pad(k.transpose(0, 2, 1, 3), pad)  # [B, KH, T, hdp]
     v_pages = jnp.pad(v.transpose(0, 2, 1, 3), pad)
@@ -461,7 +486,8 @@ def verify_step_impl(
                                       mesh=attn_mesh, axis=attn_axis)
         x = x + dense(attn.reshape(b, s, -1), lp["wo"])
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
-        x = x + _mlp_block(xm, lp)
+        y, _ = _mlp_block(xm, lp, cfg)  # serving paths drop the MoE aux term
+        x = x + y
         return (x, kc, vc), None
 
     (x, kc, vc), _ = jax.lax.scan(

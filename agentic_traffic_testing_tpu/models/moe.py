@@ -1,0 +1,108 @@
+"""Sparse mixture-of-experts MLP (Mixtral variant) with expert parallelism.
+
+TPU-first formulation: routing is expressed as two einsums against a
+dispatch/combine tensor (the GShard recipe) instead of per-token gathers —
+every op is a dense, statically-shaped contraction the MXU and the SPMD
+partitioner both understand. Expert parallelism is then *only a sharding*:
+expert weights carry `P('ep', ...)` on their leading expert axis
+(parallel/sharding.py), and GSPMD turns the dispatch/combine einsums into
+the all-to-alls that move token slices between expert shards over ICI.
+
+Capacity semantics (standard GShard/Switch): each expert processes at most
+C = ceil(k·T/E · capacity_factor) token-slots per batch row; assignments
+past that are dropped (the token keeps its other experts' contributions).
+Gate weights are the top-k softmax probabilities renormalized over the
+selected experts, matching HF Mixtral numerics (golden test:
+tests/test_moe.py vs MixtralForCausalLM).
+
+The reference testbed serves dense Llama only (SURVEY.md §2.3: "Expert
+parallel (EP/MoE): No"); this extends the rebuild's model families beyond
+the reference envelope.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.models.config import ModelConfig
+
+
+def router_topk(x: jax.Array, w_router: jax.Array, cfg: ModelConfig):
+    """Top-k routing. x [B, T, D] -> (probs [B,T,E] f32, gates [B,T,k] f32,
+    idx [B,T,k] i32). Router math runs in f32 regardless of model dtype
+    (bf16 softmax-over-experts is unstable enough to flip rankings)."""
+    logits = jnp.einsum("btd,de->bte", x.astype(jnp.float32),
+                        w_router.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, idx = jax.lax.top_k(probs, cfg.num_experts_per_tok)
+    gates = gates / jnp.sum(gates, axis=-1, keepdims=True)  # Mixtral renorm
+    return probs, gates, idx.astype(jnp.int32)
+
+
+def expert_capacity(t: int, cfg: ModelConfig) -> int:
+    return max(1, math.ceil(cfg.num_experts_per_tok * t / cfg.num_experts
+                            * cfg.moe_capacity_factor))
+
+
+def moe_mlp(x: jax.Array, lp: dict, cfg: ModelConfig):
+    """Sparse MoE SwiGLU. x [B, T, D] -> (y [B, T, D], aux-loss scalar f32).
+
+    lp: w_router [D, E]; w_gate/w_up [E, D, F]; w_down [E, F, D].
+    The aux scalar is the Switch load-balancing loss E·Σ_e f_e·P_e (f =
+    fraction of assignments to e, P = mean router prob of e); training adds
+    it to the objective, inference ignores it.
+    """
+    b, t, d = x.shape
+    e, k = cfg.num_experts, cfg.num_experts_per_tok
+    c = expert_capacity(t, cfg)
+    probs, gates, idx = router_topk(x, lp["w_router"], cfg)
+
+    # One-hot selection per (token, choice): [B, T*k, E]; choice order is
+    # (t0 c0, t0 c1, t1 c0, ...), so earlier tokens win capacity ties.
+    sel = jax.nn.one_hot(idx, e, dtype=jnp.float32).reshape(b, t * k, e)
+    # Position of each assignment in its expert's buffer, then capacity-drop.
+    pos = jnp.cumsum(sel, axis=1) - sel                      # [B, T*k, E]
+    pos = jnp.sum(pos * sel, axis=-1)                        # [B, T*k]
+    keep = (pos < c).astype(jnp.float32)
+    # Dispatch one-hots [B, T*k, E, C] and gate-weighted combine tensor.
+    disp = (sel * keep[..., None])[..., None] * jax.nn.one_hot(
+        jnp.minimum(pos, c - 1), c, dtype=jnp.float32)[..., None, :]
+    comb = disp * gates.reshape(b, t * k)[..., None, None]
+
+    disp = disp.astype(x.dtype)
+    # Token features per assignment slot: [B, T*k, D].
+    x_rep = jnp.repeat(x, k, axis=1)
+    expert_in = jnp.einsum("gsec,gsd->egcd", disp, x_rep)    # [E, B, C, D]
+    gate = jnp.einsum("egcd,edf->egcf", expert_in, lp["w_gate"])
+    up = jnp.einsum("egcd,edf->egcf", expert_in, lp["w_up"])
+    act = jax.nn.silu(gate) * up
+    out_e = jnp.einsum("egcf,efd->egcd", act, lp["w_down"])  # [E, B, C, D]
+    y = jnp.einsum("gsec,egcd->gsd", comb.astype(x.dtype), out_e)
+    y = y.reshape(b, t, k, d).sum(axis=2).astype(x.dtype)
+
+    # Switch aux loss over real assignments (dropped ones still count toward
+    # f_e — they were routed there, which is exactly the imbalance signal).
+    f = jnp.mean(sel.reshape(b, t, k, e).sum(axis=2), axis=(0, 1))  # [E]
+    p_mean = jnp.mean(probs, axis=(0, 1))                           # [E]
+    aux = jnp.float32(e) * jnp.sum(f * p_mean)
+    return y, aux
+
+
+def init_moe_layer_weights(key: jax.Array, cfg: ModelConfig, dtype) -> dict:
+    """Random-init the per-layer MoE weight entries (stacked [L, ...])."""
+    d, f = cfg.hidden_size, cfg.intermediate_size
+    e, L = cfg.num_experts, cfg.num_layers
+    keys = jax.random.split(key, 4)
+
+    def w(kk, shape):
+        return (jax.random.normal(kk, shape, jnp.float32) * 0.02).astype(dtype)
+
+    return {
+        "w_router": w(keys[0], (L, d, e)),
+        "w_gate": w(keys[1], (L, e, d, f)),
+        "w_up": w(keys[2], (L, e, d, f)),
+        "w_down": w(keys[3], (L, e, f, d)),
+    }

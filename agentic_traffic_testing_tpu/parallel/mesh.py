@@ -10,6 +10,8 @@ Axis vocabulary (scaling-book convention):
     dp  — data parallel (batch dim; gradient psum in training, request-level in serving)
     pp  — pipeline parallel (layer-stack stages; GPipe microbatch handoffs
           over ICI ppermutes — parallel/pipeline.py)
+    ep  — expert parallel (MoE expert dim; GSPMD all-to-alls on the
+          dispatch/combine einsums — models/moe.py)
     sp  — sequence/context parallel (ring attention over ICI neighbors)
     tp  — tensor parallel (head/feature dim; all-reduce after row-parallel matmuls)
 
@@ -26,9 +28,10 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 AXIS_DP = "dp"
 AXIS_PP = "pp"
+AXIS_EP = "ep"
 AXIS_SP = "sp"
 AXIS_TP = "tp"
-MESH_AXES = (AXIS_DP, AXIS_PP, AXIS_SP, AXIS_TP)
+MESH_AXES = (AXIS_DP, AXIS_PP, AXIS_EP, AXIS_SP, AXIS_TP)
 
 
 def make_mesh(
@@ -36,6 +39,7 @@ def make_mesh(
     sp: int = 1,
     tp: int = 1,
     pp: int = 1,
+    ep: int = 1,
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """Build a (dp, pp, sp, tp) mesh over the first dp*pp*sp*tp devices.
@@ -49,12 +53,12 @@ def make_mesh(
     `pp` unless pipeline stages are in play.
     """
     devices = list(devices if devices is not None else jax.devices())
-    n = dp * sp * tp * pp
+    n = dp * sp * tp * pp * ep
     if len(devices) < n:
         raise ValueError(
-            f"mesh (dp={dp},pp={pp},sp={sp},tp={tp}) needs {n} devices, "
-            f"have {len(devices)}")
-    arr = np.array(devices[:n]).reshape(dp, pp, sp, tp)
+            f"mesh (dp={dp},pp={pp},ep={ep},sp={sp},tp={tp}) needs {n} "
+            f"devices, have {len(devices)}")
+    arr = np.array(devices[:n]).reshape(dp, pp, ep, sp, tp)
     return Mesh(arr, MESH_AXES)
 
 
@@ -77,12 +81,12 @@ def single_axis_mesh(axis: str, n: Optional[int] = None,
     """A 1-axis mesh (e.g. pure-TP serving); other axes sized 1."""
     devices = list(devices if devices is not None else jax.devices())
     n = n or len(devices)
-    sizes = {AXIS_DP: 1, AXIS_PP: 1, AXIS_SP: 1, AXIS_TP: 1}
+    sizes = {a: 1 for a in MESH_AXES}
     if axis not in sizes:
         raise ValueError(f"unknown axis {axis!r}")
     sizes[axis] = n
     return make_mesh(dp=sizes[AXIS_DP], sp=sizes[AXIS_SP], tp=sizes[AXIS_TP],
-                     pp=sizes[AXIS_PP], devices=devices)
+                     pp=sizes[AXIS_PP], ep=sizes[AXIS_EP], devices=devices)
 
 
 def replicated(mesh: Mesh) -> NamedSharding:

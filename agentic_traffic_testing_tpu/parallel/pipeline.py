@@ -90,8 +90,11 @@ def make_pp_pipeline(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
 
         def run_stage(x):
             def body(x, lp):
-                return decoder_layer(x, lp, cfg, sin, cos, positions,
-                                     seq_lens), None
+                # MoE aux terms are dropped in the pipelined step for now
+                # (pipelined MoE training would bank them like activations).
+                y, _aux = decoder_layer(x, lp, cfg, sin, cos, positions,
+                                        seq_lens)
+                return y, None
             x, _ = jax.lax.scan(body, x, local_layers)
             return x
 

@@ -29,7 +29,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig
-from agentic_traffic_testing_tpu.parallel.mesh import AXIS_TP
+from agentic_traffic_testing_tpu.parallel.mesh import AXIS_EP, AXIS_TP
 from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
 
 
@@ -52,10 +52,23 @@ def param_pspecs(cfg: ModelConfig) -> dict:
         "wk": P(None, None, AXIS_TP),
         "wv": P(None, None, AXIS_TP),
         "wo": P(None, AXIS_TP, None),
-        "w_gate": P(None, None, AXIS_TP),
-        "w_up": P(None, None, AXIS_TP),
-        "w_down": P(None, AXIS_TP, None),
     }
+    if cfg.num_experts:
+        # Expert parallelism is a sharding of the expert axis; the MoE
+        # dispatch/combine einsums (models/moe.py) become GSPMD all-to-alls.
+        # Each expert's SwiGLU keeps the Megatron column/row split on tp.
+        layers.update({
+            "w_router": P(None, None, None),
+            "w_gate": P(None, AXIS_EP, None, AXIS_TP),
+            "w_up": P(None, AXIS_EP, None, AXIS_TP),
+            "w_down": P(None, AXIS_EP, AXIS_TP, None),
+        })
+    else:
+        layers.update({
+            "w_gate": P(None, None, AXIS_TP),
+            "w_up": P(None, None, AXIS_TP),
+            "w_down": P(None, AXIS_TP, None),
+        })
     if cfg.qkv_bias:
         layers["bq"] = P(None, AXIS_TP)
         layers["bk"] = P(None, AXIS_TP)

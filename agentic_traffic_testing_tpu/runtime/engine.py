@@ -185,6 +185,9 @@ class LLMEngine:
     ) -> None:
         self.cfg = cfg
         self.model_cfg = model_cfg or resolve_config(cfg.model)
+        if cfg.quantization and self.model_cfg.num_experts:
+            raise NotImplementedError(
+                "int8 quantization is not wired up for MoE configs yet")
         dtype = jnp.bfloat16 if cfg.dtype in ("bfloat16", "bf16") else jnp.float32
         platform = jax.devices()[0].platform
         decode_steps = cfg.resolved_decode_steps(platform)
