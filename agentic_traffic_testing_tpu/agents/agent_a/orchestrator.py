@@ -199,6 +199,11 @@ class AgentVerseOrchestrator:
         self.max_workers = _env_int("MAX_PARALLEL_WORKERS", 5)
         self.eval_max_tokens = _env_int("LLM_EVAL_MAX_TOKENS", 1024)
         self.eval_max_prompt_chars = _env_int("EVAL_MAX_PROMPT_CHARS", 8000)
+        # Token-aware eval guardrail (primary path, reference
+        # orchestrator.py:627-821); chars above are the fallback proxy.
+        self.max_model_len = _env_int("LLM_MAX_MODEL_LEN", 4096)
+        self.prompt_margin_tokens = _env_int("LLM_PROMPT_SAFETY_MARGIN_TOKENS", 128)
+        self._eval_tokenizer: Any = False  # False = unresolved, None = unavailable
         self.worker_urls = agent_b_urls()
         self._sem = asyncio.Semaphore(self.max_workers)
 
@@ -423,16 +428,74 @@ class AgentVerseOrchestrator:
         return result
 
     # ------------------------------------------------------- Stage 4
-    def _budget_results_text(self, results_text: str, task: str, plan: str) -> str:
-        """Trim the *oldest* result content, keep the tail (reference keeps
-        the most recent work — orchestrator.py:627-821); char-budgeted
-        against EVAL_MAX_PROMPT_CHARS as the model-len guardrail proxy."""
-        budget = self.eval_max_prompt_chars - len(task) - min(len(plan), 2000)
+    def _resolve_eval_tokenizer(self):
+        """Lazily resolve the tokenizer used for prompt budgeting.
+
+        `LLM_TOKENIZER_PATH` names a local HF tokenizer dir (same weights dir
+        the backend serves from) or the literal "byte" (tests). Unset/invalid
+        -> None, and budgeting falls back to characters — mirroring the
+        reference, which only token-budgets when vLLM's tokenizer resolves
+        (reference: orchestrator.py:84-107)."""
+        if self._eval_tokenizer is not False:
+            return self._eval_tokenizer
+        spec = os.environ.get("LLM_TOKENIZER_PATH", "")
+        tok = None
+        try:
+            if spec == "byte":
+                from agentic_traffic_testing_tpu.utils.tokenizer import ByteTokenizer
+
+                tok = ByteTokenizer()
+            elif spec:
+                from agentic_traffic_testing_tpu.utils.tokenizer import (
+                    ByteTokenizer,
+                    load_tokenizer,
+                )
+
+                loaded = load_tokenizer(spec)
+                # A silent byte fallback would badly over-trim subword text.
+                tok = None if isinstance(loaded, ByteTokenizer) else loaded
+        except Exception:
+            tok = None
+        self._eval_tokenizer = tok
+        return tok
+
+    def _budget_text(self, results_text: str, base_prompt: str,
+                     completion_tokens: int) -> str:
+        """Trim the *oldest* content so base_prompt + results + the reserved
+        completion fit the model window (reference keeps the most recent work
+        — orchestrator.py:627-821).
+
+        Primary path: token-budgeted against
+        `LLM_MAX_MODEL_LEN − completion_tokens − LLM_PROMPT_SAFETY_MARGIN_TOKENS`
+        when a tokenizer resolves. Fallback: char-budgeted against
+        EVAL_MAX_PROMPT_CHARS (the pre-token heuristic)."""
+        marker = "[...truncated...]\n"
+        tok = self._resolve_eval_tokenizer()
+        if tok is not None and self.max_model_len > 0 and completion_tokens > 0:
+            try:
+                budget = (self.max_model_len - completion_tokens
+                          - self.prompt_margin_tokens
+                          - len(tok.encode(base_prompt))
+                          - len(tok.encode(marker)))
+                if budget <= 0:
+                    return ""  # base prompt alone is at the limit
+                ids = tok.encode(results_text)
+                if len(ids) <= budget + len(tok.encode(marker)):
+                    return results_text
+                return marker + tok.decode(ids[-budget:])
+            except Exception:
+                pass  # tokenizer misbehaved mid-flight: fall back to chars
+        budget = self.eval_max_prompt_chars - len(base_prompt)
         if budget <= 0:
             budget = 1000
         if len(results_text) > budget:
-            results_text = "[...truncated...]\n" + results_text[-budget:]
+            results_text = marker + results_text[-budget:]
         return results_text
+
+    def _budget_results_text(self, results_text: str, task: str, plan: str) -> str:
+        base = prompts.EVALUATION_PROMPT.format(
+            task=task, plan=plan[:2000], results="")
+        return self._budget_text(results_text, base, self.eval_max_tokens)
 
     async def evaluate_results(self, state: AgentVerseState,
                                cb: Optional[ProgressCallback]) -> EvaluationResult:
@@ -467,13 +530,20 @@ class AgentVerseOrchestrator:
     async def _generate_final_output(self, state: AgentVerseState,
                                      cb: Optional[ProgressCallback]) -> str:
         results_text = state.execution.combined_text() if state.execution else ""
-        results_text = self._budget_results_text(results_text, state.task, "")
         feedback = state.evaluation.feedback if state.evaluation else ""
+        # Reserve the synthesis completion against the model window too —
+        # LLM_FINAL_MAX_TOKENS, default half the window (a fixed 4096 would
+        # overflow LLM_MAX_MODEL_LEN=4096 outright after any prompt).
+        final_max = _env_int("LLM_FINAL_MAX_TOKENS", 0) or min(
+            4096, max(512, self.max_model_len // 2))
+        base = prompts.FINAL_SYNTHESIS_PROMPT.format(
+            task=state.task, results="", feedback=feedback[:1000])
+        results_text = self._budget_text(results_text, base, final_max)
         res = await self._call_llm_tracked(
             state,
             prompts.FINAL_SYNTHESIS_PROMPT.format(
                 task=state.task, results=results_text, feedback=feedback[:1000]),
-            stage="final_synthesis", cb=cb, max_tokens=4096)
+            stage="final_synthesis", cb=cb, max_tokens=final_max)
         return res.output
 
     # ------------------------------------------------------- main loop

@@ -277,3 +277,65 @@ def test_parse_evaluation_robustness():
     assert "fine" in broken["feedback"]
     partial = parse_evaluation('{"completeness": 100, "correctness": 50, "clarity": 100}')
     assert partial["overall_score"] == pytest.approx(0.4 * 100 + 0.4 * 50 + 0.2 * 100)
+
+
+# ---------------------------------------------------------------- budgeting
+
+
+def _make_orchestrator(monkeypatch, **env):
+    from agentic_traffic_testing_tpu.agents.agent_a.orchestrator import (
+        AgentVerseOrchestrator,
+    )
+
+    monkeypatch.delenv("LLM_TOKENIZER_PATH", raising=False)
+    for k, v in env.items():
+        monkeypatch.setenv(k, str(v))
+    return AgentVerseOrchestrator(client=None)
+
+
+def test_eval_budget_token_aware_trims_tail(monkeypatch):
+    """Primary path (ref orchestrator.py:627-821): token budget =
+    max_model_len − eval_max_tokens − margin − base prompt; oldest content
+    trimmed, newest kept."""
+    orch = _make_orchestrator(
+        monkeypatch, LLM_TOKENIZER_PATH="byte", LLM_MAX_MODEL_LEN=1500,
+        LLM_EVAL_MAX_TOKENS=100, LLM_PROMPT_SAFETY_MARGIN_TOKENS=16)
+    results = "x" * 5000 + "THE-RECENT-TAIL"
+    out = orch._budget_results_text(results, task="t", plan="p")
+    assert out.startswith("[...truncated...]")
+    assert out.endswith("THE-RECENT-TAIL")
+    # Byte tokenizer: 1 token per ASCII char -> the whole prompt must fit
+    # the model-len budget with completion + margin reserved.
+    from agentic_traffic_testing_tpu.agents.agent_a import prompts
+
+    prompt = prompts.EVALUATION_PROMPT.format(task="t", plan="p", results=out)
+    assert len(prompt.encode()) <= 1500 - 100 - 16
+
+
+def test_eval_budget_token_aware_passthrough(monkeypatch):
+    orch = _make_orchestrator(
+        monkeypatch, LLM_TOKENIZER_PATH="byte", LLM_MAX_MODEL_LEN=8192,
+        LLM_EVAL_MAX_TOKENS=256)
+    short = "short results"
+    assert orch._budget_results_text(short, task="t", plan="p") == short
+
+
+def test_eval_budget_char_fallback_without_tokenizer(monkeypatch):
+    """No tokenizer resolves -> the pre-token char heuristic guards: results
+    are trimmed so base prompt + results stay near EVAL_MAX_PROMPT_CHARS."""
+    orch = _make_orchestrator(monkeypatch, EVAL_MAX_PROMPT_CHARS=1500)
+    out = orch._budget_results_text("y" * 5000, task="t", plan="p")
+    assert out.startswith("[...truncated...]")
+    from agentic_traffic_testing_tpu.agents.agent_a import prompts
+
+    prompt = prompts.EVALUATION_PROMPT.format(task="t", plan="p", results=out)
+    assert len(prompt) <= 1500 + len("[...truncated...]\n")
+
+
+def test_eval_budget_zero_budget_drops_results(monkeypatch):
+    """Base prompt alone exceeding the limit yields empty results, not a
+    negative slice."""
+    orch = _make_orchestrator(
+        monkeypatch, LLM_TOKENIZER_PATH="byte", LLM_MAX_MODEL_LEN=64,
+        LLM_EVAL_MAX_TOKENS=32)
+    assert orch._budget_results_text("z" * 100, task="t", plan="p") == ""
