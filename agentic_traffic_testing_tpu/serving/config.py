@@ -50,6 +50,10 @@ class ServerConfig:
     num_blocks: Optional[int] = None           # LLM_NUM_BLOCKS (None -> HBM profile)
     block_size: int = 16                       # LLM_BLOCK_SIZE
     weights_path: Optional[str] = None         # LLM_WEIGHTS_PATH (local safetensors dir)
+    # A failing weight load aborts startup unless this is set: silently
+    # serving a randomly initialized model behind 200s (a typo'd
+    # LLM_WEIGHTS_PATH) must be an explicit opt-in, not a fallback.
+    allow_random_weights: bool = False         # LLM_ALLOW_RANDOM_WEIGHTS
     speculation: Optional[str] = None          # LLM_SPECULATION ("ngram" | unset)
     spec_tokens: int = 3                       # LLM_SPEC_TOKENS (drafts/step)
     spec_ngram: int = 3                        # LLM_SPEC_NGRAM (match length)
@@ -90,6 +94,7 @@ class ServerConfig:
         c.num_blocks = int(nb) if nb else None
         c.block_size = int(os.environ.get("LLM_BLOCK_SIZE") or c.block_size)
         c.weights_path = os.environ.get("LLM_WEIGHTS_PATH") or None
+        c.allow_random_weights = _env_bool("LLM_ALLOW_RANDOM_WEIGHTS", "0")
         c.speculation = os.environ.get("LLM_SPECULATION") or None
         c.spec_tokens = int(os.environ.get("LLM_SPEC_TOKENS") or c.spec_tokens)
         c.spec_ngram = int(os.environ.get("LLM_SPEC_NGRAM") or c.spec_ngram)

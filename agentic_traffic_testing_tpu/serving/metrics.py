@@ -105,6 +105,13 @@ class LLMMetrics:
             f"{prefix}_spec_verify_iters_total",
             "Speculative verify iterations run (cumulative, live lanes)",
             registry=r)
+        # 1 = checkpoint weights loaded; 0 = randomly initialized (dev mode
+        # or explicit LLM_ALLOW_RANDOM_WEIGHTS=1 fallback). Alert on 0 in any
+        # deployment that sets LLM_WEIGHTS_PATH.
+        self.model_loaded = Gauge(
+            f"{prefix}_model_loaded",
+            "Whether checkpoint weights are loaded (1) vs random init (0)",
+            registry=r)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)

@@ -66,6 +66,7 @@ class LLMServer:
     def __init__(self, cfg: ServerConfig, engine: Optional[LLMEngine] = None) -> None:
         self.cfg = cfg
         self.tokenizer = load_tokenizer(cfg.weights_path or cfg.model)
+        self.model_loaded = False  # set by _load_params on checkpoint load
         self.engine = engine or self._build_engine()
         self.metrics = (
             LLMMetrics(cfg.metrics_prefix, cfg.metrics_include_tokens)
@@ -93,6 +94,7 @@ class LLMServer:
                 max_model_len=cfg.max_model_len,
                 max_num_seqs=cfg.max_num_seqs,
             )
+            self.metrics.model_loaded.set(1 if self.model_loaded else 0)
 
     def _build_engine(self) -> LLMEngine:
         c = self.cfg
@@ -137,12 +139,25 @@ class LLMServer:
             return LLMEngine(ecfg, model_cfg=model_cfg, runner=runner)
         if c.weights_path:
             from agentic_traffic_testing_tpu.models.config import resolve_config
-            model_cfg = resolve_config(c.weights_path)
-            params = self._load_params(model_cfg)
+            try:
+                model_cfg = resolve_config(c.weights_path)
+            except Exception as e:
+                if not c.allow_random_weights:
+                    raise RuntimeError(
+                        f"weight load failed for {c.weights_path!r}; refusing "
+                        f"to serve randomly initialized weights (set "
+                        f"LLM_ALLOW_RANDOM_WEIGHTS=1 to opt in)") from e
+                log.exception("no model config at %s; random init of %s "
+                              "(LLM_ALLOW_RANDOM_WEIGHTS=1)",
+                              c.weights_path, c.model)
+                model_cfg = None
+            if model_cfg is not None:
+                params = self._load_params(model_cfg)
         return LLMEngine(ecfg, model_cfg=model_cfg, params=params)
 
     def _load_params(self, model_cfg):
         if not self.cfg.weights_path:
+            self.model_loaded = False  # explicit random-init dev mode
             return None
         from agentic_traffic_testing_tpu.models.weights import load_params
 
@@ -152,9 +167,19 @@ class LLMServer:
             dtype = jnp.bfloat16 if self.cfg.dtype in ("bfloat16", "bf16") else jnp.float32
             _, params = load_params(self.cfg.weights_path, model_cfg, dtype=dtype,
                                     quantization=self.cfg.quantization)
+            self.model_loaded = True
             return params
-        except Exception:
-            log.exception("weight load failed for %s; random init", self.cfg.weights_path)
+        except Exception as e:
+            if not self.cfg.allow_random_weights:
+                # Fail fast: a typo'd LLM_WEIGHTS_PATH serving garbage behind
+                # healthy 200s is the worst failure mode a testbed can have.
+                raise RuntimeError(
+                    f"weight load failed for {self.cfg.weights_path!r}; refusing "
+                    f"to serve randomly initialized weights (set "
+                    f"LLM_ALLOW_RANDOM_WEIGHTS=1 to opt in)") from e
+            log.exception("weight load failed for %s; random init "
+                          "(LLM_ALLOW_RANDOM_WEIGHTS=1)", self.cfg.weights_path)
+            self.model_loaded = False
             return None
 
     # -- helpers ------------------------------------------------------------
