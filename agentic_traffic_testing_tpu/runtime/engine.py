@@ -84,10 +84,12 @@ class EngineConfig:
     # Model steps fused into ONE decode dispatch (lax.scan on device). The
     # sampled token feeds the next step without host involvement, so dispatch
     # round-trip cost is amortized K×. None -> auto: 16 on TPU, 1 elsewhere
-    # (keeps CPU tests step-exact by default). Measured on v5e (1B bf16,
-    # 128-token decode): bs=1 127/152/131/106 tok/s at K=8/16/32/64 (waste
-    # past the stop point grows with K), bs=8 977 vs 942 at K=8 vs 16 —
-    # K=16 is the best joint default for the testbed's bursty low-batch load.
+    # (keeps CPU tests step-exact by default). The budget-aware dispatcher
+    # (_decode_budget_satisfied) makes max_tokens-bounded work waste-free at
+    # any K — r2 measured bs=8 at 1079/1207/1210 tok/s for K=16/32/64 — but
+    # EOS-stopping chat still discards a partial dispatch on stop, so the
+    # auto default stays at the latency-friendlier 16; throughput-oriented
+    # deployments (bench.py) set 32.
     decode_steps: Optional[int] = None
     # Prompts longer than this prefill in fixed chunks (bounded bucket +
     # per-step latency); 0/None disables chunking.
@@ -334,6 +336,13 @@ class LLMEngine:
             # Composition may change: sync up, then let the scheduler decide.
             self._drain_all()
             self._plan_and_dispatch()
+        elif self._decode_budget_satisfied() and self._inflight:
+            # Every running lane's remaining token budget is already covered
+            # by in-flight dispatches: one more dispatch would compute only
+            # tokens the harvester drops. Retire the oldest instead of
+            # pipelining waste (the bench shape: max_tokens=64, K=16,
+            # depth=2 used to run 6 dispatches for 4 dispatches of work).
+            self._apply_inflight(self._inflight.popleft())
         else:
             self._dispatch_decode()
 
@@ -389,22 +398,43 @@ class LLMEngine:
             seq_lens[i] = r.num_prompt_tokens
             steps[i] = r.sampling_step
         self._fill_tables(reqs, tables)
+        tables_dev = jnp.asarray(tables)
         samp = self._sampling_arrays(reqs, b)
         state, self.cache, out = self.runner.prefill(
-            jnp.asarray(tokens), self.cache, jnp.asarray(tables),
+            jnp.asarray(tokens), self.cache, tables_dev,
             jnp.asarray(seq_lens), samp, jnp.asarray(steps),
         )
-        # Prefill readback is synchronous: it IS the first token (TTFT).
-        toks = np.asarray(jax.device_get(out))
-        now = time.monotonic()
-        for i, r in enumerate(reqs):
+        for r in reqs:
             r.num_computed_tokens = r.num_prompt_tokens
             self._register_prefix(r)
-            if r.first_token_time is None:
-                r.first_token_time = now
-            self._append_token(r, int(toks[i]))
-        # The new sequences join decode on the next step() via plan().
-        self._invalidate_decode_state()
+        if getattr(self.runner, "spec_tokens", 0) > 0:
+            # Speculative decode builds its host-side history from the first
+            # token, so the readback stays synchronous here.
+            toks = np.asarray(jax.device_get(out))
+            now = time.monotonic()
+            for i, r in enumerate(reqs):
+                if r.first_token_time is None:
+                    r.first_token_time = now
+                self._append_token(r, int(toks[i]))
+            self._invalidate_decode_state()
+            return
+        # Async prefill -> decode handoff: the prefill program already
+        # returns a ready DecodeState (sampled token, positions, PRNG steps),
+        # so decode dispatches can follow back-to-back without waiting for
+        # the first token's host round trip (~100 ms through the axon tunnel
+        # for a bs=8 batch). The sampled tokens join the harvest pipeline as
+        # a 1-token in-flight entry; TTFT is stamped when they land on host.
+        first = out[:, None]  # [B] -> [B, 1], harvest expects [B, K]
+        try:
+            first.copy_to_host_async()
+        except Exception:
+            pass
+        self._decode_requests = list(reqs)
+        self._decode_state = state
+        self._decode_tables = tables_dev
+        self._decode_samp = samp
+        self._decode_block_counts = [r.blocks.num_blocks for r in reqs]
+        self._inflight.append(_Inflight(first, list(reqs)))
 
     def _register_prefix(self, r: Request) -> None:
         """Index this prompt's full blocks for prefix reuse (no-op unless the
@@ -502,6 +532,35 @@ class LLMEngine:
         self._fill_tables(self._decode_requests, tables)
         self._decode_tables = jnp.asarray(tables)
         self._decode_block_counts = counts
+
+    def _decode_budget_satisfied(self) -> bool:
+        """True when no running decode lane still needs tokens beyond what
+        the in-flight dispatches will already deliver.
+
+        Each in-flight dispatch is guaranteed to emit at least `decode_steps`
+        tokens per live lane (speculative iterations emit >= 1 each), so a
+        lane with `sampling_step + K * inflight` past its max_tokens (or its
+        context past max_model_len) gains nothing from another dispatch.
+        EOS stops are not predictable host-side and are handled as today:
+        harvest notices, and the post-stop tail is dropped."""
+        if not self._decode_requests:
+            return False
+        for r in self._decode_requests:
+            if r.is_finished():
+                continue
+            # tokens.shape[1] = steps per lane in that dispatch: 1 for the
+            # prefill handoff entry, decode_steps for decode (speculative
+            # [B, K, S] entries emit >= K, so K is the guaranteed floor).
+            inflight_toks = sum(
+                int(inf.tokens.shape[1]) for inf in self._inflight
+                if any(rr is r for rr in inf.requests))
+            needed = min(
+                r.sampling.max_tokens - r.sampling_step,
+                self.cfg.max_model_len - r.total_len,
+            )
+            if inflight_toks < needed:
+                return False
+        return True
 
     def _dispatch_decode(self) -> None:
         if self._decode_state is None:

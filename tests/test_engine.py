@@ -122,9 +122,11 @@ def test_preemption_recompute_exact(runner):
         eng = make_engine(runner)
         solos.append(eng.generate(p, greedy(16)).generated_ids)
 
-    # 12 usable blocks * 8 = 96 tokens < two seqs' peak 2*(30+16+4) = 100:
-    # both admit (5 blocks each) but growth must preempt one.
-    eng = make_engine(runner, num_blocks=13)
+    # 11 usable blocks * 8 = 88 tokens < two seqs' peak 2*(30+16) = 92:
+    # both admit (5 blocks each) but growth must preempt one. (The engine
+    # no longer dispatches past a lane's budget, so the old 13-block pool —
+    # sized against wasted-lookahead growth — now fits without preempting.)
+    eng = make_engine(runner, num_blocks=12)
     reqs = [eng.add_request(p1, greedy(16)), eng.add_request(p2, greedy(16))]
     run_all(eng, reqs)
     assert [r.generated_ids for r in reqs] == solos

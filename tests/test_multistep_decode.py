@@ -129,12 +129,41 @@ def test_preemption_with_multistep(params):
     rng = np.random.default_rng(6)
     p1 = rng.integers(0, CFG.vocab_size, 30).tolist()
     p2 = rng.integers(0, CFG.vocab_size, 30).tolist()
-    solos = [oracle(params, p, greedy(16)) for p in (p1, p2)]
+    solos = [oracle(params, p, greedy(32)) for p in (p1, p2)]
     # Tight pool: growth under the larger multi-step lookahead must preempt,
-    # and recompute must reproduce the exact sequences. (13 usable blocks;
-    # both admit at 6, but peak demand is 7+7.)
+    # and recompute must reproduce the exact sequences. (13 usable blocks,
+    # peak demand 2*(30+32)=124 tokens > 104; sized for the budget-aware
+    # dispatcher, which no longer grows lookahead past a lane's max_tokens.)
     eng = make_engine(params, decode_steps=4, num_blocks=14)
-    reqs = [eng.add_request(p1, greedy(16)), eng.add_request(p2, greedy(16))]
+    reqs = [eng.add_request(p1, greedy(32)), eng.add_request(p2, greedy(32))]
     run_all(eng, reqs)
     assert [r.generated_ids for r in reqs] == solos
     assert eng.scheduler.num_preemptions > 0
+
+
+def test_no_wasted_trailing_dispatches(params, monkeypatch):
+    """Once every lane's budget is in flight, the engine drains instead of
+    dispatching: exactly ceil(max_tokens / K) decode dispatches for a
+    fixed-length batch (round-2: 2 of 6 dispatches in the bench shape were
+    computing only dropped tokens)."""
+    k = 4
+    eng = make_engine(params, decode_steps=k)
+    calls = {"decode": 0}
+    orig = eng.runner.decode
+
+    def counting(*a, **kw):
+        calls["decode"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(eng.runner, "decode", counting)
+    max_tokens = 16
+    reqs = [eng.add_request(list(range(2, 12)),
+                            SamplingParams(temperature=0.0,
+                                           max_tokens=max_tokens,
+                                           ignore_eos=True))
+            for _ in range(3)]
+    while eng.has_work() and not all(r.is_finished() for r in reqs):
+        eng.step()
+    assert all(len(r.output_ids) == max_tokens for r in reqs)
+    # prefill samples token 1; decode covers the remaining 15 -> ceil(15/4)=4
+    assert calls["decode"] == -(-(max_tokens - 1) // k)
