@@ -5,7 +5,9 @@ Selected once at trace time (the choice is baked into the jitted decode
 program, like picking a kernel at engine build in the reference's vLLM
 backend). Override with ATT_TPU_ATTENTION:
 
-    auto      (default) dma on TPU, gather on CPU/GPU
+    auto      (default) dma2 on TPU, gather on CPU/GPU
+    dma2      grid-(B,) kernel, each page DMA carries all KV heads (8x fewer
+              descriptors than dma — the decisive cost at short context)
     dma       grid-(B,KH) kernel, double-buffered manual page DMA
     pallas    v1 kernel, one BlockSpec pipeline step per page (slower at
               short context: ~2-3 us grid overhead per 2 KB page)
@@ -32,11 +34,13 @@ from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
 from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
     paged_attention_decode,
     paged_attention_decode_dma,
+    paged_attention_decode_dma2,
 )
 from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
 
 
-VALID_MODES = ("auto", "dma", "pallas", "interpret", "gather", "shard_dma")
+VALID_MODES = ("auto", "dma", "dma2", "pallas", "interpret", "gather",
+               "shard_dma")
 
 
 def backend_choice() -> str:
@@ -48,7 +52,7 @@ def backend_choice() -> str:
             f"ATT_TPU_ATTENTION={mode!r} invalid; choose one of "
             f"{tuple(m for m in VALID_MODES if m != 'shard_dma')}")
     if mode == "auto":
-        return "dma" if jax.default_backend() == "tpu" else "gather"
+        return "dma2" if jax.default_backend() == "tpu" else "gather"
     return mode
 
 
@@ -93,6 +97,12 @@ def paged_decode_attention(
                                     ctx_lens, lay, mesh, axis)
     if mode == "dma":
         out = paged_attention_decode_dma(
+            q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
+            ctx_lens, layer=lay,
+        )
+        return out[:, None] if s == 1 else out
+    if mode == "dma2":
+        out = paged_attention_decode_dma2(
             q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
             ctx_lens, layer=lay,
         )

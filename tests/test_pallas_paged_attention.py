@@ -18,12 +18,14 @@ from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
 from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
     paged_attention_decode,
     paged_attention_decode_dma,
+    paged_attention_decode_dma2,
 )
 from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK, gather_kv
 
 KERNELS = {
     "v1": paged_attention_decode,
     "dma": paged_attention_decode_dma,
+    "dma2": paged_attention_decode_dma2,
 }
 
 
@@ -159,3 +161,32 @@ def test_decode_step_uses_kernel_when_forced(monkeypatch):
     monkeypatch.setenv("ATT_TPU_ATTENTION", "gather")
     want, _ = decode_step_impl(params, cfg, nxt, cache, bt, lens)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-4, rtol=1e-3)
+
+
+@kernel_params
+def test_kernel_multi_query_verify_layout(kernel):
+    """S>1 (speculative verify): query token s sits at ctx-1+s and may
+    attend through its own freshly written slot."""
+    rng = np.random.default_rng(9)
+    b, s, h, kh, hd, bs = 2, 3, 4, 2, 64, 4
+    ctx = [6, 11]  # context of query token 0; slots for s=1,2 already written
+    q = jnp.asarray(rng.standard_normal((b, s, h, hd)), jnp.float32)
+    k_pages = jnp.asarray(rng.standard_normal((kh, 16, bs, hd)), jnp.float32)
+    v_pages = jnp.asarray(rng.standard_normal((kh, 16, bs, hd)), jnp.float32)
+    bt = np.full((b, 8), TRASH_BLOCK, np.int32)
+    nxt = 1
+    for i, ln in enumerate(ctx):
+        n = -(-(ln + s - 1) // bs)
+        bt[i, :n] = np.arange(nxt, nxt + n)
+        nxt += n
+    bt = jnp.asarray(bt)
+    cl = jnp.asarray(ctx, jnp.int32)
+
+    got = kernel(q, k_pages, v_pages, bt, cl, interpret=True)
+    k_all = gather_kv(k_pages, bt)
+    v_all = gather_kv(v_pages, bt)
+    qpos = (cl - 1)[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
+    want = causal_attention(q, k_all, v_all, q_positions=qpos,
+                            kv_valid_len=cl + s - 1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)
