@@ -95,9 +95,41 @@ def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
     )
 
 
+def _qtensor_spec(spec: P, rank: int) -> "QTensor":
+    """Expand a weight's PartitionSpec to its QTensor (q, scale) pair.
+
+    int8 quantization is per-output-channel over the contraction dim
+    (models/quant.py: scale shape = weight shape with dim -2 collapsed to 1),
+    so the scale inherits the weight's spec except that its size-1
+    contraction axis must stay unsharded. Column-parallel weights therefore
+    get tp-sharded scales; row-parallel weights get replicated scales — and
+    the q @ x partials are scaled AFTER the psum-of-partials XLA inserts,
+    which is exact because the per-channel scale is constant across the
+    contraction shards."""
+    from agentic_traffic_testing_tpu.models.quant import QTensor
+
+    full = tuple(spec) + (None,) * (rank - len(spec))
+    return QTensor(q=P(*full), scale=P(*full[:-2], None, full[-1]))
+
+
+def expand_quant_specs(params: Any, specs: Any) -> Any:
+    """Replace specs of QTensor-valued params with per-leaf (q, scale) specs."""
+    from agentic_traffic_testing_tpu.models.quant import QTensor
+
+    def rec(p, s):
+        if isinstance(p, QTensor):
+            return _qtensor_spec(s, p.q.ndim)
+        if isinstance(p, dict):
+            return {k: rec(p[k], s[k]) for k in p}
+        return s
+
+    return rec(params, specs)
+
+
 def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
     validate_tp(cfg, mesh.shape[AXIS_TP])
-    return shard_pytree(params, param_pspecs(cfg), mesh)
+    specs = expand_quant_specs(params, param_pspecs(cfg))
+    return shard_pytree(params, specs, mesh)
 
 
 def shard_kv_cache(cache: KVCache, mesh: Mesh) -> KVCache:

@@ -113,11 +113,6 @@ class LLMServer:
         params = None
         model_cfg = None
         if c.tp_size > 1:
-            if c.quantization:
-                raise NotImplementedError(
-                    "tensor-parallel serving of int8-quantized params is not "
-                    "wired up yet (QTensor leaves need their own PartitionSpecs)"
-                )
             from agentic_traffic_testing_tpu.models.config import resolve_config
             from agentic_traffic_testing_tpu.models.llama import init_params
             from agentic_traffic_testing_tpu.parallel.mesh import single_axis_mesh
@@ -129,7 +124,18 @@ class LLMServer:
             params = self._load_params(model_cfg)
             if params is None:
                 dtype = jnp.bfloat16 if c.dtype in ("bfloat16", "bf16") else jnp.float32
-                params = init_params(model_cfg, jax.random.key(0), dtype=dtype)
+                if c.quantization == "int8":
+                    from agentic_traffic_testing_tpu.models.llama import (
+                        init_params_quantized,
+                    )
+
+                    # int8 x TP: QTensor leaves carry their own (q, scale)
+                    # PartitionSpecs (parallel/sharding.py expand_quant_specs)
+                    # — the combination that fits Llama-3-70B int8 on a
+                    # v5e-8's 8x16 GB HBM (serving/configs/llama-3-70b-tp8).
+                    params = init_params_quantized(model_cfg, 0, dtype=dtype)
+                else:
+                    params = init_params(model_cfg, jax.random.key(0), dtype=dtype)
             runner = TPRunner(
                 model_cfg, params, single_axis_mesh("tp", c.tp_size),
                 decode_steps=ecfg.resolved_decode_steps(jax.devices()[0].platform),
