@@ -114,6 +114,104 @@ function renderEvents(state) {
      ${escapeHtml(truncate(JSON.stringify(e), 200))}</div>`).join("");
 }
 
+/* Swim-lane SVG of every request the run made (parity: reference
+ * renderers.js renderLlmRequestsGraph): three actor lanes — Agent A,
+ * Agent B workers, LLM backend — time flowing downward, one row per
+ * orchestrator LLM call (A→LLM, labeled by stage) or worker execution
+ * (A→B→LLM→back, labeled by expert). Tooltips carry latency/tokens. */
+function renderFlowGraph(state) {
+  const el = $("flow");
+  if (!el) return;
+  const rows = [];
+  for (let i = state.events.length - 1; i >= 0; i--) {   // chronological
+    const e = state.events[i];
+    if (e.event === "llm_request" || e.event === "llm_error") {
+      rows.push({ kind: "llm", at: e.at, label: e.stage ?? "call",
+                  err: e.event === "llm_error" || !!e.error,
+                  tip: `${e.stage ?? "call"} · iter ${e.iteration ?? "?"} · ` +
+                       `${fmtMs(e.latency_ms)} · ${fmtNum(e.prompt_tokens)}p/` +
+                       `${fmtNum(e.completion_tokens)}c tok` });
+    } else if (e.event === "execution_result") {
+      rows.push({ kind: "worker", at: e.at, label: e.expert ?? "worker",
+                  err: e.ok === false,
+                  tip: `exec · iter ${e.iteration ?? "?"} · ` +
+                       `${e.expert ?? "worker"}` });
+    }
+  }
+  if (!rows.length) {
+    el.innerHTML = '<div class="muted">no requests yet</div>';
+    return;
+  }
+  // Bounded like renderEvents' 120-entry cap: this repaints per event, and
+  // an unbounded SVG rebuild would be O(run length) DOM work each time.
+  const MAX_FLOW_ROWS = 100;
+  const dropped = rows.length - MAX_FLOW_ROWS;
+  if (dropped > 0) rows.splice(0, dropped);
+  const laneX = { a: 70, b: 230, llm: 390 };
+  const width = 460, rowH = 30, top = 34;
+  const height = top + rows.length * rowH + 16;
+  const parts = [`<svg viewBox="0 0 ${width} ${height}" class="flow-svg"
+    preserveAspectRatio="xMidYMin meet">`];
+  for (const [key, name] of [["a", "Agent A"], ["b", "Agent B"], ["llm", "LLM backend"]]) {
+    parts.push(`<line class="lane" x1="${laneX[key]}" y1="${top - 8}"
+      x2="${laneX[key]}" y2="${height - 10}"></line>
+      <text class="lane-label" x="${laneX[key]}" y="16"
+        text-anchor="middle">${name}</text>`);
+  }
+  rows.forEach((r, idx) => {
+    const y = top + idx * rowH + rowH / 2;
+    const cls = r.err ? "flow-err" : "flow-ok";
+    const tip = `<title>${escapeHtml(`${r.at} — ${r.tip}`)}</title>`;
+    if (r.kind === "llm") {
+      parts.push(`<g class="${cls}">${tip}
+        <line class="edge" x1="${laneX.a}" y1="${y}" x2="${laneX.llm}" y2="${y}"
+          marker-end="url(#arrow)"></line>
+        <circle cx="${laneX.llm}" cy="${y}" r="5"></circle>
+        <text class="edge-label" x="${(laneX.a + laneX.llm) / 2}" y="${y - 5}"
+          text-anchor="middle">${escapeHtml(truncate(r.label, 24))}</text></g>`);
+    } else {
+      parts.push(`<g class="${cls}">${tip}
+        <line class="edge" x1="${laneX.a}" y1="${y}" x2="${laneX.b}" y2="${y}"
+          marker-end="url(#arrow)"></line>
+        <line class="edge dashed" x1="${laneX.b}" y1="${y}" x2="${laneX.llm}" y2="${y}"></line>
+        <line class="edge dashed" x1="${laneX.b}" y1="${y + 8}" x2="${laneX.a}" y2="${y + 8}"></line>
+        <circle cx="${laneX.b}" cy="${y}" r="5"></circle>
+        <text class="edge-label" x="${(laneX.a + laneX.b) / 2}" y="${y - 5}"
+          text-anchor="middle">${escapeHtml(truncate(r.label, 18))}</text></g>`);
+    }
+  });
+  parts.push(`<defs><marker id="arrow" markerWidth="8" markerHeight="8"
+    refX="7" refY="3" orient="auto"><path d="M0,0 L7,3 L0,6 z"></path>
+    </marker></defs></svg>`);
+  el.innerHTML = parts.join("");
+}
+
+/* Score progression across iterations (parity: reference renderers.js
+ * renderIterationHistory): one bar per iteration colored by the success
+ * threshold bands, with the score delta vs the previous iteration. */
+function renderHistory(state) {
+  const el = $("history");
+  if (!el) return;
+  if (!state.scores.length) {
+    el.innerHTML = '<div class="muted">no evaluations yet</div>';
+    return;
+  }
+  const sorted = [...state.scores].sort((a, b) => a.iteration - b.iteration);
+  const bars = sorted.map((s, i) => {
+    const band = s.score >= 70 ? "good" : s.score >= 40 ? "mid" : "bad";
+    const prev = i > 0 ? sorted[i - 1].score : null;
+    const delta = prev == null ? "" : (s.score >= prev ? "▲" : "▼") +
+      Math.abs(Math.round(s.score - prev));
+    return `<div class="hist-col" title="iteration ${s.iteration}: ${s.score}/100">
+      <div class="hist-delta ${s.score >= (prev ?? s.score) ? "up" : "down"}">${delta}</div>
+      <div class="hist-bar ${band}" style="height:${Math.max(4, s.score)}px"></div>
+      <div class="hist-score">${Math.round(s.score)}</div>
+      <div class="hist-iter">it ${s.iteration}</div>
+    </div>`;
+  });
+  el.innerHTML = `<div class="hist-row">${bars.join("")}</div>`;
+}
+
 function renderFinal(state) {
   if (state.error) {
     $("final").textContent = `workflow error: ${state.error}`;
@@ -130,6 +228,8 @@ function renderAll(state) {
   renderDiscussion(state);
   renderCalls(state);
   renderTotals(state);
+  renderFlowGraph(state);
+  renderHistory(state);
   renderEvents(state);
   renderFinal(state);
 }
@@ -140,12 +240,12 @@ const EVENT_PANELS = {
   iteration_start: [renderIterations, renderStages],
   iteration_complete: [renderIterations],
   stage_start: [renderStages],
-  stage_complete: [renderStages, renderIterations],
+  stage_complete: [renderStages, renderIterations, renderHistory],
   discussion_round: [renderDiscussion],
   vertical_iteration: [renderDiscussion],
-  execution_result: [renderDiscussion],
-  llm_request: [renderCalls, renderTotals],
-  llm_error: [renderCalls, renderTotals],
+  execution_result: [renderDiscussion, renderFlowGraph],
+  llm_request: [renderCalls, renderTotals, renderFlowGraph],
+  llm_error: [renderCalls, renderTotals, renderFlowGraph],
 };
 
 function renderFor(state, eventName) {
