@@ -11,9 +11,14 @@ the all-to-alls that move token slices between expert shards over ICI.
 Capacity semantics (standard GShard/Switch): each expert processes at most
 C = ceil(k·T/E · capacity_factor) token-slots per batch row; assignments
 past that are dropped (the token keeps its other experts' contributions).
-Gate weights are the top-k softmax probabilities renormalized over the
-selected experts, matching HF Mixtral numerics (golden test:
-tests/test_moe.py vs MixtralForCausalLM).
+This DIFFERS from HF Mixtral, which has no capacity limit and drops
+nothing: under imbalanced routing with the default capacity_factor, prefill
+outputs can deviate from a Mixtral checkpoint's. Setting
+capacity_factor >= num_experts makes dropping impossible and reproduces HF
+numerics exactly (golden test: tests/test_moe.py vs MixtralForCausalLM at
+cf=E; serving override: LLM_MOE_CAPACITY_FACTOR). Gate weights are the
+top-k softmax probabilities renormalized over the selected experts, as in
+Mixtral.
 
 The reference testbed serves dense Llama only (SURVEY.md §2.3: "Expert
 parallel (EP/MoE): No"); this extends the rebuild's model families beyond

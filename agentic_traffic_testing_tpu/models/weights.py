@@ -77,9 +77,21 @@ def _hf_tensor_plan(cfg: ModelConfig) -> dict[str, tuple]:
         plan[p + "self_attn.k_proj.weight"] = (("layers", "wk"), i, True)
         plan[p + "self_attn.v_proj.weight"] = (("layers", "wv"), i, True)
         plan[p + "self_attn.o_proj.weight"] = (("layers", "wo"), i, True)
-        plan[p + "mlp.gate_proj.weight"] = (("layers", "w_gate"), i, True)
-        plan[p + "mlp.up_proj.weight"] = (("layers", "w_up"), i, True)
-        plan[p + "mlp.down_proj.weight"] = (("layers", "w_down"), i, True)
+        if cfg.num_experts:
+            # Mixtral MoE schema: router gate + per-expert SwiGLU (HF names
+            # w1/w3/w2 = gate/up/down). Index is (layer, expert) for the
+            # stacked [L, E, ...] buffers.
+            plan[p + "block_sparse_moe.gate.weight"] = (
+                ("layers", "w_router"), i, True)
+            for e in range(cfg.num_experts):
+                ep = p + f"block_sparse_moe.experts.{e}."
+                plan[ep + "w1.weight"] = (("layers", "w_gate"), (i, e), True)
+                plan[ep + "w3.weight"] = (("layers", "w_up"), (i, e), True)
+                plan[ep + "w2.weight"] = (("layers", "w_down"), (i, e), True)
+        else:
+            plan[p + "mlp.gate_proj.weight"] = (("layers", "w_gate"), i, True)
+            plan[p + "mlp.up_proj.weight"] = (("layers", "w_up"), i, True)
+            plan[p + "mlp.down_proj.weight"] = (("layers", "w_down"), i, True)
         if cfg.qkv_bias:
             plan[p + "self_attn.q_proj.bias"] = (("layers", "bq"), i, False)
             plan[p + "self_attn.k_proj.bias"] = (("layers", "bk"), i, False)
@@ -98,10 +110,17 @@ def _alloc_stacked(cfg: ModelConfig, dtype) -> dict:
         "wk": np.empty((L, d, kh * hd), dtype),
         "wv": np.empty((L, d, kh * hd), dtype),
         "wo": np.empty((L, h * hd, d), dtype),
-        "w_gate": np.empty((L, d, f), dtype),
-        "w_up": np.empty((L, d, f), dtype),
-        "w_down": np.empty((L, f, d), dtype),
     }
+    if cfg.num_experts:
+        e = cfg.num_experts
+        layers["w_router"] = np.empty((L, d, e), dtype)
+        layers["w_gate"] = np.empty((L, e, d, f), dtype)
+        layers["w_up"] = np.empty((L, e, d, f), dtype)
+        layers["w_down"] = np.empty((L, e, f, d), dtype)
+    else:
+        layers["w_gate"] = np.empty((L, d, f), dtype)
+        layers["w_up"] = np.empty((L, d, f), dtype)
+        layers["w_down"] = np.empty((L, f, d), dtype)
     if cfg.qkv_bias:
         layers["bq"] = np.empty((L, h * hd), dtype)
         layers["bk"] = np.empty((L, kh * hd), dtype)
@@ -125,6 +144,8 @@ def _fill(params: dict, plan: dict, name: str, arr: np.ndarray, dtype) -> bool:
         tgt = tgt[k]
     if layer is None:
         tgt[dest[-1]][...] = a.astype(dtype)
+    elif isinstance(layer, tuple):  # (layer, expert) for stacked MoE buffers
+        tgt[dest[-1]][layer[0], layer[1]] = a.astype(dtype)
     else:
         tgt[dest[-1]][layer] = a.astype(dtype)
     return True

@@ -90,8 +90,8 @@ def make_pp_pipeline(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
 
         def run_stage(x):
             def body(x, lp):
-                # MoE aux terms are dropped in the pipelined step for now
-                # (pipelined MoE training would bank them like activations).
+                # aux is always 0 here: MoE configs are rejected at
+                # make_pp_train_step entry (aux banking is unimplemented).
                 y, _aux = decoder_layer(x, lp, cfg, sin, cos, positions,
                                         seq_lens)
                 return y, None
@@ -144,6 +144,16 @@ def make_pp_train_step(
     Requires cfg.num_layers % pp == 0 and batch % num_microbatches == 0."""
     from agentic_traffic_testing_tpu.parallel.mesh import AXIS_TP
     from agentic_traffic_testing_tpu.training.train import causal_lm_loss
+
+    if cfg.num_experts:
+        # The GPipe schedule banks only activations between stages; MoE's
+        # per-layer aux losses would be silently dropped (no load balancing
+        # -> expert collapse). Refuse rather than mistrain; the plain
+        # (dp, sp, tp) step trains MoE with the aux term.
+        raise NotImplementedError(
+            "pipelined MoE training is not supported: the pipeline step "
+            "does not bank per-layer load-balance aux losses — use "
+            "make_train_step (dp/sp/tp) for MoE configs")
 
     pp = mesh.shape[AXIS_PP]
     validate_tp(cfg, mesh.shape[AXIS_TP])  # same guard as the plain path

@@ -101,6 +101,10 @@ class EngineConfig:
     # Weight-only quantization: None (serve in `dtype`) or "int8"
     # (models/quant.py — halves weight HBM so Llama-3-8B fits one v5e chip).
     quantization: Optional[str] = None
+    # MoE expert-capacity override (None -> model default). HF Mixtral drops
+    # no tokens; >= num_experts guarantees no capacity drops (exact HF
+    # numerics) at the cost of E-fold larger expert buffers (models/moe.py).
+    moe_capacity_factor: Optional[float] = None
     # None = auto (C++ native/ core if it builds, Python otherwise);
     # True/False force one implementation.
     native_allocator: Optional[bool] = None
@@ -124,6 +128,11 @@ class EngineConfig:
                 f"unknown speculation {self.speculation!r}; supported: ngram")
         if self.speculation and self.spec_tokens < 1:
             raise ValueError("spec_tokens must be >= 1 when speculation is on")
+        if self.moe_capacity_factor is not None and self.moe_capacity_factor <= 0:
+            # 0 would clamp every expert to one slot -> near-total token
+            # dropping served behind healthy 200s.
+            raise ValueError(
+                f"moe_capacity_factor must be > 0, got {self.moe_capacity_factor}")
 
     @property
     def effective_spec_tokens(self) -> int:
@@ -187,6 +196,20 @@ class LLMEngine:
     ) -> None:
         self.cfg = cfg
         self.model_cfg = model_cfg or resolve_config(cfg.model)
+        if (cfg.moe_capacity_factor is not None and self.model_cfg.num_experts
+                and self.model_cfg.moe_capacity_factor != cfg.moe_capacity_factor):
+            # Applied here (the one model-cfg resolution point) so every
+            # construction path — server, bench, tests — honors the knob.
+            # A caller-supplied runner compiled its programs from its own
+            # cfg, so the override must already match it (the server's TP
+            # branch applies it before building the runner).
+            self.model_cfg = dataclasses.replace(
+                self.model_cfg, moe_capacity_factor=cfg.moe_capacity_factor)
+            if runner is not None and runner.cfg.moe_capacity_factor != (
+                    cfg.moe_capacity_factor):
+                raise ValueError(
+                    "moe_capacity_factor override conflicts with the supplied "
+                    "runner's model config — apply it before building the runner")
         if cfg.quantization and self.model_cfg.num_experts:
             raise NotImplementedError(
                 "int8 quantization is not wired up for MoE configs yet")

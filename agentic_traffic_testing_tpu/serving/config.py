@@ -54,6 +54,11 @@ class ServerConfig:
     # serving a randomly initialized model behind 200s (a typo'd
     # LLM_WEIGHTS_PATH) must be an explicit opt-in, not a fallback.
     allow_random_weights: bool = False         # LLM_ALLOW_RANDOM_WEIGHTS
+    # MoE expert capacity factor override (None -> model default). HF
+    # Mixtral drops no tokens; set >= num_experts to guarantee no capacity
+    # drops at inference (exact HF numerics) at the cost of E-fold larger
+    # expert buffers — see models/moe.py capacity semantics.
+    moe_capacity_factor: Optional[float] = None  # LLM_MOE_CAPACITY_FACTOR
     speculation: Optional[str] = None          # LLM_SPECULATION ("ngram" | unset)
     spec_tokens: int = 3                       # LLM_SPEC_TOKENS (drafts/step)
     spec_ngram: int = 3                        # LLM_SPEC_NGRAM (match length)
@@ -95,6 +100,12 @@ class ServerConfig:
         c.block_size = int(os.environ.get("LLM_BLOCK_SIZE") or c.block_size)
         c.weights_path = os.environ.get("LLM_WEIGHTS_PATH") or None
         c.allow_random_weights = _env_bool("LLM_ALLOW_RANDOM_WEIGHTS", "0")
+        mcf = os.environ.get("LLM_MOE_CAPACITY_FACTOR")
+        c.moe_capacity_factor = float(mcf) if mcf else None
+        if c.moe_capacity_factor is not None and c.moe_capacity_factor <= 0:
+            raise ValueError(
+                f"LLM_MOE_CAPACITY_FACTOR must be > 0, got {mcf!r} "
+                f"(unset it to use the model default)")
         c.speculation = os.environ.get("LLM_SPECULATION") or None
         c.spec_tokens = int(os.environ.get("LLM_SPEC_TOKENS") or c.spec_tokens)
         c.spec_ngram = int(os.environ.get("LLM_SPEC_NGRAM") or c.spec_ngram)

@@ -106,6 +106,7 @@ class LLMServer:
             decode_steps=c.decode_steps, quantization=c.quantization,
             prefill_chunk_tokens=c.prefill_chunk_tokens,
             prefix_caching=c.prefix_caching,
+            moe_capacity_factor=c.moe_capacity_factor,
             speculation=c.speculation, spec_tokens=c.spec_tokens,
             spec_ngram=c.spec_ngram,
         )
@@ -113,6 +114,8 @@ class LLMServer:
         params = None
         model_cfg = None
         if c.tp_size > 1:
+            import dataclasses
+
             from agentic_traffic_testing_tpu.models.config import resolve_config
             from agentic_traffic_testing_tpu.models.llama import init_params
             from agentic_traffic_testing_tpu.parallel.mesh import single_axis_mesh
@@ -121,6 +124,11 @@ class LLMServer:
             import jax.numpy as jnp
 
             model_cfg = resolve_config(c.model)
+            if c.moe_capacity_factor is not None and model_cfg.num_experts:
+                # Before TPRunner construction: the runner compiles its step
+                # programs from this cfg (LLMEngine re-applies idempotently).
+                model_cfg = dataclasses.replace(
+                    model_cfg, moe_capacity_factor=c.moe_capacity_factor)
             params = self._load_params(model_cfg)
             if params is None:
                 dtype = jnp.bfloat16 if c.dtype in ("bfloat16", "bf16") else jnp.float32

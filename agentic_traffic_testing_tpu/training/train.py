@@ -59,6 +59,7 @@ def make_train_step(
     mesh: Mesh,
     optimizer: Optional[optax.GradientTransformation] = None,
     remat: bool = True,
+    moe_aux_coeff: float = 0.01,
 ) -> TrainStep:
     """Build the jitted train step for `cfg` over `mesh`.
 
@@ -67,6 +68,11 @@ def make_train_step(
     shard_map; tp shards heads inside the same shard_map. `remat`
     checkpoints the layer scan body — the standard HBM-for-FLOPs trade on
     TPU for long sequences.
+
+    MoE configs (cfg.num_experts > 0) add the Switch load-balance aux term
+    to the objective: loss = lm_loss + moe_aux_coeff * Σ_layers aux (the
+    standard λ=0.01 default; 0 disables). Without it the router collapses
+    onto a few experts.
     """
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
     sp = mesh.shape[AXIS_SP]
@@ -81,16 +87,22 @@ def make_train_step(
             # the full T by construction there.
             return ring(q, k, v)
 
+    with_aux = bool(cfg.num_experts) and moe_aux_coeff != 0.0
+
     def loss_fn(params, tokens, mask):
-        fwd = forward_full_impl
         if remat:
             fwd = jax.checkpoint(
-                partial(forward_full_impl, attn_fn=attn_fn), static_argnums=(1,)
+                partial(forward_full_impl, attn_fn=attn_fn, with_aux=with_aux),
+                static_argnums=(1,),
             )
-            logits = fwd(params, cfg, tokens)
+            out = fwd(params, cfg, tokens)
         else:
-            logits = fwd(params, cfg, tokens, attn_fn=attn_fn)
-        return causal_lm_loss(logits, tokens, mask)
+            out = forward_full_impl(params, cfg, tokens, attn_fn=attn_fn,
+                                    with_aux=with_aux)
+        if with_aux:
+            logits, aux = out
+            return causal_lm_loss(logits, tokens, mask) + moe_aux_coeff * aux
+        return causal_lm_loss(out, tokens, mask)
 
     batch_sharding = NamedSharding(mesh, P(AXIS_DP, AXIS_SP))
 

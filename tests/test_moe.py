@@ -1,0 +1,169 @@
+"""MoE (models/moe.py) correctness: HF Mixtral golden logits, dense-oracle
+equivalence, capacity-drop semantics, and the training aux-loss wiring.
+
+The reference serves dense Llama only (SURVEY.md §2.3), so the oracle here
+is transformers' MixtralForCausalLM instantiated locally (no hub access) —
+the same golden pattern as tests/test_model_golden.py. Capacity note: HF
+Mixtral never drops tokens; our GShard-style capacity can. At
+capacity_factor >= num_experts dropping is impossible, so logits must match
+HF exactly; the drop path is pinned separately.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.models.config import PRESETS, ModelConfig
+from agentic_traffic_testing_tpu.models.llama import forward_full, init_params
+from agentic_traffic_testing_tpu.models.moe import expert_capacity, moe_mlp
+from agentic_traffic_testing_tpu.models.weights import params_from_hf_state_dict
+
+MOE_CFG = PRESETS["tiny-moe"]
+
+
+def _mixtral_pair(seed=0, cf=None):
+    """(our cfg, our params, hf model) from one tiny random Mixtral."""
+    import torch
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    torch.manual_seed(seed)
+    hf_cfg = MixtralConfig(
+        vocab_size=96, hidden_size=48, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2, rope_theta=10000.0,
+        rms_norm_eps=1e-5, max_position_embeddings=128,
+        tie_word_embeddings=False,
+    )
+    model = MixtralForCausalLM(hf_cfg).eval()
+    cfg = ModelConfig.from_hf_config(hf_cfg.to_dict(), name="tiny-mixtral")
+    if cf is not None:
+        cfg = dataclasses.replace(cfg, moe_capacity_factor=cf)
+    sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+    params = params_from_hf_state_dict(cfg, sd, dtype=np.float32)
+    return cfg, params, model
+
+
+def test_mixtral_golden_logits_no_drop():
+    """cf = E makes capacity dropping impossible -> exact HF numerics."""
+    import torch
+
+    cfg, params, model = _mixtral_pair(cf=4.0)
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, (2, 12))
+    ours = forward_full(params, cfg, jnp.asarray(tokens, jnp.int32))
+    with torch.no_grad():
+        theirs = model(torch.tensor(tokens)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours, np.float32), theirs,
+                               atol=3e-4, rtol=2e-3)
+
+
+def test_moe_mlp_matches_dense_oracle():
+    """moe_mlp's einsum dispatch/combine == explicit per-token top-k SwiGLU
+    (no drops at cf=E)."""
+    cfg = dataclasses.replace(MOE_CFG, moe_capacity_factor=float(MOE_CFG.num_experts))
+    params = init_params(cfg, jax.random.key(1), dtype=jnp.float32)
+    lp = {k: v[0] for k, v in params["layers"].items()
+          if k in ("w_router", "w_gate", "w_up", "w_down")}
+    rng = np.random.default_rng(2)
+    x = jnp.asarray(rng.standard_normal((2, 6, cfg.hidden_size)), jnp.float32)
+
+    y, aux = moe_mlp(x, lp, cfg)
+
+    # Oracle: loop tokens in numpy/jnp, no dispatch tensors.
+    logits = np.einsum("btd,de->bte", np.asarray(x, np.float64),
+                       np.asarray(lp["w_router"], np.float64))
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    want = np.zeros_like(np.asarray(x, np.float64))
+    for b in range(x.shape[0]):
+        for t in range(x.shape[1]):
+            topk = np.argsort(-probs[b, t])[: cfg.num_experts_per_tok]
+            gates = probs[b, t, topk] / probs[b, t, topk].sum()
+            for g, e in zip(gates, topk):
+                xe = np.asarray(x, np.float64)[b, t]
+                gate = xe @ np.asarray(lp["w_gate"], np.float64)[e]
+                up = xe @ np.asarray(lp["w_up"], np.float64)[e]
+                act = gate / (1 + np.exp(-gate)) * up
+                want[b, t] += g * (act @ np.asarray(lp["w_down"], np.float64)[e])
+    np.testing.assert_allclose(np.asarray(y, np.float64), want,
+                               atol=1e-4, rtol=1e-3)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_capacity_drops_assignments():
+    """cf small enough forces drops: output differs from the no-drop run,
+    and the dropped token keeps its other experts' contributions (finite,
+    not zeroed)."""
+    cfg_full = dataclasses.replace(MOE_CFG, moe_capacity_factor=float(MOE_CFG.num_experts))
+    cfg_tight = dataclasses.replace(MOE_CFG, moe_capacity_factor=0.25)
+    assert expert_capacity(8, cfg_tight) < expert_capacity(8, cfg_full)
+    params = init_params(MOE_CFG, jax.random.key(3), dtype=jnp.float32)
+    lp = {k: v[0] for k, v in params["layers"].items()
+          if k in ("w_router", "w_gate", "w_up", "w_down")}
+    x = jnp.asarray(np.random.default_rng(4).standard_normal((1, 8, MOE_CFG.hidden_size)),
+                    jnp.float32)
+    y_full, _ = moe_mlp(x, lp, cfg_full)
+    y_tight, _ = moe_mlp(x, lp, cfg_tight)
+    assert np.isfinite(np.asarray(y_tight)).all()
+    assert not np.allclose(np.asarray(y_full), np.asarray(y_tight))
+
+
+def test_train_step_includes_aux_loss():
+    """ADVICE r1: the Switch aux term must actually reach the objective.
+    With optax.sgd(0) the reported loss is pure objective: it must equal
+    lm_loss + coeff * aux and move with the coefficient."""
+    import optax
+
+    from agentic_traffic_testing_tpu.models.llama import forward_full_impl
+    from agentic_traffic_testing_tpu.parallel.mesh import make_mesh
+    from agentic_traffic_testing_tpu.training.train import (
+        causal_lm_loss,
+        init_train_state,
+        make_train_step,
+    )
+
+    cfg = MOE_CFG
+    mesh = make_mesh(1, 1, 1, devices=jax.devices()[:1])
+    opt = optax.sgd(0.0)
+    rng = np.random.default_rng(5)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (2, 16)), jnp.int32)
+    mask = jnp.ones((2, 16), jnp.float32)
+
+    params, opt_state = init_train_state(cfg, mesh, opt, seed=7)
+    logits, aux = forward_full_impl(params, cfg, tokens, with_aux=True)
+    lm = float(causal_lm_loss(logits, tokens, mask))
+    aux = float(aux)
+    assert aux > 0
+
+    for coeff in (0.0, 0.01, 0.1):
+        p, o = init_train_state(cfg, mesh, opt, seed=7)
+        ts = make_train_step(cfg, mesh, opt, remat=False, moe_aux_coeff=coeff)
+        _, _, loss = ts(p, o, tokens, mask)
+        np.testing.assert_allclose(float(loss), lm + coeff * aux, rtol=1e-5)
+
+
+def test_pipeline_rejects_moe():
+    """GPipe banks only activations; MoE must be refused, not mistrained."""
+    import optax
+
+    from agentic_traffic_testing_tpu.parallel.mesh import make_mesh
+    from agentic_traffic_testing_tpu.parallel.pipeline import make_pp_train_step
+
+    with pytest.raises(NotImplementedError, match="aux"):
+        make_pp_train_step(MOE_CFG, make_mesh(1, 1, 1, pp=2), optax.sgd(0.0))
+
+
+def test_engine_capacity_override_and_validation():
+    """The capacity knob rides EngineConfig, so every construction path —
+    server, bench, direct — honors it; <= 0 is rejected at config time."""
+    from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+
+    eng = LLMEngine(EngineConfig(model="tiny-moe", dtype="float32",
+                                 num_blocks=32, moe_capacity_factor=4.0))
+    assert eng.model_cfg.moe_capacity_factor == 4.0
+    with pytest.raises(ValueError, match="moe_capacity_factor"):
+        EngineConfig(model="tiny-moe", moe_capacity_factor=0.0)
