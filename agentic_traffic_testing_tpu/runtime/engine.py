@@ -257,12 +257,21 @@ class LLMEngine:
             cfg.scheduler_config(decode_steps * (1 + spec)), self.allocator)
         # Fixed block-table width: worst-case blocks for max_model_len.
         self.table_width = -(-cfg.max_model_len // cfg.block_size)
-        # Chunked prefill attends over a bucketed prior-page width, not the
-        # full table, so early chunks of a long prompt don't pay attention
-        # over max_model_len worth of slots (pow2 ladder -> bounded compiles).
+        # Chunked prefill gathers prior KV over the table width it is given
+        # (prefill_chunk_impl), so a width ladder lets short chunks avoid
+        # attending over max_model_len worth of slots. On TPU we accept one
+        # full-width variant instead: the gather costs a bounded extra HBM
+        # read per chunk (~0.3 ms/chunk at 2048 ctx for a 1B model —
+        # context, not width, dominates once fused), and collapsing the
+        # ladder cuts compile variants 6x, which is what ends the cold-
+        # compile stalls under prefix-cached traffic (docs/BENCHMARKS.md r2
+        # spec x prefix investigation). Off-TPU keeps the ladder: CPU test
+        # models compile in seconds and the gather there is the whole cost.
         from agentic_traffic_testing_tpu.runtime.scheduler import pow2_buckets
 
-        self._chunk_width_buckets = pow2_buckets(4, self.table_width)
+        self._chunk_width_buckets = (
+            [self.table_width] if platform == "tpu"
+            else pow2_buckets(4, self.table_width))
 
         self._inflight: deque[_Inflight] = deque()
         self._decode_requests: list[Request] = []   # composition of device state
@@ -312,6 +321,71 @@ class LLMEngine:
         # Never exceed what max_num_seqs * max_model_len can actually use.
         cap = self.cfg.max_num_seqs * self.table_width + 1
         return max(2, min(n, cap))
+
+    def warmup_decode_buckets(self) -> int:
+        """Precompile the decode program for every batch bucket.
+
+        Staggered arrivals walk the engine through small-batch buckets
+        (1, 2, 4, ...) before reaching steady state; each cold bucket is a
+        10-20 s XLA compile that BLOCKS the step loop mid-traffic (observed:
+        a 5-way cache-hit fan-out crawling at 0.6 tok/s for 62 s while
+        buckets compiled — docs/BENCHMARKS.md r2 A/B). Dummy lanes point at
+        the trash block, so the KV writes land in the slot reserved for
+        exactly this. Returns the number of programs compiled."""
+        from agentic_traffic_testing_tpu.runtime.scheduler import pow2_buckets
+
+        spec = getattr(self.runner, "spec_tokens", 0)
+        n = 0
+        for b in pow2_buckets(1, self.cfg.max_num_seqs):
+            tables = jnp.full((b, self.table_width), TRASH_BLOCK, jnp.int32)
+            tokens = jnp.zeros((b,), jnp.int32)
+            positions = jnp.zeros((b,), jnp.int32)
+            steps = jnp.zeros((b,), jnp.int32)
+            if spec > 0:
+                hist = jnp.zeros(
+                    (b, self.table_width * self.cfg.block_size), jnp.int32)
+                state = SpecDecodeState(tokens=tokens, positions=positions,
+                                        steps=steps, history=hist)
+            else:
+                state = DecodeState(tokens=tokens, positions=positions,
+                                    steps=steps)
+            samp = self._sampling_arrays([], b)
+            result = self.runner.decode(self.cache, tables, state, samp)
+            # decode donates the cache: keep the returned one (dummy writes
+            # went to the trash block; real pages are untouched).
+            self.cache = result[1]
+            jax.block_until_ready(result[2])
+            n += 1
+        return n
+
+    def warmup_chunk_buckets(self) -> int:
+        """Precompile the chunked-prefill program for every (chunk, width)
+        bucket combination the live path can emit.
+
+        Prefix-cached requests prefill only their suffix through the chunk
+        path, and the suffix length walks the bucket ladder as prompts vary
+        — each cold bucket is a ~15-20 s compile serialized against live
+        decode (the r2 spec x prefix fan-out stall's second half). Chunk
+        lengths come from the scheduler's chunk_ladder() (the exact compiled
+        set: _next_chunk splits chunks rather than emitting off-ladder
+        lengths); widths are this engine's _chunk_width_buckets (one on TPU,
+        the pow2 ladder off-TPU). Only worth the startup time when prefix
+        caching (or very long prompts) will actually route traffic here."""
+        n = 0
+        for c in self.scheduler.cfg.chunk_ladder():
+            for width in self._chunk_width_buckets:
+                if width * self.cfg.block_size < c:
+                    continue  # live path never attends narrower than a chunk
+                tokens = jnp.zeros((1, c), jnp.int32)
+                tables = jnp.full((1, width), TRASH_BLOCK, jnp.int32)
+                samp = self._sampling_arrays([], 1)
+                self.cache, out = self.runner.prefill_chunk(
+                    tokens, self.cache, tables, jnp.int32(0), jnp.int32(1),
+                    samp, jnp.zeros((1,), jnp.int32),
+                )
+                jax.block_until_ready(out)
+                n += 1
+        return n
 
     # -- request API -------------------------------------------------------
 

@@ -116,6 +116,18 @@ class SchedulerConfig:
         ]
         self.batch_buckets = pow2_buckets(1, self.max_num_seqs)
 
+    def chunk_ladder(self) -> list[int]:
+        """The complete set of compiled chunk lengths (block-aligned,
+        capped at the chunk size). _next_chunk only ever emits these —
+        splitting a chunk rather than clamping off-ladder — so a warmup
+        pass over this list covers every chunk program (engine.py
+        warmup_chunk_buckets)."""
+        bs = self.block_size
+        cap = self.prefill_chunk_tokens or self.max_model_len
+        rungs = {min(-(-b // bs) * bs, cap) for b in self.prefill_buckets}
+        rungs.add(bs)  # the end-of-table fallback floor
+        return sorted(rungs)
+
 
 class Scheduler:
     """Owns the waiting queue, the running set, and block allocation."""
@@ -214,20 +226,29 @@ class Scheduler:
         remaining = req.num_prompt_tokens - start
         c = self.cfg.prefill_chunk_tokens
         real = remaining if c is None else min(c, remaining)
-        # Bucket the compiled chunk length (a cache-hit suffix is usually far
-        # shorter than the full chunk size), block-aligned, and clamped so
-        # chunk_start + padded never exceeds the block table — the padded
-        # tail's page writes would otherwise clamp onto the last real block
-        # and destroy its KV.
+        # Pick the compiled chunk length from the block-aligned ladder (a
+        # cache-hit suffix is usually far shorter than the full chunk size).
+        # chunk_start + padded must never exceed the block table — the
+        # padded tail's page writes would otherwise clamp onto the last real
+        # block and destroy its KV. Near the table end we SPLIT the chunk
+        # onto a smaller rung instead of clamping to an off-ladder length
+        # (every off-ladder shape is a fresh 10-20 s XLA compile serialized
+        # against live traffic; the warmup pass compiles exactly
+        # cfg.chunk_ladder()). The remainder continues next plan().
         bs = self.cfg.block_size
         table_tokens = -(-self.cfg.max_model_len // bs) * bs
-        padded = bucket_up(real, self.cfg.prefill_buckets)
-        padded = -(-padded // bs) * bs
-        if c is not None:
-            padded = min(padded, c)
-        padded = min(padded, table_tokens - start)
+        ladder = self.cfg.chunk_ladder()
+        room = table_tokens - start
+        padded = next((a for a in ladder if a >= real), ladder[-1])
+        if padded > room:
+            fits = [a for a in ladder if a <= room]
+            # room >= remaining >= 1 and the ladder floor is block_size, so
+            # fits is empty only when room < block_size — impossible, since
+            # start is block-aligned progress within table_tokens.
+            padded = fits[-1]
+            real = min(real, padded)
         return ChunkPrefill(request=req, chunk_start=start, chunk_len=real,
-                            padded_len=max(padded, bs))
+                            padded_len=padded)
 
     def abort(self, req: Request) -> None:
         if req in self.running:

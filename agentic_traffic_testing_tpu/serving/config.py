@@ -59,6 +59,10 @@ class ServerConfig:
     # drops at inference (exact HF numerics) at the cost of E-fold larger
     # expert buffers — see models/moe.py capacity semantics.
     moe_capacity_factor: Optional[float] = None  # LLM_MOE_CAPACITY_FACTOR
+    # Precompile decode programs for every batch bucket at startup (TPU
+    # only): cold buckets otherwise compile mid-traffic, stalling the step
+    # loop 10-20 s per bucket under staggered arrivals.
+    warmup: bool = True                        # LLM_WARMUP
     speculation: Optional[str] = None          # LLM_SPECULATION ("ngram" | unset)
     spec_tokens: int = 3                       # LLM_SPEC_TOKENS (drafts/step)
     spec_ngram: int = 3                        # LLM_SPEC_NGRAM (match length)
@@ -106,6 +110,7 @@ class ServerConfig:
             raise ValueError(
                 f"LLM_MOE_CAPACITY_FACTOR must be > 0, got {mcf!r} "
                 f"(unset it to use the model default)")
+        c.warmup = _env_bool("LLM_WARMUP", "1")
         c.speculation = os.environ.get("LLM_SPECULATION") or None
         c.spec_tokens = int(os.environ.get("LLM_SPEC_TOKENS") or c.spec_tokens)
         c.spec_ngram = int(os.environ.get("LLM_SPEC_NGRAM") or c.spec_ngram)

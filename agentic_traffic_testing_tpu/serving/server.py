@@ -68,6 +68,17 @@ class LLMServer:
         self.tokenizer = load_tokenizer(cfg.weights_path or cfg.model)
         self.model_loaded = False  # set by _load_params on checkpoint load
         self.engine = engine or self._build_engine()
+        if cfg.warmup and engine is None:
+            import jax
+
+            if jax.devices()[0].platform == "tpu":
+                t0 = time.monotonic()
+                n = self.engine.warmup_decode_buckets()
+                if cfg.prefix_caching:
+                    # Cache-hit suffixes route through the chunk path.
+                    n += self.engine.warmup_chunk_buckets()
+                log.info("warmed %d decode/chunk bucket programs in %.1fs",
+                         n, time.monotonic() - t0)
         self.metrics = (
             LLMMetrics(cfg.metrics_prefix, cfg.metrics_include_tokens)
             if cfg.metrics_enabled else None

@@ -1,0 +1,135 @@
+#!/usr/bin/env python3
+"""Controlled A/B: ngram speculation x prefix caching (round-1 anomaly).
+
+Round-1 full-stack numbers showed fanout throughput of 221 tok/s with
+speculation alone but 80 tok/s with prefix-caching+speculation — a 2.7x
+swing attributed to "tunnel drift", which drift cannot explain. This script
+isolates the interaction at the engine level: the agent-b fan-out shape
+(requests sharing a long system-prompt prefix, arriving concurrently),
+2x2 {speculation} x {prefix caching}, BENCH_REPS repetitions each,
+reporting median throughput, speculation acceptance
+(spec_emitted/spec_iters), and the prefill-path split (batched vs solo
+chunk admissions — the suspected mechanism: cache-hit requests admit solo,
+tearing down the decode pipeline per admission).
+
+Usage:  python scripts/experiment/spec_prefix_ab.py [--model llama-3.2-1b]
+Prints one markdown table + one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def run_case(model: str, *, spec: bool, prefix: bool, reps: int,
+             fanout: int, prefix_len: int, suffix_len: int,
+             decode_tokens: int):
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+    cfg = EngineConfig(
+        model=model, dtype="bfloat16",
+        max_num_seqs=fanout,
+        max_model_len=max(1024, prefix_len + suffix_len + decode_tokens + 16),
+        prefix_caching=prefix,
+        speculation="ngram" if spec else None,
+    )
+    engine = LLMEngine(cfg)
+    rng = np.random.default_rng(0)
+    vocab = engine.model_cfg.vocab_size
+    # Repetitive alphabet -> n-gram proposals can actually hit; shared
+    # prefix -> the prefix cache can actually hit (the agentic shape).
+    alphabet = rng.integers(10, 200, 24).tolist()
+    shared = [alphabet[i % len(alphabet)] for i in range(prefix_len)]
+
+    counts = {"prefill": 0, "chunk": 0}
+    orig_prefill, orig_chunk = engine._run_prefill, engine._run_chunk
+
+    def cp(plan):
+        counts["prefill"] += 1
+        return orig_prefill(plan)
+
+    def cc(plan):
+        counts["chunk"] += 1
+        return orig_chunk(plan)
+
+    engine._run_prefill, engine._run_chunk = cp, cc
+
+    def one_wave():
+        reqs = []
+        for i in range(fanout):
+            suffix = [alphabet[(i + j) % len(alphabet)] for j in range(suffix_len)]
+            reqs.append(engine.add_request(
+                shared + suffix,
+                SamplingParams(temperature=0.0, max_tokens=decode_tokens,
+                               ignore_eos=True)))
+        t0 = time.monotonic()
+        while engine.has_work() and not all(r.is_finished() for r in reqs):
+            engine.step()
+        dt = time.monotonic() - t0
+        return sum(len(r.output_ids) for r in reqs) / dt
+
+    one_wave()  # warmup: compiles + seeds the prefix cache
+    counts["prefill"] = counts["chunk"] = 0
+    vals = [one_wave() for _ in range(reps)]
+    accept = (engine.spec_emitted / engine.spec_iters
+              if engine.spec_iters else None)
+    return {
+        "spec": spec, "prefix": prefix,
+        "toks_s_median": round(statistics.median(vals), 1),
+        "toks_s_spread": [round(min(vals), 1), round(max(vals), 1)],
+        "accept_tok_per_iter": round(accept, 3) if accept else None,
+        "prefills_batched": counts["prefill"],
+        "prefills_solo_chunks": counts["chunk"],
+    }
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default=None)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--fanout", type=int, default=5)
+    ap.add_argument("--prefix-len", type=int, default=384)
+    ap.add_argument("--suffix-len", type=int, default=32)
+    ap.add_argument("--decode-tokens", type=int, default=64)
+    args = ap.parse_args()
+
+    import jax
+
+    platform = jax.devices()[0].platform
+    model = args.model or ("llama-3.2-1b" if platform == "tpu" else "debug-512")
+
+    rows = []
+    for spec in (False, True):
+        for prefix in (False, True):
+            r = run_case(model, spec=spec, prefix=prefix, reps=args.reps,
+                         fanout=args.fanout, prefix_len=args.prefix_len,
+                         suffix_len=args.suffix_len,
+                         decode_tokens=args.decode_tokens)
+            rows.append(r)
+            print(f"  done spec={spec} prefix={prefix}: "
+                  f"{r['toks_s_median']} tok/s", file=sys.stderr)
+
+    print("| spec | prefix | tok/s (median) | spread | accept tok/iter | "
+          "batched prefills | solo chunks |")
+    print("|---|---|---|---|---|---|---|")
+    for r in rows:
+        print(f"| {'on' if r['spec'] else 'off'} | "
+              f"{'on' if r['prefix'] else 'off'} | {r['toks_s_median']} | "
+              f"{r['toks_s_spread']} | {r['accept_tok_per_iter'] or '—'} | "
+              f"{r['prefills_batched']} | {r['prefills_solo_chunks']} |")
+    print(json.dumps({"model": model, "platform": platform,
+                      "fanout": args.fanout, "reps": args.reps, "rows": rows}))
+
+
+if __name__ == "__main__":
+    main()

@@ -128,3 +128,38 @@ def test_multistep_decode_with_chunked_prefill(params):
     eng = LLMEngine(ecfg, model_cfg=CFG, runner=runner)
     req = eng.generate(prompt, greedy(9))
     assert req.generated_ids == want
+
+
+def test_next_chunk_stays_on_compile_ladder():
+    """Every emitted padded_len is in cfg.chunk_ladder(), even when the
+    chunk would overrun the block table near max_model_len — the scheduler
+    splits the chunk onto a smaller rung instead of clamping to an
+    off-ladder (fresh-compile) length."""
+    from agentic_traffic_testing_tpu.runtime.block_allocator import (
+        make_block_allocator,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import Request
+    from agentic_traffic_testing_tpu.runtime.scheduler import (
+        Scheduler,
+        SchedulerConfig,
+    )
+
+    cfg = SchedulerConfig(max_model_len=4096, block_size=16,
+                          prefill_chunk_tokens=1024)
+    sched = Scheduler(cfg, make_block_allocator(600, 16))
+    ladder = cfg.chunk_ladder()
+
+    # The verdict-finding shape: 3200 cached tokens of a 4000-token prompt;
+    # the naive clamp would emit padded = 4096 - 3200 = 896 (off-ladder).
+    req = Request(request_id="r", prompt_ids=list(range(4000)),
+                  sampling=SamplingParams(max_tokens=4))
+    req.num_computed_tokens = 3200
+    seen = []
+    while req.num_computed_tokens < req.num_prompt_tokens:
+        plan = sched._next_chunk(req)
+        assert plan.padded_len in ladder, (plan.padded_len, ladder)
+        assert plan.chunk_len <= plan.padded_len
+        assert plan.chunk_start + plan.padded_len <= 4096
+        seen.append((plan.chunk_len, plan.padded_len))
+        req.num_computed_tokens += plan.chunk_len
+    assert sum(c for c, _ in seen) == 800

@@ -199,3 +199,29 @@ def test_native_allocator_engine_parity(runner):
         kind = type(eng.allocator).__name__
         assert ("Native" in kind) == use_native, kind
     assert outs[False] == outs[True]
+
+
+def test_warmup_decode_buckets_harmless(runner):
+    """Warmup precompiles every batch bucket; dummy writes land in the trash
+    block, so subsequent generation is token-exact vs an unwarmed engine."""
+    rng = np.random.default_rng(9)
+    prompt = rng.integers(0, CFG.vocab_size, 12).tolist()
+    ref = make_engine(runner).generate(prompt, greedy(8)).generated_ids
+
+    eng = make_engine(runner)
+    n = eng.warmup_decode_buckets()
+    assert n >= 1
+    assert eng.generate(prompt, greedy(8)).generated_ids == ref
+
+
+def test_warmup_chunk_buckets_harmless(runner):
+    """Chunk-ladder warmup (prefix-caching deployments) leaves generation
+    token-exact."""
+    rng = np.random.default_rng(10)
+    prompt = rng.integers(0, CFG.vocab_size, 12).tolist()
+    ref = make_engine(runner).generate(prompt, greedy(8)).generated_ids
+
+    eng = make_engine(runner, prefill_chunk_tokens=32)
+    n = eng.warmup_chunk_buckets()
+    assert n >= 1
+    assert eng.generate(prompt, greedy(8)).generated_ids == ref
