@@ -439,7 +439,7 @@ class LLMEngine:
             # tokens the harvester drops. Retire the oldest instead of
             # pipelining waste (the bench shape: max_tokens=64, K=16,
             # depth=2 used to run 6 dispatches for 4 dispatches of work).
-            self._apply_inflight(self._inflight.popleft())
+            self._retire([self._inflight.popleft()])
         else:
             self._dispatch_decode()
 
@@ -715,27 +715,51 @@ class LLMEngine:
     # -- harvest / stop conditions ----------------------------------------
 
     def _harvest(self, max_inflight: int) -> None:
+        batch: list[_Inflight] = []
         while len(self._inflight) > max_inflight or (
             self._inflight and self._any_request_gone(self._inflight[0])
         ):
-            self._apply_inflight(self._inflight.popleft())
+            batch.append(self._inflight.popleft())
+        # Note: retiring these may finish requests that also appear in the
+        # remaining entries; those are picked up next step() — the pipeline
+        # already tolerates that one-dispatch lag.
+        self._retire(batch)
 
     def _drain_all(self) -> None:
-        while self._inflight:
-            self._apply_inflight(self._inflight.popleft())
+        batch = list(self._inflight)
+        self._inflight.clear()
+        self._retire(batch)
+
+    def _retire(self, infs: list[_Inflight]) -> None:
+        """Fetch + apply in-flight entries with ONE batched host transfer:
+        each separate device_get is a full host<->device round trip (tens of
+        ms through the axon tunnel), so retiring a wave entry-by-entry would
+        turn the pipeline tail into N round trips."""
+        if not infs:
+            return
+        leaves: list = []
+        for inf in infs:
+            leaves.append(inf.tokens)
+            if inf.counts is not None:
+                leaves.append(inf.counts)
+        fetched = iter(jax.device_get(leaves))
+        for inf in infs:
+            toks = np.asarray(next(fetched))
+            counts = (np.asarray(next(fetched))
+                      if inf.counts is not None else None)
+            self._apply_inflight_host(inf.requests, toks, counts)
 
     def _any_request_gone(self, inf: _Inflight) -> bool:
         return any(r.is_finished() for r in inf.requests)
 
-    def _apply_inflight(self, inf: _Inflight) -> None:
-        # Plain decode: tokens [B, K], every entry emitted. Speculative:
-        # tokens [B, K, spec+1] with counts [B, K] — only the first
-        # counts[b, k] entries of iteration k were accepted on device.
-        toks = np.asarray(jax.device_get(inf.tokens))
-        counts = (None if inf.counts is None
-                  else np.asarray(jax.device_get(inf.counts)))
+    def _apply_inflight_host(self, requests: list[Request], toks: np.ndarray,
+                             counts: Optional[np.ndarray]) -> None:
+        # Plain decode: tokens [B, K], every entry emitted; the prefill
+        # handoff entry is [B, 1]. Speculative: tokens [B, K, spec+1] with
+        # counts [B, K] — only the first counts[b, k] entries of iteration k
+        # were accepted on device.
         now = time.monotonic()
-        for i, r in enumerate(inf.requests):
+        for i, r in enumerate(requests):
             if r.is_finished() or r.state is not RequestState.RUNNING:
                 continue  # stopped at an earlier lagged step, or preempted
             if r.first_token_time is None:

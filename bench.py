@@ -7,8 +7,11 @@ Prints exactly one JSON line:
 
 Two workloads, both shapes of the agent-b fan-out load the reference testbed
 generates (BASELINE.md §2 "Fan-out workload"):
-  1. Throughput: `BENCH_BATCH` (default 8) concurrent requests, 128-token
-     prompts, 64 greedy decode tokens each — tok/s is the headline value.
+  1. Throughput: `BENCH_TOTAL_REQUESTS` (default 3x batch) requests queued
+     into a `BENCH_BATCH`-lane (default 8) engine — sustained continuous-
+     batching throughput at fan-out concurrency, the quantity a vLLM-style
+     serving benchmark reports. 128-token prompts, 64 greedy decode tokens
+     each; tok/s = total completion tokens / wall.
   2. TTFT under fan-out: 5 concurrent long-prompt (512-token) arrivals;
      `queue_wait_p50_s` = median enqueue -> first-token-on-host wait,
      matching the reference's queue_wait_seconds semantics (reference:
@@ -57,6 +60,7 @@ def main() -> None:
     default_model = "llama-3.2-1b" if platform == "tpu" else "debug-512"
     model = os.environ.get("BENCH_MODEL", default_model)
     batch = int(os.environ.get("BENCH_BATCH", "8"))
+    total_requests = int(os.environ.get("BENCH_TOTAL_REQUESTS", str(3 * batch)))
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
     decode_tokens = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
     reps = int(os.environ.get("BENCH_REPS", "3"))
@@ -65,6 +69,7 @@ def main() -> None:
 
     ds = os.environ.get("BENCH_DECODE_STEPS")
     decode_steps = int(ds) if ds else (32 if platform == "tpu" else None)
+    quantization = os.environ.get("BENCH_QUANTIZATION") or None
     # Two engines so each workload runs its natural serving config (the
     # throughput number stays comparable round-over-round): a short-context
     # engine for the batch workload, a long-context one for the fan-out TTFT
@@ -77,14 +82,16 @@ def main() -> None:
         max_model_len=max(512, prompt_len + decode_tokens + 16),
         num_blocks=None if platform == "tpu" else 1024,
         decode_steps=decode_steps,
+        quantization=quantization,
     )
     engine = LLMEngine(cfg)
     rng = np.random.default_rng(0)
     vocab = engine.model_cfg.vocab_size
 
     def run_batch() -> tuple[float, int]:
+        """Sustained load: total_requests queued at once, batch lanes."""
         reqs = []
-        for _ in range(batch):
+        for _ in range(total_requests):
             ids = rng.integers(10, vocab - 10, prompt_len).tolist()
             reqs.append(engine.add_request(
                 ids, SamplingParams(temperature=0.0, max_tokens=decode_tokens,
@@ -105,6 +112,7 @@ def main() -> None:
         max_model_len=max(1024, fanout_prompt + decode_tokens + 16),
         num_blocks=None if platform == "tpu" else 1024,
         decode_steps=decode_steps,
+        quantization=quantization,
     ), model_cfg=engine.model_cfg, runner=engine.runner)
 
     def run_fanout() -> float:
@@ -133,7 +141,9 @@ def main() -> None:
 
     nominal = NOMINAL_BASELINE_TOKS_S.get(model, 2000.0)
     print(json.dumps({
-        "metric": f"decode_throughput_{model}_bs{batch}_{platform}",
+        "metric": (f"decode_throughput_{model}"
+                   + (f"_{quantization}" if quantization else "")
+                   + f"_bs{batch}_n{total_requests}_{platform}"),
         "value": round(value, 2),
         "unit": "tok/s",
         "vs_baseline": round(value / nominal, 4),
