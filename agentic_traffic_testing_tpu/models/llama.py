@@ -114,11 +114,6 @@ def init_params_quantized(cfg: ModelConfig, seed: int = 0,
     perf work, never materialized in float anywhere."""
     import numpy as np
 
-    if cfg.num_experts:
-        raise NotImplementedError(
-            "int8 weight quantization is not wired up for MoE configs yet "
-            "(the expert einsums need a quantized contraction)")
-
     d, hd, f = cfg.hidden_size, cfg.head_dim_, cfg.intermediate_size
     h, kh, L, v = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers, cfg.vocab_size
     rng = np.random.default_rng(seed)
@@ -139,10 +134,20 @@ def init_params_quantized(cfg: ModelConfig, seed: int = 0,
         "wk": qw((L, d, kh * hd)),
         "wv": qw((L, d, kh * hd)),
         "wo": qw((L, h * hd, d)),
-        "w_gate": qw((L, d, f)),
-        "w_up": qw((L, d, f)),
-        "w_down": qw((L, f, d)),
     }
+    if cfg.num_experts:
+        e = cfg.num_experts
+        # Router math runs fp regardless (models/moe.py router_topk);
+        # expert SwiGLUs quantize per (expert, output channel).
+        layers["w_router"] = jnp.asarray(
+            rng.standard_normal((L, d, e)).astype(np.float32) * 0.02, dtype)
+        layers["w_gate"] = qw((L, e, d, f))
+        layers["w_up"] = qw((L, e, d, f))
+        layers["w_down"] = qw((L, e, f, d))
+    else:
+        layers["w_gate"] = qw((L, d, f))
+        layers["w_up"] = qw((L, d, f))
+        layers["w_down"] = qw((L, f, d))
     if cfg.qkv_bias:
         layers["bq"] = jnp.zeros((L, h * hd), dtype)
         layers["bk"] = jnp.zeros((L, kh * hd), dtype)

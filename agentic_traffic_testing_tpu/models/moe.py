@@ -33,6 +33,21 @@ import jax
 import jax.numpy as jnp
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig
+from agentic_traffic_testing_tpu.models.quant import QTensor
+
+
+def _expert_einsum(eq: str, x: jax.Array, w) -> jax.Array:
+    """Per-expert contraction for raw or int8 (QTensor) expert weights.
+
+    Quantized expert weights [E, K, N] carry per-(expert, output-channel)
+    scales [E, 1, N]; the int8 operand upcasts inside the einsum (XLA fuses
+    it into the operand read, HBM traffic stays int8 — same recipe as
+    quant.dense) and the scale lands on the output's last axis."""
+    if isinstance(w, QTensor):
+        y = jnp.einsum(eq, x, w.q.astype(x.dtype))
+        scale = jnp.squeeze(w.scale, axis=-2)          # [E, N]
+        return y * scale[:, None, None, :].astype(x.dtype)
+    return jnp.einsum(eq, x, w)
 
 
 def router_topk(x: jax.Array, w_router: jax.Array, cfg: ModelConfig):
@@ -81,10 +96,10 @@ def moe_mlp(x: jax.Array, lp: dict, cfg: ModelConfig):
     # Token features per assignment slot: [B, T*k, D].
     x_rep = jnp.repeat(x, k, axis=1)
     expert_in = jnp.einsum("gsec,gsd->egcd", disp, x_rep)    # [E, B, C, D]
-    gate = jnp.einsum("egcd,edf->egcf", expert_in, lp["w_gate"])
-    up = jnp.einsum("egcd,edf->egcf", expert_in, lp["w_up"])
+    gate = _expert_einsum("egcd,edf->egcf", expert_in, lp["w_gate"])
+    up = _expert_einsum("egcd,edf->egcf", expert_in, lp["w_up"])
     act = jax.nn.silu(gate) * up
-    out_e = jnp.einsum("egcf,efd->egcd", act, lp["w_down"])  # [E, B, C, D]
+    out_e = _expert_einsum("egcf,efd->egcd", act, lp["w_down"])  # [E, B, C, D]
     y = jnp.einsum("gsec,egcd->gsd", comb.astype(x.dtype), out_e)
     y = y.reshape(b, t, k, d).sum(axis=2).astype(x.dtype)
 

@@ -210,9 +210,6 @@ class LLMEngine:
                 raise ValueError(
                     "moe_capacity_factor override conflicts with the supplied "
                     "runner's model config — apply it before building the runner")
-        if cfg.quantization and self.model_cfg.num_experts:
-            raise NotImplementedError(
-                "int8 quantization is not wired up for MoE configs yet")
         dtype = jnp.bfloat16 if cfg.dtype in ("bfloat16", "bf16") else jnp.float32
         platform = jax.devices()[0].platform
         decode_steps = cfg.resolved_decode_steps(platform)

@@ -37,6 +37,7 @@ import os
 import statistics
 import sys
 import time
+from typing import Optional
 
 
 NOMINAL_BASELINE_TOKS_S = {
@@ -88,10 +89,11 @@ def main() -> None:
     rng = np.random.default_rng(0)
     vocab = engine.model_cfg.vocab_size
 
-    def run_batch() -> tuple[float, int]:
-        """Sustained load: total_requests queued at once, batch lanes."""
+    def run_batch(n_requests: Optional[int] = None) -> tuple[float, int]:
+        """Sustained load: n_requests (default total_requests) queued at
+        once, batch lanes."""
         reqs = []
-        for _ in range(total_requests):
+        for _ in range(n_requests or total_requests):
             ids = rng.integers(10, vocab - 10, prompt_len).tolist()
             reqs.append(engine.add_request(
                 ids, SamplingParams(temperature=0.0, max_tokens=decode_tokens,
@@ -112,7 +114,9 @@ def main() -> None:
         max_model_len=max(1024, fanout_prompt + decode_tokens + 16),
         num_blocks=None if platform == "tpu" else 1024,
         decode_steps=decode_steps,
-        quantization=quantization,
+        # No quantization field: the shared runner already carries the
+        # (possibly quantized) params; cfg.quantization only matters when
+        # the engine builds params itself.
     ), model_cfg=engine.model_cfg, runner=engine.runner)
 
     def run_fanout() -> float:
@@ -129,8 +133,10 @@ def main() -> None:
                  if r.first_token_time is not None]
         return statistics.median(waits)
 
-    # Warmup compiles every (batch, bucket) shape both workloads touch.
-    run_batch()
+    # Warmup compiles every (batch, bucket) shape both workloads touch;
+    # one batch-sized wave already walks the same bucket ladder as the
+    # sustained run does while draining.
+    run_batch(min(batch, total_requests))
     run_fanout()
 
     tp_runs = [run_batch() for _ in range(reps)]

@@ -18,7 +18,11 @@ import jax
 import jax.numpy as jnp
 
 from agentic_traffic_testing_tpu.models.config import PRESETS, ModelConfig
-from agentic_traffic_testing_tpu.models.llama import forward_full, init_params
+from agentic_traffic_testing_tpu.models.llama import (
+    forward_full,
+    init_params,
+    init_params_quantized,
+)
 from agentic_traffic_testing_tpu.models.moe import expert_capacity, moe_mlp
 from agentic_traffic_testing_tpu.models.weights import params_from_hf_state_dict
 
@@ -167,3 +171,97 @@ def test_engine_capacity_override_and_validation():
     assert eng.model_cfg.moe_capacity_factor == 4.0
     with pytest.raises(ValueError, match="moe_capacity_factor"):
         EngineConfig(model="tiny-moe", moe_capacity_factor=0.0)
+
+
+# ------------------------------------------------------ expert parallelism
+
+
+def test_moe_forward_matches_under_ep_sharding():
+    """EP is only a sharding: params placed with P('ep', ...) on the expert
+    axis must reproduce single-device logits (GSPMD inserts the all-to-alls
+    on the dispatch/combine einsums)."""
+    from agentic_traffic_testing_tpu.parallel.mesh import make_mesh
+    from agentic_traffic_testing_tpu.parallel.sharding import shard_params
+
+    params = init_params(MOE_CFG, jax.random.key(11), dtype=jnp.float32)
+    tokens = jnp.asarray(
+        np.random.default_rng(12).integers(0, MOE_CFG.vocab_size, (2, 16)),
+        jnp.int32)
+    ref = forward_full(params, MOE_CFG, tokens)
+
+    for ep, tp in ((2, 1), (4, 1), (2, 2)):
+        mesh = make_mesh(ep=ep, tp=tp)
+        sharded = shard_params(params, MOE_CFG, mesh)
+        got = forward_full(sharded, MOE_CFG, tokens)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   atol=2e-4, rtol=1e-3, err_msg=f"ep={ep},tp={tp}")
+
+
+def test_moe_train_step_on_ep_mesh():
+    """Full MoE training step (incl. the aux term) over a (dp, ep, tp) mesh:
+    first-step loss equals the single-device step's."""
+    import optax
+
+    from agentic_traffic_testing_tpu.parallel.mesh import make_mesh
+    from agentic_traffic_testing_tpu.training.train import (
+        init_train_state,
+        make_train_step,
+    )
+
+    rng = np.random.default_rng(13)
+    tokens = jnp.asarray(rng.integers(0, MOE_CFG.vocab_size, (4, 16)), jnp.int32)
+    mask = jnp.ones((4, 16), jnp.float32)
+    opt = optax.sgd(0.0)
+
+    def first_loss(mesh):
+        params, opt_state = init_train_state(MOE_CFG, mesh, opt, seed=5)
+        ts = make_train_step(MOE_CFG, mesh, opt, remat=False)
+        _, _, loss = ts(params, opt_state, tokens, mask)
+        return float(loss)
+
+    l_ep = first_loss(make_mesh(dp=2, ep=2, tp=2))
+    l_single = first_loss(make_mesh(1, 1, 1, devices=jax.devices()[:1]))
+    assert abs(l_ep - l_single) < 1e-4, (l_ep, l_single)
+
+
+# ------------------------------------------------------------ int8 x MoE
+
+
+def test_moe_int8_logits_track_full_precision():
+    """Quantized expert einsums: int8 MoE logits track fp within the same
+    per-channel error budget as the dense model's quant path."""
+    from agentic_traffic_testing_tpu.models.quant import quantize_params
+
+    params = init_params(MOE_CFG, jax.random.key(14), dtype=jnp.float32)
+    qparams = quantize_params(params)
+    tokens = jnp.asarray(
+        np.random.default_rng(15).integers(0, MOE_CFG.vocab_size, (1, 12)),
+        jnp.int32)
+    ref = np.asarray(forward_full(params, MOE_CFG, tokens), np.float32)
+    got = np.asarray(forward_full(qparams, MOE_CFG, tokens), np.float32)
+    # Same top-1 almost everywhere and bounded absolute drift.
+    agree = (ref.argmax(-1) == got.argmax(-1)).mean()
+    assert agree >= 0.9, agree
+    assert np.abs(got - ref).max() < 0.12 * np.abs(ref).max()
+
+
+def test_moe_int8_engine_decode_and_ep_mesh():
+    """The engine serves int8 MoE (guard removed), and EP x TP sharding of
+    the QTensor expert leaves reproduces the single-device int8 decode
+    token-exactly."""
+    from agentic_traffic_testing_tpu.parallel.mesh import make_mesh
+    from agentic_traffic_testing_tpu.parallel.tp_runner import TPRunner
+    from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+    qparams = init_params_quantized(MOE_CFG, 2, dtype=jnp.float32)
+    ecfg = EngineConfig(model="tiny-moe", dtype="float32", quantization="int8",
+                        num_blocks=64, max_model_len=128)
+    prompt = list(range(5, 21))
+    samp = SamplingParams(temperature=0.0, max_tokens=8)
+    ref = LLMEngine(ecfg, model_cfg=MOE_CFG, params=qparams).generate(prompt, samp)
+    assert len(ref.output_ids) == 8
+
+    runner = TPRunner(MOE_CFG, qparams, make_mesh(ep=2, tp=2))
+    got = LLMEngine(ecfg, model_cfg=MOE_CFG, runner=runner).generate(prompt, samp)
+    assert got.output_ids == ref.output_ids
