@@ -423,9 +423,30 @@ class LLMEngine:
         # Only tear the decode pipeline down for admission when the head of
         # the waiting queue could actually be admitted — an unadmittable
         # (KV-starved) waiter must not degrade decode to synchronous readback.
-        admission_possible = (self.scheduler.can_admit_head()
-                              or self.scheduler.has_pending_chunk()
-                              or bool(self.scheduler.failed))
+        admission_possible = self._admission_possible()
+        if (not admission_possible and self.scheduler.waiting
+                and self._inflight and self._decode_requests
+                and self._decode_budget_satisfied()):
+            # Wave overlap: every running lane's remaining tokens are already
+            # computed inside in-flight dispatches, so their KV blocks and
+            # scheduler slots are dead weight — release them NOW and dispatch
+            # the next wave's prefill behind the in-flight work instead of
+            # draining first. The final result copy then crosses the tunnel
+            # (~110 ms one-way on axon) while the next wave computes; tokens
+            # still land via the normal harvest. Device execution is FIFO, so
+            # the prefill's writes into reused blocks order after the old
+            # wave's reads/writes.
+            for r in self._decode_requests:
+                if not r.is_finished():
+                    self.scheduler.finish(r)
+            self._invalidate_decode_state()
+            admission_possible = self._admission_possible()
+            if admission_possible:
+                self._plan_and_dispatch()
+                self._harvest(max_inflight=self.cfg.pipeline_depth)
+                return self._flush_events()
+            # Released but still unadmittable (pool too small for the next
+            # head): fall through to the drain path below.
         if admission_possible or self._decode_state is None or not self._decode_requests:
             # Composition may change: sync up, then let the scheduler decide.
             self._drain_all()
@@ -442,6 +463,12 @@ class LLMEngine:
 
         self._harvest(max_inflight=self.cfg.pipeline_depth)
         return self._flush_events()
+
+    def _admission_possible(self) -> bool:
+        """Would the scheduler change composition if we synced up right now?"""
+        return (self.scheduler.can_admit_head()
+                or self.scheduler.has_pending_chunk()
+                or bool(self.scheduler.failed))
 
     def _plan_and_dispatch(self) -> None:
         """Plan against *current* (post-drain) state and run the step."""
@@ -647,7 +674,7 @@ class LLMEngine:
             # [B, K, S] entries emit >= K, so K is the guaranteed floor).
             inflight_toks = sum(
                 int(inf.tokens.shape[1]) for inf in self._inflight
-                if any(rr is r for rr in inf.requests))
+                if r in inf.requests)  # identity: Request is eq=False
             needed = min(
                 r.sampling.max_tokens - r.sampling_step,
                 self.cfg.max_model_len - r.total_len,
@@ -800,8 +827,12 @@ class LLMEngine:
         r.state = RequestState.FINISHED
         r.finish_reason = reason
         r.finish_time = time.monotonic()
-        self.scheduler.finish(r)
-        self._invalidate_decode_state()
+        self.scheduler.finish(r)  # no-op if the lane was released early
+        # Only tear down the decode pipeline if r is part of the CURRENT
+        # composition — harvesting a previous (early-released) wave's finish
+        # must not stall the wave already decoding.
+        if r in self._decode_requests:  # identity: Request is eq=False
+            self._invalidate_decode_state()
 
     def _invalidate_decode_state(self) -> None:
         self._decode_state = None

@@ -225,3 +225,33 @@ def test_warmup_chunk_buckets_harmless(runner):
     n = eng.warmup_chunk_buckets()
     assert n >= 1
     assert eng.generate(prompt, greedy(8)).generated_ids == ref
+
+
+def test_wave_overlap_releases_lanes_early(runner, monkeypatch):
+    """Successive waves of budget-bound requests: satisfied lanes release
+    their slots early so the next wave's prefill dispatches behind the
+    in-flight work — no blocking drain between waves (only the final one),
+    and outputs stay token-exact vs solo runs."""
+    rng = np.random.default_rng(11)
+    prompts = [rng.integers(0, CFG.vocab_size, 9).tolist() for _ in range(6)]
+    solos = []
+    for p in prompts:
+        eng = make_engine(runner)
+        solos.append(eng.generate(p, greedy(8, ignore_eos=True)).generated_ids)
+
+    eng = make_engine(runner, max_num_seqs=2)
+    drains_with_entries = []
+    orig = eng._drain_all
+
+    def counting():
+        if eng._inflight:
+            drains_with_entries.append(len(eng._inflight))
+        return orig()
+
+    monkeypatch.setattr(eng, "_drain_all", counting)
+    reqs = [eng.add_request(p, greedy(8, ignore_eos=True)) for p in prompts]
+    run_all(eng, reqs)
+    assert [r.generated_ids for r in reqs] == solos
+    # Waves hand over through early release + in-flight prefill, not through
+    # mid-run blocking drains; at most the run's tail drains with entries.
+    assert len(drains_with_entries) <= 1, drains_with_entries
