@@ -94,6 +94,13 @@ class EngineConfig:
     # Prompts longer than this prefill in fixed chunks (bounded bucket +
     # per-step latency); 0/None disables chunking.
     prefill_chunk_tokens: Optional[int] = 2048
+    # Multi-request prefill batches form only up to this padded length
+    # (None -> scheduler default 128). Raising it lets concurrent long-prompt
+    # arrivals prefill in ONE weight-streaming pass instead of solo — the
+    # TTFT-under-fan-out lever — but each (batch, length) bucket is a fresh
+    # XLA compile; pair with warmup_prefill_buckets() so a burst never
+    # compiles mid-traffic.
+    prefill_batch_max_len: Optional[int] = None
     # Content-addressed reuse of full prompt blocks (vLLM automatic-prefix-
     # caching analog); cached requests prefill only their suffix.
     prefix_caching: bool = False
@@ -156,6 +163,8 @@ class EngineConfig:
             block_size=self.block_size,
             decode_lookahead=max(4, (self.pipeline_depth + 1) * decode_steps),
             prefill_chunk_tokens=self.prefill_chunk_tokens or None,
+            **({"prefill_batch_max_len": self.prefill_batch_max_len}
+               if self.prefill_batch_max_len is not None else {}),
         )
 
 
@@ -353,6 +362,59 @@ class LLMEngine:
             self.cache = result[1]
             jax.block_until_ready(result[2])
             n += 1
+        return n
+
+    def warmup_prefill_buckets(self, min_len: int = 0,
+                               max_len: Optional[int] = None) -> int:
+        """Precompile the batched-prefill program for every (batch, length)
+        bucket combination the live path can emit.
+
+        Relevant when `prefill_batch_max_len` is raised past the 128 default:
+        concurrent long-prompt arrivals then prefill together, and each cold
+        (batch, length) shape is a 15-40 s XLA compile that would otherwise
+        land mid-burst (the exact failure prefill_batch_max_len=128 existed
+        to avoid). `min_len`/`max_len` bound the warmed length buckets so
+        deployments that only see one prompt shape (bench.py's fan-out probe)
+        don't pay for the whole ladder. Dummy lanes write to the trash block.
+        Returns the number of programs compiled."""
+        from agentic_traffic_testing_tpu.runtime.scheduler import bucket_up
+
+        scfg = self.scheduler.cfg
+        cap = min(scfg.prefill_batch_max_len,
+                  max_len if max_len is not None else scfg.prefill_batch_max_len)
+        if scfg.prefill_chunk_tokens is not None:
+            # Longer prompts route solo through the chunk path; no batched
+            # prefill bucket past the chunk threshold's own bucket can ever
+            # dispatch, so warming it would be pure wasted startup time.
+            chunk_bucket = bucket_up(scfg.prefill_chunk_tokens,
+                                     scfg.prefill_buckets)
+            cap = min(cap, -(-chunk_bucket // self.cfg.block_size)
+                      * self.cfg.block_size)
+        lens = sorted({-(-t // self.cfg.block_size) * self.cfg.block_size
+                       for t in scfg.prefill_buckets})
+        n = 0
+        for t in lens:
+            if t < min_len or t > cap:
+                continue
+            # The scheduler bounds the UNPADDED member count by the token
+            # budget, then pads UP to a batch bucket — so the largest live
+            # shape at this length is bucket_up(k_max), not the largest
+            # bucket with b*t under the budget.
+            k_max = max(1, min(scfg.max_num_seqs,
+                               scfg.max_num_batched_tokens // t))
+            b_cap = bucket_up(k_max, scfg.batch_buckets)
+            for b in scfg.batch_buckets:
+                if b > b_cap:
+                    break
+                tokens = jnp.zeros((b, t), jnp.int32)
+                tables = jnp.full((b, self.table_width), TRASH_BLOCK, jnp.int32)
+                seq_lens = jnp.ones((b,), jnp.int32)
+                samp = self._sampling_arrays([], b)
+                state, self.cache, out = self.runner.prefill(
+                    tokens, self.cache, tables, seq_lens, samp,
+                    jnp.zeros((b,), jnp.int32))
+                jax.block_until_ready(out)
+                n += 1
         return n
 
     def warmup_chunk_buckets(self) -> int:

@@ -46,6 +46,11 @@ class ServerConfig:
     quantization: Optional[str] = None         # LLM_QUANTIZATION ("int8" | unset)
     decode_steps: Optional[int] = None         # LLM_DECODE_STEPS (None -> auto)
     prefill_chunk_tokens: int = 2048           # LLM_PREFILL_CHUNK_TOKENS (0 = off)
+    # Batch same-bucket prompt prefills up to this padded length (None ->
+    # engine default 128). Raising it cuts TTFT under concurrent long-prompt
+    # bursts (one weight-streaming pass instead of solo prefills); warmup
+    # then precompiles every (batch, length) bucket <= the cap at startup.
+    prefill_batch_max_len: Optional[int] = None  # LLM_PREFILL_BATCH_MAX_LEN
     prefix_caching: bool = False               # LLM_PREFIX_CACHING
     num_blocks: Optional[int] = None           # LLM_NUM_BLOCKS (None -> HBM profile)
     block_size: int = 16                       # LLM_BLOCK_SIZE
@@ -98,6 +103,8 @@ class ServerConfig:
         c.decode_steps = int(ds) if ds else None
         c.prefill_chunk_tokens = int(
             os.environ.get("LLM_PREFILL_CHUNK_TOKENS") or c.prefill_chunk_tokens)
+        pbml = os.environ.get("LLM_PREFILL_BATCH_MAX_LEN")
+        c.prefill_batch_max_len = int(pbml) if pbml else None
         c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
         nb = os.environ.get("LLM_NUM_BLOCKS")
         c.num_blocks = int(nb) if nb else None
@@ -139,6 +146,8 @@ class ServerConfig:
         p.add_argument("--decode-steps", type=int, default=c.decode_steps)
         p.add_argument("--prefill-chunk-tokens", type=int,
                        default=c.prefill_chunk_tokens)
+        p.add_argument("--prefill-batch-max-len", type=int,
+                       default=c.prefill_batch_max_len)
         p.add_argument("--enable-prefix-caching", dest="prefix_caching",
                        action="store_true", default=c.prefix_caching)
         p.add_argument("--num-blocks", type=int, default=c.num_blocks)
@@ -152,7 +161,8 @@ class ServerConfig:
         for f in ("model", "dtype", "max_num_seqs", "max_num_batched_tokens",
                   "memory_utilization", "max_tokens", "max_model_len",
                   "temperature", "host", "port", "tp_size", "quantization",
-                  "decode_steps", "prefill_chunk_tokens", "prefix_caching",
+                  "decode_steps", "prefill_chunk_tokens",
+                  "prefill_batch_max_len", "prefix_caching",
                   "num_blocks", "block_size", "weights_path",
                   "speculation", "spec_tokens", "spec_ngram"):
             setattr(c, f, getattr(a, f))

@@ -77,6 +77,11 @@ class LLMServer:
                 if cfg.prefix_caching:
                     # Cache-hit suffixes route through the chunk path.
                     n += self.engine.warmup_chunk_buckets()
+                if cfg.prefill_batch_max_len is not None:
+                    # Batched prefills are tuned: cover every (batch, length)
+                    # bucket under the cap so a burst never compiles
+                    # mid-traffic (the exact stall the solo default avoids).
+                    n += self.engine.warmup_prefill_buckets()
                 log.info("warmed %d decode/chunk bucket programs in %.1fs",
                          n, time.monotonic() - t0)
         self.metrics = (
@@ -116,6 +121,7 @@ class LLMServer:
             num_blocks=c.num_blocks, memory_utilization=c.memory_utilization,
             decode_steps=c.decode_steps, quantization=c.quantization,
             prefill_chunk_tokens=c.prefill_chunk_tokens,
+            prefill_batch_max_len=c.prefill_batch_max_len,
             prefix_caching=c.prefix_caching,
             moe_capacity_factor=c.moe_capacity_factor,
             speculation=c.speculation, spec_tokens=c.spec_tokens,

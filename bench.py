@@ -114,6 +114,12 @@ def main() -> None:
         max_model_len=max(1024, fanout_prompt + decode_tokens + 16),
         num_blocks=None if platform == "tpu" else 1024,
         decode_steps=decode_steps,
+        # Concurrent long-prompt arrivals prefill in ONE batched pass (the
+        # TTFT lever); the warmup run_fanout() below compiles the single
+        # (batch, length) bucket this probe can hit. The cap must cover the
+        # PADDED bucket (pow2 ceiling), or an off-bucket prompt length would
+        # silently fall back to solo prefills.
+        prefill_batch_max_len=max(128, 1 << (fanout_prompt - 1).bit_length()),
         # No quantization field: the shared runner already carries the
         # (possibly quantized) params; cfg.quantization only matters when
         # the engine builds params itself.

@@ -1,0 +1,113 @@
+#!/usr/bin/env python3
+"""Trace the engine's decode workload and print a device-op time summary.
+
+Runs the bench.py throughput workload (1B bf16, bs=8 by default) under
+`jax.profiler.trace`, then parses the written xplane protobuf and prints
+per-op total durations for the busiest device plane — the tool behind the
+decode-step anatomy in docs/BENCHMARKS.md. No reference analog (the
+reference profiles via nsight outside the repo).
+
+Usage: python scripts/dev/profile_decode.py [trace_dir]
+Env: same BENCH_* knobs as bench.py; PROFILE_TOP (default 40).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def run_workload(trace_dir: str) -> None:
+    import jax
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+    platform = jax.devices()[0].platform
+    model = os.environ.get("BENCH_MODEL",
+                           "llama-3.2-1b" if platform == "tpu" else "debug-512")
+    batch = int(os.environ.get("BENCH_BATCH", "8"))
+    total = int(os.environ.get("BENCH_TOTAL_REQUESTS", str(3 * batch)))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
+    decode_tokens = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
+    ds = os.environ.get("BENCH_DECODE_STEPS")
+    decode_steps = int(ds) if ds else (32 if platform == "tpu" else None)
+
+    cfg = EngineConfig(model=model, max_num_seqs=batch,
+                       max_model_len=max(512, prompt_len + decode_tokens + 8),
+                       decode_steps=decode_steps)
+    eng = LLMEngine(cfg)
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, eng.model_cfg.vocab_size, prompt_len).tolist()
+               for _ in range(total)]
+    sp = SamplingParams(max_tokens=decode_tokens, ignore_eos=True)
+
+    # Warm (compile) pass outside the trace so the trace holds steady state.
+    for p in prompts[:batch]:
+        eng.add_request(p, sp)
+    while eng.has_work():
+        eng.step()
+
+    with jax.profiler.trace(trace_dir):
+        for p in prompts:
+            eng.add_request(p, sp)
+        while eng.has_work():
+            eng.step()
+
+
+def summarize(trace_dir: str, top: int) -> None:
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not paths:
+        raise SystemExit(f"no .xplane.pb under {trace_dir}")
+    xspace = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        xspace.ParseFromString(f.read())
+
+    best = None  # busiest non-host plane = the device compute timeline
+    for plane in xspace.planes:
+        total_ps = sum(ev.duration_ps for line in plane.lines
+                       for ev in line.events)
+        lname = plane.name.lower()
+        if "host" in lname or "cpu" in lname or "python" in lname:
+            continue
+        if best is None or total_ps > best[0]:
+            best = (total_ps, plane)
+    if best is None:
+        raise SystemExit("no device plane found")
+    _, plane = best
+    names = dict(plane.event_metadata.items())
+
+    by_op: dict[str, list[float]] = defaultdict(lambda: [0.0, 0])
+    line_total_ps = 0.0
+    for line in plane.lines:
+        for ev in line.events:
+            md = names.get(ev.metadata_id)
+            name = md.name if md else str(ev.metadata_id)
+            acc = by_op[name]
+            acc[0] += ev.duration_ps
+            acc[1] += 1
+            line_total_ps += ev.duration_ps
+    print(f"plane: {plane.name}  total device-op time: "
+          f"{line_total_ps / 1e9:.3f} ms")
+    rows = sorted(by_op.items(), key=lambda kv: -kv[1][0])[:top]
+    for name, (ps, n) in rows:
+        print(f"{ps / 1e9:10.3f} ms  x{n:<6d} {name[:110]}")
+
+
+def main() -> None:
+    trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/decode_trace"
+    top = int(os.environ.get("PROFILE_TOP", "40"))
+    run_workload(trace_dir)
+    summarize(trace_dir, top)
+
+
+if __name__ == "__main__":
+    main()

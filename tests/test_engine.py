@@ -227,6 +227,47 @@ def test_warmup_chunk_buckets_harmless(runner):
     assert eng.generate(prompt, greedy(8)).generated_ids == ref
 
 
+def test_long_prefill_batching(runner):
+    """With prefill_batch_max_len raised, same-bucket long prompts prefill in
+    ONE batched dispatch (not solo), and outputs stay token-exact."""
+    rng = np.random.default_rng(12)
+    prompts = [rng.integers(0, CFG.vocab_size, n).tolist() for n in (60, 57, 49)]
+    solos = []
+    for p in prompts:
+        eng = make_engine(runner)
+        solos.append(eng.generate(p, greedy(6)).generated_ids)
+
+    eng = make_engine(runner, prefill_batch_max_len=64)
+    reqs = [eng.add_request(p, greedy(6)) for p in prompts]
+    eng.step()  # first step must admit ALL THREE in one prefill batch
+    assert eng.scheduler.num_scheduled_prefills == 1
+    assert sum(1 for r in reqs if r.state.name == "RUNNING") == 3
+    run_all(eng, reqs)
+    assert [r.generated_ids for r in reqs] == solos
+
+    # With a cap below the 64-token bucket the head admits solo instead.
+    eng = make_engine(runner, prefill_batch_max_len=32)
+    reqs = [eng.add_request(p, greedy(6)) for p in prompts]
+    eng.step()
+    assert eng.scheduler.num_scheduled_prefills == 1
+    assert sum(1 for r in reqs if r.state.name == "RUNNING") == 1  # solo head
+    run_all(eng, reqs)
+    assert [r.generated_ids for r in reqs] == solos
+
+
+def test_warmup_prefill_buckets_harmless(runner):
+    """Warming batched-prefill shapes neither corrupts live KV nor changes
+    outputs, and covers the (batch, length) combos under the cap."""
+    rng = np.random.default_rng(13)
+    prompt = rng.integers(0, CFG.vocab_size, 40).tolist()
+    eng = make_engine(runner, prefill_batch_max_len=64)
+    ref = eng.generate(prompt, greedy(6)).generated_ids
+    n = eng.warmup_prefill_buckets()
+    # tiny engine: length buckets {32, 64} x batch buckets {1, 2, 4}
+    assert n == 6
+    assert eng.generate(prompt, greedy(6)).generated_ids == ref
+
+
 def test_wave_overlap_releases_lanes_early(runner, monkeypatch):
     """Successive waves of budget-bound requests: satisfied lanes release
     their slots early so the next wave's prefill dispatches behind the
