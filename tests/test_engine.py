@@ -268,6 +268,39 @@ def test_warmup_prefill_buckets_harmless(runner):
     assert eng.generate(prompt, greedy(6)).generated_ids == ref
 
 
+def test_warmup_prefill_covers_live_shapes(runner, monkeypatch):
+    """Every (batch, length) prefill shape the scheduler emits under bursty
+    traffic must already be warmed — the warmup's reason to exist is that a
+    cold shape is a multi-second XLA compile mid-burst. Guards the padded-
+    batch-ladder bound (the scheduler budgets the UNPADDED count, then pads
+    UP to a batch bucket)."""
+    # max_num_seqs=4 -> batch ladder [1, 2, 4]; budget 192 caps a 64-token
+    # bucket at 3 UNPADDED members (64*4 > 192), which then pad UP to the
+    # 4-bucket — so shape (4, 64) is live even though 4*64 exceeds the
+    # budget, and a warmup that bounded b*t by the budget would miss it.
+    eng = make_engine(runner, max_num_seqs=4, prefill_batch_max_len=64,
+                      max_num_batched_tokens=192)
+    shapes: set[tuple[int, int]] = set()
+    orig = eng.runner.prefill
+
+    def recording(tokens, *a, **kw):
+        shapes.add(tuple(tokens.shape))
+        return orig(tokens, *a, **kw)
+
+    monkeypatch.setattr(eng.runner, "prefill", recording)
+    eng.warmup_prefill_buckets()
+    warmed = set(shapes)
+    shapes.clear()
+
+    rng = np.random.default_rng(14)
+    for lens in [(60, 57, 49), (20, 22), (9,), (33, 40, 61)]:
+        reqs = [eng.add_request(rng.integers(0, CFG.vocab_size, n).tolist(),
+                                greedy(4)) for n in lens]
+        run_all(eng, reqs)
+    assert shapes, "burst traffic never hit the batched-prefill path"
+    assert shapes <= warmed, f"cold prefill shapes after warmup: {shapes - warmed}"
+
+
 def test_wave_overlap_releases_lanes_early(runner, monkeypatch):
     """Successive waves of budget-bound requests: satisfied lanes release
     their slots early so the next wave's prefill dispatches behind the
