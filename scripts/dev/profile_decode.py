@@ -101,6 +101,39 @@ def summarize(trace_dir: str, top: int) -> None:
     for name, (ps, n) in rows:
         print(f"{ps / 1e9:10.3f} ms  x{n:<6d} {name[:110]}")
 
+    # Idle-gap analysis at OP granularity: where the chip sat waiting.
+    # Prefer the op-level line by name — a module/step-level line's events
+    # wrap their ops plus any intra-module idle, so picking the line with
+    # the largest duration sum would make the gap analysis tautologically
+    # ~100% busy whenever op-level idle exists.
+    op_lines = [l for l in plane.lines if "op" in l.name.lower()]
+    pool = op_lines or list(plane.lines)
+    if not pool:
+        return
+    busiest = max(pool, key=lambda l: sum(e.duration_ps for e in l.events))
+    evs = sorted(busiest.events, key=lambda e: e.offset_ps)
+    if not evs:
+        return
+    span_ps = (evs[-1].offset_ps + evs[-1].duration_ps) - evs[0].offset_ps
+    busy_ps, cur_end = 0, evs[0].offset_ps
+    gaps: list[tuple[int, str, str]] = []
+    prev_name = ""
+    for ev in evs:
+        start, end = ev.offset_ps, ev.offset_ps + ev.duration_ps
+        md = names.get(ev.metadata_id)
+        name = (md.name if md else str(ev.metadata_id))[:60]
+        if start > cur_end:
+            gaps.append((start - cur_end, prev_name, name))
+        busy_ps += max(0, end - max(start, cur_end))
+        if end > cur_end:
+            cur_end = end
+            prev_name = name
+    print(f"\nline '{busiest.name}': span {span_ps/1e9:.1f} ms, busy "
+          f"{busy_ps/1e9:.1f} ms ({100*busy_ps/max(1,span_ps):.1f}%), "
+          f"{len(gaps)} gaps totalling {(span_ps-busy_ps)/1e9:.1f} ms")
+    for g, before, after in sorted(gaps, reverse=True)[:15]:
+        print(f"  gap {g/1e9:8.3f} ms  after [{before}]  before [{after}]")
+
 
 def main() -> None:
     trace_dir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/decode_trace"
