@@ -463,15 +463,29 @@ class LLMEngine:
         self._requests[req.request_id] = req
         return req
 
-    def abort_request(self, req: Request) -> None:
-        self._drain_all()
+    def abort_request(self, req: Request) -> list[StepOutput]:
+        """Abort one request. Returns any SIBLING events the abort produced:
+        the drain applies in-flight tokens, which can finish other lanes —
+        and if that empties the engine, no later step() would ever flush
+        them (a disconnect-triggered abort would strand the survivors'
+        streams). Callers that abort from outside the step loop must route
+        the returned events exactly like step()'s."""
+        if req.is_finished():
+            # Already completed (e.g. a PREVIOUS abort's drain finished this
+            # lane normally): don't clobber FINISHED/STOP state with ABORT.
+            return []
+        # Mark aborted BEFORE draining: _apply_inflight_host skips
+        # non-RUNNING lanes, so no token computed-but-unharvested at abort
+        # time lands on the request.
         req.state = RequestState.ABORTED
         req.finish_reason = FinishReason.ABORT
         req.finish_time = time.monotonic()
+        self._drain_all()
         self.scheduler.abort(req)
         self._requests.pop(req.request_id, None)
         self._new_tokens.pop(req.request_id, None)
         self._invalidate_decode_state()
+        return self._flush_events()
 
     def has_work(self) -> bool:
         return self.scheduler.has_work() or bool(self._inflight)

@@ -130,7 +130,13 @@ class AsyncLLMEngine:
                 continue
             if self._on_step is not None and events:
                 self._on_step(sum(1 for e in events if e.new_token_ids))
-            for e in events:
+            # Work-list, not a plain for: an abort's drain can FINISH sibling
+            # requests, and their events surface only in abort_request's
+            # return value — if the engine is empty afterwards no later
+            # step() would flush them, stranding the survivors' streams.
+            pending = list(events)
+            while pending:
+                e = pending.pop(0)
                 stream = self._streams.get(e.request.request_id)
                 if stream is None:
                     continue
@@ -141,7 +147,12 @@ class AsyncLLMEngine:
                 elif not alive:
                     # Client loop is gone: stop paying for this generation.
                     del self._streams[e.request.request_id]
-                    self.engine.abort_request(e.request)
+                    extra = self.engine.abort_request(e.request)
+                    if self._on_step is not None and extra:
+                        # Keep token accounting complete: these sibling
+                        # events never pass through the step() path above.
+                        self._on_step(sum(1 for x in extra if x.new_token_ids))
+                    pending.extend(extra)
 
     def _fail_all(self) -> None:
         """Abort every live request in the engine and notify its stream.

@@ -268,6 +268,78 @@ def test_warmup_prefill_buckets_harmless(runner):
     assert eng.generate(prompt, greedy(6)).generated_ids == ref
 
 
+def test_abort_after_early_release(runner):
+    """Abort a request whose lane was released by the wave-overlap path but
+    whose in-flight tokens have not harvested yet: no crash, no tokens
+    applied after the abort, and the next wave still completes exactly."""
+    rng = np.random.default_rng(15)
+    prompts = [rng.integers(0, CFG.vocab_size, 9).tolist() for _ in range(4)]
+    solos = []
+    for p in prompts:
+        eng = make_engine(runner)
+        solos.append(eng.generate(p, greedy(8, ignore_eos=True)).generated_ids)
+
+    eng = make_engine(runner, max_num_seqs=2)
+    reqs = [eng.add_request(p, greedy(8, ignore_eos=True)) for p in prompts]
+    aborted = None
+    for _ in range(10_000):
+        eng.step()
+        if aborted is None:
+            # Early release moves a still-RUNNING first-wave request out of
+            # the scheduler while its tokens ride the in-flight pipeline.
+            gone = [r for r in reqs[:2]
+                    if not r.is_finished() and r not in eng.scheduler.running
+                    and r.state.name == "RUNNING"]
+            if gone:
+                aborted = gone[0]
+                n_before = len(aborted.generated_ids)
+                eng.abort_request(aborted)
+                assert aborted.finish_reason == FinishReason.ABORT
+        if all(r.is_finished() for r in reqs):
+            break
+    assert aborted is not None, "wave overlap never released a live lane"
+    assert len(aborted.generated_ids) == n_before, (
+        "tokens landed on an aborted request after abort_request returned")
+    for r, solo in zip(reqs, solos):
+        if r is not aborted:
+            assert r.generated_ids == solo
+
+
+def test_abort_returns_finished_sibling_events(runner):
+    """abort_request's drain can finish batchmates; their events must come
+    back from abort_request itself — with the engine empty afterwards, no
+    later step() would ever flush them (the async façade would strand the
+    surviving client's stream)."""
+    rng = np.random.default_rng(16)
+    eng = make_engine(runner)
+    a = eng.add_request(rng.integers(0, CFG.vocab_size, 9).tolist(),
+                        greedy(6, ignore_eos=True))
+    b = eng.add_request(rng.integers(0, CFG.vocab_size, 9).tolist(),
+                        greedy(6, ignore_eos=True))
+    got_b_tokens = []
+    # Step until every remaining token rides the in-flight pipeline, then
+    # abort `a` while both are mid-flight.
+    for _ in range(10_000):
+        for ev in eng.step():
+            if ev.request is b:
+                got_b_tokens.extend(ev.new_token_ids)
+        if eng._inflight and eng._decode_budget_satisfied():
+            break
+        assert eng.has_work()
+    events = eng.abort_request(a)
+    for ev in events:
+        if ev.request is b:
+            got_b_tokens.extend(ev.new_token_ids)
+    while not b.is_finished() and eng.has_work():
+        # drain may not have covered b's full budget
+        for ev in eng.step():
+            if ev.request is b:
+                got_b_tokens.extend(ev.new_token_ids)
+    assert b.is_finished()
+    assert got_b_tokens == b.generated_ids, (
+        "sibling tokens lost: stream events disagree with the request state")
+
+
 def test_warmup_prefill_covers_live_shapes(runner, monkeypatch):
     """Every (batch, length) prefill shape the scheduler emits under bursty
     traffic must already be warmed — the warmup's reason to exist is that a
