@@ -78,7 +78,7 @@ def make_pp_pipeline(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
     m = num_microbatches
 
     @partial(jax.shard_map, mesh=mesh, axis_names={AXIS_PP},
-             in_specs=(P(AXIS_PP), P()), out_specs=P(), check_vma=False)
+             in_specs=(P(AXIS_PP), P()), out_specs=(P(), P()), check_vma=False)
     def pipeline(local_layers, x_mb):
         p = jax.lax.axis_index(AXIS_PP)
         mb, t = x_mb.shape[1], x_mb.shape[2]
@@ -90,13 +90,11 @@ def make_pp_pipeline(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
 
         def run_stage(x):
             def body(x, lp):
-                # aux is always 0 here: MoE configs are rejected at
-                # make_pp_train_step entry (aux banking is unimplemented).
-                y, _aux = decoder_layer(x, lp, cfg, sin, cos, positions,
-                                        seq_lens)
-                return y, None
-            x, _ = jax.lax.scan(body, x, local_layers)
-            return x
+                y, aux = decoder_layer(x, lp, cfg, sin, cos, positions,
+                                       seq_lens)
+                return y, aux
+            x, auxs = jax.lax.scan(body, x, local_layers)
+            return x, jnp.sum(auxs)
 
         if remat:
             run_stage = jax.checkpoint(run_stage)
@@ -104,12 +102,17 @@ def make_pp_pipeline(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
         perm = [(i, (i + 1) % pp) for i in range(pp)]
 
         def tick(carry, tk):
-            x_cur, out = carry
+            x_cur, out, aux_acc = carry
             # Stage 0 injects microbatch tk; warm-up/drain ticks past M just
             # recycle the last one — their results are never banked.
             inject = x_mb[jnp.minimum(tk, m - 1)]
             x_in = jnp.where(p == 0, inject, x_cur)
-            y = run_stage(x_in)
+            y, aux = run_stage(x_in)
+            # Stage p holds real microbatch tk-p exactly when 0 <= tk-p < M;
+            # warm-up (zero-input) and drain (recycled-input) ticks must not
+            # contribute their layers' MoE load-balance terms.
+            aux_valid = (tk >= p) & (tk - p < m)
+            aux_acc = aux_acc + jnp.where(aux_valid, aux, 0.0)
             # Last stage banks finished microbatch tk-(pp-1); other stages
             # (and warm-up ticks) rewrite the slot with its current value.
             slot = jnp.clip(tk - (pp - 1), 0, m - 1)
@@ -118,14 +121,17 @@ def make_pp_pipeline(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
             out = jax.lax.dynamic_update_index_in_dim(
                 out, jnp.where(take, y, prev), slot, 0)
             x_next = jax.lax.ppermute(y, AXIS_PP, perm)
-            return (x_next, out), None
+            return (x_next, out, aux_acc), None
 
-        (x_last, out), _ = jax.lax.scan(
-            tick, (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb)),
+        (x_last, out, aux_acc), _ = jax.lax.scan(
+            tick,
+            (jnp.zeros_like(x_mb[0]), jnp.zeros_like(x_mb), jnp.float32(0.0)),
             jnp.arange(m + pp - 1, dtype=jnp.int32))
-        # Only the last stage banked anything; everyone else holds zeros, so
-        # one psum broadcasts the result and the loss stays in GSPMD outside.
-        return jax.lax.psum(out, AXIS_PP)
+        # Only the last stage banked activations; everyone else holds zeros,
+        # so one psum broadcasts the result (and totals the per-stage aux
+        # sums) and the loss stays in GSPMD outside. aux is the sum over
+        # (layer, microbatch); the caller averages over microbatches.
+        return jax.lax.psum(out, AXIS_PP), jax.lax.psum(aux_acc, AXIS_PP)
 
     return pipeline
 
@@ -136,24 +142,25 @@ def make_pp_train_step(
     optimizer: Optional[optax.GradientTransformation] = None,
     num_microbatches: int = 2,
     remat: bool = True,
+    moe_aux_coeff: float = 0.01,
 ):
     """Pipelined analog of training/train.py:make_train_step over a
     (dp, pp, tp) mesh. Composes with dp (batch dim, GSPMD) and tp (Megatron
     specs inside each stage, GSPMD); sp must be 1 — ring attention partitions
     the sequence the schedule's activations don't (future work).
-    Requires cfg.num_layers % pp == 0 and batch % num_microbatches == 0."""
+    Requires cfg.num_layers % pp == 0 and batch % num_microbatches == 0.
+
+    MoE configs add the Switch load-balance term like the plain step, with
+    one gradient-accumulation-style caveat: each tick's aux is computed over
+    its MICROBATCH's tokens and the terms are averaged, so the objective is
+    mean_m aux(microbatch_m), not aux(full batch) — the f·P products are
+    means over fewer tokens. Routing, capacity drops, and the forward
+    activations are exactly microbatch-invariant (capacity competition is
+    per sequence, models/moe.py expert_capacity), so only the aux scalar
+    differs from the unpipelined objective.
+    """
     from agentic_traffic_testing_tpu.parallel.mesh import AXIS_TP
     from agentic_traffic_testing_tpu.training.train import causal_lm_loss
-
-    if cfg.num_experts:
-        # The GPipe schedule banks only activations between stages; MoE's
-        # per-layer aux losses would be silently dropped (no load balancing
-        # -> expert collapse). Refuse rather than mistrain; the plain
-        # (dp, sp, tp) step trains MoE with the aux term.
-        raise NotImplementedError(
-            "pipelined MoE training is not supported: the pipeline step "
-            "does not bank per-layer load-balance aux losses — use "
-            "make_train_step (dp/sp/tp) for MoE configs")
 
     pp = mesh.shape[AXIS_PP]
     validate_tp(cfg, mesh.shape[AXIS_TP])  # same guard as the plain path
@@ -168,15 +175,20 @@ def make_pp_train_step(
     pipeline = make_pp_pipeline(cfg, mesh, m, remat=remat)
     batch_sharding = NamedSharding(mesh, P(AXIS_DP, None))
 
+    with_aux = bool(cfg.num_experts) and moe_aux_coeff != 0.0
+
     def loss_fn(params, tokens, mask):
         b, t = tokens.shape
         x = embed_lookup(params["tok_embed"], tokens,
                          dtype=params["final_norm"].dtype)
-        h = pipeline(params["layers"], x.reshape(m, b // m, t, -1))
+        h, aux = pipeline(params["layers"], x.reshape(m, b // m, t, -1))
         h = rms_norm(h.reshape(b, t, -1), params["final_norm"],
                      cfg.rms_norm_eps)
         logits = dense(h, params["unembed"]).astype(jnp.float32)
-        return causal_lm_loss(logits, tokens, mask)
+        loss = causal_lm_loss(logits, tokens, mask)
+        if with_aux:
+            loss = loss + moe_aux_coeff * aux / m  # mean over microbatches
+        return loss
 
     @partial(jax.jit, donate_argnums=(0, 1))
     def step_fn(params, opt_state, tokens, mask):

@@ -150,15 +150,60 @@ def test_train_step_includes_aux_loss():
         np.testing.assert_allclose(float(loss), lm + coeff * aux, rtol=1e-5)
 
 
-def test_pipeline_rejects_moe():
-    """GPipe banks only activations; MoE must be refused, not mistrained."""
+def test_pipeline_moe_matches_microbatched_oracle():
+    """Pipelined MoE training banks each tick's load-balance aux: the loss
+    must equal lm(full batch) + coeff * mean_m aux(microbatch_m) — the
+    gradient-accumulation convention (routing/drops are microbatch-invariant
+    since capacity competition is per sequence, so only the aux means
+    differ from the unpipelined objective) — and one optimizer step must
+    match a pure-GSPMD oracle of that exact objective."""
     import optax
 
+    from agentic_traffic_testing_tpu.models.llama import forward_full_impl
     from agentic_traffic_testing_tpu.parallel.mesh import make_mesh
-    from agentic_traffic_testing_tpu.parallel.pipeline import make_pp_train_step
+    from agentic_traffic_testing_tpu.parallel.pipeline import (
+        init_pp_train_state,
+        make_pp_train_step,
+    )
+    from agentic_traffic_testing_tpu.training.train import (
+        causal_lm_loss,
+        init_train_state,
+    )
 
-    with pytest.raises(NotImplementedError, match="aux"):
-        make_pp_train_step(MOE_CFG, make_mesh(1, 1, 1, pp=2), optax.sgd(0.0))
+    cfg, m, coeff = MOE_CFG, 2, 0.05
+    rng = np.random.default_rng(9)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (4, 16)), jnp.int32)
+    mask = jnp.ones((4, 16), jnp.float32)
+    opt = optax.adamw(1e-3)
+
+    mesh1 = make_mesh(1, 1, 1, devices=jax.devices()[:1])
+    ref_params, ref_opt = init_train_state(cfg, mesh1, opt, seed=3)
+
+    def oracle_loss(params):
+        logits = forward_full_impl(params, cfg, tokens)
+        lm = causal_lm_loss(logits, tokens, mask)
+        mb = tokens.shape[0] // m
+        aux = sum(
+            forward_full_impl(params, cfg, tokens[i * mb:(i + 1) * mb],
+                              with_aux=True)[1]
+            for i in range(m))
+        return lm + coeff * aux / m
+
+    loss_ref, grads = jax.jit(jax.value_and_grad(oracle_loss))(ref_params)
+    updates, _ = opt.update(grads, ref_opt, ref_params)
+    ref_after = optax.apply_updates(ref_params, updates)
+
+    mesh = make_mesh(pp=2)
+    pp_params, pp_opt = init_pp_train_state(cfg, mesh, opt, seed=3)
+    step = make_pp_train_step(cfg, mesh, opt, num_microbatches=m,
+                              moe_aux_coeff=coeff)
+    pp_params, _, loss_pp = step(pp_params, pp_opt, tokens, mask)
+    assert np.isclose(float(loss_pp), float(loss_ref), atol=1e-5), (
+        float(loss_pp), float(loss_ref))
+    for a, b in zip(jax.tree_util.tree_leaves(ref_after),
+                    jax.tree_util.tree_leaves(pp_params)):
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   atol=2e-5, rtol=2e-5)
 
 
 def test_engine_capacity_override_and_validation():
