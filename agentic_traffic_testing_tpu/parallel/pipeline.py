@@ -42,6 +42,7 @@ from agentic_traffic_testing_tpu.models.config import ModelConfig
 from agentic_traffic_testing_tpu.models.llama import decoder_layer, init_params
 from agentic_traffic_testing_tpu.models.quant import dense, embed_lookup
 from agentic_traffic_testing_tpu.ops.jnp_ops import rms_norm, rope_sin_cos
+from agentic_traffic_testing_tpu.ops.ring_attention import ring_attention
 from agentic_traffic_testing_tpu.parallel.mesh import (
     AXIS_DP,
     AXIS_PP,
@@ -68,30 +69,48 @@ def pp_param_pspecs(cfg: ModelConfig) -> dict:
 
 def make_pp_pipeline(cfg: ModelConfig, mesh: Mesh, num_microbatches: int,
                      remat: bool = True):
-    """Build pipeline(local_layers, x_mb) -> activations, shard_mapped over pp.
+    """Build pipeline(local_layers, x_mb) -> activations, shard_mapped over
+    pp (and sp when the mesh has one).
 
-    x_mb: [M, mb, T, D] microbatched embeddings, pp-replicated (dp sharding
-    of the mb dim keeps riding GSPMD — `pp` is the only manual axis here).
+    x_mb: [M, mb, T, D] microbatched embeddings, pp-replicated with T
+    sharded over `sp` (dp sharding of the mb dim and tp sharding inside
+    each stage keep riding GSPMD — only pp/sp are manual here). With sp > 1
+    the attention site is ring attention over the sp axis (the activations
+    each stage hands to the next stay sequence-sharded; KV shards rotate
+    over ICI inside each layer — ops/ring_attention.py), and RoPE positions
+    are offset by the shard's global sequence start.
     Returns the post-stack activations in the same layout.
     """
     pp = mesh.shape[AXIS_PP]
+    sp = mesh.shape[AXIS_SP]
     m = num_microbatches
+    x_spec = P(None, None, AXIS_SP, None)
 
-    @partial(jax.shard_map, mesh=mesh, axis_names={AXIS_PP},
-             in_specs=(P(AXIS_PP), P()), out_specs=(P(), P()), check_vma=False)
+    @partial(jax.shard_map, mesh=mesh, axis_names={AXIS_PP, AXIS_SP},
+             in_specs=(P(AXIS_PP), x_spec), out_specs=(x_spec, P()),
+             check_vma=False)
     def pipeline(local_layers, x_mb):
         p = jax.lax.axis_index(AXIS_PP)
-        mb, t = x_mb.shape[1], x_mb.shape[2]
-        positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None],
-                                     (mb, t))
+        mb, t = x_mb.shape[1], x_mb.shape[2]  # t = LOCAL (per-sp-shard) len
+        start = jax.lax.axis_index(AXIS_SP) * t
+        positions = jnp.broadcast_to(
+            start + jnp.arange(t, dtype=jnp.int32)[None], (mb, t))
         seq_lens = jnp.full((mb,), t, jnp.int32)
         sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta,
                                 cfg.rope_scaling)
 
+        attn_fn = None
+        if sp > 1:
+            def attn_fn(q, k, v, *, q_positions=None, kv_valid_len=None):
+                # Positions are the implicit global arange (the offsets
+                # above feed only RoPE); full-sequence forward only, like
+                # training/train.py's adapter.
+                return ring_attention(q, k, v, axis_name=AXIS_SP)
+
         def run_stage(x):
             def body(x, lp):
                 y, aux = decoder_layer(x, lp, cfg, sin, cos, positions,
-                                       seq_lens)
+                                       seq_lens, attn_fn=attn_fn)
                 return y, aux
             x, auxs = jax.lax.scan(body, x, local_layers)
             return x, jnp.sum(auxs)
@@ -146,9 +165,11 @@ def make_pp_train_step(
 ):
     """Pipelined analog of training/train.py:make_train_step over a
     (dp, pp, tp) mesh. Composes with dp (batch dim, GSPMD) and tp (Megatron
-    specs inside each stage, GSPMD); sp must be 1 — ring attention partitions
-    the sequence the schedule's activations don't (future work).
-    Requires cfg.num_layers % pp == 0 and batch % num_microbatches == 0.
+    specs inside each stage, GSPMD) and sp (sequence dim sharded through
+    the schedule; ring attention over sp inside every stage — dense configs
+    only, since MoE capacity/aux semantics are defined over the full
+    sequence). Requires cfg.num_layers % pp == 0, batch %
+    num_microbatches == 0, and T % sp == 0.
 
     MoE configs add the Switch load-balance term like the plain step, with
     one gradient-accumulation-style caveat: each tick's aux is computed over
@@ -164,16 +185,18 @@ def make_pp_train_step(
 
     pp = mesh.shape[AXIS_PP]
     validate_tp(cfg, mesh.shape[AXIS_TP])  # same guard as the plain path
-    if mesh.shape[AXIS_SP] != 1:
-        raise ValueError("pipeline training requires sp=1 (ring attention "
-                         "and pp stages are not composed yet)")
+    if mesh.shape[AXIS_SP] != 1 and cfg.num_experts:
+        raise ValueError(
+            "pipelined MoE requires sp=1: expert capacity and the "
+            "load-balance aux are defined over the full sequence, which "
+            "sequence sharding would silently change")
     if cfg.num_layers % pp:
         raise ValueError(
             f"num_layers={cfg.num_layers} not divisible by pp={pp}")
     optimizer = optimizer or optax.adamw(3e-4, weight_decay=0.01)
     m = num_microbatches
     pipeline = make_pp_pipeline(cfg, mesh, m, remat=remat)
-    batch_sharding = NamedSharding(mesh, P(AXIS_DP, None))
+    batch_sharding = NamedSharding(mesh, P(AXIS_DP, AXIS_SP))
 
     with_aux = bool(cfg.num_experts) and moe_aux_coeff != 0.0
 
@@ -190,10 +213,15 @@ def make_pp_train_step(
             loss = loss + moe_aux_coeff * aux / m  # mean over microbatches
         return loss
 
+    sp = mesh.shape[AXIS_SP]
+
     @partial(jax.jit, donate_argnums=(0, 1))
     def step_fn(params, opt_state, tokens, mask):
         if tokens.shape[0] % m:
             raise ValueError(f"batch {tokens.shape[0]} % microbatches {m} != 0")
+        if tokens.shape[1] % sp:
+            raise ValueError(
+                f"sequence length {tokens.shape[1]} % sp {sp} != 0")
         tokens = jax.lax.with_sharding_constraint(tokens, batch_sharding)
         mask = jax.lax.with_sharding_constraint(mask, batch_sharding)
         loss, grads = jax.value_and_grad(loss_fn)(params, tokens, mask)

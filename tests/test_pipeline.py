@@ -81,11 +81,30 @@ def test_pp_composes_with_dp_and_tp():
     assert len(wq.sharding.spec) >= 1 and wq.sharding.spec[0] == "pp"
 
 
+def test_pp_composes_with_sp():
+    """(dp=2, sp=2, pp=2): activations stay sequence-sharded through the
+    schedule and every stage attends via ring attention over sp — loss and
+    stepped params still match the unpipelined, unsharded step."""
+    ref_loss, ref_params = run_one_step(make_mesh(), pipelined=False)
+    loss, params = run_one_step(make_mesh(dp=2, sp=2, pp=2), pipelined=True,
+                                num_microbatches=2)
+    assert np.isclose(loss, ref_loss, atol=1e-5), (loss, ref_loss)
+    for a, b_ in zip(jax.tree_util.tree_leaves(ref_params),
+                     jax.tree_util.tree_leaves(params)):
+        np.testing.assert_allclose(np.asarray(b_), np.asarray(a),
+                                   atol=2e-5, rtol=2e-5)
+
+
 def test_pp_validations():
     with pytest.raises(ValueError, match="divisible"):
         make_pp_train_step(CFG, make_mesh(pp=3))
+    moe_cfg = ModelConfig(
+        name="pp-moe-sp", vocab_size=256, hidden_size=64,
+        intermediate_size=128, num_layers=4, num_heads=4, num_kv_heads=2,
+        head_dim=16, num_experts=4, num_experts_per_tok=2,
+    )
     with pytest.raises(ValueError, match="sp=1"):
-        make_pp_train_step(CFG, make_mesh(sp=2, pp=2))
+        make_pp_train_step(moe_cfg, make_mesh(sp=2, pp=2))
 
 
 def test_pp_pspecs_shape():
