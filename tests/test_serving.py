@@ -38,6 +38,74 @@ EXPECTED_METRIC_FAMILIES = [
 ]
 
 
+def test_server_config_env_contract(monkeypatch):
+    """The LLM_* env surface is the reference's operator contract
+    (reference: llm/serve_llm.py:52-82): every knob must parse from env,
+    and unset optionals stay None rather than becoming 0/""."""
+    env = {
+        "LLM_MODEL": "llama-3.2-3b",
+        "LLM_DTYPE": "bfloat16",
+        "LLM_MAX_NUM_SEQS": "10",
+        "LLM_MAX_NUM_BATCHED_TOKENS": "4096",
+        "LLM_GPU_MEMORY_UTILIZATION": "0.8",
+        "LLM_MAX_MODEL_LEN": "2048",
+        "LLM_MAX_TOKENS": "256",
+        "LLM_PROMPT_SAFETY_MARGIN_TOKENS": "64",
+        "LLM_TEMPERATURE": "0.4",
+        "LLM_HOST": "127.0.0.9",
+        "LLM_PORT": "8123",
+        "LLM_TP_SIZE": "2",
+        "LLM_QUANTIZATION": "int8",
+        "LLM_DECODE_STEPS": "32",
+        "LLM_PREFILL_CHUNK_TOKENS": "1024",
+        "LLM_PREFILL_BATCH_MAX_LEN": "512",
+        "LLM_PREFIX_CACHING": "1",
+        "LLM_NUM_BLOCKS": "2048",
+        "LLM_BLOCK_SIZE": "32",
+        "LLM_WEIGHTS_PATH": "/ckpts/llama",
+        "LLM_ALLOW_RANDOM_WEIGHTS": "1",
+        "LLM_MOE_CAPACITY_FACTOR": "4.0",
+        "LLM_SPECULATION": "ngram",
+        "LLM_SPEC_TOKENS": "4",
+        "LLM_SPEC_NGRAM": "2",
+        "LLM_WARMUP": "0",
+        "LLM_METRICS_ENABLED": "0",
+        "LOG_LLM_REQUESTS": "1",
+        "LLM_LOG_MAX_CHARS": "99",
+    }
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    c = ServerConfig.from_env()
+    assert (c.model, c.dtype) == ("llama-3.2-3b", "bfloat16")
+    assert (c.max_num_seqs, c.max_num_batched_tokens) == (10, 4096)
+    assert (c.memory_utilization, c.safety_margin_tokens) == (0.8, 64)
+    assert (c.max_model_len, c.max_tokens) == (2048, 256)
+    assert c.temperature == 0.4
+    assert (c.host, c.port) == ("127.0.0.9", 8123)
+    assert (c.tp_size, c.quantization, c.decode_steps) == (2, "int8", 32)
+    assert (c.prefill_chunk_tokens, c.prefill_batch_max_len) == (1024, 512)
+    assert (c.prefix_caching, c.num_blocks, c.block_size) == (True, 2048, 32)
+    assert (c.weights_path, c.allow_random_weights) == ("/ckpts/llama", True)
+    assert c.moe_capacity_factor == 4.0
+    assert (c.speculation, c.spec_tokens, c.spec_ngram) == ("ngram", 4, 2)
+    assert (c.warmup, c.metrics_enabled) == (False, False)
+    assert (c.log_requests, c.log_max_chars) == (True, 99)
+
+    for k in env:
+        monkeypatch.delenv(k)
+    # Hermetic second half: clear optionals a CI environment might export.
+    for k in ("LLM_NUM_BLOCKS", "LLM_WEIGHTS_PATH", "LLM_MOE_CAPACITY_FACTOR"):
+        monkeypatch.delenv(k, raising=False)
+    d = ServerConfig.from_env()
+    # Unset optionals are None (auto), not zero/empty-string coercions.
+    assert d.prefill_batch_max_len is None
+    assert d.decode_steps is None
+    assert d.quantization is None
+    assert d.speculation is None
+    assert d.num_blocks is None
+    assert d.moe_capacity_factor is None
+
+
 @pytest.fixture(scope="module")
 def server():
     cfg = ServerConfig(
