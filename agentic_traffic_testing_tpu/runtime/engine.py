@@ -382,27 +382,35 @@ class LLMEngine:
         scfg = self.scheduler.cfg
         cap = min(scfg.prefill_batch_max_len,
                   max_len if max_len is not None else scfg.prefill_batch_max_len)
+        # Prompts past the batching cap still take the batched-prefill path
+        # SOLO (the scheduler's cap only limits batches of >= 2 members), up
+        # to the chunk threshold's bucket — past that they route through the
+        # chunk path (warmup_chunk_buckets' territory) and warming batched
+        # shapes would be pure wasted startup time.
+        solo_cap = max(scfg.prefill_buckets)
         if scfg.prefill_chunk_tokens is not None:
-            # Longer prompts route solo through the chunk path; no batched
-            # prefill bucket past the chunk threshold's own bucket can ever
-            # dispatch, so warming it would be pure wasted startup time.
             chunk_bucket = bucket_up(scfg.prefill_chunk_tokens,
                                      scfg.prefill_buckets)
-            cap = min(cap, -(-chunk_bucket // self.cfg.block_size)
-                      * self.cfg.block_size)
+            solo_cap = (-(-chunk_bucket // self.cfg.block_size)
+                        * self.cfg.block_size)
+        cap = min(cap, solo_cap)
         lens = sorted({-(-t // self.cfg.block_size) * self.cfg.block_size
                        for t in scfg.prefill_buckets})
         n = 0
         for t in lens:
-            if t < min_len or t > cap:
+            if t < min_len or t > solo_cap:
                 continue
             # The scheduler bounds the UNPADDED member count by the token
             # budget, then pads UP to a batch bucket — so the largest live
             # shape at this length is bucket_up(k_max), not the largest
-            # bucket with b*t under the budget.
-            k_max = max(1, min(scfg.max_num_seqs,
-                               scfg.max_num_batched_tokens // t))
-            b_cap = bucket_up(k_max, scfg.batch_buckets)
+            # bucket with b*t under the budget. Above the batching cap only
+            # the solo shape is live.
+            if t > cap:
+                b_cap = 1
+            else:
+                k_max = max(1, min(scfg.max_num_seqs,
+                                   scfg.max_num_batched_tokens // t))
+                b_cap = bucket_up(k_max, scfg.batch_buckets)
             for b in scfg.batch_buckets:
                 if b > b_cap:
                     break

@@ -263,8 +263,10 @@ def test_warmup_prefill_buckets_harmless(runner):
     eng = make_engine(runner, prefill_batch_max_len=64)
     ref = eng.generate(prompt, greedy(6)).generated_ids
     n = eng.warmup_prefill_buckets()
-    # tiny engine: length buckets {32, 64} x batch buckets {1, 2, 4}
-    assert n == 6
+    # tiny engine: length buckets {32, 64} x batch buckets {1, 2, 4}, plus
+    # the solo (1, 128) shape past the batching cap (solo prompts above the
+    # cap still take the batched-prefill path with batch 1).
+    assert n == 7
     assert eng.generate(prompt, greedy(6)).generated_ids == ref
 
 
@@ -365,7 +367,9 @@ def test_warmup_prefill_covers_live_shapes(runner, monkeypatch):
     shapes.clear()
 
     rng = np.random.default_rng(14)
-    for lens in [(60, 57, 49), (20, 22), (9,), (33, 40, 61)]:
+    # (100,) lands above the 64-token batching cap: still the batched-prefill
+    # path, solo — warmup must have compiled that (1, 128) shape too.
+    for lens in [(60, 57, 49), (20, 22), (9,), (33, 40, 61), (100,)]:
         reqs = [eng.add_request(rng.integers(0, CFG.vocab_size, n).tolist(),
                                 greedy(4)) for n in lens]
         run_all(eng, reqs)
