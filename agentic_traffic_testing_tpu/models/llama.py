@@ -29,7 +29,13 @@ import jax
 import jax.numpy as jnp
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig
-from agentic_traffic_testing_tpu.models.quant import QTensor, dense, embed_lookup
+from agentic_traffic_testing_tpu.models.quant import (
+    Q4Slice,
+    QTensor,
+    QTensor4,
+    dense,
+    embed_lookup,
+)
 from agentic_traffic_testing_tpu.ops.attention_backend import paged_decode_attention
 from agentic_traffic_testing_tpu.ops.kv_writer import write_prompt_pages
 from agentic_traffic_testing_tpu.ops.jnp_ops import (
@@ -105,27 +111,44 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 
 def init_params_quantized(cfg: ModelConfig, seed: int = 0,
-                          dtype=jnp.bfloat16) -> Params:
-    """Random-init DIRECTLY in int8 (checkpoint-free benches/tests of big
-    configs: an 8B in bf16 alone overflows one v5e chip's HBM, and even a
-    host-side fp32 init of it costs minutes of RNG + tunnel transfer).
-    Weights are uniform int8 with a constant per-tensor scale chosen so the
+                          dtype=jnp.bfloat16, scheme: str = "int8") -> Params:
+    """Random-init DIRECTLY in int8/int4 (checkpoint-free benches/tests of
+    big configs: an 8B in bf16 alone overflows one v5e chip's HBM, and even
+    a host-side fp32 init of it costs minutes of RNG + tunnel transfer).
+    Weights are uniform with a constant per-tensor scale chosen so the
     dequantized std matches init_params' 0.02 — statistically equivalent for
     perf work, never materialized in float anywhere."""
     import numpy as np
 
+    if scheme not in ("int8", "int4"):
+        raise ValueError(f"unknown quantization scheme {scheme!r}")
     d, hd, f = cfg.hidden_size, cfg.head_dim_, cfg.intermediate_size
     h, kh, L, v = cfg.num_heads, cfg.num_kv_heads, cfg.num_layers, cfg.vocab_size
     rng = np.random.default_rng(seed)
     # uniform[-127,127] has std ~73.3; scale it back to weight std 0.02.
     SCALE = np.float32(0.02 / 73.3)
+    # uniform[-8,7] nibbles have std ~4.6.
+    SCALE4 = np.float32(0.02 / 4.6)
 
-    def qw(shape, axis=-2):
+    def qw8(shape, axis=-2):
         q = rng.integers(-127, 128, size=shape, dtype=np.int8)
         sshape = list(shape)
         sshape[axis] = 1
         return QTensor(q=jnp.asarray(q),
                        scale=jnp.full(sshape, SCALE, jnp.float32))
+
+    def qw4(shape, axis=-2):
+        # Random bytes ARE two uniform random nibbles each; pack along the
+        # last axis (QTensor4 half-pairing — layout is moot for random init).
+        pshape = list(shape)
+        pshape[-1] //= 2
+        packed = rng.integers(-128, 128, size=pshape, dtype=np.int8)
+        sshape = list(shape)
+        sshape[-2:] = [2, shape[-1] // 2]
+        return QTensor4(packed=jnp.asarray(packed),
+                        scale=jnp.full(sshape, SCALE4, jnp.float32))
+
+    qw = qw8 if scheme == "int8" else qw4
 
     layers: dict = {
         "ln_attn": jnp.ones((L, d), dtype),
@@ -136,6 +159,10 @@ def init_params_quantized(cfg: ModelConfig, seed: int = 0,
         "wo": qw((L, h * hd, d)),
     }
     if cfg.num_experts:
+        if scheme == "int4":
+            raise NotImplementedError(
+                "int4 x MoE is not wired: expert einsums dispatch on QTensor "
+                "(models/moe.py) — serve MoE configs with int8")
         e = cfg.num_experts
         # Router math runs fp regardless (models/moe.py router_topk);
         # expert SwiGLUs quantize per (expert, output channel).
@@ -157,12 +184,35 @@ def init_params_quantized(cfg: ModelConfig, seed: int = 0,
         "layers": layers,
         "final_norm": jnp.ones((d,), dtype),
     }
-    if cfg.tie_word_embeddings:
+    if cfg.tie_word_embeddings and scheme == "int8":
         te = params["tok_embed"]
         params["unembed"] = QTensor(q=te.q.T, scale=jnp.full((1, v), SCALE, jnp.float32))
     else:
+        # int4: packed nibbles can't be transposed in place — random-init an
+        # independent unembed (statistically identical for perf work).
         params["unembed"] = qw((d, v))
     return params
+
+
+def _scan_split(layers: dict):
+    """Partition stacked layer params into scan-able xs and closure-held
+    int4 leaves. A QTensor4 must NOT ride `lax.scan` xs: the scan's
+    per-iteration slice would materialize the full packed layer in HBM,
+    exactly the copy the pallas kernel's layer-indirected BlockSpec avoids
+    (ops/pallas/int4_matmul.py)."""
+    xs = {k: v for k, v in layers.items() if not isinstance(v, QTensor4)}
+    held = {k: v for k, v in layers.items() if isinstance(v, QTensor4)}
+    return xs, held
+
+
+def _merge_lp(xs_lp: dict, held: dict, li) -> dict:
+    """Rebuild the per-layer param dict inside a scan body: sliced xs leaves
+    plus Q4Slice views (stacked tensor + layer index) for held leaves."""
+    if not held:
+        return xs_lp
+    lp = dict(xs_lp)
+    lp.update({k: Q4Slice(v, li) for k, v in held.items()})
+    return lp
 
 
 def _qkv(x: jax.Array, lp: dict, cfg: ModelConfig):
@@ -243,11 +293,15 @@ def forward_full_impl(params: Params, cfg: ModelConfig, tokens: jax.Array,
     x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)
     sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     seq_lens = jnp.full((b,), t, jnp.int32)
+    xs_layers, held = _scan_split(params["layers"])
 
-    def body(x, lp):
+    def body(x, xs):
+        xs_lp, li = xs
+        lp = _merge_lp(xs_lp, held, li)
         return decoder_layer(x, lp, cfg, sin, cos, positions, seq_lens, attn_fn)
 
-    x, aux = jax.lax.scan(body, x, params["layers"])
+    x, aux = jax.lax.scan(
+        body, x, (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)))
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     logits = _unembed(x, params, cfg)
     return (logits, jnp.sum(aux)) if with_aux else logits
@@ -313,12 +367,15 @@ def prefill_impl(
         return causal_attention(q, k, v, q_positions=positions,
                                 kv_valid_len=seq_lens)
 
+    xs_layers, held = _scan_split(params["layers"])
+
     def body(x, xs):
-        lp, li = xs
+        xs_lp, li = xs
+        lp = _merge_lp(xs_lp, held, li)
         return _prefill_layer_body(x, lp, li, cfg, sin, cos, attn_site, cache)
 
     x, (ks, vs) = jax.lax.scan(
-        body, x, (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
+        body, x, (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)))
     kc, vc = write_prompt_pages(cache.k, cache.v, ks, vs, block_tables,
                                 mode=kv_writer_mode)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -383,12 +440,15 @@ def prefill_chunk_impl(
             kv_valid_mask=kv_mask,
         )
 
+    xs_layers, held = _scan_split(params["layers"])
+
     def body(x, xs):
-        lp, li = xs
+        xs_lp, li = xs
+        lp = _merge_lp(xs_lp, held, li)
         return _prefill_layer_body(x, lp, li, cfg, sin, cos, attn_site, cache)
 
     x, (ks, vs) = jax.lax.scan(
-        body, x, (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
+        body, x, (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)))
     # The chunk offset is a traced scalar, which only the DUS writer supports
     # — remap the (env- or caller-chosen) pallas/interpret writer to it.
     from agentic_traffic_testing_tpu.ops.kv_writer import writer_choice
@@ -468,9 +528,12 @@ def verify_step_impl(
     # live context for this step's kept tokens) — route them to trash.
     capacity = block_tables.shape[1] * cache.block_size
 
+    xs_layers, held = _scan_split(params["layers"])
+
     def body(carry, xs):
         x, kc, vc = carry
-        lp, li = xs
+        xs_lp, li = xs
+        lp = _merge_lp(xs_lp, held, li)
         xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
         q, k, v = _qkv(xa, lp, cfg)
         q = apply_rope(q, sin, cos)
@@ -497,7 +560,7 @@ def verify_step_impl(
 
     (x, kc, vc), _ = jax.lax.scan(
         body, (x, cache.k, cache.v),
-        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+        (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return _unembed(x, params, cfg), KVCache(kc, vc)

@@ -62,15 +62,112 @@ quantize_array = functools.partial(
 )
 
 
-def dense(x: jax.Array, w: DenseW) -> jax.Array:
+class QTensor4(NamedTuple):
+    """Per-output-channel symmetric int4 weight, nibble-packed.
+
+    Layout matches ops/pallas/int4_matmul.py: `packed[..., k, j]` holds
+    column j in its low nibble and column j + N/2 in its high nibble
+    (HALF pairing — the kernel then never interleaves vectors); scales are
+    split the same way. The kernel streams true int4 bytes from HBM —
+    measured 1.8x the fused-int8 matmul's wall time per weight-bound step.
+    """
+
+    packed: jax.Array   # int8 [..., K, N//2] nibble pairs
+    scale: jax.Array    # f32 [..., 2, N//2] per-column, split by half
+
+    @property
+    def shape(self):
+        *lead, k, half = self.packed.shape
+        return (*lead, k, 2 * half)
+
+    @property
+    def logical_dtype(self):
+        return self.scale.dtype
+
+
+class Q4Slice(NamedTuple):
+    """One layer's view of a stacked QTensor4 + the (traced) layer index.
+
+    Built inside a layer-scan body: the stacked tensor rides the closure
+    (NOT scan xs — slicing a pallas operand in xs would materialize the
+    full per-layer copy) and the kernel does the indexing in its BlockSpec.
+    """
+
+    stacked: QTensor4
+    layer: jax.Array    # scalar i32
+
+
+def _unpack4(packed: jax.Array, scale: jax.Array, dtype) -> jax.Array:
+    """Dequantize a (possibly leading-dim-stacked) QTensor4 to `dtype`.
+
+    The XLA fallback path (CPU tests, shapes the kernel does not serve):
+    materializes the full weight, so it streams int8-equivalent bytes —
+    correctness-first, the kernel is the fast path.
+    """
+    p32 = packed.astype(jnp.int32)
+    lo = jax.lax.shift_right_arithmetic(
+        jax.lax.shift_left(p32, jnp.int32(28)), jnp.int32(28))
+    hi = jax.lax.shift_right_arithmetic(p32, jnp.int32(4))
+    se = scale[..., 0, :][..., None, :]  # [..., 1, N/2]
+    so = scale[..., 1, :][..., None, :]
+    return jnp.concatenate(
+        [lo.astype(dtype) * se.astype(dtype),
+         hi.astype(dtype) * so.astype(dtype)], axis=-1)
+
+
+def _int4_kernel_ok(rows: int, k: int, half: int) -> bool:
+    """Shapes the pallas kernel serves: small row count (decode/verify) and
+    a lane-tileable half width."""
+    if jax.default_backend() != "tpu":
+        return False
+    if rows > 256:
+        return False  # prefill-sized row blocks: fallback (v1 keeps one shape)
+    return half <= 512 or half % 128 == 0
+
+
+def _int4_n_block(half: int) -> int:
+    if half <= 512:
+        return 2 * half
+    for hb in (512, 384, 256, 128):
+        if half % hb == 0:
+            return 2 * hb
+    raise ValueError(f"no tileable n_block for N/2={half}")
+
+
+def _dense4(x: jax.Array, w: QTensor4, layer=None) -> jax.Array:
+    from agentic_traffic_testing_tpu.ops.pallas.int4_matmul import int4_matmul
+
+    *lead, k = x.shape
+    rows = 1
+    for d in lead:
+        rows *= d
+    half = w.packed.shape[-1]
+    x2 = x.reshape(rows, k)
+    if _int4_kernel_ok(rows, k, half):
+        y = int4_matmul(x2, w.packed, w.scale, layer=0 if layer is None else layer,
+                        n_block=_int4_n_block(half), out_dtype=x.dtype)
+    else:
+        packed, scale = w.packed, w.scale
+        if layer is not None:
+            packed = jax.lax.dynamic_index_in_dim(packed, layer, 0, keepdims=False)
+            scale = jax.lax.dynamic_index_in_dim(scale, layer, 0, keepdims=False)
+        y = x2 @ _unpack4(packed, scale, x.dtype)
+    return y.reshape(*lead, 2 * half)
+
+
+def dense(x: jax.Array, w) -> jax.Array:
     """x @ w for raw or quantized weights (contraction over x's last dim)."""
     if isinstance(w, QTensor):
         y = x @ w.q.astype(x.dtype)
         return y * jnp.squeeze(w.scale, axis=-2).astype(x.dtype)
+    if isinstance(w, QTensor4):
+        return _dense4(x, w)
+    if isinstance(w, Q4Slice):
+        return _dense4(x, w.stacked, layer=w.layer)
     return x @ w
 
 
-def embed_lookup(w: DenseW, ids: jax.Array, dtype=None) -> jax.Array:
+def embed_lookup(w, ids: jax.Array, dtype=None) -> jax.Array:
     """Row gather from an embedding table ([V, D], quantized per column).
 
     `dtype` sets the activation dtype for the quantized path (callers pass
@@ -80,7 +177,30 @@ def embed_lookup(w: DenseW, ids: jax.Array, dtype=None) -> jax.Array:
         rows = w.q[ids].astype(w.scale.dtype)
         out = rows * jnp.squeeze(w.scale, axis=-2)
         return out.astype(dtype if dtype is not None else jnp.bfloat16)
+    if isinstance(w, QTensor4):
+        out_dtype = dtype if dtype is not None else jnp.bfloat16
+        return _unpack4(w.packed[ids], w.scale, out_dtype)
     return w[ids]
+
+
+def _quantize_array4_impl(w: jax.Array) -> QTensor4:
+    """Per-output-column symmetric int4 over the second-to-last (K) axis,
+    packed with half pairing (column j with column j + N/2)."""
+    wf = w.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)       # [..., 1, N]
+    scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+    q = jnp.clip(jnp.round(wf / scale), -8, 7).astype(jnp.int32)
+    n = q.shape[-1]
+    lo = q[..., : n // 2]
+    hi = q[..., n // 2:]
+    packed = jnp.bitwise_or(
+        jnp.left_shift(hi, 4),
+        jnp.bitwise_and(lo, 0xF)).astype(jnp.int8)
+    sc = jnp.concatenate([scale[..., : n // 2], scale[..., n // 2:]], axis=-2)
+    return QTensor4(packed=packed, scale=sc.astype(jnp.float32))
+
+
+quantize_array4 = jax.jit(_quantize_array4_impl)
 
 
 # Param-dict leaves that carry the model's FLOPs/bytes; everything else
@@ -88,13 +208,26 @@ def embed_lookup(w: DenseW, ids: jax.Array, dtype=None) -> jax.Array:
 _QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
 
-def quantize_params(params: dict, delete_originals: bool = False) -> dict:
+def quantize_params(params: dict, delete_originals: bool = False,
+                    scheme: str = "int8") -> dict:
     """Quantize a llama.init_params-schema dict leaf-by-leaf.
 
-    `delete_originals=True` frees each bf16 leaf as soon as its int8 copy
-    exists, bounding peak HBM at (int8 total + one bf16 leaf) — required to
-    quantize an 8B model in place on a 16 GiB chip.
+    `delete_originals=True` frees each bf16 leaf as soon as its quantized
+    copy exists, bounding peak HBM at (quantized total + one bf16 leaf) —
+    required to quantize an 8B model in place on a 16 GiB chip.
+    `scheme`: "int8" (per-column QTensor) or "int4" (nibble-packed QTensor4
+    served by the pallas int4 matmul kernel).
     """
+    if scheme not in ("int8", "int4"):
+        raise ValueError(f"unknown quantization scheme {scheme!r}")
+    if scheme == "int4" and "w_router" in params.get("layers", {}):
+        # Single choke point for every load path (engine random-init,
+        # weights.py checkpoint load, direct callers): the expert einsums
+        # dispatch on the int8 QTensor only (models/moe.py).
+        raise NotImplementedError(
+            "int4 x MoE is not wired — serve MoE configs with int8")
+    qfn = quantize_array if scheme == "int8" else quantize_array4
+
     def free(w) -> None:
         if delete_originals and hasattr(w, "delete"):
             w.delete()  # numpy leaves (host-streamed loads) have no .delete
@@ -104,7 +237,7 @@ def quantize_params(params: dict, delete_originals: bool = False) -> dict:
     layers_out: dict[str, Any] = {}
     for key, w in layers_in.items():
         if key in _QUANT_LAYER_KEYS:
-            layers_out[key] = quantize_array(jnp.asarray(w))
+            layers_out[key] = qfn(jnp.asarray(w))
             free(w)
         else:
             layers_out[key] = jnp.asarray(w)
@@ -112,7 +245,7 @@ def quantize_params(params: dict, delete_originals: bool = False) -> dict:
         if key == "layers":
             continue
         if key in ("tok_embed", "unembed"):
-            out[key] = quantize_array(jnp.asarray(w))
+            out[key] = qfn(jnp.asarray(w))
             free(w)
         else:
             out[key] = jnp.asarray(w)
@@ -121,4 +254,4 @@ def quantize_params(params: dict, delete_originals: bool = False) -> dict:
 
 
 def is_quantized(params: dict) -> bool:
-    return isinstance(params.get("unembed"), QTensor)
+    return isinstance(params.get("unembed"), (QTensor, QTensor4))

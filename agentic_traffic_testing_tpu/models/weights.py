@@ -175,12 +175,12 @@ def load_params(
 ) -> tuple[ModelConfig, dict]:
     """Load params from a local HF directory of safetensors shards.
 
-    With `quantization="int8"` the bf16 tree stays host-side and is
+    With `quantization="int8"`/"int4" the bf16 tree stays host-side and is
     quantized leaf-by-leaf onto the device (models/quant.py) — the full-
     precision model never occupies HBM, which is what lets Llama-3-8B load
     on a single 16 GiB chip.
     """
-    if quantization not in (None, "int8"):  # before the multi-GiB shard read
+    if quantization not in (None, "int8", "int4"):  # before the shard read
         raise ValueError(f"unknown quantization {quantization!r}")
     cfg = cfg or ModelConfig.from_local_dir(model_dir)
     np_dtype = ml_dtypes.bfloat16 if dtype == jnp.bfloat16 else np.dtype(dtype)
@@ -201,10 +201,10 @@ def load_params(
         raise ValueError(f"checkpoint incomplete: missing {sorted(missing)[:8]}...")
     if cfg.tie_word_embeddings:
         params["unembed"][...] = params["tok_embed"].T
-    if quantization == "int8":
+    if quantization:
         from agentic_traffic_testing_tpu.models.quant import quantize_params
 
-        return cfg, quantize_params(params)
+        return cfg, quantize_params(params, scheme=quantization)
     return cfg, _to_jax(params)
 
 

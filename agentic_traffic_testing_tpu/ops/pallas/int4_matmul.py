@@ -1,0 +1,143 @@
+"""Weight-only int4 matmul kernel: stream packed nibbles, unpack in VMEM.
+
+Why a kernel: XLA:TPU cannot fuse nibble-unpacking into an MXU operand read
+— lowering `bitcast_convert_type(s8) -> s4 -> bf16` materializes a doubled
+u8 intermediate in HBM (measured: slower than int8), and the axon plugin
+cannot pass native s4 jit arguments at all. Streaming the PACKED bytes into
+VMEM and unpacking there keeps HBM traffic at true int4 bytes — the whole
+point: weight-bound decode throughput scales with bytes streamed, and int4
+halves int8's. The reference's analog capability (AWQ/GPTQ int4) lives
+inside its vLLM dependency (`--quantization awq`); here it is first-party.
+
+Packing convention (HALF pairing, chosen so the kernel never interleaves
+vectors — Mosaic rejects minor-dim interleave shape casts): byte [k, j]
+holds w[k, j] in its LOW nibble and w[k, j + N/2] in its HIGH nibble. The
+kernel computes the two half-matmuls as two MXU dots per block and emits
+them as two outputs; the caller concatenates once ([B, N/2] ++ [B, N/2] —
+bytes(B·N), trivial next to the K·N/2 weight stream).
+
+Layer indirection: stacked [L, K, N/2] weights ride scalar prefetch, and
+the weight BlockSpec's index_map selects (layer, n-block) — the per-layer
+slice is never materialized (the same pattern as paged_attention.py's page
+streaming; a lax.scan xs slice of a pallas operand would copy it).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _kernel(layer_ref, x_ref, w_ref, s_ref, lo_out, hi_out, acc_e, acc_o, *,
+            out_dtype, k_chunks):
+    # Nibble unpack in int32 (Mosaic legalizes vector shifts only at i32;
+    # i8/i16 shifts fail to legalize): sign-preserving low nibble via
+    # shift-up-then-down, high via shift-down. The K dimension is chunked
+    # (grid minor axis) to bound the unpack intermediates' VMEM footprint —
+    # a whole [14336, 512] i32 block is a 29 MB scoped allocation.
+    kk = pl.program_id(1)
+    w32 = w_ref[0].astype(jnp.int32)                 # [k_blk, hb]
+    lo = jax.lax.shift_right_arithmetic(
+        jax.lax.shift_left(w32, jnp.int32(28)), jnp.int32(28))
+    hi = jax.lax.shift_right_arithmetic(w32, jnp.int32(4))
+    x = x_ref[...]                                   # [B, k_blk]
+    dims = (((1,), (0,)), ((), ()))
+    ye = jax.lax.dot_general(x, lo.astype(x.dtype), dims,
+                             preferred_element_type=jnp.float32)
+    yo = jax.lax.dot_general(x, hi.astype(x.dtype), dims,
+                             preferred_element_type=jnp.float32)
+
+    @pl.when(kk == 0)
+    def _():
+        acc_e[...] = jnp.zeros_like(acc_e)
+        acc_o[...] = jnp.zeros_like(acc_o)
+
+    acc_e[...] += ye
+    acc_o[...] += yo
+
+    @pl.when(kk == k_chunks - 1)
+    def _():
+        lo_out[...] = (acc_e[...] * s_ref[0, 0][None, :]).astype(out_dtype)
+        hi_out[...] = (acc_o[...] * s_ref[0, 1][None, :]).astype(out_dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("n_block", "out_dtype"))
+def int4_matmul(x, packed, scale, layer=None, *, n_block: int = 512,
+                out_dtype=jnp.bfloat16):
+    """y[B, N] = x[B, K] @ unpack(packed) * scale.
+
+    x:      [B, K] bf16/f32 activations (B >= 8 for MXU sublane tiling).
+    packed: [K, N/2] int8 half-pair nibbles (low = column j, high = column
+            j + N/2), or [L, K, N/2] with `layer` a (traced) scalar
+            selecting the layer — no slice materialization.
+    scale:  [2, N/2] f32 per-column scales (row 0 = first half's columns,
+            row 1 = second half's), or [L, 2, N/2].
+    """
+    stacked = packed.ndim == 3
+    if not stacked:
+        packed = packed[None]
+        scale = scale[None]
+        layer = 0
+    L, K, half = packed.shape
+    N = 2 * half
+    hb = n_block // 2
+    if half % hb:
+        raise ValueError(f"N/2={half} not a multiple of n_block/2={hb}")
+    # Chunk K only when the i32 unpack intermediates would blow scoped VMEM
+    # (~16 MB; a whole [14336, 512] i32 block alone is 29 MB) — chunking
+    # costs ~30% at shapes that fit, so small K stays monolithic.
+    k_blk = K
+    if K * hb * 4 > 8_000_000:
+        for cand in (2048, 1024, 512, 256, 128):
+            if K % cand == 0 and cand * hb * 4 <= 8_000_000:
+                k_blk = cand
+                break
+    k_chunks = K // k_blk
+    grid = (half // hb, k_chunks)
+    b = x.shape[0]
+
+    layer_arr = jnp.asarray([layer], jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((b, k_blk), lambda j, kk, s: (0, kk)),
+            pl.BlockSpec((1, k_blk, hb), lambda j, kk, s: (s[0], kk, j)),
+            pl.BlockSpec((1, 2, hb), lambda j, kk, s: (s[0], 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((b, hb), lambda j, kk, s: (0, j)),
+            pl.BlockSpec((b, hb), lambda j, kk, s: (0, j)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((b, hb), jnp.float32),
+            pltpu.VMEM((b, hb), jnp.float32),
+        ],
+    )
+    kernel = pl.pallas_call(
+        functools.partial(_kernel, out_dtype=out_dtype, k_chunks=k_chunks),
+        grid_spec=grid_spec,
+        out_shape=[jax.ShapeDtypeStruct((b, half), out_dtype),
+                   jax.ShapeDtypeStruct((b, half), out_dtype)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("arbitrary", "arbitrary"),
+        ),
+    )
+    ye, yo = kernel(layer_arr, x, packed, scale)
+    return jnp.concatenate([ye, yo], axis=-1)
+
+
+def pack_int4(vals):
+    """Host-side packing oracle: int8 array of int4 values [-8, 7] with even
+    last dim N -> (packed [..., N/2] int8, layout doc above)."""
+    import numpy as np
+
+    n = vals.shape[-1]
+    lo = vals[..., : n // 2]
+    hi = vals[..., n // 2:]
+    return ((hi.astype(np.int16) << 4) | (lo.astype(np.int16) & 0xF)).astype(
+        np.int8)

@@ -105,8 +105,10 @@ class EngineConfig:
     # caching analog); cached requests prefill only their suffix.
     prefix_caching: bool = False
     seed: int = 0
-    # Weight-only quantization: None (serve in `dtype`) or "int8"
-    # (models/quant.py — halves weight HBM so Llama-3-8B fits one v5e chip).
+    # Weight-only quantization: None (serve in `dtype`), "int8"
+    # (models/quant.py — halves weight HBM so Llama-3-8B fits one v5e chip),
+    # or "int4" (nibble-packed, served by the pallas int4 matmul kernel —
+    # halves int8's streamed bytes again; single-chip dense models only).
     quantization: Optional[str] = None
     # MoE expert-capacity override (None -> model default). HF Mixtral drops
     # no tokens; >= num_experts guarantees no capacity drops (exact HF
@@ -127,9 +129,10 @@ class EngineConfig:
         # Fail fast: a typo'd scheme must not silently serve full-precision
         # (or, behind a broad except in the server's weight loader, random)
         # weights.
-        if self.quantization not in (None, "int8"):
+        if self.quantization not in (None, "int8", "int4"):
             raise ValueError(
-                f"unknown quantization {self.quantization!r}; supported: int8")
+                f"unknown quantization {self.quantization!r}; "
+                f"supported: int8, int4")
         if self.speculation not in (None, "ngram"):
             raise ValueError(
                 f"unknown speculation {self.speculation!r}; supported: ngram")
@@ -222,26 +225,44 @@ class LLMEngine:
         dtype = jnp.bfloat16 if cfg.dtype in ("bfloat16", "bf16") else jnp.float32
         platform = jax.devices()[0].platform
         decode_steps = cfg.resolved_decode_steps(platform)
+        if cfg.quantization == "int4" and self.model_cfg.num_experts:
+            raise NotImplementedError(
+                "int4 x MoE is not wired (expert einsums dispatch on the "
+                "int8 QTensor) — serve MoE configs with int8")
         if runner is not None:
             self.runner = runner
             decode_steps = runner.decode_steps
         else:
             if params is None:
                 log.warning("no checkpoint: random-initializing %s", self.model_cfg.name)
-                if cfg.quantization == "int8":
+                if cfg.quantization:
                     from agentic_traffic_testing_tpu.models.llama import init_params_quantized
 
-                    params = init_params_quantized(self.model_cfg, cfg.seed, dtype=dtype)
+                    params = init_params_quantized(self.model_cfg, cfg.seed,
+                                                   dtype=dtype,
+                                                   scheme=cfg.quantization)
                 else:
                     params = init_params(self.model_cfg, jax.random.key(cfg.seed), dtype=dtype)
-            elif cfg.quantization == "int8":
-                from agentic_traffic_testing_tpu.models.quant import is_quantized, quantize_params
+            elif cfg.quantization:
+                from agentic_traffic_testing_tpu.models.quant import (
+                    QTensor4,
+                    is_quantized,
+                    quantize_params,
+                )
 
                 if not is_quantized(params):
                     # No delete_originals: the caller still owns these arrays
                     # (memory-critical loads pre-quantize in weights.py /
                     # init_params_quantized instead).
-                    params = quantize_params(params)
+                    params = quantize_params(params, scheme=cfg.quantization)
+                elif (isinstance(params.get("unembed"), QTensor4)
+                      != (cfg.quantization == "int4")):
+                    # Pre-quantized params of the OTHER scheme: serving them
+                    # would silently mislabel every metric and benchmark.
+                    raise ValueError(
+                        f"engine configured quantization="
+                        f"{cfg.quantization!r} but the supplied params are "
+                        f"quantized with the other scheme")
             self.runner = ModelRunner(
                 self.model_cfg, params, decode_steps=decode_steps,
                 spec_tokens=cfg.effective_spec_tokens,

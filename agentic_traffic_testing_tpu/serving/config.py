@@ -43,7 +43,7 @@ class ServerConfig:
     host: str = "0.0.0.0"                      # LLM_HOST
     port: int = 8000                           # LLM_PORT
     tp_size: int = 1                           # LLM_TP_SIZE (TPU-native knob)
-    quantization: Optional[str] = None         # LLM_QUANTIZATION ("int8" | unset)
+    quantization: Optional[str] = None         # LLM_QUANTIZATION ("int8" | "int4" | unset)
     decode_steps: Optional[int] = None         # LLM_DECODE_STEPS (None -> auto)
     prefill_chunk_tokens: int = 2048           # LLM_PREFILL_CHUNK_TOKENS (0 = off)
     # Batch same-bucket prompt prefills up to this padded length (None ->

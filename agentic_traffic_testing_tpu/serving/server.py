@@ -146,6 +146,11 @@ class LLMServer:
                 # programs from this cfg (LLMEngine re-applies idempotently).
                 model_cfg = dataclasses.replace(
                     model_cfg, moe_capacity_factor=c.moe_capacity_factor)
+            if c.quantization == "int4":
+                raise NotImplementedError(
+                    "int4 x TP is not wired (QTensor4 leaves have no "
+                    "PartitionSpecs yet) — use int8 for tensor-parallel "
+                    "serving, int4 for single-chip")
             params = self._load_params(model_cfg)
             if params is None:
                 dtype = jnp.bfloat16 if c.dtype in ("bfloat16", "bf16") else jnp.float32

@@ -95,7 +95,78 @@ def test_engine_end_to_end_quantized():
 
 def test_unknown_quantization_fails_fast():
     with pytest.raises(ValueError, match="unknown quantization"):
-        EngineConfig(model="tiny", quantization="int4")
+        EngineConfig(model="tiny", quantization="fp6")
+
+
+# ----------------------------------------------------------- int4 (round 2)
+
+
+def test_quantize_array4_reconstruction():
+    from agentic_traffic_testing_tpu.models.quant import (
+        _unpack4,
+        quantize_array4,
+    )
+
+    rng = np.random.default_rng(7)
+    w = jnp.asarray(rng.standard_normal((64, 48)), jnp.float32)
+    qt = quantize_array4(w)
+    assert qt.packed.shape == (64, 24) and qt.packed.dtype == jnp.int8
+    assert qt.scale.shape == (2, 24)
+    deq = np.asarray(_unpack4(qt.packed, qt.scale, jnp.float32))
+    # Per-column scale = amax/7; int4 rounding error is bounded by scale/2.
+    amax = np.abs(np.asarray(w)).max(axis=0)
+    assert np.all(np.abs(deq - np.asarray(w)) <= amax[None, :] / 7 / 2 + 1e-6)
+
+
+def test_pack_int4_unpack_roundtrip():
+    """The kernel-side packing oracle (ops/pallas/int4_matmul.pack_int4) and
+    the model-side unpacker must agree on the half-pairing byte layout —
+    they are the two independent implementations of the convention."""
+    from agentic_traffic_testing_tpu.models.quant import _unpack4
+    from agentic_traffic_testing_tpu.ops.pallas.int4_matmul import pack_int4
+
+    rng = np.random.default_rng(11)
+    vals = rng.integers(-8, 8, (16, 32)).astype(np.int8)
+    packed = jnp.asarray(pack_int4(vals))
+    ones = jnp.ones((2, 16), jnp.float32)
+    got = np.asarray(_unpack4(packed, ones, jnp.float32))
+    np.testing.assert_array_equal(got, vals.astype(np.float32))
+
+
+def test_int4_engine_matches_dequantized_oracle():
+    """The int4 serving path (Q4Slice closures through every scan) must be
+    numerically identical to serving the SAME dequantized weights in full
+    precision — pinning the packing, the layer indexing, and the fallback
+    matmul in one shot."""
+    import jax.tree_util as jtu
+
+    from agentic_traffic_testing_tpu.models.quant import QTensor4, _unpack4
+
+    params = init_params(CFG, jax.random.key(1), dtype=jnp.float32)
+    q4 = quantize_params(params, scheme="int4")
+    assert is_quantized(q4)
+
+    def deq(leaf):
+        if isinstance(leaf, QTensor4):
+            return _unpack4(leaf.packed, leaf.scale, jnp.float32)
+        return leaf
+    deq_params = jtu.tree_map(deq, q4,
+                              is_leaf=lambda x: isinstance(x, QTensor4))
+
+    rng = np.random.default_rng(8)
+    prompts = [rng.integers(0, CFG.vocab_size, n).tolist() for n in (6, 13)]
+
+    def run(p):
+        from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+        eng = LLMEngine(
+            EngineConfig(model="tiny", dtype="float32", max_model_len=128,
+                         block_size=8, num_blocks=64, max_num_seqs=4),
+            model_cfg=CFG, runner=ModelRunner(CFG, p))
+        return [eng.generate(ids, SamplingParams(max_tokens=8, temperature=0.0)
+                             ).generated_ids for ids in prompts]
+
+    assert run(q4) == run(deq_params)
 
 
 def test_init_params_quantized_schema():
