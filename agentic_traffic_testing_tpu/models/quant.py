@@ -116,12 +116,19 @@ def _unpack4(packed: jax.Array, scale: jax.Array, dtype) -> jax.Array:
 
 
 def _int4_kernel_ok(rows: int, k: int, half: int) -> bool:
-    """Shapes the pallas kernel serves: small row count (decode/verify) and
-    a lane-tileable half width."""
+    """Shapes the pallas kernel serves: decode/verify row counts, or
+    prefill row counts divisible by the kernel's row block and small enough
+    that per-row-block weight re-streams still beat the XLA fallback, and a
+    lane-tileable half width."""
+    from agentic_traffic_testing_tpu.ops.pallas.int4_matmul import (
+        MAX_KERNEL_ROWS,
+        ROW_BLOCK,
+    )
+
     if jax.default_backend() != "tpu":
         return False
-    if rows > 256:
-        return False  # prefill-sized row blocks: fallback (v1 keeps one shape)
+    if rows > ROW_BLOCK and (rows % ROW_BLOCK or rows > MAX_KERNEL_ROWS):
+        return False  # odd or oversized prefill rows: XLA-unpack fallback
     return half <= 512 or half % 128 == 0
 
 
