@@ -33,6 +33,7 @@ from agentic_traffic_testing_tpu.models.quant import (
     Q4Slice,
     QTensor,
     QTensor4,
+    QTensor4TP,
     dense,
     embed_lookup,
 )
@@ -159,10 +160,6 @@ def init_params_quantized(cfg: ModelConfig, seed: int = 0,
         "wo": qw((L, h * hd, d)),
     }
     if cfg.num_experts:
-        if scheme == "int4":
-            raise NotImplementedError(
-                "int4 x MoE is not wired: expert einsums dispatch on QTensor "
-                "(models/moe.py) — serve MoE configs with int8")
         e = cfg.num_experts
         # Router math runs fp regardless (models/moe.py router_topk);
         # expert SwiGLUs quantize per (expert, output channel).
@@ -199,9 +196,11 @@ def _scan_split(layers: dict):
     int4 leaves. A QTensor4 must NOT ride `lax.scan` xs: the scan's
     per-iteration slice would materialize the full packed layer in HBM,
     exactly the copy the pallas kernel's layer-indirected BlockSpec avoids
-    (ops/pallas/int4_matmul.py)."""
-    xs = {k: v for k, v in layers.items() if not isinstance(v, QTensor4)}
-    held = {k: v for k, v in layers.items() if isinstance(v, QTensor4)}
+    (ops/pallas/int4_matmul.py). QTensor4TP (the tensor-parallel wrapper)
+    rides the closure for the same reason."""
+    held_types = (QTensor4, QTensor4TP)
+    xs = {k: v for k, v in layers.items() if not isinstance(v, held_types)}
+    held = {k: v for k, v in layers.items() if isinstance(v, held_types)}
     return xs, held
 
 
@@ -364,8 +363,12 @@ def prefill_impl(
     sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
 
     def attn_site(q, k, v, lp_index):
-        return causal_attention(q, k, v, q_positions=positions,
-                                kv_valid_len=seq_lens)
+        # Flash kernel on TPU (ops/flash_prefill.py), jnp oracle elsewhere —
+        # the score-materializing path was ~70% of the prefill scan.
+        from agentic_traffic_testing_tpu.ops.flash_prefill import prefill_attention
+
+        return prefill_attention(q, k, v, q_positions=positions,
+                                 kv_valid_len=seq_lens)
 
     xs_layers, held = _scan_split(params["layers"])
 

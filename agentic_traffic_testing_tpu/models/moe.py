@@ -33,20 +33,61 @@ import jax
 import jax.numpy as jnp
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig
-from agentic_traffic_testing_tpu.models.quant import QTensor
+from agentic_traffic_testing_tpu.models.quant import Q4Slice, QTensor, QTensor4
+
+
+def _expert_dense4(x: jax.Array, w) -> jax.Array:
+    """Per-expert int4 matmul: x [E, B, C, K] @ w[e] -> [E, B, C, N].
+
+    `lax.scan` over the expert axis, each iteration a `_dense4` on the FLAT
+    [(L*)E, K, N/2] stack with index layer*E + e — the pallas kernel's
+    scalar-prefetch BlockSpec streams only that expert's packed bytes
+    (ops/pallas/int4_matmul.py), so one pass over the expert weights costs
+    exactly the int4 bytes. Activations ride scan xs (slicing activations is
+    cheap; it is the WEIGHT stack that must never ride xs — models/llama.py
+    _scan_split). The per-expert row count (B*C) is decode-sized, squarely
+    in the kernel's row envelope; off-TPU or at odd shapes _dense4 falls
+    back to the XLA unpack path on the indexed slice."""
+    from agentic_traffic_testing_tpu.models.quant import _dense4
+
+    if isinstance(w, Q4Slice):
+        stacked, base = w.stacked, w.layer
+    else:
+        stacked, base = w, None
+    packed, scale = stacked.packed, stacked.scale
+    e = x.shape[0]
+    if packed.ndim == 4:                                # [L, E, K, N/2]
+        packed = packed.reshape(-1, *packed.shape[2:])  # [(L*E), K, N/2]
+        scale = scale.reshape(-1, *scale.shape[2:])
+    flat = QTensor4(packed=packed, scale=scale)
+
+    def body(_, xs):
+        xe, ei = xs
+        idx = ei if base is None else base * e + ei
+        return None, _dense4(xe, flat, layer=idx)
+
+    _, ys = jax.lax.scan(body, None, (x, jnp.arange(e, dtype=jnp.int32)))
+    return ys
 
 
 def _expert_einsum(eq: str, x: jax.Array, w) -> jax.Array:
-    """Per-expert contraction for raw or int8 (QTensor) expert weights.
+    """Per-expert contraction for raw, int8 (QTensor), or int4 (QTensor4 /
+    Q4Slice) expert weights.
 
-    Quantized expert weights [E, K, N] carry per-(expert, output-channel)
-    scales [E, 1, N]; the int8 operand upcasts inside the einsum (XLA fuses
-    it into the operand read, HBM traffic stays int8 — same recipe as
-    quant.dense) and the scale lands on the output's last axis."""
+    Quantized int8 expert weights [E, K, N] carry per-(expert,
+    output-channel) scales [E, 1, N]; the int8 operand upcasts inside the
+    einsum (XLA fuses it into the operand read, HBM traffic stays int8 —
+    same recipe as quant.dense) and the scale lands on the output's last
+    axis. int4 expert weights stream packed bytes through the pallas kernel
+    per expert (`_expert_dense4`)."""
     if isinstance(w, QTensor):
         y = jnp.einsum(eq, x, w.q.astype(x.dtype))
         scale = jnp.squeeze(w.scale, axis=-2)          # [E, N]
         return y * scale[:, None, None, :].astype(x.dtype)
+    if isinstance(w, (QTensor4, Q4Slice)):
+        # Both expert einsums are expert-major batched matmuls over x's
+        # last axis; eq is already encoded in the operand layout.
+        return _expert_dense4(x, w)
     return jnp.einsum(eq, x, w)
 
 

@@ -97,6 +97,55 @@ class Q4Slice(NamedTuple):
     layer: jax.Array    # scalar i32
 
 
+@jax.tree_util.register_pytree_node_class
+class QTensor4TP:
+    """A QTensor4 sharded for tensor parallelism.
+
+    Wraps the (already device-put, already sharded) packed/scale arrays with
+    the static TP context the matmul needs: `kind` ("col" = output dim
+    sharded, Megatron column-parallel; "row" = contraction dim sharded, psum
+    after), plus the mesh and axis name. A pallas_call has no GSPMD
+    partitioning rule, so the int4 kernel runs under `jax.shard_map` per
+    chip — the same escape hatch the DMA paged-attention kernel uses
+    (ops/attention_backend.py:_shard_dma_attention). Carrying the mesh in
+    pytree aux_data (hashable, participates in jit cache keys) means dense()
+    needs no threaded-through TP arguments.
+
+    Column-parallel leaves must be packed with `groups=tp`
+    (quantize_array4): pairing column j with j + N/(2·tp) *within each of
+    the tp column groups* makes a contiguous shard of the packed array a
+    contiguous slice of logical columns, so each chip's local shard is
+    itself a well-formed half-paired QTensor4 and the kernel runs unchanged.
+    Row-parallel leaves shard only K — standard packing.
+    """
+
+    def __init__(self, packed: jax.Array, scale: jax.Array, kind: str,
+                 mesh, axis: str) -> None:
+        if kind not in ("col", "row"):
+            raise ValueError(f"kind={kind!r}; choose col|row")
+        self.packed = packed
+        self.scale = scale
+        self.kind = kind
+        self.mesh = mesh
+        self.axis = axis
+
+    def tree_flatten(self):
+        return (self.packed, self.scale), (self.kind, self.mesh, self.axis)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    @property
+    def shape(self):
+        *lead, k, half = self.packed.shape
+        return (*lead, k, 2 * half)
+
+    @property
+    def logical_dtype(self):
+        return self.scale.dtype
+
+
 def _unpack4(packed: jax.Array, scale: jax.Array, dtype) -> jax.Array:
     """Dequantize a (possibly leading-dim-stacked) QTensor4 to `dtype`.
 
@@ -162,14 +211,57 @@ def _dense4(x: jax.Array, w: QTensor4, layer=None) -> jax.Array:
     return y.reshape(*lead, 2 * half)
 
 
+def _dense4_tp(x: jax.Array, w: QTensor4TP, layer=None) -> jax.Array:
+    """The int4 matmul under `jax.shard_map` over the TP axis.
+
+    col: x replicated in, output sharded on its last dim — no collective
+    (grouped packing makes each chip's shard a contiguous logical slice).
+    row: x sharded on its last (contraction) dim, full-N partial products
+    psum'd to a replicated output — the scale multiply commutes with the
+    psum because per-output-column scales are constant across K shards
+    (same argument as int8's expand_quant_specs).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    nd = x.ndim
+    pnd, snd = w.packed.ndim, w.scale.ndim
+    if w.kind == "col":
+        xspec = P(*(None,) * nd)
+        pspec = P(*(None,) * (pnd - 1), w.axis)
+        sspec = P(*(None,) * (snd - 1), w.axis)
+        ospec = P(*(None,) * (nd - 1), w.axis)
+    else:
+        xspec = P(*(None,) * (nd - 1), w.axis)
+        pspec = P(*(None,) * (pnd - 2), w.axis, None)
+        sspec = P(*(None,) * snd)
+        ospec = P(*(None,) * nd)
+    lay = jnp.asarray(0 if layer is None else layer, jnp.int32)
+
+    def local(x_l, p_l, s_l, lay_l):
+        y = _dense4(x_l, QTensor4(p_l, s_l),
+                    layer=None if layer is None else lay_l)
+        return jax.lax.psum(y, w.axis) if w.kind == "row" else y
+
+    return jax.shard_map(
+        local, mesh=w.mesh,
+        in_specs=(xspec, pspec, sspec, P()),
+        out_specs=ospec,
+        check_vma=False,
+    )(x, w.packed, w.scale, lay)
+
+
 def dense(x: jax.Array, w) -> jax.Array:
     """x @ w for raw or quantized weights (contraction over x's last dim)."""
     if isinstance(w, QTensor):
         y = x @ w.q.astype(x.dtype)
         return y * jnp.squeeze(w.scale, axis=-2).astype(x.dtype)
+    if isinstance(w, QTensor4TP):
+        return _dense4_tp(x, w)
     if isinstance(w, QTensor4):
         return _dense4(x, w)
     if isinstance(w, Q4Slice):
+        if isinstance(w.stacked, QTensor4TP):
+            return _dense4_tp(x, w.stacked, layer=w.layer)
         return _dense4(x, w.stacked, layer=w.layer)
     return x @ w
 
@@ -190,50 +282,94 @@ def embed_lookup(w, ids: jax.Array, dtype=None) -> jax.Array:
     return w[ids]
 
 
-def _quantize_array4_impl(w: jax.Array) -> QTensor4:
+def _quantize_array4_impl(w: jax.Array, groups: int = 1) -> QTensor4:
     """Per-output-column symmetric int4 over the second-to-last (K) axis,
-    packed with half pairing (column j with column j + N/2)."""
+    packed with half pairing (column j with column j + N/2).
+
+    `groups=g > 1` pairs within each of g contiguous column groups instead
+    (column j with j + N/(2g) inside its group) — the tensor-parallel
+    layout: sharding the packed array's last dim over g chips then hands
+    each chip a self-contained half-paired shard of contiguous logical
+    columns (see QTensor4TP). The dequantized VALUES are identical either
+    way (scales are per-column, independent of pairing); only the byte
+    layout changes.
+    """
     wf = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)       # [..., 1, N]
     scale = jnp.where(amax > 0, amax / 7.0, 1.0)
     q = jnp.clip(jnp.round(wf / scale), -8, 7).astype(jnp.int32)
-    n = q.shape[-1]
-    lo = q[..., : n // 2]
-    hi = q[..., n // 2:]
+    *lead, k, n = q.shape
+    if n % (2 * groups):
+        raise ValueError(f"N={n} not divisible by 2*groups={2 * groups}")
+    h = n // (2 * groups)
+    qg = q.reshape(*lead, k, groups, 2 * h)
+    lo, hi = qg[..., :h], qg[..., h:]
     packed = jnp.bitwise_or(
         jnp.left_shift(hi, 4),
-        jnp.bitwise_and(lo, 0xF)).astype(jnp.int8)
-    sc = jnp.concatenate([scale[..., : n // 2], scale[..., n // 2:]], axis=-2)
+        jnp.bitwise_and(lo, 0xF)).astype(jnp.int8).reshape(*lead, k, n // 2)
+    sg = scale.reshape(*lead, 1, groups, 2 * h)
+    sc = jnp.concatenate(
+        [sg[..., :h].reshape(*lead, 1, n // 2),
+         sg[..., h:].reshape(*lead, 1, n // 2)], axis=-2)      # [..., 2, N/2]
     return QTensor4(packed=packed, scale=sc.astype(jnp.float32))
 
 
-quantize_array4 = jax.jit(_quantize_array4_impl)
+quantize_array4 = jax.jit(_quantize_array4_impl, static_argnames=("groups",))
 
 
 # Param-dict leaves that carry the model's FLOPs/bytes; everything else
 # (norms, biases) stays in the original dtype.
 _QUANT_LAYER_KEYS = ("wq", "wk", "wv", "wo", "w_gate", "w_up", "w_down")
 
+# Megatron split by key: "col" shards the output (last) dim, "row" the
+# contraction dim. Drives both int4 grouped packing (col leaves pack with
+# groups=tp) and QTensor4TP wrapping (parallel/sharding.py).
+TP_KIND = {
+    "wq": "col", "wk": "col", "wv": "col", "w_gate": "col", "w_up": "col",
+    "unembed": "col",
+    "wo": "row", "w_down": "row",
+}
+
 
 def quantize_params(params: dict, delete_originals: bool = False,
-                    scheme: str = "int8") -> dict:
+                    scheme: str = "int8", int4_groups: int = 1) -> dict:
     """Quantize a llama.init_params-schema dict leaf-by-leaf.
 
     `delete_originals=True` frees each bf16 leaf as soon as its quantized
     copy exists, bounding peak HBM at (quantized total + one bf16 leaf) —
     required to quantize an 8B model in place on a 16 GiB chip.
     `scheme`: "int8" (per-column QTensor) or "int4" (nibble-packed QTensor4
-    served by the pallas int4 matmul kernel).
+    served by the pallas int4 matmul kernel). `int4_groups` (= the TP
+    degree) packs column-parallel int4 leaves group-wise so their packed
+    shards stay self-contained under tensor parallelism (see QTensor4TP);
+    row-parallel leaves and tok_embed keep standard packing (their N axis
+    is never sharded / they run the global GSPMD unpack path).
     """
     if scheme not in ("int8", "int4"):
         raise ValueError(f"unknown quantization scheme {scheme!r}")
-    if scheme == "int4" and "w_router" in params.get("layers", {}):
-        # Single choke point for every load path (engine random-init,
-        # weights.py checkpoint load, direct callers): the expert einsums
-        # dispatch on the int8 QTensor only (models/moe.py).
+    if (scheme == "int4" and int4_groups > 1
+            and "w_router" in params.get("layers", {})):
+        # int4 expert weights run the kernel inside a scan over experts
+        # (models/moe.py _expert_dense4) — a pallas path GSPMD cannot
+        # partition, and no shard_map wrapper exists for it yet.
         raise NotImplementedError(
-            "int4 x MoE is not wired — serve MoE configs with int8")
-    qfn = quantize_array if scheme == "int8" else quantize_array4
+            "int4 x MoE x TP is not wired — serve MoE int4 single-chip, "
+            "or int8 for tensor-parallel MoE")
+
+    def qfn(w, key=None):
+        if scheme == "int8":
+            return quantize_array(w)
+        if key == "unembed" and int4_groups > 1:
+            # int4 x TP hybrid: the V-sharded lm_head stays int8. Its packed
+            # half-width V/(2*tp) is rarely lane-tileable (Llama vocab
+            # 128256 / 16 = 8016, not %128), which would force the slow
+            # XLA-unpack fallback every step; int8 QTensor sharding is
+            # GSPMD-native and proven (expand_quant_specs). The lm_head is
+            # ~4% of Llama-70B bytes — the int4 win lives in the layer
+            # weights.
+            return quantize_array(w)
+        groups = int4_groups if TP_KIND.get(key) == "col" else 1
+        return quantize_array4(w, groups=groups)
 
     def free(w) -> None:
         if delete_originals and hasattr(w, "delete"):
@@ -244,7 +380,7 @@ def quantize_params(params: dict, delete_originals: bool = False,
     layers_out: dict[str, Any] = {}
     for key, w in layers_in.items():
         if key in _QUANT_LAYER_KEYS:
-            layers_out[key] = qfn(jnp.asarray(w))
+            layers_out[key] = qfn(jnp.asarray(w), key)
             free(w)
         else:
             layers_out[key] = jnp.asarray(w)
@@ -252,7 +388,7 @@ def quantize_params(params: dict, delete_originals: bool = False,
         if key == "layers":
             continue
         if key in ("tok_embed", "unembed"):
-            out[key] = qfn(jnp.asarray(w))
+            out[key] = qfn(jnp.asarray(w), key)
             free(w)
         else:
             out[key] = jnp.asarray(w)
@@ -261,4 +397,4 @@ def quantize_params(params: dict, delete_originals: bool = False,
 
 
 def is_quantized(params: dict) -> bool:
-    return isinstance(params.get("unembed"), (QTensor, QTensor4))
+    return isinstance(params.get("unembed"), (QTensor, QTensor4, QTensor4TP))

@@ -172,13 +172,15 @@ def load_params(
     cfg: ModelConfig | None = None,
     dtype=jnp.bfloat16,
     quantization: str | None = None,
+    int4_groups: int = 1,
 ) -> tuple[ModelConfig, dict]:
     """Load params from a local HF directory of safetensors shards.
 
     With `quantization="int8"`/"int4" the bf16 tree stays host-side and is
     quantized leaf-by-leaf onto the device (models/quant.py) — the full-
     precision model never occupies HBM, which is what lets Llama-3-8B load
-    on a single 16 GiB chip.
+    on a single 16 GiB chip. `int4_groups` = the TP degree for int4 x TP
+    serving (grouped packing of column-parallel leaves; models/quant.py).
     """
     if quantization not in (None, "int8", "int4"):  # before the shard read
         raise ValueError(f"unknown quantization {quantization!r}")
@@ -204,7 +206,8 @@ def load_params(
     if quantization:
         from agentic_traffic_testing_tpu.models.quant import quantize_params
 
-        return cfg, quantize_params(params, scheme=quantization)
+        return cfg, quantize_params(params, scheme=quantization,
+                                    int4_groups=int4_groups)
     return cfg, _to_jax(params)
 
 

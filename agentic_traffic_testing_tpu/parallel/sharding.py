@@ -23,7 +23,7 @@ Constraint: tp must divide num_kv_heads (KV-head sharding) and num_heads.
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
@@ -95,30 +95,34 @@ def shard_pytree(tree: Any, specs: Any, mesh: Mesh) -> Any:
     )
 
 
-def _qtensor_spec(spec: P, rank: int) -> "QTensor":
-    """Expand a weight's PartitionSpec to its QTensor (q, scale) pair.
+def _qtensor_spec(spec: P, rank: int, cls) -> Any:
+    """Expand a weight's PartitionSpec to its quantized (q|packed, scale) pair.
 
-    int8 quantization is per-output-channel over the contraction dim
-    (models/quant.py: scale shape = weight shape with dim -2 collapsed to 1),
-    so the scale inherits the weight's spec except that its size-1
-    contraction axis must stay unsharded. Column-parallel weights therefore
-    get tp-sharded scales; row-parallel weights get replicated scales — and
-    the q @ x partials are scaled AFTER the psum-of-partials XLA inserts,
-    which is exact because the per-channel scale is constant across the
-    contraction shards."""
-    from agentic_traffic_testing_tpu.models.quant import QTensor
-
+    int8/int4 quantization is per-output-channel over the contraction dim
+    (models/quant.py: scale shape = weight shape with dim -2 collapsed to 1
+    for int8, or to 2 half-rows for int4 — either way size-independent of
+    the weight's contraction dim), so the scale inherits the weight's spec
+    except that its contraction axis must stay unsharded. Column-parallel
+    weights therefore get tp-sharded scales; row-parallel weights get
+    replicated scales — and the q @ x partials are scaled AFTER the
+    psum-of-partials, which is exact because the per-channel scale is
+    constant across the contraction shards. The int4 packed array keeps the
+    weight's spec unchanged (N -> N/2 preserves the axis; grouped packing —
+    quantize_params int4_groups — makes the N/2 shards logically
+    contiguous)."""
     full = tuple(spec) + (None,) * (rank - len(spec))
-    return QTensor(q=P(*full), scale=P(*full[:-2], None, full[-1]))
+    kw = "q" if cls.__name__ == "QTensor" else "packed"
+    return cls(**{kw: P(*full)}, scale=P(*full[:-2], None, full[-1]))
 
 
 def expand_quant_specs(params: Any, specs: Any) -> Any:
-    """Replace specs of QTensor-valued params with per-leaf (q, scale) specs."""
-    from agentic_traffic_testing_tpu.models.quant import QTensor
+    """Replace specs of quantized params with per-leaf (q, scale) specs."""
+    from agentic_traffic_testing_tpu.models.quant import QTensor, QTensor4
 
     def rec(p, s):
-        if isinstance(p, QTensor):
-            return _qtensor_spec(s, p.q.ndim)
+        if isinstance(p, (QTensor, QTensor4)):
+            return _qtensor_spec(s, (p.q if isinstance(p, QTensor)
+                                     else p.packed).ndim, type(p))
         if isinstance(p, dict):
             return {k: rec(p[k], s[k]) for k in p}
         return s
@@ -126,10 +130,72 @@ def expand_quant_specs(params: Any, specs: Any) -> Any:
     return rec(params, specs)
 
 
-def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
+def wrap_int4_tp(params: Any, mesh: Mesh) -> Any:
+    """Wrap sharded QTensor4 matmul leaves in QTensor4TP (models/quant.py).
+
+    Gives each leaf the static TP context (col/row kind + mesh + axis) that
+    routes dense() through the shard_map int4-kernel path — the GSPMD
+    partitioner cannot partition a pallas_call. tok_embed stays a plain
+    QTensor4: its gather+unpack is ordinary XLA, which GSPMD partitions
+    globally (grouping irrelevance: it is never locally reinterpreted).
+    """
+    from agentic_traffic_testing_tpu.models.quant import (
+        TP_KIND,
+        QTensor4,
+        QTensor4TP,
+    )
+
+    def wrap(key: str, leaf: Any) -> Any:
+        kind = TP_KIND.get(key)
+        if kind is None or not isinstance(leaf, QTensor4):
+            return leaf
+        return QTensor4TP(leaf.packed, leaf.scale, kind, mesh, AXIS_TP)
+
+    out = {k: wrap(k, v) for k, v in params.items() if k != "layers"}
+    out["layers"] = {k: wrap(k, v) for k, v in params["layers"].items()}
+    return out
+
+
+def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh,
+                 int4_groups: Optional[int] = None) -> Any:
+    """Shard a param tree for the mesh; quantized leaves expand their specs.
+
+    `int4_groups` is the caller's attestation of how int4 column-parallel
+    leaves were packed (quantize_params' int4_groups). A QTensor4 records
+    nothing about its packing, and sharding ungrouped packing over tp chips
+    silently decodes garbage (the lo/hi nibble pairing crosses shard
+    boundaries) — so when int4 leaves meet a tp>1 mesh, the attestation is
+    REQUIRED and must equal the tp degree.
+    """
+    from agentic_traffic_testing_tpu.models.quant import QTensor4
+
     validate_tp(cfg, mesh.shape[AXIS_TP])
+    tp = mesh.shape[AXIS_TP]
+    has_int4 = any(isinstance(l, QTensor4)
+                   for l in list(params["layers"].values())
+                   + [params.get("unembed")])
+    sharded = tp > 1 or dict(mesh.shape).get(AXIS_EP, 1) > 1
+    if sharded and cfg.num_experts and any(
+            isinstance(l, QTensor4)
+            for l in params["layers"].values()):
+        # Before any device_put: the int4 expert path is a pallas scan
+        # (models/moe.py _expert_dense4) with no shard_map wrapper, and
+        # quantize_params likewise refuses int4_groups>1 for MoE trees.
+        raise NotImplementedError(
+            "int4 x MoE x TP is not wired — serve MoE int4 single-chip, "
+            "or int8 for tensor-parallel MoE")
+    if tp > 1 and has_int4 and int4_groups != tp:
+        raise ValueError(
+            f"int4 x TP requires grouped packing: quantize with "
+            f"quantize_params(..., scheme='int4', int4_groups={tp}) (or "
+            f"init_params_quantized, whose random packing is layout-free) "
+            f"and pass int4_groups={tp} to shard_params/TPRunner — got "
+            f"int4_groups={int4_groups!r}")
     specs = expand_quant_specs(params, param_pspecs(cfg))
-    return shard_pytree(params, specs, mesh)
+    params = shard_pytree(params, specs, mesh)
+    if tp > 1:
+        params = wrap_int4_tp(params, mesh)
+    return params
 
 
 def shard_kv_cache(cache: KVCache, mesh: Mesh) -> KVCache:

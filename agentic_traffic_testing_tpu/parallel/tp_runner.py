@@ -42,7 +42,9 @@ class TPRunner(ModelRunner):
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,
-                 spec_ngram: int = 3) -> None:
+                 spec_ngram: int = 3, int4_groups=None) -> None:
+        """`int4_groups`: required attestation (= tp degree) when params
+        carry int4 QTensor4 leaves — see parallel/sharding.shard_params."""
         validate_tp(cfg, mesh.shape[AXIS_TP])
         self.mesh = mesh
         mode = os.environ.get("ATT_TP_ATTENTION")
@@ -55,7 +57,7 @@ class TPRunner(ModelRunner):
         if mode == "shard_dma":
             self.attn_mesh = mesh
             self.attn_axis = AXIS_TP
-        params = shard_params(params, cfg, mesh)
+        params = shard_params(params, cfg, mesh, int4_groups=int4_groups)
         super().__init__(cfg, params, decode_steps=decode_steps,
                          spec_tokens=spec_tokens, spec_ngram=spec_ngram)
 

@@ -92,8 +92,11 @@ class EngineConfig:
     # deployments (bench.py) set 32.
     decode_steps: Optional[int] = None
     # Prompts longer than this prefill in fixed chunks (bounded bucket +
-    # per-step latency); 0/None disables chunking.
-    prefill_chunk_tokens: Optional[int] = 2048
+    # per-step latency); 0/None disables chunking. Raised 2048 -> 4096 in
+    # round 3: the flash prefill site (ops/flash_prefill.py) makes a solo
+    # 4096 pass ~2x cheaper than two chunked dispatches (each chunk re-pays
+    # the dispatch overhead and attends over the prior-pages gather).
+    prefill_chunk_tokens: Optional[int] = 4096
     # Multi-request prefill batches form only up to this padded length
     # (None -> scheduler default 128). Raising it lets concurrent long-prompt
     # arrivals prefill in ONE weight-streaming pass instead of solo — the
@@ -225,10 +228,6 @@ class LLMEngine:
         dtype = jnp.bfloat16 if cfg.dtype in ("bfloat16", "bf16") else jnp.float32
         platform = jax.devices()[0].platform
         decode_steps = cfg.resolved_decode_steps(platform)
-        if cfg.quantization == "int4" and self.model_cfg.num_experts:
-            raise NotImplementedError(
-                "int4 x MoE is not wired (expert einsums dispatch on the "
-                "int8 QTensor) — serve MoE configs with int8")
         if runner is not None:
             self.runner = runner
             decode_steps = runner.decode_steps
@@ -255,10 +254,13 @@ class LLMEngine:
                     # (memory-critical loads pre-quantize in weights.py /
                     # init_params_quantized instead).
                     params = quantize_params(params, scheme=cfg.quantization)
-                elif (isinstance(params.get("unembed"), QTensor4)
+                elif (isinstance(params["layers"]["wq"], QTensor4)
                       != (cfg.quantization == "int4")):
                     # Pre-quantized params of the OTHER scheme: serving them
                     # would silently mislabel every metric and benchmark.
+                    # Keyed on a layer weight, not unembed — int4 x TP
+                    # legitimately hybridizes the lm_head to int8
+                    # (models/quant.py quantize_params).
                     raise ValueError(
                         f"engine configured quantization="
                         f"{cfg.quantization!r} but the supplied params are "

@@ -11,6 +11,9 @@ the layer scan and jit boundaries).
 import numpy as np
 import pytest
 
+# Heavyweight tier: CPU-mesh jit compiles dominate (pytest.ini tiering).
+pytestmark = pytest.mark.full
+
 import jax
 import jax.numpy as jnp
 
@@ -26,6 +29,7 @@ from agentic_traffic_testing_tpu.models.quant import (
     embed_lookup,
     is_quantized,
     quantize_array,
+    quantize_array4,
     quantize_params,
 )
 from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
@@ -251,3 +255,107 @@ def test_llama70b_tp8_int8_fits_v5e8_hbm():
     assert per_chip_weights + kv < hbm, (per_chip_weights / 1e9, kv / 1e9)
     # ...and the point of int8: bf16 at tp=8 would NOT fit this profile.
     assert (2 * total / 8) + kv > hbm
+
+
+# ------------------------------------------------------- int4 x TP (round 3)
+
+
+def _hybrid_int4_single_device_params(params):
+    """Single-device params with the SAME logical weights as the int4 x TP
+    hybrid: int4 layer weights (grouped and ungrouped packing dequantize to
+    identical values — scales are per-column) plus the int8 lm_head that
+    quantize_params(int4_groups>1) ships under TP."""
+    q = quantize_params(params, scheme="int4")
+    q["unembed"] = quantize_array(params["unembed"])
+    return q
+
+
+def test_tp_int4_decode_matches_single_device():
+    """TP=2 int4 greedy decode is token-exact vs the single-device engine
+    on the same logical weights: column-parallel QTensor4 leaves pack
+    group-wise (models/quant.py quantize_array4 groups=2) and run under
+    shard_map (QTensor4TP), row-parallel leaves shard K and psum."""
+    from agentic_traffic_testing_tpu.parallel.mesh import make_mesh
+    from agentic_traffic_testing_tpu.parallel.tp_runner import TPRunner
+
+    params = init_params(CFG, jax.random.key(3), dtype=jnp.float32)
+    ecfg = EngineConfig(model="tiny", dtype="float32", quantization="int4",
+                        num_blocks=64, max_model_len=128)
+    prompt = list(range(7, 27))
+    samp = SamplingParams(temperature=0.0, max_tokens=12)
+
+    ref = LLMEngine(ecfg, model_cfg=CFG,
+                    params=_hybrid_int4_single_device_params(params)
+                    ).generate(prompt, samp)
+    qtp = quantize_params(params, scheme="int4", int4_groups=2)
+    runner = TPRunner(CFG, qtp, make_mesh(tp=2), int4_groups=2)
+    tp = LLMEngine(ecfg, model_cfg=CFG, runner=runner).generate(prompt, samp)
+    assert tp.output_ids == ref.output_ids
+
+
+def test_tp8_70b_shape_int4_decode():
+    """The llama-3-70b-int4-tp8.yaml north star, scaled down: 8 KV heads
+    over 8 chips with int4 layer weights — the capacity configuration that
+    halves int8's per-chip weight stream."""
+    from agentic_traffic_testing_tpu.models.config import ModelConfig
+    from agentic_traffic_testing_tpu.parallel.mesh import make_mesh
+    from agentic_traffic_testing_tpu.parallel.tp_runner import TPRunner
+
+    cfg = ModelConfig(
+        name="70b-shape", vocab_size=512, hidden_size=128,
+        intermediate_size=256, num_layers=2, num_heads=16, num_kv_heads=8,
+        head_dim=8,
+    )
+    params = init_params(cfg, jax.random.key(1), dtype=jnp.float32)
+    ecfg = EngineConfig(model="tiny", dtype="float32", quantization="int4",
+                        num_blocks=64, max_model_len=128)
+    prompt = list(range(3, 23))
+    samp = SamplingParams(temperature=0.0, max_tokens=6)
+
+    ref = LLMEngine(ecfg, model_cfg=cfg,
+                    params=_hybrid_int4_single_device_params(params)
+                    ).generate(prompt, samp)
+    qtp = quantize_params(params, scheme="int4", int4_groups=8)
+    runner = TPRunner(cfg, qtp, make_mesh(tp=8), int4_groups=8)
+    got = LLMEngine(ecfg, model_cfg=cfg, runner=runner).generate(prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
+def test_grouped_int4_packing_dequantizes_identically():
+    """quantize_array4(w, groups=g) is a byte-layout change only: reshaping
+    each group's packed shard through _unpack4 reproduces the ungrouped
+    dequantization exactly (per-column scales are pairing-independent)."""
+    from agentic_traffic_testing_tpu.models.quant import _unpack4
+
+    w = jax.random.normal(jax.random.key(0), (32, 48), jnp.float32)
+    base = _unpack4(*quantize_array4(w), jnp.float32)
+    g = 4
+    qg = quantize_array4(w, groups=g)
+    h = 48 // (2 * g)
+    shards = [
+        _unpack4(qg.packed[:, i * h:(i + 1) * h],
+                 qg.scale[:, i * h:(i + 1) * h], jnp.float32)
+        for i in range(g)
+    ]
+    np.testing.assert_array_equal(np.concatenate(shards, axis=1), np.asarray(base))
+
+
+def test_llama70b_tp8_int4_fits_v5e8_hbm():
+    """Capacity check for serving/configs/llama-3-70b-int4-tp8.yaml: int4
+    layer weights + int8 lm_head sharded over 8 chips leave roughly half of
+    int8's weight footprint — headroom that becomes KV pool."""
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+
+    cfg = resolve_config("llama-3-70b")
+    shapes = jax.eval_shape(
+        lambda: init_params_quantized(cfg, 0, dtype=jnp.bfloat16,
+                                      scheme="int4"))
+    total = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(shapes))
+    shapes8 = jax.eval_shape(
+        lambda: init_params_quantized(cfg, 0, dtype=jnp.bfloat16))
+    total8 = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                 for l in jax.tree_util.tree_leaves(shapes8))
+    assert total < 0.6 * total8
+    kv = (2 * cfg.num_layers * 8 * 8192 * cfg.num_kv_heads // 8 * 128 * 2)
+    assert total / 8 + kv < 16 * 1024**3 * 0.92
