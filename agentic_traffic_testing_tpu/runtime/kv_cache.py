@@ -99,45 +99,6 @@ def write_prompt_kv(
     return cache_l.at[:, idx].set(blocks, mode="drop", unique_indices=False)
 
 
-def write_prompt_kv_full(
-    cache: jax.Array,         # [L, KH, num_blocks, bs, hd] (full stacked pool)
-    layer: jax.Array,         # scalar i32 — layer being written
-    new: jax.Array,           # [B, T, KH, hd] with T % bs == 0
-    block_tables: jax.Array,  # [B, max_blocks]
-    first_block=0,            # scalar: table column of new[:, 0:bs] (chunked prefill)
-) -> jax.Array:
-    """Write a padded prompt's K (or V) into the FULL stacked pool, one
-    `dynamic_update_slice` per (sequence, block).
-
-    Why not a scatter: XLA:TPU lowers scatter as copy-the-operand-then-update
-    — a full-pool copy per layer per step (measured ~2 ms/GB/op on v5e),
-    which made KV writes dominate the step. Chained dynamic_update_slice
-    updates alias in place after the first, so the whole prompt write costs
-    one pool copy per dispatch instead of 2·L.
-    """
-    _, kh, _, bs, _ = cache.shape
-    b, t, _, hd = new.shape  # logical head dim; pool lanes may be padded wider
-    zero = jnp.int32(0)
-    tiles = new.transpose(0, 2, 1, 3)  # [B, KH, T, hd]
-
-    # lax.scan over the block index keeps the HLO at one body regardless of
-    # prompt length (a Python unroll would emit B*T/bs chained DUS nodes and
-    # scale compile time with the (batch, len) bucket).
-    def write_block(cache, j):
-        for i in range(b):  # B is small and static; unrolled
-            upd = jax.lax.dynamic_slice(
-                tiles, (i, 0, j * bs, 0), (1, kh, bs, hd)
-            ).reshape(1, kh, 1, bs, hd)
-            cache = jax.lax.dynamic_update_slice(
-                cache, upd,
-                (layer, zero, block_tables[i, j + first_block], zero, zero)
-            )
-        return cache, None
-
-    cache, _ = jax.lax.scan(write_block, cache, jnp.arange(t // bs, dtype=jnp.int32))
-    return cache
-
-
 def write_decode_kv(
     cache_l: jax.Array,
     new: jax.Array,
@@ -170,7 +131,9 @@ def write_decode_kv_full(
     valid=None,               # [B] bool — False routes the write to the trash block
 ) -> jax.Array:
     """One-token-per-sequence write into the FULL stacked pool via chained
-    `dynamic_update_slice` (see `write_prompt_kv_full` for why not scatter).
+    `dynamic_update_slice` — not scatter: XLA:TPU lowers scatter as
+    copy-the-operand-then-update (a full-pool copy per op, ~2 ms/GB on v5e),
+    while chained DUS aliases in place after the first update.
     Trash lanes (block table row = TRASH_BLOCK) land in the trash block.
 
     `valid=False` lanes also land in the trash block. Speculative verify
