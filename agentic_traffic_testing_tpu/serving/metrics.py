@@ -82,6 +82,20 @@ class LLMMetrics:
             f"{prefix}_computed_max_concurrency",
             "KV-cache-derived max concurrency: total_tokens / max_model_len",
             registry=r)
+        # Runtime concurrency probe (reference: serve_llm.py:224-340 derives
+        # this from the live vLLM engine with a retry ladder; here the engine
+        # is first-party, so the probe additionally folds in the MEASURED
+        # context envelope — how many typical-sized requests the live pool
+        # actually sustains, not just worst-case max_model_len ones).
+        self.probed_max_concurrency = Gauge(
+            f"{prefix}_probed_max_concurrency",
+            "Live-probed achievable concurrency: KV total_tokens / measured "
+            "p95 context length, capped at max_num_seqs; -1 until traffic",
+            registry=r)
+        self.measured_context_p95 = Gauge(
+            f"{prefix}_measured_context_p95_tokens",
+            "p95 of observed request context lengths (prompt+completion) "
+            "over the probe window; -1 until traffic", registry=r)
         self.interarrival = Histogram(
             f"{prefix}_interarrival_seconds",
             "Time between consecutive LLM request arrivals",
@@ -159,3 +173,19 @@ class LLMMetrics:
         by_len = total / max_model_len if max_model_len > 0 else -1
         self.kv_cache_est_max_concurrency.set(round(by_len, 2))
         self.computed_max_concurrency.set(round(min(by_len, max_num_seqs), 2))
+        self.probed_max_concurrency.set(-1)
+        self.measured_context_p95.set(-1)
+
+    def set_probe(self, *, total_tokens: int, max_num_seqs: int,
+                  ctx_p95: Optional[float]) -> None:
+        """Refresh the live concurrency probe (server._probe_max_concurrency).
+
+        Left at -1 until the window has traffic — a dashboard distinguishing
+        "unprobed" from "probed low" mirrors the reference's unset-gauge
+        behavior when all three vLLM strategies fail (serve_llm.py:336-340).
+        """
+        if not ctx_p95 or ctx_p95 <= 0:
+            return
+        self.measured_context_p95.set(round(ctx_p95, 1))
+        self.probed_max_concurrency.set(
+            round(min(total_tokens / ctx_p95, max_num_seqs), 2))

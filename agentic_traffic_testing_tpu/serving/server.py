@@ -25,6 +25,7 @@ import logging
 import os
 import time
 import uuid
+from collections import deque
 from typing import Any, Dict, Optional
 
 from aiohttp import web
@@ -97,6 +98,10 @@ class LLMServer:
         self._inflight_lock = asyncio.Lock()
         self._inflight = 0
         self._last_arrival: Optional[float] = None
+        # Rolling window of finished-request context lengths for the
+        # runtime concurrency probe (reference: serve_llm.py:224-340).
+        self._ctx_window: deque[int] = deque(maxlen=256)
+        self._probe_task: Optional[asyncio.Task] = None
         if self.metrics:
             self.metrics.set_config_gauges(
                 max_num_seqs=cfg.max_num_seqs,
@@ -146,24 +151,23 @@ class LLMServer:
                 # programs from this cfg (LLMEngine re-applies idempotently).
                 model_cfg = dataclasses.replace(
                     model_cfg, moe_capacity_factor=c.moe_capacity_factor)
-            if c.quantization == "int4":
-                raise NotImplementedError(
-                    "int4 x TP is not wired (QTensor4 leaves have no "
-                    "PartitionSpecs yet) — use int8 for tensor-parallel "
-                    "serving, int4 for single-chip")
             params = self._load_params(model_cfg)
             if params is None:
                 dtype = jnp.bfloat16 if c.dtype in ("bfloat16", "bf16") else jnp.float32
-                if c.quantization == "int8":
+                if c.quantization in ("int8", "int4"):
                     from agentic_traffic_testing_tpu.models.llama import (
                         init_params_quantized,
                     )
 
-                    # int8 x TP: QTensor leaves carry their own (q, scale)
-                    # PartitionSpecs (parallel/sharding.py expand_quant_specs)
-                    # — the combination that fits Llama-3-70B int8 on a
-                    # v5e-8's 8x16 GB HBM (serving/configs/llama-3-70b-tp8).
-                    params = init_params_quantized(model_cfg, 0, dtype=dtype)
+                    # Quantized x TP: QTensor/QTensor4 leaves carry their own
+                    # (q|packed, scale) PartitionSpecs (parallel/sharding.py
+                    # expand_quant_specs); int4 matmuls additionally run the
+                    # pallas kernel under shard_map (QTensor4TP). int8 TP=8
+                    # fits Llama-3-70B on a v5e-8's 8x16 GB HBM
+                    # (serving/configs/llama-3-70b-tp8); int4 halves the
+                    # per-chip weight stream again (llama-3-70b-int4-tp8).
+                    params = init_params_quantized(model_cfg, 0, dtype=dtype,
+                                                   scheme=c.quantization)
                 else:
                     params = init_params(model_cfg, jax.random.key(0), dtype=dtype)
             runner = TPRunner(
@@ -171,6 +175,9 @@ class LLMServer:
                 decode_steps=ecfg.resolved_decode_steps(jax.devices()[0].platform),
                 spec_tokens=ecfg.effective_spec_tokens,
                 spec_ngram=ecfg.spec_ngram,
+                # load_params/init_params_quantized packed col leaves with
+                # groups=tp above (sharding.shard_params attestation).
+                int4_groups=(c.tp_size if c.quantization == "int4" else None),
             )
             return LLMEngine(ecfg, model_cfg=model_cfg, runner=runner)
         if c.weights_path:
@@ -202,7 +209,10 @@ class LLMServer:
 
             dtype = jnp.bfloat16 if self.cfg.dtype in ("bfloat16", "bf16") else jnp.float32
             _, params = load_params(self.cfg.weights_path, model_cfg, dtype=dtype,
-                                    quantization=self.cfg.quantization)
+                                    quantization=self.cfg.quantization,
+                                    int4_groups=(self.cfg.tp_size
+                                                 if self.cfg.quantization == "int4"
+                                                 else 1))
             self.model_loaded = True
             return params
         except Exception as e:
@@ -426,6 +436,10 @@ class LLMServer:
             try:
                 text, queue_wait_s, n_tokens = await self._generate(
                     prompt_ids, sampling, request_id, span)
+                # Feed the concurrency probe's context-envelope window
+                # (tracked regardless of metrics_include_tokens: it budgets
+                # KV, not billing).
+                self._ctx_window.append(len(prompt_ids) + n_tokens)
                 # prompt_ids is the exact sequence prefilled (incl. BOS) —
                 # the truthful accounting for KV/window budgeting.
                 prompt_tokens = (len(prompt_ids) if self.cfg.metrics_include_tokens
@@ -534,13 +548,46 @@ class LLMServer:
         if manage_engine:
             async def _start(app):
                 self.async_engine.start()
+                if self.metrics:
+                    self._probe_task = asyncio.ensure_future(
+                        self._probe_max_concurrency())
 
             async def _stop(app):
+                if self._probe_task:
+                    self._probe_task.cancel()
                 self.async_engine.shutdown()
 
             app.on_startup.append(_start)
             app.on_cleanup.append(_stop)
         return app
+
+    async def _probe_max_concurrency(self) -> None:
+        """Background task: refresh concurrency gauges from the LIVE engine.
+
+        Reference analog: `_probe_engine_max_concurrency`
+        (serve_llm.py:224-340), which retries on a 5/15/30 s ladder because
+        vLLM's internals are opaque and slow to initialize. Here the engine
+        is first-party, so the static KV-derived number is already exact at
+        startup; the probe's added value is the MEASURED context envelope —
+        once traffic flows, `llm_probed_max_concurrency` reports how many
+        observed-p95-sized requests the live KV pool sustains (vs the
+        worst-case max_model_len bound of `llm_computed_max_concurrency`).
+        The same ladder, then a slow steady refresh.
+        """
+        total = (self.engine.cache.num_blocks - 1) * self.engine.cache.block_size
+        delays = [5.0, 15.0, 30.0]
+        try:
+            while True:
+                await asyncio.sleep(delays.pop(0) if delays else 60.0)
+                if not self._ctx_window:
+                    continue
+                window = sorted(self._ctx_window)
+                p95 = window[min(len(window) - 1, int(0.95 * len(window)))]
+                self.metrics.set_probe(total_tokens=total,
+                                       max_num_seqs=self.cfg.max_num_seqs,
+                                       ctx_p95=float(p95))
+        except asyncio.CancelledError:
+            pass
 
 
 def _server_kind():

@@ -11,6 +11,9 @@ runtime/scheduler.py ChunkPrefill + models/llama.py prefill_chunk_impl.)
 import numpy as np
 import pytest
 
+# Heavyweight tier: CPU-mesh jit compiles dominate (pytest.ini tiering).
+pytestmark = pytest.mark.full
+
 import jax
 import jax.numpy as jnp
 

@@ -1,0 +1,140 @@
+"""End-to-end real-weights parity: HF checkpoint -> server /chat vs HF.
+
+Round-3 verdict item #10: the golden tests cover the model functions on
+converted state dicts, but the full serving path (safetensors load ->
+quantize/shard -> engine -> HTTP) ran random weights only. Here a tiny HF
+Llama checkpoint is written to disk with save_pretrained, the server loads
+it through the production weights path (ServerConfig.weights_path ->
+models/weights.py load_params), and greedy /chat completions must match
+transformers' generate() token-for-token. Reference analog: the hf_cpu_server
+behavior contract (reference llm/hf_cpu_server.py) — same model, same
+greedy tokens, different engine.
+
+A second, env-gated test does the same against a REAL checkpoint when
+ATT_E2E_WEIGHTS_PATH is set (no weights are downloadable in CI).
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from aiohttp.test_utils import TestClient, TestServer
+
+from agentic_traffic_testing_tpu.serving.config import ServerConfig
+from agentic_traffic_testing_tpu.serving.server import LLMServer
+
+
+@pytest.fixture(scope="module")
+def tiny_hf_checkpoint(tmp_path_factory):
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    torch.manual_seed(7)
+    hf_cfg = LlamaConfig(
+        # vocab covers the server's byte-fallback tokenizer (256 bytes + 6
+        # specials) so /chat prompts tokenize into this model's id space.
+        vocab_size=262,
+        hidden_size=64,
+        intermediate_size=160,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=256,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+    )
+    model = LlamaForCausalLM(hf_cfg).eval()
+    path = tmp_path_factory.mktemp("tiny-llama-ckpt")
+    model.save_pretrained(path, safe_serialization=True)
+    return str(path), model
+
+
+def _chat(server, payload):
+    async def wrapper():
+        app = server.make_app(manage_engine=False)
+        async with TestClient(TestServer(app)) as client:
+            resp = await client.post("/chat", json=payload)
+            assert resp.status == 200, await resp.text()
+            return await resp.json()
+
+    return asyncio.run(wrapper())
+
+
+def test_chat_matches_hf_generate_on_loaded_checkpoint(tiny_hf_checkpoint):
+    import torch
+
+    path, hf_model = tiny_hf_checkpoint
+    cfg = ServerConfig(
+        model="tiny", dtype="float32", max_num_seqs=2, max_model_len=128,
+        num_blocks=64, max_tokens=12, temperature=0.0,
+        # Default margin (128) would swallow the whole prompt at this
+        # max_model_len — the guardrail has its own test (test_serving.py).
+        safety_margin_tokens=8,
+        weights_path=path,
+    )
+    srv = LLMServer(cfg)
+    assert srv.model_loaded is True
+    assert b"llm_model_loaded 1.0" in srv.metrics.render()
+    srv.async_engine.start()
+    try:
+        prompt = "hello tiny model"
+        body = _chat(srv, {"prompt": prompt, "skip_chat_template": True,
+                           "max_tokens": 12, "temperature": 0.0})
+        # Reconstruct the exact ids the server prefilled (BOS + byte ids —
+        # the server's own tokenizer is the ground truth for both sides).
+        ids = srv.tokenizer.encode(prompt)
+        bos = getattr(srv.tokenizer, "bos_id", None) or srv.tokenizer.bos_token_id
+        if ids[0] != bos:
+            ids = [bos] + ids
+        with torch.no_grad():
+            out = hf_model.generate(
+                torch.tensor([ids]), max_new_tokens=12, do_sample=False,
+                pad_token_id=0)
+        hf_completion = out[0, len(ids):].tolist()
+        expect = srv.tokenizer.decode(hf_completion)
+        # HF stops at its config eos (id 2) which the byte tokenizer does
+        # not treat as a stop, so the server may continue past it — parity
+        # holds token-for-token over HF's whole natural trajectory
+        # (including its final eos token).
+        assert len(hf_completion) >= 4
+        assert body["output"].startswith(expect), (body["output"], expect,
+                                                   hf_completion)
+    finally:
+        srv.async_engine.shutdown()
+
+
+@pytest.mark.skipif(not os.environ.get("ATT_E2E_WEIGHTS_PATH"),
+                    reason="set ATT_E2E_WEIGHTS_PATH to a local HF "
+                           "checkpoint dir to run real-weights parity")
+def test_chat_matches_hf_generate_real_checkpoint():
+    import torch
+    from transformers import AutoModelForCausalLM
+
+    path = os.environ["ATT_E2E_WEIGHTS_PATH"]
+    cfg = ServerConfig(
+        model=path, dtype="bfloat16", max_num_seqs=2, max_model_len=512,
+        max_tokens=16, temperature=0.0, weights_path=path,
+        tokenizer_path=path,
+    )
+    srv = LLMServer(cfg)
+    assert srv.model_loaded is True
+    srv.async_engine.start()
+    try:
+        prompt = "The capital of France is"
+        body = _chat(srv, {"prompt": prompt, "skip_chat_template": True,
+                           "max_tokens": 16, "temperature": 0.0})
+        ids = srv.tokenizer.encode(prompt)
+        bos = getattr(srv.tokenizer, "bos_id", None) or srv.tokenizer.bos_token_id
+        if ids[0] != bos:
+            ids = [bos] + ids
+        model = AutoModelForCausalLM.from_pretrained(
+            path, torch_dtype=torch.float32).eval()
+        with torch.no_grad():
+            out = model.generate(torch.tensor([ids]), max_new_tokens=16,
+                                 do_sample=False)
+        expect = srv.tokenizer.decode(out[0, len(ids):].tolist())
+        assert body["output"] == expect
+    finally:
+        srv.async_engine.shutdown()

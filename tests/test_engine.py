@@ -9,6 +9,9 @@ accounting. Greedy sampling + tiny fp32 model => deterministic oracles.
 import numpy as np
 import pytest
 
+# Heavyweight tier: CPU-mesh jit compiles dominate (pytest.ini tiering).
+pytestmark = pytest.mark.full
+
 import jax
 import jax.numpy as jnp
 

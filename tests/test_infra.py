@@ -61,6 +61,72 @@ def test_grafana_dashboard_json():
     assert dash.get("panels") or dash.get("rows")
 
 
+def test_grafana_dashboard_panel_parity():
+    """Reference dashboard parity: >= 44 panels (the reference's count) and
+    every PromQL expr references only metric families something in this repo
+    (or cAdvisor/node-exporter, which the monitoring compose ships) exports.
+    scrape_metrics.py treats the dashboard as the scrape schema, so a panel
+    querying a family nothing exports silently shrinks every experiment's
+    metrics.csv."""
+    import re
+    import sys
+
+    dash_path = (REPO / "infra" / "monitoring" / "grafana" / "dashboards"
+                 / "agentic-traffic.json")
+    sys.path.insert(0, str(REPO / "scripts" / "experiment"))
+    try:
+        from scrape_metrics import load_dashboard_panels
+    finally:
+        sys.path.pop(0)
+    pairs = load_dashboard_panels(str(dash_path))
+    dash = json.loads(dash_path.read_text())
+    assert len(dash["panels"]) >= 44, len(dash["panels"])
+    assert len(pairs) >= 36  # every non-row panel carries at least one expr
+
+    # The repo's own exported families.
+    from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
+
+    llm = set()
+    for fam in LLMMetrics("llm").registry.collect():
+        llm.add(fam.name)
+        if fam.type == "histogram":
+            llm.update({f"{fam.name}_bucket", f"{fam.name}_sum",
+                        f"{fam.name}_count"})
+        if fam.type == "counter":
+            llm.add(f"{fam.name}_total")
+    collector_src = (REPO / "scripts" / "monitoring"
+                     / "tcp_metrics_collector.py").read_text()
+    exporter_src = (REPO / "scripts" / "monitoring"
+                    / "docker_mapping_exporter.py").read_text()
+    exported = llm | set(re.findall(r"\btcp_[a-z_]+", collector_src)) \
+        | set(re.findall(r"\bdocker_[a-z_]+", exporter_src))
+
+    # Shipped by the monitoring compose's cAdvisor/node-exporter containers.
+    shipped_prefixes = ("container_", "machine_", "node_")
+    promql_funcs = {
+        "rate", "irate", "increase", "sum", "avg", "min", "max", "count",
+        "by", "le", "on", "ignoring", "group_left", "group_right", "vector",
+        "time", "histogram_quantile", "label_replace", "clamp_min",
+        "clamp_max", "abs", "or", "and", "unless", "without", "topk",
+        "bottomk", "delta", "idelta", "deriv", "quantile", "max_over_time",
+        "avg_over_time", "sum_over_time", "min_over_time",
+    }
+    bad = []
+    for panel, expr in pairs:
+        # Strip label selectors, strings, ranges, and by/without grouping
+        # clauses (their contents are label names, not metric families).
+        stripped = re.sub(r'\{[^}]*\}|"[^"]*"|\[[^\]]*\]', " ", expr)
+        stripped = re.sub(r"\b(by|without|on|ignoring|group_left|group_right)"
+                          r"\s*\([^)]*\)", " ", stripped)
+        for tok in re.findall(r"[a-zA-Z_:][a-zA-Z0-9_:]*", stripped):
+            if tok in promql_funcs or tok.startswith(shipped_prefixes):
+                continue
+            base = re.sub(r"_(bucket|sum|count)$", "", tok)
+            if tok not in exported and base not in exported:
+                bad.append((panel, tok))
+    assert not bad, f"dashboard exprs reference unexported families: {bad}"
+
+
 def test_prometheus_scrapes_llm_backend():
     doc = yaml.safe_load((REPO / "infra" / "monitoring" / "prometheus.yml").read_text())
     jobs = {j["job_name"] for j in doc["scrape_configs"]}

@@ -11,6 +11,9 @@ uses when ATT_TPU_ATTENTION=gather.
 import numpy as np
 import pytest
 
+# Heavyweight tier: CPU-mesh jit compiles dominate (pytest.ini tiering).
+pytestmark = pytest.mark.full
+
 import jax
 import jax.numpy as jnp
 

@@ -11,6 +11,9 @@ import numpy as np
 import optax
 import pytest
 
+# Heavyweight tier: CPU-mesh jit compiles dominate (pytest.ini tiering).
+pytestmark = pytest.mark.full
+
 from agentic_traffic_testing_tpu.models.config import ModelConfig, resolve_config
 from agentic_traffic_testing_tpu.models.llama import forward_full, init_params
 from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention

@@ -9,6 +9,9 @@ count, composed with dp and tp. Runs on the 8-virtual-CPU-device mesh
 import numpy as np
 import pytest
 
+# Heavyweight tier: CPU-mesh jit compiles dominate (pytest.ini tiering).
+pytestmark = pytest.mark.full
+
 import jax
 import jax.numpy as jnp
 import optax

@@ -12,6 +12,9 @@ it is runtime/block_allocator.PrefixCachingAllocator + the chunk machinery.
 import numpy as np
 import pytest
 
+# Heavyweight tier: CPU-mesh jit compiles dominate (pytest.ini tiering).
+pytestmark = pytest.mark.full
+
 import jax
 import jax.numpy as jnp
 

@@ -14,6 +14,9 @@ run in interpreter mode on CPU (SURVEY.md §4 kernel-test strategy).
 import numpy as np
 import pytest
 
+# Heavyweight tier: CPU-mesh jit compiles dominate (pytest.ini tiering).
+pytestmark = pytest.mark.full
+
 import jax
 import jax.numpy as jnp
 
