@@ -117,6 +117,14 @@ class EngineConfig:
     # no tokens; >= num_experts guarantees no capacity drops (exact HF
     # numerics) at the cost of E-fold larger expert buffers (models/moe.py).
     moe_capacity_factor: Optional[float] = None
+    # KV-cache page dtype: None (follow `dtype`) or "fp8" (float8_e4m3 pages
+    # — exactly double the KV capacity / concurrency and half the decode KV
+    # stream, no scale plumbing; the vLLM analog is --kv-cache-dtype fp8,
+    # which the reference inherits through its vllm dependency). e4m3's
+    # per-element dynamic exponent costs ~2% RMS on K/V (~6% on individual
+    # pre-softmax scores, averaging out over slots) — the accuracy envelope
+    # tests/test_kv_fp8.py pins.
+    kv_cache_dtype: Optional[str] = None
     # None = auto (C++ native/ core if it builds, Python otherwise);
     # True/False force one implementation.
     native_allocator: Optional[bool] = None
@@ -136,6 +144,10 @@ class EngineConfig:
             raise ValueError(
                 f"unknown quantization {self.quantization!r}; "
                 f"supported: int8, int4")
+        if self.kv_cache_dtype not in (None, "fp8", "fp8_e4m3"):
+            raise ValueError(
+                f"unknown kv_cache_dtype {self.kv_cache_dtype!r}; "
+                f"supported: fp8")
         if self.speculation not in (None, "ngram"):
             raise ValueError(
                 f"unknown speculation {self.speculation!r}; supported: ngram")
@@ -272,8 +284,10 @@ class LLMEngine:
             )
 
         num_blocks = cfg.num_blocks or self._default_num_blocks()
+        kv_dtype = (jnp.float8_e4m3fn if cfg.kv_cache_dtype in ("fp8", "fp8_e4m3")
+                    else dtype)
         self.cache = self.runner.prepare_cache(
-            make_kv_cache(self.model_cfg, num_blocks, cfg.block_size, dtype)
+            make_kv_cache(self.model_cfg, num_blocks, cfg.block_size, kv_dtype)
         )
         self.allocator = make_block_allocator(num_blocks, cfg.block_size,
                                               native=cfg.native_allocator,
@@ -332,6 +346,10 @@ class LLMEngine:
             # No introspection (CPU tests): small fixed pool.
             return 512
         bytes_per = 2 if self.cfg.dtype in ("bfloat16", "bf16") else 4
+        # fp8 pages store one byte per element — the profiling pass hands
+        # out roughly double the blocks (and the transient scan outputs are
+        # cast to the page dtype inside the layer scan, so they halve too).
+        kv_bytes = 1 if self.cfg.kv_cache_dtype else bytes_per
         # Reserve room for prefill's per-layer K/V scan outputs (llama.py
         # prefill_impl defers pool writes; the transient peaks at one full
         # prefill bucket, B*T <= max_num_batched_tokens, lane-padded).
@@ -340,11 +358,11 @@ class LLMEngine:
         transient = (2 * self.model_cfg.num_layers
                      * self.cfg.max_num_batched_tokens
                      * self.model_cfg.num_kv_heads
-                     * phys_head_dim(self.model_cfg.head_dim_) * bytes_per)
+                     * phys_head_dim(self.model_cfg.head_dim_) * kv_bytes)
         free = max(0, free - transient)
         n = profile_num_blocks(
             self.model_cfg, self.cfg.block_size, free,
-            self.cfg.memory_utilization, bytes_per,
+            self.cfg.memory_utilization, kv_bytes,
             tp_size=self.runner.tp_size,
         )
         # Never exceed what max_num_seqs * max_model_len can actually use.

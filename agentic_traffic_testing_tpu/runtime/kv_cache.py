@@ -148,6 +148,7 @@ def write_decode_kv_full(
     _, kh, _, bs, _ = cache.shape
     b, _, hd = new.shape  # logical head dim; pool lanes may be padded wider
     zero = jnp.int32(0)
+    new = new.astype(cache.dtype)  # fp8 pages: quantize at write
     for i in range(b):
         blk = block_tables[i, positions[i] // bs]  # OOB positions clamp; see above
         if valid is not None:

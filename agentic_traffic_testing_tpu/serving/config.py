@@ -45,13 +45,16 @@ class ServerConfig:
     tp_size: int = 1                           # LLM_TP_SIZE (TPU-native knob)
     quantization: Optional[str] = None         # LLM_QUANTIZATION ("int8" | "int4" | unset)
     decode_steps: Optional[int] = None         # LLM_DECODE_STEPS (None -> auto)
-    prefill_chunk_tokens: int = 2048           # LLM_PREFILL_CHUNK_TOKENS (0 = off)
+    prefill_chunk_tokens: int = 4096           # LLM_PREFILL_CHUNK_TOKENS (0 = off)
     # Batch same-bucket prompt prefills up to this padded length (None ->
     # engine default 128). Raising it cuts TTFT under concurrent long-prompt
     # bursts (one weight-streaming pass instead of solo prefills); warmup
     # then precompiles every (batch, length) bucket <= the cap at startup.
     prefill_batch_max_len: Optional[int] = None  # LLM_PREFILL_BATCH_MAX_LEN
     prefix_caching: bool = False               # LLM_PREFIX_CACHING
+    # "fp8" stores KV pages as float8_e4m3 — double capacity/concurrency,
+    # half the decode KV stream (vLLM --kv-cache-dtype fp8 analog).
+    kv_cache_dtype: Optional[str] = None       # LLM_KV_CACHE_DTYPE
     num_blocks: Optional[int] = None           # LLM_NUM_BLOCKS (None -> HBM profile)
     block_size: int = 16                       # LLM_BLOCK_SIZE
     weights_path: Optional[str] = None         # LLM_WEIGHTS_PATH (local safetensors dir)
@@ -106,6 +109,7 @@ class ServerConfig:
         pbml = os.environ.get("LLM_PREFILL_BATCH_MAX_LEN")
         c.prefill_batch_max_len = int(pbml) if pbml else None
         c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
+        c.kv_cache_dtype = os.environ.get("LLM_KV_CACHE_DTYPE") or None
         nb = os.environ.get("LLM_NUM_BLOCKS")
         c.num_blocks = int(nb) if nb else None
         c.block_size = int(os.environ.get("LLM_BLOCK_SIZE") or c.block_size)

@@ -128,6 +128,7 @@ class LLMServer:
             prefill_chunk_tokens=c.prefill_chunk_tokens,
             prefill_batch_max_len=c.prefill_batch_max_len,
             prefix_caching=c.prefix_caching,
+            kv_cache_dtype=c.kv_cache_dtype,
             moe_capacity_factor=c.moe_capacity_factor,
             speculation=c.speculation, spec_tokens=c.spec_tokens,
             spec_ngram=c.spec_ngram,
@@ -574,7 +575,7 @@ class LLMServer:
         worst-case max_model_len bound of `llm_computed_max_concurrency`).
         The same ladder, then a slow steady refresh.
         """
-        total = (self.engine.cache.num_blocks - 1) * self.engine.cache.block_size
+        total = self.engine.cache.usable_tokens
         delays = [5.0, 15.0, 30.0]
         try:
             while True:

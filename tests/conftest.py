@@ -25,3 +25,15 @@ import jax  # noqa: E402
 # jax_platforms to "axon,cpu" regardless of JAX_PLATFORMS — undo that here,
 # before any backend is initialized.
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Surface the test-tier split: a direct run of a full-marked module
+    with the default `-m "not full"` addopts deselects everything silently
+    (pytest.ini) — tell the developer how to opt in."""
+    n = len(terminalreporter.stats.get("deselected", []))
+    if n and config.option.markexpr == "not full":
+        terminalreporter.write_line(
+            f"[tiers] {n} heavyweight tests deselected by the default "
+            f"'-m \"not full\"' tier — run with -m \"full or not full\" "
+            f"for the full suite (pytest.ini)")
