@@ -112,7 +112,8 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 
 def init_params_quantized(cfg: ModelConfig, seed: int = 0,
-                          dtype=jnp.bfloat16, scheme: str = "int8") -> Params:
+                          dtype=jnp.bfloat16, scheme: str = "int8",
+                          int4_k_group: int = 0) -> Params:
     """Random-init DIRECTLY in int8/int4 (checkpoint-free benches/tests of
     big configs: an 8B in bf16 alone overflows one v5e chip's HBM, and even
     a host-side fp32 init of it costs minutes of RNG + tunnel transfer).
@@ -138,26 +139,44 @@ def init_params_quantized(cfg: ModelConfig, seed: int = 0,
         return QTensor(q=jnp.asarray(q),
                        scale=jnp.full(sshape, SCALE, jnp.float32))
 
-    def qw4(shape, axis=-2):
+    def qw4(shape, axis=-2, k_grouped=False):
         # Random bytes ARE two uniform random nibbles each; pack along the
         # last axis (QTensor4 half-pairing — layout is moot for random init).
         pshape = list(shape)
         pshape[-1] //= 2
         packed = rng.integers(-128, 128, size=pshape, dtype=np.int8)
         sshape = list(shape)
-        sshape[-2:] = [2, shape[-1] // 2]
+        if k_grouped and int4_k_group:
+            if shape[-2] % int4_k_group:
+                # Match quantize_array4's contract: a config whose K the
+                # group size does not divide must fail here too, not bench
+                # a silently different (ungrouped) kernel variant.
+                raise ValueError(
+                    f"K={shape[-2]} not divisible by "
+                    f"int4_k_group={int4_k_group}")
+            # AWQ-style K-group scales: constant values (random init), but
+            # the [., Gk, 2, N/2] shape matches real-checkpoint serving so
+            # perf work compiles the same kernel variant.
+            sshape[-2:] = [shape[-2] // int4_k_group, 2, shape[-1] // 2]
+        else:
+            sshape[-2:] = [2, shape[-1] // 2]
         return QTensor4(packed=jnp.asarray(packed),
                         scale=jnp.full(sshape, SCALE4, jnp.float32))
 
-    qw = qw8 if scheme == "int8" else qw4
+    if scheme == "int8":
+        def qw(shape, k_grouped=False):
+            return qw8(shape)
+    else:
+        def qw(shape, k_grouped=False):
+            return qw4(shape, k_grouped=k_grouped)
 
     layers: dict = {
         "ln_attn": jnp.ones((L, d), dtype),
         "ln_mlp": jnp.ones((L, d), dtype),
-        "wq": qw((L, d, h * hd)),
-        "wk": qw((L, d, kh * hd)),
-        "wv": qw((L, d, kh * hd)),
-        "wo": qw((L, h * hd, d)),
+        "wq": qw((L, d, h * hd), k_grouped=True),
+        "wk": qw((L, d, kh * hd), k_grouped=True),
+        "wv": qw((L, d, kh * hd), k_grouped=True),
+        "wo": qw((L, h * hd, d), k_grouped=True),
     }
     if cfg.num_experts:
         e = cfg.num_experts
@@ -165,13 +184,13 @@ def init_params_quantized(cfg: ModelConfig, seed: int = 0,
         # expert SwiGLUs quantize per (expert, output channel).
         layers["w_router"] = jnp.asarray(
             rng.standard_normal((L, d, e)).astype(np.float32) * 0.02, dtype)
-        layers["w_gate"] = qw((L, e, d, f))
-        layers["w_up"] = qw((L, e, d, f))
-        layers["w_down"] = qw((L, e, f, d))
+        layers["w_gate"] = qw((L, e, d, f), k_grouped=True)
+        layers["w_up"] = qw((L, e, d, f), k_grouped=True)
+        layers["w_down"] = qw((L, e, f, d), k_grouped=True)
     else:
-        layers["w_gate"] = qw((L, d, f))
-        layers["w_up"] = qw((L, d, f))
-        layers["w_down"] = qw((L, f, d))
+        layers["w_gate"] = qw((L, d, f), k_grouped=True)
+        layers["w_up"] = qw((L, d, f), k_grouped=True)
+        layers["w_down"] = qw((L, f, d), k_grouped=True)
     if cfg.qkv_bias:
         layers["bq"] = jnp.zeros((L, h * hd), dtype)
         layers["bk"] = jnp.zeros((L, kh * hd), dtype)

@@ -149,26 +149,35 @@ class QTensor4TP:
 def _unpack4(packed: jax.Array, scale: jax.Array, dtype) -> jax.Array:
     """Dequantize a (possibly leading-dim-stacked) QTensor4 to `dtype`.
 
-    The XLA fallback path (CPU tests, shapes the kernel does not serve):
-    materializes the full weight, so it streams int8-equivalent bytes —
-    correctness-first, the kernel is the fast path.
+    scale [..., 2, N/2] is the per-full-K-column layout; [..., Gk, 2, N/2]
+    (one extra axis) is K-group-wise (quantize_array4 k_group>0): group g
+    scales rows [g*kg, (g+1)*kg). The XLA fallback path (CPU tests, shapes
+    the kernel does not serve): materializes the full weight, so it streams
+    int8-equivalent bytes — correctness-first, the kernel is the fast path.
     """
     p32 = packed.astype(jnp.int32)
     lo = jax.lax.shift_right_arithmetic(
         jax.lax.shift_left(p32, jnp.int32(28)), jnp.int32(28))
     hi = jax.lax.shift_right_arithmetic(p32, jnp.int32(4))
-    se = scale[..., 0, :][..., None, :]  # [..., 1, N/2]
-    so = scale[..., 1, :][..., None, :]
+    if scale.ndim == packed.ndim + 1:           # K-group-wise
+        kg = packed.shape[-2] // scale.shape[-3]
+        se = jnp.repeat(scale[..., 0, :], kg, axis=-2)   # [..., K, N/2]
+        so = jnp.repeat(scale[..., 1, :], kg, axis=-2)
+    else:
+        se = scale[..., 0, :][..., None, :]     # [..., 1, N/2]
+        so = scale[..., 1, :][..., None, :]
     return jnp.concatenate(
         [lo.astype(dtype) * se.astype(dtype),
          hi.astype(dtype) * so.astype(dtype)], axis=-1)
 
 
-def _int4_kernel_ok(rows: int, k: int, half: int) -> bool:
+def _int4_kernel_ok(rows: int, k: int, half: int, k_group: int = 0) -> bool:
     """Shapes the pallas kernel serves: decode/verify row counts, or
     prefill row counts divisible by the kernel's row block and small enough
     that per-row-block weight re-streams still beat the XLA fallback, and a
-    lane-tileable half width."""
+    lane-tileable half width. K-group scales finer than the kernel's
+    8-groups-per-chunk bound (ops/pallas/int4_matmul.py) route to the XLA
+    fallback — correct, just unaccelerated."""
     from agentic_traffic_testing_tpu.ops.pallas.int4_matmul import (
         MAX_KERNEL_ROWS,
         ROW_BLOCK,
@@ -178,6 +187,8 @@ def _int4_kernel_ok(rows: int, k: int, half: int) -> bool:
         return False
     if rows > ROW_BLOCK and (rows % ROW_BLOCK or rows > MAX_KERNEL_ROWS):
         return False  # odd or oversized prefill rows: XLA-unpack fallback
+    if k_group and (k_group < 128 or k_group % 128):
+        return False  # kernel needs >=128-row aligned chunks per group
     return half <= 512 or half % 128 == 0
 
 
@@ -198,8 +209,10 @@ def _dense4(x: jax.Array, w: QTensor4, layer=None) -> jax.Array:
     for d in lead:
         rows *= d
     half = w.packed.shape[-1]
+    kg = (k // w.scale.shape[-3]
+          if w.scale.ndim == w.packed.ndim + 1 else 0)
     x2 = x.reshape(rows, k)
-    if _int4_kernel_ok(rows, k, half):
+    if _int4_kernel_ok(rows, k, half, k_group=kg):
         y = int4_matmul(x2, w.packed, w.scale, layer=0 if layer is None else layer,
                         n_block=_int4_n_block(half), out_dtype=x.dtype)
     else:
@@ -233,7 +246,10 @@ def _dense4_tp(x: jax.Array, w: QTensor4TP, layer=None) -> jax.Array:
     else:
         xspec = P(*(None,) * (nd - 1), w.axis)
         pspec = P(*(None,) * (pnd - 2), w.axis, None)
-        sspec = P(*(None,) * snd)
+        # K-group-wise scales (scale rank = packed rank + 1) shard their
+        # group axis with K; per-full-K scales replicate.
+        sspec = (P(*(None,) * (snd - 3), w.axis, None, None)
+                 if snd == pnd + 1 else P(*(None,) * snd))
         ospec = P(*(None,) * nd)
     lay = jnp.asarray(0 if layer is None else layer, jnp.int32)
 
@@ -282,7 +298,8 @@ def embed_lookup(w, ids: jax.Array, dtype=None) -> jax.Array:
     return w[ids]
 
 
-def _quantize_array4_impl(w: jax.Array, groups: int = 1) -> QTensor4:
+def _quantize_array4_impl(w: jax.Array, groups: int = 1,
+                          k_group: int = 0) -> QTensor4:
     """Per-output-column symmetric int4 over the second-to-last (K) axis,
     packed with half pairing (column j with column j + N/2).
 
@@ -293,12 +310,31 @@ def _quantize_array4_impl(w: jax.Array, groups: int = 1) -> QTensor4:
     columns (see QTensor4TP). The dequantized VALUES are identical either
     way (scales are per-column, independent of pairing); only the byte
     layout changes.
+
+    `k_group=kg > 0` computes a separate scale per kg rows of K
+    (AWQ/GPTQ-style group quantization — the accuracy knob for real
+    checkpoints, where a single full-K scale lets one outlier row wash out
+    a column). Scale shape grows one axis: [..., K/kg, 2, N/2]; the matmul
+    kernel applies each group's scale to its f32 partial sum, so group
+    boundaries cost nothing in exactness (ops/pallas/int4_matmul.py).
     """
     wf = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)       # [..., 1, N]
-    scale = jnp.where(amax > 0, amax / 7.0, 1.0)
-    q = jnp.clip(jnp.round(wf / scale), -8, 7).astype(jnp.int32)
-    *lead, k, n = q.shape
+    *lead, k, n = wf.shape
+    if k_group:
+        if k % k_group:
+            raise ValueError(f"K={k} not divisible by k_group={k_group}")
+        gk = k // k_group
+        wg = wf.reshape(*lead, gk, k_group, n)
+        amax = jnp.max(jnp.abs(wg), axis=-2, keepdims=True)   # [..., Gk, 1, N]
+        scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+        q = jnp.clip(jnp.round(wg / scale), -8, 7).astype(jnp.int32)
+        q = q.reshape(*lead, k, n)
+        scale_cols = scale[..., 0, :]                         # [..., Gk, N]
+    else:
+        amax = jnp.max(jnp.abs(wf), axis=-2, keepdims=True)   # [..., 1, N]
+        scale = jnp.where(amax > 0, amax / 7.0, 1.0)
+        q = jnp.clip(jnp.round(wf / scale), -8, 7).astype(jnp.int32)
+        scale_cols = scale                                    # [..., 1, N]
     if n % (2 * groups):
         raise ValueError(f"N={n} not divisible by 2*groups={2 * groups}")
     h = n // (2 * groups)
@@ -307,14 +343,19 @@ def _quantize_array4_impl(w: jax.Array, groups: int = 1) -> QTensor4:
     packed = jnp.bitwise_or(
         jnp.left_shift(hi, 4),
         jnp.bitwise_and(lo, 0xF)).astype(jnp.int8).reshape(*lead, k, n // 2)
-    sg = scale.reshape(*lead, 1, groups, 2 * h)
-    sc = jnp.concatenate(
-        [sg[..., :h].reshape(*lead, 1, n // 2),
-         sg[..., h:].reshape(*lead, 1, n // 2)], axis=-2)      # [..., 2, N/2]
-    return QTensor4(packed=packed, scale=sc.astype(jnp.float32))
+    gk = scale_cols.shape[-2]
+    sg = scale_cols.reshape(*lead, gk, groups, 2 * h)
+    sc = jnp.stack(
+        [sg[..., :h].reshape(*lead, gk, n // 2),
+         sg[..., h:].reshape(*lead, gk, n // 2)], axis=-2)    # [..., Gk, 2, N/2]
+    sc = sc.astype(jnp.float32)
+    if not k_group:
+        sc = sc[..., 0, :, :]                                 # [..., 2, N/2]
+    return QTensor4(packed=packed, scale=sc)
 
 
-quantize_array4 = jax.jit(_quantize_array4_impl, static_argnames=("groups",))
+quantize_array4 = jax.jit(_quantize_array4_impl,
+                          static_argnames=("groups", "k_group"))
 
 
 # Param-dict leaves that carry the model's FLOPs/bytes; everything else
@@ -332,7 +373,8 @@ TP_KIND = {
 
 
 def quantize_params(params: dict, delete_originals: bool = False,
-                    scheme: str = "int8", int4_groups: int = 1) -> dict:
+                    scheme: str = "int8", int4_groups: int = 1,
+                    int4_k_group: int = 0) -> dict:
     """Quantize a llama.init_params-schema dict leaf-by-leaf.
 
     `delete_originals=True` frees each bf16 leaf as soon as its quantized
@@ -344,6 +386,10 @@ def quantize_params(params: dict, delete_originals: bool = False,
     shards stay self-contained under tensor parallelism (see QTensor4TP);
     row-parallel leaves and tok_embed keep standard packing (their N axis
     is never sharded / they run the global GSPMD unpack path).
+    `int4_k_group` (e.g. 512) adds AWQ-style K-group-wise scales on the
+    layer matmul weights — the accuracy knob for real checkpoints
+    (quantize_array4; embeddings keep per-column scales: the row gather
+    cannot reindex row-group scales).
     """
     if scheme not in ("int8", "int4"):
         raise ValueError(f"unknown quantization scheme {scheme!r}")
@@ -369,7 +415,8 @@ def quantize_params(params: dict, delete_originals: bool = False,
             # weights.
             return quantize_array(w)
         groups = int4_groups if TP_KIND.get(key) == "col" else 1
-        return quantize_array4(w, groups=groups)
+        kg = int4_k_group if key in _QUANT_LAYER_KEYS else 0
+        return quantize_array4(w, groups=groups, k_group=kg)
 
     def free(w) -> None:
         if delete_originals and hasattr(w, "delete"):

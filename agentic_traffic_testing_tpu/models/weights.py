@@ -173,6 +173,7 @@ def load_params(
     dtype=jnp.bfloat16,
     quantization: str | None = None,
     int4_groups: int = 1,
+    int4_k_group: int = 0,
 ) -> tuple[ModelConfig, dict]:
     """Load params from a local HF directory of safetensors shards.
 
@@ -207,7 +208,8 @@ def load_params(
         from agentic_traffic_testing_tpu.models.quant import quantize_params
 
         return cfg, quantize_params(params, scheme=quantization,
-                                    int4_groups=int4_groups)
+                                    int4_groups=int4_groups,
+                                    int4_k_group=int4_k_group)
     return cfg, _to_jax(params)
 
 

@@ -25,6 +25,7 @@ streaming; a lax.scan xs slice of a pallas operand would copy it).
 from __future__ import annotations
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
@@ -41,12 +42,18 @@ MAX_KERNEL_ROWS = 2048
 
 
 def _kernel(layer_ref, x_ref, w_ref, s_ref, lo_out, hi_out, acc_e, acc_o, *,
-            out_dtype, k_chunks):
+            out_dtype, k_chunks, groups_per_block):
     # Nibble unpack in int32 (Mosaic legalizes vector shifts only at i32;
     # i8/i16 shifts fail to legalize): sign-preserving low nibble via
     # shift-up-then-down, high via shift-down. The K dimension is chunked
     # (grid minor axis) to bound the unpack intermediates' VMEM footprint —
     # a whole [14336, 512] i32 block is a 29 MB scoped allocation.
+    #
+    # K-group-wise scales (groups_per_block > 0): each group's scale lands
+    # on its own f32 partial sum — exact, because scaling commutes with the
+    # accumulation and the {-8..7} nibble values are exact in the dot's
+    # bf16 operands. Per-full-K scales (groups_per_block == 0) keep the
+    # single end-of-accumulation multiply.
     kk = pl.program_id(2)
     w32 = w_ref[0].astype(jnp.int32)                 # [k_blk, hb]
     lo = jax.lax.shift_right_arithmetic(
@@ -54,28 +61,47 @@ def _kernel(layer_ref, x_ref, w_ref, s_ref, lo_out, hi_out, acc_e, acc_o, *,
     hi = jax.lax.shift_right_arithmetic(w32, jnp.int32(4))
     x = x_ref[...]                                   # [B, k_blk]
     dims = (((1,), (0,)), ((), ()))
-    ye = jax.lax.dot_general(x, lo.astype(x.dtype), dims,
-                             preferred_element_type=jnp.float32)
-    yo = jax.lax.dot_general(x, hi.astype(x.dtype), dims,
-                             preferred_element_type=jnp.float32)
 
     @pl.when(kk == 0)
     def _():
         acc_e[...] = jnp.zeros_like(acc_e)
         acc_o[...] = jnp.zeros_like(acc_o)
 
-    acc_e[...] += ye
-    acc_o[...] += yo
+    if groups_per_block:
+        k_blk = x.shape[1]
+        sub = k_blk // groups_per_block
+        for g in range(groups_per_block):           # static unroll
+            xg = x[:, g * sub:(g + 1) * sub]
+            log = lo[g * sub:(g + 1) * sub]
+            hig = hi[g * sub:(g + 1) * sub]
+            ye = jax.lax.dot_general(xg, log.astype(x.dtype), dims,
+                                     preferred_element_type=jnp.float32)
+            yo = jax.lax.dot_general(xg, hig.astype(x.dtype), dims,
+                                     preferred_element_type=jnp.float32)
+            acc_e[...] += ye * s_ref[0, g, 0][None, :]
+            acc_o[...] += yo * s_ref[0, g, 1][None, :]
+    else:
+        ye = jax.lax.dot_general(x, lo.astype(x.dtype), dims,
+                                 preferred_element_type=jnp.float32)
+        yo = jax.lax.dot_general(x, hi.astype(x.dtype), dims,
+                                 preferred_element_type=jnp.float32)
+        acc_e[...] += ye
+        acc_o[...] += yo
 
     @pl.when(kk == k_chunks - 1)
     def _():
-        lo_out[...] = (acc_e[...] * s_ref[0, 0][None, :]).astype(out_dtype)
-        hi_out[...] = (acc_o[...] * s_ref[0, 1][None, :]).astype(out_dtype)
+        if groups_per_block:
+            lo_out[...] = acc_e[...].astype(out_dtype)
+            hi_out[...] = acc_o[...].astype(out_dtype)
+        else:
+            lo_out[...] = (acc_e[...] * s_ref[0, 0][None, :]).astype(out_dtype)
+            hi_out[...] = (acc_o[...] * s_ref[0, 1][None, :]).astype(out_dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("n_block", "out_dtype"))
+@functools.partial(jax.jit,
+                   static_argnames=("n_block", "out_dtype", "interpret"))
 def int4_matmul(x, packed, scale, layer=None, *, n_block: int = 512,
-                out_dtype=jnp.bfloat16):
+                out_dtype=jnp.bfloat16, interpret: bool = False):
     """y[B, N] = x[B, K] @ unpack(packed) * scale.
 
     x:      [B, K] bf16/f32 activations (B >= 8 for MXU sublane tiling).
@@ -83,14 +109,21 @@ def int4_matmul(x, packed, scale, layer=None, *, n_block: int = 512,
             j + N/2), or [L, K, N/2] with `layer` a (traced) scalar
             selecting the layer — no slice materialization.
     scale:  [2, N/2] f32 per-column scales (row 0 = first half's columns,
-            row 1 = second half's), or [L, 2, N/2].
+            row 1 = second half's), or [L, 2, N/2]; with one extra leading
+            group axis ([Gk, 2, N/2] / [L, Gk, 2, N/2]) scales are
+            K-group-wise over K/Gk rows each (models/quant.py
+            quantize_array4 k_group).
+    `interpret` runs the pallas interpreter (CPU tests).
     """
     stacked = packed.ndim == 3
+    grouped = scale.ndim == packed.ndim + 1
     if not stacked:
         packed = packed[None]
         scale = scale[None]
         layer = 0
     L, K, half = packed.shape
+    gk = scale.shape[1] if grouped else 1
+    kg = K // gk                                  # rows per scale group
     N = 2 * half
     hb = n_block // 2
     if half % hb:
@@ -104,6 +137,26 @@ def int4_matmul(x, packed, scale, layer=None, *, n_block: int = 512,
             if K % cand == 0 and cand * hb * 4 <= 8_000_000:
                 k_blk = cand
                 break
+    if grouped:
+        if K % kg:
+            raise ValueError(f"K={K} not divisible by Gk={gk} groups")
+        # A chunk must hold whole groups or lie within one group: realign
+        # k_blk to gcd(k_blk, kg) (both divide K, so the gcd does too).
+        if k_blk % kg and kg % k_blk:
+            k_blk = math.gcd(k_blk, kg)
+        # Each group is a separate sub-dot; finer than 8 groups per chunk
+        # would statically unroll dozens of tiny-contraction dots (MXU
+        # underutilization + compile blowup) — shrink the chunk instead
+        # (smaller chunks only reduce the VMEM footprint).
+        if k_blk // kg > 8:
+            k_blk = 8 * kg if K % (8 * kg) == 0 else kg
+        if k_blk < 128:
+            # _int4_kernel_ok routes such configs (k_group not a >=128
+            # multiple of the lane quantum) to the XLA fallback before
+            # reaching here; direct callers get the loud version.
+            raise ValueError(
+                f"k_group={kg} cannot align a >=128-row K chunk at K={K}; "
+                f"use a multiple of 128")
     k_chunks = K // k_blk
     b = x.shape[0]
     # Row-block large inputs (prefill: rows = B*T). The packed weight is
@@ -117,13 +170,24 @@ def int4_matmul(x, packed, scale, layer=None, *, n_block: int = 512,
     grid = (b // rb, half // hb, k_chunks)
 
     layer_arr = jnp.asarray([layer], jnp.int32)
+    if grouped:
+        gpb = max(1, k_blk // kg)  # scale groups spanned by one K chunk
+        # Gk-axis block index: chunk kk starts at row kk*k_blk = group
+        # (kk*k_blk)//kg; with gpb>1 blocks tile the axis, so divide again.
+        s_spec = pl.BlockSpec(
+            (1, gpb, 2, hb),
+            lambda r, j, kk, s, _gpb=gpb, _kg=kg, _kb=k_blk:
+                (s[0], (kk * _kb) // (_kg * _gpb), 0, j))
+    else:
+        gpb = 0
+        s_spec = pl.BlockSpec((1, 2, hb), lambda r, j, kk, s: (s[0], 0, j))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,
         in_specs=[
             pl.BlockSpec((rb, k_blk), lambda r, j, kk, s: (r, kk)),
             pl.BlockSpec((1, k_blk, hb), lambda r, j, kk, s: (s[0], kk, j)),
-            pl.BlockSpec((1, 2, hb), lambda r, j, kk, s: (s[0], 0, j)),
+            s_spec,
         ],
         out_specs=[
             pl.BlockSpec((rb, hb), lambda r, j, kk, s: (r, j)),
@@ -135,13 +199,15 @@ def int4_matmul(x, packed, scale, layer=None, *, n_block: int = 512,
         ],
     )
     kernel = pl.pallas_call(
-        functools.partial(_kernel, out_dtype=out_dtype, k_chunks=k_chunks),
+        functools.partial(_kernel, out_dtype=out_dtype, k_chunks=k_chunks,
+                          groups_per_block=gpb),
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((b, half), out_dtype),
                    jax.ShapeDtypeStruct((b, half), out_dtype)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
+        interpret=interpret,
     )
     ye, yo = kernel(layer_arr, x, packed, scale)
     return jnp.concatenate([ye, yo], axis=-1)

@@ -115,11 +115,24 @@ def _qtensor_spec(spec: P, rank: int, cls) -> Any:
     return cls(**{kw: P(*full)}, scale=P(*full[:-2], None, full[-1]))
 
 
+def _qtensor4_grouped_spec(spec: P, rank: int) -> Any:
+    """QTensor4 with K-group-wise scales [..., Gk, 2, N/2]: the group axis
+    sits where K sat, so it inherits K's sharding (row-parallel leaves
+    shard it; column-parallel leaves leave it replicated)."""
+    from agentic_traffic_testing_tpu.models.quant import QTensor4
+
+    full = tuple(spec) + (None,) * (rank - len(spec))
+    return QTensor4(packed=P(*full),
+                    scale=P(*full[:-1], None, full[-1]))
+
+
 def expand_quant_specs(params: Any, specs: Any) -> Any:
     """Replace specs of quantized params with per-leaf (q, scale) specs."""
     from agentic_traffic_testing_tpu.models.quant import QTensor, QTensor4
 
     def rec(p, s):
+        if isinstance(p, QTensor4) and p.scale.ndim == p.packed.ndim + 1:
+            return _qtensor4_grouped_spec(s, p.packed.ndim)
         if isinstance(p, (QTensor, QTensor4)):
             return _qtensor_spec(s, (p.q if isinstance(p, QTensor)
                                      else p.packed).ndim, type(p))

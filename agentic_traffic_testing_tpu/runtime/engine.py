@@ -113,6 +113,10 @@ class EngineConfig:
     # or "int4" (nibble-packed, served by the pallas int4 matmul kernel —
     # halves int8's streamed bytes again; single-chip dense models only).
     quantization: Optional[str] = None
+    # AWQ-style K-group size for int4 scales (0 = one scale per full-K
+    # column). 512 is the accuracy knob for real checkpoints
+    # (models/quant.py quantize_array4 k_group).
+    int4_k_group: int = 0
     # MoE expert-capacity override (None -> model default). HF Mixtral drops
     # no tokens; >= num_experts guarantees no capacity drops (exact HF
     # numerics) at the cost of E-fold larger expert buffers (models/moe.py).
@@ -251,7 +255,8 @@ class LLMEngine:
 
                     params = init_params_quantized(self.model_cfg, cfg.seed,
                                                    dtype=dtype,
-                                                   scheme=cfg.quantization)
+                                                   scheme=cfg.quantization,
+                                                   int4_k_group=cfg.int4_k_group)
                 else:
                     params = init_params(self.model_cfg, jax.random.key(cfg.seed), dtype=dtype)
             elif cfg.quantization:
@@ -265,7 +270,8 @@ class LLMEngine:
                     # No delete_originals: the caller still owns these arrays
                     # (memory-critical loads pre-quantize in weights.py /
                     # init_params_quantized instead).
-                    params = quantize_params(params, scheme=cfg.quantization)
+                    params = quantize_params(params, scheme=cfg.quantization,
+                                             int4_k_group=cfg.int4_k_group)
                 elif (isinstance(params["layers"]["wq"], QTensor4)
                       != (cfg.quantization == "int4")):
                     # Pre-quantized params of the OTHER scheme: serving them

@@ -55,6 +55,8 @@ class ServerConfig:
     # "fp8" stores KV pages as float8_e4m3 — double capacity/concurrency,
     # half the decode KV stream (vLLM --kv-cache-dtype fp8 analog).
     kv_cache_dtype: Optional[str] = None       # LLM_KV_CACHE_DTYPE
+    # AWQ-style K-group size for int4 weight scales (0 = per-column).
+    int4_k_group: int = 0                      # LLM_INT4_K_GROUP
     num_blocks: Optional[int] = None           # LLM_NUM_BLOCKS (None -> HBM profile)
     block_size: int = 16                       # LLM_BLOCK_SIZE
     weights_path: Optional[str] = None         # LLM_WEIGHTS_PATH (local safetensors dir)
@@ -110,6 +112,7 @@ class ServerConfig:
         c.prefill_batch_max_len = int(pbml) if pbml else None
         c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
         c.kv_cache_dtype = os.environ.get("LLM_KV_CACHE_DTYPE") or None
+        c.int4_k_group = int(os.environ.get("LLM_INT4_K_GROUP") or c.int4_k_group)
         nb = os.environ.get("LLM_NUM_BLOCKS")
         c.num_blocks = int(nb) if nb else None
         c.block_size = int(os.environ.get("LLM_BLOCK_SIZE") or c.block_size)

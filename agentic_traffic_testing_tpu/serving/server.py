@@ -129,6 +129,7 @@ class LLMServer:
             prefill_batch_max_len=c.prefill_batch_max_len,
             prefix_caching=c.prefix_caching,
             kv_cache_dtype=c.kv_cache_dtype,
+            int4_k_group=c.int4_k_group,
             moe_capacity_factor=c.moe_capacity_factor,
             speculation=c.speculation, spec_tokens=c.spec_tokens,
             spec_ngram=c.spec_ngram,
@@ -213,7 +214,8 @@ class LLMServer:
                                     quantization=self.cfg.quantization,
                                     int4_groups=(self.cfg.tp_size
                                                  if self.cfg.quantization == "int4"
-                                                 else 1))
+                                                 else 1),
+                                    int4_k_group=self.cfg.int4_k_group)
             self.model_loaded = True
             return params
         except Exception as e:
