@@ -175,9 +175,11 @@ def _int4_kernel_ok(rows: int, k: int, half: int, k_group: int = 0) -> bool:
     """Shapes the pallas kernel serves: decode/verify row counts, or
     prefill row counts divisible by the kernel's row block and small enough
     that per-row-block weight re-streams still beat the XLA fallback, and a
-    lane-tileable half width. K-group scales finer than the kernel's
-    8-groups-per-chunk bound (ops/pallas/int4_matmul.py) route to the XLA
-    fallback — correct, just unaccelerated."""
+    lane-tileable half width. K-group sizes that are not >=128-row
+    multiples route to the XLA fallback: the kernel needs group boundaries
+    to align with >=128-row K chunks (its chunk floor —
+    ops/pallas/int4_matmul.py); aligned-but-fine groups are fine (the
+    kernel shrinks its chunk to cap 8 sub-dots per chunk)."""
     from agentic_traffic_testing_tpu.ops.pallas.int4_matmul import (
         MAX_KERNEL_ROWS,
         ROW_BLOCK,

@@ -154,12 +154,18 @@ def main() -> None:
 
     def run_prefill() -> float:
         """Solo long-prompt prefill wall (enqueue -> first token), the
-        compute-bound half of serving (round-3: flash attention site)."""
+        compute-bound half of serving (round-3: flash attention site). On
+        failure the stale request is aborted so it cannot linger in
+        fan_engine and contaminate the TTFT probe that shares it."""
         ids = rng.integers(10, vocab - 10, prefill_len).tolist()
         req = fan_engine.add_request(ids, SamplingParams(
             temperature=0.0, max_tokens=1, ignore_eos=True))
-        while fan_engine.has_work() and not req.is_finished():
-            fan_engine.step()
+        try:
+            while fan_engine.has_work() and not req.is_finished():
+                fan_engine.step()
+        except Exception:
+            fan_engine.abort_request(req)
+            raise
         return req.first_token_time - req.arrival_time
 
     # Warmup compiles every (batch, bucket) shape both workloads touch;

@@ -440,3 +440,36 @@ def test_int4_k_group_engine_matches_dequantized_oracle():
         return eng.generate(prompt, samp).output_ids
 
     assert run(q4) == run(deq_params)
+
+
+def test_load_params_quantizes_like_in_memory_path(tmp_path):
+    """The checkpoint loader's quantize-at-load (weights.load_params) and
+    the in-memory quantize_params produce identical QTensor leaves for the
+    same weights — pinning the loader-quantizer integration the real-
+    checkpoint serving path depends on."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from agentic_traffic_testing_tpu.models.weights import (
+        load_params,
+        params_from_hf_state_dict,
+    )
+
+    torch.manual_seed(11)
+    hf_cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        tie_word_embeddings=False)
+    model = LlamaForCausalLM(hf_cfg).eval()
+    model.save_pretrained(tmp_path, safe_serialization=True)
+
+    cfg, loaded = load_params(str(tmp_path), dtype=jnp.float32,
+                              quantization="int8")
+    sd = {k: v.detach().cpu().numpy() for k, v in model.state_dict().items()}
+    mem = quantize_params(
+        params_from_hf_state_dict(cfg, sd, dtype=np.float32))
+    np.testing.assert_array_equal(
+        np.asarray(loaded["layers"]["wq"].q), np.asarray(mem["layers"]["wq"].q))
+    np.testing.assert_allclose(
+        np.asarray(loaded["layers"]["wq"].scale),
+        np.asarray(mem["layers"]["wq"].scale), rtol=1e-6)
