@@ -138,3 +138,38 @@ def test_fp8_kv_gauges_report_doubled_tokens():
     srv = LLMServer(cfg)
     assert srv.engine.cache.k.dtype == jnp.float8_e4m3fn
     assert b"llm_kv_cache_total_tokens" in srv.metrics.render()
+
+
+def test_fp8_composes_with_prefix_caching():
+    """fp8 pages are content-addressed like bf16 ones (hashes are over
+    token ids, not page bytes): a cache-hit prefill over f8 pages decodes
+    the same greedy tokens as a cold one."""
+    params = init_params(CFG, jax.random.key(5), dtype=jnp.float32)
+    ecfg = EngineConfig(model="tiny", dtype="float32", kv_cache_dtype="fp8",
+                        prefix_caching=True, num_blocks=64, max_model_len=128)
+    eng = LLMEngine(ecfg, model_cfg=CFG, params=params)
+    prompt = list(range(11, 43))
+    samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    cold = eng.generate(prompt, samp).output_ids
+    warm = eng.generate(prompt, samp).output_ids  # prefix-cache hit path
+    assert cold == warm
+
+
+def test_fp8_composes_with_speculation():
+    """ngram speculation over f8 pages: verify-step drafts write f8 KV and
+    greedy output matches the non-speculative fp8 engine exactly (same
+    dequantized bytes, same argmax)."""
+    params = init_params(CFG, jax.random.key(6), dtype=jnp.float32)
+    prompt = [5, 6, 7, 8] * 6
+    samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    def run(spec):
+        ecfg = EngineConfig(model="tiny", dtype="float32",
+                            kv_cache_dtype="fp8", num_blocks=64,
+                            max_model_len=128,
+                            speculation="ngram" if spec else None,
+                            spec_tokens=2)
+        return LLMEngine(ecfg, model_cfg=CFG, params=params).generate(
+            prompt, samp).output_ids
+
+    assert run(False) == run(True)
