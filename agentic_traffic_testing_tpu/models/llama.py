@@ -455,9 +455,31 @@ def prefill_chunk_impl(
         v_prior = kvc.gather_kv(
             jax.lax.dynamic_index_in_dim(cache.v, li, 0, keepdims=False),
             block_tables)[..., :hd].astype(v.dtype)
+        k_all = jnp.concatenate([k_prior, k], axis=1)
+        v_all = jnp.concatenate([v_prior, v], axis=1)
+        import os as _os
+
+        if _os.environ.get("ATT_CHUNK_ATTENTION") == "flash":
+            # Opt-in flash site for the chunk path (round 3): kills the
+            # [H, C, W*bs+C] score materialization; the gather above stays
+            # (its bytes are bounded by context, not width). Interpret mode
+            # engages off-TPU so the same path is CPU-testable. Exact for
+            # full chunks only: the two-region mask covers chunk_start and
+            # the garbage tail, but a PARTIAL chunk (chunk_len < C, the
+            # final chunk of a prompt) also needs the chunk_len clamp — the
+            # engine only emits full chunks before the last, and the last
+            # chunk's logits come from chunk_len-1, whose row is exact
+            # (rows past chunk_len attend garbage that nothing reads;
+            # their K/V pages beyond seq_len are never read either).
+            from agentic_traffic_testing_tpu.ops.pallas.chunk_flash import (
+                chunk_flash_attention,
+            )
+
+            return chunk_flash_attention(
+                q, k_all, v_all, chunk_start, prior_len=w * bs,
+                interpret=jax.default_backend() != "tpu")
         return causal_attention(
-            q, jnp.concatenate([k_prior, k], axis=1),
-            jnp.concatenate([v_prior, v], axis=1),
+            q, k_all, v_all,
             q_positions=positions, kv_positions=kv_positions,
             kv_valid_mask=kv_mask,
         )

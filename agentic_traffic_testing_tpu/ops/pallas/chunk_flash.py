@@ -1,0 +1,159 @@
+"""Flash attention for the chunked-prefill site: [prior pages ++ chunk].
+
+Why: a >prefill_chunk_tokens prompt prefills in chunks, and each chunk
+attends over [previously-written pages (gathered)] ++ [itself, in
+register]. The jnp site materializes f32 scores [H, C, W*bs + C] — at an
+8k prompt's second 4096-chunk that is ~100 GB of HBM traffic across a 1B
+model's layers, the same disease the solo path's flash site cured
+(docs/BENCHMARKS.md round-3 prefill anatomy). The in-tree flash kernel
+cannot express this case (no offset-causal, no residual outputs to merge
+two calls), so this kernel runs the standard flash recipe over the
+concatenated KV with the chunk's two-region validity mask built in:
+
+    kv slot i valid for q token s (absolute position chunk_start + s) iff
+        i <  chunk_start                (prior region, always causal-past)
+     or i >= prior_len and i - prior_len <= s    (in-chunk causal)
+
+Prior slots in [chunk_start, prior_len) — the bucketed gather width's
+garbage tail — are invalid by the first clause. The gather that feeds
+`kv` already exists in the chunk path (bytes are bounded: context * KH *
+hd per layer); what this kernel removes is the score materialization, not
+the gather.
+
+Grid (KH, C/QB, Tkv/KB): one GQA query tile per (kv head, q block), kv
+streamed in KB-token blocks by the BlockSpec pipeline, online softmax in
+f32 scratch that persists across the innermost kv axis — the same
+pattern as the v1 paged decode kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _kernel(start_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
+            scale: float, prior_len: int, kv_block: int, q_block: int,
+            queries_per_kv: int):
+    """start_ref [1] (SMEM): chunk_start. q_ref [1, QB*qpk, hd]; k/v_ref
+    [1, KB, hd]; o_ref like q_ref; scratch persists over the kv grid dim."""
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+    last_kb = pl.num_programs(2) - 1
+    rows = q_ref.shape[1]
+    chunk_start = start_ref[0]
+
+    @pl.when(kb == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32) * scale                  # [rows, hd]
+    k = k_ref[0].astype(jnp.float32)                          # [KB, hd]
+    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+
+    kv_pos = kb * kv_block + jax.lax.broadcasted_iota(
+        jnp.int32, (rows, kv_block), 1)
+    q_tok = (qb * q_block
+             + jax.lax.broadcasted_iota(jnp.int32, (rows, kv_block), 0)
+             // queries_per_kv)
+    valid = jnp.logical_or(
+        kv_pos < chunk_start,
+        jnp.logical_and(kv_pos >= prior_len, kv_pos - prior_len <= q_tok))
+    s = jnp.where(valid, s, _NEG_INF)
+
+    m_prev = m_ref[:rows, 0:1]
+    m_cur = jnp.max(s, axis=-1, keepdims=True)
+    m_new = jnp.maximum(m_prev, m_cur)
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    l_new = l_ref[:rows, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    v = v_ref[0].astype(jnp.float32)
+    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                             preferred_element_type=jnp.float32)
+    acc_ref[:rows, :] = acc_ref[:rows, :] * alpha + pv
+    m_ref[:rows, :] = jnp.broadcast_to(m_new, (rows, m_ref.shape[1]))
+    l_ref[:rows, :] = jnp.broadcast_to(l_new, (rows, l_ref.shape[1]))
+
+    @pl.when(kb == last_kb)
+    def _finish():
+        l = jnp.maximum(l_ref[:rows, 0:1], 1e-30)
+        o_ref[0] = (acc_ref[:rows, :] / l).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("prior_len", "interpret"))
+def chunk_flash_attention(
+    q: jax.Array,            # [1, C, H, hd] — one sequence's chunk queries
+    kv_k: jax.Array,         # [1, Tkv, KH, hd] — gathered prior ++ chunk K
+    kv_v: jax.Array,         # [1, Tkv, KH, hd]
+    chunk_start: jax.Array,  # scalar i32 — absolute position of q[:, 0]
+    *,
+    prior_len: int,          # static: gathered prior width in tokens (W*bs)
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [1, C, H, hd]; see module docstring for the validity rule."""
+    _, c, h, hd = q.shape
+    kh = kv_k.shape[2]
+    qpk = h // kh
+    scale = 1.0 / math.sqrt(hd)
+    # Pad kv up to a 1024-token tile: padded slots sit past prior_len with
+    # in-chunk offset >= C > any q token, so the validity mask drops them
+    # for free — no caller-side shape constraints.
+    kv_block = 1024 if kv_k.shape[1] > 1024 else kv_k.shape[1]
+    pad = -kv_k.shape[1] % kv_block
+    if pad:
+        kv_k = jnp.pad(kv_k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        kv_v = jnp.pad(kv_v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    tkv = kv_k.shape[1]
+    q_block = c
+    for cand in (512, 256, 128, 64, 32, 16):
+        if c > 512 and c % cand == 0:
+            q_block = cand
+            break
+    rows = q_block * qpk
+    # Head-major GQA tiles: [KH, C*qpk, hd], row t*qpk + g = token t, group g.
+    q_r = (q[0].reshape(c, kh, qpk, hd).transpose(1, 0, 2, 3)
+           .reshape(kh, c * qpk, hd))
+    k_r = kv_k[0].transpose(1, 0, 2)                         # [KH, Tkv, hd]
+    v_r = kv_v[0].transpose(1, 0, 2)
+
+    grid = (kh, c // q_block, tkv // kv_block)
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, prior_len=prior_len, kv_block=kv_block,
+            q_block=q_block, queries_per_kv=qpk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, rows, hd), lambda kh_, qb, kb, s: (kh_, qb, 0)),
+                pl.BlockSpec((1, kv_block, hd), lambda kh_, qb, kb, s: (kh_, kb, 0)),
+                pl.BlockSpec((1, kv_block, hd), lambda kh_, qb, kb, s: (kh_, kb, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, rows, hd),
+                                   lambda kh_, qb, kb, s: (kh_, qb, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((kh, c * qpk, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(jnp.asarray(chunk_start, jnp.int32).reshape(1), q_r, k_r, v_r)
+    # [KH, C*qpk, hd] -> [1, C, H, hd]
+    return (out.reshape(kh, c, qpk, hd).transpose(1, 0, 2, 3)
+            .reshape(1, c, h, hd))

@@ -132,8 +132,38 @@ def t_fp8_engine():
     assert all(len(r.output_ids) == 32 for r in reqs)
 
 
+@check("chunk-flash kernel on hardware")
+def t_chunk_flash():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
+    from agentic_traffic_testing_tpu.ops.pallas.chunk_flash import (
+        chunk_flash_attention,
+    )
+
+    B, C, H, KH, hd = 1, 2048, 32, 8, 64
+    W_TOK, start = 4096, 4000
+    q = jax.random.normal(jax.random.key(8), (B, C, H, hd), jnp.bfloat16)
+    kk = jax.random.normal(jax.random.key(9), (B, W_TOK + C, KH, hd), jnp.bfloat16)
+    vv = jax.random.normal(jax.random.key(10), (B, W_TOK + C, KH, hd), jnp.bfloat16)
+    got = np.asarray(chunk_flash_attention(
+        q, kk, vv, jnp.int32(start), prior_len=W_TOK), np.float32)
+    positions = start + jnp.arange(C, dtype=jnp.int32)[None]
+    kv_pos = jnp.concatenate(
+        [jnp.arange(W_TOK, dtype=jnp.int32)[None], positions], axis=1)
+    kv_mask = jnp.concatenate(
+        [jnp.arange(W_TOK, dtype=jnp.int32)[None] < start,
+         jnp.ones((1, C), bool)], axis=1)
+    ref = np.asarray(causal_attention(
+        q, kk, vv, q_positions=positions, kv_positions=kv_pos,
+        kv_valid_mask=kv_mask), np.float32)
+    assert np.abs(got - ref).max() < 0.03
+
+
 def main() -> None:
-    for fn in (t_flash, t_fp8, t_int4g, t_fp8_engine):
+    for fn in (t_flash, t_fp8, t_int4g, t_fp8_engine, t_chunk_flash):
         fn()
     if FAILED:
         sys.exit(f"FAILED: {FAILED}")

@@ -166,3 +166,32 @@ def test_next_chunk_stays_on_compile_ladder():
         seen.append((plan.chunk_len, plan.padded_len))
         req.num_computed_tokens += plan.chunk_len
     assert sum(c for c, _ in seen) == 800
+
+
+@pytest.mark.parametrize("plen", [64, 100])
+def test_chunk_flash_site_matches_unchunked_greedy(params, plen, monkeypatch):
+    """ATT_CHUNK_ATTENTION=flash swaps the chunk attention site for the
+    pallas chunk-flash kernel (interpret mode here): greedy output must
+    match the unchunked oracle exactly, including the bucketed prior
+    width's garbage tail and partial final chunks. A call counter pins
+    that the kernel actually ran — the jnp fallback would produce the
+    same tokens, so output equality alone cannot catch a disconnected
+    dispatch."""
+    from agentic_traffic_testing_tpu.ops.pallas import chunk_flash as cfmod
+
+    calls = []
+    real = cfmod.chunk_flash_attention
+
+    def counting(*a, **kw):
+        calls.append(1)
+        return real(*a, **kw)
+
+    monkeypatch.setattr(cfmod, "chunk_flash_attention", counting)
+    monkeypatch.setenv("ATT_CHUNK_ATTENTION", "flash")
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, CFG.vocab_size, plen).tolist()
+    want = oracle(params, prompt, greedy(10))
+    eng = make_engine(params, chunk=32)
+    req = eng.generate(prompt, greedy(10))
+    assert req.generated_ids == want
+    assert calls, "chunk_flash_attention was never invoked"
