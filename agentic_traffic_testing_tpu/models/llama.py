@@ -364,6 +364,9 @@ def prefill_impl(
     block_tables: jax.Array,  # [B, max_blocks] (padding rows -> TRASH_BLOCK)
     seq_lens: jax.Array,      # [B] true prompt lengths
     kv_writer_mode: Optional[str] = None,  # static; see ops/kv_writer.py
+    attn_mode: Optional[str] = None,       # static; None=auto | "ring_sp"
+    attn_mesh=None,           # static Mesh + axis for attn_mode="ring_sp"
+    attn_axis: Optional[str] = None,
 ) -> tuple[jax.Array, KVCache]:
     """Returns (last-token logits [B, V] fp32, updated cache).
 
@@ -373,6 +376,13 @@ def prefill_impl(
     page writes out of the layer scan stops them serializing against layer
     compute (~3x prefill win on v5e). Attention uses the in-register K/V, so
     numerics don't depend on the pool at all here.
+
+    `attn_mode="ring_sp"` swaps the attention site for ring attention over
+    the `attn_axis` mesh axis (ops/ring_attention.py) — the serving
+    sequence-parallel prefill: T sharded over sp chips, O(T/sp) score
+    memory per chip, one ppermute hop per ring step. Everything else in
+    this function is per-token math that GSPMD shards for free from the
+    input sharding; decode is untouched (parallel/sp_runner.py).
     """
     b, t = tokens.shape
     if t % cache.block_size != 0:  # trace-time check: unaligned tails would be dropped
@@ -381,13 +391,34 @@ def prefill_impl(
     x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)
     sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
 
-    def attn_site(q, k, v, lp_index):
-        # Flash kernel on TPU (ops/flash_prefill.py), jnp oracle elsewhere —
-        # the score-materializing path was ~70% of the prefill scan.
-        from agentic_traffic_testing_tpu.ops.flash_prefill import prefill_attention
+    if attn_mode == "ring_sp":
+        from agentic_traffic_testing_tpu.ops.ring_attention import (
+            make_sp_prefill_attention,
+        )
 
-        return prefill_attention(q, k, v, q_positions=positions,
-                                 kv_valid_len=seq_lens)
+        sp = attn_mesh.shape[attn_axis]
+        if t % sp != 0:
+            raise ValueError(
+                f"sp prefill needs T % sp == 0; got T={t}, sp={sp} "
+                f"(serving buckets are pow2/block-aligned — this means the "
+                f"bucket ladder and the sp degree disagree)")
+        ring = make_sp_prefill_attention(attn_mesh, sp_axis=attn_axis)
+
+        def attn_site(q, k, v, lp_index):
+            # Same tail-padding contract as the flash site: causality alone
+            # is exact, kv_valid_len unused.
+            return ring(q, k, v)
+    else:
+        def attn_site(q, k, v, lp_index):
+            # Flash kernel on TPU (ops/flash_prefill.py), jnp oracle
+            # elsewhere — the score-materializing path was ~70% of the
+            # prefill scan.
+            from agentic_traffic_testing_tpu.ops.flash_prefill import (
+                prefill_attention,
+            )
+
+            return prefill_attention(q, k, v, q_positions=positions,
+                                     kv_valid_len=seq_lens)
 
     xs_layers, held = _scan_split(params["layers"])
 
@@ -614,7 +645,9 @@ def verify_step_impl(
 # its own fused jits from the *_impl functions (model step + on-device
 # sampling in one dispatch — see runtime/runner.py).
 forward_full = jax.jit(forward_full_impl, static_argnames=("cfg",))
-prefill = jax.jit(prefill_impl, static_argnames=("cfg", "kv_writer_mode"),
+prefill = jax.jit(prefill_impl,
+                  static_argnames=("cfg", "kv_writer_mode", "attn_mode",
+                                   "attn_mesh", "attn_axis"),
                   donate_argnums=(3,))
 decode_step = jax.jit(
     decode_step_impl,

@@ -59,7 +59,12 @@ def _expert_dense4(x: jax.Array, w) -> jax.Array:
     if packed.ndim == 4:                                # [L, E, K, N/2]
         packed = packed.reshape(-1, *packed.shape[2:])  # [(L*E), K, N/2]
         scale = scale.reshape(-1, *scale.shape[2:])
-    flat = QTensor4(packed=packed, scale=scale)
+    # Propagate the packing aux: a TP-grouped expert stack must still trip
+    # _dense4's global-path guard, not silently decode column-permuted
+    # (quantize_params refuses int4 x MoE x TP today, so this is defense in
+    # depth for when that wiring lands).
+    flat = QTensor4(packed=packed, scale=scale,
+                    groups=getattr(stacked, "groups", 1))
 
     def body(_, xs):
         xe, ei = xs

@@ -62,7 +62,8 @@ quantize_array = functools.partial(
 )
 
 
-class QTensor4(NamedTuple):
+@jax.tree_util.register_pytree_node_class
+class QTensor4:
     """Per-output-channel symmetric int4 weight, nibble-packed.
 
     Layout matches ops/pallas/int4_matmul.py: `packed[..., k, j]` holds
@@ -70,10 +71,28 @@ class QTensor4(NamedTuple):
     (HALF pairing — the kernel then never interleaves vectors); scales are
     split the same way. The kernel streams true int4 bytes from HBM —
     measured 1.8x the fused-int8 matmul's wall time per weight-bound step.
+
+    `groups` records the PACKING layout (quantize_array4's `groups`): 1 is
+    the standard full-N half pairing above; g>1 pairs within each of g
+    contiguous column groups — the tensor-parallel byte layout, only
+    decodable as g contiguous shards (QTensor4TP). It rides pytree aux_data
+    (static, participates in jit cache keys and treedef equality), so the
+    global dequantize path can refuse a TP-packed tensor instead of
+    silently decoding column-permuted weights (_dense4 guard).
     """
 
-    packed: jax.Array   # int8 [..., K, N//2] nibble pairs
-    scale: jax.Array    # f32 [..., 2, N//2] per-column, split by half
+    def __init__(self, packed: jax.Array, scale: jax.Array,
+                 groups: int = 1) -> None:
+        self.packed = packed    # int8 [..., K, N//2] nibble pairs
+        self.scale = scale      # f32 [..., 2, N//2] per-column, split by half
+        self.groups = groups
+
+    def tree_flatten(self):
+        return (self.packed, self.scale), (self.groups,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
 
     @property
     def shape(self):
@@ -206,6 +225,21 @@ def _int4_n_block(half: int) -> int:
 def _dense4(x: jax.Array, w: QTensor4, layer=None) -> jax.Array:
     from agentic_traffic_testing_tpu.ops.pallas.int4_matmul import int4_matmul
 
+    if w.groups > 1:
+        # Both branches below assume full-N half pairing: the kernel pairs
+        # column j with j + N/2, and _unpack4 concatenates [lo, hi] across
+        # the full width. A groups>1 tensor (TP byte layout) decodes to
+        # column-PERMUTED weights here — e.g. a tp-packed checkpoint loaded
+        # single-chip. Refuse loudly; the valid consumers are the per-chip
+        # shards under QTensor4TP's shard_map, whose local tensors are
+        # self-contained groups=1 views.
+        raise ValueError(
+            f"QTensor4 packed with groups={w.groups} reached the global "
+            f"int4 matmul path — this byte layout is only decodable as "
+            f"{w.groups} contiguous TP shards (QTensor4TP under shard_map). "
+            f"Serve it with tp_size={w.groups}, or repack with "
+            f"quantize_params(..., int4_groups=1) for single-chip use.")
+
     *lead, k = x.shape
     rows = 1
     for d in lead:
@@ -295,6 +329,12 @@ def embed_lookup(w, ids: jax.Array, dtype=None) -> jax.Array:
         out = rows * jnp.squeeze(w.scale, axis=-2)
         return out.astype(dtype if dtype is not None else jnp.bfloat16)
     if isinstance(w, QTensor4):
+        if w.groups > 1:
+            raise ValueError(
+                f"embedding QTensor4 packed with groups={w.groups}: the row "
+                f"gather dequantizes globally and would decode column-"
+                f"permuted rows — embeddings must keep standard packing "
+                f"(quantize_params already does)")
         out_dtype = dtype if dtype is not None else jnp.bfloat16
         return _unpack4(w.packed[ids], w.scale, out_dtype)
     return w[ids]
@@ -353,7 +393,7 @@ def _quantize_array4_impl(w: jax.Array, groups: int = 1,
     sc = sc.astype(jnp.float32)
     if not k_group:
         sc = sc[..., 0, :, :]                                 # [..., 2, N/2]
-    return QTensor4(packed=packed, scale=sc)
+    return QTensor4(packed=packed, scale=sc, groups=groups)
 
 
 quantize_array4 = jax.jit(_quantize_array4_impl,

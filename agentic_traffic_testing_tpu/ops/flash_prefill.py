@@ -6,37 +6,40 @@ those read/write passes are ~70% of the prefill layer scan (~43 of 60 ms)
 while the MLP matmuls already run at ~100% MFU (docs/BENCHMARKS.md round-3
 prefill anatomy). The fix is the standard flash recipe — stream K/V tiles
 through VMEM with an online softmax, never materializing scores — via the
-in-tree `jax.experimental.pallas.ops.tpu.flash_attention` kernel. The CUDA
-analog lives inside vLLM's prefill kernels for the reference
-(serve_llm.py:527-605 delegates to vLLM); here it is one more pallas site.
+FIRST-PARTY kernel in ops/pallas/chunk_flash.py (round-4: one in-tree
+kernel body covers the solo/batched site here and the chunked site; the
+round-3 `jax.experimental.pallas.ops.tpu.flash_attention` library
+dependency is gone). The CUDA analog lives inside vLLM's prefill kernels
+for the reference (serve_llm.py:527-605 delegates to vLLM); here it is
+one more pallas site.
 
 Scope: the SOLO and BATCHED prefill paths (contiguous positions from 0,
-padding only at the tail). Under those invariants plain `causal=True` is
+padding only at the tail). Under those invariants plain causality is
 exact: real queries precede tail padding, so no real query row ever admits
 a padded kv slot, and padded rows' outputs land in pages past seq_len that
 no later step reads (ctx_lens bounds every decode/chunk read). The chunked
-path keeps its gather site (prior pages + in-register chunk have different
-validity rules). Off-TPU or at kernel-unfriendly shapes this falls back to
-the jnp oracle, so CPU tests and the virtual mesh see identical numerics.
+path keeps its own entry point (prior pages + in-register chunk have
+different validity rules — same kernel body, chunk_flash_attention). Off-
+TPU or at kernel-unfriendly shapes this falls back to the jnp oracle, so
+CPU tests and the virtual mesh see identical numerics.
 """
 
 from __future__ import annotations
 
-import math
 from typing import Optional
 
 import jax
-import jax.numpy as jnp
 
-from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention, repeat_kv
+from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
 
 
 def _flash_ok(tq: int, hd: int) -> bool:
     if jax.default_backend() != "tpu":
         return False
-    # The kernel tiles queries/keys in 128-row blocks and lanes in 128s;
-    # every serving bucket is block_size-aligned, so T % 128 covers all but
-    # the smallest buckets (those are cheap in jnp anyway).
+    # The kernel tiles q/kv rows in >=16-token power-of-two blocks; every
+    # serving bucket is block_size-aligned, so T % 128 covers all but the
+    # smallest buckets (those are cheap in jnp anyway). hd is the tile's
+    # lane dim — the serving models use 64 or 128.
     return tq >= 256 and tq % 128 == 0 and hd in (64, 128, 256)
 
 
@@ -53,34 +56,8 @@ def prefill_attention(
     if not _flash_ok(tq, hd):
         return causal_attention(q, k, v, q_positions=q_positions,
                                 kv_valid_len=kv_valid_len)
-    from jax.experimental.pallas.ops.tpu.flash_attention import (
-        BlockSizes,
-        flash_attention,
+    from agentic_traffic_testing_tpu.ops.pallas.chunk_flash import (
+        causal_flash_attention,
     )
 
-    kh = k.shape[2]
-    # GQA via head repetition, matching repeat_kv's h // (H/KH) grouping.
-    k = repeat_kv(k, h // kh)
-    v = repeat_kv(v, h // kh)
-    # Large blocks, measured: the library defaults grid far too fine for
-    # serving shapes (2048x64: 120 ms/call default vs 3.9 ms at full-T
-    # blocks on v5e — docs/BENCHMARKS.md round-3 prefill anatomy). The
-    # kernel requires block sizes that DIVIDE tq, so take the largest
-    # power-of-two divisor (tq % 128 == 0 guarantees >= 128) capped at the
-    # measured sweet spot — odd buckets like 3072 or 640 get 1024/128-wide
-    # blocks instead of a trace-time ValueError.
-    blk = 128
-    while blk * 2 <= 2048 and tq % (blk * 2) == 0:
-        blk *= 2
-    bs = BlockSizes(block_q=blk, block_k_major=blk, block_k=min(blk, 512),
-                    block_b=1)
-    # Kernel layout is head-major [B, H, T, hd].
-    out = flash_attention(
-        q.transpose(0, 2, 1, 3),
-        k.transpose(0, 2, 1, 3),
-        v.transpose(0, 2, 1, 3),
-        causal=True,
-        sm_scale=1.0 / math.sqrt(hd),
-        block_sizes=bs,
-    )
-    return out.transpose(0, 2, 1, 3).astype(q.dtype)
+    return causal_flash_attention(q, k, v).astype(q.dtype)

@@ -1,29 +1,43 @@
-"""Flash attention for the chunked-prefill site: [prior pages ++ chunk].
+"""First-party flash attention: solo/batched prefill + chunked-prefill site.
 
-Why: a >prefill_chunk_tokens prompt prefills in chunks, and each chunk
-attends over [previously-written pages (gathered)] ++ [itself, in
-register]. The jnp site materializes f32 scores [H, C, W*bs + C] — at an
-8k prompt's second 4096-chunk that is ~100 GB of HBM traffic across a 1B
-model's layers, the same disease the solo path's flash site cured
-(docs/BENCHMARKS.md round-3 prefill anatomy). The in-tree flash kernel
-cannot express this case (no offset-causal, no residual outputs to merge
-two calls), so this kernel runs the standard flash recipe over the
-concatenated KV with the chunk's two-region validity mask built in:
+Why: materialized-score attention is HBM-bound — the jnp prefill site
+writes per-layer f32 score tensors ([H, T, T] — 537 MB/layer for a 1B at
+T=2048), and the xplane trace shows those read/write passes are ~70% of
+the prefill layer scan while the MLP matmuls already run at ~100% MFU
+(docs/BENCHMARKS.md round-3 prefill anatomy). The fix is the standard
+flash recipe: stream K/V tiles through VMEM with an online softmax in f32
+scratch, never materializing scores. The CUDA analog lives inside vLLM's
+prefill kernels for the reference (reference llm/serve_llm.py:527-605
+delegates to vLLM); here it is an in-tree pallas kernel.
 
-    kv slot i valid for q token s (absolute position chunk_start + s) iff
-        i <  chunk_start                (prior region, always causal-past)
-     or i >= prior_len and i - prior_len <= s    (in-chunk causal)
+ONE kernel body serves both prefill shapes (round-4: replaces the
+`jax.experimental.pallas.ops.tpu.flash_attention` library kernel at the
+solo/batched site, so the whole flash surface is first-party):
 
-Prior slots in [chunk_start, prior_len) — the bucketed gather width's
-garbage tail — are invalid by the first clause. The gather that feeds
-`kv` already exists in the chunk path (bytes are bounded: context * KH *
-hd per layer); what this kernel removes is the score materialization, not
-the gather.
+  * `causal_flash_attention` — the solo/batched prefill site: [B, T]
+    queries over [B, T] keys, plain causal, contiguous positions from 0
+    (tail padding is handled by causality: padded rows' outputs land in
+    pages past seq_len that no later step reads).
+  * `chunk_flash_attention` — the chunked-prefill site: each chunk attends
+    over [previously-written pages (gathered)] ++ [itself, in register]
+    with the two-region validity rule
 
-Grid (KH, C/QB, Tkv/KB): one GQA query tile per (kv head, q block), kv
-streamed in KB-token blocks by the BlockSpec pipeline, online softmax in
-f32 scratch that persists across the innermost kv axis — the same
-pattern as the v1 paged decode kernel.
+        kv slot i valid for q token s (absolute position chunk_start + s) iff
+            i <  chunk_start                (prior region, always causal-past)
+         or i >= prior_len and i - prior_len <= s    (in-chunk causal)
+
+    Prior slots in [chunk_start, prior_len) — the bucketed gather width's
+    garbage tail — are invalid by the first clause. Plain causal IS this
+    rule at prior_len = chunk_start = 0, which is what makes one kernel
+    body cover both sites.
+
+Grid ([B,] KH, Tq/QB, Tkv/KB): one GQA query tile per (kv head, q block),
+kv streamed in KB-token blocks by the BlockSpec pipeline, online softmax
+in f32 scratch that persists across the innermost kv axis — the same
+pattern as the v1 paged decode kernel. KV blocks with no valid slot for
+their q tile (beyond-diagonal, or entirely inside the gather-tail gap)
+skip their compute via pl.when — the DMA still streams them, but the MXU
+and softmax passes don't run.
 """
 
 from __future__ import annotations
@@ -41,13 +55,15 @@ _NEG_INF = -1e30
 
 def _kernel(start_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
             scale: float, prior_len: int, kv_block: int, q_block: int,
-            queries_per_kv: int):
-    """start_ref [1] (SMEM): chunk_start. q_ref [1, QB*qpk, hd]; k/v_ref
-    [1, KB, hd]; o_ref like q_ref; scratch persists over the kv grid dim."""
-    qb = pl.program_id(1)
-    kb = pl.program_id(2)
-    last_kb = pl.num_programs(2) - 1
-    rows = q_ref.shape[1]
+            queries_per_kv: int, q_axis: int):
+    """start_ref [1] (SMEM): chunk_start. q_ref [..., QB*qpk, hd]; k/v_ref
+    [..., KB, hd]; o_ref like q_ref; scratch persists over the kv grid
+    dim. `q_axis` = grid index of the q-block axis (kv axis follows it)."""
+    qb = pl.program_id(q_axis)
+    kb = pl.program_id(q_axis + 1)
+    last_kb = pl.num_programs(q_axis + 1) - 1
+    rows = q_ref.shape[-2]
+    hd = q_ref.shape[-1]
     chunk_start = start_ref[0]
 
     @pl.when(kb == 0)
@@ -56,38 +72,67 @@ def _kernel(start_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    q = q_ref[0].astype(jnp.float32) * scale                  # [rows, hd]
-    k = k_ref[0].astype(jnp.float32)                          # [KB, hd]
-    s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+    # Fully-invalid kv block for this q tile: nothing in the always-valid
+    # prior region, and the in-chunk region is either absent or entirely
+    # beyond the tile's last query row. Beyond-diagonal blocks and gap
+    # blocks both land here; the compute skip is the flash equivalent of
+    # the library kernel's causal grid shrink (DMA still streams the
+    # block — bandwidth-bound loss only above the diagonal).
+    min_kv = kb * kv_block
+    max_q_tok = (qb + 1) * q_block - 1
+    has_prior = min_kv < chunk_start
+    has_inchunk = jnp.logical_and(
+        min_kv + kv_block > prior_len,
+        jnp.maximum(min_kv, prior_len) - prior_len <= max_q_tok)
 
-    kv_pos = kb * kv_block + jax.lax.broadcasted_iota(
-        jnp.int32, (rows, kv_block), 1)
-    q_tok = (qb * q_block
-             + jax.lax.broadcasted_iota(jnp.int32, (rows, kv_block), 0)
-             // queries_per_kv)
-    valid = jnp.logical_or(
-        kv_pos < chunk_start,
-        jnp.logical_and(kv_pos >= prior_len, kv_pos - prior_len <= q_tok))
-    s = jnp.where(valid, s, _NEG_INF)
+    @pl.when(jnp.logical_or(has_prior, has_inchunk))
+    def _update():
+        q = q_ref[...].reshape(rows, hd).astype(jnp.float32) * scale
+        k = k_ref[...].reshape(kv_block, hd).astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
 
-    m_prev = m_ref[:rows, 0:1]
-    m_cur = jnp.max(s, axis=-1, keepdims=True)
-    m_new = jnp.maximum(m_prev, m_cur)
-    alpha = jnp.exp(m_prev - m_new)
-    p = jnp.exp(s - m_new)
-    l_new = l_ref[:rows, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    v = v_ref[0].astype(jnp.float32)
-    pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
-                             preferred_element_type=jnp.float32)
-    acc_ref[:rows, :] = acc_ref[:rows, :] * alpha + pv
-    m_ref[:rows, :] = jnp.broadcast_to(m_new, (rows, m_ref.shape[1]))
-    l_ref[:rows, :] = jnp.broadcast_to(l_new, (rows, l_ref.shape[1]))
+        kv_pos = min_kv + jax.lax.broadcasted_iota(
+            jnp.int32, (rows, kv_block), 1)
+        q_tok = (qb * q_block
+                 + jax.lax.broadcasted_iota(jnp.int32, (rows, kv_block), 0)
+                 // queries_per_kv)
+        valid = jnp.logical_or(
+            kv_pos < chunk_start,
+            jnp.logical_and(kv_pos >= prior_len, kv_pos - prior_len <= q_tok))
+        s = jnp.where(valid, s, _NEG_INF)
+
+        m_prev = m_ref[:rows, 0:1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_ref[:rows, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[...].reshape(kv_block, hd).astype(jnp.float32)
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_ref[:rows, :] = acc_ref[:rows, :] * alpha + pv
+        m_ref[:rows, :] = jnp.broadcast_to(m_new, (rows, m_ref.shape[1]))
+        l_ref[:rows, :] = jnp.broadcast_to(l_new, (rows, l_ref.shape[1]))
 
     @pl.when(kb == last_kb)
     def _finish():
         l = jnp.maximum(l_ref[:rows, 0:1], 1e-30)
-        o_ref[0] = (acc_ref[:rows, :] / l).astype(o_ref.dtype)
+        o_ref[...] = (acc_ref[:rows, :] / l).astype(o_ref.dtype).reshape(
+            o_ref.shape)
+
+
+def _pick_q_block(t: int, qpk: int) -> int:
+    """Largest power-of-two divisor of t capped at 512 tokens and 2048
+    rows (q rows = tokens * qpk must fit VMEM next to kv + f32 scratch)."""
+    qb = t
+    for cand in (512, 256, 128, 64, 32, 16):
+        if t > 512 and t % cand == 0:
+            qb = cand
+            break
+    while qb > 16 and qb * qpk > 2048:
+        qb //= 2
+    return qb
 
 
 @functools.partial(jax.jit,
@@ -115,11 +160,7 @@ def chunk_flash_attention(
         kv_k = jnp.pad(kv_k, ((0, 0), (0, pad), (0, 0), (0, 0)))
         kv_v = jnp.pad(kv_v, ((0, 0), (0, pad), (0, 0), (0, 0)))
     tkv = kv_k.shape[1]
-    q_block = c
-    for cand in (512, 256, 128, 64, 32, 16):
-        if c > 512 and c % cand == 0:
-            q_block = cand
-            break
+    q_block = _pick_q_block(c, qpk)
     rows = q_block * qpk
     # Head-major GQA tiles: [KH, C*qpk, hd], row t*qpk + g = token t, group g.
     q_r = (q[0].reshape(c, kh, qpk, hd).transpose(1, 0, 2, 3)
@@ -131,7 +172,7 @@ def chunk_flash_attention(
     out = pl.pallas_call(
         functools.partial(
             _kernel, scale=scale, prior_len=prior_len, kv_block=kv_block,
-            q_block=q_block, queries_per_kv=qpk),
+            q_block=q_block, queries_per_kv=qpk, q_axis=1),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
@@ -157,3 +198,75 @@ def chunk_flash_attention(
     # [KH, C*qpk, hd] -> [1, C, H, hd]
     return (out.reshape(kh, c, qpk, hd).transpose(1, 0, 2, 3)
             .reshape(1, c, h, hd))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def causal_flash_attention(
+    q: jax.Array,            # [B, T, H, hd]
+    k: jax.Array,            # [B, T, KH, hd]
+    v: jax.Array,            # [B, T, KH, hd]
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """Plain causal flash attention for the solo/batched prefill site.
+
+    Same kernel body as the chunked site at prior_len = chunk_start = 0
+    (the two-region rule degenerates to kv_pos <= q_tok), batched by a
+    leading grid axis. Contiguity contract as in ops/flash_prefill.py:
+    positions run from 0, padding only at the tail, so causality alone is
+    exact — no kv_valid_len needed. Returns [B, T, H, hd].
+    """
+    b, t, h, hd = q.shape
+    kh = k.shape[2]
+    qpk = h // kh
+    scale = 1.0 / math.sqrt(hd)
+    kv_block = 1024 if t > 1024 else t
+    pad = -t % kv_block
+    if pad:
+        # Padded kv slots land at positions >= t > any q token: masked by
+        # causality for free.
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    tkv = k.shape[1]
+    q_block = _pick_q_block(t, qpk)
+    rows = q_block * qpk
+    # Head-major GQA tiles: [B, KH, T*qpk, hd].
+    q_r = (q.reshape(b, t, kh, qpk, hd).transpose(0, 2, 1, 3, 4)
+           .reshape(b, kh, t * qpk, hd))
+    k_r = k.transpose(0, 2, 1, 3)                            # [B, KH, Tkv, hd]
+    v_r = v.transpose(0, 2, 1, 3)
+
+    grid = (b, kh, t // q_block, tkv // kv_block)
+    out = pl.pallas_call(
+        functools.partial(
+            _kernel, scale=scale, prior_len=0, kv_block=kv_block,
+            q_block=q_block, queries_per_kv=qpk, q_axis=2),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 1, rows, hd),
+                             lambda b_, kh_, qb, kb, s: (b_, kh_, qb, 0)),
+                pl.BlockSpec((1, 1, kv_block, hd),
+                             lambda b_, kh_, qb, kb, s: (b_, kh_, kb, 0)),
+                pl.BlockSpec((1, 1, kv_block, hd),
+                             lambda b_, kh_, qb, kb, s: (b_, kh_, kb, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rows, hd),
+                                   lambda b_, kh_, qb, kb, s: (b_, kh_, qb, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, hd), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b, kh, t * qpk, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary"),
+        ),
+        interpret=interpret,
+    )(jnp.zeros((1,), jnp.int32), q_r, k_r, v_r)
+    # [B, KH, T*qpk, hd] -> [B, T, H, hd]
+    return (out.reshape(b, kh, t, qpk, hd).transpose(0, 2, 1, 3, 4)
+            .reshape(b, t, h, hd))

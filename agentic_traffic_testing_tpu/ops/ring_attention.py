@@ -102,6 +102,33 @@ def ring_attention(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)           # [B,Tl,H,hd]
 
 
+def make_sp_prefill_attention(mesh: Mesh, *, sp_axis: str = "sp"):
+    """Ring attention for the SERVING prefill site (round-4: SURVEY §5.7's
+    last box — sequence-parallel serving).
+
+    Layout differs from the training adapter below: batch stays unsharded
+    (a serving prefill is one long prompt, or a few — nothing to shard),
+    only the sequence dim rides `sp_axis`; heads are untouched (an sp-only
+    serving mesh). The contract matches ops/flash_prefill.py's site:
+    positions are the implicit global arange 0..T, padding only at the
+    tail, so causality alone is exact. T must divide by the sp degree
+    (serving buckets are powers of two — always true for sp in {2,4,8}).
+    """
+    qs = P(None, sp_axis, None, None)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(qs, qs, qs),
+        out_specs=qs,
+        check_vma=False,
+    )
+    def attn(q, k, v):
+        return ring_attention(q, k, v, axis_name=sp_axis)
+
+    return attn
+
+
 def make_sp_attention(mesh: Mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
                       tp_axis: str = "tp"):
     """Wrap `ring_attention` in shard_map over a (dp, sp, tp) mesh.

@@ -112,18 +112,21 @@ def _qtensor_spec(spec: P, rank: int, cls) -> Any:
     contiguous)."""
     full = tuple(spec) + (None,) * (rank - len(spec))
     kw = "q" if cls.__name__ == "QTensor" else "packed"
-    return cls(**{kw: P(*full)}, scale=P(*full[:-2], None, full[-1]))
+    out = cls(**{kw: P(*full)}, scale=P(*full[:-2], None, full[-1]))
+    return out
 
 
-def _qtensor4_grouped_spec(spec: P, rank: int) -> Any:
+def _qtensor4_grouped_spec(spec: P, rank: int, groups: int) -> Any:
     """QTensor4 with K-group-wise scales [..., Gk, 2, N/2]: the group axis
     sits where K sat, so it inherits K's sharding (row-parallel leaves
-    shard it; column-parallel leaves leave it replicated)."""
+    shard it; column-parallel leaves leave it replicated). `groups` mirrors
+    the param leaf's packing aux so the spec tree's treedef matches."""
     from agentic_traffic_testing_tpu.models.quant import QTensor4
 
     full = tuple(spec) + (None,) * (rank - len(spec))
     return QTensor4(packed=P(*full),
-                    scale=P(*full[:-1], None, full[-1]))
+                    scale=P(*full[:-1], None, full[-1]),
+                    groups=groups)
 
 
 def expand_quant_specs(params: Any, specs: Any) -> Any:
@@ -132,10 +135,13 @@ def expand_quant_specs(params: Any, specs: Any) -> Any:
 
     def rec(p, s):
         if isinstance(p, QTensor4) and p.scale.ndim == p.packed.ndim + 1:
-            return _qtensor4_grouped_spec(s, p.packed.ndim)
-        if isinstance(p, (QTensor, QTensor4)):
-            return _qtensor_spec(s, (p.q if isinstance(p, QTensor)
-                                     else p.packed).ndim, type(p))
+            return _qtensor4_grouped_spec(s, p.packed.ndim, p.groups)
+        if isinstance(p, QTensor4):
+            out = _qtensor_spec(s, p.packed.ndim, QTensor4)
+            out.groups = p.groups   # mirror packing aux: treedefs must match
+            return out
+        if isinstance(p, QTensor):
+            return _qtensor_spec(s, p.q.ndim, QTensor)
         if isinstance(p, dict):
             return {k: rec(p[k], s[k]) for k in p}
         return s
@@ -174,13 +180,14 @@ def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh,
     """Shard a param tree for the mesh; quantized leaves expand their specs.
 
     `int4_groups` is the caller's attestation of how int4 column-parallel
-    leaves were packed (quantize_params' int4_groups). A QTensor4 records
-    nothing about its packing, and sharding ungrouped packing over tp chips
-    silently decodes garbage (the lo/hi nibble pairing crosses shard
-    boundaries) — so when int4 leaves meet a tp>1 mesh, the attestation is
-    REQUIRED and must equal the tp degree.
+    leaves were packed (quantize_params' int4_groups). Sharding ungrouped
+    packing over tp chips silently decodes garbage (the lo/hi nibble
+    pairing crosses shard boundaries) — so when int4 leaves meet a tp>1
+    mesh, the attestation is REQUIRED and must equal the tp degree. Leaves
+    that RECORD their packing (QTensor4.groups aux; random-init leaves are
+    layout-free and record 1) are additionally cross-checked against it.
     """
-    from agentic_traffic_testing_tpu.models.quant import QTensor4
+    from agentic_traffic_testing_tpu.models.quant import TP_KIND, QTensor4
 
     validate_tp(cfg, mesh.shape[AXIS_TP])
     tp = mesh.shape[AXIS_TP]
@@ -204,6 +211,21 @@ def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh,
             f"init_params_quantized, whose random packing is layout-free) "
             f"and pass int4_groups={tp} to shard_params/TPRunner — got "
             f"int4_groups={int4_groups!r}")
+    for key, leaf in list(params["layers"].items()) + [
+            ("unembed", params.get("unembed")),
+            ("tok_embed", params.get("tok_embed"))]:
+        if not isinstance(leaf, QTensor4) or leaf.groups == 1:
+            continue
+        # Recorded packing contradicts the target layout: a groups=g byte
+        # layout is only decodable as g contiguous column shards, so it must
+        # be a column-parallel leaf on a tp=g mesh — anything else (tp=1
+        # serving of a TP-packed checkpoint, tp degree mismatch, a grouped
+        # row/embed leaf) would decode column-permuted weights.
+        if TP_KIND.get(key) != "col" or leaf.groups != tp:
+            raise ValueError(
+                f"param {key!r} is int4-packed with groups={leaf.groups}, "
+                f"which cannot be served on a tp={tp} mesh — repack with "
+                f"quantize_params(..., int4_groups={tp if tp > 1 else 1})")
     specs = expand_quant_specs(params, param_pspecs(cfg))
     params = shard_pytree(params, specs, mesh)
     if tp > 1:

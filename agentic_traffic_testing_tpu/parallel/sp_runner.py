@@ -1,0 +1,72 @@
+"""Sequence-parallel serving runner: long-prompt prefill sharded over `sp`.
+
+The reference testbed handles long context by truncation only (reference:
+llm/serve_llm.py:812-844; SURVEY.md §5.7). Round 3 gave serving chunked
+prefill (latency-bounded, single-chip) and training ring attention; this
+runner closes the last box — SEQUENCE-PARALLEL SERVING PREFILL. The use
+case: a prompt long enough that one chip's prefill latency (or its score
+memory) is the bottleneck, on a pod where extra chips are available but
+the model fits one chip (so TP buys nothing but collective overhead).
+
+Design: prefill's attention site swaps to ring attention over the sp axis
+(models/llama.prefill_impl attn_mode="ring_sp"): T sharded across chips,
+O(T/sp) score memory each, KV shards rotating by `lax.ppermute` one ICI
+hop per ring step. Every OTHER op in prefill is per-token math — GSPMD
+shards it over T from the same input sharding for free, and the deferred
+page write (T-sharded values into the replicated pool) becomes the one
+all-gather, exactly the KV decode needs anyway. Decode is UNCHANGED: the
+pool is replicated, every chip runs the identical decode program (decode
+is weight-streaming-bound; sp was never its lever — docs/BENCHMARKS.md).
+
+Token-exactness vs the single-device engine holds because ring attention
+is exact causal attention (same softmax, f32 accumulation) and everything
+else is the same jitted math — pinned by tests/test_parallel.py and
+dryrun leg 3c (__graft_entry__.py).
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from agentic_traffic_testing_tpu.models.config import ModelConfig
+from agentic_traffic_testing_tpu.parallel.mesh import AXIS_SP
+from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
+from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+
+class SPPrefillRunner(ModelRunner):
+    """Runner whose prefill runs ring attention over an `sp` mesh axis.
+
+    Params and KV pool are replicated over the mesh (the model fits one
+    chip by assumption — otherwise compose TP, which this first cut does
+    not); only prefill activations are sequence-sharded. Decode runs the
+    jnp gather attention: replicated GSPMD execution needs an attention
+    with a partitioning rule, which the single-chip pallas DMA kernel does
+    not have (same constraint that makes TPRunner wrap it in shard_map).
+    """
+
+    kv_writer_mode = "dus"   # pallas writer has no GSPMD partitioning rule
+    attn_mode = "gather"     # decode: replicated jnp paged attention
+    prefill_attn_mode = "ring_sp"
+
+    def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
+                 decode_steps: int = 1, spec_tokens: int = 0,
+                 spec_ngram: int = 3) -> None:
+        sp = mesh.shape[AXIS_SP]
+        if sp < 2:
+            raise ValueError(f"SPPrefillRunner needs an sp axis >= 2, got {sp}")
+        self.mesh = mesh
+        self.prefill_attn_mesh = mesh
+        self.prefill_attn_axis = AXIS_SP
+        params = jax.device_put(params, NamedSharding(mesh, P()))
+        super().__init__(cfg, params, decode_steps=decode_steps,
+                         spec_tokens=spec_tokens, spec_ngram=spec_ngram)
+
+    @property
+    def sp_size(self) -> int:
+        return self.mesh.shape[AXIS_SP]
+
+    def prepare_cache(self, cache: KVCache) -> KVCache:
+        """Replicate the page pool (decode reads it whole on every chip)."""
+        return jax.device_put(cache, NamedSharding(self.mesh, P()))

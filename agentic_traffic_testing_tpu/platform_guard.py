@@ -1,0 +1,30 @@
+"""Honor an explicit JAX_PLATFORMS=cpu despite the axon sitecustomize.
+
+The axon environment's sitecustomize calls its register() at interpreter
+start and pins jax_platforms to "axon,cpu" REGARDLESS of the JAX_PLATFORMS
+env var — and when the TPU tunnel is wedged, the axon backend init hangs
+~25 minutes before raising UNAVAILABLE. Any entry point that documents
+`JAX_PLATFORMS=cpu ...` (the README quickstart, bench.py, the test
+harness) must therefore re-force the platform in-process BEFORE the first
+backend touch, or "run it on CPU" turns into a silent half-hour hang.
+
+One shared helper so the workaround cannot drift between entry points
+(each used to carry its own copy). Call it as early as possible; it is a
+no-op unless JAX_PLATFORMS is exactly "cpu".
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_cpu_if_requested() -> bool:
+    """Apply the CPU pin when JAX_PLATFORMS=cpu; returns True if applied."""
+    if os.environ.get("JAX_PLATFORMS", "").strip() != "cpu":
+        return False
+    # Subprocesses must not re-register the axon TPU plugin either.
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    return True

@@ -72,9 +72,12 @@ class SpecDecodeState(NamedTuple):
 
 def _prefill_sample_impl(params, cfg: ModelConfig, tokens, cache, block_tables,
                          seq_lens, samp: SamplingArrays, steps,
-                         kv_writer_mode=None):
+                         kv_writer_mode=None, attn_mode=None, attn_mesh=None,
+                         attn_axis=None):
     logits, cache = prefill_impl(params, cfg, tokens, cache, block_tables,
-                                 seq_lens, kv_writer_mode=kv_writer_mode)
+                                 seq_lens, kv_writer_mode=kv_writer_mode,
+                                 attn_mode=attn_mode, attn_mesh=attn_mesh,
+                                 attn_axis=attn_axis)
     keys = make_row_keys(samp.seeds, steps)
     out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
     state = DecodeState(tokens=out, positions=seq_lens, steps=steps + 1)
@@ -191,7 +194,10 @@ class ModelRunner:
         self.spec_ngram = max(1, int(spec_ngram))
         self._prefill = jax.jit(
             partial(_prefill_sample_impl, cfg=cfg,
-                    kv_writer_mode=self.kv_writer_mode),
+                    kv_writer_mode=self.kv_writer_mode,
+                    attn_mode=self.prefill_attn_mode,
+                    attn_mesh=self.prefill_attn_mesh,
+                    attn_axis=self.prefill_attn_axis),
             donate_argnames=("cache",),
         )
         self._prefill_chunk = jax.jit(
@@ -228,6 +234,12 @@ class ModelRunner:
     #: prompt-page KV writer baked into the prefill jit (None = auto;
     #: the TP runner forces "dus" — see ops/kv_writer.py)
     kv_writer_mode: Optional[str] = None
+    #: prefill-attention implementation baked into the prefill jit (None =
+    #: auto: flash on TPU / jnp oracle; the SP runner sets "ring_sp" with
+    #: its mesh + axis — see models/llama.prefill_impl)
+    prefill_attn_mode: Optional[str] = None
+    prefill_attn_mesh = None
+    prefill_attn_axis: Optional[str] = None
 
     def prepare_cache(self, cache: KVCache) -> KVCache:
         """Hook for placing a freshly allocated cache (TP runner shards it)."""

@@ -1,5 +1,11 @@
 """`python -m agentic_traffic_testing_tpu.serving` — run the LLM backend."""
 
+from agentic_traffic_testing_tpu.platform_guard import force_cpu_if_requested
+
+# Before any other import can touch a jax backend: the README's CPU
+# quickstart (`JAX_PLATFORMS=cpu ...`) must not hang on a wedged TPU tunnel.
+force_cpu_if_requested()
+
 from agentic_traffic_testing_tpu.serving.server import main
 
 main()

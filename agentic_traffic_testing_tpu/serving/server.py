@@ -137,15 +137,58 @@ class LLMServer:
         runner = None
         params = None
         model_cfg = None
+        if c.sp_size > 1:
+            from agentic_traffic_testing_tpu.models.config import resolve_config
+            from agentic_traffic_testing_tpu.parallel.mesh import single_axis_mesh
+            from agentic_traffic_testing_tpu.parallel.sp_runner import (
+                SPPrefillRunner,
+            )
+            import jax
+
+            if c.tp_size > 1:
+                # Covers programmatic ServerConfig construction too (the
+                # from_env path already rejects this combination).
+                raise ValueError("sp_size and tp_size are mutually exclusive "
+                                 "for now (parallel/sp_runner.py)")
+            if c.quantization == "int4":
+                # The int4 matmul is a pallas_call, which GSPMD cannot
+                # partition over the sp mesh (same constraint that forces
+                # the TP runner's shard_map wrapper). int8 is plain XLA
+                # math and shards fine.
+                raise NotImplementedError(
+                    "int4 x sequence-parallel serving is not wired — use "
+                    "int8 or bf16 with LLM_SP_SIZE")
+            # Chunked prefill would defeat sp entirely: the chunk jit has
+            # no ring mode, so chunks would run replicated on every chip
+            # with zero speedup — the one long-prompt pass IS the sp
+            # feature (memory O(T/sp) replaces the chunk path's reason to
+            # exist here).
+            ecfg.prefill_chunk_tokens = 0
+            model_cfg = resolve_config(c.model)
+            if c.moe_capacity_factor is not None and model_cfg.num_experts:
+                import dataclasses
+
+                # Before runner construction, same as the tp branch: the
+                # runner compiles its step programs from this cfg and
+                # LLMEngine cross-checks the override against it.
+                model_cfg = dataclasses.replace(
+                    model_cfg, moe_capacity_factor=c.moe_capacity_factor)
+            params = self._params_or_random_init(model_cfg)
+            runner = SPPrefillRunner(
+                model_cfg, params, single_axis_mesh("sp", c.sp_size),
+                decode_steps=ecfg.resolved_decode_steps(
+                    jax.devices()[0].platform),
+                spec_tokens=ecfg.effective_spec_tokens,
+                spec_ngram=ecfg.spec_ngram,
+            )
+            return LLMEngine(ecfg, model_cfg=model_cfg, runner=runner)
         if c.tp_size > 1:
             import dataclasses
 
             from agentic_traffic_testing_tpu.models.config import resolve_config
-            from agentic_traffic_testing_tpu.models.llama import init_params
             from agentic_traffic_testing_tpu.parallel.mesh import single_axis_mesh
             from agentic_traffic_testing_tpu.parallel.tp_runner import TPRunner
             import jax
-            import jax.numpy as jnp
 
             model_cfg = resolve_config(c.model)
             if c.moe_capacity_factor is not None and model_cfg.num_experts:
@@ -153,25 +196,14 @@ class LLMServer:
                 # programs from this cfg (LLMEngine re-applies idempotently).
                 model_cfg = dataclasses.replace(
                     model_cfg, moe_capacity_factor=c.moe_capacity_factor)
-            params = self._load_params(model_cfg)
-            if params is None:
-                dtype = jnp.bfloat16 if c.dtype in ("bfloat16", "bf16") else jnp.float32
-                if c.quantization in ("int8", "int4"):
-                    from agentic_traffic_testing_tpu.models.llama import (
-                        init_params_quantized,
-                    )
-
-                    # Quantized x TP: QTensor/QTensor4 leaves carry their own
-                    # (q|packed, scale) PartitionSpecs (parallel/sharding.py
-                    # expand_quant_specs); int4 matmuls additionally run the
-                    # pallas kernel under shard_map (QTensor4TP). int8 TP=8
-                    # fits Llama-3-70B on a v5e-8's 8x16 GB HBM
-                    # (serving/configs/llama-3-70b-tp8); int4 halves the
-                    # per-chip weight stream again (llama-3-70b-int4-tp8).
-                    params = init_params_quantized(model_cfg, 0, dtype=dtype,
-                                                   scheme=c.quantization)
-                else:
-                    params = init_params(model_cfg, jax.random.key(0), dtype=dtype)
+            # Quantized x TP: QTensor/QTensor4 leaves carry their own
+            # (q|packed, scale) PartitionSpecs (parallel/sharding.py
+            # expand_quant_specs); int4 matmuls additionally run the
+            # pallas kernel under shard_map (QTensor4TP). int8 TP=8
+            # fits Llama-3-70B on a v5e-8's 8x16 GB HBM
+            # (serving/configs/llama-3-70b-tp8); int4 halves the
+            # per-chip weight stream again (llama-3-70b-int4-tp8).
+            params = self._params_or_random_init(model_cfg)
             runner = TPRunner(
                 model_cfg, params, single_axis_mesh("tp", c.tp_size),
                 decode_steps=ecfg.resolved_decode_steps(jax.devices()[0].platform),
@@ -199,6 +231,30 @@ class LLMServer:
             if model_cfg is not None:
                 params = self._load_params(model_cfg)
         return LLMEngine(ecfg, model_cfg=model_cfg, params=params)
+
+    def _params_or_random_init(self, model_cfg):
+        """Checkpoint params if configured, else random init honoring the
+        configured quantization scheme (and its K-group size) — the one
+        param-resolution path shared by the sp and tp runner branches, so
+        loading changes cannot drift between them."""
+        params = self._load_params(model_cfg)
+        if params is not None:
+            return params
+        import jax
+        import jax.numpy as jnp
+
+        from agentic_traffic_testing_tpu.models.llama import (
+            init_params,
+            init_params_quantized,
+        )
+
+        c = self.cfg
+        dtype = jnp.bfloat16 if c.dtype in ("bfloat16", "bf16") else jnp.float32
+        if c.quantization in ("int8", "int4"):
+            return init_params_quantized(model_cfg, 0, dtype=dtype,
+                                         scheme=c.quantization,
+                                         int4_k_group=c.int4_k_group)
+        return init_params(model_cfg, jax.random.key(0), dtype=dtype)
 
     def _load_params(self, model_cfg):
         if not self.cfg.weights_path:

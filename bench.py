@@ -3,22 +3,39 @@
 
 Prints exactly one JSON line:
     {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N,
+     "bs8_toks_s": N, "bs8_vs_baseline": N, "roofline_frac": N,
      "queue_wait_p50_s": N, "queue_wait_spread_s": [min, max], "reps": N}
+or, when every attempt to reach the backend fails, one structured error
+line ({"metric": null, "error": ...}) — never a bare traceback, so the
+driver's scoreboard slot is always parseable (round-3 lesson: the axon
+tunnel refused one init and the whole round's verified-perf slot was
+lost to a traceback).
 
-Two workloads, both shapes of the agent-b fan-out load the reference testbed
-generates (BASELINE.md §2 "Fan-out workload"):
-  1. Throughput: `BENCH_TOTAL_REQUESTS` (default 3x batch) requests queued
-     into a `BENCH_BATCH`-lane (default 32 on TPU — the measured best
-     operating point of the batch-scaling curve, docs/BENCHMARKS.md) engine
-     — sustained continuous-batching throughput at fan-out concurrency, the
-     quantity a vLLM-style serving benchmark reports. 128-token prompts, 64
-     greedy decode tokens each; tok/s = total completion tokens / wall.
+Process shape: this file re-executes itself as a subprocess for the real
+measurement (BENCH_INNER=1). A failed TPU-plugin init can leave the
+in-process backend state poisoned, so retries only count if each attempt
+is a fresh process. The parent retries with backoff
+(BENCH_ATTEMPTS, default 3; BENCH_ATTEMPT_TIMEOUT seconds each, default
+1500 — the axon tunnel serializes server-side compiles and can
+legitimately sit several minutes), passes the child's JSON through on
+success, and emits the structured error line otherwise.
+
+Two workloads, both shapes of the agent-b fan-out load the reference
+testbed generates (BASELINE.md §2 "Fan-out workload"):
+  1. Throughput: `BENCH_TOTAL_REQUESTS` (default 3x batch) requests
+     queued into a `BENCH_BATCH`-lane (default 32 on TPU — the measured
+     best operating point of the batch-scaling curve, docs/BENCHMARKS.md)
+     engine — sustained continuous-batching throughput at fan-out
+     concurrency. 128-token prompts, 64 greedy decode tokens each;
+     tok/s = total completion tokens / wall. Measured at BOTH the
+     bs=8 operating point (the round-1/2 series — keeps the headline
+     comparable across every round) and the default batch.
   2. TTFT under fan-out: 5 concurrent long-prompt (512-token) arrivals;
      `queue_wait_p50_s` = median enqueue -> first-token-on-host wait,
      matching the reference's queue_wait_seconds semantics (reference:
-     llm/serve_llm.py:104-108, 546-558). Reported with min/max spread over
-     `BENCH_REPS` (default 3) repetitions — single-run numbers through the
-     axon tunnel drift ±10-20%.
+     llm/serve_llm.py:104-108, 546-558). Reported with min/max spread
+     over `BENCH_REPS` (default 3) repetitions — single-run numbers
+     through the axon tunnel drift ±10-20%.
 
 The model is the Llama-3.2-1B architecture (reference default family,
 randomly initialized — no weight downloads in this environment) in bf16,
@@ -26,9 +43,10 @@ served by the engine's throughput configuration (fused decode_steps=32;
 override with BENCH_DECODE_STEPS).
 
 The reference publishes no measured numbers (BASELINE.md: "blank
-scoreboard"), so `vs_baseline` is the ratio against NOMINAL_BASELINE_TOKS_S —
-a fixed scoreboard constant standing in for a single-GPU vLLM figure on the
-same model class — to make round-over-round movement visible.
+scoreboard"), so `vs_baseline` is the ratio against
+NOMINAL_BASELINE_TOKS_S — a fixed scoreboard constant standing in for a
+single-GPU vLLM figure on the same model class — to make round-over-round
+movement visible.
 """
 
 from __future__ import annotations
@@ -36,6 +54,7 @@ from __future__ import annotations
 import json
 import os
 import statistics
+import subprocess
 import sys
 import time
 from typing import Optional
@@ -51,7 +70,73 @@ NOMINAL_BASELINE_TOKS_S = {
 }
 
 
+def launcher() -> int:
+    """Retry the real bench in fresh subprocesses; always print one JSON line.
+
+    Fresh process per attempt: jax caches a failed backend init for the
+    life of the process, so an in-process retry of `jax.devices()` after
+    an axon UNAVAILABLE would just replay the cached failure.
+    """
+    attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
+    timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1500"))
+    delays = [0.0, 20.0, 60.0]
+    errors = []
+    for i in range(attempts):
+        delay = delays[i] if i < len(delays) else delays[-1]
+        if delay:
+            time.sleep(delay)
+        env = dict(os.environ, BENCH_INNER="1")
+        t0 = time.monotonic()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            errors.append(f"attempt {i + 1}: timeout after {timeout_s:.0f}s "
+                          f"(tunnel hang?)")
+            print(errors[-1], file=sys.stderr, flush=True)
+            break  # a wedged tunnel does not recover on retry (round-3 log)
+        attempt_s = time.monotonic() - t0
+        # The child prints progress to stderr and exactly one JSON line to
+        # stdout; forward stderr for the driver's log either way.
+        if proc.stderr:
+            sys.stderr.write(proc.stderr[-4000:])
+            sys.stderr.flush()
+        line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
+        if proc.returncode == 0 and line.startswith("{"):
+            print(line)
+            return 0
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()[-8:]
+        errors.append(f"attempt {i + 1}: rc={proc.returncode}: "
+                      + " | ".join(tail[-3:]))
+        print(errors[-1], file=sys.stderr, flush=True)
+        if attempt_s > 600:
+            # The axon init takes ~25 min to FAIL when the tunnel is wedged
+            # (vs seconds when healthy): a long-then-failed attempt means
+            # down-hard, and two more 25-minute waits would just eat the
+            # driver's budget. Emit the structured error now.
+            errors.append("abandoning retries: failure took "
+                          f"{attempt_s:.0f}s — backend looks wedged, not "
+                          f"transient")
+            print(errors[-1], file=sys.stderr, flush=True)
+            break
+    print(json.dumps({
+        "metric": None,
+        "error": "benchmark failed after retries (backend unreachable?)",
+        "attempts": attempts,
+        "attempt_errors": [e[-500:] for e in errors],
+    }))
+    return 1
+
+
 def main() -> None:
+    # An explicit JAX_PLATFORMS=cpu run (CI, dev boxes) must really mean
+    # cpu — see platform_guard.py for the sitecustomize trap this defuses.
+    from agentic_traffic_testing_tpu.platform_guard import (
+        force_cpu_if_requested,
+    )
+
+    force_cpu_if_requested()
     import jax
     import numpy as np
 
@@ -59,6 +144,9 @@ def main() -> None:
     from agentic_traffic_testing_tpu.runtime.request import SamplingParams
 
     platform = jax.devices()[0].platform
+    # Touch the device before building anything: fail fast into the
+    # parent's retry loop rather than mid-engine-construction.
+    jax.numpy.zeros((8,), jax.numpy.float32).block_until_ready()
     default_model = "llama-3.2-1b" if platform == "tpu" else "debug-512"
     model = os.environ.get("BENCH_MODEL", default_model)
     # bs=32 is the measured best operating point of the batch-scaling curve
@@ -69,6 +157,10 @@ def main() -> None:
     # per GPU (reference infra/.env.example:129) but nothing in the engine
     # pins that low on a v5e.
     batch = int(os.environ.get("BENCH_BATCH", "32" if platform == "tpu" else "8"))
+    # The secondary, round-1/2-comparable operating point. 0 disables.
+    small_batch = int(os.environ.get("BENCH_SMALL_BATCH", "8"))
+    if small_batch >= batch:
+        small_batch = 0
     total_requests = int(os.environ.get("BENCH_TOTAL_REQUESTS", str(3 * batch)))
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "128"))
     decode_tokens = int(os.environ.get("BENCH_DECODE_TOKENS", "64"))
@@ -79,11 +171,13 @@ def main() -> None:
     ds = os.environ.get("BENCH_DECODE_STEPS")
     decode_steps = int(ds) if ds else (32 if platform == "tpu" else None)
     quantization = os.environ.get("BENCH_QUANTIZATION") or None
-    # Two engines so each workload runs its natural serving config (the
+    kv_cache_dtype = os.environ.get("BENCH_KV_CACHE_DTYPE") or None
+    # Separate engines so each workload runs its natural serving config (the
     # throughput number stays comparable round-over-round): a short-context
-    # engine for the batch workload, a long-context one for the fan-out TTFT
-    # probe. decode_steps=32 is the throughput configuration — waste-free now
-    # that the engine stops dispatching past each lane's budget.
+    # engine for the batch workloads, a long-context one for the fan-out
+    # TTFT probe. decode_steps=32 is the throughput configuration —
+    # waste-free now that the engine stops dispatching past each lane's
+    # budget.
     cfg = EngineConfig(
         model=model,
         dtype="bfloat16",
@@ -92,29 +186,47 @@ def main() -> None:
         num_blocks=None if platform == "tpu" else 1024,
         decode_steps=decode_steps,
         quantization=quantization,
+        kv_cache_dtype=kv_cache_dtype,
     )
     engine = LLMEngine(cfg)
     rng = np.random.default_rng(0)
     vocab = engine.model_cfg.vocab_size
 
-    def run_batch(n_requests: Optional[int] = None) -> tuple[float, int]:
-        """Sustained load: n_requests (default total_requests) queued at
-        once, batch lanes."""
+    def run_batch(target: LLMEngine, n_requests: int) -> tuple[float, int]:
+        """Sustained load: n_requests queued at once."""
         reqs = []
-        for _ in range(n_requests or total_requests):
+        for _ in range(n_requests):
             ids = rng.integers(10, vocab - 10, prompt_len).tolist()
-            reqs.append(engine.add_request(
+            reqs.append(target.add_request(
                 ids, SamplingParams(temperature=0.0, max_tokens=decode_tokens,
                                     ignore_eos=True)))
         t0 = time.monotonic()
-        while engine.has_work() and not all(r.is_finished() for r in reqs):
-            engine.step()
+        while target.has_work() and not all(r.is_finished() for r in reqs):
+            target.step()
         dt = time.monotonic() - t0
         toks = sum(len(r.output_ids) for r in reqs)
         return dt, toks
 
-    # Shares the throughput engine's runner (params + compiled programs);
-    # only the KV pool and scheduler limits differ.
+    # The bs=8 series engine shares the runner (params + compiled
+    # programs); its KV pool is explicit and small (8 lanes x ~40 blocks)
+    # so it never competes with the primary engine's HBM-profiled pool.
+    small_engine = None
+    if small_batch:
+        blocks_needed = small_batch * (-(-cfg.max_model_len // 16) + 4)
+        small_engine = LLMEngine(EngineConfig(
+            model=model,
+            dtype="bfloat16",
+            max_num_seqs=small_batch,
+            max_model_len=cfg.max_model_len,
+            num_blocks=max(512, blocks_needed),
+            decode_steps=decode_steps,
+            # Same KV dtype as the primary engine: the bs8 series must
+            # measure the same configuration the metric name advertises.
+            kv_cache_dtype=kv_cache_dtype,
+        ), model_cfg=engine.model_cfg, runner=engine.runner)
+
+    # Shares the throughput engine's runner too; only the KV pool and
+    # scheduler limits differ.
     prefill_probe_len = int(os.environ.get("BENCH_PREFILL_LEN", "2048"))
     fan_engine = LLMEngine(EngineConfig(
         model=model,
@@ -168,10 +280,12 @@ def main() -> None:
             raise
         return req.first_token_time - req.arrival_time
 
-    # Warmup compiles every (batch, bucket) shape both workloads touch;
+    # Warmup compiles every (batch, bucket) shape the workloads touch;
     # one batch-sized wave already walks the same bucket ladder as the
     # sustained run does while draining.
-    run_batch(min(batch, total_requests))
+    run_batch(engine, min(batch, total_requests))
+    if small_engine is not None:
+        run_batch(small_engine, small_batch)
     run_fanout()
     # The prefill probe must never take down the headline measurement: any
     # failure (odd bucket compile, OOM on exotic configs) just drops the
@@ -183,9 +297,14 @@ def main() -> None:
         except Exception:
             prefill_ok = False
 
-    tp_runs = [run_batch() for _ in range(reps)]
+    tp_runs = [run_batch(engine, total_requests) for _ in range(reps)]
     values = [toks / dt for dt, toks in tp_runs]
     value = statistics.median(values)
+    small_values = []
+    if small_engine is not None:
+        small_runs = [run_batch(small_engine, 3 * small_batch)
+                      for _ in range(reps)]
+        small_values = [toks / dt for dt, toks in small_runs]
     ttft_runs = [run_fanout() for _ in range(reps)]
     ttft_p50 = statistics.median(ttft_runs)
     try:
@@ -208,13 +327,17 @@ def main() -> None:
     def count_params(tree) -> int:
         """Logical parameter count across raw/int8/int4 leaves (an int4
         packed byte holds two params; scales are negligible)."""
-        from agentic_traffic_testing_tpu.models.quant import QTensor, QTensor4
+        from agentic_traffic_testing_tpu.models.quant import (
+            QTensor,
+            QTensor4,
+            QTensor4TP,
+        )
 
         total = 0
 
         def visit(x):
             nonlocal total
-            if isinstance(x, QTensor4):
+            if isinstance(x, (QTensor4, QTensor4TP)):
                 total += 2 * x.packed.size
             elif isinstance(x, QTensor):
                 total += x.q.size
@@ -223,7 +346,7 @@ def main() -> None:
 
         jax.tree_util.tree_map(
             visit, tree,
-            is_leaf=lambda x: isinstance(x, (QTensor, QTensor4)))
+            is_leaf=lambda x: isinstance(x, (QTensor, QTensor4, QTensor4TP)))
         return total
 
     mcfg = engine.model_cfg
@@ -231,14 +354,19 @@ def main() -> None:
                        - 2 * mcfg.vocab_size * mcfg.hidden_size)
     hdp = engine.cache.k.shape[-1]
     mean_ctx = prompt_len + decode_tokens / 2
-    kv_bytes_step = (batch * mean_ctx * mcfg.num_layers * 2 * mcfg.num_kv_heads
-                     * hdp * engine.cache.k.dtype.itemsize)
-    roofline = batch / ((weight_bytes + kv_bytes_step) / HBM_BW)
 
+    def roofline_for(bs: int) -> float:
+        kv_bytes_step = (bs * mean_ctx * mcfg.num_layers * 2
+                         * mcfg.num_kv_heads * hdp
+                         * engine.cache.k.dtype.itemsize)
+        return bs / ((weight_bytes + kv_bytes_step) / HBM_BW)
+
+    roofline = roofline_for(batch)
     nominal = NOMINAL_BASELINE_TOKS_S.get(model, 2000.0)
     print(json.dumps({
         "metric": (f"decode_throughput_{model}"
                    + (f"_{quantization}" if quantization else "")
+                   + (f"_kv{kv_cache_dtype}" if kv_cache_dtype else "")
                    + f"_bs{batch}_n{total_requests}_{platform}"),
         "value": round(value, 2),
         "unit": "tok/s",
@@ -246,6 +374,17 @@ def main() -> None:
         "roofline_toks_s": round(roofline, 0),
         "roofline_frac": round(value / roofline, 3),
         "throughput_spread_toks_s": [round(min(values), 2), round(max(values), 2)],
+        **({} if not small_values else {
+            # The round-1/2-comparable operating point (same model, same
+            # prompt/decode shape, 8 lanes) so the series never breaks.
+            "bs8_batch": small_batch,
+            "bs8_toks_s": round(statistics.median(small_values), 2),
+            "bs8_vs_baseline": round(statistics.median(small_values) / nominal, 4),
+            "bs8_spread_toks_s": [round(min(small_values), 2),
+                                  round(max(small_values), 2)],
+            "bs8_roofline_frac": round(
+                statistics.median(small_values) / roofline_for(small_batch), 3),
+        }),
         "queue_wait_p50_s": round(ttft_p50, 4),
         "queue_wait_spread_s": [round(min(ttft_runs), 4), round(max(ttft_runs), 4)],
         "fanout": fanout,
@@ -267,4 +406,6 @@ def main() -> None:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    if os.environ.get("BENCH_INNER") == "1":
+        sys.exit(main())
+    sys.exit(launcher())

@@ -9,8 +9,6 @@ import os
 import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"  # force: the outer env pins the axon TPU tunnel
-# Subprocesses spawned by tests must not re-register the axon TPU plugin.
-os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -19,12 +17,14 @@ os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+# Undo the axon sitecustomize's platform pin before any backend init (and
+# strip the plugin env from test subprocesses) — shared guard, see
+# agentic_traffic_testing_tpu/platform_guard.py.
+from agentic_traffic_testing_tpu.platform_guard import (  # noqa: E402
+    force_cpu_if_requested,
+)
 
-# The axon sitecustomize calls register() at interpreter start, which pins
-# jax_platforms to "axon,cpu" regardless of JAX_PLATFORMS — undo that here,
-# before any backend is initialized.
-jax.config.update("jax_platforms", "cpu")
+force_cpu_if_requested()
 
 
 def pytest_terminal_summary(terminalreporter, exitstatus, config):

@@ -1,0 +1,92 @@
+"""First-party causal flash kernel (ops/pallas/chunk_flash.py round-4):
+interpret-mode equivalence vs the jnp oracle at serving-bucket shapes.
+
+The solo/batched prefill site (ops/flash_prefill.py) routes to
+`causal_flash_attention` on TPU; these tests pin the kernel's numerics on
+CPU via pallas interpret mode (SURVEY.md §4 kernel-test strategy), across
+batch, GQA grouping, multi-block grids, and the odd (non-power-of-two)
+buckets the pow2-divisor block picker must serve. The chunked-site entry
+point (`chunk_flash_attention`, same kernel body) keeps its own tests in
+test_chunked_prefill.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
+from agentic_traffic_testing_tpu.ops.pallas.chunk_flash import (
+    causal_flash_attention,
+)
+
+
+def _mk(b, t, h, kh, hd, seed=0):
+    ks = jax.random.split(jax.random.key(seed), 3)
+    q = jax.random.normal(ks[0], (b, t, h, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (b, t, kh, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (b, t, kh, hd), jnp.float32)
+    return q, k, v
+
+
+def _oracle(q, k, v):
+    b, t = q.shape[:2]
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    return causal_attention(q, k, v, q_positions=pos,
+                            kv_valid_len=jnp.full((b,), t, jnp.int32))
+
+
+@pytest.mark.parametrize("b,t,h,kh,hd", [
+    (1, 256, 4, 4, 64),     # solo, MHA
+    (1, 256, 8, 2, 64),     # solo, GQA 4:1 (llama-1B head layout)
+    (3, 256, 8, 2, 64),     # batched prefill
+    (1, 512, 4, 2, 128),    # hd=128 lane tile
+])
+def test_causal_flash_matches_oracle(b, t, h, kh, hd):
+    q, k, v = _mk(b, t, h, kh, hd)
+    want = _oracle(q, k, v)
+    got = causal_flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_causal_flash_multiblock_grid_and_skip():
+    """T large enough that the grid has several q and kv blocks, so the
+    online-softmax carry across kv blocks AND the beyond-diagonal compute
+    skip are both exercised (a wrong skip bound shows up as a softmax
+    normalization error on the block boundary rows)."""
+    q, k, v = _mk(1, 2048, 4, 1, 64, seed=1)
+    want = _oracle(q, k, v)
+    got = causal_flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_causal_flash_odd_bucket():
+    """640 = the odd serving bucket from the round-3 blocker: not a
+    multiple of 512/256, so the block picker must fall to 128-token
+    blocks and pad kv to the 640-tile — no trace-time ValueError, exact
+    numerics."""
+    q, k, v = _mk(1, 640, 8, 2, 64, seed=2)
+    want = _oracle(q, k, v)
+    got = causal_flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_padded_tail_rows_do_not_corrupt_real_rows():
+    """The site contract (ops/flash_prefill.py): padding only at the tail,
+    causality alone protects real rows. Real rows' outputs must be
+    identical whether the tail holds garbage or real tokens."""
+    b, t, real = 1, 256, 200
+    q, k, v = _mk(b, t, 4, 2, 64, seed=3)
+    got_full = causal_flash_attention(q, k, v, interpret=True)
+    junk = jnp.full_like(k[:, real:], 37.0)
+    got_junk = causal_flash_attention(
+        q,
+        k.at[:, real:].set(junk), v.at[:, real:].set(junk),
+        interpret=True)
+    np.testing.assert_allclose(np.asarray(got_junk[:, :real]),
+                               np.asarray(got_full[:, :real]),
+                               rtol=2e-5, atol=2e-5)

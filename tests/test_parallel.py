@@ -76,6 +76,35 @@ def test_tp_engine_matches_single_device(tiny_cfg, tiny_params):
     assert ref.output_ids == tp.output_ids
 
 
+@pytest.mark.parametrize("sp", [2, 4])
+def test_sp_serving_prefill_matches_single_device(tiny_cfg, tiny_params, sp):
+    """Serving sequence parallelism (round-4, SURVEY §5.7's last box): a
+    long-prompt prefill through SPPrefillRunner — ring attention over the
+    sp axis, decode on the replicated pool — must be token-exact vs the
+    single-device engine. Prompt length crosses several KV blocks so the
+    sp-sharded deferred page write is really exercised."""
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
+
+    ecfg = EngineConfig(model="tiny", dtype="float32", num_blocks=64,
+                        max_model_len=128)
+    prompt = [(5 * i + 2) % tiny_cfg.vocab_size for i in range(57)]
+    samp = SamplingParams(temperature=0.0, max_tokens=12)
+
+    ref = LLMEngine(ecfg, model_cfg=tiny_cfg,
+                    params=tiny_params).generate(prompt, samp)
+    runner = SPPrefillRunner(tiny_cfg, tiny_params, make_mesh(sp=sp))
+    got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(
+        prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
+def test_sp_runner_rejects_trivial_axis(tiny_cfg, tiny_params):
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
+
+    with pytest.raises(ValueError, match="sp axis"):
+        SPPrefillRunner(tiny_cfg, tiny_params, make_mesh(sp=1))
+
+
 def test_tp_shard_dma_matches_gather(tiny_cfg, tiny_params, monkeypatch):
     """The shard_map-wrapped DMA kernel (TPU default for TP; interpret mode
     here on the CPU mesh) must reproduce the GSPMD gather path's greedy

@@ -328,7 +328,8 @@ def test_grouped_int4_packing_dequantizes_identically():
     from agentic_traffic_testing_tpu.models.quant import _unpack4
 
     w = jax.random.normal(jax.random.key(0), (32, 48), jnp.float32)
-    base = _unpack4(*quantize_array4(w), jnp.float32)
+    q1 = quantize_array4(w)
+    base = _unpack4(q1.packed, q1.scale, jnp.float32)
     g = 4
     qg = quantize_array4(w, groups=g)
     h = 48 // (2 * g)
@@ -372,7 +373,8 @@ def test_int4_k_group_improves_outlier_reconstruction():
 
     w = jax.random.normal(jax.random.key(0), (256, 96), jnp.float32)
     w = w.at[3].mul(20.0)
-    d0 = _unpack4(*quantize_array4(w), jnp.float32)
+    q0 = quantize_array4(w)
+    d0 = _unpack4(q0.packed, q0.scale, jnp.float32)
     qg = quantize_array4(w, k_group=64)
     assert qg.scale.shape == (4, 2, 48)
     dg = _unpack4(qg.packed, qg.scale, jnp.float32)

@@ -1,0 +1,170 @@
+"""Default-tier smoke tests for the heavyweight ("full"-marked) surfaces.
+
+The full tier (`-m "full or not full"`) carries the deep suites for the
+engine, parallelism, quantization, MoE, speculation, and chunked prefill —
+compile-bound, ~35 min on one CPU core, so the default tier deselects them
+(pytest.ini). That left a plain `pytest tests/` green while the riskiest
+code paths went unexercised (round-3 advisor finding). This module is the
+bridge: ONE small, fast test per heavyweight area, always on, sized to add
+roughly a minute to the default tier. Each test pins the area's core
+correctness contract; the full-tier module it shadows carries the real
+depth (named in each docstring).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.models.config import PRESETS
+from agentic_traffic_testing_tpu.models.llama import forward_full_impl, init_params
+from agentic_traffic_testing_tpu.models.quant import (
+    _unpack4,
+    dense,
+    quantize_array4,
+    quantize_params,
+)
+from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+CFG = PRESETS["tiny"]
+
+
+def _generate(ecfg_kw: dict, prompt: list[int], max_tokens: int = 8,
+              params=None) -> list[int]:
+    ecfg = EngineConfig(model="tiny", dtype="float32", max_model_len=128,
+                        block_size=8, num_blocks=64, max_num_seqs=2, **ecfg_kw)
+    eng = LLMEngine(ecfg, model_cfg=CFG, params=params)
+    req = eng.add_request(prompt, SamplingParams(temperature=0.0,
+                                                 max_tokens=max_tokens,
+                                                 ignore_eos=True))
+    for _ in range(10_000):
+        eng.step()
+        if req.is_finished():
+            break
+    assert req.is_finished()
+    return list(req.generated_ids)
+
+
+def test_smoke_int4_kgroup_dense_matches_unpack_oracle():
+    """int4 K-group scales (shadows test_quant's k-group suite): the
+    grouped quantizer reconstructs within int4 step error and dense()'s
+    fallback path matches the explicit unpack-then-matmul oracle."""
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((128, 32)), jnp.float32)
+    qt = quantize_array4(w, k_group=32)
+    assert qt.scale.shape == (4, 2, 16)
+    deq = _unpack4(qt.packed, qt.scale, jnp.float32)
+    assert float(jnp.max(jnp.abs(deq - w))) <= float(jnp.max(qt.scale)) * 0.51
+    x = jnp.asarray(rng.standard_normal((3, 128)), jnp.float32)
+    np.testing.assert_allclose(np.asarray(dense(x, qt)), np.asarray(x @ deq),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_smoke_grouped_packing_refused_on_global_path():
+    """The TP byte layout (groups>1) must never silently decode on the
+    single-chip path (shadows test_quant's TP suites; round-3 advisor
+    finding — the guard is the QTensor4.groups aux)."""
+    w = jnp.ones((32, 16), jnp.float32)
+    qg = quantize_array4(w, groups=2)
+    assert qg.groups == 2
+    with pytest.raises(ValueError, match="groups=2"):
+        dense(jnp.ones((2, 32), jnp.float32), qg)
+
+
+def test_smoke_int4_tp_dense_matches_oracle():
+    """int4 x TP shard_map matmul on a 2-device CPU mesh (shadows
+    test_quant's tp_int4 suite): grouped packing + QTensor4TP column path
+    reproduces the ungrouped dequantize-then-matmul oracle."""
+    from jax.sharding import Mesh
+
+    from agentic_traffic_testing_tpu.models.quant import QTensor4TP
+
+    rng = np.random.default_rng(1)
+    w = jnp.asarray(rng.standard_normal((32, 64)), jnp.float32)
+    q1 = quantize_array4(w)                    # standard packing: the oracle
+    want = jnp.ones((2, 32), jnp.float32) @ _unpack4(q1.packed, q1.scale,
+                                                     jnp.float32)
+    qg = quantize_array4(w, groups=2)          # TP byte layout
+    mesh = Mesh(np.array(jax.devices("cpu")[:2]), ("tp",))
+    wtp = QTensor4TP(qg.packed, qg.scale, "col", mesh, "tp")
+    got = dense(jnp.ones((2, 32), jnp.float32), wtp)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_smoke_chunked_prefill_token_exact():
+    """Chunked prefill (shadows test_chunked_prefill): a prompt longer than
+    prefill_chunk_tokens must produce exactly the one-shot engine's
+    tokens."""
+    params = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, CFG.vocab_size, 80).tolist()
+    want = _generate({}, prompt, params=params)
+    got = _generate({"prefill_chunk_tokens": 32}, prompt, params=params)
+    assert got == want
+
+
+def test_smoke_speculative_decode_token_exact():
+    """n-gram speculation (shadows test_speculative): a pure perf knob —
+    greedy output must match the non-speculative engine exactly, on a
+    repetitive prompt where the proposer actually fires."""
+    params = init_params(CFG, jax.random.key(1), dtype=jnp.float32)
+    prompt = [5, 9, 11, 5, 9, 11, 5, 9, 11, 5, 9]
+    want = _generate({}, prompt, params=params)
+    got = _generate({"speculation": "ngram", "spec_tokens": 3},
+                    prompt, params=params)
+    assert got == want
+
+
+def test_smoke_moe_int4_logits_match_dequantized_oracle():
+    """MoE x int4 (shadows test_moe's int4 suite): the packed-weight
+    forward must match the same weights dequantized up front — identical
+    routing by construction, so any mismatch is the int4 expert-matmul
+    path itself. (A vs-full-precision corr bound is the wrong contract
+    at tiny-MoE scale: quantization legitimately flips router top-k.)"""
+    mcfg = PRESETS["tiny-moe"]
+    params = init_params(mcfg, jax.random.key(2), dtype=jnp.float32)
+    qparams = quantize_params(params, scheme="int4")
+
+    def deq(leaf):
+        from agentic_traffic_testing_tpu.models.quant import QTensor4
+
+        if isinstance(leaf, QTensor4):
+            return _unpack4(leaf.packed, leaf.scale, jnp.float32)
+        return leaf
+
+    oracle = jax.tree_util.tree_map(
+        deq, qparams,
+        is_leaf=lambda x: type(x).__name__ == "QTensor4")
+    rng = np.random.default_rng(3)
+    tokens = jnp.asarray(rng.integers(0, mcfg.vocab_size, (1, 12)), jnp.int32)
+    want = np.asarray(forward_full_impl(oracle, mcfg, tokens))
+    got = np.asarray(forward_full_impl(qparams, mcfg, tokens))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_smoke_tp2_engine_decode_matches_single_device():
+    """TP on a 2-device CPU mesh end-to-end (shadows test_parallel /
+    test_quant TP suites): TPRunner greedy decode is token-exact vs the
+    single-device engine."""
+    from agentic_traffic_testing_tpu.parallel.mesh import single_axis_mesh
+    from agentic_traffic_testing_tpu.parallel.tp_runner import TPRunner
+
+    params = init_params(CFG, jax.random.key(3), dtype=jnp.float32)
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, CFG.vocab_size, 13).tolist()
+    want = _generate({}, prompt, max_tokens=6, params=params)
+
+    runner = TPRunner(CFG, params, single_axis_mesh("tp", 2))
+    ecfg = EngineConfig(model="tiny", dtype="float32", max_model_len=128,
+                        block_size=8, num_blocks=64, max_num_seqs=2)
+    eng = LLMEngine(ecfg, model_cfg=CFG, runner=runner)
+    req = eng.add_request(prompt, SamplingParams(temperature=0.0, max_tokens=6,
+                                                 ignore_eos=True))
+    for _ in range(10_000):
+        eng.step()
+        if req.is_finished():
+            break
+    assert list(req.generated_ids) == want
