@@ -169,6 +169,17 @@ def chunk_flash_attention(
     v_r = kv_v[0].transpose(1, 0, 2)
 
     grid = (kh, c // q_block, tkv // kv_block)
+
+    # Clamp beyond-diagonal kv blocks (fully invalid: past the prior
+    # region AND past this q tile's last in-chunk row) to the diagonal so
+    # the Mosaic pipeline elides their re-fetch — the compute skip in the
+    # kernel already ignores them. The dynamic gather-tail gap
+    # [chunk_start, prior_len) stays streamed: it is at most one bucket
+    # step wide and its bound is a traced scalar.
+    def kv_index(kh_, qb, kb, s):
+        last_valid = (prior_len + (qb + 1) * q_block - 1) // kv_block
+        return (kh_, jnp.minimum(kb, last_valid), 0)
+
     out = pl.pallas_call(
         functools.partial(
             _kernel, scale=scale, prior_len=prior_len, kv_block=kv_block,
@@ -178,8 +189,8 @@ def chunk_flash_attention(
             grid=grid,
             in_specs=[
                 pl.BlockSpec((1, rows, hd), lambda kh_, qb, kb, s: (kh_, qb, 0)),
-                pl.BlockSpec((1, kv_block, hd), lambda kh_, qb, kb, s: (kh_, kb, 0)),
-                pl.BlockSpec((1, kv_block, hd), lambda kh_, qb, kb, s: (kh_, kb, 0)),
+                pl.BlockSpec((1, kv_block, hd), kv_index),
+                pl.BlockSpec((1, kv_block, hd), kv_index),
             ],
             out_specs=pl.BlockSpec((1, rows, hd),
                                    lambda kh_, qb, kb, s: (kh_, qb, 0)),
@@ -237,6 +248,15 @@ def causal_flash_attention(
     v_r = v.transpose(0, 2, 1, 3)
 
     grid = (b, kh, t // q_block, tkv // kv_block)
+
+    # Beyond-diagonal kv blocks are fully masked (the kernel skips their
+    # compute); CLAMP their block index to the diagonal so consecutive
+    # grid steps map to the same block and the Mosaic pipeline elides the
+    # re-fetch — without this the kernel streams ~2x the causal KV bytes.
+    def kv_index(b_, kh_, qb, kb, s):
+        last_valid = ((qb + 1) * q_block - 1) // kv_block
+        return (b_, kh_, jnp.minimum(kb, last_valid), 0)
+
     out = pl.pallas_call(
         functools.partial(
             _kernel, scale=scale, prior_len=0, kv_block=kv_block,
@@ -247,10 +267,8 @@ def causal_flash_attention(
             in_specs=[
                 pl.BlockSpec((1, 1, rows, hd),
                              lambda b_, kh_, qb, kb, s: (b_, kh_, qb, 0)),
-                pl.BlockSpec((1, 1, kv_block, hd),
-                             lambda b_, kh_, qb, kb, s: (b_, kh_, kb, 0)),
-                pl.BlockSpec((1, 1, kv_block, hd),
-                             lambda b_, kh_, qb, kb, s: (b_, kh_, kb, 0)),
+                pl.BlockSpec((1, 1, kv_block, hd), kv_index),
+                pl.BlockSpec((1, 1, kv_block, hd), kv_index),
             ],
             out_specs=pl.BlockSpec((1, 1, rows, hd),
                                    lambda b_, kh_, qb, kb, s: (b_, kh_, qb, 0)),

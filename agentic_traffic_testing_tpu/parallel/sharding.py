@@ -112,8 +112,7 @@ def _qtensor_spec(spec: P, rank: int, cls) -> Any:
     contiguous)."""
     full = tuple(spec) + (None,) * (rank - len(spec))
     kw = "q" if cls.__name__ == "QTensor" else "packed"
-    out = cls(**{kw: P(*full)}, scale=P(*full[:-2], None, full[-1]))
-    return out
+    return cls(**{kw: P(*full)}, scale=P(*full[:-2], None, full[-1]))
 
 
 def _qtensor4_grouped_spec(spec: P, rank: int, groups: int) -> Any:

@@ -49,6 +49,11 @@ class SPPrefillRunner(ModelRunner):
     kv_writer_mode = "dus"   # pallas writer has no GSPMD partitioning rule
     attn_mode = "gather"     # decode: replicated jnp paged attention
     prefill_attn_mode = "ring_sp"
+    # The chunk jit has no ring mode — chunks would run replicated with
+    # zero sp speedup. LLMEngine refuses the combination at construction;
+    # serve with prefill_chunk_tokens=0 (one sharded long-prompt pass is
+    # the sp feature).
+    supports_chunked_prefill = False
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

@@ -245,6 +245,18 @@ class LLMEngine:
         platform = jax.devices()[0].platform
         decode_steps = cfg.resolved_decode_steps(platform)
         if runner is not None:
+            if (cfg.prefill_chunk_tokens
+                    and cfg.max_model_len > cfg.prefill_chunk_tokens
+                    and not runner.supports_chunked_prefill):
+                # Fail at construction, not mid-request: a long prompt would
+                # otherwise route to the chunk jit, which this runner cannot
+                # serve faithfully (e.g. SPPrefillRunner — chunks would run
+                # replicated with zero sp speedup; the sp feature IS the one
+                # sharded long-prompt pass).
+                raise ValueError(
+                    f"{type(runner).__name__} does not support chunked "
+                    f"prefill — build the engine with "
+                    f"prefill_chunk_tokens=0 (the serving sp branch does)")
             self.runner = runner
             decode_steps = runner.decode_steps
         else:

@@ -240,6 +240,10 @@ class ModelRunner:
     prefill_attn_mode: Optional[str] = None
     prefill_attn_mesh = None
     prefill_attn_axis: Optional[str] = None
+    #: whether this runner's chunk jit serves the engine's chunked-prefill
+    #: path faithfully (the SP runner sets False: chunks have no ring mode,
+    #: and the engine must refuse the combination at construction)
+    supports_chunked_prefill: bool = True
 
     def prepare_cache(self, cache: KVCache) -> KVCache:
         """Hook for placing a freshly allocated cache (TP runner shards it)."""

@@ -123,7 +123,9 @@ def launcher() -> int:
     print(json.dumps({
         "metric": None,
         "error": "benchmark failed after retries (backend unreachable?)",
-        "attempts": attempts,
+        # Attempts actually made — the loop exits early on a hang/wedge.
+        "attempts": sum(1 for e in errors if e.startswith("attempt")),
+        "attempts_configured": attempts,
         "attempt_errors": [e[-500:] for e in errors],
     }))
     return 1
@@ -212,7 +214,8 @@ def main() -> None:
     # so it never competes with the primary engine's HBM-profiled pool.
     small_engine = None
     if small_batch:
-        blocks_needed = small_batch * (-(-cfg.max_model_len // 16) + 4)
+        blocks_needed = small_batch * (
+            -(-cfg.max_model_len // cfg.block_size) + 4)
         small_engine = LLMEngine(EngineConfig(
             model=model,
             dtype="bfloat16",
