@@ -38,11 +38,22 @@ def ring_attention(
     *,
     axis_name: str,
     scale: Optional[float] = None,
+    kv_block: int = 1024,
 ) -> jax.Array:
     """Exact causal attention over an `axis_name`-sharded sequence.
 
     Must be called inside shard_map/pjit manual mode with `axis_name` bound.
     Returns [B, Tl, H, hd] in q.dtype.
+
+    Two-level streaming (round 4): the ring streams SHARDS between chips,
+    and within each shard the softmax additionally streams `kv_block`-sized
+    sub-blocks via `lax.scan` — peak score memory is [B, H, Tl, kv_block]
+    instead of [B, H, Tl, Tl]. At the serving-sp use case (16k prompt over
+    sp=4 -> Tl=4096) the one-level version materialized a ~2 GB f32 score
+    transient per ring step, the same disease the flash prefill kernel
+    cured on the single-chip path. Exact either way; sub-blocking only
+    engages when it divides Tl (serving/training shard lengths are powers
+    of two).
     """
     b, tl, h, hd = q.shape
     kh = k.shape[2]
@@ -50,23 +61,24 @@ def ring_attention(
     my = jax.lax.axis_index(axis_name)
     scale = scale if scale is not None else 1.0 / math.sqrt(hd)
 
+    kb = min(kv_block, tl)
+    while kb > 1 and tl % kb:
+        kb //= 2
+    if kb == 1 and tl > 1:
+        # Divisor search bottomed out (odd tl such as 4095): a per-token
+        # scan would be a compile/runtime blowup — fall back to one
+        # full-shard fold instead.
+        kb = tl
+
     qf = q.astype(jnp.float32) * scale
     q_pos = my * tl + jnp.arange(tl, dtype=jnp.int32)          # [Tl] global
 
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
-    def accum(state, k_blk, v_blk, step):
-        """Fold one KV shard into the streaming softmax. k/v_blk are the raw
-        [B, Tl, KH, hd] shards (original dtype); GQA-repeat and fp32 cast
-        happen here so only the small raw shards ride the ring."""
+    def fold(state, kf, vf, kv_pos):
+        """One streaming-softmax update over a [B, kb, H, hd] kv block."""
         m, l, acc = state
-        kf = repeat_kv(k_blk, h // kh).astype(jnp.float32)
-        vf = repeat_kv(v_blk, h // kh).astype(jnp.float32)
-        # After `step` rotations this chip holds the shard that started life
-        # on chip (my - step) mod sp.
-        src = (my - step) % sp
-        kv_pos = src * tl + jnp.arange(tl, dtype=jnp.int32)    # [Tl] global
-        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)         # [B,H,Tl,Tl]
+        logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)         # [B,H,Tl,kb]
         mask = kv_pos[None, None, None, :] <= q_pos[None, None, :, None]
         logits = jnp.where(mask, logits, NEG)
 
@@ -79,6 +91,29 @@ def ring_attention(
         l_new = l * corr + jnp.sum(p, axis=-1)
         acc_new = acc * corr[..., None] + jnp.einsum("bhqk,bkhd->bhqd", p, vf)
         return (m_new, l_new, acc_new)
+
+    def accum(state, k_blk, v_blk, step):
+        """Fold one KV shard into the streaming softmax. k/v_blk are the raw
+        [B, Tl, KH, hd] shards (original dtype); GQA-repeat and fp32 cast
+        happen here so only the small raw shards ride the ring."""
+        kf = repeat_kv(k_blk, h // kh).astype(jnp.float32)
+        vf = repeat_kv(v_blk, h // kh).astype(jnp.float32)
+        # After `step` rotations this chip holds the shard that started life
+        # on chip (my - step) mod sp.
+        src = (my - step) % sp
+        if kb == tl:
+            kv_pos = src * tl + jnp.arange(tl, dtype=jnp.int32)  # [Tl] global
+            return fold(state, kf, vf, kv_pos)
+
+        def sub(carry, i):
+            ks = jax.lax.dynamic_slice_in_dim(kf, i * kb, kb, axis=1)
+            vs = jax.lax.dynamic_slice_in_dim(vf, i * kb, kb, axis=1)
+            kv_pos = src * tl + i * kb + jnp.arange(kb, dtype=jnp.int32)
+            return fold(carry, ks, vs, kv_pos), None
+
+        state, _ = jax.lax.scan(
+            sub, state, jnp.arange(tl // kb, dtype=jnp.int32))
+        return state
 
     def block(carry, step):
         k_blk, v_blk, state = carry
@@ -102,7 +137,8 @@ def ring_attention(
     return out.transpose(0, 2, 1, 3).astype(q.dtype)           # [B,Tl,H,hd]
 
 
-def make_sp_prefill_attention(mesh: Mesh, *, sp_axis: str = "sp"):
+def make_sp_prefill_attention(mesh: Mesh, *, sp_axis: str = "sp",
+                              kv_block: int = 1024):
     """Ring attention for the SERVING prefill site (round-4: SURVEY §5.7's
     last box — sequence-parallel serving).
 
@@ -124,13 +160,13 @@ def make_sp_prefill_attention(mesh: Mesh, *, sp_axis: str = "sp"):
         check_vma=False,
     )
     def attn(q, k, v):
-        return ring_attention(q, k, v, axis_name=sp_axis)
+        return ring_attention(q, k, v, axis_name=sp_axis, kv_block=kv_block)
 
     return attn
 
 
 def make_sp_attention(mesh: Mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
-                      tp_axis: str = "tp"):
+                      tp_axis: str = "tp", kv_block: int = 1024):
     """Wrap `ring_attention` in shard_map over a (dp, sp, tp) mesh.
 
     Returns attn(q, k, v) for q [B, T, H, hd] / kv [B, T, KH, hd] with
@@ -148,6 +184,6 @@ def make_sp_attention(mesh: Mesh, *, dp_axis: str = "dp", sp_axis: str = "sp",
         check_vma=False,
     )
     def attn(q, k, v):
-        return ring_attention(q, k, v, axis_name=sp_axis)
+        return ring_attention(q, k, v, axis_name=sp_axis, kv_block=kv_block)
 
     return attn

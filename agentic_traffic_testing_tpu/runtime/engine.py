@@ -245,18 +245,23 @@ class LLMEngine:
         platform = jax.devices()[0].platform
         decode_steps = cfg.resolved_decode_steps(platform)
         if runner is not None:
-            if (cfg.prefill_chunk_tokens
-                    and cfg.max_model_len > cfg.prefill_chunk_tokens
-                    and not runner.supports_chunked_prefill):
-                # Fail at construction, not mid-request: a long prompt would
-                # otherwise route to the chunk jit, which this runner cannot
-                # serve faithfully (e.g. SPPrefillRunner — chunks would run
-                # replicated with zero sp speedup; the sp feature IS the one
-                # sharded long-prompt pass).
+            chunk_reachable = (
+                (cfg.prefill_chunk_tokens
+                 and cfg.max_model_len > cfg.prefill_chunk_tokens)
+                # Prefix-cached requests prefill their suffix through the
+                # chunk path REGARDLESS of the chunk threshold.
+                or cfg.prefix_caching)
+            if chunk_reachable and not runner.supports_chunked_prefill:
+                # Fail at construction, not mid-request: the chunk jit is
+                # one this runner cannot serve faithfully (e.g.
+                # SPPrefillRunner — chunks would run replicated with zero
+                # sp speedup; the sp feature IS the one sharded
+                # long-prompt pass).
                 raise ValueError(
-                    f"{type(runner).__name__} does not support chunked "
-                    f"prefill — build the engine with "
-                    f"prefill_chunk_tokens=0 (the serving sp branch does)")
+                    f"{type(runner).__name__} does not support the chunked-"
+                    f"prefill path — build the engine with "
+                    f"prefill_chunk_tokens=0 and prefix_caching=False "
+                    f"(the serving sp branch does)")
             self.runner = runner
             decode_steps = runner.decode_steps
         else:

@@ -158,6 +158,13 @@ class LLMServer:
                 raise NotImplementedError(
                     "int4 x sequence-parallel serving is not wired — use "
                     "int8 or bf16 with LLM_SP_SIZE")
+            if c.prefix_caching:
+                # Cached-prefix requests prefill their suffix through the
+                # chunk jit, which has no ring mode — the combination
+                # would silently lose the advertised parallelism.
+                raise NotImplementedError(
+                    "prefix caching x sequence-parallel serving is not "
+                    "wired — unset LLM_PREFIX_CACHING with LLM_SP_SIZE")
             # Chunked prefill would defeat sp entirely: the chunk jit has
             # no ring mode, so chunks would run replicated on every chip
             # with zero speedup — the one long-prompt pass IS the sp

@@ -76,11 +76,55 @@ def launcher() -> int:
     Fresh process per attempt: jax caches a failed backend init for the
     life of the process, so an in-process retry of `jax.devices()` after
     an axon UNAVAILABLE would just replay the cached failure.
+
+    A cheap device PROBE gates the heavy measurement: when the tunnel is
+    wedged, backend init hangs ~25 minutes before erroring — probing with
+    a short timeout first caps the total failure path at ~probe budget
+    instead of a full measurement attempt (healthy init is seconds).
     """
     attempts = int(os.environ.get("BENCH_ATTEMPTS", "3"))
     timeout_s = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1500"))
+    probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", "300"))
     delays = [0.0, 20.0, 60.0]
     errors = []
+
+    probe_src = (
+        "from agentic_traffic_testing_tpu.platform_guard import "
+        "force_cpu_if_requested; force_cpu_if_requested(); "
+        "import jax; d = jax.devices(); print(d[0].platform, len(d))")
+    probe_ok = False
+    for p in range(attempts):
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c", probe_src], env=dict(os.environ),
+                capture_output=True, text=True, timeout=probe_timeout)
+        except subprocess.TimeoutExpired:
+            errors.append(f"probe {p + 1}: no device in {probe_timeout:.0f}s "
+                          f"(tunnel hang)")
+            print(errors[-1], file=sys.stderr, flush=True)
+            # A hang does not recover on immediate retry; one more probe
+            # after a pause, then give up without burning a 25-min attempt.
+            if p + 1 >= 2:
+                break
+            time.sleep(60)
+            continue
+        if probe.returncode == 0:
+            probe_ok = True
+            break
+        tail = (probe.stderr or "").strip().splitlines()[-1:]
+        errors.append(f"probe {p + 1}: rc={probe.returncode}: "
+                      + " | ".join(tail))
+        print(errors[-1], file=sys.stderr, flush=True)
+        if p + 1 < attempts:
+            time.sleep(30)
+    if not probe_ok:
+        print(json.dumps({
+            "metric": None,
+            "error": "no usable backend (device probe failed)",
+            "attempts": 0,
+            "attempt_errors": [e[-500:] for e in errors],
+        }))
+        return 1
     for i in range(attempts):
         delay = delays[i] if i < len(delays) else delays[-1]
         if delay:

@@ -1,0 +1,19 @@
+#!/usr/bin/env python3
+"""Probe the axon TPU tunnel: exit 0 iff a device is reachable and computes.
+
+Used by the round-4 recovery watcher (and by hand). When the tunnel is
+wedged, backend init hangs ~25 min before raising UNAVAILABLE — run under
+a timeout.
+"""
+
+import time
+
+import jax
+
+t0 = time.time()
+devices = jax.devices()
+print(f"TUNNEL UP: {devices} in {time.time() - t0:.1f}s", flush=True)
+import jax.numpy as jnp
+
+x = jnp.ones((128, 128), jnp.bfloat16)
+print("compute:", float((x @ x).sum()))

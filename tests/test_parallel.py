@@ -64,6 +64,24 @@ def test_ring_attention_matches_dense(dp, sp, tp):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
 
 
+def test_ring_attention_subblock_streaming_matches_dense():
+    """kv_block < Tl engages the round-4 two-level streaming (lax.scan over
+    sub-blocks inside each ring step): numerics must match the dense oracle
+    exactly like the one-level path — including the causal boundary rows at
+    every sub-block edge."""
+    mesh = make_mesh(sp=2)
+    attn = make_sp_attention(mesh, kv_block=4)   # Tl=16 -> 4 sub-blocks
+    b, t, h, kh, hd = 2, 32, 4, 2, 8
+    q = jax.random.normal(jax.random.key(4), (b, t, h, hd), jnp.float32)
+    k = jax.random.normal(jax.random.key(5), (b, t, kh, hd), jnp.float32)
+    v = jax.random.normal(jax.random.key(6), (b, t, kh, hd), jnp.float32)
+    out = attn(q, k, v)
+    qpos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    ref = causal_attention(q, k, v, q_positions=qpos,
+                           kv_valid_len=jnp.full((b,), t, jnp.int32))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)
+
+
 def test_tp_engine_matches_single_device(tiny_cfg, tiny_params):
     """Greedy decode must be bit-identical between TP=2 and one device."""
     ecfg = EngineConfig(model="tiny", dtype="float32", num_blocks=64, max_model_len=128)
@@ -96,6 +114,36 @@ def test_sp_serving_prefill_matches_single_device(tiny_cfg, tiny_params, sp):
     got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(
         prompt, samp)
     assert got.output_ids == ref.output_ids
+
+
+def test_sp_batched_prefill_matches_single_device(tiny_cfg, tiny_params):
+    """Concurrent same-bucket arrivals ride the BATCHED prefill pass
+    (B > 1) — the ring adapter keeps batch unsharded, so this pins the
+    [B, T/sp] layout end to end, not just the solo case."""
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams as SP
+
+    ecfg = EngineConfig(model="tiny", dtype="float32", num_blocks=96,
+                        max_model_len=128, max_num_seqs=3,
+                        prefill_batch_max_len=128)
+    prompts = [[(3 * i + j) % tiny_cfg.vocab_size for i in range(29 + j)]
+               for j in range(3)]
+    samp = SP(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    def run(runner):
+        eng = (LLMEngine(ecfg, model_cfg=tiny_cfg, params=tiny_params)
+               if runner is None else
+               LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner))
+        reqs = [eng.add_request(p, samp) for p in prompts]
+        for _ in range(10_000):
+            eng.step()
+            if all(r.is_finished() for r in reqs):
+                break
+        return [list(r.generated_ids) for r in reqs]
+
+    want = run(None)
+    got = run(SPPrefillRunner(tiny_cfg, tiny_params, make_mesh(sp=2)))
+    assert got == want
 
 
 def test_sp_runner_rejects_trivial_axis(tiny_cfg, tiny_params):
