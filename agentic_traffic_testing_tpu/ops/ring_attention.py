@@ -138,19 +138,21 @@ def ring_attention(
 
 
 def make_sp_prefill_attention(mesh: Mesh, *, sp_axis: str = "sp",
-                              kv_block: int = 1024):
+                              tp_axis: str = "tp", kv_block: int = 1024):
     """Ring attention for the SERVING prefill site (round-4: SURVEY §5.7's
     last box — sequence-parallel serving).
 
     Layout differs from the training adapter below: batch stays unsharded
     (a serving prefill is one long prompt, or a few — nothing to shard),
-    only the sequence dim rides `sp_axis`; heads are untouched (an sp-only
-    serving mesh). The contract matches ops/flash_prefill.py's site:
-    positions are the implicit global arange 0..T, padding only at the
-    tail, so causality alone is exact. T must divide by the sp degree
-    (serving buckets are powers of two — always true for sp in {2,4,8}).
+    the sequence dim rides `sp_axis` and heads ride `tp_axis` (size 1 on
+    an sp-only serving mesh — the spec entry is then a no-op, so the same
+    adapter serves SPPrefillRunner and the composed SPTPRunner). The
+    contract matches ops/flash_prefill.py's site: positions are the
+    implicit global arange 0..T, padding only at the tail, so causality
+    alone is exact. T must divide by the sp degree (serving buckets are
+    powers of two — always true for sp in {2,4,8}).
     """
-    qs = P(None, sp_axis, None, None)
+    qs = P(None, sp_axis, tp_axis, None)
 
     @partial(
         jax.shard_map,

@@ -30,7 +30,8 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig
-from agentic_traffic_testing_tpu.parallel.mesh import AXIS_SP
+from agentic_traffic_testing_tpu.parallel.mesh import AXIS_SP, AXIS_TP
+from agentic_traffic_testing_tpu.parallel.tp_runner import TPRunner
 from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
 from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
 
@@ -75,3 +76,51 @@ class SPPrefillRunner(ModelRunner):
     def prepare_cache(self, cache: KVCache) -> KVCache:
         """Replicate the page pool (decode reads it whole on every chip)."""
         return jax.device_put(cache, NamedSharding(self.mesh, P()))
+
+
+class SPTPRunner(TPRunner):
+    """Tensor-parallel runner whose PREFILL additionally shards the
+    sequence over an `sp` mesh axis (round-4 composition: the long-context
+    profile for models that do NOT fit one chip).
+
+    Layout on an (sp, tp) mesh: params and KV pool are tp-sharded exactly
+    as in TPRunner (replicated over sp); prefill activations are
+    T-sharded over sp with heads tp-sharded inside the ring adapter
+    (ops/ring_attention.py make_sp_prefill_attention — the same head
+    layout the training sp x tp step uses). Decode is TPRunner's path
+    unchanged, with the sp groups running it redundantly (decode is
+    weight-streaming-bound; sp buys nothing there and the redundancy
+    costs no wall-clock). int4 is refused: its prefill matmuls run the
+    pallas kernel under a tp-only shard_map (QTensor4TP), which cannot
+    additionally partition T over sp.
+    """
+
+    prefill_attn_mode = "ring_sp"
+    supports_chunked_prefill = False
+
+    def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
+                 decode_steps: int = 1, spec_tokens: int = 0,
+                 spec_ngram: int = 3, int4_groups=None) -> None:
+        sp = mesh.shape[AXIS_SP]
+        if sp < 2 or mesh.shape[AXIS_TP] < 2:
+            raise ValueError(
+                f"SPTPRunner needs sp >= 2 AND tp >= 2 (got sp={sp}, "
+                f"tp={mesh.shape[AXIS_TP]}) — use TPRunner or "
+                f"SPPrefillRunner for a single-axis mesh")
+        from agentic_traffic_testing_tpu.models.quant import QTensor4
+
+        if any(isinstance(l, QTensor4)
+               for l in list(params["layers"].values())
+               + [params.get("unembed"), params.get("tok_embed")]):
+            raise NotImplementedError(
+                "int4 x (sp x tp) serving is not wired — the int4 pallas "
+                "matmul's shard_map covers tp only; use int8 or bf16")
+        self.prefill_attn_mesh = mesh
+        self.prefill_attn_axis = AXIS_SP
+        super().__init__(cfg, params, mesh, decode_steps=decode_steps,
+                         spec_tokens=spec_tokens, spec_ngram=spec_ngram,
+                         int4_groups=int4_groups)
+
+    @property
+    def sp_size(self) -> int:
+        return self.mesh.shape[AXIS_SP]

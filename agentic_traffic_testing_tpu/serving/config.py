@@ -45,7 +45,8 @@ class ServerConfig:
     tp_size: int = 1                           # LLM_TP_SIZE (TPU-native knob)
     # Sequence-parallel prefill degree (TPU-native knob): long-prompt
     # prefill rides ring attention over an sp mesh axis, decode unchanged
-    # (parallel/sp_runner.py). Mutually exclusive with tp_size > 1 for now.
+    # (parallel/sp_runner.py). Composes with tp_size > 1 (SPTPRunner,
+    # bf16/int8 — int4's kernel shard_map covers tp only).
     sp_size: int = 1                           # LLM_SP_SIZE
     quantization: Optional[str] = None         # LLM_QUANTIZATION ("int8" | "int4" | unset)
     decode_steps: Optional[int] = None         # LLM_DECODE_STEPS (None -> auto)
@@ -108,11 +109,6 @@ class ServerConfig:
         c.port = int(os.environ.get("LLM_PORT") or c.port)
         c.tp_size = int(os.environ.get("LLM_TP_SIZE") or c.tp_size)
         c.sp_size = int(os.environ.get("LLM_SP_SIZE") or c.sp_size)
-        if c.sp_size > 1 and c.tp_size > 1:
-            raise ValueError(
-                "LLM_SP_SIZE and LLM_TP_SIZE are mutually exclusive for now "
-                "(sp serving prefill assumes replicated params — "
-                "parallel/sp_runner.py)")
         c.quantization = os.environ.get("LLM_QUANTIZATION") or None
         ds = os.environ.get("LLM_DECODE_STEPS")
         c.decode_steps = int(ds) if ds else None

@@ -139,22 +139,22 @@ class LLMServer:
         model_cfg = None
         if c.sp_size > 1:
             from agentic_traffic_testing_tpu.models.config import resolve_config
-            from agentic_traffic_testing_tpu.parallel.mesh import single_axis_mesh
+            from agentic_traffic_testing_tpu.parallel.mesh import (
+                make_mesh,
+                single_axis_mesh,
+            )
             from agentic_traffic_testing_tpu.parallel.sp_runner import (
                 SPPrefillRunner,
+                SPTPRunner,
             )
             import jax
 
-            if c.tp_size > 1:
-                # Covers programmatic ServerConfig construction too (the
-                # from_env path already rejects this combination).
-                raise ValueError("sp_size and tp_size are mutually exclusive "
-                                 "for now (parallel/sp_runner.py)")
             if c.quantization == "int4":
-                # The int4 matmul is a pallas_call, which GSPMD cannot
-                # partition over the sp mesh (same constraint that forces
-                # the TP runner's shard_map wrapper). int8 is plain XLA
-                # math and shards fine.
+                # The int4 matmul is a pallas_call whose shard_map covers
+                # tp only — it cannot additionally partition T over sp
+                # (same constraint class that forces the TP runner's
+                # shard_map wrapper). int8 is plain XLA math and shards
+                # fine on either mesh.
                 raise NotImplementedError(
                     "int4 x sequence-parallel serving is not wired — use "
                     "int8 or bf16 with LLM_SP_SIZE")
@@ -181,13 +181,23 @@ class LLMServer:
                 model_cfg = dataclasses.replace(
                     model_cfg, moe_capacity_factor=c.moe_capacity_factor)
             params = self._params_or_random_init(model_cfg)
-            runner = SPPrefillRunner(
-                model_cfg, params, single_axis_mesh("sp", c.sp_size),
+            common = dict(
                 decode_steps=ecfg.resolved_decode_steps(
                     jax.devices()[0].platform),
                 spec_tokens=ecfg.effective_spec_tokens,
                 spec_ngram=ecfg.spec_ngram,
             )
+            if c.tp_size > 1:
+                # Composed sp x tp: ring prefill with tp-sharded heads
+                # over TP-sharded params/KV — the long-context profile
+                # for models that need TP to fit (parallel/sp_runner.py).
+                runner = SPTPRunner(
+                    model_cfg, params,
+                    make_mesh(sp=c.sp_size, tp=c.tp_size), **common)
+            else:
+                runner = SPPrefillRunner(
+                    model_cfg, params, single_axis_mesh("sp", c.sp_size),
+                    **common)
             return LLMEngine(ecfg, model_cfg=model_cfg, runner=runner)
         if c.tp_size > 1:
             import dataclasses

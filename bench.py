@@ -92,19 +92,25 @@ def launcher() -> int:
         "from agentic_traffic_testing_tpu.platform_guard import "
         "force_cpu_if_requested; force_cpu_if_requested(); "
         "import jax; d = jax.devices(); print(d[0].platform, len(d))")
+    repo_root = os.path.dirname(os.path.abspath(__file__))
     probe_ok = False
     for p in range(attempts):
         try:
+            # cwd=repo root: `-c` puts only the cwd on sys.path, and the
+            # guard import must resolve regardless of where the driver
+            # launched bench.py from.
             probe = subprocess.run(
                 [sys.executable, "-c", probe_src], env=dict(os.environ),
-                capture_output=True, text=True, timeout=probe_timeout)
+                capture_output=True, text=True, timeout=probe_timeout,
+                cwd=repo_root)
         except subprocess.TimeoutExpired:
             errors.append(f"probe {p + 1}: no device in {probe_timeout:.0f}s "
                           f"(tunnel hang)")
             print(errors[-1], file=sys.stderr, flush=True)
-            # A hang does not recover on immediate retry; one more probe
-            # after a pause, then give up without burning a 25-min attempt.
-            if p + 1 >= 2:
+            # A hang does not recover on immediate retry; at most one more
+            # probe after a pause, then give up without burning a 25-min
+            # attempt — and never sleep when no further probe will run.
+            if p + 1 >= min(2, attempts):
                 break
             time.sleep(60)
             continue

@@ -153,6 +153,69 @@ def test_sp_runner_rejects_trivial_axis(tiny_cfg, tiny_params):
         SPPrefillRunner(tiny_cfg, tiny_params, make_mesh(sp=1))
 
 
+def test_sptp_runner_guards(tiny_cfg, tiny_params):
+    """SPTPRunner refusals: single-axis meshes, int4 params, and the
+    engine-level chunk-path refusal all fail fast with actionable errors
+    (a silent fall-through would only surface at TPU serve time)."""
+    from agentic_traffic_testing_tpu.models.quant import quantize_params
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPTPRunner
+
+    with pytest.raises(ValueError, match="sp >= 2 AND tp >= 2"):
+        SPTPRunner(tiny_cfg, tiny_params, make_mesh(sp=2, tp=1))
+    with pytest.raises(NotImplementedError, match="int4"):
+        SPTPRunner(tiny_cfg, quantize_params(tiny_params, scheme="int4"),
+                   make_mesh(sp=2, tp=2))
+    runner = SPTPRunner(tiny_cfg, tiny_params, make_mesh(sp=2, tp=2))
+    with pytest.raises(ValueError, match="chunked"):
+        LLMEngine(EngineConfig(model="tiny", dtype="float32", num_blocks=64,
+                               max_model_len=8192, prefill_chunk_tokens=64),
+                  model_cfg=tiny_cfg, runner=runner)
+    with pytest.raises(ValueError, match="chunked"):
+        LLMEngine(EngineConfig(model="tiny", dtype="float32", num_blocks=64,
+                               max_model_len=128, prefix_caching=True),
+                  model_cfg=tiny_cfg, runner=runner)
+
+
+def test_sptp_serving_prefill_matches_single_device(tiny_cfg, tiny_params):
+    """sp x tp composition (round 4): long-prompt prefill rides ring
+    attention over sp WITH heads tp-sharded, params/KV tp-sharded as in
+    plain TP, decode unchanged — token-exact vs the single-device engine
+    on a (sp=2, tp=2) CPU mesh."""
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPTPRunner
+
+    ecfg = EngineConfig(model="tiny", dtype="float32", num_blocks=64,
+                        max_model_len=128)
+    prompt = [(11 * i + 5) % tiny_cfg.vocab_size for i in range(61)]
+    samp = SamplingParams(temperature=0.0, max_tokens=10, ignore_eos=True)
+
+    ref = LLMEngine(ecfg, model_cfg=tiny_cfg,
+                    params=tiny_params).generate(prompt, samp)
+    runner = SPTPRunner(tiny_cfg, tiny_params, make_mesh(sp=2, tp=2))
+    got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(
+        prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
+def test_sptp_int8_serving_prefill_matches_single_device(tiny_cfg, tiny_params):
+    """sp x tp x int8: quantized leaves expand their (q, scale) specs over
+    the composed mesh exactly as under plain TP."""
+    from agentic_traffic_testing_tpu.models.quant import quantize_params
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPTPRunner
+
+    qparams = quantize_params(tiny_params)
+    ecfg = EngineConfig(model="tiny", dtype="float32", quantization="int8",
+                        num_blocks=64, max_model_len=128)
+    prompt = [(7 * i + 2) % tiny_cfg.vocab_size for i in range(45)]
+    samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    ref = LLMEngine(ecfg, model_cfg=tiny_cfg,
+                    params=qparams).generate(prompt, samp)
+    runner = SPTPRunner(tiny_cfg, qparams, make_mesh(sp=2, tp=2))
+    got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(
+        prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
 def test_tp_shard_dma_matches_gather(tiny_cfg, tiny_params, monkeypatch):
     """The shard_map-wrapped DMA kernel (TPU default for TP; interpret mode
     here on the CPU mesh) must reproduce the GSPMD gather path's greedy
