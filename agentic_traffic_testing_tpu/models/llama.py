@@ -113,13 +113,20 @@ def init_params(cfg: ModelConfig, key: jax.Array, dtype=jnp.bfloat16) -> Params:
 
 def init_params_quantized(cfg: ModelConfig, seed: int = 0,
                           dtype=jnp.bfloat16, scheme: str = "int8",
-                          int4_k_group: int = 0) -> Params:
+                          int4_k_group: int = 0,
+                          int4_groups: int = 1) -> Params:
     """Random-init DIRECTLY in int8/int4 (checkpoint-free benches/tests of
     big configs: an 8B in bf16 alone overflows one v5e chip's HBM, and even
     a host-side fp32 init of it costs minutes of RNG + tunnel transfer).
     Weights are uniform with a constant per-tensor scale chosen so the
     dequantized std matches init_params' 0.02 — statistically equivalent for
-    perf work, never materialized in float anywhere."""
+    perf work, never materialized in float anywhere.
+
+    `int4_groups` mirrors quantize_params' TP semantics where they affect
+    SHAPES: with int4_groups > 1 the unembed hybridizes to int8 (its packed
+    half-width V/2 is rarely tp-shardable — models/quant.py quantize_params
+    documents the same rule). The byte-layout half of grouped packing is
+    moot for random init (layout-free by construction)."""
     import numpy as np
 
     if scheme not in ("int8", "int4"):
@@ -203,6 +210,11 @@ def init_params_quantized(cfg: ModelConfig, seed: int = 0,
     if cfg.tie_word_embeddings and scheme == "int8":
         te = params["tok_embed"]
         params["unembed"] = QTensor(q=te.q.T, scale=jnp.full((1, v), SCALE, jnp.float32))
+    elif scheme == "int4" and int4_groups > 1:
+        # int4 x TP hybrid, mirroring quantize_params: the V-sharded
+        # lm_head stays int8 (packed half-width V/2 per tp shard is rarely
+        # lane-tileable or even integral).
+        params["unembed"] = qw8((d, v))
     else:
         # int4: packed nibbles can't be transposed in place — random-init an
         # independent unembed (statistically identical for perf work).

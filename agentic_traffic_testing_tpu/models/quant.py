@@ -23,7 +23,7 @@ of QTensor params is not wired up yet (the TP runner rejects the combo).
 from __future__ import annotations
 
 import functools
-from typing import Any, NamedTuple, Union
+from typing import Any, NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -136,10 +136,19 @@ class QTensor4TP:
     contiguous slice of logical columns, so each chip's local shard is
     itself a well-formed half-paired QTensor4 and the kernel runs unchanged.
     Row-parallel leaves shard only K — standard packing.
+
+    `sp_axis` (round-4, sp x tp composed serving) additionally lets the
+    matmul shard the ACTIVATION's token dim over a sequence-parallel mesh
+    axis. Whether it applies is decided per call site at trace time by
+    shape (_dense4_tp): a [B, T, D] prefill activation with T divisible by
+    the sp degree shards T (each chip computes its token slice against its
+    weight shard); decode activations (S in {1..4}) stay replicated over
+    sp — exactly the sp-redundant decode the composed runner documents.
+    Weights carry no sp dimension either way.
     """
 
     def __init__(self, packed: jax.Array, scale: jax.Array, kind: str,
-                 mesh, axis: str) -> None:
+                 mesh, axis: str, sp_axis: Optional[str] = None) -> None:
         if kind not in ("col", "row"):
             raise ValueError(f"kind={kind!r}; choose col|row")
         self.packed = packed
@@ -147,9 +156,11 @@ class QTensor4TP:
         self.kind = kind
         self.mesh = mesh
         self.axis = axis
+        self.sp_axis = sp_axis
 
     def tree_flatten(self):
-        return (self.packed, self.scale), (self.kind, self.mesh, self.axis)
+        return ((self.packed, self.scale),
+                (self.kind, self.mesh, self.axis, self.sp_axis))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -269,24 +280,44 @@ def _dense4_tp(x: jax.Array, w: QTensor4TP, layer=None) -> jax.Array:
     psum'd to a replicated output — the scale multiply commutes with the
     psum because per-output-column scales are constant across K shards
     (same argument as int8's expand_quant_specs).
+
+    With `w.sp_axis` set (composed sp x tp serving) and a [B, T, D]
+    activation whose T divides the sp degree, the token dim additionally
+    shards over sp — decided at TRACE time from the shape, so the prefill
+    jit shards T while the decode/verify jits (S in {1..4}) replicate, all
+    from the same param tree.
     """
     from jax.sharding import PartitionSpec as P
 
     nd = x.ndim
     pnd, snd = w.packed.ndim, w.scale.ndim
+    sp = None
+    if (w.sp_axis is not None and nd == 3
+            and dict(w.mesh.shape).get(w.sp_axis, 1) > 1
+            # Prefill activations only: decode/verify widths (S =
+            # spec_tokens + 1, <= 8) can be sp-divisible too, and sharding
+            # them would inject per-layer resharding collectives into the
+            # latency path the design keeps sp-redundant. 64 is safely
+            # above any verify width and below any long-prompt bucket
+            # worth sharding.
+            and x.shape[1] >= 64
+            and x.shape[1] % w.mesh.shape[w.sp_axis] == 0):
+        sp = w.sp_axis
     if w.kind == "col":
-        xspec = P(*(None,) * nd)
+        xspec = P(None, sp, None) if nd == 3 else P(*(None,) * nd)
         pspec = P(*(None,) * (pnd - 1), w.axis)
         sspec = P(*(None,) * (snd - 1), w.axis)
-        ospec = P(*(None,) * (nd - 1), w.axis)
+        ospec = (P(None, sp, w.axis) if nd == 3
+                 else P(*(None,) * (nd - 1), w.axis))
     else:
-        xspec = P(*(None,) * (nd - 1), w.axis)
+        xspec = (P(None, sp, w.axis) if nd == 3
+                 else P(*(None,) * (nd - 1), w.axis))
         pspec = P(*(None,) * (pnd - 2), w.axis, None)
         # K-group-wise scales (scale rank = packed rank + 1) shard their
         # group axis with K; per-full-K scales replicate.
         sspec = (P(*(None,) * (snd - 3), w.axis, None, None)
                  if snd == pnd + 1 else P(*(None,) * snd))
-        ospec = P(*(None,) * nd)
+        ospec = P(None, sp, None) if nd == 3 else P(*(None,) * nd)
     lay = jnp.asarray(0 if layer is None else layer, jnp.int32)
 
     def local(x_l, p_l, s_l, lay_l):

@@ -29,7 +29,7 @@ import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig
-from agentic_traffic_testing_tpu.parallel.mesh import AXIS_EP, AXIS_TP
+from agentic_traffic_testing_tpu.parallel.mesh import AXIS_EP, AXIS_SP, AXIS_TP
 from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
 
 
@@ -163,11 +163,17 @@ def wrap_int4_tp(params: Any, mesh: Mesh) -> Any:
         QTensor4TP,
     )
 
+    # On a composed (sp, tp) mesh the matmul may additionally shard the
+    # activation's token dim over sp (decided per call site by shape —
+    # models/quant._dense4_tp).
+    sp_axis = AXIS_SP if dict(mesh.shape).get(AXIS_SP, 1) > 1 else None
+
     def wrap(key: str, leaf: Any) -> Any:
         kind = TP_KIND.get(key)
         if kind is None or not isinstance(leaf, QTensor4):
             return leaf
-        return QTensor4TP(leaf.packed, leaf.scale, kind, mesh, AXIS_TP)
+        return QTensor4TP(leaf.packed, leaf.scale, kind, mesh, AXIS_TP,
+                          sp_axis=sp_axis)
 
     out = {k: wrap(k, v) for k, v in params.items() if k != "layers"}
     out["layers"] = {k: wrap(k, v) for k, v in params["layers"].items()}

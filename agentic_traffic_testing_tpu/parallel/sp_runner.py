@@ -90,9 +90,11 @@ class SPTPRunner(TPRunner):
     layout the training sp x tp step uses). Decode is TPRunner's path
     unchanged, with the sp groups running it redundantly (decode is
     weight-streaming-bound; sp buys nothing there and the redundancy
-    costs no wall-clock). int4 is refused: its prefill matmuls run the
-    pallas kernel under a tp-only shard_map (QTensor4TP), which cannot
-    additionally partition T over sp.
+    costs no wall-clock). int4 composes too: the QTensor4TP shard_map
+    carries the sp axis and shards the prefill activation's token dim by
+    SHAPE at trace time (models/quant._dense4_tp), so the kernel keeps
+    its tp-only weight layout while sp still divides the token work;
+    the usual `int4_groups=tp` packing attestation applies.
     """
 
     prefill_attn_mode = "ring_sp"
@@ -107,14 +109,6 @@ class SPTPRunner(TPRunner):
                 f"SPTPRunner needs sp >= 2 AND tp >= 2 (got sp={sp}, "
                 f"tp={mesh.shape[AXIS_TP]}) — use TPRunner or "
                 f"SPPrefillRunner for a single-axis mesh")
-        from agentic_traffic_testing_tpu.models.quant import QTensor4
-
-        if any(isinstance(l, QTensor4)
-               for l in list(params["layers"].values())
-               + [params.get("unembed"), params.get("tok_embed")]):
-            raise NotImplementedError(
-                "int4 x (sp x tp) serving is not wired — the int4 pallas "
-                "matmul's shard_map covers tp only; use int8 or bf16")
         self.prefill_attn_mesh = mesh
         self.prefill_attn_axis = AXIS_SP
         super().__init__(cfg, params, mesh, decode_steps=decode_steps,

@@ -63,6 +63,15 @@ class LLMMetrics:
         self.config_max_tokens = Gauge(
             f"{prefix}_config_max_tokens",
             "Configured max tokens per generation (LLM_MAX_TOKENS)", registry=r)
+        # Parallel topology (TPU-native knobs; no reference analog — its
+        # tensor_parallel_size lives inside vLLM engine args). Dashboards
+        # distinguishing tp/sp/sp x tp deployments read these.
+        self.config_tp_size = Gauge(
+            f"{prefix}_config_tp_size",
+            "Tensor-parallel degree (LLM_TP_SIZE)", registry=r)
+        self.config_sp_size = Gauge(
+            f"{prefix}_config_sp_size",
+            "Sequence-parallel prefill degree (LLM_SP_SIZE)", registry=r)
         self.kv_cache_num_gpu_blocks = Gauge(
             f"{prefix}_kv_cache_num_gpu_blocks",
             "KV cache: number of device blocks allocated; -1 means unknown",
@@ -157,11 +166,14 @@ class LLMMetrics:
                 self.completion_tokens.inc(completion_tokens)
 
     def set_config_gauges(self, *, max_num_seqs: int, max_num_batched_tokens: int,
-                          memory_utilization: float, max_tokens: int) -> None:
+                          memory_utilization: float, max_tokens: int,
+                          tp_size: int = 1, sp_size: int = 1) -> None:
         self.config_max_num_seqs.set(max_num_seqs)
         self.config_max_num_batched_tokens.set(max_num_batched_tokens)
         self.config_gpu_memory_utilization.set(memory_utilization)
         self.config_max_tokens.set(max_tokens)
+        self.config_tp_size.set(tp_size)
+        self.config_sp_size.set(sp_size)
 
     def set_kv_gauges(self, *, num_blocks: int, block_size: int,
                       max_model_len: int, max_num_seqs: int) -> None:

@@ -108,6 +108,8 @@ class LLMServer:
                 max_num_batched_tokens=cfg.max_num_batched_tokens,
                 memory_utilization=cfg.memory_utilization,
                 max_tokens=cfg.max_tokens,
+                tp_size=cfg.tp_size,
+                sp_size=cfg.sp_size,
             )
             self.metrics.set_kv_gauges(
                 num_blocks=self.engine.cache.num_blocks - 1,  # exclude trash block
@@ -149,15 +151,14 @@ class LLMServer:
             )
             import jax
 
-            if c.quantization == "int4":
-                # The int4 matmul is a pallas_call whose shard_map covers
-                # tp only — it cannot additionally partition T over sp
-                # (same constraint class that forces the TP runner's
-                # shard_map wrapper). int8 is plain XLA math and shards
-                # fine on either mesh.
+            if c.quantization == "int4" and c.tp_size <= 1:
+                # sp-only int4 has no shard_map wrapper (the pallas matmul
+                # cannot ride plain GSPMD over the sp mesh); the COMPOSED
+                # sp x tp path works — QTensor4TP carries the sp axis and
+                # shards the activation's token dim (models/quant.py).
                 raise NotImplementedError(
-                    "int4 x sequence-parallel serving is not wired — use "
-                    "int8 or bf16 with LLM_SP_SIZE")
+                    "int4 x sp-only serving is not wired — add LLM_TP_SIZE "
+                    ">= 2 (composed sp x tp serves int4), or use int8/bf16")
             if c.prefix_caching:
                 # Cached-prefix requests prefill their suffix through the
                 # chunk jit, which has no ring mode — the combination
@@ -193,7 +194,12 @@ class LLMServer:
                 # for models that need TP to fit (parallel/sp_runner.py).
                 runner = SPTPRunner(
                     model_cfg, params,
-                    make_mesh(sp=c.sp_size, tp=c.tp_size), **common)
+                    make_mesh(sp=c.sp_size, tp=c.tp_size),
+                    # load_params/init_params_quantized packed col leaves
+                    # with groups=tp (sharding.shard_params attestation).
+                    int4_groups=(c.tp_size if c.quantization == "int4"
+                                 else None),
+                    **common)
             else:
                 runner = SPPrefillRunner(
                     model_cfg, params, single_axis_mesh("sp", c.sp_size),
@@ -270,7 +276,12 @@ class LLMServer:
         if c.quantization in ("int8", "int4"):
             return init_params_quantized(model_cfg, 0, dtype=dtype,
                                          scheme=c.quantization,
-                                         int4_k_group=c.int4_k_group)
+                                         int4_k_group=c.int4_k_group,
+                                         # int4 x TP: unembed hybridizes to
+                                         # int8 (shape rule — llama.py).
+                                         int4_groups=(c.tp_size
+                                                      if c.quantization == "int4"
+                                                      else 1))
         return init_params(model_cfg, jax.random.key(0), dtype=dtype)
 
     def _load_params(self, model_cfg):
