@@ -61,6 +61,27 @@ log = logging.getLogger("att_tpu.server")
 PROGRESS_INTERVAL_S = 2.0
 
 
+def validate_sp_serving_config(c) -> None:
+    """Refusals for sequence-parallel serving (sp_size > 1), separated from
+    engine construction so the fail-fast paths are unit-testable without
+    building an engine."""
+    if c.quantization == "int4" and c.tp_size <= 1:
+        # sp-only int4 has no shard_map wrapper (the pallas matmul cannot
+        # ride plain GSPMD over the sp mesh); the COMPOSED sp x tp path
+        # works — QTensor4TP carries the sp axis and shards the
+        # activation's token dim (models/quant.py).
+        raise NotImplementedError(
+            "int4 x sp-only serving is not wired — add LLM_TP_SIZE "
+            ">= 2 (composed sp x tp serves int4), or use int8/bf16")
+    if c.prefix_caching:
+        # Cached-prefix requests prefill their suffix through the chunk
+        # jit, which has no ring mode — the combination would silently
+        # lose the advertised parallelism.
+        raise NotImplementedError(
+            "prefix caching x sequence-parallel serving is not wired — "
+            "unset LLM_PREFIX_CACHING with LLM_SP_SIZE")
+
+
 class LLMServer:
     """Owns engine + tokenizer + metrics; handlers are bound methods."""
 
@@ -151,21 +172,7 @@ class LLMServer:
             )
             import jax
 
-            if c.quantization == "int4" and c.tp_size <= 1:
-                # sp-only int4 has no shard_map wrapper (the pallas matmul
-                # cannot ride plain GSPMD over the sp mesh); the COMPOSED
-                # sp x tp path works — QTensor4TP carries the sp axis and
-                # shards the activation's token dim (models/quant.py).
-                raise NotImplementedError(
-                    "int4 x sp-only serving is not wired — add LLM_TP_SIZE "
-                    ">= 2 (composed sp x tp serves int4), or use int8/bf16")
-            if c.prefix_caching:
-                # Cached-prefix requests prefill their suffix through the
-                # chunk jit, which has no ring mode — the combination
-                # would silently lose the advertised parallelism.
-                raise NotImplementedError(
-                    "prefix caching x sequence-parallel serving is not "
-                    "wired — unset LLM_PREFIX_CACHING with LLM_SP_SIZE")
+            validate_sp_serving_config(c)
             # Chunked prefill would defeat sp entirely: the chunk jit has
             # no ring mode, so chunks would run replicated on every chip
             # with zero speedup — the one long-prompt pass IS the sp

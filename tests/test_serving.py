@@ -274,6 +274,25 @@ def test_profile_endpoints(server, tmp_path):
 
 
 
+def test_sp_serving_refusals():
+    """Sequence-parallel serving fail-fast paths (round 4): sp-only int4
+    and sp x prefix-caching are refused with actionable errors BEFORE any
+    engine build (server.validate_sp_serving_config)."""
+    from agentic_traffic_testing_tpu.serving.server import (
+        validate_sp_serving_config,
+    )
+
+    c = ServerConfig()
+    c.sp_size, c.quantization = 2, "int4"
+    with pytest.raises(NotImplementedError, match="sp-only"):
+        validate_sp_serving_config(c)
+    c.tp_size = 2  # composed sp x tp serves int4
+    validate_sp_serving_config(c)
+    c.quantization, c.prefix_caching = None, True
+    with pytest.raises(NotImplementedError, match="prefix caching"):
+        validate_sp_serving_config(c)
+
+
 def test_bad_weights_path_fails_fast(tmp_path):
     """A weight-load failure must abort startup, not silently serve random
     weights behind 200s (round-1 verdict weak #3)."""
