@@ -217,13 +217,17 @@ def test_sptp_int8_serving_prefill_matches_single_device(tiny_cfg, tiny_params):
     assert got.output_ids == ref.output_ids
 
 
-def test_sptp_int4_serving_matches_single_device(tiny_cfg, tiny_params):
+@pytest.mark.parametrize("kg", [0, 32])
+def test_sptp_int4_serving_matches_single_device(tiny_cfg, tiny_params, kg):
     """sp x tp x int4 (round 4): the QTensor4TP shard_map carries the sp
     axis and shards the PREFILL activation's token dim by shape, so the
     packed-nibble matmul composes with sequence parallelism — token-exact
     vs the single-chip int4 engine on the same logical weights (grouped
     and ungrouped packing dequantize identically; the lm_head hybridizes
-    to int8 under TP, mirrored in the reference params)."""
+    to int8 under TP, mirrored in the reference params). kg=32 adds
+    K-group scales: the grouped-scale axis shards with K on row-parallel
+    leaves and rides sp activation sharding unchanged — the full
+    quantization feature set under the composed mesh."""
     from agentic_traffic_testing_tpu.models.quant import (
         quantize_array,
         quantize_params,
@@ -231,15 +235,16 @@ def test_sptp_int4_serving_matches_single_device(tiny_cfg, tiny_params):
     from agentic_traffic_testing_tpu.parallel.sp_runner import SPTPRunner
 
     ecfg = EngineConfig(model="tiny", dtype="float32", quantization="int4",
-                        num_blocks=64, max_model_len=128)
+                        int4_k_group=kg, num_blocks=64, max_model_len=128)
     prompt = [(13 * i + 3) % tiny_cfg.vocab_size for i in range(53)]
     samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
 
-    q_ref = quantize_params(tiny_params, scheme="int4")
+    q_ref = quantize_params(tiny_params, scheme="int4", int4_k_group=kg)
     q_ref["unembed"] = quantize_array(tiny_params["unembed"])
     ref = LLMEngine(ecfg, model_cfg=tiny_cfg,
                     params=q_ref).generate(prompt, samp)
-    q_tp = quantize_params(tiny_params, scheme="int4", int4_groups=2)
+    q_tp = quantize_params(tiny_params, scheme="int4", int4_groups=2,
+                           int4_k_group=kg)
     runner = SPTPRunner(tiny_cfg, q_tp, make_mesh(sp=2, tp=2), int4_groups=2)
     got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(
         prompt, samp)

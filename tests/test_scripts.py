@@ -212,3 +212,28 @@ def test_health_check_reports_down_services():
     assert report["ok"] is False
     by_name = {c["check"]: c for c in report["checks"]}
     assert by_name["llm.health"]["error"] == "connection_refused"
+
+
+# --------------------------------------------------------- platform guard
+
+
+def test_platform_guard_honors_explicit_cpu(monkeypatch):
+    """force_cpu_if_requested (round 4): no-op unless JAX_PLATFORMS is
+    exactly "cpu"; when it is, the axon plugin env is stripped so
+    subprocesses cannot re-register it (the sitecustomize pin trap —
+    see agentic_traffic_testing_tpu/platform_guard.py)."""
+    from agentic_traffic_testing_tpu.platform_guard import (
+        force_cpu_if_requested,
+    )
+
+    monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+    monkeypatch.setenv("PALLAS_AXON_POOL_IPS", "10.0.0.1")
+    assert force_cpu_if_requested() is False
+    assert os.environ.get("PALLAS_AXON_POOL_IPS") == "10.0.0.1"
+
+    monkeypatch.setenv("JAX_PLATFORMS", "tpu,cpu")
+    assert force_cpu_if_requested() is False
+
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    assert force_cpu_if_requested() is True
+    assert "PALLAS_AXON_POOL_IPS" not in os.environ
