@@ -146,6 +146,26 @@ def test_sp_batched_prefill_matches_single_device(tiny_cfg, tiny_params):
     assert got == want
 
 
+def test_sp_moe_serving_prefill_matches_single_device():
+    """MoE x sp serving (round 4): the GShard dispatch/combine einsums ride
+    GSPMD over the T-sharded prefill activations (the training MoE x sp
+    step already proves the partitioning); ring attention handles the
+    attention site. Token-exact vs the single-device MoE engine."""
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
+
+    mcfg = resolve_config("tiny-moe")
+    params = init_params(mcfg, jax.random.key(9), dtype=jnp.float32)
+    ecfg = EngineConfig(model="tiny-moe", dtype="float32", num_blocks=64,
+                        max_model_len=128)
+    prompt = [(19 * i + 4) % mcfg.vocab_size for i in range(41)]
+    samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    ref = LLMEngine(ecfg, model_cfg=mcfg, params=params).generate(prompt, samp)
+    runner = SPPrefillRunner(mcfg, params, make_mesh(sp=2))
+    got = LLMEngine(ecfg, model_cfg=mcfg, runner=runner).generate(prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
 def test_sp_runner_rejects_trivial_axis(tiny_cfg, tiny_params):
     from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
 
