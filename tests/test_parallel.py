@@ -166,6 +166,93 @@ def test_sp_moe_serving_prefill_matches_single_device():
     assert got.output_ids == ref.output_ids
 
 
+def test_sptp_moe_int8_serving_matches_single_device():
+    """MoE x int8 x (sp x tp): expert weights shard over tp (QTensor specs),
+    the GShard einsums partition over sp-sharded prefill activations, ring
+    attention handles the attention site — token-exact vs single-device."""
+    from agentic_traffic_testing_tpu.models.quant import quantize_params
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPTPRunner
+
+    mcfg = resolve_config("tiny-moe")
+    params = init_params(mcfg, jax.random.key(4), dtype=jnp.float32)
+    qparams = quantize_params(params)
+    ecfg = EngineConfig(model="tiny-moe", dtype="float32", quantization="int8",
+                        num_blocks=64, max_model_len=128)
+    prompt = [(23 * i + 6) % mcfg.vocab_size for i in range(37)]
+    samp = SamplingParams(temperature=0.0, max_tokens=10, ignore_eos=True)
+
+    ref = LLMEngine(ecfg, model_cfg=mcfg, params=qparams).generate(
+        prompt, samp)
+    runner = SPTPRunner(mcfg, qparams, make_mesh(sp=2, tp=2))
+    got = LLMEngine(ecfg, model_cfg=mcfg, runner=runner).generate(
+        prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
+@pytest.mark.parametrize("topology", ["tp", "sp", "sptp"])
+@pytest.mark.parametrize("feature", ["fp8kv", "spec"])
+def test_feature_x_topology_matches_single_device(tiny_cfg, tiny_params,
+                                                  topology, feature):
+    """The README composition matrix, executable: fp8 KV pages and n-gram
+    speculation each compose with every serving topology (tp, sp, sp x tp)
+    token-exactly — the features live in the KV pool dtype and the decode
+    scan, orthogonal to how prefill/params shard."""
+    from agentic_traffic_testing_tpu.parallel.sp_runner import (
+        SPPrefillRunner,
+        SPTPRunner,
+    )
+
+    kw = (dict(kv_cache_dtype="fp8") if feature == "fp8kv"
+          else dict(speculation="ngram", spec_tokens=3))
+    ecfg = EngineConfig(model="tiny", dtype="float32", num_blocks=64,
+                        max_model_len=128, **kw)
+    prompt = ([5, 9, 11, 5, 9, 11, 5, 9, 11, 5, 9] * 3 if feature == "spec"
+              else [(29 * i + 8) % tiny_cfg.vocab_size for i in range(33)])
+    samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    spec_kw = dict(spec_tokens=3) if feature == "spec" else {}
+
+    ref = LLMEngine(ecfg, model_cfg=tiny_cfg,
+                    params=tiny_params).generate(prompt, samp)
+    if topology == "tp":
+        runner = TPRunner(tiny_cfg, tiny_params, make_mesh(tp=2), **spec_kw)
+    elif topology == "sp":
+        runner = SPPrefillRunner(tiny_cfg, tiny_params, make_mesh(sp=2),
+                                 **spec_kw)
+    else:
+        runner = SPTPRunner(tiny_cfg, tiny_params, make_mesh(sp=2, tp=2),
+                            **spec_kw)
+    got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(
+        prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
+def test_chunked_and_prefix_caching_under_tp(tiny_cfg, tiny_params):
+    """Chunked prefill and prefix caching are engine-level features that
+    must survive a TP runner unchanged: chunked output token-exact vs the
+    unchunked single-device engine, and a prefix-cache HIT (second
+    identical prompt) as exact as the miss."""
+    base = EngineConfig(model="tiny", dtype="float32", num_blocks=96,
+                        max_model_len=256)
+    prompt = [(31 * i + 9) % tiny_cfg.vocab_size for i in range(70)]
+    samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    ref = LLMEngine(base, model_cfg=tiny_cfg,
+                    params=tiny_params).generate(prompt, samp)
+
+    ec = EngineConfig(model="tiny", dtype="float32", num_blocks=96,
+                      max_model_len=256, prefill_chunk_tokens=32)
+    got = LLMEngine(ec, model_cfg=tiny_cfg,
+                    runner=TPRunner(tiny_cfg, tiny_params,
+                                    make_mesh(tp=2))).generate(prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+    ep = EngineConfig(model="tiny", dtype="float32", num_blocks=96,
+                      max_model_len=256, prefix_caching=True)
+    eng = LLMEngine(ep, model_cfg=tiny_cfg,
+                    runner=TPRunner(tiny_cfg, tiny_params, make_mesh(tp=2)))
+    assert eng.generate(prompt, samp).output_ids == ref.output_ids
+    assert eng.generate(prompt, samp).output_ids == ref.output_ids  # hit
+
+
 def test_sp_runner_rejects_trivial_axis(tiny_cfg, tiny_params):
     from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
 
