@@ -40,15 +40,16 @@ class SPPrefillRunner(ModelRunner):
     """Runner whose prefill runs ring attention over an `sp` mesh axis.
 
     Params and KV pool are replicated over the mesh (the model fits one
-    chip by assumption — otherwise compose TP, which this first cut does
-    not); only prefill activations are sequence-sharded. Decode runs the
-    jnp gather attention: replicated GSPMD execution needs an attention
-    with a partitioning rule, which the single-chip pallas DMA kernel does
-    not have (same constraint that makes TPRunner wrap it in shard_map).
+    chip by assumption — otherwise compose TP via SPTPRunner); only
+    prefill activations are sequence-sharded. Decode runs replicated: the
+    pallas DMA kernel has no GSPMD partitioning rule, so on TPU it rides
+    the same shard_map wrapper TPRunner uses — here over the SIZE-1 tp
+    axis (full heads per chip, replicated over sp) — and off-TPU the jnp
+    gather path keeps CPU-mesh tests fast (ATT_TP_ATTENTION overrides for
+    targeted interpret-mode tests).
     """
 
     kv_writer_mode = "dus"   # pallas writer has no GSPMD partitioning rule
-    attn_mode = "gather"     # decode: replicated jnp paged attention
     prefill_attn_mode = "ring_sp"
     # The chunk jit has no ring mode — chunks would run replicated with
     # zero sp speedup. LLMEngine refuses the combination at construction;
@@ -59,12 +60,21 @@ class SPPrefillRunner(ModelRunner):
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,
                  spec_ngram: int = 3) -> None:
+        from agentic_traffic_testing_tpu.parallel.tp_runner import (
+            resolve_decode_attn_mode,
+        )
+
         sp = mesh.shape[AXIS_SP]
         if sp < 2:
             raise ValueError(f"SPPrefillRunner needs an sp axis >= 2, got {sp}")
         self.mesh = mesh
         self.prefill_attn_mesh = mesh
         self.prefill_attn_axis = AXIS_SP
+        mode = resolve_decode_attn_mode()
+        self.attn_mode = mode
+        if mode == "shard_dma":
+            self.attn_mesh = mesh
+            self.attn_axis = AXIS_TP
         params = jax.device_put(params, NamedSharding(mesh, P()))
         super().__init__(cfg, params, decode_steps=decode_steps,
                          spec_tokens=spec_tokens, spec_ngram=spec_ngram)

@@ -28,6 +28,22 @@ from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
 from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
 
 
+def resolve_decode_attn_mode() -> str:
+    """Decode-attention implementation for mesh runners: shard_dma on TPU
+    (the pallas DMA kernel under jax.shard_map — plain GSPMD cannot
+    partition a pallas_call), jnp gather elsewhere (shard_dma off-TPU
+    interprets the kernel — correct but slow; ATT_TP_ATTENTION overrides
+    for targeted tests). Shared by TPRunner and the sp runners so the env
+    contract cannot drift between them."""
+    mode = os.environ.get("ATT_TP_ATTENTION")
+    if mode is None:
+        mode = "shard_dma" if jax.default_backend() == "tpu" else "gather"
+    if mode not in ("shard_dma", "gather"):
+        raise ValueError(
+            f"ATT_TP_ATTENTION={mode!r} invalid; choose shard_dma|gather")
+    return mode
+
+
 class TPRunner(ModelRunner):
     """Runner whose params/cache live sharded on a `tp` mesh axis."""
 
@@ -47,12 +63,7 @@ class TPRunner(ModelRunner):
         carry int4 QTensor4 leaves — see parallel/sharding.shard_params."""
         validate_tp(cfg, mesh.shape[AXIS_TP])
         self.mesh = mesh
-        mode = os.environ.get("ATT_TP_ATTENTION")
-        if mode is None:
-            mode = "shard_dma" if jax.default_backend() == "tpu" else "gather"
-        if mode not in ("shard_dma", "gather"):
-            raise ValueError(
-                f"ATT_TP_ATTENTION={mode!r} invalid; choose shard_dma|gather")
+        mode = resolve_decode_attn_mode()
         self.attn_mode = mode
         if mode == "shard_dma":
             self.attn_mesh = mesh

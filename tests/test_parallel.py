@@ -253,6 +253,32 @@ def test_chunked_and_prefix_caching_under_tp(tiny_cfg, tiny_params):
     assert eng.generate(prompt, samp).output_ids == ref.output_ids  # hit
 
 
+def test_sp_shard_dma_decode_matches_gather(tiny_cfg, tiny_params,
+                                            monkeypatch):
+    """SPPrefillRunner's TPU decode path (round 4): the DMA kernel under
+    shard_map over the SIZE-1 tp axis, replicated over sp — interpret mode
+    here must reproduce the gather path's greedy decode exactly."""
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
+
+    ecfg = EngineConfig(model="tiny", dtype="float32", num_blocks=64,
+                        max_model_len=128)
+    prompt = list(range(9, 41))
+    samp = SamplingParams(temperature=0.0, max_tokens=4)
+
+    monkeypatch.delenv("ATT_TP_ATTENTION", raising=False)
+    ref_runner = SPPrefillRunner(tiny_cfg, tiny_params, make_mesh(sp=2))
+    assert ref_runner.attn_mode == "gather"  # CPU default
+    ref = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=ref_runner).generate(
+        prompt, samp)
+
+    monkeypatch.setenv("ATT_TP_ATTENTION", "shard_dma")
+    runner = SPPrefillRunner(tiny_cfg, tiny_params, make_mesh(sp=2))
+    assert runner.attn_mode == "shard_dma"
+    got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(
+        prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
 def test_sp_runner_rejects_trivial_axis(tiny_cfg, tiny_params):
     from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
 
