@@ -20,6 +20,12 @@ from collections import defaultdict
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+# Honor an explicit JAX_PLATFORMS=cpu despite the axon sitecustomize
+# (wedged-tunnel hang trap - see agentic_traffic_testing_tpu/platform_guard.py).
+from agentic_traffic_testing_tpu.platform_guard import force_cpu_if_requested  # noqa: E402
+
+force_cpu_if_requested()
+
 
 def run_workload(trace_dir: str) -> None:
     import jax

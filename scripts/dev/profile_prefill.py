@@ -31,6 +31,12 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
 
+# Honor an explicit JAX_PLATFORMS=cpu despite the axon sitecustomize
+# (wedged-tunnel hang trap - see agentic_traffic_testing_tpu/platform_guard.py).
+from agentic_traffic_testing_tpu.platform_guard import force_cpu_if_requested  # noqa: E402
+
+force_cpu_if_requested()
+
 
 PEAK_FLOPS = {
     "TPU v5 lite": 197e12,

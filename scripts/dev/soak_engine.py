@@ -23,6 +23,12 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
 
+# Honor an explicit JAX_PLATFORMS=cpu despite the axon sitecustomize
+# (wedged-tunnel hang trap - see agentic_traffic_testing_tpu/platform_guard.py).
+from agentic_traffic_testing_tpu.platform_guard import force_cpu_if_requested  # noqa: E402
+
+force_cpu_if_requested()
+
 
 def main() -> None:
     import numpy as np
