@@ -75,6 +75,16 @@ def test_causal_flash_odd_bucket():
                                rtol=2e-5, atol=2e-5)
 
 
+def test_causal_flash_bf16_matches_oracle():
+    """Serving dtype: bf16 q/k/v through the kernel (f32 accumulation
+    in-kernel, output cast back) tracks the oracle within bf16 rounding."""
+    q, k, v = [x.astype(jnp.bfloat16) for x in _mk(2, 256, 8, 2, 64, seed=4)]
+    want = _oracle(q, k, v).astype(jnp.float32)
+    got = causal_flash_attention(q, k, v, interpret=True).astype(jnp.float32)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-2, atol=2e-2)
+
+
 def test_padded_tail_rows_do_not_corrupt_real_rows():
     """The site contract (ops/flash_prefill.py): padding only at the tail,
     causality alone protects real rows. Real rows' outputs must be
