@@ -180,6 +180,40 @@ def wrap_int4_tp(params: Any, mesh: Mesh) -> Any:
     return out
 
 
+def wrap_int4_replicated(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
+    """Guarded int4 wrap for runners that REPLICATE weights over the mesh
+    (sp-only serving): each chip keeps the full packed tensors, wrapped in
+    QTensor4TP over the size-1 tp axis so the matmul runs the kernel under
+    shard_map (with the prefill activation's token dim sp-sharded by shape
+    — models/quant._dense4_tp). Carries the same refusals shard_params
+    enforces on the sharded path, so a caller cannot skip them:
+
+      * int4 x MoE: the expert scan has no shard_map wrapper.
+      * TP-packed leaves (groups > 1): that byte layout is only decodable
+        as `groups` contiguous shards; wrapping it replicated would decode
+        column-permuted weights with no error (QTensor4TP's local view
+        rebuilds groups=1, bypassing the _dense4 guard).
+    """
+    from agentic_traffic_testing_tpu.models.quant import QTensor4
+
+    leaves = list(params["layers"].items()) + [
+        ("unembed", params.get("unembed"))]
+    if not any(isinstance(l, QTensor4) for _, l in leaves):
+        return params
+    if cfg.num_experts:
+        raise NotImplementedError(
+            "int4 x MoE x sp is not wired — the int4 expert scan has no "
+            "shard_map wrapper; use int8 or bf16 for MoE with LLM_SP_SIZE")
+    for key, leaf in leaves:
+        if isinstance(leaf, QTensor4) and leaf.groups != 1:
+            raise ValueError(
+                f"param {key!r} is int4-packed with groups={leaf.groups} "
+                f"(a tp={leaf.groups} byte layout) — sp-only serving "
+                f"replicates weights and needs standard packing "
+                f"(quantize_params int4_groups=1)")
+    return wrap_int4_tp(params, mesh)
+
+
 def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh,
                  int4_groups: Optional[int] = None) -> Any:
     """Shard a param tree for the mesh; quantized leaves expand their specs.

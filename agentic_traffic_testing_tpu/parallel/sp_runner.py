@@ -76,6 +76,19 @@ class SPPrefillRunner(ModelRunner):
             self.attn_mesh = mesh
             self.attn_axis = AXIS_TP
         params = jax.device_put(params, NamedSharding(mesh, P()))
+        # int4 x sp-only (round 4): the pallas matmul cannot ride plain
+        # GSPMD over the sp mesh, but the QTensor4TP shard_map wrapper
+        # works with a SIZE-1 tp axis — each chip keeps the full packed
+        # weight while the prefill activation's token dim shards over sp
+        # (shape-gated, models/quant._dense4_tp). The guarded helper
+        # refuses MoE int4 and TP-packed (groups>1) leaves — same
+        # refusals the sharded path enforces. The config this enables:
+        # 8B int4 (~4 GiB) fits one chip, sp divides a long prompt.
+        from agentic_traffic_testing_tpu.parallel.sharding import (
+            wrap_int4_replicated,
+        )
+
+        params = wrap_int4_replicated(params, cfg, mesh)
         super().__init__(cfg, params, decode_steps=decode_steps,
                          spec_tokens=spec_tokens, spec_ngram=spec_ngram)
 

@@ -45,8 +45,9 @@ class ServerConfig:
     tp_size: int = 1                           # LLM_TP_SIZE (TPU-native knob)
     # Sequence-parallel prefill degree (TPU-native knob): long-prompt
     # prefill rides ring attention over an sp mesh axis, decode unchanged
-    # (parallel/sp_runner.py). Composes with tp_size > 1 (SPTPRunner,
-    # bf16/int8 — int4's kernel shard_map covers tp only).
+    # (parallel/sp_runner.py). Composes with tp_size > 1 (SPTPRunner) and
+    # with int8/int4 on dense models (int4 via the QTensor4TP shard_map;
+    # int4 x MoE is refused — the expert scan has no shard_map wrapper).
     sp_size: int = 1                           # LLM_SP_SIZE
     quantization: Optional[str] = None         # LLM_QUANTIZATION ("int8" | "int4" | unset)
     decode_steps: Optional[int] = None         # LLM_DECODE_STEPS (None -> auto)

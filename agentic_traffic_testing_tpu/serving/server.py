@@ -64,15 +64,9 @@ PROGRESS_INTERVAL_S = 2.0
 def validate_sp_serving_config(c) -> None:
     """Refusals for sequence-parallel serving (sp_size > 1), separated from
     engine construction so the fail-fast paths are unit-testable without
-    building an engine."""
-    if c.quantization == "int4" and c.tp_size <= 1:
-        # sp-only int4 has no shard_map wrapper (the pallas matmul cannot
-        # ride plain GSPMD over the sp mesh); the COMPOSED sp x tp path
-        # works — QTensor4TP carries the sp axis and shards the
-        # activation's token dim (models/quant.py).
-        raise NotImplementedError(
-            "int4 x sp-only serving is not wired — add LLM_TP_SIZE "
-            ">= 2 (composed sp x tp serves int4), or use int8/bf16")
+    building an engine. (int4 needs no refusal on either sp mesh: sp-only
+    wraps the full packed weights in the size-1-tp shard_map, composed
+    sp x tp shards them — parallel/sp_runner.py.)"""
     if c.prefix_caching:
         # Cached-prefix requests prefill their suffix through the chunk
         # jit, which has no ring mode — the combination would silently

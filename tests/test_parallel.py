@@ -279,6 +279,46 @@ def test_sp_shard_dma_decode_matches_gather(tiny_cfg, tiny_params,
     assert got.output_ids == ref.output_ids
 
 
+def test_sp_only_int4_serving_matches_single_device(tiny_cfg, tiny_params):
+    """int4 x sp-only (round 4): each chip keeps the FULL packed weights
+    (QTensor4TP over the size-1 tp axis — standard packing, no repack)
+    while prefill tokens shard over sp. Same logical weights as the
+    single-chip int4 engine, so greedy output is token-exact."""
+    from agentic_traffic_testing_tpu.models.quant import quantize_params
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
+
+    qparams = quantize_params(tiny_params, scheme="int4")
+    ecfg = EngineConfig(model="tiny", dtype="float32", quantization="int4",
+                        num_blocks=64, max_model_len=128)
+    prompt = [(37 * i + 11) % tiny_cfg.vocab_size for i in range(67)]
+    samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+
+    ref = LLMEngine(ecfg, model_cfg=tiny_cfg,
+                    params=qparams).generate(prompt, samp)
+    runner = SPPrefillRunner(tiny_cfg, qparams, make_mesh(sp=2))
+    got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(
+        prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
+def test_sp_only_int4_guards(tiny_cfg, tiny_params):
+    """The sp-only int4 wrap keeps shard_params' refusals: TP-packed
+    leaves (groups>1 — would silently decode column-permuted replicated)
+    and MoE int4 (expert scan has no shard_map wrapper) both fail fast."""
+    from agentic_traffic_testing_tpu.models.quant import quantize_params
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
+
+    tp_packed = quantize_params(tiny_params, scheme="int4", int4_groups=2)
+    with pytest.raises(ValueError, match="groups=2"):
+        SPPrefillRunner(tiny_cfg, tp_packed, make_mesh(sp=2))
+
+    mcfg = resolve_config("tiny-moe")
+    mq = quantize_params(init_params(mcfg, jax.random.key(8),
+                                     dtype=jnp.float32), scheme="int4")
+    with pytest.raises(NotImplementedError, match="int4 x MoE x sp"):
+        SPPrefillRunner(mcfg, mq, make_mesh(sp=2))
+
+
 def test_sp_runner_rejects_trivial_axis(tiny_cfg, tiny_params):
     from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
 

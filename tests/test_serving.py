@@ -275,20 +275,17 @@ def test_profile_endpoints(server, tmp_path):
 
 
 def test_sp_serving_refusals():
-    """Sequence-parallel serving fail-fast paths (round 4): sp-only int4
-    and sp x prefix-caching are refused with actionable errors BEFORE any
-    engine build (server.validate_sp_serving_config)."""
+    """Sequence-parallel serving fail-fast paths (round 4): sp x
+    prefix-caching is refused with an actionable error BEFORE any engine
+    build; int4 passes on either sp mesh (server.validate_sp_serving_config)."""
     from agentic_traffic_testing_tpu.serving.server import (
         validate_sp_serving_config,
     )
 
     c = ServerConfig()
     c.sp_size, c.quantization = 2, "int4"
-    with pytest.raises(NotImplementedError, match="sp-only"):
-        validate_sp_serving_config(c)
-    c.tp_size = 2  # composed sp x tp serves int4
-    validate_sp_serving_config(c)
-    c.quantization, c.prefix_caching = None, True
+    validate_sp_serving_config(c)  # int4 serves on either sp mesh (round 4)
+    c.prefix_caching = True
     with pytest.raises(NotImplementedError, match="prefix caching"):
         validate_sp_serving_config(c)
 
