@@ -22,15 +22,26 @@ path keeps its own entry point (prior pages + in-register chunk have
 different validity rules — same kernel body, chunk_flash_attention). Off-
 TPU or at kernel-unfriendly shapes this falls back to the jnp oracle, so
 CPU tests and the virtual mesh see identical numerics.
+
+Escape hatch (round-4 advisor): the first-party kernel's Mosaic-specific
+behaviors (index_map clamping for DMA elision, pl.when compute skips under
+'arbitrary' kv semantics) are not exercised by interpret mode, and it
+shipped during a tunnel outage.  Until tpu_r4_validation.py passes on real
+hardware, operators can pin `ATT_PREFILL_ATTENTION=library` to route this
+site through the proven `jax.experimental.pallas.ops.tpu.flash_attention`
+library kernel (the round-3 path, preserved verbatim below), or `=jnp` for
+the oracle.  Default `flash` = first-party.
 """
 
 from __future__ import annotations
 
+import math
+import os
 from typing import Optional
 
 import jax
 
-from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
+from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention, repeat_kv
 
 
 def _flash_ok(tq: int, hd: int) -> bool:
@@ -53,11 +64,57 @@ def prefill_attention(
 ) -> jax.Array:
     """Causal self-attention for the (solo|batched) prefill layer body."""
     b, tq, h, hd = q.shape
-    if not _flash_ok(tq, hd):
+    impl = os.environ.get("ATT_PREFILL_ATTENTION", "flash")
+    if impl not in ("flash", "library", "jnp"):
+        # An unrecognized value must not silently route to the kernel the
+        # operator may be trying to avoid.
+        raise ValueError(
+            f"ATT_PREFILL_ATTENTION={impl!r}: expected flash|library|jnp")
+    if impl == "jnp" or not _flash_ok(tq, hd):
         return causal_attention(q, k, v, q_positions=q_positions,
                                 kv_valid_len=kv_valid_len)
+    if impl == "library":
+        return _library_flash_attention(q, k, v)
     from agentic_traffic_testing_tpu.ops.pallas.chunk_flash import (
         causal_flash_attention,
     )
 
     return causal_flash_attention(q, k, v).astype(q.dtype)
+
+
+def _library_flash_attention(q: jax.Array, k: jax.Array,
+                             v: jax.Array) -> jax.Array:
+    """Round-3 path: the jax.experimental TPU flash kernel, kept as the
+    ATT_PREFILL_ATTENTION=library escape hatch until the first-party kernel
+    is validated on real Mosaic tiling."""
+    from jax.experimental.pallas.ops.tpu.flash_attention import (
+        BlockSizes,
+        flash_attention,
+    )
+
+    b, tq, h, hd = q.shape
+    kh = k.shape[2]
+    # GQA via head repetition, matching repeat_kv's h // (H/KH) grouping.
+    k = repeat_kv(k, h // kh)
+    v = repeat_kv(v, h // kh)
+    # Large blocks, measured: the library defaults grid far too fine for
+    # serving shapes (2048x64: 120 ms/call default vs 3.9 ms at full-T
+    # blocks on v5e — docs/BENCHMARKS.md round-3 prefill anatomy). The
+    # kernel requires block sizes that DIVIDE tq, so take the largest
+    # power-of-two divisor (tq % 128 == 0 guarantees >= 128) capped at the
+    # measured sweet spot.
+    blk = 128
+    while blk * 2 <= 2048 and tq % (blk * 2) == 0:
+        blk *= 2
+    bs = BlockSizes(block_q=blk, block_k_major=blk, block_k=min(blk, 512),
+                    block_b=1)
+    # Kernel layout is head-major [B, H, T, hd].
+    out = flash_attention(
+        q.transpose(0, 2, 1, 3),
+        k.transpose(0, 2, 1, 3),
+        v.transpose(0, 2, 1, 3),
+        causal=True,
+        sm_scale=1.0 / math.sqrt(hd),
+        block_sizes=bs,
+    )
+    return out.transpose(0, 2, 1, 3).astype(q.dtype)

@@ -171,7 +171,25 @@ class LLMServer:
             # no ring mode, so chunks would run replicated on every chip
             # with zero speedup — the one long-prompt pass IS the sp
             # feature (memory O(T/sp) replaces the chunk path's reason to
-            # exist here).
+            # exist here). Loud, not silent: an operator who set the knob
+            # (env or CLI) must see that sp dropped it — but the config
+            # default (4096) must not warn on every sp start and train
+            # operators to ignore it. Differs-from-default catches both
+            # setting paths; explicitly re-stating exactly 4096 stays
+            # silent, an accepted edge.
+            from agentic_traffic_testing_tpu.serving.config import (
+                ServerConfig as _SC,
+            )
+            _chunk_default = _SC.__dataclass_fields__[
+                "prefill_chunk_tokens"].default
+            if ecfg.prefill_chunk_tokens and (
+                    ecfg.prefill_chunk_tokens != _chunk_default
+                    or os.environ.get("LLM_PREFILL_CHUNK_TOKENS")):
+                log.warning(
+                    "LLM_PREFILL_CHUNK_TOKENS=%d is ignored with LLM_SP_SIZE="
+                    "%d: sequence-parallel prefill runs the full prompt in "
+                    "one ring pass (chunking has no ring mode)",
+                    ecfg.prefill_chunk_tokens, c.sp_size)
             ecfg.prefill_chunk_tokens = 0
             model_cfg = resolve_config(c.model)
             if c.moe_capacity_factor is not None and model_cfg.num_experts:

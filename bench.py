@@ -3,8 +3,12 @@
 
 Prints exactly one JSON line:
     {"metric": ..., "value": N, "unit": "tok/s", "vs_baseline": N,
-     "bs8_toks_s": N, "bs8_vs_baseline": N, "roofline_frac": N,
+     "bs{S}_toks_s": N, "bs{S}_vs_baseline": N, "roofline_frac": N,
      "queue_wait_p50_s": N, "queue_wait_spread_s": [min, max], "reps": N}
+where S = BENCH_SMALL_BATCH (default 8, so the stable series is bs8_*).
+Secondary series are best-effort: the bs{S}_* keys drop when the small
+engine can't allocate, queue_wait_*/fanout_*/prefill_* drop when the
+fan-out engine can't — the headline `value` survives both.
 or, when every attempt to reach the backend fails, one structured error
 line ({"metric": null, "error": ...}) — never a bare traceback, so the
 driver's scoreboard slot is always parseable (round-3 lesson: the axon
@@ -266,44 +270,55 @@ def main() -> None:
     # The bs=8 series engine shares the runner (params + compiled
     # programs); its KV pool is explicit and small (8 lanes x ~40 blocks)
     # so it never competes with the primary engine's HBM-profiled pool.
+    # Both secondary engines allocate AFTER the primary's profiled pool, so
+    # on tight-HBM configs their pools can fail — never take down the
+    # headline for a secondary series: drop the series instead.
     small_engine = None
     if small_batch:
         blocks_needed = small_batch * (
             -(-cfg.max_model_len // cfg.block_size) + 4)
-        small_engine = LLMEngine(EngineConfig(
-            model=model,
-            dtype="bfloat16",
-            max_num_seqs=small_batch,
-            max_model_len=cfg.max_model_len,
-            num_blocks=max(512, blocks_needed),
-            decode_steps=decode_steps,
-            # Same KV dtype as the primary engine: the bs8 series must
-            # measure the same configuration the metric name advertises.
-            kv_cache_dtype=kv_cache_dtype,
-        ), model_cfg=engine.model_cfg, runner=engine.runner)
+        try:
+            small_engine = LLMEngine(EngineConfig(
+                model=model,
+                dtype="bfloat16",
+                max_num_seqs=small_batch,
+                max_model_len=cfg.max_model_len,
+                num_blocks=max(512, blocks_needed),
+                decode_steps=decode_steps,
+                # Same KV dtype as the primary engine: the small-batch
+                # series must measure the configuration its name advertises.
+                kv_cache_dtype=kv_cache_dtype,
+            ), model_cfg=engine.model_cfg, runner=engine.runner)
+        except Exception as e:
+            print(f"bench: small-batch engine dropped ({e!r})", file=sys.stderr)
 
     # Shares the throughput engine's runner too; only the KV pool and
     # scheduler limits differ.
     prefill_probe_len = int(os.environ.get("BENCH_PREFILL_LEN", "2048"))
-    fan_engine = LLMEngine(EngineConfig(
-        model=model,
-        dtype="bfloat16",
-        max_num_seqs=fanout,
-        # Covers both the fan-out TTFT probe and the solo prefill probe.
-        max_model_len=max(1024, fanout_prompt + decode_tokens + 16,
-                          prefill_probe_len + 80),
-        num_blocks=None if platform == "tpu" else 1024,
-        decode_steps=decode_steps,
-        # Concurrent long-prompt arrivals prefill in ONE batched pass (the
-        # TTFT lever); the warmup run_fanout() below compiles the single
-        # (batch, length) bucket this probe can hit. The cap must cover the
-        # PADDED bucket (pow2 ceiling), or an off-bucket prompt length would
-        # silently fall back to solo prefills.
-        prefill_batch_max_len=max(128, 1 << (fanout_prompt - 1).bit_length()),
-        # No quantization field: the shared runner already carries the
-        # (possibly quantized) params; cfg.quantization only matters when
-        # the engine builds params itself.
-    ), model_cfg=engine.model_cfg, runner=engine.runner)
+    try:
+        fan_engine = LLMEngine(EngineConfig(
+            model=model,
+            dtype="bfloat16",
+            max_num_seqs=fanout,
+            # Covers both the fan-out TTFT probe and the solo prefill probe.
+            max_model_len=max(1024, fanout_prompt + decode_tokens + 16,
+                              prefill_probe_len + 80),
+            num_blocks=None if platform == "tpu" else 1024,
+            decode_steps=decode_steps,
+            # Concurrent long-prompt arrivals prefill in ONE batched pass
+            # (the TTFT lever); the warmup run_fanout() below compiles the
+            # single (batch, length) bucket this probe can hit. The cap must
+            # cover the PADDED bucket (pow2 ceiling), or an off-bucket
+            # prompt length would silently fall back to solo prefills.
+            prefill_batch_max_len=max(
+                128, 1 << (fanout_prompt - 1).bit_length()),
+            # No quantization field: the shared runner already carries the
+            # (possibly quantized) params; cfg.quantization only matters
+            # when the engine builds params itself.
+        ), model_cfg=engine.model_cfg, runner=engine.runner)
+    except Exception as e:
+        fan_engine = None
+        print(f"bench: fan-out engine dropped ({e!r})", file=sys.stderr)
 
     def run_fanout() -> float:
         """p50 enqueue->first-token wait across `fanout` concurrent arrivals."""
@@ -343,11 +358,13 @@ def main() -> None:
     run_batch(engine, min(batch, total_requests))
     if small_engine is not None:
         run_batch(small_engine, small_batch)
-    run_fanout()
+    if fan_engine is not None:
+        run_fanout()
     # The prefill probe must never take down the headline measurement: any
     # failure (odd bucket compile, OOM on exotic configs) just drops the
     # prefill_* fields from the JSON.
-    prefill_ok = prefill_len + 64 <= fan_engine.cfg.max_model_len
+    prefill_ok = (fan_engine is not None
+                  and prefill_len + 64 <= fan_engine.cfg.max_model_len)
     if prefill_ok:
         try:
             run_prefill()
@@ -362,8 +379,9 @@ def main() -> None:
         small_runs = [run_batch(small_engine, 3 * small_batch)
                       for _ in range(reps)]
         small_values = [toks / dt for dt, toks in small_runs]
-    ttft_runs = [run_fanout() for _ in range(reps)]
-    ttft_p50 = statistics.median(ttft_runs)
+    ttft_runs = ([run_fanout() for _ in range(reps)]
+                 if fan_engine is not None else [])
+    ttft_p50 = statistics.median(ttft_runs) if ttft_runs else None
     try:
         prefill_s = (statistics.median([run_prefill() for _ in range(reps)])
                      if prefill_ok else None)
@@ -433,19 +451,26 @@ def main() -> None:
         "throughput_spread_toks_s": [round(min(values), 2), round(max(values), 2)],
         **({} if not small_values else {
             # The round-1/2-comparable operating point (same model, same
-            # prompt/decode shape, 8 lanes) so the series never breaks.
-            "bs8_batch": small_batch,
-            "bs8_toks_s": round(statistics.median(small_values), 2),
-            "bs8_vs_baseline": round(statistics.median(small_values) / nominal, 4),
-            "bs8_spread_toks_s": [round(min(small_values), 2),
-                                  round(max(small_values), 2)],
-            "bs8_roofline_frac": round(
+            # prompt/decode shape, `small_batch` lanes) so the series never
+            # breaks. Keys carry the ACTUAL batch (default bs8_*) so a
+            # BENCH_SMALL_BATCH override never mislabels its series.
+            f"bs{small_batch}_batch": small_batch,
+            f"bs{small_batch}_toks_s": round(
+                statistics.median(small_values), 2),
+            f"bs{small_batch}_vs_baseline": round(
+                statistics.median(small_values) / nominal, 4),
+            f"bs{small_batch}_spread_toks_s": [round(min(small_values), 2),
+                                               round(max(small_values), 2)],
+            f"bs{small_batch}_roofline_frac": round(
                 statistics.median(small_values) / roofline_for(small_batch), 3),
         }),
-        "queue_wait_p50_s": round(ttft_p50, 4),
-        "queue_wait_spread_s": [round(min(ttft_runs), 4), round(max(ttft_runs), 4)],
-        "fanout": fanout,
-        "fanout_prompt_tokens": fanout_prompt,
+        **({} if ttft_p50 is None else {
+            "queue_wait_p50_s": round(ttft_p50, 4),
+            "queue_wait_spread_s": [round(min(ttft_runs), 4),
+                                    round(max(ttft_runs), 4)],
+            "fanout": fanout,
+            "fanout_prompt_tokens": fanout_prompt,
+        }),
         **({} if prefill_s is None else {
             # Compute-bound half of serving (round-3 flash prefill site).
             # est_mfu counts dense matmul FLOPs (2 * non-embedding params

@@ -121,8 +121,12 @@ def run_bench(env_over: dict, tag: str, out_path: str) -> None:
     row["sweep_tag"] = tag
     with open(out_path, "a") as f:
         f.write(json.dumps(row) + "\n")
-    print(f"  {tag}: {row['value']} tok/s (bs8={row.get('bs8_toks_s')})",
-          flush=True)
+    # bench.py names the secondary series from the ACTUAL small batch
+    # (default bs8_*); derive the key so a BENCH_SMALL_BATCH override in
+    # env_over or the ambient env still prints the series.
+    sb = int(env.get("BENCH_SMALL_BATCH", "8"))  # int-parse like bench.py
+    print(f"  {tag}: {row['value']} tok/s "
+          f"(bs{sb}={row.get(f'bs{sb}_toks_s')})", flush=True)
 
 
 def sweep() -> None:

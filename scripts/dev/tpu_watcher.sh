@@ -1,0 +1,44 @@
+#!/usr/bin/env bash
+# Round-5 TPU tunnel watcher.
+#
+# The round-3/4 tunnel outages meant two consecutive rounds shipped with no
+# driver-verified TPU perf artifact (VERDICT r4 "What's missing" #1).  This
+# daemon closes the window-miss failure mode: it probes the tunnel every
+# PROBE_INTERVAL seconds and, the moment a chip answers, runs the full
+# validation + sweep batch and records timestamped artifacts under docs/
+# (docs/bench_sweep_r4.jsonl rows + a docs/bench_watcher_*.json driver-
+# semantics line) for BENCHMARKS.md and the round record.
+#
+# Usage:  nohup bash scripts/dev/tpu_watcher.sh & disown
+# Stop:   touch scripts/dev/tpu_watcher.stop
+set -u
+REPO="$(cd "$(dirname "$0")/../.." && pwd)"
+cd "$REPO"
+LOG=docs/tpu_watcher_r5.log
+STOP=scripts/dev/tpu_watcher.stop
+PROBE_INTERVAL="${PROBE_INTERVAL:-240}"
+PROBE_TIMEOUT="${PROBE_TIMEOUT:-150}"
+
+log() { echo "[$(date -u +%FT%TZ)] $*" >>"$LOG"; }
+
+log "watcher start pid=$$ interval=${PROBE_INTERVAL}s"
+while true; do
+  if [ -e "$STOP" ]; then log "stop marker seen; exiting"; exit 0; fi
+  if timeout "$PROBE_TIMEOUT" python scripts/dev/probe_tpu.py >>"$LOG" 2>&1; then
+    TS=$(date -u +%Y%m%dT%H%M%SZ)
+    log "TUNNEL UP — running validation + sweep (ts=$TS)"
+    timeout 5400 python scripts/dev/tpu_r4_validation.py --sweep \
+      >"docs/tpu_r5_validation_${TS}.log" 2>&1
+    RC=$?
+    log "validation+sweep rc=$RC (docs/tpu_r5_validation_${TS}.log)"
+    # A standalone driver-semantics bench line too, in case the sweep died
+    # partway: bench.py emits the one-line JSON the driver records.
+    timeout 2400 python bench.py >"docs/bench_watcher_${TS}.json" 2>>"$LOG"
+    log "bench rc=$? (docs/bench_watcher_${TS}.json)"
+    log "watcher done; exiting so results are not overwritten"
+    exit 0
+  else
+    log "probe: no device in ${PROBE_TIMEOUT}s"
+  fi
+  sleep "$PROBE_INTERVAL"
+done

@@ -100,3 +100,43 @@ def test_padded_tail_rows_do_not_corrupt_real_rows():
     np.testing.assert_allclose(np.asarray(got_junk[:, :real]),
                                np.asarray(got_full[:, :real]),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_prefill_attention_env_escape_hatch(monkeypatch):
+    """ATT_PREFILL_ATTENTION routes the site (round-4 advisor): `jnp`
+    forces the oracle even at kernel-eligible shapes, `library` routes to
+    the preserved jax.experimental path, default routes to the first-party
+    kernel. Routing is pinned by stubbing the two kernel targets — their
+    numerics have their own tests (and the library kernel needs Mosaic)."""
+    from agentic_traffic_testing_tpu.ops import flash_prefill
+
+    b, t, h, kh, hd = 1, 256, 4, 2, 64
+    q, k, v = _mk(b, t, h, kh, hd)
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    vlen = jnp.full((b,), t, jnp.int32)
+    want = _oracle(q, k, v)
+
+    # Make the TPU-only shape gate pass on CPU so routing is observable.
+    monkeypatch.setattr(flash_prefill, "_flash_ok", lambda tq, hd: True)
+    calls = []
+    monkeypatch.setattr(flash_prefill, "_library_flash_attention",
+                        lambda q, k, v: calls.append("library") or want)
+    import agentic_traffic_testing_tpu.ops.pallas.chunk_flash as cf
+    monkeypatch.setattr(cf, "causal_flash_attention",
+                        lambda q, k, v: calls.append("flash") or want)
+
+    monkeypatch.setenv("ATT_PREFILL_ATTENTION", "jnp")
+    got = flash_prefill.prefill_attention(q, k, v, q_positions=pos,
+                                          kv_valid_len=vlen)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+    assert calls == []
+
+    monkeypatch.setenv("ATT_PREFILL_ATTENTION", "library")
+    flash_prefill.prefill_attention(q, k, v, q_positions=pos,
+                                    kv_valid_len=vlen)
+    assert calls == ["library"]
+
+    monkeypatch.delenv("ATT_PREFILL_ATTENTION")
+    flash_prefill.prefill_attention(q, k, v, q_positions=pos,
+                                    kv_valid_len=vlen)
+    assert calls == ["library", "flash"]
