@@ -33,7 +33,69 @@ import jax
 import jax.numpy as jnp
 
 from agentic_traffic_testing_tpu.models.config import ModelConfig
-from agentic_traffic_testing_tpu.models.quant import Q4Slice, QTensor, QTensor4
+from agentic_traffic_testing_tpu.models.quant import (
+    Q4Slice,
+    QTensor,
+    QTensor4,
+    QTensor4TP,
+)
+
+
+def _expert_dense4_tp(x: jax.Array, w: QTensor4TP, base) -> jax.Array:
+    """The int4 expert scan under `jax.shard_map` over the (ep, tp) axes —
+    the round-5 wiring that closes the int4 x MoE x TP cell.
+
+    Mirrors quant._dense4_tp's Megatron split, with the expert axis
+    additionally sharded over `w.ep_axis`:
+
+      col (w_gate/w_up): x [E, B, C, K] ep-sharded on E, K replicated;
+          packed [L, E, K, N/2] group-packed (groups = tp) so each tp
+          shard is a self-contained half-paired stack; output N-sharded —
+          no collective.
+      row (w_down): x's contraction dim K additionally tp-sharded;
+          full-N partials psum over tp (per-output-column scales commute
+          with the psum, same argument as the dense row path).
+
+    Inside the shard_map every operand is local, so the body is exactly
+    the single-chip expert scan `_expert_dense4` on the local expert/
+    column shards (local packed views are self-contained groups=1
+    QTensor4s — the point of grouped packing). GSPMD turns the spec
+    mismatch with the dispatch einsum's output into the usual ICI
+    resharding collectives, just as it does for the int8 expert einsums.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    pnd, snd = w.packed.ndim, w.scale.ndim   # pnd = 4: [L, E, K, N/2]
+    ep, tp = w.ep_axis, w.axis
+    kgrouped = snd == pnd + 1                # K-group scales add one axis
+    if w.kind == "col":
+        xspec = P(ep, None, None, None)
+        pspec = P(None, ep, None, tp)
+        sspec = (P(None, ep, None, None, tp) if kgrouped
+                 else P(None, ep, None, tp))
+        ospec = P(ep, None, None, tp)
+    else:
+        xspec = P(ep, None, None, tp)
+        pspec = P(None, ep, tp, None)
+        # K-group scales shard their group axis with K; per-full-K scales
+        # replicate over tp (constant across contraction shards).
+        sspec = (P(None, ep, tp, None, None) if kgrouped
+                 else P(None, ep, None, None))
+        ospec = P(ep, None, None, None)
+    lay = jnp.asarray(0 if base is None else base, jnp.int32)
+
+    def local(x_l, p_l, s_l, lay_l):
+        stacked_l = QTensor4(p_l, s_l)   # local shard: groups=1 by design
+        w_l = stacked_l if base is None else Q4Slice(stacked_l, lay_l)
+        y = _expert_dense4(x_l, w_l)
+        return jax.lax.psum(y, tp) if w.kind == "row" else y
+
+    return jax.shard_map(
+        local, mesh=w.mesh,
+        in_specs=(xspec, pspec, sspec, P()),
+        out_specs=ospec,
+        check_vma=False,
+    )(x, w.packed, w.scale, lay)
 
 
 def _expert_dense4(x: jax.Array, w) -> jax.Array:
@@ -54,15 +116,19 @@ def _expert_dense4(x: jax.Array, w) -> jax.Array:
         stacked, base = w.stacked, w.layer
     else:
         stacked, base = w, None
+    if isinstance(stacked, QTensor4TP):
+        return _expert_dense4_tp(x, stacked, base)
     packed, scale = stacked.packed, stacked.scale
     e = x.shape[0]
     if packed.ndim == 4:                                # [L, E, K, N/2]
         packed = packed.reshape(-1, *packed.shape[2:])  # [(L*E), K, N/2]
         scale = scale.reshape(-1, *scale.shape[2:])
-    # Propagate the packing aux: a TP-grouped expert stack must still trip
-    # _dense4's global-path guard, not silently decode column-permuted
-    # (quantize_params refuses int4 x MoE x TP today, so this is defense in
-    # depth for when that wiring lands).
+    # Propagate the packing aux: a TP-grouped expert stack that reaches
+    # this GLOBAL path (e.g. a tp-packed checkpoint served single-chip
+    # without repacking) must still trip _dense4's groups guard, not
+    # silently decode column-permuted. The valid grouped consumers are the
+    # per-chip shards inside _expert_dense4_tp's shard_map, whose local
+    # views are self-contained groups=1.
     flat = QTensor4(packed=packed, scale=scale,
                     groups=getattr(stacked, "groups", 1))
 
@@ -89,7 +155,7 @@ def _expert_einsum(eq: str, x: jax.Array, w) -> jax.Array:
         y = jnp.einsum(eq, x, w.q.astype(x.dtype))
         scale = jnp.squeeze(w.scale, axis=-2)          # [E, N]
         return y * scale[:, None, None, :].astype(x.dtype)
-    if isinstance(w, (QTensor4, Q4Slice)):
+    if isinstance(w, (QTensor4, QTensor4TP, Q4Slice)):
         # Both expert einsums are expert-major batched matmuls over x's
         # last axis; eq is already encoded in the operand layout.
         return _expert_dense4(x, w)

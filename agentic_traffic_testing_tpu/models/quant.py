@@ -145,10 +145,17 @@ class QTensor4TP:
     weight shard); decode activations (S in {1..4}) stay replicated over
     sp — exactly the sp-redundant decode the composed runner documents.
     Weights carry no sp dimension either way.
+
+    `ep_axis` (round-5, int4 x MoE x TP) marks EXPERT weight stacks
+    ([L, E, K, N/2] — one leading axis more than dense stacks): their
+    expert dim shards over the named mesh axis, and the matmul routes
+    through the expert-scan shard_map in models/moe.py
+    (_expert_dense4_tp) instead of _dense4_tp.
     """
 
     def __init__(self, packed: jax.Array, scale: jax.Array, kind: str,
-                 mesh, axis: str, sp_axis: Optional[str] = None) -> None:
+                 mesh, axis: str, sp_axis: Optional[str] = None,
+                 ep_axis: Optional[str] = None) -> None:
         if kind not in ("col", "row"):
             raise ValueError(f"kind={kind!r}; choose col|row")
         self.packed = packed
@@ -157,10 +164,11 @@ class QTensor4TP:
         self.mesh = mesh
         self.axis = axis
         self.sp_axis = sp_axis
+        self.ep_axis = ep_axis
 
     def tree_flatten(self):
         return ((self.packed, self.scale),
-                (self.kind, self.mesh, self.axis, self.sp_axis))
+                (self.kind, self.mesh, self.axis, self.sp_axis, self.ep_axis))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -466,14 +474,10 @@ def quantize_params(params: dict, delete_originals: bool = False,
     """
     if scheme not in ("int8", "int4"):
         raise ValueError(f"unknown quantization scheme {scheme!r}")
-    if (scheme == "int4" and int4_groups > 1
-            and "w_router" in params.get("layers", {})):
-        # int4 expert weights run the kernel inside a scan over experts
-        # (models/moe.py _expert_dense4) — a pallas path GSPMD cannot
-        # partition, and no shard_map wrapper exists for it yet.
-        raise NotImplementedError(
-            "int4 x MoE x TP is not wired — serve MoE int4 single-chip, "
-            "or int8 for tensor-parallel MoE")
+    # int4 x MoE x TP (round 5): expert stacks [L, E, K, N] pack exactly
+    # like dense leaves — col experts (w_gate/w_up) group-wise over their
+    # output dim, w_down standard — and serve through the expert-scan
+    # shard_map (models/moe.py _expert_dense4_tp).
 
     def qfn(w, key=None):
         if scheme == "int8":

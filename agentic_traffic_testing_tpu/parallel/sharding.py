@@ -172,8 +172,12 @@ def wrap_int4_tp(params: Any, mesh: Mesh) -> Any:
         kind = TP_KIND.get(key)
         if kind is None or not isinstance(leaf, QTensor4):
             return leaf
+        # Expert stacks ([L, E, K, N/2] — one leading axis more than a
+        # dense stack's [L, K, N/2]) carry the ep axis; models/moe.py
+        # routes them through the expert-scan shard_map.
+        ep_axis = AXIS_EP if leaf.packed.ndim == 4 else None
         return QTensor4TP(leaf.packed, leaf.scale, kind, mesh, AXIS_TP,
-                          sp_axis=sp_axis)
+                          sp_axis=sp_axis, ep_axis=ep_axis)
 
     out = {k: wrap(k, v) for k, v in params.items() if k != "layers"}
     out["layers"] = {k: wrap(k, v) for k, v in params["layers"].items()}
@@ -188,7 +192,9 @@ def wrap_int4_replicated(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
     — models/quant._dense4_tp). Carries the same refusals shard_params
     enforces on the sharded path, so a caller cannot skip them:
 
-      * int4 x MoE: the expert scan has no shard_map wrapper.
+      * int4 x MoE: the expert shard_map (models/moe.py
+        _expert_dense4_tp) serves SHARDED expert stacks on (ep, tp)
+        meshes; the sp-only replicated wrap is not wired to it.
       * TP-packed leaves (groups > 1): that byte layout is only decodable
         as `groups` contiguous shards; wrapping it replicated would decode
         column-permuted weights with no error (QTensor4TP's local view
@@ -202,8 +208,10 @@ def wrap_int4_replicated(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
         return params
     if cfg.num_experts:
         raise NotImplementedError(
-            "int4 x MoE x sp is not wired — the int4 expert scan has no "
-            "shard_map wrapper; use int8 or bf16 for MoE with LLM_SP_SIZE")
+            "int4 x MoE x sp is not wired — the expert shard_map "
+            "(models/moe.py _expert_dense4_tp) serves (ep, tp) meshes, "
+            "not the sp replicated wrap; use int8 or bf16 for MoE with "
+            "LLM_SP_SIZE")
     for key, leaf in leaves:
         if isinstance(leaf, QTensor4) and leaf.groups != 1:
             raise ValueError(
@@ -233,16 +241,6 @@ def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh,
     has_int4 = any(isinstance(l, QTensor4)
                    for l in list(params["layers"].values())
                    + [params.get("unembed")])
-    sharded = tp > 1 or dict(mesh.shape).get(AXIS_EP, 1) > 1
-    if sharded and cfg.num_experts and any(
-            isinstance(l, QTensor4)
-            for l in params["layers"].values()):
-        # Before any device_put: the int4 expert path is a pallas scan
-        # (models/moe.py _expert_dense4) with no shard_map wrapper, and
-        # quantize_params likewise refuses int4_groups>1 for MoE trees.
-        raise NotImplementedError(
-            "int4 x MoE x TP is not wired — serve MoE int4 single-chip, "
-            "or int8 for tensor-parallel MoE")
     if tp > 1 and has_int4 and int4_groups != tp:
         raise ValueError(
             f"int4 x TP requires grouped packing: quantize with "
@@ -267,7 +265,13 @@ def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh,
                 f"quantize_params(..., int4_groups={tp if tp > 1 else 1})")
     specs = expand_quant_specs(params, param_pspecs(cfg))
     params = shard_pytree(params, specs, mesh)
-    if tp > 1:
+    has_int4_experts = any(isinstance(l, QTensor4) and l.packed.ndim == 4
+                           for l in params["layers"].values())
+    # Wrap on tp>1 as before; ALSO on an ep-sharded mesh with int4 expert
+    # stacks (tp may be 1): the expert scan is a pallas path GSPMD cannot
+    # partition, so it must run under the expert shard_map
+    # (models/moe.py _expert_dense4_tp) whenever its operands are sharded.
+    if tp > 1 or (dict(mesh.shape).get(AXIS_EP, 1) > 1 and has_int4_experts):
         params = wrap_int4_tp(params, mesh)
     return params
 
