@@ -457,6 +457,9 @@ def prefill_chunk_impl(
     chunk_start: jax.Array,   # scalar i32 — absolute position of tokens[0, 0]
     chunk_len: jax.Array,     # scalar i32 — real (unpadded) tokens in this chunk
     kv_writer_mode: Optional[str] = None,
+    attn_mode: Optional[str] = None,       # static; None=auto | "ring_sp"
+    attn_mesh=None,           # static Mesh + axis for attn_mode="ring_sp"
+    attn_axis: Optional[str] = None,
 ) -> tuple[jax.Array, KVCache]:
     """One chunk of a chunked prefill. Returns (last-chunk-token logits
     [1, V] fp32 — meaningful only on the final chunk — and the updated cache).
@@ -468,6 +471,14 @@ def prefill_chunk_impl(
     with the table-column offset chunk_start // block_size. The capability
     lives inside vLLM for the reference (enable_chunked_prefill); here it is
     first-party.
+
+    `attn_mode="ring_sp"` (round 5 — prefix caching x sp) swaps the
+    attention site for the chunk-ring hybrid: the chunk's token dim shards
+    over the `attn_axis` mesh axis (ring rounds at positions offset by
+    chunk_start) while the gathered prior pages stay replicated and seed
+    each chip's streaming softmax (ops/ring_attention.py
+    make_sp_chunk_attention). Everything else is per-token math GSPMD
+    shards from the input sharding, as in prefill_impl's ring mode.
     """
     b, c = tokens.shape
     if b != 1:
@@ -481,10 +492,41 @@ def prefill_chunk_impl(
     sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
     hd = cfg.head_dim_
 
-    # KV geometry: [prior pages (gathered, valid below chunk_start)] ++
-    # [this chunk in-register (causal via positions, valid below chunk_len)].
-    # Callers bound `w` to a bucketed prior width (engine._run_chunk), so
-    # early chunks don't pay attention over max_model_len worth of slots.
+    if attn_mode == "ring_sp":
+        from agentic_traffic_testing_tpu.ops.ring_attention import (
+            make_sp_chunk_attention,
+        )
+
+        sp = attn_mesh.shape[attn_axis]
+        if c % sp != 0:
+            raise ValueError(
+                f"sp chunk prefill needs C % sp == 0; got C={c}, sp={sp} "
+                f"(chunk buckets are block-aligned powers of two — this "
+                f"means the bucket ladder and the sp degree disagree)")
+        ring_chunk = make_sp_chunk_attention(attn_mesh, sp_axis=attn_axis)
+
+        def attn_site(q, k, v, li):
+            # Tail padding is safe by causality (padded suffix slots sit
+            # at positions past every real query); rows past chunk_len
+            # produce garbage nothing reads, as in the flash site.
+            k_prior = kvc.gather_kv(
+                jax.lax.dynamic_index_in_dim(cache.k, li, 0, keepdims=False),
+                block_tables)[..., :hd].astype(k.dtype)
+            v_prior = kvc.gather_kv(
+                jax.lax.dynamic_index_in_dim(cache.v, li, 0, keepdims=False),
+                block_tables)[..., :hd].astype(v.dtype)
+            return ring_chunk(q, k, v, k_prior, v_prior, chunk_start)
+
+        return _prefill_chunk_tail(params, cfg, x, sin, cos, attn_site,
+                                   cache, block_tables, chunk_start,
+                                   chunk_len, kv_writer_mode, bs)
+
+    # KV geometry (gather site): [prior pages (gathered, valid below
+    # chunk_start)] ++ [this chunk in-register (causal via positions,
+    # valid below chunk_len)]. Callers bound `w` to a bucketed prior width
+    # (engine._run_chunk), so early chunks don't pay attention over
+    # max_model_len worth of slots. The ring site above owes none of this:
+    # its prior validity lives in ring_attention's prior_len.
     page_positions = jnp.arange(w * bs, dtype=jnp.int32)[None]
     kv_positions = jnp.concatenate([page_positions, positions], axis=1)
     kv_mask = jnp.concatenate(
@@ -527,6 +569,16 @@ def prefill_chunk_impl(
             kv_valid_mask=kv_mask,
         )
 
+    return _prefill_chunk_tail(params, cfg, x, sin, cos, attn_site, cache,
+                               block_tables, chunk_start, chunk_len,
+                               kv_writer_mode, bs)
+
+
+def _prefill_chunk_tail(params, cfg: ModelConfig, x, sin, cos, attn_site,
+                        cache: KVCache, block_tables, chunk_start, chunk_len,
+                        kv_writer_mode, bs):
+    """Shared chunk-prefill tail: layer scan, offset page write, last-real-
+    token unembed (both the gather site and the round-5 ring site)."""
     xs_layers, held = _scan_split(params["layers"])
 
     def body(x, xs):

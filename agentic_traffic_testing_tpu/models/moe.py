@@ -85,7 +85,9 @@ def _expert_dense4_tp(x: jax.Array, w: QTensor4TP, base) -> jax.Array:
     lay = jnp.asarray(0 if base is None else base, jnp.int32)
 
     def local(x_l, p_l, s_l, lay_l):
-        stacked_l = QTensor4(p_l, s_l)   # local shard: groups=1 by design
+        # Local shard: groups=1 on tp>1 meshes by the attestation; the
+        # global grouped layout on a size-1 tp axis (replicated wrap).
+        stacked_l = QTensor4(p_l, s_l, groups=w.local_groups)
         w_l = stacked_l if base is None else Q4Slice(stacked_l, lay_l)
         y = _expert_dense4(x_l, w_l)
         return jax.lax.psum(y, tp) if w.kind == "row" else y
@@ -125,10 +127,8 @@ def _expert_dense4(x: jax.Array, w) -> jax.Array:
         scale = scale.reshape(-1, *scale.shape[2:])
     # Propagate the packing aux: a TP-grouped expert stack that reaches
     # this GLOBAL path (e.g. a tp-packed checkpoint served single-chip
-    # without repacking) must still trip _dense4's groups guard, not
-    # silently decode column-permuted. The valid grouped consumers are the
-    # per-chip shards inside _expert_dense4_tp's shard_map, whose local
-    # views are self-contained groups=1.
+    # without repacking) decodes per contiguous group in _dense4 — losing
+    # the aux here would silently decode column-permuted weights instead.
     flat = QTensor4(packed=packed, scale=scale,
                     groups=getattr(stacked, "groups", 1))
 

@@ -155,7 +155,7 @@ class QTensor4TP:
 
     def __init__(self, packed: jax.Array, scale: jax.Array, kind: str,
                  mesh, axis: str, sp_axis: Optional[str] = None,
-                 ep_axis: Optional[str] = None) -> None:
+                 ep_axis: Optional[str] = None, groups: int = 1) -> None:
         if kind not in ("col", "row"):
             raise ValueError(f"kind={kind!r}; choose col|row")
         self.packed = packed
@@ -165,10 +165,25 @@ class QTensor4TP:
         self.axis = axis
         self.sp_axis = sp_axis
         self.ep_axis = ep_axis
+        # The GLOBAL packing layout (QTensor4.groups). Each chip's local
+        # view is itself grouped with groups/tp (col leaves; the
+        # attestation makes that 1 on tp>1 meshes) or groups (row leaves
+        # and the size-1-tp replicated wrap, where the "shard" is the
+        # whole grouped tensor).
+        self.groups = groups
+
+    @property
+    def local_groups(self) -> int:
+        # max(1, ...): layout-free groups=1 col leaves (random init) on a
+        # tp>1 mesh must stay 1, never 0.
+        tp_size = dict(self.mesh.shape).get(self.axis, 1)
+        return (max(1, self.groups // tp_size) if self.kind == "col"
+                else self.groups)
 
     def tree_flatten(self):
         return ((self.packed, self.scale),
-                (self.kind, self.mesh, self.axis, self.sp_axis, self.ep_axis))
+                (self.kind, self.mesh, self.axis, self.sp_axis, self.ep_axis,
+                 self.groups))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -245,19 +260,24 @@ def _dense4(x: jax.Array, w: QTensor4, layer=None) -> jax.Array:
     from agentic_traffic_testing_tpu.ops.pallas.int4_matmul import int4_matmul
 
     if w.groups > 1:
-        # Both branches below assume full-N half pairing: the kernel pairs
-        # column j with j + N/2, and _unpack4 concatenates [lo, hi] across
-        # the full width. A groups>1 tensor (TP byte layout) decodes to
-        # column-PERMUTED weights here — e.g. a tp-packed checkpoint loaded
-        # single-chip. Refuse loudly; the valid consumers are the per-chip
-        # shards under QTensor4TP's shard_map, whose local tensors are
-        # self-contained groups=1 views.
-        raise ValueError(
-            f"QTensor4 packed with groups={w.groups} reached the global "
-            f"int4 matmul path — this byte layout is only decodable as "
-            f"{w.groups} contiguous TP shards (QTensor4TP under shard_map). "
-            f"Serve it with tp_size={w.groups}, or repack with "
-            f"quantize_params(..., int4_groups=1) for single-chip use.")
+        # TP byte layout served GLOBALLY (round 5 — e.g. a tp-packed 70B
+        # checkpoint on a single chip or an sp-only long-context mesh,
+        # without repacking): group g's packed slice [..., g*hg:(g+1)*hg]
+        # is itself a well-formed half-paired groups=1 QTensor4 over the
+        # CONTIGUOUS logical columns [g*ng, (g+1)*ng) — that locality is
+        # the whole point of grouped packing — and the split-by-half scale
+        # rows are laid out group-major, so the same slice of the scale's
+        # last dim belongs to it (quantize_array4). Decompose and recurse:
+        # each slice takes the kernel or fallback by its own shape.
+        hg = w.packed.shape[-1] // w.groups
+        outs = []
+        for g in range(w.groups):
+            sl = slice(g * hg, (g + 1) * hg)
+            # The scale's last dim is N/2 in both the per-full-K and the
+            # K-group layout, so the same slice applies.
+            wg = QTensor4(w.packed[..., sl], w.scale[..., sl], groups=1)
+            outs.append(_dense4(x, wg, layer=layer))
+        return jnp.concatenate(outs, axis=-1)
 
     *lead, k = x.shape
     rows = 1
@@ -329,7 +349,7 @@ def _dense4_tp(x: jax.Array, w: QTensor4TP, layer=None) -> jax.Array:
     lay = jnp.asarray(0 if layer is None else layer, jnp.int32)
 
     def local(x_l, p_l, s_l, lay_l):
-        y = _dense4(x_l, QTensor4(p_l, s_l),
+        y = _dense4(x_l, QTensor4(p_l, s_l, groups=w.local_groups),
                     layer=None if layer is None else lay_l)
         return jax.lax.psum(y, w.axis) if w.kind == "row" else y
 

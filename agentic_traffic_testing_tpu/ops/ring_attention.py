@@ -39,6 +39,8 @@ def ring_attention(
     axis_name: str,
     scale: Optional[float] = None,
     kv_block: int = 1024,
+    pos_offset: Optional[jax.Array] = None,
+    prior: Optional[tuple] = None,
 ) -> jax.Array:
     """Exact causal attention over an `axis_name`-sharded sequence.
 
@@ -54,6 +56,17 @@ def ring_attention(
     cured on the single-chip path. Exact either way; sub-blocking only
     engages when it divides Tl (serving/training shard lengths are powers
     of two).
+
+    Chunk-ring hybrid (round 5 — prefix caching x sp): `pos_offset`
+    (traced scalar) shifts the ring's global positions so the sharded
+    tokens are a SUFFIX starting at that absolute position, and `prior`
+    = (k_prior, v_prior, prior_len) seeds the streaming softmax with a
+    REPLICATED already-cached segment at absolute positions 0..W (valid
+    where position < prior_len) before the ring rounds run. The prior
+    fold streams the same kv_block sub-blocks and costs no collective —
+    the pages are replicated on sp serving meshes. Exactness argument is
+    unchanged: one online softmax over [prior ++ suffix], same f32
+    accumulation.
     """
     b, tl, h, hd = q.shape
     kh = k.shape[2]
@@ -71,15 +84,18 @@ def ring_attention(
         kb = tl
 
     qf = q.astype(jnp.float32) * scale
-    q_pos = my * tl + jnp.arange(tl, dtype=jnp.int32)          # [Tl] global
+    off = jnp.int32(0) if pos_offset is None else pos_offset.astype(jnp.int32)
+    q_pos = off + my * tl + jnp.arange(tl, dtype=jnp.int32)    # [Tl] global
 
     perm = [(j, (j + 1) % sp) for j in range(sp)]
 
-    def fold(state, kf, vf, kv_pos):
+    def fold(state, kf, vf, kv_pos, kv_valid=None):
         """One streaming-softmax update over a [B, kb, H, hd] kv block."""
         m, l, acc = state
         logits = jnp.einsum("bqhd,bkhd->bhqk", qf, kf)         # [B,H,Tl,kb]
         mask = kv_pos[None, None, None, :] <= q_pos[None, None, :, None]
+        if kv_valid is not None:
+            mask = mask & kv_valid[None, None, None, :]
         logits = jnp.where(mask, logits, NEG)
 
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))        # [B,H,Tl]
@@ -102,13 +118,13 @@ def ring_attention(
         # on chip (my - step) mod sp.
         src = (my - step) % sp
         if kb == tl:
-            kv_pos = src * tl + jnp.arange(tl, dtype=jnp.int32)  # [Tl] global
+            kv_pos = off + src * tl + jnp.arange(tl, dtype=jnp.int32)  # global
             return fold(state, kf, vf, kv_pos)
 
         def sub(carry, i):
             ks = jax.lax.dynamic_slice_in_dim(kf, i * kb, kb, axis=1)
             vs = jax.lax.dynamic_slice_in_dim(vf, i * kb, kb, axis=1)
-            kv_pos = src * tl + i * kb + jnp.arange(kb, dtype=jnp.int32)
+            kv_pos = off + src * tl + i * kb + jnp.arange(kb, dtype=jnp.int32)
             return fold(carry, ks, vs, kv_pos), None
 
         state, _ = jax.lax.scan(
@@ -127,6 +143,31 @@ def ring_attention(
         jnp.zeros((b, h, tl), jnp.float32),
         jnp.zeros((b, h, tl, hd), jnp.float32),
     )
+    if prior is not None:
+        # Seed the softmax with the replicated cached segment (absolute
+        # positions 0..W, valid below prior_len). Causality vs the suffix
+        # queries is automatic (every valid prior position < prior_len <=
+        # off <= q_pos), but the validity mask itself is load-bearing:
+        # gathered page widths run past the cached length.
+        k_prior, v_prior, prior_len = prior
+        kpf = repeat_kv(k_prior, h // kh).astype(jnp.float32)
+        vpf = repeat_kv(v_prior, h // kh).astype(jnp.float32)
+        w = k_prior.shape[1]
+        pb = min(kv_block, w)
+        while pb > 1 and w % pb:
+            pb //= 2
+        if pb == 1 and w > 1:
+            pb = w
+
+        def prior_sub(carry, i):
+            ks = jax.lax.dynamic_slice_in_dim(kpf, i * pb, pb, axis=1)
+            vs = jax.lax.dynamic_slice_in_dim(vpf, i * pb, pb, axis=1)
+            kv_pos = i * pb + jnp.arange(pb, dtype=jnp.int32)
+            return fold(carry, ks, vs, kv_pos,
+                        kv_valid=kv_pos < prior_len), None
+
+        state0, _ = jax.lax.scan(
+            prior_sub, state0, jnp.arange(w // pb, dtype=jnp.int32))
     # sp-1 (rotate, accumulate) rounds, then fold the last shard without the
     # wasted final rotation.
     (k_last, v_last, state), _ = jax.lax.scan(
@@ -163,6 +204,44 @@ def make_sp_prefill_attention(mesh: Mesh, *, sp_axis: str = "sp",
     )
     def attn(q, k, v):
         return ring_attention(q, k, v, axis_name=sp_axis, kv_block=kv_block)
+
+    return attn
+
+
+def make_sp_chunk_attention(mesh: Mesh, *, sp_axis: str = "sp",
+                            tp_axis: str = "tp", kv_block: int = 1024):
+    """Chunk-ring hybrid for the CACHED-SUFFIX prefill site (round 5 —
+    prefix caching x sequence-parallel serving).
+
+    A prefix-cache hit prefills only the prompt's suffix; that suffix
+    attends to [cached pages ++ itself causally]. Here the suffix tokens
+    shard over `sp_axis` (ring rounds exactly as in the full-prompt
+    adapter, positions offset by `chunk_start`) while the already-cached
+    pages stay REPLICATED — they live in the replicated KV pool on sp
+    serving meshes, so seeding each chip's streaming softmax with them
+    costs no collective (ring_attention's `prior` segment). Heads ride
+    `tp_axis` (size 1 on sp-only meshes), mirroring the other adapters.
+
+    attn(q, k, v, k_prior, v_prior, chunk_start): q/k/v [B, C, H|KH, hd]
+    sharded on their token dim (C % sp == 0 — serving chunk buckets are
+    block-aligned powers of two); k_prior/v_prior [B, W, KH, hd] gathered
+    pages, valid below `chunk_start` (traced scalar).
+    """
+    qs = P(None, sp_axis, tp_axis, None)
+    ps = P(None, None, tp_axis, None)
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(qs, qs, qs, ps, ps, P()),
+        out_specs=qs,
+        check_vma=False,
+    )
+    def attn(q, k, v, k_prior, v_prior, chunk_start):
+        return ring_attention(
+            q, k, v, axis_name=sp_axis, kv_block=kv_block,
+            pos_offset=chunk_start,
+            prior=(k_prior, v_prior, chunk_start))
 
     return attn
 

@@ -177,7 +177,8 @@ def wrap_int4_tp(params: Any, mesh: Mesh) -> Any:
         # routes them through the expert-scan shard_map.
         ep_axis = AXIS_EP if leaf.packed.ndim == 4 else None
         return QTensor4TP(leaf.packed, leaf.scale, kind, mesh, AXIS_TP,
-                          sp_axis=sp_axis, ep_axis=ep_axis)
+                          sp_axis=sp_axis, ep_axis=ep_axis,
+                          groups=leaf.groups)
 
     out = {k: wrap(k, v) for k, v in params.items() if k != "layers"}
     out["layers"] = {k: wrap(k, v) for k, v in params["layers"].items()}
@@ -189,16 +190,27 @@ def wrap_int4_replicated(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
     (sp-only serving): each chip keeps the full packed tensors, wrapped in
     QTensor4TP over the size-1 tp axis so the matmul runs the kernel under
     shard_map (with the prefill activation's token dim sp-sharded by shape
-    — models/quant._dense4_tp). Carries the same refusals shard_params
-    enforces on the sharded path, so a caller cannot skip them:
+    — models/quant._dense4_tp).
 
-      * int4 x MoE: the expert shard_map (models/moe.py
-        _expert_dense4_tp) serves SHARDED expert stacks on (ep, tp)
-        meshes; the sp-only replicated wrap is not wired to it.
-      * TP-packed leaves (groups > 1): that byte layout is only decodable
-        as `groups` contiguous shards; wrapping it replicated would decode
-        column-permuted weights with no error (QTensor4TP's local view
-        rebuilds groups=1, bypassing the _dense4 guard).
+    Replication (not weight sharding) is a deliberate design for sp-only
+    meshes, not a gap. sp-only presumes the model fits one chip — the 8B
+    int4 profile is ~4 GiB of a 16 GiB v5e, leaving ~11 GiB of KV pages
+    per chip either way, because per-chip HBM (not pod-total bytes) is
+    the serving constraint. Sharding weights over sp (ZeRO-3 style) would
+    save 3 GiB/chip at sp=4 but turn every decode step's weight read into
+    an ICI all-gather: ~45-90 GB/s per v5e link vs the ~700 GB/s measured
+    HBM stream (docs/BENCHMARKS.md decode anatomy) — an order of
+    magnitude off the weight-streaming bound that decode lives on. Models
+    that need sharding to FIT take the sp x tp mesh (SPTPRunner), where
+    int4 shards for real under the grouped-packing contract.
+
+    Remaining refusal, kept from the sharded path: int4 x MoE — the
+    expert shard_map (models/moe.py _expert_dense4_tp) serves SHARDED
+    expert stacks on (ep, tp) meshes and is not wired to the replicated
+    wrap. TP-packed leaves (groups > 1) are ACCEPTED as of round 5: the
+    wrap propagates the packing aux and the global matmul path decodes
+    grouped layouts per contiguous group (models/quant._dense4), so a
+    tp-packed checkpoint serves on an sp mesh without repacking.
     """
     from agentic_traffic_testing_tpu.models.quant import QTensor4
 
@@ -212,13 +224,6 @@ def wrap_int4_replicated(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
             "(models/moe.py _expert_dense4_tp) serves (ep, tp) meshes, "
             "not the sp replicated wrap; use int8 or bf16 for MoE with "
             "LLM_SP_SIZE")
-    for key, leaf in leaves:
-        if isinstance(leaf, QTensor4) and leaf.groups != 1:
-            raise ValueError(
-                f"param {key!r} is int4-packed with groups={leaf.groups} "
-                f"(a tp={leaf.groups} byte layout) — sp-only serving "
-                f"replicates weights and needs standard packing "
-                f"(quantize_params int4_groups=1)")
     return wrap_int4_tp(params, mesh)
 
 
@@ -253,16 +258,19 @@ def shard_params(params: Any, cfg: ModelConfig, mesh: Mesh,
             ("tok_embed", params.get("tok_embed"))]:
         if not isinstance(leaf, QTensor4) or leaf.groups == 1:
             continue
-        # Recorded packing contradicts the target layout: a groups=g byte
-        # layout is only decodable as g contiguous column shards, so it must
-        # be a column-parallel leaf on a tp=g mesh — anything else (tp=1
-        # serving of a TP-packed checkpoint, tp degree mismatch, a grouped
-        # row/embed leaf) would decode column-permuted weights.
-        if TP_KIND.get(key) != "col" or leaf.groups != tp:
+        # Recorded packing must agree with the target layout when the
+        # weight is actually SHARDED: a groups=g byte layout splits into
+        # exactly g contiguous column shards, so on a tp>1 mesh it must be
+        # a column-parallel leaf with groups == tp. On tp=1 meshes (single
+        # chip, sp-only replication) grouped leaves are fine — the global
+        # matmul path decodes them per contiguous group (round 5,
+        # models/quant._dense4), so tp-packed checkpoints serve without
+        # repacking.
+        if tp > 1 and (TP_KIND.get(key) != "col" or leaf.groups != tp):
             raise ValueError(
                 f"param {key!r} is int4-packed with groups={leaf.groups}, "
                 f"which cannot be served on a tp={tp} mesh — repack with "
-                f"quantize_params(..., int4_groups={tp if tp > 1 else 1})")
+                f"quantize_params(..., int4_groups={tp})")
     specs = expand_quant_specs(params, param_pspecs(cfg))
     params = shard_pytree(params, specs, mesh)
     has_int4_experts = any(isinstance(l, QTensor4) and l.packed.ndim == 4

@@ -51,11 +51,16 @@ class SPPrefillRunner(ModelRunner):
 
     kv_writer_mode = "dus"   # pallas writer has no GSPMD partitioning rule
     prefill_attn_mode = "ring_sp"
-    # The chunk jit has no ring mode — chunks would run replicated with
-    # zero sp speedup. LLMEngine refuses the combination at construction;
-    # serve with prefill_chunk_tokens=0 (one sharded long-prompt pass is
-    # the sp feature).
-    supports_chunked_prefill = False
+    # Round 5: the chunk jit rides the chunk-ring hybrid — the chunk's
+    # token dim shards over sp while gathered prior pages (replicated pool)
+    # seed each chip's streaming softmax (models/llama.prefill_chunk_impl,
+    # ops/ring_attention.make_sp_chunk_attention). This is what makes
+    # prefix caching compose with sp: cache-hit suffixes prefill sharded.
+    # The server still zeroes prefill_chunk_tokens under sp (one sharded
+    # long-prompt pass beats chunking there), but the path is faithful if
+    # an operator chunks deliberately.
+    chunk_attn_mode = "ring_sp"
+    supports_chunked_prefill = True
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,
@@ -81,9 +86,10 @@ class SPPrefillRunner(ModelRunner):
         # works with a SIZE-1 tp axis — each chip keeps the full packed
         # weight while the prefill activation's token dim shards over sp
         # (shape-gated, models/quant._dense4_tp). The guarded helper
-        # refuses MoE int4 and TP-packed (groups>1) leaves — same
-        # refusals the sharded path enforces. The config this enables:
-        # 8B int4 (~4 GiB) fits one chip, sp divides a long prompt.
+        # refuses MoE int4; TP-packed (groups>1) checkpoints are ACCEPTED
+        # since round 5 (the global matmul decodes them per contiguous
+        # group). The config this enables: 8B int4 (~4 GiB) fits one
+        # chip, sp divides a long prompt.
         from agentic_traffic_testing_tpu.parallel.sharding import (
             wrap_int4_replicated,
         )
@@ -121,7 +127,8 @@ class SPTPRunner(TPRunner):
     """
 
     prefill_attn_mode = "ring_sp"
-    supports_chunked_prefill = False
+    chunk_attn_mode = "ring_sp"   # chunk-ring hybrid, heads tp-sharded
+    supports_chunked_prefill = True
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

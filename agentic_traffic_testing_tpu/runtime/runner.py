@@ -87,12 +87,16 @@ def _prefill_sample_impl(params, cfg: ModelConfig, tokens, cache, block_tables,
 def _prefill_chunk_sample_impl(params, cfg: ModelConfig, tokens, cache,
                                block_tables, chunk_start, chunk_len,
                                samp: SamplingArrays, steps,
-                               kv_writer_mode=None):
+                               kv_writer_mode=None, attn_mode=None,
+                               attn_mesh=None, attn_axis=None):
     """One chunk of a chunked prefill + sampling of the chunk's last token
     (the sample only matters on the final chunk; earlier chunks discard it)."""
     logits, cache = prefill_chunk_impl(params, cfg, tokens, cache,
                                        block_tables, chunk_start, chunk_len,
-                                       kv_writer_mode=kv_writer_mode)
+                                       kv_writer_mode=kv_writer_mode,
+                                       attn_mode=attn_mode,
+                                       attn_mesh=attn_mesh,
+                                       attn_axis=attn_axis)
     keys = make_row_keys(samp.seeds, steps)
     out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
     return cache, out
@@ -202,7 +206,10 @@ class ModelRunner:
         )
         self._prefill_chunk = jax.jit(
             partial(_prefill_chunk_sample_impl, cfg=cfg,
-                    kv_writer_mode=self.kv_writer_mode),
+                    kv_writer_mode=self.kv_writer_mode,
+                    attn_mode=self.chunk_attn_mode,
+                    attn_mesh=self.prefill_attn_mesh,
+                    attn_axis=self.prefill_attn_axis),
             donate_argnames=("cache",),
         )
         if self.spec_tokens > 0:
@@ -240,9 +247,14 @@ class ModelRunner:
     prefill_attn_mode: Optional[str] = None
     prefill_attn_mesh = None
     prefill_attn_axis: Optional[str] = None
+    #: chunk-attention implementation baked into the chunk jit (None =
+    #: auto: gather + causal/flash site; the SP runners set "ring_sp" —
+    #: the round-5 chunk-ring hybrid, models/llama.prefill_chunk_impl —
+    #: reusing prefill_attn_mesh/axis)
+    chunk_attn_mode: Optional[str] = None
     #: whether this runner's chunk jit serves the engine's chunked-prefill
-    #: path faithfully (the SP runner sets False: chunks have no ring mode,
-    #: and the engine must refuse the combination at construction)
+    #: path faithfully (since round 5 every runner does: the SP runners'
+    #: chunk jit rides the chunk-ring hybrid)
     supports_chunked_prefill: bool = True
 
     def prepare_cache(self, cache: KVCache) -> KVCache:

@@ -64,16 +64,16 @@ PROGRESS_INTERVAL_S = 2.0
 def validate_sp_serving_config(c) -> None:
     """Refusals for sequence-parallel serving (sp_size > 1), separated from
     engine construction so the fail-fast paths are unit-testable without
-    building an engine. (int4 needs no refusal on either sp mesh: sp-only
-    wraps the full packed weights in the size-1-tp shard_map, composed
-    sp x tp shards them — parallel/sp_runner.py.)"""
-    if c.prefix_caching:
-        # Cached-prefix requests prefill their suffix through the chunk
-        # jit, which has no ring mode — the combination would silently
-        # lose the advertised parallelism.
-        raise NotImplementedError(
-            "prefix caching x sequence-parallel serving is not wired — "
-            "unset LLM_PREFIX_CACHING with LLM_SP_SIZE")
+    building an engine.
+
+    Round 5: EMPTY — the last sp refusal (prefix caching) lifted when the
+    chunk jit gained its ring mode (the chunk-ring hybrid: cache-hit
+    suffixes shard over sp while the cached pages seed each chip's
+    streaming softmax — models/llama.prefill_chunk_impl). int4 needed no
+    refusal since round 4 (sp-only wraps the full packed weights in the
+    size-1-tp shard_map, composed sp x tp shards them). Kept as the
+    documented hook so future sp-incompatible features fail fast here,
+    and because tests pin its (now-permissive) behavior."""
 
 
 class LLMServer:

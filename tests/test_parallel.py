@@ -253,6 +253,97 @@ def test_chunked_and_prefix_caching_under_tp(tiny_cfg, tiny_params):
     assert eng.generate(prompt, samp).output_ids == ref.output_ids  # hit
 
 
+def test_chunk_ring_hybrid_matches_oracle():
+    """Op-level pin for the round-5 chunk-ring hybrid: suffix queries
+    sharded over sp with a replicated prior segment reproduce plain causal
+    attention over [prior ++ suffix] (prior validity < chunk_start, suffix
+    positions offset by it) to f32 accumulation noise."""
+    from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
+    from agentic_traffic_testing_tpu.ops.ring_attention import (
+        make_sp_chunk_attention,
+    )
+
+    b, c, w, h, kh, hd = 1, 32, 48, 4, 2, 16
+    start = 40                       # 40 valid prior slots of 48 gathered
+    ks = jax.random.split(jax.random.key(11), 5)
+    q = jax.random.normal(ks[0], (b, c, h, hd), jnp.float32)
+    k = jax.random.normal(ks[1], (b, c, kh, hd), jnp.float32)
+    v = jax.random.normal(ks[2], (b, c, kh, hd), jnp.float32)
+    kp = jax.random.normal(ks[3], (b, w, kh, hd), jnp.float32)
+    vp = jax.random.normal(ks[4], (b, w, kh, hd), jnp.float32)
+
+    got = make_sp_chunk_attention(make_mesh(sp=2))(
+        q, k, v, kp, vp, jnp.int32(start))
+
+    q_pos = start + jnp.arange(c, dtype=jnp.int32)[None]
+    kv_pos = jnp.concatenate(
+        [jnp.arange(w, dtype=jnp.int32)[None], q_pos], axis=1)
+    kv_mask = jnp.concatenate(
+        [jnp.arange(w, dtype=jnp.int32)[None] < start,
+         jnp.ones((1, c), bool)], axis=1)
+    want = causal_attention(
+        q, jnp.concatenate([kp, k], axis=1), jnp.concatenate([vp, v], axis=1),
+        q_positions=q_pos, kv_positions=kv_pos, kv_valid_mask=kv_mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_prefix_caching_and_chunked_under_sp(tiny_cfg, tiny_params):
+    """Round 5 (the last refused sp cell): prefix caching composes with
+    sequence-parallel serving via the chunk-ring hybrid — a cache HIT
+    prefills only the suffix, sharded over sp, with the cached pages
+    seeding each chip's streaming softmax (models/llama.prefill_chunk_impl
+    attn_mode='ring_sp') — and deliberate chunked prefill rides the same
+    mode. Token-exact vs the unchunked single-device engine, miss and hit."""
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
+
+    base = EngineConfig(model="tiny", dtype="float32", num_blocks=96,
+                        max_model_len=256)
+    prompt = [(31 * i + 9) % tiny_cfg.vocab_size for i in range(70)]
+    samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    ref = LLMEngine(base, model_cfg=tiny_cfg,
+                    params=tiny_params).generate(prompt, samp)
+
+    ep = EngineConfig(model="tiny", dtype="float32", num_blocks=96,
+                      max_model_len=256, prefix_caching=True)
+    eng = LLMEngine(ep, model_cfg=tiny_cfg,
+                    runner=SPPrefillRunner(tiny_cfg, tiny_params,
+                                           make_mesh(sp=2)))
+    assert eng.generate(prompt, samp).output_ids == ref.output_ids  # miss
+    assert eng.generate(prompt, samp).output_ids == ref.output_ids  # hit
+
+    ec = EngineConfig(model="tiny", dtype="float32", num_blocks=96,
+                      max_model_len=256, prefill_chunk_tokens=32)
+    got = LLMEngine(ec, model_cfg=tiny_cfg,
+                    runner=SPPrefillRunner(tiny_cfg, tiny_params,
+                                           make_mesh(sp=2))
+                    ).generate(prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
+def test_prefix_caching_under_sptp(tiny_cfg, tiny_params):
+    """The chunk-ring hybrid with heads tp-sharded (SPTPRunner): the
+    gathered prior pages arrive KH-sharded over tp (the pool is tp-sharded
+    there) and the ring shards the suffix over sp — cache hit token-exact
+    vs the single-device engine."""
+    from agentic_traffic_testing_tpu.parallel.sp_runner import SPTPRunner
+
+    base = EngineConfig(model="tiny", dtype="float32", num_blocks=96,
+                        max_model_len=256)
+    prompt = [(37 * i + 5) % tiny_cfg.vocab_size for i in range(70)]
+    samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    ref = LLMEngine(base, model_cfg=tiny_cfg,
+                    params=tiny_params).generate(prompt, samp)
+
+    ep = EngineConfig(model="tiny", dtype="float32", num_blocks=96,
+                      max_model_len=256, prefix_caching=True)
+    eng = LLMEngine(ep, model_cfg=tiny_cfg,
+                    runner=SPTPRunner(tiny_cfg, tiny_params,
+                                      make_mesh(sp=2, tp=2)))
+    assert eng.generate(prompt, samp).output_ids == ref.output_ids  # miss
+    assert eng.generate(prompt, samp).output_ids == ref.output_ids  # hit
+
+
 def test_sp_shard_dma_decode_matches_gather(tiny_cfg, tiny_params,
                                             monkeypatch):
     """SPPrefillRunner's TPU decode path (round 4): the DMA kernel under
@@ -301,16 +392,35 @@ def test_sp_only_int4_serving_matches_single_device(tiny_cfg, tiny_params):
     assert got.output_ids == ref.output_ids
 
 
-def test_sp_only_int4_guards(tiny_cfg, tiny_params):
-    """The sp-only int4 wrap keeps shard_params' refusals: TP-packed
-    leaves (groups>1 — would silently decode column-permuted replicated)
-    and MoE int4 (expert scan has no shard_map wrapper) both fail fast."""
+def test_sp_only_int4_tp_packed_serves_and_moe_guard(tiny_cfg, tiny_params):
+    """Round 5: a TP-packed (groups>1) int4 checkpoint SERVES on an
+    sp-only mesh without repacking — the replicated wrap propagates the
+    packing aux (QTensor4TP.groups) and the global matmul decodes grouped
+    layouts per contiguous group (models/quant._dense4) — token-exact vs
+    the standard-packed single-chip engine on the same logical weights
+    (grouped and ungrouped packing dequantize identically). MoE int4
+    stays refused on sp (the expert shard_map serves (ep, tp) meshes)."""
     from agentic_traffic_testing_tpu.models.quant import quantize_params
     from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
 
+    from agentic_traffic_testing_tpu.models.quant import quantize_array
+
+    ecfg = EngineConfig(model="tiny", dtype="float32", quantization="int4",
+                        num_blocks=64, max_model_len=128)
+    prompt = [(11 * i + 2) % tiny_cfg.vocab_size for i in range(35)]
+    samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
+    # Same logical weights as the tp-packed tree: int4 layer weights plus
+    # the int8 lm_head that quantize_params(int4_groups>1) hybridizes to.
+    q_ref = quantize_params(tiny_params, scheme="int4")
+    q_ref["unembed"] = quantize_array(tiny_params["unembed"])
+    ref = LLMEngine(ecfg, model_cfg=tiny_cfg, params=q_ref).generate(
+        prompt, samp)
+
     tp_packed = quantize_params(tiny_params, scheme="int4", int4_groups=2)
-    with pytest.raises(ValueError, match="groups=2"):
-        SPPrefillRunner(tiny_cfg, tp_packed, make_mesh(sp=2))
+    runner = SPPrefillRunner(tiny_cfg, tp_packed, make_mesh(sp=2))
+    got = LLMEngine(ecfg, model_cfg=tiny_cfg, runner=runner).generate(
+        prompt, samp)
+    assert got.output_ids == ref.output_ids
 
     mcfg = resolve_config("tiny-moe")
     mq = quantize_params(init_params(mcfg, jax.random.key(8),

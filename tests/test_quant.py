@@ -321,6 +321,26 @@ def test_tp8_70b_shape_int4_decode():
     assert got.output_ids == ref.output_ids
 
 
+def test_tp_packed_int4_serves_single_chip():
+    """Round 5: a TP-packed (groups>1) checkpoint serves on ONE chip
+    without repacking — _dense4 decomposes the grouped layout into its
+    contiguous per-group slices (each a well-formed groups=1 QTensor4)
+    and concatenates, so greedy decode is token-exact vs the
+    standard-packed engine on the same logical weights."""
+    params = init_params(CFG, jax.random.key(3), dtype=jnp.float32)
+    ecfg = EngineConfig(model="tiny", dtype="float32", quantization="int4",
+                        num_blocks=64, max_model_len=128)
+    prompt = list(range(7, 27))
+    samp = SamplingParams(temperature=0.0, max_tokens=12)
+
+    ref = LLMEngine(ecfg, model_cfg=CFG,
+                    params=_hybrid_int4_single_device_params(params)
+                    ).generate(prompt, samp)
+    qtp = quantize_params(params, scheme="int4", int4_groups=2)
+    got = LLMEngine(ecfg, model_cfg=CFG, params=qtp).generate(prompt, samp)
+    assert got.output_ids == ref.output_ids
+
+
 def test_grouped_int4_packing_dequantizes_identically():
     """quantize_array4(w, groups=g) is a byte-layout change only: reshaping
     each group's packed shard through _unpack4 reproduces the ungrouped

@@ -275,9 +275,10 @@ def test_profile_endpoints(server, tmp_path):
 
 
 def test_sp_serving_refusals():
-    """Sequence-parallel serving fail-fast paths (round 4): sp x
-    prefix-caching is refused with an actionable error BEFORE any engine
-    build; int4 passes on either sp mesh (server.validate_sp_serving_config)."""
+    """Sequence-parallel serving fail-fast hook (round 5: now EMPTY — the
+    validator must accept every shipped feature combination, including the
+    round-4 int4 wraps and the round-5 prefix-caching chunk-ring hybrid).
+    The hook stays so future sp-incompatible features fail fast there."""
     from agentic_traffic_testing_tpu.serving.server import (
         validate_sp_serving_config,
     )
@@ -286,8 +287,7 @@ def test_sp_serving_refusals():
     c.sp_size, c.quantization = 2, "int4"
     validate_sp_serving_config(c)  # int4 serves on either sp mesh (round 4)
     c.prefix_caching = True
-    with pytest.raises(NotImplementedError, match="prefix caching"):
-        validate_sp_serving_config(c)
+    validate_sp_serving_config(c)  # prefix caching x sp serves (round 5)
 
 
 def test_bad_weights_path_fails_fast(tmp_path):

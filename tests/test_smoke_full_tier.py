@@ -62,15 +62,19 @@ def test_smoke_int4_kgroup_dense_matches_unpack_oracle():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_smoke_grouped_packing_refused_on_global_path():
-    """The TP byte layout (groups>1) must never silently decode on the
-    single-chip path (shadows test_quant's TP suites; round-3 advisor
-    finding — the guard is the QTensor4.groups aux)."""
-    w = jnp.ones((32, 16), jnp.float32)
+def test_smoke_grouped_packing_decodes_on_global_path():
+    """The TP byte layout (groups>1) decodes CORRECTLY on the single-chip
+    path (round 5: _dense4 decomposes into contiguous per-group slices —
+    before that it refused; silently column-permuted decode was the
+    round-3 hazard and would show up here as a large mismatch)."""
+    rng = np.random.default_rng(7)
+    w = jnp.asarray(rng.standard_normal((32, 16)), jnp.float32)
+    x = jnp.asarray(rng.standard_normal((2, 32)), jnp.float32)
     qg = quantize_array4(w, groups=2)
     assert qg.groups == 2
-    with pytest.raises(ValueError, match="groups=2"):
-        dense(jnp.ones((2, 32), jnp.float32), qg)
+    want = dense(x, quantize_array4(w))   # standard packing: the oracle
+    np.testing.assert_allclose(np.asarray(dense(x, qg)), np.asarray(want),
+                               rtol=1e-6, atol=1e-6)
 
 
 def test_smoke_int4_tp_dense_matches_oracle():
