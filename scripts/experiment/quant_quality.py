@@ -1,0 +1,264 @@
+#!/usr/bin/env python3
+"""Quantization output-quality fixture (round 5).
+
+The reference serves real Llama-3.1-8B-Instruct weights
+(reference: llm/serve_llm.py:52), so its quantization quality is
+observable in production traffic. This environment has zero egress and no
+HF checkpoints on disk (docs/BENCHMARKS.md), so random-init weights were
+the only thing quantization had ever been run on — and random weights
+cannot show OUTPUT-quality deltas (their logits are noise either way).
+
+This script builds the strongest in-environment stand-in: it trains the
+in-repo byte-level model (models/config.py `tiny`, whose vocab is the
+ByteTokenizer's by design) on the repository's own documentation until the
+weights have real structure (loss well below uniform ~log 262 = 5.57),
+then measures every quantization scheme the framework ships against the
+fp32 baseline on HELD-OUT text:
+
+  - logit RMS drift and next-token top-1 agreement,
+  - held-out perplexity per scheme,
+  - greedy 32-token continuation agreement through the REAL engine
+    (serving path, not just forward math),
+  - fp8 KV pages (LLM_KV_CACHE_DTYPE=fp8) the same way — its error enters
+    through the cache, not the weights, so only the engine path shows it.
+
+Usage:
+    JAX_PLATFORMS=cpu python scripts/experiment/quant_quality.py \
+        [--steps 400] [--model tiny] [--out docs/quant_quality_fixture.md]
+
+The committed fixture numbers live in docs/BENCHMARKS.md ("Quantization
+output quality"); rerun this script to reproduce them. `tests/
+test_e2e_weights.py` remains the real-checkpoint E2E gate the moment
+ATT_E2E_WEIGHTS_PATH points at an HF dir.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import sys
+import time
+
+REPO = os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "..")
+sys.path.insert(0, REPO)
+
+from agentic_traffic_testing_tpu.platform_guard import force_cpu_if_requested
+
+
+def _corpus_ids(tok) -> list[int]:
+    """The repo's own documentation as one token stream."""
+    paths = [os.path.join(REPO, "README.md"), os.path.join(REPO, "SURVEY.md")]
+    docs_dir = os.path.join(REPO, "docs")
+    paths += sorted(
+        os.path.join(docs_dir, p) for p in os.listdir(docs_dir)
+        if p.endswith(".md"))
+    text = "\n\n".join(
+        open(p, encoding="utf-8", errors="replace").read() for p in paths
+        if os.path.isfile(p))
+    return tok.encode(text)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="tiny")
+    ap.add_argument("--steps", type=int, default=400)
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--k-group", type=int, default=64)
+    ap.add_argument("--gen-prompts", type=int, default=16)
+    ap.add_argument("--gen-tokens", type=int, default=32)
+    ap.add_argument("--out", default=None,
+                    help="write the markdown table + JSON line here")
+    args = ap.parse_args()
+
+    force_cpu_if_requested()
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import forward_full
+    from agentic_traffic_testing_tpu.models.quant import (
+        quantize_array,
+        quantize_params,
+    )
+    from agentic_traffic_testing_tpu.parallel.mesh import make_mesh
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+    from agentic_traffic_testing_tpu.training.train import (
+        init_train_state,
+        make_train_step,
+    )
+    from agentic_traffic_testing_tpu.utils.tokenizer import load_tokenizer
+
+    cfg = resolve_config(args.model)
+    tok = load_tokenizer("byte-fallback")
+    if cfg.vocab_size < tok.vocab_size:
+        raise SystemExit(f"{args.model}: vocab {cfg.vocab_size} < byte "
+                         f"tokenizer {tok.vocab_size}")
+    ids = _corpus_ids(tok)
+    split = int(len(ids) * 0.9)
+    train_ids = np.asarray(ids[:split], np.int32)
+    held_ids = np.asarray(ids[split:], np.int32)
+    print(f"corpus: {len(ids)} tokens ({split} train / {len(held_ids)} held)",
+          flush=True)
+
+    # ---- train ----------------------------------------------------------
+    mesh = make_mesh()
+    optimizer = optax.adamw(args.lr)
+    params, opt_state = init_train_state(cfg, mesh, optimizer,
+                                         seed=args.seed, dtype=jnp.float32)
+    step = make_train_step(cfg, mesh, optimizer)
+    rng = np.random.default_rng(args.seed)
+    t0 = time.time()
+    loss = float("nan")
+    for i in range(args.steps):
+        starts = rng.integers(0, len(train_ids) - args.seq - 1, args.batch)
+        tokens = np.stack([train_ids[s:s + args.seq] for s in starts])
+        params, opt_state, loss = step(
+            params, opt_state, jnp.asarray(tokens),
+            jnp.ones_like(tokens, jnp.float32))
+        if i % 50 == 0 or i == args.steps - 1:
+            print(f"step {i}: loss {float(loss):.4f} "
+                  f"({time.time() - t0:.0f}s)", flush=True)
+    final_loss = float(loss)
+    if final_loss > 4.5:
+        print(f"WARNING: final loss {final_loss:.2f} is close to uniform "
+              f"(5.57) — the fixture is weak; raise --steps", flush=True)
+
+    # ---- held-out evaluation -------------------------------------------
+    n_eval = min(16, (len(held_ids) - 1) // args.seq)
+    eval_tokens = jnp.asarray(np.stack(
+        [held_ids[i * args.seq:(i + 1) * args.seq] for i in range(n_eval)]))
+    eval_targets = jnp.asarray(np.stack(
+        [held_ids[i * args.seq + 1:(i + 1) * args.seq + 1]
+         for i in range(n_eval)]))
+
+    def eval_metrics(p):
+        logits = np.asarray(forward_full(p, cfg, eval_tokens), np.float32)
+        logp = logits - np.log(np.exp(
+            logits - logits.max(-1, keepdims=True)).sum(-1, keepdims=True)) \
+            - logits.max(-1, keepdims=True)
+        nll = -np.take_along_axis(
+            logp, np.asarray(eval_targets)[..., None], axis=-1).mean()
+        return logits, float(np.exp(nll))
+
+    base_logits, base_ppl = eval_metrics(params)
+    base_top1 = base_logits.argmax(-1)
+
+    def scheme_variants():
+        yield "int8", quantize_params(params, scheme="int8")
+        yield "int4", quantize_params(params, scheme="int4")
+        q_kg = quantize_params(params, scheme="int4",
+                               int4_k_group=args.k_group)
+        yield f"int4 kg={args.k_group}", q_kg
+
+    rows = []
+    for name, qp in scheme_variants():
+        logits, ppl = eval_metrics(qp)
+        rms = float(np.sqrt(((logits - base_logits) ** 2).mean()))
+        ref_rms = float(np.sqrt((base_logits ** 2).mean()))
+        top1 = float((logits.argmax(-1) == base_top1).mean())
+        rows.append({"scheme": name, "ppl": ppl,
+                     "logit_rms_rel": rms / ref_rms, "top1_agree": top1})
+        print(f"{name}: ppl {ppl:.3f} (base {base_ppl:.3f}), rel logit RMS "
+              f"{rms / ref_rms:.4f}, top-1 agree {top1:.4f}", flush=True)
+
+    # ---- greedy continuation agreement through the real engine ----------
+    samp = SamplingParams(temperature=0.0, max_tokens=args.gen_tokens,
+                          ignore_eos=True)
+    prompts = []
+    for i in range(args.gen_prompts):
+        s = rng.integers(0, max(1, len(held_ids) - 64))
+        prompts.append([int(t) for t in held_ids[s:s + 48]])
+
+    def engine_outputs(p=None, quantization=None, kv_cache_dtype=None,
+                       k_group=0):
+        ecfg = EngineConfig(model=args.model, dtype="float32",
+                            quantization=quantization,
+                            int4_k_group=k_group,
+                            kv_cache_dtype=kv_cache_dtype,
+                            num_blocks=128, max_model_len=128)
+        eng = LLMEngine(ecfg, model_cfg=cfg,
+                        params=p if p is not None else params)
+        return [eng.generate(pr, samp).output_ids for pr in prompts]
+
+    base_gen = engine_outputs()
+
+    def gen_agreement(gen) -> tuple[float, float]:
+        """(exact-sequence rate, mean matching-prefix fraction)."""
+        exact = np.mean([g == b for g, b in zip(gen, base_gen)])
+        fracs = []
+        for g, b in zip(gen, base_gen):
+            n = 0
+            for x, y in zip(g, b):
+                if x != y:
+                    break
+                n += 1
+            fracs.append(n / max(1, len(b)))
+        return float(exact), float(np.mean(fracs))
+
+    gen_rows = []
+    for name, quant, kg in [("int8", "int8", 0), ("int4", "int4", 0),
+                            (f"int4 kg={args.k_group}", "int4",
+                             args.k_group)]:
+        qp = quantize_params(params, scheme=quant, int4_k_group=kg)
+        exact, frac = gen_agreement(engine_outputs(
+            p=qp, quantization=quant, k_group=kg))
+        gen_rows.append({"scheme": name, "gen_exact": exact,
+                         "gen_prefix_frac": frac})
+        print(f"{name}: greedy {args.gen_tokens}-token exact-match "
+              f"{exact:.3f}, mean matching prefix {frac:.3f}", flush=True)
+
+    exact8, frac8 = gen_agreement(engine_outputs(kv_cache_dtype="fp8"))
+    gen_rows.append({"scheme": "fp8 KV (fp32 weights)", "gen_exact": exact8,
+                     "gen_prefix_frac": frac8})
+    print(f"fp8 KV: greedy exact-match {exact8:.3f}, mean matching prefix "
+          f"{frac8:.3f}", flush=True)
+
+    # ---- report ---------------------------------------------------------
+    by_scheme = {r["scheme"]: r for r in rows}
+    lines = [
+        "| scheme | held-out ppl | rel logit RMS | top-1 agree | "
+        f"greedy {args.gen_tokens}-tok exact | mean matching prefix |",
+        "|---|---|---|---|---|---|",
+        f"| fp32 baseline | {base_ppl:.3f} | 0 | 1.000 | 1.000 | 1.000 |",
+    ]
+    for gr in gen_rows:
+        r = by_scheme.get(gr["scheme"], {})
+        ppl = f"{r['ppl']:.3f}" if r else "= baseline"
+        rms = f"{r['logit_rms_rel']:.4f}" if r else "n/a (cache-side)"
+        top1 = f"{r['top1_agree']:.4f}" if r else "n/a"
+        lines.append(
+            f"| {gr['scheme']} | {ppl} | {rms} | {top1} | "
+            f"{gr['gen_exact']:.3f} | {gr['gen_prefix_frac']:.3f} |")
+    table = "\n".join(lines)
+    print("\n" + table, flush=True)
+    record = {
+        "model": args.model, "steps": args.steps, "final_loss": final_loss,
+        "base_ppl": base_ppl, "rows": rows, "gen_rows": gen_rows,
+        "corpus_tokens": len(ids),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write("# Quantization output quality — trained byte-LM "
+                    "fixture\n\n")
+            f.write(f"Generated by scripts/experiment/quant_quality.py "
+                    f"(model={args.model}, steps={args.steps}, final train "
+                    f"loss {final_loss:.3f}, corpus {len(ids)} tokens of "
+                    f"in-repo docs).\n\n")
+            f.write(table + "\n\n```json\n" + json.dumps(record) + "\n```\n")
+        print(f"wrote {args.out}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
