@@ -1,0 +1,298 @@
+"""Pipeline-parallel SERVING runner: layer stages over the `pp` mesh axis.
+
+Why this exists (and when to use it): docs/architecture_diagrams/
+serving_stack.md's round-5 ADR shows tp x sp dominates pp on every
+serving metric on a v5e pod — PP decodes one request stream at 1/P chip
+utilization by construction. What PP uniquely buys is CAPACITY without
+constraints: L/P weight layers AND L/P KV-cache layers per chip, with no
+KV-head-divisibility requirement (TP's binding constraint past tp=8 on
+Llama-70B's 8 KV heads) and no interconnect-bandwidth exposure on the
+decode path beyond one [B, D] activation hop per stage. This runner is
+that capacity escape hatch, shipped and token-exact; the ADR's latency
+math is unchanged and documented honestly below.
+
+Execution model (phase loop, not GPipe): serving steps are latency-bound
+single passes, so the schedule is P sequential phases inside one
+`jax.shard_map` over `pp`. At phase j, chip j holds the REAL activation
+and applies its local layer stack; a `ppermute` hands the output one hop
+along the ring. Every chip runs every phase in SPMD lockstep (inactive
+phases compute on garbage — the wall-clock equals the idle bubble either
+way), so per-token latency equals the FULL layer stack (single-chip
+latency + P activation hops): PP here scales capacity, never speed. KV
+writes during inactive phases route to the trash block
+(`write_decode_kv_full(valid=...)`), and each chip banks prompt KV only
+from its own real phase, so the pp-sharded pool (cache layer axis
+`P('pp')`) only ever holds real pages.
+
+No contraction is split across chips (unlike TP's row-parallel psum), so
+outputs are BIT-identical to the single-chip engine — pinned token-exact
+by tests/test_parallel.py and dryrun leg 6 (__graft_entry__.py).
+
+The reference has no pipeline parallelism anywhere (vLLM-internal only,
+never configured — SURVEY.md §2.3); serving-PP goes past the training
+GPipe stack (parallel/pipeline.py) that round 2 shipped.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from agentic_traffic_testing_tpu.models.config import ModelConfig
+from agentic_traffic_testing_tpu.models.llama import (
+    _mlp_block,
+    _prefill_layer_body,
+    _qkv,
+    _unembed,
+)
+from agentic_traffic_testing_tpu.ops.attention_backend import (
+    paged_decode_attention,
+)
+from agentic_traffic_testing_tpu.ops.flash_prefill import prefill_attention
+from agentic_traffic_testing_tpu.ops.jnp_ops import (
+    apply_rope,
+    rms_norm,
+    rope_sin_cos,
+)
+from agentic_traffic_testing_tpu.ops.kv_writer import write_prompt_pages
+from agentic_traffic_testing_tpu.parallel.mesh import AXIS_PP
+from agentic_traffic_testing_tpu.parallel.pipeline import pp_param_pspecs
+from agentic_traffic_testing_tpu.parallel.sharding import shard_pytree
+from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
+from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
+from agentic_traffic_testing_tpu.ops.sampling import make_row_keys, sample
+from agentic_traffic_testing_tpu.runtime.runner import (
+    DecodeState,
+    ModelRunner,
+    SamplingArrays,
+)
+
+
+def _ring_perm(pp: int):
+    return [(i, (i + 1) % pp) for i in range(pp)]
+
+
+def pp_prefill_impl(params, cfg: ModelConfig, tokens, cache: KVCache,
+                    block_tables, seq_lens, mesh: Mesh):
+    """Staged prefill. tokens [B, T] -> (last-token logits [B, V] f32,
+    updated pp-sharded cache). Each chip banks its own stage's prompt KV
+    (taken from its real phase) and bulk-writes it into its local layer
+    slice of the pool."""
+    b, t = tokens.shape
+    if t % cache.block_size != 0:
+        raise ValueError(
+            f"prefill length {t} not a multiple of block_size "
+            f"{cache.block_size}")
+    pp = mesh.shape[AXIS_PP]
+    positions = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (b, t))
+    from agentic_traffic_testing_tpu.models.quant import embed_lookup
+
+    x = embed_lookup(params["tok_embed"], tokens,
+                     dtype=params["final_norm"].dtype)
+    sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta,
+                            cfg.rope_scaling)
+
+    def attn_site(q, k, v, li):
+        return prefill_attention(q, k, v, q_positions=positions,
+                                 kv_valid_len=seq_lens)
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(AXIS_PP), P(), P(AXIS_PP), P(AXIS_PP), P()),
+        out_specs=(P(), P(AXIS_PP), P(AXIS_PP)),
+        check_vma=False,
+    )
+    def staged(local_layers, x0, kc, vc, tables):
+        p = jax.lax.axis_index(AXIS_PP)
+        local_cache = KVCache(kc, vc)
+        n_local = kc.shape[0]
+
+        def run_stage(x):
+            def body(x, xs):
+                lp, li = xs
+                return _prefill_layer_body(x, lp, li, cfg, sin, cos,
+                                           attn_site, local_cache)
+            return jax.lax.scan(
+                body, x,
+                (local_layers, jnp.arange(n_local, dtype=jnp.int32)))
+
+        x_held = x0
+        ks_bank = vs_bank = None
+        for j in range(pp):
+            y, (ks, vs) = run_stage(x_held)
+            # Bank this phase's KV only on the chip whose REAL phase it is;
+            # phase 0 seeds the bank (any chip's j=0 values are overwritten
+            # by its own phase p before the loop ends).
+            keep = p == jnp.int32(j)
+            ks_bank = jnp.where(keep, ks, ks if ks_bank is None else ks_bank)
+            vs_bank = jnp.where(keep, vs, vs if vs_bank is None else vs_bank)
+            x_held = jax.lax.ppermute(y, AXIS_PP, _ring_perm(pp))
+        # After P phases the finished activation sits on chip 0; everyone
+        # else contributes zeros so one psum replicates it.
+        x_fin = jax.lax.psum(
+            jnp.where(p == 0, x_held, jnp.zeros_like(x_held)), AXIS_PP)
+        kc, vc = write_prompt_pages(kc, vc, ks_bank, vs_bank, tables,
+                                    mode="dus")
+        return x_fin, kc, vc
+
+    x, kc, vc = staged(params["layers"], x, cache.k, cache.v, block_tables)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    last = jnp.take_along_axis(
+        x, jnp.maximum(seq_lens - 1, 0)[:, None, None], axis=1)[:, 0]
+    return _unembed(last[:, None, :], params, cfg)[:, 0], KVCache(kc, vc)
+
+
+def pp_decode_step_impl(params, cfg: ModelConfig, tokens, cache: KVCache,
+                        block_tables, positions, mesh: Mesh):
+    """One staged decode step. tokens [B] -> (logits [B, V] f32, cache).
+    Mirrors verify_step_impl's S=1 layer body; inactive phases' KV writes
+    route to the trash block so only the owning chip's real phase lands."""
+    b = tokens.shape[0]
+    pp = mesh.shape[AXIS_PP]
+    pos_grid = positions[:, None]                                # [B, 1]
+    from agentic_traffic_testing_tpu.models.quant import dense, embed_lookup
+
+    x = embed_lookup(params["tok_embed"], tokens[:, None],
+                     dtype=params["final_norm"].dtype)            # [B, 1, D]
+    sin, cos = rope_sin_cos(pos_grid, cfg.head_dim_, cfg.rope_theta,
+                            cfg.rope_scaling)
+    capacity = block_tables.shape[1] * cache.block_size
+
+    @partial(
+        jax.shard_map, mesh=mesh,
+        in_specs=(P(AXIS_PP), P(), P(AXIS_PP), P(AXIS_PP), P()),
+        out_specs=(P(), P(AXIS_PP), P(AXIS_PP)),
+        check_vma=False,
+    )
+    def staged(local_layers, x0, kc, vc, tables):
+        p = jax.lax.axis_index(AXIS_PP)
+        n_local = kc.shape[0]
+
+        def run_stage(x, kc, vc, active):
+            def body(carry, xs):
+                x, kc, vc = carry
+                lp, li = xs
+                xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
+                q, k, v = _qkv(xa, lp, cfg)
+                q = apply_rope(q, sin, cos)
+                k = apply_rope(k, sin, cos)
+                ok = (positions < capacity) & active
+                kc = kvc.write_decode_kv_full(kc, li, k[:, 0], tables,
+                                              positions, valid=ok)
+                vc = kvc.write_decode_kv_full(vc, li, v[:, 0], tables,
+                                              positions, valid=ok)
+                attn = paged_decode_attention(q, kc, vc, tables, positions,
+                                              layer=li)
+                x = x + dense(attn.reshape(b, 1, -1), lp["wo"])
+                xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
+                y, _ = _mlp_block(xm, lp, cfg)
+                return (x + y, kc, vc), None
+
+            (x, kc, vc), _ = jax.lax.scan(
+                body, (x, kc, vc),
+                (local_layers, jnp.arange(n_local, dtype=jnp.int32)))
+            return x, kc, vc
+
+        x_held = x0
+        for j in range(pp):
+            active = jnp.broadcast_to(p == jnp.int32(j), (b,))
+            x_held, kc, vc = run_stage(x_held, kc, vc, active)
+            x_held = jax.lax.ppermute(x_held, AXIS_PP, _ring_perm(pp))
+        x_fin = jax.lax.psum(
+            jnp.where(p == 0, x_held, jnp.zeros_like(x_held)), AXIS_PP)
+        return x_fin, kc, vc
+
+    x, kc, vc = staged(params["layers"], x, cache.k, cache.v, block_tables)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    return _unembed(x, params, cfg)[:, 0], KVCache(kc, vc)
+
+
+def _pp_prefill_sample_impl(params, cfg, tokens, cache, block_tables,
+                            seq_lens, samp: SamplingArrays, steps, mesh=None):
+    logits, cache = pp_prefill_impl(params, cfg, tokens, cache, block_tables,
+                                    seq_lens, mesh)
+    keys = make_row_keys(samp.seeds, steps)
+    out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
+    return DecodeState(tokens=out, positions=seq_lens, steps=steps + 1), \
+        cache, out
+
+
+def _pp_decode_sample_impl(params, cfg, cache, block_tables,
+                           state: DecodeState, samp: SamplingArrays,
+                           num_steps: int = 1, mesh=None):
+    def body(carry, _):
+        st, cache = carry
+        logits, cache = pp_decode_step_impl(params, cfg, st.tokens, cache,
+                                            block_tables, st.positions, mesh)
+        keys = make_row_keys(samp.seeds, st.steps)
+        out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
+        new_st = DecodeState(tokens=out, positions=st.positions + 1,
+                             steps=st.steps + 1)
+        return (new_st, cache), out
+
+    (state, cache), toks = jax.lax.scan(body, (state, cache), None,
+                                        length=num_steps)
+    return state, cache, toks.T
+
+
+class PPRunner(ModelRunner):
+    """Serving runner over a pp-only mesh (capacity scaling; see module
+    docstring for the latency model and the ADR pointer)."""
+
+    kv_writer_mode = "dus"
+    supports_chunked_prefill = False   # no staged chunk jit (and no prefix
+    #                                    caching): engine refuses at build
+
+    def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
+                 decode_steps: int = 1, spec_tokens: int = 0,
+                 spec_ngram: int = 3) -> None:
+        from agentic_traffic_testing_tpu.models.quant import is_quantized
+
+        pp = mesh.shape[AXIS_PP]
+        if pp < 2:
+            raise ValueError(f"PPRunner needs a pp axis >= 2, got {pp}")
+        if cfg.num_layers % pp:
+            raise ValueError(
+                f"num_layers={cfg.num_layers} not divisible by pp={pp}")
+        if spec_tokens:
+            raise NotImplementedError(
+                "speculation x pipeline-parallel serving is not wired — "
+                "unset LLM_SPECULATION with pp, or use tp/sp")
+        from agentic_traffic_testing_tpu.models.quant import (
+            QTensor,
+            QTensor4,
+        )
+
+        if is_quantized(params) or any(
+                isinstance(l, (QTensor, QTensor4))
+                for l in params["layers"].values()):
+            raise NotImplementedError(
+                "quantization x pipeline-parallel serving is not wired — "
+                "pp is the capacity escape hatch for bf16; use tp/sp for "
+                "quantized serving")
+        self.cfg = cfg
+        self.mesh = mesh
+        self.pp = pp
+        self.decode_steps = max(1, int(decode_steps))
+        self.spec_tokens = 0
+        self.spec_ngram = max(1, int(spec_ngram))
+        self.params = shard_pytree(params, pp_param_pspecs(cfg), mesh)
+        self._prefill = jax.jit(
+            partial(_pp_prefill_sample_impl, cfg=cfg, mesh=mesh),
+            donate_argnames=("cache",))
+        self._decode = jax.jit(
+            partial(_pp_decode_sample_impl, cfg=cfg, mesh=mesh,
+                    num_steps=self.decode_steps),
+            donate_argnames=("cache",))
+        self._prefill_chunk = None  # unreachable: supports_chunked_prefill
+
+    def prepare_cache(self, cache: KVCache) -> KVCache:
+        """Shard the pool's layer axis over pp: each stage holds exactly
+        its own layers' pages."""
+        spec = NamedSharding(self.mesh, P(AXIS_PP))
+        return KVCache(k=jax.device_put(cache.k, spec),
+                       v=jax.device_put(cache.v, spec))

@@ -387,6 +387,8 @@ class LLMEngine:
             self.model_cfg, self.cfg.block_size, free,
             self.cfg.memory_utilization, kv_bytes,
             tp_size=self.runner.tp_size,
+            # PPRunner shards the pool's layer axis over its stages.
+            pp_size=getattr(self.runner, "pp", 1),
         )
         # Never exceed what max_num_seqs * max_model_len can actually use.
         cap = self.cfg.max_num_seqs * self.table_width + 1

@@ -188,6 +188,7 @@ def profile_num_blocks(
     memory_utilization: float,
     dtype_bytes: int = 2,
     tp_size: int = 1,
+    pp_size: int = 1,
 ) -> int:
     """Derive the block budget from free HBM, vLLM-profiling style.
 
@@ -195,10 +196,14 @@ def profile_num_blocks(
     profiling pass (reference: llm/serve_llm.py:245-264); here the equivalent
     computation is explicit: blocks = utilization * free_hbm / bytes_per_block.
     With tensor parallelism each chip holds KH/tp heads, so per-chip block
-    bytes shrink accordingly (min 1 head group).
+    bytes shrink accordingly (min 1 head group); with pipeline stages each
+    chip holds L/pp layers of every block (parallel/pp_runner.py shards the
+    pool's layer axis), shrinking per-chip block bytes the same way — the
+    capacity win is PP's whole purpose, so the budget must see it.
     """
     kh_local = max(1, cfg.num_kv_heads // tp_size)
-    per_block = (2 * cfg.num_layers * block_size * kh_local
+    layers_local = max(1, cfg.num_layers // pp_size)
+    per_block = (2 * layers_local * block_size * kh_local
                  * phys_head_dim(cfg.head_dim_) * dtype_bytes)
     budget = int(hbm_bytes_free * memory_utilization)
     return max(0, budget // per_block)

@@ -45,10 +45,16 @@ class ServerConfig:
     tp_size: int = 1                           # LLM_TP_SIZE (TPU-native knob)
     # Sequence-parallel prefill degree (TPU-native knob): long-prompt
     # prefill rides ring attention over an sp mesh axis, decode unchanged
-    # (parallel/sp_runner.py). Composes with tp_size > 1 (SPTPRunner) and
-    # with int8/int4 on dense models (int4 via the QTensor4TP shard_map;
-    # int4 x MoE is refused — the expert scan has no shard_map wrapper).
+    # (parallel/sp_runner.py). Composes with tp_size > 1 (SPTPRunner),
+    # with int8/int4 on dense models (int4 via the QTensor4TP shard_map),
+    # and with prefix caching (round-5 chunk-ring hybrid); int4 x MoE x sp
+    # stays refused (MoE int4 shards on (ep, tp) meshes instead).
     sp_size: int = 1                           # LLM_SP_SIZE
+    # Pipeline-parallel serving degree (round 5): L/pp layers + L/pp KV
+    # pages per chip, bf16 only — the capacity escape hatch when KV-head
+    # divisibility caps tp (parallel/pp_runner.py; latency model in the
+    # serving-stack ADR). Mutually exclusive with tp_size/sp_size.
+    pp_size: int = 1                           # LLM_PP_SIZE
     quantization: Optional[str] = None         # LLM_QUANTIZATION ("int8" | "int4" | unset)
     decode_steps: Optional[int] = None         # LLM_DECODE_STEPS (None -> auto)
     prefill_chunk_tokens: int = 4096           # LLM_PREFILL_CHUNK_TOKENS (0 = off)
@@ -110,6 +116,7 @@ class ServerConfig:
         c.port = int(os.environ.get("LLM_PORT") or c.port)
         c.tp_size = int(os.environ.get("LLM_TP_SIZE") or c.tp_size)
         c.sp_size = int(os.environ.get("LLM_SP_SIZE") or c.sp_size)
+        c.pp_size = int(os.environ.get("LLM_PP_SIZE") or c.pp_size)
         c.quantization = os.environ.get("LLM_QUANTIZATION") or None
         ds = os.environ.get("LLM_DECODE_STEPS")
         c.decode_steps = int(ds) if ds else None

@@ -154,6 +154,54 @@ class LLMServer:
         runner = None
         params = None
         model_cfg = None
+        if c.pp_size > 1:
+            import dataclasses
+
+            from agentic_traffic_testing_tpu.models.config import resolve_config
+            from agentic_traffic_testing_tpu.parallel.mesh import single_axis_mesh
+            from agentic_traffic_testing_tpu.parallel.pp_runner import PPRunner
+            import jax
+
+            # Checked HERE, before any other topology branch can win the
+            # dispatch: a silently-ignored LLM_PP_SIZE is worse than a
+            # refusal (the operator believes pp is active).
+            if c.tp_size > 1 or c.sp_size > 1:
+                raise NotImplementedError(
+                    "pp does not compose with tp/sp in serving — pp is the "
+                    "bf16 capacity escape hatch (see the serving-stack "
+                    "ADR); pick one of LLM_PP_SIZE or "
+                    "LLM_TP_SIZE/LLM_SP_SIZE")
+            if c.prefix_caching:
+                raise NotImplementedError(
+                    "prefix caching x pipeline-parallel serving is not "
+                    "wired (no staged chunk jit) — unset LLM_PREFIX_CACHING "
+                    "with LLM_PP_SIZE")
+            # pp prefill runs the whole prompt in one staged pass; like the
+            # sp branch, an explicitly set chunk knob is dropped LOUDLY.
+            if ecfg.prefill_chunk_tokens and os.environ.get(
+                    "LLM_PREFILL_CHUNK_TOKENS"):
+                log.warning(
+                    "LLM_PREFILL_CHUNK_TOKENS=%d is ignored with "
+                    "LLM_PP_SIZE=%d: pipeline-parallel prefill runs the "
+                    "full prompt in one staged pass",
+                    ecfg.prefill_chunk_tokens, c.pp_size)
+            ecfg.prefill_chunk_tokens = 0
+            model_cfg = resolve_config(c.model)
+            if c.moe_capacity_factor is not None and model_cfg.num_experts:
+                # Before runner construction (the runner compiles its step
+                # programs from this cfg; LLMEngine cross-checks).
+                model_cfg = dataclasses.replace(
+                    model_cfg, moe_capacity_factor=c.moe_capacity_factor)
+            params = self._params_or_random_init(model_cfg)
+            runner = PPRunner(
+                model_cfg, params, single_axis_mesh("pp", c.pp_size),
+                decode_steps=ecfg.resolved_decode_steps(
+                    jax.devices()[0].platform),
+                # Forwarded so PPRunner's refusal fires instead of the
+                # speculation knob silently vanishing.
+                spec_tokens=ecfg.effective_spec_tokens,
+                spec_ngram=ecfg.spec_ngram)
+            return LLMEngine(ecfg, model_cfg=model_cfg, runner=runner)
         if c.sp_size > 1:
             from agentic_traffic_testing_tpu.models.config import resolve_config
             from agentic_traffic_testing_tpu.parallel.mesh import (
@@ -167,11 +215,11 @@ class LLMServer:
             import jax
 
             validate_sp_serving_config(c)
-            # Chunked prefill would defeat sp entirely: the chunk jit has
-            # no ring mode, so chunks would run replicated on every chip
-            # with zero speedup — the one long-prompt pass IS the sp
-            # feature (memory O(T/sp) replaces the chunk path's reason to
-            # exist here). Loud, not silent: an operator who set the knob
+            # The server prefers ONE ring-sharded long-prompt pass over
+            # chunking under sp (the chunk jit does have a ring mode since
+            # round 5 — it serves prefix-cache suffixes — but operator-level
+            # chunking would just slice the sp feature into more
+            # dispatches). Loud, not silent: an operator who set the knob
             # (env or CLI) must see that sp dropped it — but the config
             # default (4096) must not warn on every sp start and train
             # operators to ignore it. Differs-from-default catches both

@@ -290,6 +290,46 @@ def test_sp_serving_refusals():
     validate_sp_serving_config(c)  # prefix caching x sp serves (round 5)
 
 
+def test_pp_serving_branch_builds_and_guards(monkeypatch):
+    """LLM_PP_SIZE server wiring (round 5): the pp branch builds a working
+    PPRunner engine (chunk knob dropped like the sp branch), and its
+    guards fire loudly — pp x sp/tp mutual exclusion wins the dispatch
+    even though the sp branch comes later, prefix caching and speculation
+    refuse instead of silently vanishing."""
+    from agentic_traffic_testing_tpu.parallel.pp_runner import PPRunner
+    from agentic_traffic_testing_tpu.serving.server import LLMServer
+
+    cfg = ServerConfig(model="tiny", dtype="float32", max_num_seqs=2,
+                       max_model_len=128, num_blocks=64, warmup=False,
+                       metrics_enabled=False)
+    cfg.pp_size = 2
+    server = LLMServer(cfg)
+    assert isinstance(server.engine.runner, PPRunner)
+    assert server.engine.cfg.prefill_chunk_tokens == 0
+
+    bad = ServerConfig(model="tiny", dtype="float32", max_num_seqs=2,
+                       max_model_len=128, num_blocks=64, warmup=False,
+                       metrics_enabled=False)
+    bad.pp_size, bad.sp_size = 2, 2
+    with pytest.raises(NotImplementedError, match="pp does not compose"):
+        LLMServer(bad)
+
+    px = ServerConfig(model="tiny", dtype="float32", max_num_seqs=2,
+                      max_model_len=128, num_blocks=64, warmup=False,
+                      metrics_enabled=False, prefix_caching=True)
+    px.pp_size = 2
+    with pytest.raises(NotImplementedError, match="prefix caching"):
+        LLMServer(px)
+
+    sp = ServerConfig(model="tiny", dtype="float32", max_num_seqs=2,
+                      max_model_len=128, num_blocks=64, warmup=False,
+                      metrics_enabled=False, speculation="ngram",
+                      spec_tokens=3)
+    sp.pp_size = 2
+    with pytest.raises(NotImplementedError, match="speculation"):
+        LLMServer(sp)
+
+
 def test_bad_weights_path_fails_fast(tmp_path):
     """A weight-load failure must abort startup, not silently serve random
     weights behind 200s (round-1 verdict weak #3)."""
