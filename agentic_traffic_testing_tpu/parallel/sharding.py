@@ -185,7 +185,7 @@ def wrap_int4_tp(params: Any, mesh: Mesh) -> Any:
     return out
 
 
-def wrap_int4_replicated(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
+def wrap_int4_replicated(params: Any, mesh: Mesh) -> Any:
     """Guarded int4 wrap for runners that REPLICATE weights over the mesh
     (sp-only serving): each chip keeps the full packed tensors, wrapped in
     QTensor4TP over the size-1 tp axis so the matmul runs the kernel under
@@ -204,11 +204,16 @@ def wrap_int4_replicated(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
     that need sharding to FIT take the sp x tp mesh (SPTPRunner), where
     int4 shards for real under the grouped-packing contract.
 
-    Remaining refusal, kept from the sharded path: int4 x MoE — the
-    expert shard_map (models/moe.py _expert_dense4_tp) serves SHARDED
-    expert stacks on (ep, tp) meshes and is not wired to the replicated
-    wrap. TP-packed leaves (groups > 1) are ACCEPTED as of round 5: the
-    wrap propagates the packing aux and the global matmul path decodes
+    int4 x MoE x sp (round 5, the matrix's last refusal lifted): expert
+    stacks wrap like everything else — QTensor4TP with ep_axis over the
+    SIZE-1 ep axis — and the expert scan runs under
+    models/moe._expert_dense4_tp's shard_map with both weight axes sized
+    1: each sp chip keeps the full expert stacks and computes the expert
+    MLP replicated (the dispatch einsum's sp-sharded input is gathered at
+    the shard_map boundary). Ring attention still carries the sp win;
+    the MoE MLP is replicated compute, same as decode — documented, not
+    silent. TP-packed leaves (groups > 1) are likewise ACCEPTED as of
+    round 5: the wrap propagates the packing aux and the matmul decodes
     grouped layouts per contiguous group (models/quant._dense4), so a
     tp-packed checkpoint serves on an sp mesh without repacking.
     """
@@ -218,12 +223,6 @@ def wrap_int4_replicated(params: Any, cfg: ModelConfig, mesh: Mesh) -> Any:
         ("unembed", params.get("unembed"))]
     if not any(isinstance(l, QTensor4) for _, l in leaves):
         return params
-    if cfg.num_experts:
-        raise NotImplementedError(
-            "int4 x MoE x sp is not wired — the expert shard_map "
-            "(models/moe.py _expert_dense4_tp) serves (ep, tp) meshes, "
-            "not the sp replicated wrap; use int8 or bf16 for MoE with "
-            "LLM_SP_SIZE")
     return wrap_int4_tp(params, mesh)
 
 

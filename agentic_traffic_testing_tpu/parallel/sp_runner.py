@@ -85,16 +85,17 @@ class SPPrefillRunner(ModelRunner):
         # GSPMD over the sp mesh, but the QTensor4TP shard_map wrapper
         # works with a SIZE-1 tp axis — each chip keeps the full packed
         # weight while the prefill activation's token dim shards over sp
-        # (shape-gated, models/quant._dense4_tp). The guarded helper
-        # refuses MoE int4; TP-packed (groups>1) checkpoints are ACCEPTED
-        # since round 5 (the global matmul decodes them per contiguous
-        # group). The config this enables: 8B int4 (~4 GiB) fits one
-        # chip, sp divides a long prompt.
+        # (shape-gated, models/quant._dense4_tp). As of round 5 the wrap
+        # covers EVERY int4 tree: MoE expert stacks route through the
+        # expert shard_map with size-1 weight axes, and TP-packed
+        # (groups>1) checkpoints decode per contiguous group. The config
+        # this enables: 8B int4 (~4 GiB) fits one chip, sp divides a
+        # long prompt.
         from agentic_traffic_testing_tpu.parallel.sharding import (
             wrap_int4_replicated,
         )
 
-        params = wrap_int4_replicated(params, cfg, mesh)
+        params = wrap_int4_replicated(params, mesh)
         super().__init__(cfg, params, decode_steps=decode_steps,
                          spec_tokens=spec_tokens, spec_ngram=spec_ngram)
 

@@ -46,9 +46,9 @@ class ServerConfig:
     # Sequence-parallel prefill degree (TPU-native knob): long-prompt
     # prefill rides ring attention over an sp mesh axis, decode unchanged
     # (parallel/sp_runner.py). Composes with tp_size > 1 (SPTPRunner),
-    # with int8/int4 on dense models (int4 via the QTensor4TP shard_map),
-    # and with prefix caching (round-5 chunk-ring hybrid); int4 x MoE x sp
-    # stays refused (MoE int4 shards on (ep, tp) meshes instead).
+    # with int8/int4 on dense AND MoE models (int4 via the QTensor4TP /
+    # expert shard_maps), and with prefix caching (round-5 chunk-ring
+    # hybrid).
     sp_size: int = 1                           # LLM_SP_SIZE
     # Pipeline-parallel serving degree (round 5): L/pp layers + L/pp KV
     # pages per chip, bf16 only — the capacity escape hatch when KV-head

@@ -455,14 +455,16 @@ def test_sp_only_int4_serving_matches_single_device(tiny_cfg, tiny_params):
     assert got.output_ids == ref.output_ids
 
 
-def test_sp_only_int4_tp_packed_serves_and_moe_guard(tiny_cfg, tiny_params):
+def test_sp_only_int4_tp_packed_and_moe_serve(tiny_cfg, tiny_params):
     """Round 5: a TP-packed (groups>1) int4 checkpoint SERVES on an
     sp-only mesh without repacking — the replicated wrap propagates the
     packing aux (QTensor4TP.groups) and the global matmul decodes grouped
     layouts per contiguous group (models/quant._dense4) — token-exact vs
     the standard-packed single-chip engine on the same logical weights
-    (grouped and ungrouped packing dequantize identically). MoE int4
-    stays refused on sp (the expert shard_map serves (ep, tp) meshes)."""
+    (grouped and ungrouped packing dequantize identically). int4 MoE
+    serves on sp too (the matrix's LAST refusal, lifted round 5): expert
+    stacks wrap over the size-1 (ep, tp) axes and the expert scan runs
+    replicated per sp chip while ring attention keeps the sp win."""
     from agentic_traffic_testing_tpu.models.quant import quantize_params
     from agentic_traffic_testing_tpu.parallel.sp_runner import SPPrefillRunner
 
@@ -488,8 +490,16 @@ def test_sp_only_int4_tp_packed_serves_and_moe_guard(tiny_cfg, tiny_params):
     mcfg = resolve_config("tiny-moe")
     mq = quantize_params(init_params(mcfg, jax.random.key(8),
                                      dtype=jnp.float32), scheme="int4")
-    with pytest.raises(NotImplementedError, match="int4 x MoE x sp"):
-        SPPrefillRunner(mcfg, mq, make_mesh(sp=2))
+    ecfg_m = EngineConfig(model="tiny-moe", dtype="float32",
+                          quantization="int4", num_blocks=64,
+                          max_model_len=128)
+    mprompt = [(19 * i + 4) % mcfg.vocab_size for i in range(41)]
+    ref_m = LLMEngine(ecfg_m, model_cfg=mcfg, params=mq).generate(
+        mprompt, samp)
+    got_m = LLMEngine(ecfg_m, model_cfg=mcfg,
+                      runner=SPPrefillRunner(mcfg, mq, make_mesh(sp=2))
+                      ).generate(mprompt, samp)
+    assert got_m.output_ids == ref_m.output_ids
 
 
 def test_sp_runner_rejects_trivial_axis(tiny_cfg, tiny_params):
