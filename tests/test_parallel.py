@@ -189,14 +189,18 @@ def test_sptp_moe_int8_serving_matches_single_device():
     assert got.output_ids == ref.output_ids
 
 
-@pytest.mark.parametrize("topology", ["tp", "sp", "sptp"])
+@pytest.mark.parametrize("topology", ["tp", "sp", "sptp", "pp"])
 @pytest.mark.parametrize("feature", ["fp8kv", "spec"])
 def test_feature_x_topology_matches_single_device(tiny_cfg, tiny_params,
                                                   topology, feature):
     """The README composition matrix, executable: fp8 KV pages and n-gram
-    speculation each compose with every serving topology (tp, sp, sp x tp)
-    token-exactly — the features live in the KV pool dtype and the decode
-    scan, orthogonal to how prefill/params shard."""
+    speculation each compose with every serving topology token-exactly —
+    the features live in the KV pool dtype and the decode scan,
+    orthogonal to how prefill/params shard. The pp column (round 5):
+    fp8 KV composes (the staged pool is just pages of another dtype);
+    speculation REFUSES by design (capacity ADR), and that refusal is the
+    matrix cell being pinned."""
+    from agentic_traffic_testing_tpu.parallel.pp_runner import PPRunner
     from agentic_traffic_testing_tpu.parallel.sp_runner import (
         SPPrefillRunner,
         SPTPRunner,
@@ -211,6 +215,10 @@ def test_feature_x_topology_matches_single_device(tiny_cfg, tiny_params,
     samp = SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True)
     spec_kw = dict(spec_tokens=3) if feature == "spec" else {}
 
+    if topology == "pp" and feature == "spec":
+        with pytest.raises(NotImplementedError, match="speculation"):
+            PPRunner(tiny_cfg, tiny_params, make_mesh(pp=2), **spec_kw)
+        return
     ref = LLMEngine(ecfg, model_cfg=tiny_cfg,
                     params=tiny_params).generate(prompt, samp)
     if topology == "tp":
@@ -218,6 +226,8 @@ def test_feature_x_topology_matches_single_device(tiny_cfg, tiny_params,
     elif topology == "sp":
         runner = SPPrefillRunner(tiny_cfg, tiny_params, make_mesh(sp=2),
                                  **spec_kw)
+    elif topology == "pp":
+        runner = PPRunner(tiny_cfg, tiny_params, make_mesh(pp=2))
     else:
         runner = SPTPRunner(tiny_cfg, tiny_params, make_mesh(sp=2, tp=2),
                             **spec_kw)
