@@ -72,6 +72,9 @@ class LLMMetrics:
         self.config_sp_size = Gauge(
             f"{prefix}_config_sp_size",
             "Sequence-parallel prefill degree (LLM_SP_SIZE)", registry=r)
+        self.config_pp_size = Gauge(
+            f"{prefix}_config_pp_size",
+            "Pipeline-parallel serving degree (LLM_PP_SIZE)", registry=r)
         self.kv_cache_num_gpu_blocks = Gauge(
             f"{prefix}_kv_cache_num_gpu_blocks",
             "KV cache: number of device blocks allocated; -1 means unknown",
@@ -167,13 +170,15 @@ class LLMMetrics:
 
     def set_config_gauges(self, *, max_num_seqs: int, max_num_batched_tokens: int,
                           memory_utilization: float, max_tokens: int,
-                          tp_size: int = 1, sp_size: int = 1) -> None:
+                          tp_size: int = 1, sp_size: int = 1,
+                          pp_size: int = 1) -> None:
         self.config_max_num_seqs.set(max_num_seqs)
         self.config_max_num_batched_tokens.set(max_num_batched_tokens)
         self.config_gpu_memory_utilization.set(memory_utilization)
         self.config_max_tokens.set(max_tokens)
         self.config_tp_size.set(tp_size)
         self.config_sp_size.set(sp_size)
+        self.config_pp_size.set(pp_size)
 
     def set_kv_gauges(self, *, num_blocks: int, block_size: int,
                       max_model_len: int, max_num_seqs: int) -> None:

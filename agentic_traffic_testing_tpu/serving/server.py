@@ -125,6 +125,7 @@ class LLMServer:
                 max_tokens=cfg.max_tokens,
                 tp_size=cfg.tp_size,
                 sp_size=cfg.sp_size,
+                pp_size=cfg.pp_size,
             )
             self.metrics.set_kv_gauges(
                 num_blocks=self.engine.cache.num_blocks - 1,  # exclude trash block

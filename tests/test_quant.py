@@ -495,3 +495,32 @@ def test_load_params_quantizes_like_in_memory_path(tmp_path):
     np.testing.assert_allclose(
         np.asarray(loaded["layers"]["wq"].scale),
         np.asarray(mem["layers"]["wq"].scale), rtol=1e-6)
+
+
+def test_llama8b_bf16_pp2_fits_where_single_chip_does_not():
+    """Capacity check for serving/configs/llama-3.1-8b-bf16-pp2.yaml: the
+    8B bf16 weight stack alone crowds a 16 GB v5e chip (this is why the
+    single-chip 8B profiles quantize), while pp=2 stages it — ~half the
+    layer stack AND half of every KV block per chip — so the UNQUANTIZED
+    model serves with the profile's KV working set in budget."""
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import init_params
+
+    cfg = resolve_config("llama-3.1-8b")
+    shapes = jax.eval_shape(
+        lambda: init_params(cfg, jax.random.key(0), dtype=jnp.bfloat16))
+    total = sum(int(np.prod(l.shape)) * l.dtype.itemsize
+                for l in jax.tree_util.tree_leaves(shapes))
+    # KV working set of the yaml profile: 8 seqs x 8192 tokens bf16 (8B
+    # head_dim is already lane-width 128, so the logical helper equals
+    # the phys footprint); the pool's layer axis shards over pp.
+    kv_full = cfg.kv_bytes_per_token() * 8 * 8192
+    hbm = 16 * 1024**3 * 0.90
+    # Single chip: weights + KV blow the budget (the profile's raison
+    # d'etre)...
+    assert total + kv_full > hbm
+    # ...pp=2: the layer stack halves (embeddings/unembed replicate) and
+    # so does every block's resident share.
+    embed = 2 * cfg.vocab_size * cfg.hidden_size * 2
+    per_chip = (total - embed) / 2 + embed + kv_full / 2
+    assert per_chip < hbm, per_chip / 1e9
