@@ -87,10 +87,16 @@ def _kernel(start_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
 
     @pl.when(jnp.logical_or(has_prior, has_inchunk))
     def _update():
-        q = q_ref[...].reshape(rows, hd).astype(jnp.float32) * scale
-        k = k_ref[...].reshape(kv_block, hd).astype(jnp.float32)
+        # MXU operands stay in the input dtype (bf16 in serving) with f32
+        # accumulation — f32xf32 passes run the MXU at ~1/4 rate. Scale is
+        # applied to the f32 scores, not the bf16 operand. (A masked/
+        # unmasked branch split was A/B'd on chip in round 5 and bought
+        # nothing — the kernel is bound by the VPU passes over the f32
+        # score tile, which both branches share.)
+        q = q_ref[...].reshape(rows, hd)
+        k = k_ref[...].reshape(kv_block, hd)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+                                preferred_element_type=jnp.float32) * scale
 
         kv_pos = min_kv + jax.lax.broadcasted_iota(
             jnp.int32, (rows, kv_block), 1)
@@ -108,8 +114,8 @@ def _kernel(start_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
         l_new = l_ref[:rows, 0:1] * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        v = v_ref[...].reshape(kv_block, hd).astype(jnp.float32)
-        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+        v = v_ref[...].reshape(kv_block, hd)
+        pv = jax.lax.dot_general(p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
                                  preferred_element_type=jnp.float32)
         acc_ref[:rows, :] = acc_ref[:rows, :] * alpha + pv
         m_ref[:rows, :] = jnp.broadcast_to(m_new, (rows, m_ref.shape[1]))

@@ -1,0 +1,60 @@
+#!/usr/bin/env python3
+"""A/B the solo-prefill attention implementations on the real chip.
+
+DEVICE time per call via the shared xplane harness (wall clock through
+the axon tunnel is unusable for kernels — see xplane_util docstring).
+Round-5 result at T=2048 (1B GQA layout 32:8, hd=64, bf16): first-party
+chunk_flash 0.41 ms/call vs library flash 0.54 — the in-tree kernel is
+~25% faster on device; the 5.92 ms the r4 wall-clock probe reported was
+tunnel serialization, not the kernel.
+
+Usage: python scripts/dev/flash_ab.py [T ...]
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+from scripts.dev.xplane_util import traced_device_ms
+from agentic_traffic_testing_tpu.ops.flash_prefill import (
+    _library_flash_attention,
+)
+from agentic_traffic_testing_tpu.ops.pallas.chunk_flash import (
+    causal_flash_attention,
+)
+
+N = 8  # varied input sets per implementation
+
+
+def main():
+    shapes = [int(a) for a in sys.argv[1:]] or [2048]
+    for t in shapes:
+        print(f"T={t} B=1 H=32 KH=8 hd=64 bf16:", flush=True)
+        args_list = [
+            (jax.random.normal(jax.random.key(3 * i), (1, t, 32, 64),
+                               jnp.bfloat16),
+             jax.random.normal(jax.random.key(3 * i + 1), (1, t, 8, 64),
+                               jnp.bfloat16),
+             jax.random.normal(jax.random.key(3 * i + 2), (1, t, 8, 64),
+                               jnp.bfloat16))
+            for i in range(N)
+        ]
+        for name, fn, match, tdir in (
+            ("first-party chunk_flash", jax.jit(causal_flash_attention),
+             "causal_flash", "/tmp/flash_ab_fp"),
+            ("library flash", jax.jit(_library_flash_attention),
+             "flash_attention", "/tmp/flash_ab_lib"),
+        ):
+            ms = traced_device_ms(fn, args_list, match, tdir)
+            print(f"  {name:<28s} {ms:8.3f} ms/call DEVICE", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,72 @@
+"""Shared xplane-trace device-timing harness for the dev perf scripts.
+
+Wall-clock loops through the axon tunnel are unusable for kernel timing:
+after any device-to-host transfer, per-dispatch wall time jumps to ~6 ms
+of serialized round trips regardless of the kernel, and the tunnel caches
+same-args dispatches into impossibly-fast readings (round-5 finding: the
+r4 probe read 5.92 ms wall for a 0.41 ms kernel). Device-plane op time
+from a `jax.profiler.trace` over VARIED inputs is the ground truth; this
+module is the one place that runs that measurement and parses the trace,
+so the validation probe and the A/B script cannot drift apart.
+`profile_decode.summarize()` keeps its richer per-op/idle-gap report.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+
+
+def device_op_time_ps(trace_dir: str, match: str) -> int:
+    """Sum device-plane exclusive-line event time (ps) for ops whose HLO
+    name contains `match`. Raises RuntimeError (NOT SystemExit — the
+    validation batch's @check wrapper must be able to record the failure
+    and keep going) if no trace was written."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not paths:
+        raise RuntimeError(f"no .xplane.pb under {trace_dir} — profiler "
+                           f"wrote no trace (plugin missing? dir unwritable?)")
+    xs = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    tot_ps = 0
+    for plane in xs.planes:
+        if "TPU" not in plane.name:
+            continue
+        names = dict(plane.event_metadata.items())
+        for line in plane.lines:
+            lname = line.name.lower()
+            # 'Async XLA Ops' spans overlap compute and a module-level
+            # line wraps its ops — either would double-count.
+            if "module" in lname or "async" in lname:
+                continue
+            for ev in line.events:
+                md = names.get(ev.metadata_id)
+                if md and match in md.name:
+                    tot_ps += ev.duration_ps
+    return tot_ps
+
+
+def traced_device_ms(fn, args_list, match: str, trace_dir: str) -> float:
+    """DEVICE ms/call for `fn` over `args_list` (one call per arg tuple —
+    vary the inputs or the tunnel's same-args caching deflates the
+    number). Compiles outside the trace, clears any stale trace dir, and
+    raises RuntimeError if no device event matched (HLO naming changed?)
+    so every caller fails loudly the same way."""
+    fn(*args_list[0]).block_until_ready()            # compile
+    import jax
+
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    with jax.profiler.trace(trace_dir):
+        outs = [fn(*a) for a in args_list]
+        for o in outs:
+            o.block_until_ready()
+    ms = device_op_time_ps(trace_dir, match) / 1e9 / len(args_list)
+    if ms == 0.0:
+        raise RuntimeError(f"no device events matching {match!r} in the "
+                           f"trace under {trace_dir} — filter broken?")
+    return ms

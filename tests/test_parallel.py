@@ -398,7 +398,8 @@ def test_prefix_caching_under_sptp(tiny_cfg, tiny_params):
     """The chunk-ring hybrid with heads tp-sharded (SPTPRunner): the
     gathered prior pages arrive KH-sharded over tp (the pool is tp-sharded
     there) and the ring shards the suffix over sp — cache hit token-exact
-    vs the single-device engine."""
+    vs the single-device engine. The deliberate multi-chunk prefill ladder
+    (the other refusal this mesh lifted) is pinned token-exact too."""
     from agentic_traffic_testing_tpu.parallel.sp_runner import SPTPRunner
 
     base = EngineConfig(model="tiny", dtype="float32", num_blocks=96,
@@ -415,6 +416,16 @@ def test_prefix_caching_under_sptp(tiny_cfg, tiny_params):
                                       make_mesh(sp=2, tp=2)))
     assert eng.generate(prompt, samp).output_ids == ref.output_ids  # miss
     assert eng.generate(prompt, samp).output_ids == ref.output_ids  # hit
+
+    # Multi-chunk prefill (70 tokens / 32-token chunks = 3 chunks, partial
+    # final) through the same ring_sp mode on the sp x tp mesh.
+    ec = EngineConfig(model="tiny", dtype="float32", num_blocks=96,
+                      max_model_len=256, prefill_chunk_tokens=32)
+    got = LLMEngine(ec, model_cfg=tiny_cfg,
+                    runner=SPTPRunner(tiny_cfg, tiny_params,
+                                      make_mesh(sp=2, tp=2))
+                    ).generate(prompt, samp)
+    assert got.output_ids == ref.output_ids
 
 
 def test_sp_shard_dma_decode_matches_gather(tiny_cfg, tiny_params,
@@ -520,9 +531,10 @@ def test_sp_runner_rejects_trivial_axis(tiny_cfg, tiny_params):
 
 
 def test_sptp_runner_guards(tiny_cfg, tiny_params):
-    """SPTPRunner refusals: single-axis meshes, int4 params, and the
-    engine-level chunk-path refusal all fail fast with actionable errors
-    (a silent fall-through would only surface at TPU serve time)."""
+    """SPTPRunner refusals that REMAIN after the round-5 chunk-ring hybrid
+    lifted the chunked/prefix-caching ones (those cells now have positive
+    token-exact tests below): single-axis meshes and ungrouped int4 params
+    still fail fast with actionable errors."""
     from agentic_traffic_testing_tpu.models.quant import quantize_params
     from agentic_traffic_testing_tpu.parallel.sp_runner import SPTPRunner
 
@@ -532,15 +544,14 @@ def test_sptp_runner_guards(tiny_cfg, tiny_params):
         # Ungrouped int4 packing needs the same attestation as plain TP.
         SPTPRunner(tiny_cfg, quantize_params(tiny_params, scheme="int4"),
                    make_mesh(sp=2, tp=2))
+    # Chunked prefill + prefix caching on the sp x tp mesh must CONSTRUCT
+    # now (the former refusals) — behavior is pinned token-exact by
+    # test_prefix_caching_under_sptp.
     runner = SPTPRunner(tiny_cfg, tiny_params, make_mesh(sp=2, tp=2))
-    with pytest.raises(ValueError, match="chunked"):
-        LLMEngine(EngineConfig(model="tiny", dtype="float32", num_blocks=64,
-                               max_model_len=8192, prefill_chunk_tokens=64),
-                  model_cfg=tiny_cfg, runner=runner)
-    with pytest.raises(ValueError, match="chunked"):
-        LLMEngine(EngineConfig(model="tiny", dtype="float32", num_blocks=64,
-                               max_model_len=128, prefix_caching=True),
-                  model_cfg=tiny_cfg, runner=runner)
+    LLMEngine(EngineConfig(model="tiny", dtype="float32", num_blocks=64,
+                           max_model_len=256, prefill_chunk_tokens=64,
+                           prefix_caching=True),
+              model_cfg=tiny_cfg, runner=runner)
 
 
 def test_sptp_serving_prefill_matches_single_device(tiny_cfg, tiny_params):
