@@ -247,9 +247,26 @@ def _int4_kernel_ok(rows: int, k: int, half: int, k_group: int = 0) -> bool:
     return half <= 512 or half % 128 == 0
 
 
-def _int4_n_block(half: int) -> int:
+def _int4_n_block(half: int, k: int) -> int:
+    """Output-column block for the int4 kernel at this [K, 2*half] shape.
+
+    The r5 on-chip n_block sweep (docs/BENCHMARKS.md round-5 section)
+    showed K-chunking costs 30-50%: a [14336, 4096] matmul runs 549 GB/s
+    effective at hb=128 (K monolithic) vs 362 at hb=256+ (K chunked). So
+    prefer the LARGEST hb whose [K, hb] i32 unpack intermediates keep K
+    monolithic under the kernel's scoped-VMEM budget; only when no hb
+    fits (K > ~15.6k) fall back to the widest tileable hb and let the
+    kernel's divisor-search pick the K chunk."""
+    from agentic_traffic_testing_tpu.ops.pallas.int4_matmul import (
+        VMEM_I32_BUDGET,
+    )
+
     if half <= 512:
         return 2 * half
+    fitting = [hb for hb in (512, 384, 256, 128)
+               if half % hb == 0 and k * hb * 4 <= VMEM_I32_BUDGET]
+    if fitting:
+        return 2 * fitting[0]
     for hb in (512, 384, 256, 128):
         if half % hb == 0:
             return 2 * hb
@@ -289,7 +306,7 @@ def _dense4(x: jax.Array, w: QTensor4, layer=None) -> jax.Array:
     x2 = x.reshape(rows, k)
     if _int4_kernel_ok(rows, k, half, k_group=kg):
         y = int4_matmul(x2, w.packed, w.scale, layer=0 if layer is None else layer,
-                        n_block=_int4_n_block(half), out_dtype=x.dtype)
+                        n_block=_int4_n_block(half, k), out_dtype=x.dtype)
     else:
         packed, scale = w.packed, w.scale
         if layer is not None:

@@ -39,6 +39,12 @@ ROW_BLOCK = 256
 #: int4 bytes stay below the XLA fallback's ~2.25x bf16-equivalent traffic
 #: (read packed + write bf16 + read bf16) up to ~2300 rows.
 MAX_KERNEL_ROWS = 2048
+#: Scoped-VMEM ceiling for the [k_blk, hb] i32 unpack intermediates. Shared
+#: with models/quant._int4_n_block: the n_block chooser prefers the largest
+#: hb that keeps K monolithic under this budget (K chunking measured ~30-50%
+#: slower on chip than a monolithic K at a narrower hb — r5 n_block sweep in
+#: docs/BENCHMARKS.md).
+VMEM_I32_BUDGET = 8_000_000
 
 
 def _kernel(layer_ref, x_ref, w_ref, s_ref, lo_out, hi_out, acc_e, acc_o, *,
@@ -130,13 +136,18 @@ def int4_matmul(x, packed, scale, layer=None, *, n_block: int = 512,
         raise ValueError(f"N/2={half} not a multiple of n_block/2={hb}")
     # Chunk K only when the i32 unpack intermediates would blow scoped VMEM
     # (~16 MB; a whole [14336, 512] i32 block alone is 29 MB) — chunking
-    # costs ~30% at shapes that fit, so small K stays monolithic.
+    # costs ~30-50% at shapes that fit (r5 on-chip sweep), so small K stays
+    # monolithic and a chunked K takes the LARGEST 128-multiple divisor
+    # under the budget (fewest accumulator round-trips), not a fixed pow2.
     k_blk = K
-    if K * hb * 4 > 8_000_000:
-        for cand in (2048, 1024, 512, 256, 128):
-            if K % cand == 0 and cand * hb * 4 <= 8_000_000:
-                k_blk = cand
-                break
+    if K * hb * 4 > VMEM_I32_BUDGET:
+        cap = VMEM_I32_BUDGET // (hb * 4)
+        best = 0
+        for cand in range(128, min(K, cap) + 1, 128):
+            if K % cand == 0:
+                best = cand
+        k_blk = best if best else K  # no tileable divisor: monolithic
+
     if grouped:
         if K % kg:
             raise ValueError(f"K={K} not divisible by Gk={gk} groups")

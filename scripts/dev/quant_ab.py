@@ -1,0 +1,158 @@
+#!/usr/bin/env python3
+"""A/B the round-5 quant hot spots on the real chip.
+
+Two open questions from the round-5 hardware sweep (docs/BENCHMARKS.md
+"Round-5" section):
+
+  1. 8B int4 ~= int8 at bs=32 and LOSES at bs=16 — where does the int4
+     kernel's per-step time go at the 8B's wide shapes?  A/B the
+     first-party int4 kernel vs the XLA int8 convert+dot vs plain bf16
+     at each 8B decode matmul shape, device-plane timed.
+  2. fp8-KV costs 29% of bs=32 decode throughput — is the e4m3->f32
+     VMEM cast inside the paged kernel really the whole story?  A/B the
+     dma2 paged-decode kernel with bf16 vs float8_e4m3fn pages at the
+     1B serving layout.
+
+DEVICE time per call via the shared xplane harness (wall clock through
+the axon tunnel is unusable for kernels — see xplane_util docstring).
+For the XLA int8/bf16 matmuls there is no stable HLO name to match, so
+this script sums ALL device-plane op time in a dedicated trace per
+variant (the traced region runs nothing else).
+
+Usage: python scripts/dev/quant_ab.py [matmul|paged]
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import shutil
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+N = 8  # varied input sets per variant
+
+
+def device_total_ms(fn, args_list, trace_dir: str) -> float:
+    """Total device-plane op ms/call (all ops — the trace runs only fn)."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    jax.block_until_ready(fn(*args_list[0]))
+    shutil.rmtree(trace_dir, ignore_errors=True)
+    with jax.profiler.trace(trace_dir):
+        outs = [fn(*a) for a in args_list]
+        jax.block_until_ready(outs)
+    paths = sorted(glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"),
+                             recursive=True))
+    if not paths:
+        raise RuntimeError(f"no .xplane.pb under {trace_dir}")
+    xs = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        xs.ParseFromString(f.read())
+    tot_ps = 0
+    for plane in xs.planes:
+        if "TPU" not in plane.name:
+            continue
+        for line in plane.lines:
+            lname = line.name.lower()
+            if "module" in lname or "async" in lname:
+                continue
+            for ev in line.events:
+                tot_ps += ev.duration_ps
+    ms = tot_ps / 1e9 / len(args_list)
+    if ms == 0.0:
+        raise RuntimeError(f"no device events in trace under {trace_dir}")
+    return ms
+
+
+def matmul_ab() -> None:
+    """int4 kernel vs int8 XLA vs bf16 at the llama-3.1-8b decode shapes."""
+    from agentic_traffic_testing_tpu.models.quant import (
+        quantize_array, quantize_array4,
+    )
+    from agentic_traffic_testing_tpu.models import quant
+
+    # (K, N): qkv fused, o-proj, gate+up fused, down-proj.
+    shapes = [(4096, 6144), (4096, 4096), (4096, 28672), (14336, 4096)]
+    for b in (32, 16):
+        print(f"--- 8B decode matmuls, rows={b} bf16 activations", flush=True)
+        for k, n in shapes:
+            key = jax.random.key(k + n)
+            w = jax.random.normal(key, (k, n), jnp.float32) * 0.02
+            q8 = quantize_array(w)          # QTensor (int8 + scale)
+            q4 = quantize_array4(w)         # QTensor4 (packed nibbles)
+            xs = [jax.random.normal(jax.random.key(7 * i), (b, k),
+                                    jnp.bfloat16) for i in range(N)]
+            stream_i4 = k * n / 2
+            stream_i8 = k * n
+            stream_bf = k * n * 2
+
+            def f_bf16(x, _w=jnp.asarray(w, jnp.bfloat16)):
+                return x @ _w
+
+            def f_int8(x, _q=q8):
+                return quant.dense(x, _q)
+
+            def f_int4(x, _q=q4):
+                return quant.dense(x, _q)
+
+            row = [f"  [{k:>5d},{n:>5d}]"]
+            for name, fn, byts in (("bf16", f_bf16, stream_bf),
+                                   ("int8", f_int8, stream_i8),
+                                   ("int4", f_int4, stream_i4)):
+                ms = device_total_ms(jax.jit(fn), [(x,) for x in xs],
+                                     f"/tmp/quant_ab_{name}_{k}_{n}_{b}")
+                gbs = byts / (ms / 1e3) / 1e9
+                row.append(f"{name} {ms:7.3f} ms ({gbs:5.0f} GB/s eff)")
+            print("  ".join(row), flush=True)
+
+
+def paged_ab() -> None:
+    """dma2 paged decode: bf16 vs fp8 pages at the 1B serving layout."""
+    from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
+        paged_attention_decode_dma2,
+    )
+
+    b, h, kh, hd, bs = 32, 32, 8, 64, 16
+    ctx = 176                      # ~128-token prompt + mid-completion
+    blocks_per = (ctx + bs - 1) // bs
+    nb = b * blocks_per + 1        # + trash block 0
+    max_blocks = blocks_per
+    bt = jnp.arange(1, nb, dtype=jnp.int32).reshape(b, max_blocks)
+    cl = jnp.full((b,), ctx, jnp.int32)
+
+    for dtype, tag in ((jnp.bfloat16, "bf16"), (jnp.float8_e4m3fn, "fp8")):
+        args_list = []
+        for i in range(N):
+            kk = jax.random.key(17 * i)
+            q = jax.random.normal(kk, (b, h, hd), jnp.bfloat16)
+            kp = (jax.random.normal(jax.random.key(17 * i + 1),
+                                    (kh, nb, bs, hd), jnp.bfloat16)
+                  .astype(dtype))
+            vp = (jax.random.normal(jax.random.key(17 * i + 2),
+                                    (kh, nb, bs, hd), jnp.bfloat16)
+                  .astype(dtype))
+            args_list.append((q, kp, vp, bt, cl))
+        fn = jax.jit(paged_attention_decode_dma2)
+        ms = device_total_ms(fn, args_list, f"/tmp/quant_ab_paged_{tag}")
+        kvb = 2 * kh * b * blocks_per * bs * hd * dtype(0).itemsize
+        print(f"  paged dma2 {tag:<5s} pages: {ms:7.3f} ms/call DEVICE "
+              f"({kvb / 1e6:.1f} MB KV streamed)", flush=True)
+
+
+def main() -> None:
+    which = sys.argv[1] if len(sys.argv) > 1 else "all"
+    print(f"devices: {jax.devices()}", flush=True)
+    if which in ("matmul", "all"):
+        matmul_ab()
+    if which in ("paged", "all"):
+        paged_ab()
+
+
+if __name__ == "__main__":
+    main()
