@@ -35,11 +35,12 @@ from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
     paged_attention_decode,
     paged_attention_decode_dma,
     paged_attention_decode_dma2,
+    paged_attention_decode_dma3,
 )
 from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
 
 
-VALID_MODES = ("auto", "dma", "dma2", "pallas", "interpret", "gather",
+VALID_MODES = ("auto", "dma", "dma2", "dma3", "pallas", "interpret", "gather",
                "shard_dma")
 
 
@@ -103,6 +104,12 @@ def paged_decode_attention(
         return out[:, None] if s == 1 else out
     if mode == "dma2":
         out = paged_attention_decode_dma2(
+            q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
+            ctx_lens, layer=lay,
+        )
+        return out[:, None] if s == 1 else out
+    if mode == "dma3":
+        out = paged_attention_decode_dma3(
             q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
             ctx_lens, layer=lay,
         )

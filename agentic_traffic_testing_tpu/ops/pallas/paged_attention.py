@@ -393,13 +393,30 @@ def _dma2_decode_kernel(
 
     def issue(ci, slot):
         for p in range(cp):
-            page_copy(ci, p, slot, k_hbm, k_buf, 0).start()
-            page_copy(ci, p, slot, v_hbm, v_buf, 1).start()
+            @pl.when(ci * cp + p < n_pages)
+            def _start(p=p):
+                page_copy(ci, p, slot, k_hbm, k_buf, 0).start()
+                page_copy(ci, p, slot, v_hbm, v_buf, 1).start()
 
     def wait(ci, slot):
         for p in range(cp):
-            page_copy(ci, p, slot, k_hbm, k_buf, 0).wait()
-            page_copy(ci, p, slot, v_hbm, v_buf, 1).wait()
+            @pl.when(ci * cp + p < n_pages)
+            def _wait(p=p):
+                page_copy(ci, p, slot, k_hbm, k_buf, 0).wait()
+                page_copy(ci, p, slot, v_hbm, v_buf, 1).wait()
+
+    # Tail-chunk pages past n_pages are never copied (the pl.when guards
+    # above — a ~40% byte saving at bench's ~150-token contexts), so their
+    # buffer slots can hold uninitialized VMEM on the first use of each
+    # double-buffer slot. Stale K is harmless (its scores are overwritten
+    # with _NEG_INF by the pos mask, which also replaces NaN), but stale V
+    # rides `p_ @ v` where masked p_ is exactly 0.0 — and 0 * NaN = NaN.
+    # One zero-fill of the V buffers in the first grid program makes every
+    # stale V slot a finite 0 forever (later programs only ever leave
+    # previously-DMA'd finite data behind).
+    @pl.when(b == 0)
+    def _zero_v():
+        v_buf[...] = jnp.zeros_like(v_buf)
 
     issue(0, 0)
     q = q_ref[0].astype(jnp.float32) * scale                 # [KH, rows, hd]
@@ -508,7 +525,264 @@ def paged_attention_decode_dma2(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel",),
+            # "arbitrary" pins sequential grid order: the one-time V-buffer
+            # zero-fill in program 0 must precede every other program's
+            # guarded tail-chunk reads of those buffers.
+            dimension_semantics=("arbitrary",),
+        ),
+        interpret=interpret,
+    )(*prefetch_args, block_tables.astype(jnp.int32),
+      ctx_lens.astype(jnp.int32)[:, None], q_r, k_pages, v_pages)
+    return _unpack_gqa_out(out, kh, meta)
+
+
+def _dma3_decode_kernel(
+    *refs,
+    scale: float,
+    pages_per_chunk: int,
+    n_chunk_steps: int,
+    stacked: bool,
+    q_per_seq: int = 1,
+    queries_per_kv: int = 1,
+):
+    """Decode kernel v4: grid (B, C) — the chunk walk IS the second grid
+    dim, and each step prefetches the NEXT grid step's chunk (even across
+    sequence boundaries).
+
+    v3 (_dma2_decode_kernel) runs one grid program per sequence with the
+    chunk loop inside: the first chunk's DMA latency is exposed at every
+    program start, and at bench.py's shapes (B=32, ~2 chunks/seq) those 32
+    serial stalls are most of the kernel's off-roofline time (~2 us x 32 of
+    a ~69 us call). Here the double-buffered chunk pipeline spans the whole
+    grid walk in linear step order t = b*C + ci, so only chunk t=0 ever
+    stalls; the flash-softmax running stats ride VMEM scratch between chunk
+    steps of the same sequence.
+
+    Tail chunks (ci*cp >= n_pages) issue no DMA at all — their compute runs
+    fully masked on whatever the buffers hold (finite by the one-time V
+    zero-fill below + K's mask-replaces-NaN property).
+
+    Ref order: [layer_ref?], block_tables_ref [B, W] (SMEM), ctx_lens_ref
+    [B, 1] (SMEM), q_ref [1, KH, rows, hd] (VMEM), k_hbm/v_hbm (ANY: full
+    pool), o_ref [1, KH, rows, hd], k_buf/v_buf [2, KH, CP*bs, hd] VMEM
+    scratch, m_buf/l_buf [KH, R, 128] f32 scratch, acc_buf [KH, R, hd] f32
+    scratch, sems DMA-semaphore array [2, 2]."""
+    if stacked:
+        layer_ref = refs[0]
+        (bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
+         k_buf, v_buf, m_buf, l_buf, acc_buf, rc_ref, sems) = refs[1:]
+    else:
+        layer_ref = None
+        (bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
+         k_buf, v_buf, m_buf, l_buf, acc_buf, rc_ref, sems) = refs
+    bi = pl.program_id(0)
+    ci = pl.program_id(1)
+    n_b = pl.num_programs(0)
+    c = n_chunk_steps
+    cp = pages_per_chunk
+    kh = k_buf.shape[1]
+    bs = k_buf.shape[2] // cp
+    hd = k_buf.shape[3]
+    rows = q_ref.shape[2]
+    w = bt_ref.shape[1]
+    t = bi * c + ci
+
+    def n_pages_of(b):
+        return jax.lax.div(cl_ref[b, 0] + (q_per_seq - 1) + bs - 1, bs)
+
+    def page_copy(b, cj, p, slot, kv_hbm, buf, sem_col):
+        pi = jnp.minimum(cj * cp + p, w - 1)
+        blk = bt_ref[b, pi]
+        if stacked:
+            src = kv_hbm.at[layer_ref[0], :, blk]      # [KH, bs, hd] strided
+        else:
+            src = kv_hbm.at[:, blk]
+        return pltpu.make_async_copy(
+            src, buf.at[slot, :, pl.ds(p * bs, bs), :], sems.at[slot, sem_col]
+        )
+
+    def issue(b, cj, slot):
+        np_b = n_pages_of(b)
+        for p in range(cp):
+            @pl.when(cj * cp + p < np_b)
+            def _start(p=p):
+                page_copy(b, cj, p, slot, k_hbm, k_buf, 0).start()
+                page_copy(b, cj, p, slot, v_hbm, v_buf, 1).start()
+
+    def wait(b, cj, slot):
+        np_b = n_pages_of(b)
+        for p in range(cp):
+            @pl.when(cj * cp + p < np_b)
+            def _wait(p=p):
+                page_copy(b, cj, p, slot, k_hbm, k_buf, 0).wait()
+                page_copy(b, cj, p, slot, v_hbm, v_buf, 1).wait()
+
+    np_bi = n_pages_of(bi)
+    real = ci * cp < np_bi        # this chunk holds >= 1 real page
+
+    # First grid step: make every stale V slot finite forever (see the
+    # _dma2_decode_kernel note — masked p_ is exactly 0.0 but 0 * NaN from
+    # uninitialized VMEM would poison `p_ @ v`), then start the pipeline.
+    # rc_ref counts REAL chunks processed: buffer slots alternate on that
+    # count (not on t — masked steps issue no DMA and must not flip parity).
+    @pl.when(t == 0)
+    def _prologue():
+        rc_ref[0] = 0
+        v_buf[...] = jnp.zeros_like(v_buf)
+        issue(0, 0, 0)
+
+    @pl.when(real)
+    def _real_chunk():
+        rc = rc_ref[0]
+        slot = jax.lax.rem(rc, 2)
+
+        # Prefetch real chunk rc+1 — (bi, ci+1) if this row has one, else
+        # (bi+1, 0) (every row has >= 1 real chunk: ctx >= 1 always).
+        # Issued into the other buffer slot, whose previous occupant
+        # (real chunk rc-1) was consumed in an earlier grid step.
+        same_row = (ci + 1) * cp < np_bi
+        nb = jnp.where(same_row, bi, bi + 1)
+        nc = jnp.where(same_row, ci + 1, 0)
+
+        @pl.when(nb < n_b)
+        def _prefetch():
+            issue(nb, nc, jax.lax.rem(rc + 1, 2))
+
+        wait(bi, ci, slot)
+
+        ctx = cl_ref[bi, 0]
+        q = q_ref[0].astype(jnp.float32) * scale             # [KH, rows, hd]
+        k = k_buf[slot].astype(jnp.float32)                  # [KH, cp*bs, hd]
+        v = v_buf[slot].astype(jnp.float32)
+        s = jax.lax.dot_general(                             # [KH, rows, cp*bs]
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        pos = ci * cp * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (kh, rows, cp * bs), 2)
+        row_off = (jax.lax.broadcasted_iota(
+            jnp.int32, (kh, rows, cp * bs), 1) // queries_per_kv)
+        s = jnp.where(pos < ctx + row_off, s, _NEG_INF)
+
+        @pl.when(ci == 0)
+        def _init_stats():
+            m_buf[:, :rows, :] = jnp.full(
+                (kh, rows, m_buf.shape[2]), _NEG_INF, jnp.float32)
+            l_buf[:, :rows, :] = jnp.zeros(
+                (kh, rows, l_buf.shape[2]), jnp.float32)
+            acc_buf[:, :rows, :] = jnp.zeros((kh, rows, hd), jnp.float32)
+
+        m = m_buf[:, :rows, :1]                              # [KH, rows, 1]
+        l = l_buf[:, :rows, :1]
+        acc = acc_buf[:, :rows, :]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        p_ = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p_, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(                            # [KH, rows, hd]
+            p_, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        m_buf[:, :rows, :] = jnp.broadcast_to(
+            m_new, (kh, rows, m_buf.shape[2]))
+        l_buf[:, :rows, :] = jnp.broadcast_to(
+            l_new, (kh, rows, l_buf.shape[2]))
+        acc_buf[:, :rows, :] = acc * alpha + pv
+        rc_ref[0] = rc + 1
+
+    # Masked chunks (ci*cp >= n_pages) cost only this branch check; the
+    # finalize still runs on the row's last step, reading the running stats
+    # back out of scratch (the row's real chunks all precede it in grid
+    # order, so the scratch is complete by now).
+    @pl.when(ci == c - 1)
+    def _finish():
+        o_ref[0] = (acc_buf[:, :rows, :]
+                    / jnp.maximum(l_buf[:, :rows, :1], 1e-30)
+                    ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("scale", "pages_per_chunk", "interpret")
+)
+def paged_attention_decode_dma3(
+    q: jax.Array,             # [B, H, hd] or [B, S, H, hd]
+    k_pages: jax.Array,       # [KH, nb, bs, hd] or [L, KH, nb, bs, hd]
+    v_pages: jax.Array,       # same shape as k_pages
+    block_tables: jax.Array,  # [B, max_blocks] i32
+    ctx_lens: jax.Array,      # [B] i32 — context of query token 0
+    *,
+    layer: jax.Array | None = None,
+    scale: float | None = None,
+    pages_per_chunk: int = 16,
+    interpret: bool = False,
+) -> jax.Array:
+    """Decode paged attention, cross-sequence-pipelined variant
+    (_dma3_decode_kernel). Same contract as paged_attention_decode_dma2;
+    grid is (B, ceil(max_blocks/pages_per_chunk)) and each real chunk
+    prefetches the next real chunk (across sequence boundaries), so
+    chunk-0 DMA latency is exposed once per call instead of once per
+    sequence. Chunks past a sequence's last page skip DMA and compute
+    entirely. Default pages_per_chunk=16 (vs dma2's 8): the per-chunk
+    dot dispatch overhead on the tiny GQA row tile is the next cost
+    after DMA, so fewer, wider chunks win (measured on v5e:
+    scripts/dev/paged_decode_ab.py)."""
+    stacked = k_pages.ndim == 5
+    if stacked and layer is None:
+        raise ValueError("stacked (5D) pages require a layer index")
+    kh, bs, hd_page = k_pages.shape[-4], k_pages.shape[-2], k_pages.shape[-1]
+    max_blocks = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    cp = min(pages_per_chunk, max_blocks)
+    c = (max_blocks + cp - 1) // cp
+
+    q_r, meta = _pack_gqa_q(q, kh, hd_page)
+    _, b, s_q, qpk, _, _ = meta
+    rows = s_q * qpk
+    hd = hd_page
+    r_pad = max(rows, _MIN_SUBLANES)
+    if stacked:
+        def q_map(bi, ci, lay, bt, cl):
+            return (bi, 0, 0, 0)
+        prefetch_args = (jnp.asarray(layer, jnp.int32).reshape(1),)
+    else:
+        def q_map(bi, ci, bt, cl):
+            return (bi, 0, 0, 0)
+        prefetch_args = ()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2 + len(prefetch_args),
+        grid=(b, c),
+        in_specs=[
+            pl.BlockSpec((1, kh, rows, hd), q_map),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, kh, rows, hd), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((2, kh, cp * bs, hd), k_pages.dtype),
+            pltpu.VMEM((2, kh, cp * bs, hd), k_pages.dtype),
+            pltpu.VMEM((kh, r_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((kh, r_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((kh, r_pad, hd), jnp.float32),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _dma3_decode_kernel, scale=scale, pages_per_chunk=cp,
+            n_chunk_steps=c, stacked=stacked, q_per_seq=s_q,
+            queries_per_kv=qpk,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            # sequential grid order is load-bearing: the cross-step
+            # prefetch and the one-time V zero-fill both assume linear
+            # t = b*C + ci execution.
+            dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,
     )(*prefetch_args, block_tables.astype(jnp.int32),

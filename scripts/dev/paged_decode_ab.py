@@ -1,0 +1,94 @@
+#!/usr/bin/env python3
+"""Micro-bench the paged decode attention kernel at bench.py's live shapes.
+
+The round-5 bs=32 trace (scripts/dev/profile_decode.py) shows
+paged_attention_decode_dma2 at ~76 us/call while the KV bytes actually
+resident for the mean ~150-token contexts stream in ~28 us at HBM rate —
+the kernel is the single largest off-roofline item in the decode step.
+Two over-read sources are visible in the kernel source:
+
+  * tail-chunk ceiling: the chunk loop copies `pages_per_chunk` full pages
+    per chunk even when the last chunk holds fewer real pages (clamped
+    index re-copies page w-1), a ~60% byte over-read at 10 pages/seq;
+  * lane padding: the pool pads head_dim 64 -> 128, doubling every byte.
+
+This harness times the kernel in isolation (xplane device-plane, same
+methodology as flash_ab.py) at the bench workload's shapes so fixes can be
+A/B'd without a full bench run.
+
+Usage: python scripts/dev/paged_decode_ab.py [ctx] [batch] [pages_per_chunk]
+                                             [block_size] [hd_pool]
+Env: PAGED_AB_KERNEL=dma2|dma3 (default dma2).
+No reference analog (the reference delegates paging to vLLM).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+from scripts.dev.quant_ab import device_total_ms
+
+N = 8
+
+
+def main() -> None:
+    argv = [int(a) for a in sys.argv[1:]]
+    ctx = argv[0] if len(argv) > 0 else 150
+    b = argv[1] if len(argv) > 1 else 32
+    cp = argv[2] if len(argv) > 2 else 8
+
+    from agentic_traffic_testing_tpu.ops.pallas import paged_attention as pa
+
+    kname = os.environ.get("PAGED_AB_KERNEL", "dma2")
+    kernel = {"dma2": pa.paged_attention_decode_dma2,
+              "dma3": pa.paged_attention_decode_dma3}[kname]
+
+    # bench.py 1B layout: 16 layers, 8 kv heads, 512 blocks of 16, hd
+    # lane-padded to 128 (real head_dim 64). Block size and pool hd are
+    # overridable to A/B page granularity and padding (pool token capacity
+    # is held constant at 8192).
+    L, KH, BS, HD = 16, 8, 16, 128
+    BS = argv[3] if len(argv) > 3 else BS
+    HD = argv[4] if len(argv) > 4 else HD
+    NB = 8192 // BS
+    H = 32
+    hd_real = 64
+    print(f"devices: {jax.devices()}  ctx={ctx} B={b} cp={cp} "
+          f"pool=[{L},{KH},{NB},{BS},{HD}]", flush=True)
+
+    max_blocks = NB // max(b, 1)
+    n_pages = (ctx + BS - 1) // BS
+    assert n_pages <= max_blocks
+
+    key = jax.random.key(0)
+    kp = jax.random.normal(key, (L, KH, NB, BS, HD), jnp.bfloat16)
+    vp = jax.random.normal(key, (L, KH, NB, BS, HD), jnp.bfloat16)
+    bt = jnp.arange(b * max_blocks, dtype=jnp.int32).reshape(b, max_blocks) % NB
+    cl = jnp.full((b,), ctx, jnp.int32)
+    qs = [jax.random.normal(jax.random.key(i), (b, H, HD), jnp.bfloat16)
+          for i in range(N)]
+
+    lay = jnp.int32(3)
+
+    def fn(q):
+        return kernel(q, kp, vp, bt, cl, layer=lay, pages_per_chunk=cp)
+
+    ms = device_total_ms(fn, [(q,) for q in qs], "/tmp/paged_decode_ab")
+    # real KV bytes at this context (unpadded head dim), vs copied bytes
+    # (tail-guarded: only n_pages pages per sequence are DMA'd)
+    real = b * ctx * KH * hd_real * 2 * 2
+    copied = b * n_pages * BS * KH * HD * 2 * 2
+    print(f"  {kname} cp={cp} bs={BS} hd={HD}: {ms * 1e3:8.1f} us/call DEVICE  "
+          f"(copied {copied / 1e6:.1f} MB -> {copied / (ms / 1e3) / 1e9:5.0f} "
+          f"GB/s; real KV {real / 1e6:.1f} MB)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

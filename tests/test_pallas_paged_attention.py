@@ -22,6 +22,7 @@ from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
     paged_attention_decode,
     paged_attention_decode_dma,
     paged_attention_decode_dma2,
+    paged_attention_decode_dma3,
 )
 from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK, gather_kv
 
@@ -29,6 +30,7 @@ KERNELS = {
     "v1": paged_attention_decode,
     "dma": paged_attention_decode_dma,
     "dma2": paged_attention_decode_dma2,
+    "dma3": paged_attention_decode_dma3,
 }
 
 
