@@ -37,7 +37,10 @@ from agentic_traffic_testing_tpu.models.quant import (
     dense,
     embed_lookup,
 )
-from agentic_traffic_testing_tpu.ops.attention_backend import paged_decode_attention
+from agentic_traffic_testing_tpu.ops.attention_backend import (
+    hybrid_ragged_attention,
+    paged_decode_attention,
+)
 from agentic_traffic_testing_tpu.ops.kv_writer import write_prompt_pages
 from agentic_traffic_testing_tpu.ops.jnp_ops import (
     apply_rope,
@@ -703,6 +706,107 @@ def verify_step_impl(
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     return _unembed(x, params, cfg), KVCache(kc, vc)
+
+
+def hybrid_step_impl(
+    params: Params,
+    cfg: ModelConfig,
+    dec_tokens: jax.Array,    # [B] decode input token per lane
+    chunk_tokens: jax.Array,  # [1, C] one prefill chunk; C % block_size == 0
+    cache: KVCache,           # donated
+    block_tables: jax.Array,  # [B+1, max_blocks] — row B is the chunk's
+    positions: jax.Array,     # [B] position of each decode token
+    chunk_start: jax.Array,   # scalar i32 — absolute position of chunk_tokens[0, 0]
+    chunk_len: jax.Array,     # scalar i32 — real (unpadded) tokens in the chunk
+    attn_mode: Optional[str] = None,  # static; None=auto | "ragged" | "gather"
+) -> tuple[jax.Array, jax.Array, KVCache]:
+    """HYBRID step: one fused ragged pass over B decode lanes + one prefill
+    chunk. Returns (decode next-token logits [B, V] fp32, chunk last-token
+    logits [1, V] fp32 — meaningful only on the final chunk — and the
+    updated cache).
+
+    This is the dispatch-level fusion the serial engine lacks: a decode
+    step and a chunk no longer run as two device programs with the decode
+    lanes idle behind the chunk's weight streaming — every matmul in the
+    layer body runs over the flattened B + C token stream, and attention
+    runs the ragged paged kernel (ops/pallas/ragged_paged_attention) in
+    one grid. KV is written verify-style BEFORE attention each layer —
+    per-lane DUS for the decode tokens, per-page DUS for the chunk (its
+    blocks are private suffix blocks, so no sharer observes a rewrite) —
+    which makes the ragged contract (token a of a row attends slots <
+    position + a + 1) hold uniformly for both row kinds. Numerics per row
+    therefore match decode_step_impl / prefill_chunk_impl's gather site
+    exactly; tests/test_hybrid_batch.py pins token parity.
+    """
+    b = dec_tokens.shape[0]
+    _, c = chunk_tokens.shape
+    bs = cache.block_size
+    if c % bs != 0:
+        raise ValueError(f"chunk length {c} not a multiple of block_size {bs}")
+    tokens_flat = jnp.concatenate([dec_tokens, chunk_tokens[0]])      # [T]
+    chunk_pos = chunk_start + jnp.arange(c, dtype=jnp.int32)
+    pos_flat = jnp.concatenate([positions, chunk_pos])[None]          # [1, T]
+    row_pos = jnp.concatenate([positions, chunk_start[None]])         # [B+1]
+    x = embed_lookup(params["tok_embed"], tokens_flat[None],
+                     dtype=params["final_norm"].dtype)                # [1, T, D]
+    sin, cos = rope_sin_cos(pos_flat, cfg.head_dim_, cfg.rope_theta,
+                            cfg.rope_scaling)
+    t = b + c
+    hd = cfg.head_dim_
+    capacity = block_tables.shape[1] * bs
+    q_lens = (1,) * b + (c,)
+
+    xs_layers, held = _scan_split(params["layers"])
+
+    def body(carry, xs):
+        x, kc, vc = carry
+        xs_lp, li = xs
+        lp = _merge_lp(xs_lp, held, li)
+        xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
+        q, k, v = _qkv(xa, lp, cfg)
+        q = apply_rope(q, sin, cos)
+        k = apply_rope(k, sin, cos)
+        # Decode lanes: one chained-DUS write each (in place on TPU).
+        ok = positions < capacity
+        kc = kvc.write_decode_kv_full(kc, li, k[0, :b], block_tables[:b],
+                                      positions, valid=ok)
+        vc = kvc.write_decode_kv_full(vc, li, v[0, :b], block_tables[:b],
+                                      positions, valid=ok)
+        # Chunk: whole-page DUS writes (C/bs per layer, not C) at the
+        # table-column offset — garbage tail slots beyond chunk_len land
+        # in slots nothing ever reads (same contract as write_prompt_pages
+        # on the serial chunk path).
+        k_pages = k[0, b:].transpose(1, 0, 2)                 # [KH, C, hd]
+        v_pages = v[0, b:].transpose(1, 0, 2)
+        first_block = chunk_start // bs
+        zero = jnp.int32(0)
+        for p in range(c // bs):
+            blk = block_tables[b, first_block + p]
+            kup = k_pages[:, p * bs:(p + 1) * bs][None, :, None]  # [1,KH,1,bs,hd]
+            vup = v_pages[:, p * bs:(p + 1) * bs][None, :, None]
+            kc = jax.lax.dynamic_update_slice(
+                kc, kup.astype(kc.dtype), (li, zero, blk, zero, zero))
+            vc = jax.lax.dynamic_update_slice(
+                vc, vup.astype(vc.dtype), (li, zero, blk, zero, zero))
+        attn = hybrid_ragged_attention(q[0], kc, vc, block_tables, row_pos,
+                                       q_lens, mode=attn_mode, layer=li)
+        x = x + dense(attn.reshape(1, t, -1), lp["wo"])
+        xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
+        y, _ = _mlp_block(xm, lp, cfg)  # serving paths drop the MoE aux term
+        x = x + y
+        return (x, kc, vc), None
+
+    (x, kc, vc), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v),
+        (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)),
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    # One unembed over B decode rows + the chunk's last REAL token row.
+    last_chunk = jnp.take_along_axis(
+        x, (b + jnp.maximum(chunk_len - 1, 0))[None, None, None], axis=1)
+    sel = jnp.concatenate([x[:, :b], last_chunk], axis=1)     # [1, B+1, D]
+    logits = _unembed(sel, params, cfg)[0]                    # [B+1, V]
+    return logits[:b], logits[b:], KVCache(kc, vc)
 
 
 # Jitted conveniences (tests, simple offline use). The serving engine builds

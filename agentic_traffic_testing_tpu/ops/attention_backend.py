@@ -8,6 +8,14 @@ backend). Override with ATT_TPU_ATTENTION:
     auto      (default) dma2 on TPU, gather on CPU/GPU
     dma2      grid-(B,) kernel, each page DMA carries all KV heads (8x fewer
               descriptors than dma — the decisive cost at short context)
+    dma3      grid-(B,C) kernel: the chunk walk is the second grid dim and
+              each real chunk prefetches the next across sequence
+              boundaries, so chunk-0 DMA latency is exposed once per call
+              instead of once per sequence
+    ragged    q-block-grid ragged kernel (ops/pallas/ragged_paged_attention)
+              — the hybrid prefill+decode batch path; on the decode shape
+              it runs every lane as a 1-token ragged row (interpret mode
+              engages automatically off-TPU)
     dma       grid-(B,KH) kernel, double-buffered manual page DMA
     pallas    v1 kernel, one BlockSpec pipeline step per page (slower at
               short context: ~2-3 us grid overhead per 2 KB page)
@@ -37,11 +45,15 @@ from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
     paged_attention_decode_dma2,
     paged_attention_decode_dma3,
 )
+from agentic_traffic_testing_tpu.ops.pallas.ragged_paged_attention import (
+    ragged_paged_attention,
+    ragged_paged_attention_ref,
+)
 from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
 
 
-VALID_MODES = ("auto", "dma", "dma2", "dma3", "pallas", "interpret", "gather",
-               "shard_dma")
+VALID_MODES = ("auto", "dma", "dma2", "dma3", "ragged", "pallas", "interpret",
+               "gather", "shard_dma")
 
 
 def backend_choice() -> str:
@@ -114,6 +126,17 @@ def paged_decode_attention(
             ctx_lens, layer=lay,
         )
         return out[:, None] if s == 1 else out
+    if mode == "ragged":
+        # Decode (or verify) batch as the uniform special case of a ragged
+        # batch: every lane is one s-token row. Verify semantics line up —
+        # row token a attends slots < positions + a + 1 in both contracts.
+        b, _, h, hd = q.shape
+        out = ragged_paged_attention(
+            q.reshape(b * s, h, hd), k_pages, v_pages, block_tables,
+            positions, (s,) * b, layer=lay,
+            interpret=jax.default_backend() != "tpu",
+        )
+        return out.reshape(b, s, h, hd)
     if mode in ("pallas", "interpret"):
         out = paged_attention_decode(
             q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
@@ -130,6 +153,39 @@ def paged_decode_attention(
     return causal_attention(
         q, k_all, v_all, q_positions=q_positions, kv_valid_len=positions + s
     )
+
+
+def hybrid_ragged_attention(
+    q,             # [T, H, hd] flattened ragged query tokens
+    k_pages,       # [KH, nb, bs, hd] or [L, KH, nb, bs, hd] stacked
+    v_pages,
+    block_tables,  # [R, max_blocks]
+    positions,     # [R] position of each row's first query token
+    q_lens: tuple[int, ...],   # static; sum == T
+    mode: str | None = None,
+    layer=None,
+):
+    """Ragged-batch attention dispatch for the hybrid prefill+decode step.
+
+    The Pallas ragged kernel on TPU, the jnp grouped-gather oracle
+    elsewhere (the oracle outruns interpret mode on CPU, the same split
+    every other backend mode makes). `mode` forces one path: "ragged"
+    (kernel; interpret engages automatically off-TPU) or "gather"."""
+    if mode is None:
+        mode = "ragged" if jax.default_backend() == "tpu" else "gather"
+    if mode == "ragged":
+        return ragged_paged_attention(
+            q, k_pages, v_pages, block_tables, positions, q_lens,
+            layer=layer, interpret=jax.default_backend() != "tpu",
+        )
+    if mode != "gather":
+        # A typo'd hybrid_attn_mode must not silently serve the slow
+        # gather oracle on device.
+        raise ValueError(
+            f"hybrid attention mode {mode!r} invalid; choose 'ragged' or "
+            f"'gather'")
+    return ragged_paged_attention_ref(
+        q, k_pages, v_pages, block_tables, positions, q_lens, layer=layer)
 
 
 def _shard_dma_attention(q, k_pages, v_pages, block_tables, ctx_lens, layer,

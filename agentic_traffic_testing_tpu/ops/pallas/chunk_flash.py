@@ -50,6 +50,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from agentic_traffic_testing_tpu.ops.pallas.tpu_compat import CompilerParams
+
 _NEG_INF = -1e30
 
 
@@ -207,7 +209,7 @@ def chunk_flash_attention(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((kh, c * qpk, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -285,7 +287,7 @@ def causal_flash_attention(
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((b, kh, t * qpk, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),

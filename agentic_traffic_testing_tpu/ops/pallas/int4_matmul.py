@@ -32,6 +32,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from agentic_traffic_testing_tpu.ops.pallas.tpu_compat import CompilerParams
+
 #: Rows per grid block; inputs larger than this re-stream the weights once
 #: per block.
 ROW_BLOCK = 256
@@ -215,7 +217,7 @@ def int4_matmul(x, packed, scale, layer=None, *, n_block: int = 512,
         grid_spec=grid_spec,
         out_shape=[jax.ShapeDtypeStruct((b, half), out_dtype),
                    jax.ShapeDtypeStruct((b, half), out_dtype)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         interpret=interpret,

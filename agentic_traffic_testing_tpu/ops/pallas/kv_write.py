@@ -34,6 +34,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from agentic_traffic_testing_tpu.ops.pallas.tpu_compat import CompilerParams
+
 
 def _write_kernel(
     bt_ref,        # [B, max_blocks] i32 (SMEM, scalar prefetch)
@@ -116,7 +118,7 @@ def write_prompt_kv_pallas(
         # Operand numbering includes the scalar-prefetch arg: bt=0, new_k=1,
         # new_v=2, pool_k=3, pool_v=4.
         input_output_aliases={3: 0, 4: 1},
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("arbitrary", "arbitrary"),
         ),
         interpret=interpret,

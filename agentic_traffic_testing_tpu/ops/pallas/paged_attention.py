@@ -45,6 +45,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from agentic_traffic_testing_tpu.ops.pallas.tpu_compat import CompilerParams
+
 _NEG_INF = -1e30
 # f32 scratch min tile is (8, 128): pad the softmax-stat lanes up to it.
 _STAT_LANES = 128
@@ -328,7 +330,7 @@ def paged_attention_decode_dma(
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel"),
         ),
         interpret=interpret,
@@ -407,16 +409,22 @@ def _dma2_decode_kernel(
 
     # Tail-chunk pages past n_pages are never copied (the pl.when guards
     # above — a ~40% byte saving at bench's ~150-token contexts), so their
-    # buffer slots can hold uninitialized VMEM on the first use of each
-    # double-buffer slot. Stale K is harmless (its scores are overwritten
-    # with _NEG_INF by the pos mask, which also replaces NaN), but stale V
-    # rides `p_ @ v` where masked p_ is exactly 0.0 — and 0 * NaN = NaN.
-    # One zero-fill of the V buffers in the first grid program makes every
-    # stale V slot a finite 0 forever (later programs only ever leave
-    # previously-DMA'd finite data behind).
-    @pl.when(b == 0)
-    def _zero_v():
-        v_buf[...] = jnp.zeros_like(v_buf)
+    # buffer slots can hold uninitialized VMEM. Stale K is harmless (its
+    # scores are overwritten with _NEG_INF by the pos mask, which also
+    # replaces NaN), but stale V rides `p_ @ v` where masked p_ is exactly
+    # 0.0 — and 0 * NaN = NaN. Each program zeroes ITS OWN tail chunk's
+    # never-DMA'd page slots (both double-buffer slots, before any DMA is
+    # issued, so every real page lands on top afterwards): the only
+    # compute reads of never-copied V data are exactly those slots. Doing
+    # this per program instead of once in program 0 keeps the batch grid
+    # "parallel" — on v4/v5p megacore the grid splits across two cores
+    # with separate VMEM scratch, where a program-0-only fill never runs
+    # on the second core's half.
+    for p in range(cp):
+        @pl.when((n_chunks - 1) * cp + p >= n_pages)
+        def _zero_tail(p=p):
+            v_buf[:, :, pl.ds(p * bs, bs), :] = jnp.zeros(
+                (2, kh, bs, hd), v_buf.dtype)
 
     issue(0, 0)
     q = q_ref[0].astype(jnp.float32) * scale                 # [KH, rows, hd]
@@ -524,11 +532,11 @@ def paged_attention_decode_dma2(
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            # "arbitrary" pins sequential grid order: the one-time V-buffer
-            # zero-fill in program 0 must precede every other program's
-            # guarded tail-chunk reads of those buffers.
-            dimension_semantics=("arbitrary",),
+        compiler_params=CompilerParams(
+            # Every program zero-fills its own tail V slots (no cross-
+            # program scratch dependency), so the batch grid parallelizes
+            # across megacore on v4/v5p.
+            dimension_semantics=("parallel",),
         ),
         interpret=interpret,
     )(*prefetch_args, block_tables.astype(jnp.int32),
@@ -566,7 +574,9 @@ def _dma3_decode_kernel(
     [B, 1] (SMEM), q_ref [1, KH, rows, hd] (VMEM), k_hbm/v_hbm (ANY: full
     pool), o_ref [1, KH, rows, hd], k_buf/v_buf [2, KH, CP*bs, hd] VMEM
     scratch, m_buf/l_buf [KH, R, 128] f32 scratch, acc_buf [KH, R, hd] f32
-    scratch, sems DMA-semaphore array [2, 2]."""
+    scratch, rc_ref [1] i32 SMEM scratch (the real-chunk counter that
+    drives buffer-slot parity — see _prologue), sems DMA-semaphore array
+    [2, 2]."""
     if stacked:
         layer_ref = refs[0]
         (bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
@@ -725,8 +735,9 @@ def paged_attention_decode_dma3(
     sequence. Chunks past a sequence's last page skip DMA and compute
     entirely. Default pages_per_chunk=16 (vs dma2's 8): the per-chunk
     dot dispatch overhead on the tiny GQA row tile is the next cost
-    after DMA, so fewer, wider chunks win (measured on v5e:
-    scripts/dev/paged_decode_ab.py)."""
+    after DMA, so fewer, wider chunks should win — A/B on hardware with
+    scripts/dev/paged_decode_ab.py (the pre-fix v5e numbers predate the
+    rc_ref scratch repair and are not to be trusted)."""
     stacked = k_pages.ndim == 5
     if stacked and layer is None:
         raise ValueError("stacked (5D) pages require a layer index")
@@ -766,6 +777,7 @@ def paged_attention_decode_dma3(
             pltpu.VMEM((kh, r_pad, _STAT_LANES), jnp.float32),
             pltpu.VMEM((kh, r_pad, _STAT_LANES), jnp.float32),
             pltpu.VMEM((kh, r_pad, hd), jnp.float32),
+            pltpu.SMEM((1,), jnp.int32),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
@@ -778,7 +790,7 @@ def paged_attention_decode_dma3(
         ),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             # sequential grid order is load-bearing: the cross-step
             # prefetch and the one-time V zero-fill both assume linear
             # t = b*C + ci execution.
@@ -873,7 +885,7 @@ def paged_attention_decode(
                           q_per_seq=s_q, queries_per_kv=qpk),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

@@ -1,0 +1,349 @@
+"""Pallas TPU ragged paged-attention kernel (hybrid prefill+decode batches).
+
+One call serves a RAGGED batch of rows against the paged KV pool: decode
+rows contribute one query token, prefill-chunk rows contribute a whole
+chunk of N query tokens — mixed freely in a single grid, so decode steps
+soak up the idle FLOPs of short prefill chunks instead of serializing
+behind them (the Ragged Paged Attention / Sarathi chunked-piggyback
+recipe, PAPERS.md arxiv 2604.15464 / 2309.06180).
+
+Contract (verify-style — ALL KV, including each row's own chunk tokens,
+is already written in the pool before this call):
+
+    q            [T, H, hd]  flattened query tokens; row r's q_lens[r]
+                 tokens are contiguous, starting at sum(q_lens[:r])
+    q_lens       static tuple — query tokens per row (1 = decode row)
+    positions    [R] i32 — position of row r's FIRST query token; token
+                 a of row r sits at positions[r] + a and attends pool
+                 slots < positions[r] + a + 1
+    k/v pages    [KH, nb, bs, hd] one layer, or [L, KH, nb, bs, hd]
+                 stacked (+ `layer` scalar)
+    block_tables [R, W] i32 (padding entries -> trash block 0)
+
+    returns      [T, H, hd]
+
+Design: the grid is one program per fixed-size q-token block (QBLK tokens,
+host-padded so no block spans two rows — a decode row occupies one block).
+Each program streams ONLY the pages its tokens can see (dma2-style
+double-buffered all-heads-per-DMA chunks of the row's block list), so a
+decode block reads its row's context once while a chunk row's blocks
+re-read the shared prior pages in parallel across the grid — the same
+byte schedule a flash-tiled prefill pays. Per-block row/offset/real-count
+metadata rides scalar prefetch; everything else matches the dma2 kernel
+(GQA row tiles on the MXU, fp32 online softmax, tail-slot V zeroing so
+the grid stays "parallel" across megacore).
+
+The jnp oracle for these numerics is `ragged_paged_attention_ref` below
+(gather + causal_attention per q_len group); interpret-mode parity is
+pinned in tests/test_ragged_paged_attention.py.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from agentic_traffic_testing_tpu.ops.pallas.tpu_compat import CompilerParams
+
+_NEG_INF = -1e30
+
+
+def _ragged_kernel(
+    *refs,
+    scale: float,
+    pages_per_chunk: int,
+    stacked: bool,
+    queries_per_kv: int,
+):
+    """One program per q-token block of one ragged row.
+
+    Ref order: [layer_ref?], row_ref [G] (SMEM: row of this block),
+    qoff_ref [G] (first token's index within the row), nreal_ref [G]
+    (real tokens in this block, <= QBLK), block_tables_ref [R, W] (SMEM),
+    ctx_lens_ref [R, 1] (SMEM: positions + 1), q_ref [1, KH, rows, hd]
+    (VMEM; rows = QBLK * qpk, row i = token (i // qpk), GQA member
+    (i % qpk)), k_hbm/v_hbm (ANY: full pool), o_ref [1, KH, rows, hd],
+    k_buf/v_buf [2, KH, CP*bs, hd] VMEM scratch, sems DMA-semaphore
+    array [2, 2].
+    """
+    if stacked:
+        layer_ref = refs[0]
+        (row_ref, qoff_ref, nreal_ref, bt_ref, cl_ref, q_ref,
+         k_hbm, v_hbm, o_ref, k_buf, v_buf, sems) = refs[1:]
+    else:
+        layer_ref = None
+        (row_ref, qoff_ref, nreal_ref, bt_ref, cl_ref, q_ref,
+         k_hbm, v_hbm, o_ref, k_buf, v_buf, sems) = refs
+    g = pl.program_id(0)
+    r = row_ref[g]
+    qoff = qoff_ref[g]
+    nreal = nreal_ref[g]
+    qpk = queries_per_kv
+    cp = pages_per_chunk
+    kh = k_buf.shape[1]
+    bs = k_buf.shape[2] // cp
+    hd = k_buf.shape[3]
+    rows = q_ref.shape[2]
+    w = bt_ref.shape[1]
+    ctx = cl_ref[r, 0]
+    # This block's last real token attends slots < ctx + qoff + nreal - 1.
+    n_pages = jax.lax.div(ctx + qoff + nreal - 1 + bs - 1, bs)
+    n_chunks = jax.lax.div(n_pages + cp - 1, cp)
+
+    def page_copy(ci, p, slot, kv_hbm, buf, sem_col):
+        pi = jnp.minimum(ci * cp + p, w - 1)
+        blk = bt_ref[r, pi]
+        if stacked:
+            src = kv_hbm.at[layer_ref[0], :, blk]      # [KH, bs, hd] strided
+        else:
+            src = kv_hbm.at[:, blk]
+        return pltpu.make_async_copy(
+            src, buf.at[slot, :, pl.ds(p * bs, bs), :], sems.at[slot, sem_col]
+        )
+
+    def issue(ci, slot):
+        for p in range(cp):
+            @pl.when(ci * cp + p < n_pages)
+            def _start(p=p):
+                page_copy(ci, p, slot, k_hbm, k_buf, 0).start()
+                page_copy(ci, p, slot, v_hbm, v_buf, 1).start()
+
+    def wait(ci, slot):
+        for p in range(cp):
+            @pl.when(ci * cp + p < n_pages)
+            def _wait(p=p):
+                page_copy(ci, p, slot, k_hbm, k_buf, 0).wait()
+                page_copy(ci, p, slot, v_hbm, v_buf, 1).wait()
+
+    # Same stale-V hazard and same per-program cure as the dma2 kernel:
+    # tail-chunk page slots past n_pages are never DMA'd, and masked p_
+    # (exactly 0.0) times NaN from uninitialized VMEM would poison
+    # `p_ @ v` — zero the never-copied slots of both buffers' tail region
+    # before any DMA is issued. Per program, so the grid stays "parallel".
+    for p in range(cp):
+        @pl.when((n_chunks - 1) * cp + p >= n_pages)
+        def _zero_tail(p=p):
+            v_buf[:, :, pl.ds(p * bs, bs), :] = jnp.zeros(
+                (2, kh, bs, hd), v_buf.dtype)
+
+    issue(0, 0)
+    q = q_ref[0].astype(jnp.float32) * scale                 # [KH, rows, hd]
+
+    def chunk_step(ci, carry):
+        m, l, acc = carry
+        slot = jax.lax.rem(ci, 2)
+
+        @pl.when(ci + 1 < n_chunks)
+        def _prefetch():
+            issue(ci + 1, jax.lax.rem(ci + 1, 2))
+
+        wait(ci, slot)
+        k = k_buf[slot].astype(jnp.float32)                  # [KH, cp*bs, hd]
+        v = v_buf[slot].astype(jnp.float32)
+        s = jax.lax.dot_general(                             # [KH, rows, cp*bs]
+            q, k, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        pos = ci * cp * bs + jax.lax.broadcasted_iota(
+            jnp.int32, (kh, rows, cp * bs), 2)
+        tok = (jax.lax.broadcasted_iota(jnp.int32, (kh, rows, cp * bs), 1)
+               // qpk)                                       # token within block
+        # Token a = qoff + tok attends slots < ctx + a; padding rows
+        # (tok >= nreal) mask fully so their garbage stays finite (the
+        # all-masked softmax degenerates to a mean over DMA'd V, never
+        # touching slots beyond n_pages).
+        s = jnp.where((pos < ctx + qoff + tok) & (tok < nreal), s, _NEG_INF)
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m, m_cur)
+        alpha = jnp.exp(m - m_new)
+        p_ = jnp.exp(s - m_new)
+        l_new = l * alpha + jnp.sum(p_, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(                            # [KH, rows, hd]
+            p_, v, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc * alpha + pv
+
+    m0 = jnp.full((kh, rows, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((kh, rows, 1), jnp.float32)
+    a0 = jnp.zeros((kh, rows, hd), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_chunks, chunk_step, (m0, l0, a0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _block_layout(q_lens: tuple[int, ...], qblk: int):
+    """Static padded-block layout for a ragged batch: each row's tokens
+    pad up to a multiple of `qblk` so no q-block spans two rows. Returns
+    (blk_row, blk_qoff, blk_nreal, src, inv) numpy arrays — src gathers
+    flat tokens into the padded layout, inv gathers them back out."""
+    starts = np.concatenate([[0], np.cumsum(q_lens)]).astype(np.int64)
+    blk_row, blk_qoff, blk_nreal, src = [], [], [], []
+    inv = np.zeros(int(starts[-1]), np.int64)
+    slot = 0
+    for r, ln in enumerate(q_lens):
+        for qoff in range(0, ln, qblk):
+            n = min(qblk, ln - qoff)
+            blk_row.append(r)
+            blk_qoff.append(qoff)
+            blk_nreal.append(n)
+            for i in range(qblk):
+                if i < n:
+                    src.append(starts[r] + qoff + i)
+                    inv[starts[r] + qoff + i] = slot
+                else:
+                    src.append(0)  # padding slot: any valid token, garbage out
+                slot += 1
+    return (np.asarray(blk_row, np.int32), np.asarray(blk_qoff, np.int32),
+            np.asarray(blk_nreal, np.int32), np.asarray(src),
+            np.asarray(inv))
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("q_lens", "scale", "pages_per_chunk",
+                     "q_tokens_per_block", "interpret"),
+)
+def ragged_paged_attention(
+    q: jax.Array,             # [T, H, hd] flattened ragged query tokens
+    k_pages: jax.Array,       # [KH, nb, bs, hd] or [L, KH, nb, bs, hd]
+    v_pages: jax.Array,       # same shape as k_pages
+    block_tables: jax.Array,  # [R, max_blocks] i32
+    positions: jax.Array,     # [R] i32 — position of each row's first token
+    q_lens: tuple[int, ...],  # static — query tokens per row; sum == T
+    *,
+    layer: jax.Array | None = None,
+    scale: float | None = None,
+    pages_per_chunk: int = 8,
+    q_tokens_per_block: int = 8,
+    interpret: bool = False,
+) -> jax.Array:
+    """Ragged paged attention over a mixed decode/prefill-chunk batch.
+
+    See the module docstring for the contract; `q_tokens_per_block` is the
+    static q tile each grid program owns (decode rows round up to one
+    block — 8 keeps the pad waste at 7 tokens/row while the GQA packing
+    still fills 8*qpk MXU rows)."""
+    stacked = k_pages.ndim == 5
+    if stacked and layer is None:
+        raise ValueError("stacked (5D) pages require a layer index")
+    kh, bs, hd_page = k_pages.shape[-4], k_pages.shape[-2], k_pages.shape[-1]
+    t, h, hd = q.shape
+    if t != sum(q_lens):
+        raise ValueError(f"q holds {t} tokens but q_lens sums to {sum(q_lens)}")
+    qpk = h // kh
+    max_blocks = block_tables.shape[1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    cp = min(pages_per_chunk, max_blocks)
+    qblk = q_tokens_per_block
+
+    blk_row, blk_qoff, blk_nreal, src, inv = _block_layout(q_lens, qblk)
+    n_blocks = len(blk_row)
+    rows = qblk * qpk
+    # Pack: padded token-major GQA tile per block — row i of block g is
+    # token (i // qpk), GQA member (i % qpk); pad head lanes to the pool's
+    # physical width (pad lanes contribute nothing to scores).
+    q_pad = q[jnp.asarray(src)]                              # [G*QBLK, H, hd]
+    q_pad = q_pad.reshape(n_blocks, qblk, kh, qpk, hd)
+    q_pad = q_pad.transpose(0, 2, 1, 3, 4).reshape(n_blocks, kh, rows, hd)
+    if hd_page != hd:
+        q_pad = jnp.pad(q_pad, ((0, 0), (0, 0), (0, 0), (0, hd_page - hd)))
+
+    if stacked:
+        def q_map(g, lay, row, qoff, nreal, bt, cl):
+            return (g, 0, 0, 0)
+        prefetch_args = (jnp.asarray(layer, jnp.int32).reshape(1),)
+    else:
+        def q_map(g, row, qoff, nreal, bt, cl):
+            return (g, 0, 0, 0)
+        prefetch_args = ()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=5 + len(prefetch_args),
+        grid=(n_blocks,),
+        in_specs=[
+            pl.BlockSpec((1, kh, rows, hd_page), q_map),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, kh, rows, hd_page), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((2, kh, cp * bs, hd_page), k_pages.dtype),
+            pltpu.VMEM((2, kh, cp * bs, hd_page), k_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+
+    out = pl.pallas_call(
+        functools.partial(
+            _ragged_kernel, scale=scale, pages_per_chunk=cp,
+            stacked=stacked, queries_per_kv=qpk,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_blocks, kh, rows, hd_page), q.dtype),
+        compiler_params=CompilerParams(
+            # Per-program tail-slot zeroing (no cross-program scratch
+            # dependency): blocks parallelize across megacore.
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(*prefetch_args, jnp.asarray(blk_row), jnp.asarray(blk_qoff),
+      jnp.asarray(blk_nreal), block_tables.astype(jnp.int32),
+      (positions.astype(jnp.int32) + 1)[:, None], q_pad, k_pages, v_pages)
+
+    # Unpack: [G, KH, rows, hdp] -> padded token stream -> real tokens.
+    out = out.reshape(n_blocks, kh, qblk, qpk, hd_page)
+    out = out.transpose(0, 2, 1, 3, 4).reshape(n_blocks * qblk, h, hd_page)
+    return out[jnp.asarray(inv), :, :hd]
+
+
+def ragged_paged_attention_ref(
+    q: jax.Array,             # [T, H, hd]
+    k_pages: jax.Array,       # [KH, nb, bs, hd] or [L, KH, nb, bs, hd]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [R, max_blocks]
+    positions: jax.Array,     # [R]
+    q_lens: tuple[int, ...],
+    *,
+    layer: jax.Array | None = None,
+    scale: float | None = None,
+) -> jax.Array:
+    """jnp oracle (and CPU serving path) for `ragged_paged_attention`.
+
+    Rows group by q_len (the grouping is static), so a hybrid batch costs
+    one gather+causal_attention per distinct length — typically two: the
+    uniform decode rows and the one chunk row."""
+    from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
+    from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
+
+    if k_pages.ndim == 5:
+        if layer is None:
+            raise ValueError("stacked (5D) pages require a layer index")
+        k_pages = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
+        v_pages = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
+    hd = q.shape[-1]
+    starts = np.concatenate([[0], np.cumsum(q_lens)]).astype(int)
+    groups: dict[int, list[int]] = {}
+    for r, ln in enumerate(q_lens):
+        groups.setdefault(ln, []).append(r)
+    outs: list = [None] * len(q_lens)
+    for ln, rows in groups.items():
+        idx = jnp.asarray(rows, jnp.int32)
+        qg = jnp.stack([q[starts[r]:starts[r] + ln] for r in rows])
+        pos0 = positions[idx]
+        k_all = kvc.gather_kv(k_pages, block_tables[idx])[..., :hd]
+        v_all = kvc.gather_kv(v_pages, block_tables[idx])[..., :hd]
+        qpos = pos0[:, None] + jnp.arange(ln, dtype=jnp.int32)[None]
+        out = causal_attention(
+            qg, k_all.astype(qg.dtype), v_all.astype(qg.dtype),
+            q_positions=qpos, kv_valid_len=pos0 + ln, scale=scale,
+        )
+        for i, r in enumerate(rows):
+            outs[r] = out[i]
+    return jnp.concatenate(outs, axis=0)

@@ -1,0 +1,15 @@
+"""Version-portability shims for `jax.experimental.pallas.tpu`.
+
+`pltpu.CompilerParams` is the current spelling; jax 0.4.x shipped it as
+`pltpu.TPUCompilerParams` (same fields — dimension_semantics et al.).
+Kernel modules import the name from here so one source traces on both:
+the alternative is every kernel failing at trace time with an
+AttributeError on whichever jax the image pins.
+"""
+
+from __future__ import annotations
+
+from jax.experimental.pallas import tpu as pltpu
+
+CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")

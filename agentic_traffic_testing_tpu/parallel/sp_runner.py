@@ -61,6 +61,8 @@ class SPPrefillRunner(ModelRunner):
     # an operator chunks deliberately.
     chunk_attn_mode = "ring_sp"
     supports_chunked_prefill = True
+    # No mesh wrapper for the ragged hybrid step (see TPRunner).
+    supports_hybrid = False
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

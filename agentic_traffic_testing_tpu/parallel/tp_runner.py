@@ -55,6 +55,10 @@ class TPRunner(ModelRunner):
     # for targeted tests). Page writes stay on the DUS writer, which the
     # partitioner shards cleanly.
     kv_writer_mode = "dus"
+    # The ragged hybrid kernel has no shard_map wrapper yet: a hybrid step
+    # under tp would all-gather the head-sharded pool. Engine refuses the
+    # hybrid_token_budget knob at build instead of degrading silently.
+    supports_hybrid = False
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

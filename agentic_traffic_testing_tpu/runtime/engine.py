@@ -59,6 +59,7 @@ from agentic_traffic_testing_tpu.runtime.runner import (
 from agentic_traffic_testing_tpu.runtime.scheduler import (
     ChunkPrefill,
     DecodeBatch,
+    HybridBatch,
     PrefillBatch,
     Scheduler,
     SchedulerConfig,
@@ -104,6 +105,15 @@ class EngineConfig:
     # XLA compile; pair with warmup_prefill_buckets() so a burst never
     # compiles mid-traffic.
     prefill_batch_max_len: Optional[int] = None
+    # Hybrid prefill+decode batching (Sarathi-style chunked piggyback over
+    # the ragged Pallas kernel): when > 0, a pending prefill chunk and the
+    # decode batch fuse into ONE ragged dispatch whose padded token total
+    # (decode lanes + chunk bucket) stays under this budget — decode lanes
+    # stop serializing behind chunks, which is the queue-wait lever under
+    # mixed agentic traffic. 0 (default) keeps every path bit-identical to
+    # the serial scheduler. Pair with warmup_hybrid_buckets() so the
+    # (batch, chunk) shapes never compile mid-traffic.
+    hybrid_token_budget: int = 0
     # Content-addressed reuse of full prompt blocks (vLLM automatic-prefix-
     # caching analog); cached requests prefill only their suffix.
     prefix_caching: bool = False
@@ -155,6 +165,16 @@ class EngineConfig:
         if self.speculation not in (None, "ngram"):
             raise ValueError(
                 f"unknown speculation {self.speculation!r}; supported: ngram")
+        if self.hybrid_token_budget and self.speculation:
+            # The fused hybrid step advances decode lanes without the
+            # device-resident n-gram history; silently dropping drafts
+            # would misreport every acceptance gauge.
+            raise ValueError(
+                "hybrid_token_budget x speculation is not wired — disable "
+                "one of them")
+        if self.hybrid_token_budget < 0:
+            raise ValueError(
+                f"hybrid_token_budget must be >= 0, got {self.hybrid_token_budget}")
         if self.speculation and self.spec_tokens < 1:
             raise ValueError("spec_tokens must be >= 1 when speculation is on")
         if self.moe_capacity_factor is not None and self.moe_capacity_factor <= 0:
@@ -185,6 +205,7 @@ class EngineConfig:
             block_size=self.block_size,
             decode_lookahead=max(4, (self.pipeline_depth + 1) * decode_steps),
             prefill_chunk_tokens=self.prefill_chunk_tokens or None,
+            hybrid_token_budget=self.hybrid_token_budget,
             **({"prefill_batch_max_len": self.prefill_batch_max_len}
                if self.prefill_batch_max_len is not None else {}),
         )
@@ -305,6 +326,15 @@ class LLMEngine:
                 spec_tokens=cfg.effective_spec_tokens,
                 spec_ngram=cfg.spec_ngram,
             )
+
+        if cfg.hybrid_token_budget and not getattr(
+                self.runner, "supports_hybrid", False):
+            # Fail at construction, not mid-request: the mesh runners have
+            # no shard_map wrapper for the ragged hybrid step yet.
+            raise ValueError(
+                f"{type(self.runner).__name__} does not support the fused "
+                f"hybrid prefill+decode path — build the engine with "
+                f"hybrid_token_budget=0")
 
         num_blocks = cfg.num_blocks or self._default_num_blocks()
         kv_dtype = (jnp.float8_e4m3fn if cfg.kv_cache_dtype in ("fp8", "fp8_e4m3")
@@ -626,6 +656,8 @@ class LLMEngine:
         self._fail_unservable()
         if isinstance(plan, PrefillBatch):
             self._run_prefill(plan)
+        elif isinstance(plan, HybridBatch):
+            self._run_hybrid(plan)
         elif isinstance(plan, ChunkPrefill):
             self._run_chunk(plan)
         elif isinstance(plan, DecodeBatch):
@@ -735,17 +767,107 @@ class LLMEngine:
             jnp.int32(plan.chunk_start), jnp.int32(plan.chunk_len),
             samp, jnp.asarray([r.sampling_step], jnp.int32),
         )
+        self._apply_chunk_result(plan, out)
+        # Intermediate chunk samples stay on device and are simply dropped.
+        self._invalidate_decode_state()
+
+    def _apply_chunk_result(self, plan: ChunkPrefill, out) -> None:
+        """Chunk bookkeeping shared by the serial and hybrid paths —
+        progress accounting plus, on the FINAL chunk, prefix registration
+        and the synchronous first-token readback (this sample IS the
+        request's first token, so TTFT stamps here). One site keeps the
+        two schedulers' first-token behavior in lockstep."""
+        r = plan.request
         r.num_computed_tokens += plan.chunk_len
         if plan.is_final:
             self._register_prefix(r)
-            # Synchronous readback: this sample IS the first token (TTFT).
             toks = np.asarray(jax.device_get(out))
             now = time.monotonic()
             if r.first_token_time is None:
                 r.first_token_time = now
             self._append_token(r, int(toks[0]))
-        # Intermediate chunk samples stay on device and are simply dropped.
+
+    # -- hybrid (fused chunk + decode) -------------------------------------
+
+    def _run_hybrid(self, plan: HybridBatch) -> None:
+        """ONE fused ragged dispatch: every decode lane advances a token
+        while one prefill chunk computes in the same device program
+        (runner.hybrid -> models/llama.hybrid_step_impl). The decode
+        tokens join the async harvest pipeline exactly like a prefill
+        handoff entry; the chunk bookkeeping matches _run_chunk."""
+        dec, ck = plan.decode, plan.chunk
+        reqs = dec.requests
+        b = dec.padded_batch
+        r = ck.request
+        c = ck.padded_len
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        steps = np.zeros((b + 1,), np.int32)
+        tables = np.full((b + 1, self.table_width), TRASH_BLOCK, np.int32)
+        for i, q in enumerate(reqs):
+            tokens[i] = q.output_ids[-1] if q.output_ids else q.prompt_ids[-1]
+            positions[i] = q.total_len - 1
+            steps[i] = q.sampling_step
+        steps[b] = r.sampling_step
+        self._fill_tables(reqs, tables)
+        self._fill_tables([r], tables[b:b + 1])  # chunk row rides lane B
+        chunk_tok = np.zeros((1, c), np.int32)
+        seg = r.prompt_ids[ck.chunk_start : ck.chunk_start + ck.chunk_len]
+        chunk_tok[0, : len(seg)] = seg
+        samp = self._sampling_arrays(
+            list(reqs) + [None] * (b - len(reqs)) + [r], b + 1)
+        _, self.cache, dec_out, chunk_out = self.runner.hybrid(
+            jnp.asarray(tokens), jnp.asarray(chunk_tok), self.cache,
+            jnp.asarray(tables), jnp.asarray(positions),
+            jnp.int32(ck.chunk_start), jnp.int32(ck.chunk_len),
+            samp, jnp.asarray(steps),
+        )
+        self._apply_chunk_result(ck, chunk_out)
+        # Decode lanes' tokens land via the normal async harvest; the
+        # composition changes next step anyway (the chunk continues, or
+        # its request joins decode), so no continuation state is kept.
+        first = dec_out[:, None]  # [B] -> [B, 1], harvest expects [B, K]
+        try:
+            first.copy_to_host_async()
+        except Exception:
+            pass
+        self._inflight.append(_Inflight(first, list(reqs)))
         self._invalidate_decode_state()
+
+    def warmup_hybrid_buckets(self, max_chunk: Optional[int] = None) -> int:
+        """Precompile the fused hybrid program for every (decode-batch
+        bucket, chunk rung) combination the hybrid planner can emit under
+        `hybrid_token_budget` — each cold (batch, chunk) shape is a fresh
+        XLA compile that would otherwise land mid-traffic, the same
+        failure mode warmup_decode_buckets exists for. Dummy lanes and
+        dummy chunk pages all point at the trash block. `max_chunk` bounds
+        the warmed rungs for deployments whose prompts can't reach the
+        bigger ones. Returns the number of programs compiled."""
+        from agentic_traffic_testing_tpu.runtime.scheduler import pow2_buckets
+
+        budget = self.cfg.hybrid_token_budget
+        if not budget:
+            return 0
+        ladder = [ck for ck in self.scheduler.cfg.chunk_ladder()
+                  if max_chunk is None or ck <= max_chunk]
+        n = 0
+        for b in pow2_buckets(1, self.cfg.max_num_seqs):
+            for ck in ladder:
+                if b + ck > budget:
+                    continue  # the planner's room check — unreachable shape
+                tokens = jnp.zeros((b,), jnp.int32)
+                chunk = jnp.zeros((1, ck), jnp.int32)
+                tables = jnp.full((b + 1, self.table_width), TRASH_BLOCK,
+                                  jnp.int32)
+                positions = jnp.zeros((b,), jnp.int32)
+                steps = jnp.zeros((b + 1,), jnp.int32)
+                samp = self._sampling_arrays([], b + 1)
+                _, self.cache, _, out = self.runner.hybrid(
+                    tokens, chunk, self.cache, tables, positions,
+                    jnp.int32(0), jnp.int32(1), samp, steps)
+                jax.block_until_ready(out)
+                n += 1
+        return n
 
     # -- decode ------------------------------------------------------------
 
@@ -872,11 +994,15 @@ class LLMEngine:
             _Inflight(out, list(self._decode_requests), counts))
 
     def _sampling_arrays(self, reqs: list[Request], padded: int) -> SamplingArrays:
+        # None entries are padding gaps (the hybrid step places the chunk's
+        # request at lane `padded_batch`, past the real decode lanes).
         temp = np.zeros((padded,), np.float32)
         top_k = np.zeros((padded,), np.int32)
         top_p = np.ones((padded,), np.float32)
         seeds = np.zeros((padded,), np.int32)
         for i, r in enumerate(reqs):
+            if r is None:
+                continue
             temp[i] = r.sampling.temperature
             top_k[i] = r.sampling.top_k
             top_p[i] = r.sampling.top_p

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from agentic_traffic_testing_tpu.models.config import ModelConfig
 from agentic_traffic_testing_tpu.models.llama import (
     decode_step_impl,
+    hybrid_step_impl,
     prefill_chunk_impl,
     prefill_impl,
     verify_step_impl,
@@ -100,6 +101,29 @@ def _prefill_chunk_sample_impl(params, cfg: ModelConfig, tokens, cache,
     keys = make_row_keys(samp.seeds, steps)
     out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
     return cache, out
+
+
+def _hybrid_sample_impl(params, cfg: ModelConfig, dec_tokens, chunk_tokens,
+                        cache, block_tables, positions, chunk_start,
+                        chunk_len, samp: SamplingArrays, steps,
+                        attn_mode=None):
+    """One FUSED hybrid step (B decode lanes + one prefill chunk in a
+    single ragged dispatch) + sampling for every row.
+
+    `samp`/`steps` cover B+1 lanes: the B decode lanes first, the chunk's
+    request last. Returns (DecodeState for the B decode lanes, cache,
+    decode tokens [B], chunk's sampled last token [1] — meaningful only on
+    the final chunk, exactly like prefill_chunk's sample)."""
+    b = dec_tokens.shape[0]
+    dec_logits, chunk_logits, cache = hybrid_step_impl(
+        params, cfg, dec_tokens, chunk_tokens, cache, block_tables,
+        positions, chunk_start, chunk_len, attn_mode=attn_mode)
+    keys = make_row_keys(samp.seeds, steps)
+    out = sample(jnp.concatenate([dec_logits, chunk_logits]), keys,
+                 samp.temperature, samp.top_k, samp.top_p)
+    state = DecodeState(tokens=out[:b], positions=positions + 1,
+                        steps=steps[:b] + 1)
+    return state, cache, out[:b], out[b:]
 
 
 def _decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
@@ -212,6 +236,11 @@ class ModelRunner:
                     attn_axis=self.prefill_attn_axis),
             donate_argnames=("cache",),
         )
+        self._hybrid = jax.jit(
+            partial(_hybrid_sample_impl, cfg=cfg,
+                    attn_mode=self.hybrid_attn_mode),
+            donate_argnames=("cache",),
+        )
         if self.spec_tokens > 0:
             self._decode = jax.jit(
                 partial(_spec_decode_sample_impl, cfg=cfg,
@@ -256,6 +285,16 @@ class ModelRunner:
     #: path faithfully (since round 5 every runner does: the SP runners'
     #: chunk jit rides the chunk-ring hybrid)
     supports_chunked_prefill: bool = True
+    #: ragged-attention implementation baked into the hybrid jit (None =
+    #: auto: ragged Pallas kernel on TPU, jnp grouped-gather oracle
+    #: elsewhere — ops/attention_backend.hybrid_ragged_attention)
+    hybrid_attn_mode: Optional[str] = None
+    #: whether this runner serves the engine's fused hybrid prefill+decode
+    #: path (hybrid_token_budget > 0). The mesh runners don't yet: the
+    #: ragged kernel has no shard_map wrapper, so a hybrid step there
+    #: would all-gather the head-sharded pool (parallel/ runners set
+    #: False).
+    supports_hybrid: bool = True
 
     def prepare_cache(self, cache: KVCache) -> KVCache:
         """Hook for placing a freshly allocated cache (TP runner shards it)."""
@@ -273,6 +312,20 @@ class ModelRunner:
         return self._prefill_chunk(
             self.params, tokens=tokens, cache=cache, block_tables=block_tables,
             chunk_start=chunk_start, chunk_len=chunk_len, samp=samp, steps=steps,
+        )
+
+    def hybrid(self, dec_tokens, chunk_tokens, cache, block_tables,
+               positions, chunk_start, chunk_len, samp, steps):
+        """One fused hybrid dispatch: B decode lanes + one prefill chunk.
+
+        block_tables is [B+1, W] (row B = the chunk's); samp/steps cover
+        B+1 lanes (chunk last). -> (DecodeState [B lanes], cache,
+        decode tokens [B], chunk last-token sample [1])."""
+        return self._hybrid(
+            self.params, dec_tokens=dec_tokens, chunk_tokens=chunk_tokens,
+            cache=cache, block_tables=block_tables, positions=positions,
+            chunk_start=chunk_start, chunk_len=chunk_len, samp=samp,
+            steps=steps,
         )
 
     def decode(self, cache, block_tables, state, samp):

@@ -13,7 +13,11 @@ infra/docker-compose.distributed.yml:40-41). Differences driven by XLA:
 
 Policy: prefill-priority admission (matches vLLM's default and preserves the
 TTFT semantics the testbed measures), LIFO preemption of the youngest running
-sequence when KV blocks run out, all-or-nothing block allocation.
+sequence when KV blocks run out, all-or-nothing block allocation. With
+`hybrid_token_budget` > 0 a pending prefill chunk and the decode batch fuse
+into one HybridBatch (Sarathi-style chunked piggyback over the ragged
+Pallas kernel) instead of serializing; 0 keeps the serial schedule
+bit-identical.
 """
 
 from __future__ import annotations
@@ -80,7 +84,23 @@ class ChunkPrefill:
         return self.chunk_start + self.chunk_len >= self.request.num_prompt_tokens
 
 
-StepPlan = Union[PrefillBatch, DecodeBatch, ChunkPrefill, None]
+@dataclass
+class HybridBatch:
+    """One FUSED step: the decode batch plus one prefill chunk riding along
+    in a single ragged dispatch (Sarathi-style chunked-prefill piggyback:
+    decode rows soak the idle FLOPs of the chunk instead of waiting behind
+    it). Emitted only when `hybrid_token_budget` > 0; the fused token count
+    (decode padded lanes + chunk padded length) stays under that budget."""
+
+    decode: DecodeBatch
+    chunk: ChunkPrefill
+
+    @property
+    def token_budget(self) -> int:
+        return self.decode.padded_batch + self.chunk.padded_len
+
+
+StepPlan = Union[PrefillBatch, DecodeBatch, ChunkPrefill, HybridBatch, None]
 
 
 @dataclass
@@ -97,6 +117,13 @@ class SchedulerConfig:
     # (one compiled bucket instead of one per long-prompt length; bounded
     # per-step latency). None disables chunking.
     prefill_chunk_tokens: Optional[int] = 2048
+    # Hybrid prefill+decode batching: when > 0, a pending prefill chunk and
+    # the decode batch fuse into ONE ragged dispatch (HybridBatch) whose
+    # total padded token count (decode lanes + chunk bucket) stays under
+    # this budget — the chunk splits onto a smaller ladder rung when it
+    # must. 0 (default) disables fusion entirely: planning is bit-identical
+    # to the serial prefill-priority policy.
+    hybrid_token_budget: int = 0
     # Multi-request prefill batches only form for buckets up to this length.
     # Longer prompts prefill solo: a (batch, long-bucket) combination is a
     # fresh XLA compile (~tens of seconds) that a burst of concurrent
@@ -145,6 +172,7 @@ class Scheduler:
         self.num_preemptions = 0
         self.num_scheduled_prefills = 0
         self.num_scheduled_decodes = 0
+        self.num_scheduled_hybrid = 0  # fused chunk+decode steps
 
     # -- admission ---------------------------------------------------------
 
@@ -221,7 +249,8 @@ class Scheduler:
             return None, 0
         return blocks, cached
 
-    def _next_chunk(self, req: Request) -> ChunkPrefill:
+    def _next_chunk(self, req: Request,
+                    max_padded: Optional[int] = None) -> Optional[ChunkPrefill]:
         start = req.num_computed_tokens
         remaining = req.num_prompt_tokens - start
         c = self.cfg.prefill_chunk_tokens
@@ -235,16 +264,25 @@ class Scheduler:
         # (every off-ladder shape is a fresh 10-20 s XLA compile serialized
         # against live traffic; the warmup pass compiles exactly
         # cfg.chunk_ladder()). The remainder continues next plan().
+        # `max_padded` adds the hybrid planner's token-budget cap the same
+        # way; when even the smallest rung overruns it, returns None (the
+        # caller falls back to the serial paths).
         bs = self.cfg.block_size
         table_tokens = -(-self.cfg.max_model_len // bs) * bs
         ladder = self.cfg.chunk_ladder()
         room = table_tokens - start
+        if max_padded is not None:
+            room = min(room, max_padded)
         padded = next((a for a in ladder if a >= real), ladder[-1])
         if padded > room:
             fits = [a for a in ladder if a <= room]
-            # room >= remaining >= 1 and the ladder floor is block_size, so
-            # fits is empty only when room < block_size — impossible, since
-            # start is block-aligned progress within table_tokens.
+            # Without max_padded: room >= remaining >= 1 and the ladder
+            # floor is block_size, so fits is empty only when room <
+            # block_size — impossible, since start is block-aligned
+            # progress within table_tokens. With max_padded it is the
+            # budget-doesn't-fit signal.
+            if not fits:
+                return None
             padded = fits[-1]
             real = min(real, padded)
         return ChunkPrefill(request=req, chunk_start=start, chunk_len=real,
@@ -262,7 +300,16 @@ class Scheduler:
     # -- planning ----------------------------------------------------------
 
     def plan(self) -> StepPlan:
-        """Choose the next device step. Prefill-priority."""
+        """Choose the next device step. Prefill-priority; with
+        `hybrid_token_budget` set, a pending chunk and the decode batch
+        fuse into one HybridBatch when both exist."""
+        if self.cfg.hybrid_token_budget:
+            hb = self._plan_hybrid()
+            if hb is not None:
+                self.num_scheduled_prefills += 1
+                self.num_scheduled_decodes += 1
+                self.num_scheduled_hybrid += 1
+                return hb
         pf = self._plan_prefill()
         if pf is not None:
             self.num_scheduled_prefills += 1
@@ -272,6 +319,44 @@ class Scheduler:
             self.num_scheduled_decodes += 1
         return dec
 
+    def _plan_hybrid(self) -> Optional[HybridBatch]:
+        """Fuse the in-flight (or newly admitted) prefill chunk with a
+        decode step over every OTHER running lane — one ragged dispatch.
+
+        Falls back (returns None) whenever the fusion has no partner on
+        either side: no pending chunk, no other running lanes, the decode
+        capacity pass preempted everyone, or even the smallest chunk rung
+        overruns the budget after the decode lanes take their share."""
+        pref = next((r for r in self.running if r.is_prefilling), None)
+        if pref is None:
+            pref = self._admit_chunk_head()
+        if pref is None:
+            return None
+        others = [r for r in self.running
+                  if r is not pref and not r.is_prefilling]
+        if not others:
+            return None
+        # Budget feasibility BEFORE the capacity pass: _plan_decode grows
+        # block capacity and may PREEMPT lanes — side effects that would be
+        # kept while the batch it built gets discarded if no chunk rung
+        # fits afterwards, turning an unfusably small budget into spurious
+        # preemptions the serial schedule never makes. The pass only ever
+        # shrinks the batch, so the full candidate set's bucket bounds the
+        # decode share from above; if the smallest ladder rung doesn't fit
+        # beside it, skip fusion without touching any allocator state.
+        worst_room = (self.cfg.hybrid_token_budget
+                      - bucket_up(len(others), self.cfg.batch_buckets))
+        if self.cfg.chunk_ladder()[0] > worst_room:
+            return None
+        dec = self._plan_decode(candidates=others)
+        if dec is None:
+            return None
+        room = self.cfg.hybrid_token_budget - dec.padded_batch
+        chunk = self._next_chunk(pref, max_padded=room)
+        if chunk is None:
+            return None
+        return HybridBatch(decode=dec, chunk=chunk)
+
     def has_work(self) -> bool:
         return bool(self.waiting or self.running)
 
@@ -280,6 +365,39 @@ class Scheduler:
         # Prefill writes whole blocks; keep the bucket block-aligned.
         bs = self.cfg.block_size
         return -(-n // bs) * bs
+
+    def _admit_chunk_head(self) -> Optional[Request]:
+        """Admit the head of the waiting queue onto the chunk path (long or
+        cache-hit prompts, which prefill chunk by chunk). Returns the
+        admitted (now RUNNING) request, or None — not eligible, no seat,
+        or no KV room. Shared by the serial prefill planner and the hybrid
+        planner so admission policy stays in one place."""
+        if not self.waiting:
+            return None
+        head = self.waiting[0]
+        if not (self._needs_chunking(head) or self._probe_cached(head) > 0):
+            return None
+        if len(self.running) >= self.cfg.max_num_seqs:
+            return None
+        need_tokens = head.num_prompt_tokens + 1 + self.cfg.decode_lookahead
+        blocks, cached = self._acquire_blocks(head, need_tokens)
+        if blocks is None:
+            if not self.running:
+                bad = self.waiting.popleft()
+                bad.error = (
+                    f"sequence of {bad.num_prompt_tokens} tokens cannot fit "
+                    f"the KV pool ({self.allocator.usable_tokens} tokens)"
+                )
+                self.failed.append(bad)
+            return None  # no KV room: let decode drain / preemption handle it
+        head.blocks = blocks
+        head.num_computed_tokens = cached
+        record = getattr(self.allocator, "record_prefix_stats", None)
+        if record is not None:  # hit tokens are actually applied here
+            record(head.num_prompt_tokens, cached)
+        head.state = RequestState.RUNNING
+        self.running.append(self.waiting.popleft())
+        return head
 
     def _plan_prefill(self) -> Union[PrefillBatch, ChunkPrefill, None]:
         """Admit waiting requests of one shared length bucket, or continue /
@@ -297,26 +415,9 @@ class Scheduler:
         # queue entries are re-examined when they reach the head (a cached
         # request slipping into a batch is correct, it just recomputes).
         if self._needs_chunking(head) or self._probe_cached(head) > 0:
-            if len(self.running) >= self.cfg.max_num_seqs:
+            head = self._admit_chunk_head()
+            if head is None:
                 return None
-            need_tokens = head.num_prompt_tokens + 1 + self.cfg.decode_lookahead
-            blocks, cached = self._acquire_blocks(head, need_tokens)
-            if blocks is None:
-                if not self.running:
-                    bad = self.waiting.popleft()
-                    bad.error = (
-                        f"sequence of {bad.num_prompt_tokens} tokens cannot fit "
-                        f"the KV pool ({self.allocator.usable_tokens} tokens)"
-                    )
-                    self.failed.append(bad)
-                return None  # no KV room: let decode drain / preemption handle it
-            head.blocks = blocks
-            head.num_computed_tokens = cached
-            record = getattr(self.allocator, "record_prefix_stats", None)
-            if record is not None:  # hit tokens are actually applied here
-                record(head.num_prompt_tokens, cached)
-            head.state = RequestState.RUNNING
-            self.running.append(self.waiting.popleft())
             return self._next_chunk(head)
         batch: list[Request] = []
         bucket_len = 0
@@ -377,18 +478,31 @@ class Scheduler:
             padded_batch=bucket_up(len(batch), self.cfg.batch_buckets),
         )
 
-    def _plan_decode(self) -> Optional[DecodeBatch]:
-        """One token for every running sequence; preempt if KV runs out."""
-        if not self.running:
-            return None
-        # plan() only reaches here once no chunked prefill is pending:
-        # _plan_prefill returns the next chunk for any mid-prefill request.
-        assert not any(r.is_prefilling for r in self.running), (
-            "decode planned while a chunked prefill is in flight")
+    def _plan_decode(self, candidates: Optional[list[Request]] = None
+                     ) -> Optional[DecodeBatch]:
+        """One token for every running sequence; preempt if KV runs out.
+
+        `candidates` restricts the pass to a subset of the running set (the
+        hybrid planner decodes every lane EXCEPT the one mid-prefill);
+        victims are then chosen among the candidates only, and the
+        preemption bookkeeping in _preempt keeps self.running consistent."""
+        if candidates is None:
+            if not self.running:
+                return None
+            # plan() only reaches here once no chunked prefill is pending:
+            # _plan_prefill returns the next chunk for any mid-prefill
+            # request.
+            assert not any(r.is_prefilling for r in self.running), (
+                "decode planned while a chunked prefill is in flight")
+            pool = self.running
+        else:
+            if not candidates:
+                return None
+            pool = candidates
         # Grow each sequence's KV capacity for this step (+ lookahead).
         # Victims are chosen LIFO (youngest arrival) — vLLM's policy, which
         # protects the oldest requests' latency.
-        ordered = sorted(self.running, key=lambda r: r.arrival_time)
+        ordered = sorted(pool, key=lambda r: r.arrival_time)
         native_pass = getattr(self.allocator, "decode_capacity_pass", None)
         if native_pass is not None:
             # One C++ call does the whole grow/evict pass (native/ core);
@@ -418,7 +532,10 @@ class Scheduler:
                     survivors = [r for r in survivors if r.state == RequestState.RUNNING]
                 if req is not None and req.state == RequestState.RUNNING:
                     survivors.append(req)
-        self.running = survivors
+        if candidates is None:
+            self.running = survivors
+        # candidates path: _preempt already removed each victim from
+        # self.running; the mid-prefill lane must stay, so no reassignment.
         if not survivors:
             return None
         return DecodeBatch(

@@ -64,6 +64,11 @@ class ServerConfig:
     # then precompiles every (batch, length) bucket <= the cap at startup.
     prefill_batch_max_len: Optional[int] = None  # LLM_PREFILL_BATCH_MAX_LEN
     prefix_caching: bool = False               # LLM_PREFIX_CACHING
+    # Hybrid prefill+decode batching budget (tokens per fused ragged
+    # dispatch: decode lanes + chunk bucket). 0 disables — the serial
+    # prefill-priority schedule, bit-identical to before the knob existed.
+    # Single-chip runners only (tp/sp/pp refuse at engine build).
+    hybrid_token_budget: int = 0               # LLM_HYBRID_TOKEN_BUDGET
     # "fp8" stores KV pages as float8_e4m3 — double capacity/concurrency,
     # half the decode KV stream (vLLM --kv-cache-dtype fp8 analog).
     kv_cache_dtype: Optional[str] = None       # LLM_KV_CACHE_DTYPE
@@ -125,6 +130,8 @@ class ServerConfig:
         pbml = os.environ.get("LLM_PREFILL_BATCH_MAX_LEN")
         c.prefill_batch_max_len = int(pbml) if pbml else None
         c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
+        c.hybrid_token_budget = int(
+            os.environ.get("LLM_HYBRID_TOKEN_BUDGET") or c.hybrid_token_budget)
         c.kv_cache_dtype = os.environ.get("LLM_KV_CACHE_DTYPE") or None
         c.int4_k_group = int(os.environ.get("LLM_INT4_K_GROUP") or c.int4_k_group)
         nb = os.environ.get("LLM_NUM_BLOCKS")
@@ -171,6 +178,9 @@ class ServerConfig:
                        default=c.prefill_batch_max_len)
         p.add_argument("--enable-prefix-caching", dest="prefix_caching",
                        action="store_true", default=c.prefix_caching)
+        p.add_argument("--hybrid-token-budget", type=int,
+                       default=c.hybrid_token_budget,
+                       help="fused chunk+decode dispatch budget (0 = off)")
         p.add_argument("--num-blocks", type=int, default=c.num_blocks)
         p.add_argument("--block-size", type=int, default=c.block_size)
         p.add_argument("--weights-path", default=c.weights_path)
@@ -184,6 +194,7 @@ class ServerConfig:
                   "temperature", "host", "port", "tp_size", "quantization",
                   "decode_steps", "prefill_chunk_tokens",
                   "prefill_batch_max_len", "prefix_caching",
+                  "hybrid_token_budget",
                   "num_blocks", "block_size", "weights_path",
                   "speculation", "spec_tokens", "spec_ngram"):
             setattr(c, f, getattr(a, f))

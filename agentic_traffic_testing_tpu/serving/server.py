@@ -98,6 +98,10 @@ class LLMServer:
                     # bucket under the cap so a burst never compiles
                     # mid-traffic (the exact stall the solo default avoids).
                     n += self.engine.warmup_prefill_buckets()
+                if cfg.hybrid_token_budget:
+                    # Every (decode bucket, chunk rung) the hybrid planner
+                    # can fuse — same mid-traffic-compile rationale.
+                    n += self.engine.warmup_hybrid_buckets()
                 log.info("warmed %d decode/chunk bucket programs in %.1fs",
                          n, time.monotonic() - t0)
         self.metrics = (
@@ -146,6 +150,7 @@ class LLMServer:
             prefill_chunk_tokens=c.prefill_chunk_tokens,
             prefill_batch_max_len=c.prefill_batch_max_len,
             prefix_caching=c.prefix_caching,
+            hybrid_token_budget=c.hybrid_token_budget,
             kv_cache_dtype=c.kv_cache_dtype,
             int4_k_group=c.int4_k_group,
             moe_capacity_factor=c.moe_capacity_factor,

@@ -44,6 +44,14 @@ testbed generates (BASELINE.md §2 "Fan-out workload"):
      over `BENCH_REPS` (default 3) repetitions — single-run numbers
      through the axon tunnel drift ±10-20%.
 
+A third, best-effort probe measures the hybrid prefill+decode fusion
+(hybrid_token_budget + the ragged Pallas kernel): a mixed arrival stream
+(short decoders + chunked long prompts) run with fusion ON vs OFF,
+reported as hybrid_decode_toks_s / hybrid_queue_wait_p50_s against
+serial_* twins plus the fused-step count. BENCH_HYBRID=0 disables;
+BENCH_HYBRID_BUDGET/_CHUNK/_LANES shape it. Degrades gracefully off-TPU
+(the ragged path falls back to the grouped-gather oracle).
+
 The model is the Llama-3.2-1B architecture (reference default family,
 randomly initialized — no weight downloads in this environment) in bf16,
 served by the engine's throughput configuration (fused decode_steps=32;
@@ -436,6 +444,83 @@ def main() -> None:
             raise
         return req.first_token_time - req.arrival_time
 
+    # Hybrid prefill+decode probe (ragged fused dispatch): a mixed arrival
+    # stream — short requests decoding while chunked long prompts arrive —
+    # measured with the fusion ON (hybrid_token_budget set) vs OFF. The
+    # decode tok/s delta shows chunks no longer starving decode lanes; the
+    # queue-wait delta shows prefill no longer queuing behind the decode
+    # cadence. Shares the primary runner; any failure just drops the
+    # hybrid_* keys (best-effort like every secondary series).
+    hybrid_on = os.environ.get("BENCH_HYBRID", "1") not in ("0", "false")
+    hybrid_budget = int(os.environ.get(
+        "BENCH_HYBRID_BUDGET", "256" if platform == "tpu" else "48"))
+    hybrid_chunk = int(os.environ.get(
+        "BENCH_HYBRID_CHUNK", "128" if platform == "tpu" else "32"))
+    hybrid_lanes = int(os.environ.get("BENCH_HYBRID_LANES", "8"))
+    hybrid_long_prompt = int(hybrid_chunk * 2.5)
+    hybrid_short_prompt = min(prompt_len, hybrid_chunk)
+
+    def hybrid_probe(budget: int):
+        """(decode tok/s of the short lanes, long-prompt queue-wait p50,
+        fused steps taken) under a mixed arrival stream."""
+        hyb_len = max(512, hybrid_long_prompt + decode_tokens + 16)
+        # Explicit small pool (like the bs8 engine): the probe engine is
+        # rebuilt per run and must not re-profile the primary's leftovers.
+        eng = LLMEngine(EngineConfig(
+            model=model, dtype="bfloat16", max_num_seqs=hybrid_lanes,
+            max_model_len=hyb_len,
+            num_blocks=max(1024, hybrid_lanes
+                           * (-(-hyb_len // cfg.block_size) + 4)),
+            decode_steps=decode_steps,
+            prefill_chunk_tokens=hybrid_chunk,
+            hybrid_token_budget=budget,
+            kv_cache_dtype=kv_cache_dtype,
+        ), model_cfg=engine.model_cfg, runner=engine.runner)
+        shorts = [eng.add_request(
+            rng.integers(10, vocab - 10, hybrid_short_prompt).tolist(),
+            SamplingParams(temperature=0.0, max_tokens=decode_tokens,
+                           ignore_eos=True))
+            for _ in range(max(1, hybrid_lanes - 2))]
+        for _ in range(4):  # decode wave in flight before the longs land
+            eng.step()
+        longs = [eng.add_request(
+            rng.integers(10, vocab - 10, hybrid_long_prompt).tolist(),
+            SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True))
+            for _ in range(2)]
+        reqs = shorts + longs
+        t0 = time.monotonic()
+        while eng.has_work() and not all(r.is_finished() for r in reqs):
+            eng.step()
+        dt = time.monotonic() - t0
+        toks = sum(len(r.output_ids) for r in shorts)
+        waits = [r.first_token_time - r.arrival_time for r in longs
+                 if r.first_token_time is not None]
+        return (toks / dt, statistics.median(waits) if waits else None,
+                eng.scheduler.num_scheduled_hybrid)
+
+    hybrid_res = None
+    if hybrid_on:
+        try:
+            hybrid_probe(hybrid_budget)  # warmup: compile both paths' shapes
+            hybrid_probe(0)
+            on_runs = [hybrid_probe(hybrid_budget) for _ in range(reps)]
+            off_runs = [hybrid_probe(0) for _ in range(reps)]
+            hybrid_res = {
+                "hybrid_token_budget": hybrid_budget,
+                "hybrid_decode_toks_s": round(statistics.median(
+                    [r[0] for r in on_runs]), 2),
+                "hybrid_queue_wait_p50_s": round(statistics.median(
+                    [r[1] for r in on_runs if r[1] is not None]), 4),
+                "hybrid_steps": on_runs[0][2],
+                "serial_decode_toks_s": round(statistics.median(
+                    [r[0] for r in off_runs]), 2),
+                "serial_queue_wait_p50_s": round(statistics.median(
+                    [r[1] for r in off_runs if r[1] is not None]), 4),
+            }
+        except Exception as e:
+            hybrid_res = None
+            print(f"bench: hybrid probe dropped ({e!r})", file=sys.stderr)
+
     # Warmup compiles every (batch, bucket) shape the workloads touch;
     # one batch-sized wave already walks the same bucket ladder as the
     # sustained run does while draining.
@@ -555,6 +640,7 @@ def main() -> None:
             "fanout": fanout,
             "fanout_prompt_tokens": fanout_prompt,
         }),
+        **({} if hybrid_res is None else hybrid_res),
         **({} if prefill_s is None else {
             # Compute-bound half of serving (round-3 flash prefill site).
             # est_mfu counts dense matmul FLOPs (2 * non-embedding params

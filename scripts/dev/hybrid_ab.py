@@ -1,0 +1,102 @@
+#!/usr/bin/env python3
+"""Micro-bench the ragged hybrid-batch attention kernel at bench shapes.
+
+A/B for the tentpole fusion claim at the KERNEL level, isolated from the
+engine (same xplane device-plane methodology as paged_decode_ab.py):
+
+  A (fused):  ONE ragged_paged_attention call over B decode rows + one
+              C-token prefill-chunk row — the hybrid step's shape.
+  B (serial): the dma2 decode kernel over the B decode rows, PLUS a
+              second ragged call for the chunk row alone — the two
+              dispatches the serial engine pays.
+
+The fused call should win on dispatch count and by overlapping the
+decode rows' page DMA with the chunk's MXU work across the shared grid;
+numbers feed docs/BENCHMARKS.md once measured on hardware.
+
+Usage: python scripts/dev/hybrid_ab.py [ctx] [batch] [chunk] [block_size]
+Env: HYBRID_AB_QBLK (q tokens per kernel block, default 8).
+No reference analog (the reference delegates batching policy to vLLM).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+import jax
+import jax.numpy as jnp
+
+from scripts.dev.quant_ab import device_total_ms
+
+N = 8
+
+
+def main() -> None:
+    argv = [int(a) for a in sys.argv[1:]]
+    ctx = argv[0] if len(argv) > 0 else 150
+    b = argv[1] if len(argv) > 1 else 32
+    chunk = argv[2] if len(argv) > 2 else 128
+    qblk = int(os.environ.get("HYBRID_AB_QBLK", "8"))
+
+    from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
+        paged_attention_decode_dma2,
+    )
+    from agentic_traffic_testing_tpu.ops.pallas.ragged_paged_attention import (
+        ragged_paged_attention,
+    )
+
+    # bench.py 1B layout: 16 layers, 8 kv heads, blocks of 16, hd lane-
+    # padded to 128 (real head_dim 64); pool token capacity 8192.
+    L, KH, BS, HD = 16, 8, 16, 128
+    BS = argv[3] if len(argv) > 3 else BS
+    NB = 8192 // BS
+    H = 32
+    chunk_start = 256  # chunk row's prior context
+    print(f"devices: {jax.devices()}  ctx={ctx} B={b} chunk={chunk} "
+          f"qblk={qblk} pool=[{L},{KH},{NB},{BS},{HD}]", flush=True)
+
+    rows = b + 1
+    max_blocks = NB // rows
+    assert (ctx + BS - 1) // BS <= max_blocks
+    assert (chunk_start + chunk + BS - 1) // BS <= max_blocks
+
+    key = jax.random.key(0)
+    kp = jax.random.normal(key, (L, KH, NB, BS, HD), jnp.bfloat16)
+    vp = jax.random.normal(key, (L, KH, NB, BS, HD), jnp.bfloat16)
+    bt = jnp.arange(rows * max_blocks, dtype=jnp.int32).reshape(
+        rows, max_blocks) % NB
+    dec_pos = jnp.full((b,), ctx - 1, jnp.int32)
+    pos = jnp.concatenate([dec_pos, jnp.asarray([chunk_start], jnp.int32)])
+    q_lens = (1,) * b + (chunk,)
+    t = b + chunk
+    lay = jnp.int32(3)
+    qs = [jax.random.normal(jax.random.key(i), (t, H, HD), jnp.bfloat16)
+          for i in range(N)]
+
+    def fused(q):
+        return ragged_paged_attention(
+            q, kp, vp, bt, pos, q_lens, layer=lay,
+            q_tokens_per_block=qblk)
+
+    def serial(q):
+        dec = paged_attention_decode_dma2(
+            q[:b], kp, vp, bt[:b], dec_pos + 1, layer=lay)
+        ck = ragged_paged_attention(
+            q[b:], kp, vp, bt[b:], pos[b:], (chunk,), layer=lay,
+            q_tokens_per_block=qblk)
+        return dec, ck
+
+    ms_f = device_total_ms(fused, [(q,) for q in qs], "/tmp/hybrid_ab_fused")
+    ms_s = device_total_ms(serial, [(q,) for q in qs], "/tmp/hybrid_ab_serial")
+    print(f"  fused  (1 ragged call, {t} q tokens): {ms_f * 1e3:8.1f} us/call "
+          f"DEVICE", flush=True)
+    print(f"  serial (dma2 decode + chunk call):    {ms_s * 1e3:8.1f} us/call "
+          f"DEVICE  ({ms_s / max(ms_f, 1e-9):.2f}x fused)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,247 @@
+"""Hybrid prefill+decode batching (HybridBatch + the fused ragged step).
+
+The invariants under test:
+  * hybrid_token_budget=0 (the default) is BIT-IDENTICAL to the serial
+    prefill-priority schedule — zero hybrid steps, same tokens.
+  * With the budget on, greedy and seeded-sampling outputs are
+    token-identical to the serial engine (fusion is a scheduling strategy,
+    never a numerics change), while fused steps actually happen.
+  * The fused model step works against both ragged-attention backends
+    (jnp grouped-gather oracle, and the Pallas ragged kernel in interpret
+    mode).
+  * Planner fallbacks: no decode partners -> solo chunk path; budget too
+    small for any chunk rung -> no fusion; speculation x hybrid refuses.
+"""
+
+import numpy as np
+import pytest
+
+# Heavyweight tier: CPU jit compiles dominate (pytest.ini tiering).
+pytestmark = pytest.mark.full
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.models.config import PRESETS
+from agentic_traffic_testing_tpu.models.llama import init_params
+from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+from agentic_traffic_testing_tpu.runtime.scheduler import HybridBatch
+
+CFG = PRESETS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def make_engine(params, hybrid=0, chunk=32, **kw):
+    kw.setdefault("model", "tiny")
+    kw.setdefault("dtype", "float32")
+    kw.setdefault("max_model_len", 256)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 128)
+    kw.setdefault("max_num_seqs", 4)
+    ecfg = EngineConfig(prefill_chunk_tokens=chunk,
+                        hybrid_token_budget=hybrid, **kw)
+    runner = ModelRunner(CFG, params, decode_steps=kw.get("decode_steps", 1))
+    return LLMEngine(ecfg, model_cfg=CFG, runner=runner)
+
+
+def greedy(n=8, **kw):
+    return SamplingParams(max_tokens=n, temperature=0.0, **kw)
+
+
+def run_all(engine, reqs):
+    for _ in range(10_000):
+        engine.step()
+        if all(r.is_finished() for r in reqs):
+            return
+        if not engine.has_work():
+            break
+    assert all(r.is_finished() for r in reqs), [r.state for r in reqs]
+
+
+def mixed_workload(engine, sampling_fn):
+    """Short prompts (decoding) + one long prompt (chunking) — the shape
+    the hybrid planner fuses."""
+    rng = np.random.default_rng(2)
+    shorts = [rng.integers(0, CFG.vocab_size, n).tolist() for n in (6, 14)]
+    long_p = rng.integers(0, CFG.vocab_size, 90).tolist()
+    reqs = [engine.add_request(p, sampling_fn()) for p in shorts]
+    reqs.append(engine.add_request(long_p, sampling_fn()))
+    run_all(engine, reqs)
+    return [r.generated_ids for r in reqs]
+
+
+def test_budget_zero_schedules_no_hybrid_steps(params):
+    eng = make_engine(params, hybrid=0)
+    mixed_workload(eng, greedy)
+    assert eng.scheduler.num_scheduled_hybrid == 0
+
+
+def test_hybrid_greedy_matches_serial(params):
+    want = mixed_workload(make_engine(params, hybrid=0), greedy)
+    eng = make_engine(params, hybrid=64)
+    got = mixed_workload(eng, greedy)
+    assert eng.scheduler.num_scheduled_hybrid > 0, "fusion never engaged"
+    assert got == want
+
+
+def test_hybrid_seeded_sampling_matches_serial(params):
+    sp = lambda: SamplingParams(max_tokens=6, temperature=0.8, top_k=20,
+                                seed=9)
+    want = mixed_workload(make_engine(params, hybrid=0), sp)
+    eng = make_engine(params, hybrid=64)
+    got = mixed_workload(eng, sp)
+    assert eng.scheduler.num_scheduled_hybrid > 0
+    assert got == want
+
+
+def test_hybrid_with_ragged_kernel_matches_serial(params, monkeypatch):
+    """Force the fused step's attention onto the Pallas ragged kernel
+    (interpret mode on CPU) instead of the gather oracle: tokens must
+    still match the serial engine — this is the in-engine parity pin for
+    the kernel itself."""
+    monkeypatch.setattr(ModelRunner, "hybrid_attn_mode", "ragged")
+    want = mixed_workload(make_engine(params, hybrid=0), lambda: greedy(4))
+    eng = make_engine(params, hybrid=64)
+    got = mixed_workload(eng, lambda: greedy(4))
+    assert eng.scheduler.num_scheduled_hybrid > 0
+    assert got == want
+
+
+def test_hybrid_solo_long_prompt_needs_no_partner(params):
+    """With nothing decoding, the chunk path must run solo exactly as
+    before (the hybrid planner falls back, it doesn't stall)."""
+    rng = np.random.default_rng(4)
+    long_p = rng.integers(0, CFG.vocab_size, 90).tolist()
+    want = make_engine(params, hybrid=0).generate(long_p, greedy()).generated_ids
+    eng = make_engine(params, hybrid=64)
+    req = eng.generate(long_p, greedy())
+    assert eng.scheduler.num_scheduled_hybrid == 0
+    assert req.generated_ids == want
+
+
+def test_hybrid_budget_too_small_falls_back(params):
+    """A budget below decode-lanes + smallest chunk rung can never fuse:
+    the planner must degrade to the serial schedule, not wedge."""
+    eng = make_engine(params, hybrid=3)  # block_size=8 > 3 - padded_batch
+    want = mixed_workload(make_engine(params, hybrid=0), greedy)
+    got = mixed_workload(eng, greedy)
+    assert eng.scheduler.num_scheduled_hybrid == 0
+    assert got == want
+
+
+def test_hybrid_chunk_splits_onto_budget_rung(params):
+    """A tight budget forces the chunk onto a smaller ladder rung; the
+    split remainder continues next step and output is unchanged."""
+    want = mixed_workload(make_engine(params, hybrid=0), greedy)
+    # budget 24: padded decode bucket 2 leaves room 22 -> rung 16 (< the
+    # chunk size 32), so fused chunks split.
+    eng = make_engine(params, hybrid=24)
+    got = mixed_workload(eng, greedy)
+    assert eng.scheduler.num_scheduled_hybrid > 0
+    assert got == want
+
+
+def test_hybrid_multistep_decode_composes(params):
+    """decode_steps > 1: fused hybrid steps interleave with multi-step
+    decode dispatches without token drift."""
+    want = mixed_workload(make_engine(params, hybrid=0, decode_steps=4),
+                          greedy)
+    eng = make_engine(params, hybrid=64, decode_steps=4)
+    got = mixed_workload(eng, greedy)
+    assert eng.scheduler.num_scheduled_hybrid > 0
+    assert got == want
+
+
+def test_hybrid_token_budget_counts_padded_tokens(params):
+    """Every emitted HybridBatch respects the budget on PADDED counts —
+    the fused program's real shape, not the optimistic real-token count."""
+    eng = make_engine(params, hybrid=24)
+    sched = eng.scheduler
+    orig = sched._plan_hybrid
+    seen = []
+
+    def spy():
+        hb = orig()
+        if hb is not None:
+            seen.append((hb.decode.padded_batch, hb.chunk.padded_len))
+        return hb
+
+    sched._plan_hybrid = spy
+    mixed_workload(eng, greedy)
+    assert seen, "no hybrid plans emitted"
+    for b, c in seen:
+        assert b + c <= 24, (b, c)
+
+
+def test_warmup_hybrid_buckets_compiles_reachable_shapes(params):
+    from agentic_traffic_testing_tpu.runtime.scheduler import pow2_buckets
+
+    eng = make_engine(params, hybrid=24)
+    ladder = [c for c in eng.scheduler.cfg.chunk_ladder() if c <= 16]
+    want = sum(1 for b in pow2_buckets(1, eng.cfg.max_num_seqs)
+               for c in ladder if b + c <= 24)
+    assert want > 0
+    assert eng.warmup_hybrid_buckets(max_chunk=16) == want
+    assert make_engine(params, hybrid=0).warmup_hybrid_buckets() == 0
+
+
+def test_speculation_refuses_hybrid():
+    with pytest.raises(ValueError, match="speculation"):
+        EngineConfig(model="tiny", speculation="ngram", hybrid_token_budget=64)
+
+
+def test_bench_emits_hybrid_metric_on_cpu():
+    """bench.py end-to-end (inner process, tiny shapes) on CPU: the script
+    must still run and print ONE parseable JSON line, now carrying the
+    hybrid on/off series — the CPU-degradation guard for the new metric."""
+    import json
+    import os
+    import subprocess
+    import sys as _sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(
+        os.environ, JAX_PLATFORMS="cpu", BENCH_INNER="1",
+        BENCH_MODEL="tiny", BENCH_BATCH="2", BENCH_SMALL_BATCH="0",
+        BENCH_TOTAL_REQUESTS="2", BENCH_PROMPT_LEN="16",
+        BENCH_DECODE_TOKENS="4", BENCH_REPS="1", BENCH_FANOUT="2",
+        BENCH_FANOUT_PROMPT_LEN="32", BENCH_PREFILL_LEN="64",
+        BENCH_HYBRID_BUDGET="24", BENCH_HYBRID_CHUNK="16",
+        BENCH_HYBRID_LANES="3", BENCH_NO_RECORDED="1",
+    )
+    proc = subprocess.run(
+        [_sys.executable, os.path.join(repo, "bench.py")],
+        env=env, capture_output=True, text=True, timeout=900, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["metric"] and out["value"] > 0
+    assert out["hybrid_token_budget"] == 24, out
+    assert out["hybrid_decode_toks_s"] > 0
+    assert out["serial_decode_toks_s"] > 0
+    assert out["hybrid_steps"] > 0, "fusion never engaged in the probe"
+    assert out["hybrid_queue_wait_p50_s"] >= 0
+    assert out["serial_queue_wait_p50_s"] >= 0
+
+
+def test_hybrid_batch_token_budget_property():
+    from agentic_traffic_testing_tpu.runtime.request import Request
+    from agentic_traffic_testing_tpu.runtime.scheduler import (
+        ChunkPrefill,
+        DecodeBatch,
+    )
+
+    r = Request(request_id="x", prompt_ids=[1] * 40,
+                sampling=SamplingParams(max_tokens=1))
+    hb = HybridBatch(
+        decode=DecodeBatch(requests=[], padded_batch=4),
+        chunk=ChunkPrefill(request=r, chunk_start=0, chunk_len=30,
+                           padded_len=32),
+    )
+    assert hb.token_budget == 36

@@ -1,0 +1,185 @@
+"""Ragged paged-attention kernel vs the jnp oracle, plus the every-mode
+trace smoke.
+
+Two jobs:
+  * Parity for the NEW ragged kernel (ops/pallas/ragged_paged_attention):
+    mixed decode/prefill-chunk rows in one grid, interpret mode on CPU,
+    against the grouped gather+causal_attention oracle.
+  * A trace-smoke test that BUILDS every ATT_TPU_ATTENTION kernel mode in
+    interpret mode and checks parity vs the jnp oracle. The dma3
+    missing-scratch bug (kernel unpacked 7 scratch refs, scratch_shapes
+    declared 6) crashed at TRACE time — a whole mode could ship broken
+    without any tier-1 test noticing until hardware. This class of bug
+    must fail here, in the default tier, not on a v5e.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.ops.attention_backend import (
+    paged_decode_attention,
+)
+from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
+from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
+    paged_attention_decode_dma,
+    paged_attention_decode_dma2,
+    paged_attention_decode_dma3,
+)
+from agentic_traffic_testing_tpu.ops.pallas.ragged_paged_attention import (
+    ragged_paged_attention,
+    ragged_paged_attention_ref,
+)
+from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK, gather_kv
+
+
+def _ragged_case(rng, q_lens, positions, *, h=4, kh=2, hd=64, bs=4,
+                 num_blocks=64, width=16, dtype=jnp.float32):
+    t = sum(q_lens)
+    q = jnp.asarray(rng.standard_normal((t, h, hd)), dtype)
+    kp = jnp.asarray(rng.standard_normal((kh, num_blocks, bs, hd)), dtype)
+    vp = jnp.asarray(rng.standard_normal((kh, num_blocks, bs, hd)), dtype)
+    bt = np.full((len(q_lens), width), TRASH_BLOCK, np.int32)
+    nxt = 1
+    for r, (ln, p0) in enumerate(zip(q_lens, positions)):
+        n = -(-(p0 + ln) // bs)
+        bt[r, :n] = np.arange(nxt, nxt + n)
+        nxt += n
+    assert nxt <= num_blocks
+    return q, kp, vp, jnp.asarray(bt), jnp.asarray(positions, jnp.int32)
+
+
+# -- ragged kernel parity ---------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "q_lens,positions",
+    [
+        # decode-only (uniform 1-token rows)
+        ((1, 1, 1), (5, 0, 12)),
+        # the hybrid shape: decode rows + one chunk row
+        ((1, 1, 1, 13), (6, 0, 14, 8)),
+        # chunk starting at position 0 (fresh prompt's first chunk)
+        ((1, 16), (3, 0)),
+        # two chunks of different lengths, no decode rows
+        ((9, 5), (4, 0)),
+    ],
+)
+def test_ragged_kernel_matches_oracle(q_lens, positions):
+    rng = np.random.default_rng(42)
+    q, kp, vp, bt, pos = _ragged_case(rng, q_lens, positions)
+    got = ragged_paged_attention(q, kp, vp, bt, pos, q_lens, interpret=True)
+    want = ragged_paged_attention_ref(q, kp, vp, bt, pos, q_lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_oracle_matches_causal_attention():
+    """The oracle itself against a hand-built causal_attention per row —
+    so kernel parity isn't circular through a buggy oracle."""
+    rng = np.random.default_rng(3)
+    q_lens, positions = (1, 6), (7, 2)
+    q, kp, vp, bt, pos = _ragged_case(rng, q_lens, positions)
+    want = ragged_paged_attention_ref(q, kp, vp, bt, pos, q_lens)
+    start = 0
+    for r, ln in enumerate(q_lens):
+        k_all = gather_kv(kp, bt[r:r + 1])
+        v_all = gather_kv(vp, bt[r:r + 1])
+        qpos = pos[r] + jnp.arange(ln, dtype=jnp.int32)[None]
+        row = causal_attention(
+            q[start:start + ln][None], k_all, v_all,
+            q_positions=qpos, kv_valid_len=pos[r:r + 1] + ln)
+        np.testing.assert_allclose(
+            np.asarray(want[start:start + ln]), np.asarray(row[0]),
+            atol=2e-5, rtol=2e-5)
+        start += ln
+
+
+def test_ragged_kernel_stacked_padded_pool():
+    """The serving layout: stacked [L, ...] pool, lane-padded pages, layer
+    scalar — exactly what the hybrid step passes from the decode scan."""
+    rng = np.random.default_rng(11)
+    q_lens, positions = ((1, 1, 9)), (5, 0, 4)
+    q, kp, vp, bt, pos = _ragged_case(rng, q_lens, positions, num_blocks=32)
+    L, hdp, hd = 3, 128, q.shape[-1]
+    kh, nb, bs = kp.shape[0], kp.shape[1], kp.shape[2]
+    kp5 = jnp.zeros((L, kh, nb, bs, hdp), kp.dtype)
+    vp5 = jnp.zeros((L, kh, nb, bs, hdp), vp.dtype)
+    kp5 = kp5.at[1, ..., :hd].set(kp).at[1, ..., hd:].set(99.0)
+    vp5 = vp5.at[1, ..., :hd].set(vp).at[1, ..., hd:].set(99.0)
+    got = ragged_paged_attention(q, kp5, vp5, bt, pos, q_lens,
+                                 layer=jnp.int32(1), interpret=True)
+    want = ragged_paged_attention_ref(q, kp, vp, bt, pos, q_lens)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_ragged_kernel_bf16():
+    rng = np.random.default_rng(7)
+    q_lens, positions = (1, 1, 8), (11, 3, 0)
+    q, kp, vp, bt, pos = _ragged_case(rng, q_lens, positions, h=8, kh=2,
+                                      bs=8, dtype=jnp.bfloat16)
+    got = ragged_paged_attention(q, kp, vp, bt, pos, q_lens, interpret=True)
+    want = ragged_paged_attention_ref(q, kp, vp, bt, pos, q_lens)
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(want, np.float32),
+        atol=2e-2, rtol=2e-2)
+
+
+def test_ragged_kernel_output_is_finite_with_dead_row():
+    """A trash-table 1-token row (the scheduler's dead-lane shape) must
+    produce finite garbage — padded q-block rows included."""
+    rng = np.random.default_rng(5)
+    q_lens, positions = (1, 5), (0, 2)
+    q, kp, vp, bt, pos = _ragged_case(rng, q_lens, positions)
+    bt = bt.at[0].set(TRASH_BLOCK)
+    got = ragged_paged_attention(q, kp, vp, bt, pos, q_lens, interpret=True)
+    assert np.isfinite(np.asarray(got)).all()
+
+
+# -- every-mode trace smoke -------------------------------------------------
+
+_DIRECT_KERNELS = {
+    "dma": paged_attention_decode_dma,
+    "dma2": paged_attention_decode_dma2,
+    "dma3": paged_attention_decode_dma3,
+}
+
+
+@pytest.mark.parametrize(
+    "mode", ["gather", "interpret", "dma", "dma2", "dma3", "ragged"])
+@pytest.mark.parametrize("s", [1, 3])
+def test_every_mode_traces_and_matches_oracle(mode, s):
+    """Build EVERY decode-attention mode on the decode (S=1) and verify
+    (S>1) shapes and assert parity vs the gather oracle. Pallas kernels
+    run in interpret mode; trace-time breakage (scratch_shapes vs kernel
+    unpack mismatches, BlockSpec arity bugs, version drift in
+    CompilerParams) fails HERE instead of on hardware."""
+    rng = np.random.default_rng(9)
+    b, h, kh, hd, bs = 2, 4, 2, 64, 4
+    ctx = [6, 11]
+    q = jnp.asarray(rng.standard_normal((b, s, h, hd)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((kh, 16, bs, hd)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((kh, 16, bs, hd)), jnp.float32)
+    bt = np.full((b, 8), TRASH_BLOCK, np.int32)
+    nxt = 1
+    for i, ln in enumerate(ctx):
+        n = -(-(ln + s - 1) // bs)
+        bt[i, :n] = np.arange(nxt, nxt + n)
+        nxt += n
+    bt = jnp.asarray(bt)
+    cl = jnp.asarray(ctx, jnp.int32)
+    positions = cl - 1
+
+    if mode in _DIRECT_KERNELS:
+        got = _DIRECT_KERNELS[mode](
+            q[:, 0] if s == 1 else q, kp, vp, bt, cl, interpret=True)
+        if s == 1:
+            got = got[:, None]
+    else:
+        got = paged_decode_attention(q, kp, vp, bt, positions, mode=mode)
+    want = paged_decode_attention(q, kp, vp, bt, positions, mode="gather")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               atol=2e-5, rtol=1e-4)
