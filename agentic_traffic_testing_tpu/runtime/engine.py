@@ -1144,3 +1144,45 @@ class LLMEngine:
 
     def kv_stats(self) -> dict:
         return self.scheduler.kv_stats()
+
+    # -- router-facing snapshots (read from OTHER threads) -----------------
+
+    def load_snapshot(self) -> dict:
+        """Lock-free load view for the replica router (serving/router.py).
+
+        Called from the HTTP thread while the step thread mutates the
+        engine: every field is ONE len()/attribute read of a host Python
+        object — atomic under the GIL, never blocking the step loop.
+        Fields from different instants may be mutually inconsistent (a
+        request can move waiting -> running between two reads); routing
+        needs a load estimate, not a transaction, so that is fine."""
+        return {
+            "num_waiting": len(self.scheduler.waiting),
+            "num_running": len(self.scheduler.running),
+            "inflight_dispatches": len(self._inflight),
+            "free_blocks": self.allocator.num_free_blocks,
+            "max_num_seqs": self.cfg.max_num_seqs,
+            "block_size": self.cfg.block_size,
+        }
+
+    def chain_keys_for(self, prompt_ids: list[int]):
+        """Content-addressing chain keys for a prompt, or None without a
+        prefix-caching allocator. Computed once by the router and shared
+        across every replica's probe (replicas share block_size)."""
+        chain = getattr(self.allocator, "chain_keys", None)
+        if chain is None:
+            return None
+        return chain(list(prompt_ids))
+
+    def probe_prefix_tokens(self, prompt_ids: list[int], keys=None) -> int:
+        """Read-only prefix-cache probe: cached tokens a prompt would reuse
+        on THIS replica right now; 0 without prefix caching.
+
+        Safe against the step thread without a lock: probe_prefix walks the
+        index with dict.get (one C call per block) and mutates nothing, so
+        the worst concurrent outcome is a slightly stale hit count — a
+        routing inaccuracy, never corruption."""
+        probe = getattr(self.allocator, "probe_prefix", None)
+        if probe is None:
+            return 0
+        return probe(list(prompt_ids), keys)

@@ -55,6 +55,18 @@ class ServerConfig:
     # divisibility caps tp (parallel/pp_runner.py; latency model in the
     # serving-stack ADR). Mutually exclusive with tp_size/sp_size.
     pp_size: int = 1                           # LLM_PP_SIZE
+    # Data-parallel replica count (serving/replica_pool.py): N shared-
+    # nothing LLMEngine replicas — one TPU chip each on multichip, plain
+    # N-on-CPU elsewhere — behind the router below. 1 (default) keeps the
+    # single-engine path bit-identical. Does not compose with tp/sp/pp
+    # meshes (the server refuses the combination at startup).
+    num_replicas: int = 1                      # LLM_NUM_REPLICAS
+    # Replica routing policy: round_robin | least_loaded | prefix_affinity
+    # (serving/router.py — prefix_affinity lands fan-out siblings where
+    # their scenario prompt's KV already lives; pair with
+    # LLM_PREFIX_CACHING=1, without which it degrades to consistent-hash
+    # + load routing). Ignored at num_replicas=1.
+    router_policy: str = "round_robin"         # LLM_ROUTER_POLICY
     quantization: Optional[str] = None         # LLM_QUANTIZATION ("int8" | "int4" | unset)
     decode_steps: Optional[int] = None         # LLM_DECODE_STEPS (None -> auto)
     prefill_chunk_tokens: int = 4096           # LLM_PREFILL_CHUNK_TOKENS (0 = off)
@@ -122,6 +134,17 @@ class ServerConfig:
         c.tp_size = int(os.environ.get("LLM_TP_SIZE") or c.tp_size)
         c.sp_size = int(os.environ.get("LLM_SP_SIZE") or c.sp_size)
         c.pp_size = int(os.environ.get("LLM_PP_SIZE") or c.pp_size)
+        c.num_replicas = int(
+            os.environ.get("LLM_NUM_REPLICAS") or c.num_replicas)
+        if c.num_replicas < 1:
+            # 0 would silently serve single-engine while exporting
+            # llm_config_num_replicas 0 (capacity formulas read as zero);
+            # the CPU fallback rejects the same value loudly.
+            raise ValueError(
+                f"LLM_NUM_REPLICAS must be >= 1, got {c.num_replicas} "
+                f"(unset it for the single-engine default)")
+        c.router_policy = (
+            os.environ.get("LLM_ROUTER_POLICY") or c.router_policy)
         c.quantization = os.environ.get("LLM_QUANTIZATION") or None
         ds = os.environ.get("LLM_DECODE_STEPS")
         c.decode_steps = int(ds) if ds else None
@@ -170,6 +193,10 @@ class ServerConfig:
         p.add_argument("--host", default=c.host)
         p.add_argument("--port", type=int, default=c.port)
         p.add_argument("--tp-size", type=int, default=c.tp_size)
+        p.add_argument("--num-replicas", type=int, default=c.num_replicas,
+                       help="data-parallel replica count (1 = single engine)")
+        p.add_argument("--router-policy", default=c.router_policy,
+                       help="round_robin | least_loaded | prefix_affinity")
         p.add_argument("--quantization", default=c.quantization)
         p.add_argument("--decode-steps", type=int, default=c.decode_steps)
         p.add_argument("--prefill-chunk-tokens", type=int,
@@ -191,7 +218,8 @@ class ServerConfig:
         a = p.parse_args(argv)
         for f in ("model", "dtype", "max_num_seqs", "max_num_batched_tokens",
                   "memory_utilization", "max_tokens", "max_model_len",
-                  "temperature", "host", "port", "tp_size", "quantization",
+                  "temperature", "host", "port", "tp_size", "num_replicas",
+                  "router_policy", "quantization",
                   "decode_steps", "prefill_chunk_tokens",
                   "prefill_batch_max_len", "prefix_caching",
                   "hybrid_token_budget",

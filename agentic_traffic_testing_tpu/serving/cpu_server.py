@@ -21,6 +21,7 @@ Env: LLM_MODEL, LLM_MAX_TOKENS, HOST/LLM_HOST, PORT/LLM_PORT.
 
 from __future__ import annotations
 
+import itertools
 import json
 import os
 import threading
@@ -76,17 +77,45 @@ def _build_hf(model_name: str):
     return pipeline("text-generation", model=model, tokenizer=tok, device=-1)
 
 
-_pipe = None
+_pipes: list = []
 _pipe_lock = threading.Lock()
+_rr = itertools.count()
+
+_OFFLINE_MODELS = ("tiny", "debug-512")
+
+
+def _num_replicas() -> int:
+    """LLM_NUM_REPLICAS, validated. The CPU fallback honors the knob with a
+    trivial round-robin over N independent pipelines (parity with the TPU
+    backend's EnginePool contract) — but only for the offline tiny model:
+    N copies of a real HF checkpoint would be N x the host RAM for zero
+    benefit on a shared CPU, so that combination is refused AT STARTUP
+    (run() builds the pipelines eagerly), never as a mid-request 500."""
+    raw = os.environ.get("LLM_NUM_REPLICAS", "1") or "1"
+    try:
+        n = int(raw)
+    except ValueError:
+        raise RuntimeError(f"LLM_NUM_REPLICAS={raw!r} is not an integer")
+    if n < 1:
+        raise RuntimeError(f"LLM_NUM_REPLICAS must be >= 1, got {n}")
+    return n
 
 
 def get_pipeline():
-    global _pipe
     with _pipe_lock:
-        if _pipe is None:
+        if not _pipes:
             model = os.environ.get("LLM_MODEL") or os.environ.get("MODEL_NAME", "tiny")
-            _pipe = _build_tiny() if model in ("tiny", "debug-512") else _build_hf(model)
-        return _pipe
+            n = _num_replicas()
+            if model in _OFFLINE_MODELS:
+                _pipes.extend(_build_tiny() for _ in range(n))
+            else:
+                if n > 1:
+                    raise RuntimeError(
+                        f"LLM_NUM_REPLICAS={n} on the CPU fallback is only "
+                        f"supported for the offline tiny model; unset it (or "
+                        f"set 1) when LLM_MODEL={model!r}")
+                _pipes.append(_build_hf(model))
+    return _pipes[next(_rr) % len(_pipes)]
 
 
 class CPUFallbackHandler(BaseHTTPRequestHandler):
@@ -168,9 +197,13 @@ class CPUFallbackHandler(BaseHTTPRequestHandler):
 def run() -> None:
     host = os.environ.get("LLM_HOST") or os.environ.get("HOST", "0.0.0.0")
     port = int(os.environ.get("LLM_PORT") or os.environ.get("PORT", "8000"))
+    if _num_replicas() > 1:
+        # Eager build: an unsupported replicas x model combination (or the
+        # N-fold build cost itself) must surface here, not mid-request.
+        get_pipeline()
     server = ThreadingHTTPServer((host, port), CPUFallbackHandler)
     print(f"[cpu-fallback] serving {os.environ.get('LLM_MODEL', 'tiny')} "
-          f"on http://{host}:{port}", flush=True)
+          f"x{_num_replicas()} on http://{host}:{port}", flush=True)
     try:
         server.serve_forever()
     except KeyboardInterrupt:

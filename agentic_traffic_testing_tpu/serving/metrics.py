@@ -31,7 +31,8 @@ class LLMMetrics:
 
     content_type = CONTENT_TYPE_LATEST
 
-    def __init__(self, prefix: str = "llm", include_tokens: bool = True) -> None:
+    def __init__(self, prefix: str = "llm", include_tokens: bool = True,
+                 num_replicas: int = 1) -> None:
         self.include_tokens = include_tokens
         r = self.registry = CollectorRegistry()
         self.requests_total = Counter(
@@ -75,6 +76,39 @@ class LLMMetrics:
         self.config_pp_size = Gauge(
             f"{prefix}_config_pp_size",
             "Pipeline-parallel serving degree (LLM_PP_SIZE)", registry=r)
+        self.config_num_replicas = Gauge(
+            f"{prefix}_config_num_replicas",
+            "Data-parallel replica count (LLM_NUM_REPLICAS)", registry=r)
+        # Per-replica labeled series exist ONLY under a replica pool: at
+        # num_replicas=1 no replica-labeled family appears (the one
+        # addition to the single-engine payload is the config gauge above).
+        # Every pre-existing llm_* family keeps its exact name and meaning
+        # — under a pool it reports the POOL AGGREGATE (sums; see
+        # docs/monitoring.md) — so dashboards keep working; these series
+        # add the per-replica breakdown.
+        self.replica_routed = None
+        self.replica_waiting = None
+        self.replica_running = None
+        self.replica_used_blocks = None
+        self.replica_prefix_hits = None
+        if num_replicas > 1:
+            self.replica_routed = Gauge(
+                f"{prefix}_replica_routed_requests_total",
+                "Requests the router assigned to this replica (cumulative)",
+                ["replica"], registry=r)
+            self.replica_waiting = Gauge(
+                f"{prefix}_replica_num_waiting",
+                "Requests queued on this replica", ["replica"], registry=r)
+            self.replica_running = Gauge(
+                f"{prefix}_replica_num_running",
+                "Requests running on this replica", ["replica"], registry=r)
+            self.replica_used_blocks = Gauge(
+                f"{prefix}_replica_kv_used_blocks",
+                "KV blocks in use on this replica", ["replica"], registry=r)
+            self.replica_prefix_hits = Gauge(
+                f"{prefix}_replica_prefix_cache_hit_tokens_total",
+                "Prompt tokens served from this replica's prefix cache "
+                "(cumulative)", ["replica"], registry=r)
         self.kv_cache_num_gpu_blocks = Gauge(
             f"{prefix}_kv_cache_num_gpu_blocks",
             "KV cache: number of device blocks allocated; -1 means unknown",
@@ -149,6 +183,24 @@ class LLMMetrics:
             self.prefix_cache_hit_tokens.set(stats["prefix_cache_hit_tokens"])
             self.prefix_cache_query_tokens.set(stats["prefix_cache_query_tokens"])
 
+    def set_replica_stats(self, replica_stats: list) -> None:
+        """Refresh the per-replica labeled series from EnginePool
+        .replica_stats() (called on scrape; no-op without a pool)."""
+        if self.replica_routed is None:
+            return
+        for i, stats in enumerate(replica_stats):
+            label = str(i)
+            self.replica_routed.labels(replica=label).set(
+                stats.get("routed_requests", 0))
+            self.replica_waiting.labels(replica=label).set(
+                stats.get("num_waiting", 0))
+            self.replica_running.labels(replica=label).set(
+                stats.get("num_running", 0))
+            self.replica_used_blocks.labels(replica=label).set(
+                stats.get("used_blocks", 0))
+            self.replica_prefix_hits.labels(replica=label).set(
+                stats.get("prefix_cache_hit_tokens", 0))
+
     def set_spec_stats(self, *, emitted: int, iters: int) -> None:
         """Refresh speculation-acceptance gauges (called on scrape; zeros
         until a speculative engine has decoded something)."""
@@ -171,7 +223,10 @@ class LLMMetrics:
     def set_config_gauges(self, *, max_num_seqs: int, max_num_batched_tokens: int,
                           memory_utilization: float, max_tokens: int,
                           tp_size: int = 1, sp_size: int = 1,
-                          pp_size: int = 1) -> None:
+                          pp_size: int = 1, num_replicas: int = 1) -> None:
+        # max_num_seqs/max_num_batched_tokens stay PER-REPLICA values (the
+        # configured knob, a config snapshot — docs/monitoring.md); the
+        # pool-wide seat count is num_replicas * max_num_seqs.
         self.config_max_num_seqs.set(max_num_seqs)
         self.config_max_num_batched_tokens.set(max_num_batched_tokens)
         self.config_gpu_memory_utilization.set(memory_utilization)
@@ -179,6 +234,7 @@ class LLMMetrics:
         self.config_tp_size.set(tp_size)
         self.config_sp_size.set(sp_size)
         self.config_pp_size.set(pp_size)
+        self.config_num_replicas.set(num_replicas)
 
     def set_kv_gauges(self, *, num_blocks: int, block_size: int,
                       max_model_len: int, max_num_seqs: int) -> None:

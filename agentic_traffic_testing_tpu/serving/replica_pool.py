@@ -1,0 +1,226 @@
+"""EnginePool: shared-nothing data-parallel replica serving.
+
+One `LLMEngine` is one step thread over one KV pool — every knob so far
+(hybrid batching, fp8 KV, speculation) optimizes *within* that pool. The
+pool scales *out*: N fully independent `LLMEngine` + `AsyncLLMEngine`
+replicas, each with its own scheduler, allocator, prefix-cache index and
+step thread, fronted by a pluggable router (serving/router.py). Nothing is
+shared between replicas — no cross-replica locks, no shared KV — so the
+failure and performance isolation is total: a wedged replica wedges 1/N of
+traffic, and decode throughput scales with replicas until the interconnect
+or HBM of the slowest chip saturates.
+
+Device placement: on multichip TPU each replica owns one device of
+`jax.devices()` — its params and cache are committed there with
+`jax.device_put`, so every dispatch from its step thread pins to its chip
+(runner passes `self.params` per call; jit follows committed operands).
+Under the CPU test mesh (or any single-device host) replicas are plain
+N-on-one-device: still N independent schedulers/pools, which is exactly
+what the routing and abort tests need. Data-parallel replicas do not
+compose with tp/sp/pp meshes yet — the server refuses that combination at
+startup rather than silently splitting a mesh.
+
+Two driving modes, mirroring LLMEngine/AsyncLLMEngine:
+  * sync  — `add_request` routes, `step` advances every replica with work
+    (bench.py, tests drive this single-threaded).
+  * async — `start()` spins one engine thread per replica; `generate()`
+    routes then delegates to that replica's AsyncLLMEngine stream. The
+    serving layer sees the same generate-contract as a single engine.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import AsyncIterator, Callable, List, Optional
+
+from agentic_traffic_testing_tpu.runtime.engine import LLMEngine, StepOutput
+from agentic_traffic_testing_tpu.runtime.request import Request, SamplingParams
+from agentic_traffic_testing_tpu.serving.async_engine import (
+    AsyncLLMEngine,
+    TokenEvent,
+)
+from agentic_traffic_testing_tpu.serving.router import make_router
+
+log = logging.getLogger("att_tpu.replica_pool")
+
+
+def replica_devices(num_replicas: int):
+    """Disjoint device slice per replica: one TPU chip each on multichip,
+    None (default placement) everywhere else — the CPU test mesh's 8
+    virtual devices share one set of host cores, so pinning would add
+    transfers without adding compute."""
+    import jax
+
+    devices = jax.devices()
+    if devices[0].platform != "tpu":
+        return [None] * num_replicas
+    if num_replicas > len(devices):
+        # Including the 1-chip case: two engines HBM-profiling the same
+        # chip would OOM at startup at best, or silently serve shared-chip
+        # "replicas" with zero scale-out at worst.
+        raise ValueError(
+            f"LLM_NUM_REPLICAS={num_replicas} exceeds the {len(devices)} "
+            f"available TPU devices; shared-nothing replicas need one chip "
+            f"each")
+    if len(devices) < 2:
+        return [None] * num_replicas  # one replica, one chip: default placement
+    return [devices[i] for i in range(num_replicas)]
+
+
+class EnginePool:
+    """N shared-nothing engine replicas behind one router."""
+
+    def __init__(self, engines: List[LLMEngine], policy: str = "round_robin",
+                 on_step: Optional[Callable[[int], None]] = None,
+                 devices: Optional[list] = None) -> None:
+        self.engines = list(engines)
+        self.policy = policy
+        self.router = make_router(policy, self.engines)
+        self.devices = devices or [None] * len(self.engines)
+        # Routing decisions per replica (exported as the per-replica
+        # labeled series; plain int increments under the GIL).
+        self.routed_requests = [0] * len(self.engines)
+        self._async = [AsyncLLMEngine(e, on_step=on_step)
+                       for e in self.engines]
+
+    @classmethod
+    def build(cls, engine_factory: Callable[[int], LLMEngine],
+              num_replicas: int, policy: str = "round_robin",
+              on_step: Optional[Callable[[int], None]] = None) -> "EnginePool":
+        """Construct N replicas, slicing devices on multichip.
+
+        `engine_factory(i)` builds replica i's engine; on multichip it runs
+        under `jax.default_device(dev_i)` (weights/cache materialize on the
+        right chip, no cross-chip copy at startup) and the finished
+        replica's params + cache are then committed there so dispatch pins.
+        """
+        import contextlib
+
+        import jax
+
+        devices = replica_devices(num_replicas)
+        engines: List[LLMEngine] = []
+        for i, dev in enumerate(devices):
+            ctx = (jax.default_device(dev) if dev is not None
+                   else contextlib.nullcontext())
+            with ctx:
+                engine = engine_factory(i)
+            if dev is not None:
+                engine.runner.params = jax.device_put(engine.runner.params, dev)
+                engine.cache = jax.device_put(engine.cache, dev)
+                log.info("replica %d pinned to %s", i, dev)
+            engines.append(engine)
+        return cls(engines, policy=policy, on_step=on_step, devices=devices)
+
+    def __len__(self) -> int:
+        return len(self.engines)
+
+    # -- routing -----------------------------------------------------------
+
+    def route(self, prompt_ids: list[int],
+              request_id: Optional[str] = None) -> int:
+        idx = self.router.select(prompt_ids, request_id)
+        self.routed_requests[idx] += 1
+        return idx
+
+    # -- sync API (bench, tests) -------------------------------------------
+
+    def add_request(self, prompt_ids: list[int],
+                    sampling: Optional[SamplingParams] = None,
+                    request_id: Optional[str] = None) -> Request:
+        idx = self.route(prompt_ids, request_id)
+        return self.engines[idx].add_request(prompt_ids, sampling,
+                                             request_id=request_id)
+
+    def step(self) -> list[StepOutput]:
+        """One dispatch per replica that has work; concatenated events.
+
+        Single-threaded convenience for bench/tests — replicas interleave
+        on one host thread here, while the async path gives each its own.
+        """
+        events: list[StepOutput] = []
+        for e in self.engines:
+            if e.has_work():
+                events.extend(e.step())
+        return events
+
+    def has_work(self) -> bool:
+        return any(e.has_work() for e in self.engines)
+
+    def abort_request(self, req: Request) -> list[StepOutput]:
+        """Abort on whichever replica owns the request. Sibling drain
+        events come back exactly like LLMEngine.abort_request's — and only
+        ever from the owning replica: shared-nothing means an abort cannot
+        disturb any other replica's streams."""
+        for e in self.engines:
+            if req.request_id in e._requests:
+                return e.abort_request(req)
+        return []
+
+    # -- async API (serving layer) -----------------------------------------
+
+    def start(self) -> None:
+        for a in self._async:
+            a.start()
+
+    def shutdown(self) -> None:
+        for a in self._async:
+            a.shutdown()
+
+    async def generate(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams,
+        request_id: Optional[str] = None,
+    ) -> AsyncIterator[TokenEvent]:
+        """Route once, then stream from the owning replica. The delegated
+        AsyncLLMEngine keeps its own dead-stream abort handling, so a
+        disconnected client aborts on (and only on) its replica."""
+        idx = self.route(prompt_ids, request_id)
+        async for ev in self._async[idx].generate(prompt_ids, sampling,
+                                                  request_id):
+            yield ev
+
+    # -- aggregation (metrics layer) ---------------------------------------
+
+    @property
+    def spec_emitted(self) -> int:
+        return sum(e.spec_emitted for e in self.engines)
+
+    @property
+    def spec_iters(self) -> int:
+        return sum(e.spec_iters for e in self.engines)
+
+    @property
+    def usable_tokens(self) -> int:
+        return sum(e.cache.usable_tokens for e in self.engines)
+
+    @property
+    def num_blocks(self) -> int:
+        """Usable blocks across the pool (each replica's trash block
+        excluded — it holds no request KV)."""
+        return sum(e.cache.num_blocks - 1 for e in self.engines)
+
+    @property
+    def block_size(self) -> int:
+        return self.engines[0].cache.block_size
+
+    def kv_stats(self) -> dict:
+        """Pool view with every per-replica key SUMMED except block_size
+        (a config invariant, identical across replicas). Keys match
+        LLMEngine.kv_stats exactly so the metrics layer is agnostic."""
+        agg: dict = {}
+        for e in self.engines:
+            for k, v in e.kv_stats().items():
+                agg[k] = agg.get(k, 0) + v
+        agg["block_size"] = self.block_size
+        return agg
+
+    def replica_stats(self) -> list[dict]:
+        """Per-replica snapshot for the `llm_replica_*` labeled series."""
+        out = []
+        for i, e in enumerate(self.engines):
+            stats = e.kv_stats()
+            stats["routed_requests"] = self.routed_requests[i]
+            out.append(stats)
+        return out

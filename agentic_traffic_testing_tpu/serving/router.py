@@ -1,0 +1,172 @@
+"""Replica routing policies for data-parallel serving (EnginePool).
+
+Shared-nothing replicas make *placement* the whole ballgame: each replica
+owns a private KV pool and prefix-cache index, so which replica a request
+lands on decides whether its prompt prefill is a prefix-cache hit or a full
+recompute — the block-reuse economics PagedAttention established (Kwon et
+al., PAPERS.md), and the dimension the vLLM-vs-TGI comparative study found
+serving systems differ on most in practice. Agentic traffic is the best
+possible case: the orchestrator fans out workers that all quote the same
+~512-token scenario prompt (PAPER.md workflow), so affinity routing turns
+N-1 of N sibling prefills into cache hits.
+
+Three policies, selected by `LLM_ROUTER_POLICY`:
+
+  round_robin     — strict rotation; the throughput-fair baseline.
+  least_loaded    — lowest queue depth (waiting + running) wins; ties break
+                    to the lowest replica index.
+  prefix_affinity — score replicas by their read-only prefix-cache probe
+                    (`LLMEngine.probe_prefix_tokens`); the deepest hit wins,
+                    load-tie-broken. Cold prefixes fall back to RENDEZVOUS
+                    hashing over the prompt's first KV block, so fan-out
+                    siblings deterministically co-locate *before* any of
+                    them has registered the prefix. A saturated target
+                    (a full extra wave already queued) overflows to the
+                    least-loaded unsaturated replica — bounded queue wait
+                    beats a cache hit that would sit behind max_num_seqs
+                    other requests.
+
+Routers only READ engine state, through the lock-free snapshot methods the
+engine exposes for exactly this (engine.load_snapshot / probe_prefix_tokens):
+single dict/len reads under the GIL, safe against the step thread, never
+blocking it. All policies are pure host logic — unit-testable with stub
+engines (tests/test_router.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+from typing import Optional, Sequence
+
+
+def prefix_route_key(prompt_ids: Sequence[int], block_size: int) -> bytes:
+    """Stable routing key: the prompt's first KV block's tokens.
+
+    One block (not the whole prompt) on purpose — fan-out siblings share the
+    scenario prefix but diverge in their task suffix, and the router must
+    map ALL of them to one replica. sha1 over the decimal token string is
+    process- and PYTHONHASHSEED-independent (builtin hash() of int tuples
+    happens to be stable today, but nothing documents it)."""
+    head = list(prompt_ids[: max(1, block_size)])
+    return ",".join(str(int(t)) for t in head).encode()
+
+
+def rendezvous_pick(key: bytes, n: int) -> int:
+    """Highest-random-weight (rendezvous) hash: key -> replica in [0, n).
+
+    Consistent under membership change: removing a replica only remaps the
+    keys that replica owned; every other key keeps its assignment (the
+    property plain `hash % n` lacks — resizing would reshuffle everything
+    and cold-start every prefix cache)."""
+    if n <= 0:
+        raise ValueError("rendezvous over an empty replica set")
+    best, best_score = 0, b""
+    for i in range(n):
+        score = hashlib.sha1(key + b"#%d" % i).digest()
+        if score > best_score:
+            best, best_score = i, score
+    return best
+
+
+class Router:
+    """Base: holds the replica engines, exposes `select`."""
+
+    name = "base"
+
+    def __init__(self, engines: Sequence) -> None:
+        if not engines:
+            raise ValueError("router needs at least one replica engine")
+        self.engines = list(engines)
+
+    # -- shared load accounting --------------------------------------------
+
+    def _load(self, i: int) -> tuple[int, int]:
+        """(queue depth, index): requests ahead of a new arrival on replica
+        i. The index tie-break keeps selection deterministic."""
+        s = self.engines[i].load_snapshot()
+        return (s["num_waiting"] + s["num_running"], i)
+
+    def _saturated(self, i: int) -> bool:
+        """A full extra wave is already queued: a new request would wait at
+        least one whole drain behind the running set."""
+        s = self.engines[i].load_snapshot()
+        return s["num_waiting"] >= max(1, s["max_num_seqs"])
+
+    def select(self, prompt_ids: Sequence[int],
+               request_id: Optional[str] = None) -> int:
+        raise NotImplementedError
+
+
+class RoundRobinRouter(Router):
+    name = "round_robin"
+
+    def __init__(self, engines: Sequence) -> None:
+        super().__init__(engines)
+        self._counter = itertools.count()
+
+    def select(self, prompt_ids, request_id=None) -> int:
+        # itertools.count.__next__ is a single C call — atomic under the
+        # GIL, so concurrent handlers never double-assign a slot.
+        return next(self._counter) % len(self.engines)
+
+
+class LeastLoadedRouter(Router):
+    name = "least_loaded"
+
+    def select(self, prompt_ids, request_id=None) -> int:
+        return min(range(len(self.engines)), key=self._load)
+
+
+class PrefixAffinityRouter(Router):
+    name = "prefix_affinity"
+
+    def _chain_keys(self, prompt_ids):
+        """Chain keys computed ONCE per request and shared across every
+        replica's probe (replicas share block_size, so the keys are
+        identical); None when replica 0 has no content addressing —
+        probes then all return 0 and the hash fallback decides."""
+        chain = getattr(self.engines[0], "chain_keys_for", None)
+        if chain is None:
+            return None
+        return chain(prompt_ids)
+
+    def select(self, prompt_ids, request_id=None) -> int:
+        n = len(self.engines)
+        if n == 1:
+            return 0
+        keys = self._chain_keys(prompt_ids)
+        hits = [e.probe_prefix_tokens(prompt_ids, keys) for e in self.engines]
+        best = max(hits)
+        if best > 0:
+            # Deepest hit wins; equal hits break on load, then index.
+            pick = min((i for i in range(n) if hits[i] == best),
+                       key=self._load)
+        else:
+            # Cold prefix: rendezvous hash co-locates future siblings.
+            block_size = self.engines[0].load_snapshot().get("block_size", 16)
+            pick = rendezvous_pick(
+                prefix_route_key(prompt_ids, block_size), n)
+        if not self._saturated(pick):
+            return pick
+        # Saturation overflow: a cache hit buried behind a full extra wave
+        # loses to a cold replica that can start now.
+        unsaturated = [i for i in range(n) if not self._saturated(i)]
+        if not unsaturated:
+            return pick  # everyone is saturated: affinity is still best
+        return min(unsaturated, key=self._load)
+
+
+ROUTER_POLICIES = {
+    r.name: r
+    for r in (RoundRobinRouter, LeastLoadedRouter, PrefixAffinityRouter)
+}
+
+
+def make_router(policy: str, engines: Sequence) -> Router:
+    cls = ROUTER_POLICIES.get(policy)
+    if cls is None:
+        raise ValueError(
+            f"unknown router policy {policy!r}; supported: "
+            f"{', '.join(sorted(ROUTER_POLICIES))}")
+    return cls(engines)

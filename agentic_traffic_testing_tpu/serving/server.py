@@ -83,35 +83,63 @@ class LLMServer:
         self.cfg = cfg
         self.tokenizer = load_tokenizer(cfg.weights_path or cfg.model)
         self.model_loaded = False  # set by _load_params on checkpoint load
-        self.engine = engine or self._build_engine()
+        self.metrics = (
+            LLMMetrics(cfg.metrics_prefix, cfg.metrics_include_tokens,
+                       num_replicas=cfg.num_replicas)
+            if cfg.metrics_enabled else None
+        )
+        on_step = self.metrics.batch_size.observe if self.metrics else None
+        self.pool = None
+        if cfg.num_replicas > 1:
+            if engine is not None:
+                raise ValueError(
+                    "an injected engine cannot back LLM_NUM_REPLICAS > 1 — "
+                    "let the server build the replica pool itself")
+            if cfg.tp_size > 1 or cfg.sp_size > 1 or cfg.pp_size > 1:
+                # Checked before any engine build: a replica is a single-
+                # chip engine; silently nesting meshes inside replicas
+                # would over-subscribe devices behind healthy 200s.
+                raise NotImplementedError(
+                    "data-parallel replicas (LLM_NUM_REPLICAS > 1) do not "
+                    "compose with tp/sp/pp meshes yet — pick one of "
+                    "LLM_NUM_REPLICAS or LLM_TP_SIZE/LLM_SP_SIZE/LLM_PP_SIZE")
+            from agentic_traffic_testing_tpu.serving.replica_pool import (
+                EnginePool,
+            )
+
+            self.pool = EnginePool.build(
+                lambda i: self._build_engine(), cfg.num_replicas,
+                policy=cfg.router_policy, on_step=on_step)
+            # Compatibility handle (tests, introspection): replica 0. Every
+            # metrics/aggregation path below goes through the pool instead.
+            self.engine = self.pool.engines[0]
+            self.async_engine = self.pool
+        else:
+            self.engine = engine or self._build_engine()
+            self.async_engine = AsyncLLMEngine(self.engine, on_step=on_step)
         if cfg.warmup and engine is None:
             import jax
 
             if jax.devices()[0].platform == "tpu":
                 t0 = time.monotonic()
-                n = self.engine.warmup_decode_buckets()
-                if cfg.prefix_caching:
-                    # Cache-hit suffixes route through the chunk path.
-                    n += self.engine.warmup_chunk_buckets()
-                if cfg.prefill_batch_max_len is not None:
-                    # Batched prefills are tuned: cover every (batch, length)
-                    # bucket under the cap so a burst never compiles
-                    # mid-traffic (the exact stall the solo default avoids).
-                    n += self.engine.warmup_prefill_buckets()
-                if cfg.hybrid_token_budget:
-                    # Every (decode bucket, chunk rung) the hybrid planner
-                    # can fuse — same mid-traffic-compile rationale.
-                    n += self.engine.warmup_hybrid_buckets()
+                n = 0
+                for eng in (self.pool.engines if self.pool else [self.engine]):
+                    n += eng.warmup_decode_buckets()
+                    if cfg.prefix_caching:
+                        # Cache-hit suffixes route through the chunk path.
+                        n += eng.warmup_chunk_buckets()
+                    if cfg.prefill_batch_max_len is not None:
+                        # Batched prefills are tuned: cover every (batch,
+                        # length) bucket under the cap so a burst never
+                        # compiles mid-traffic (the exact stall the solo
+                        # default avoids).
+                        n += eng.warmup_prefill_buckets()
+                    if cfg.hybrid_token_budget:
+                        # Every (decode bucket, chunk rung) the hybrid
+                        # planner can fuse — same rationale.
+                        n += eng.warmup_hybrid_buckets()
                 log.info("warmed %d decode/chunk bucket programs in %.1fs",
                          n, time.monotonic() - t0)
-        self.metrics = (
-            LLMMetrics(cfg.metrics_prefix, cfg.metrics_include_tokens)
-            if cfg.metrics_enabled else None
-        )
-        self.async_engine = AsyncLLMEngine(
-            self.engine,
-            on_step=(self.metrics.batch_size.observe if self.metrics else None),
-        )
         self.tracer = get_tracer("llm-backend")
         self._arrival_lock = asyncio.Lock()
         self._inflight_lock = asyncio.Lock()
@@ -130,13 +158,26 @@ class LLMServer:
                 tp_size=cfg.tp_size,
                 sp_size=cfg.sp_size,
                 pp_size=cfg.pp_size,
+                num_replicas=cfg.num_replicas,
             )
-            self.metrics.set_kv_gauges(
-                num_blocks=self.engine.cache.num_blocks - 1,  # exclude trash block
-                block_size=self.engine.cache.block_size,
-                max_model_len=cfg.max_model_len,
-                max_num_seqs=cfg.max_num_seqs,
-            )
+            if self.pool is not None:
+                # Pool aggregate under the EXACT pre-pool names: blocks and
+                # tokens SUM across replicas; concurrency bounds use the
+                # pool-wide seat count (docs/monitoring.md aggregation
+                # table). block_size is a config invariant.
+                self.metrics.set_kv_gauges(
+                    num_blocks=self.pool.num_blocks,
+                    block_size=self.pool.block_size,
+                    max_model_len=cfg.max_model_len,
+                    max_num_seqs=cfg.max_num_seqs * len(self.pool),
+                )
+            else:
+                self.metrics.set_kv_gauges(
+                    num_blocks=self.engine.cache.num_blocks - 1,  # exclude trash block
+                    block_size=self.engine.cache.block_size,
+                    max_model_len=cfg.max_model_len,
+                    max_num_seqs=cfg.max_num_seqs,
+                )
             self.metrics.model_loaded.set(1 if self.model_loaded else 0)
 
     def _build_engine(self) -> LLMEngine:
@@ -437,9 +478,15 @@ class LLMServer:
     async def handle_metrics(self, request: web.Request) -> web.Response:
         if self.metrics is None:
             return web.json_response({"error": "Metrics disabled"}, status=503)
-        self.metrics.set_prefix_cache_stats(self.engine.kv_stats())
-        self.metrics.set_spec_stats(emitted=self.engine.spec_emitted,
-                                    iters=self.engine.spec_iters)
+        # Pool-aggregated on scrape: EnginePool.kv_stats / spec counters SUM
+        # the per-replica values under the single-engine key names, so the
+        # pre-pool gauges keep their meaning (totals) at any replica count.
+        source = self.pool if self.pool is not None else self.engine
+        self.metrics.set_prefix_cache_stats(source.kv_stats())
+        self.metrics.set_spec_stats(emitted=source.spec_emitted,
+                                    iters=source.spec_iters)
+        if self.pool is not None:
+            self.metrics.set_replica_stats(self.pool.replica_stats())
         return web.Response(body=self.metrics.render(),
                             headers={"Content-Type": self.metrics.content_type})
 
@@ -734,7 +781,9 @@ class LLMServer:
         worst-case max_model_len bound of `llm_computed_max_concurrency`).
         The same ladder, then a slow steady refresh.
         """
-        total = self.engine.cache.usable_tokens
+        total = (self.pool.usable_tokens if self.pool is not None
+                 else self.engine.cache.usable_tokens)
+        seats = self.cfg.max_num_seqs * (len(self.pool) if self.pool else 1)
         delays = [5.0, 15.0, 30.0]
         try:
             while True:
@@ -744,7 +793,7 @@ class LLMServer:
                 window = sorted(self._ctx_window)
                 p95 = window[min(len(window) - 1, int(0.95 * len(window)))]
                 self.metrics.set_probe(total_tokens=total,
-                                       max_num_seqs=self.cfg.max_num_seqs,
+                                       max_num_seqs=seats,
                                        ctx_p95=float(p95))
         except asyncio.CancelledError:
             pass
@@ -773,7 +822,8 @@ def main(argv: Optional[list[str]] = None) -> None:
     maybe_initialize()
     cfg = ServerConfig.from_args(argv)
     print(f"[llm] starting TPU backend model={cfg.model} dtype={cfg.dtype} "
-          f"tp={cfg.tp_size} max_num_seqs={cfg.max_num_seqs} "
+          f"tp={cfg.tp_size} replicas={cfg.num_replicas} "
+          f"router={cfg.router_policy} max_num_seqs={cfg.max_num_seqs} "
           f"max_model_len={cfg.max_model_len}", flush=True)
     server = LLMServer(cfg)
     web.run_app(server.make_app(), host=cfg.host, port=cfg.port)

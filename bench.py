@@ -44,7 +44,16 @@ testbed generates (BASELINE.md §2 "Fan-out workload"):
      over `BENCH_REPS` (default 3) repetitions — single-run numbers
      through the axon tunnel drift ±10-20%.
 
-A third, best-effort probe measures the hybrid prefill+decode fusion
+A best-effort replica probe measures data-parallel scale-out
+(serving/replica_pool.py): aggregate decode tok/s of a 2-replica pool vs
+1 replica at the same per-replica lane count (replicas{1,2}_decode_toks_s,
+replica_scaling_x), and a router A/B on the fan-out workload — a
+2-replica prefix-caching pool under prefix_affinity vs round_robin,
+reporting aggregate prefix_cache_hit_tokens and queue-wait p50 per policy
+(router_* keys). BENCH_REPLICAS=0 disables;
+BENCH_REPLICA_LANES/BENCH_ROUTER_GROUPS shape it.
+
+Another best-effort probe measures the hybrid prefill+decode fusion
 (hybrid_token_budget + the ragged Pallas kernel): a mixed arrival stream
 (short decoders + chunked long prompts) run with fusion ON vs OFF,
 reported as hybrid_decode_toks_s / hybrid_queue_wait_p50_s against
@@ -498,6 +507,127 @@ def main() -> None:
         return (toks / dt, statistics.median(waits) if waits else None,
                 eng.scheduler.num_scheduled_hybrid)
 
+    # Data-parallel replica + router probe (serving/replica_pool.py +
+    # serving/router.py): (a) replica scaling — aggregate decode tok/s of a
+    # 2-replica pool vs 1 replica with the same per-replica lane count,
+    # each replica driven by its own thread (the AsyncLLMEngine shape; XLA
+    # releases the GIL during execution, so replicas genuinely overlap even
+    # on one host); (b) router A/B — the fan-out workload (scenario groups
+    # of siblings sharing a long prompt prefix) on a 2-replica
+    # prefix-caching pool under `prefix_affinity` vs `round_robin`:
+    # aggregate prefix_cache_hit_tokens and queue-wait p50. Best-effort
+    # like every secondary series; BENCH_REPLICAS=0 disables.
+    replicas_on = os.environ.get("BENCH_REPLICAS", "1") not in ("0", "false")
+    replica_lanes = int(os.environ.get(
+        "BENCH_REPLICA_LANES", str(min(8, batch))))
+    router_groups = int(os.environ.get("BENCH_ROUTER_GROUPS", "3"))
+
+    def replica_engine(lanes: int, prefix_caching: bool) -> LLMEngine:
+        rep_len = max(512, prompt_len + decode_tokens + 16,
+                      fanout_prompt + decode_tokens + 16)
+        # Explicit small pool per replica: shared-nothing KV, never
+        # re-profiling the primary engine's HBM leftovers.
+        return LLMEngine(EngineConfig(
+            model=model, dtype="bfloat16", max_num_seqs=lanes,
+            max_model_len=rep_len,
+            num_blocks=max(512, lanes * (-(-rep_len // cfg.block_size) + 4)),
+            decode_steps=decode_steps,
+            prefix_caching=prefix_caching,
+            kv_cache_dtype=kv_cache_dtype,
+        ), model_cfg=engine.model_cfg, runner=engine.runner)
+
+    def drive_pool(pool, reqs) -> float:
+        """One thread per replica (the serving architecture), returns wall."""
+        import threading
+
+        def drive(e):
+            while e.has_work() and not all(r.is_finished() for r in reqs):
+                e.step()
+
+        t0 = time.monotonic()
+        threads = [threading.Thread(target=drive, args=(e,))
+                   for e in pool.engines]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.monotonic() - t0
+
+    def replica_scaling_probe(n_replicas: int) -> float:
+        """Aggregate decode tok/s: 2 waves per replica of the throughput
+        workload over an n-replica round-robin pool."""
+        from agentic_traffic_testing_tpu.serving.replica_pool import EnginePool
+
+        pool = EnginePool([replica_engine(replica_lanes, False)
+                           for _ in range(n_replicas)], policy="round_robin")
+        reqs = [pool.add_request(
+            rng.integers(10, vocab - 10, prompt_len).tolist(),
+            SamplingParams(temperature=0.0, max_tokens=decode_tokens,
+                           ignore_eos=True))
+            for _ in range(2 * n_replicas * replica_lanes)]
+        dt = drive_pool(pool, reqs)
+        return sum(len(r.output_ids) for r in reqs) / dt
+
+    def router_probe(policy: str):
+        """(aggregate prefix-cache hit tokens, queue-wait p50) for the
+        fan-out workload under `policy` on a 2-replica pool. Per-policy rng
+        reseed: both policies must see the byte-identical workload."""
+        from agentic_traffic_testing_tpu.serving.replica_pool import EnginePool
+
+        wl = np.random.default_rng(42)
+        pool = EnginePool([replica_engine(fanout, True) for _ in range(2)],
+                          policy=policy)
+        reqs = []
+        for _ in range(router_groups):
+            prefix = wl.integers(10, vocab - 10, fanout_prompt - 16).tolist()
+            # The group leader lands first and registers the prefix...
+            lead = pool.add_request(
+                prefix + wl.integers(10, vocab - 10, 8).tolist(),
+                SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True))
+            while pool.has_work() and not lead.is_finished():
+                pool.step()
+            reqs.append(lead)
+            # ...then the siblings fan out concurrently (PAPER.md workflow:
+            # workers quoting the same scenario prompt).
+            sibs = [pool.add_request(
+                prefix + wl.integers(10, vocab - 10, 8).tolist(),
+                SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True))
+                for _ in range(fanout - 1)]
+            while pool.has_work() and not all(r.is_finished() for r in sibs):
+                pool.step()
+            reqs.extend(sibs)
+        hits = pool.kv_stats().get("prefix_cache_hit_tokens", 0)
+        waits = [r.first_token_time - r.arrival_time for r in reqs
+                 if r.first_token_time is not None]
+        return int(hits), statistics.median(waits)
+
+    replica_res = None
+    if replicas_on:
+        try:
+            replica_scaling_probe(1)  # warmup: compile the decode shapes
+            router_probe("round_robin")  # warmup: the chunk-path shapes
+            one = statistics.median(
+                [replica_scaling_probe(1) for _ in range(reps)])
+            two = statistics.median(
+                [replica_scaling_probe(2) for _ in range(reps)])
+            aff_hits, aff_wait = router_probe("prefix_affinity")
+            rr_hits, rr_wait = router_probe("round_robin")
+            replica_res = {
+                "replica_lanes": replica_lanes,
+                "replicas1_decode_toks_s": round(one, 2),
+                "replicas2_decode_toks_s": round(two, 2),
+                "replica_scaling_x": round(two / one, 3),
+                "router_fanout": fanout,
+                "router_groups": router_groups,
+                "router_prefix_affinity_hit_tokens": aff_hits,
+                "router_round_robin_hit_tokens": rr_hits,
+                "router_prefix_affinity_queue_wait_p50_s": round(aff_wait, 4),
+                "router_round_robin_queue_wait_p50_s": round(rr_wait, 4),
+            }
+        except Exception as e:
+            replica_res = None
+            print(f"bench: replica probe dropped ({e!r})", file=sys.stderr)
+
     hybrid_res = None
     if hybrid_on:
         try:
@@ -641,6 +771,7 @@ def main() -> None:
             "fanout_prompt_tokens": fanout_prompt,
         }),
         **({} if hybrid_res is None else hybrid_res),
+        **({} if replica_res is None else replica_res),
         **({} if prefill_s is None else {
             # Compute-bound half of serving (round-3 flash prefill site).
             # est_mfu counts dense matmul FLOPs (2 * non-embedding params

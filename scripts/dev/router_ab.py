@@ -1,0 +1,153 @@
+#!/usr/bin/env python3
+"""Router-policy A/B on a data-parallel replica pool (fan-out workload).
+
+The engine-level A/B for the prefix-affinity routing claim, isolated from
+the HTTP layer: build an N-replica EnginePool (shared-nothing KV +
+prefix-cache index per replica, one runner shared so the weights compile
+once), replay the agentic fan-out shape — G scenario groups whose members
+all quote the same long prompt prefix (PAPER.md workflow) — through each
+routing policy, and print one JSON line per policy:
+
+    {"policy": ..., "replicas": N, "hit_tokens": ..., "query_tokens": ...,
+     "hit_rate": ..., "queue_wait_p50_s": ..., "queue_wait_p95_s": ...,
+     "decode_toks_s": ..., "routed": [per-replica assignment counts]}
+
+`prefix_affinity` should win hit_tokens (siblings land where their
+scenario prefix's KV already lives) at no worse queue wait; `round_robin`
+is the fairness baseline, `least_loaded` the queue-depth baseline.
+Numbers feed docs/BENCHMARKS.md once measured on hardware.
+
+Usage: python scripts/dev/router_ab.py [replicas] [groups] [fanout] [prefix_len]
+Env: ROUTER_AB_MODEL (default: tiny fp32 on cpu, llama-3.2-1b bf16 on tpu),
+     ROUTER_AB_POLICIES (comma list, default all three).
+No reference analog (the reference runs exactly one vLLM process).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def run_policy(policy: str, *, runner, model_cfg, model: str, dtype: str,
+               replicas: int, groups: int, fanout: int,
+               prefix_len: int) -> dict:
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+    from agentic_traffic_testing_tpu.serving.replica_pool import EnginePool
+
+    max_len = prefix_len + 64
+    block_size = 16
+    engines = [
+        LLMEngine(EngineConfig(
+            model=model, dtype=dtype, max_num_seqs=fanout,
+            max_model_len=max_len, block_size=block_size,
+            num_blocks=max(256, fanout * (-(-max_len // block_size) + 4)),
+            prefix_caching=True,
+        ), model_cfg=model_cfg, runner=runner)
+        for _ in range(replicas)
+    ]
+    pool = EnginePool(engines, policy=policy)
+    # Reseeded per policy: every policy must see the identical workload.
+    wl = np.random.default_rng(7)
+    vocab = model_cfg.vocab_size
+    reqs = []
+    t0 = time.monotonic()
+    for _ in range(groups):
+        prefix = wl.integers(10, vocab - 10, prefix_len).tolist()
+        lead = pool.add_request(
+            prefix + wl.integers(10, vocab - 10, 8).tolist(),
+            SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True))
+        while pool.has_work() and not lead.is_finished():
+            pool.step()
+        reqs.append(lead)
+        sibs = [pool.add_request(
+            prefix + wl.integers(10, vocab - 10, 8).tolist(),
+            SamplingParams(temperature=0.0, max_tokens=8, ignore_eos=True))
+            for _ in range(fanout - 1)]
+        while pool.has_work() and not all(r.is_finished() for r in sibs):
+            pool.step()
+        reqs.extend(sibs)
+    wall = time.monotonic() - t0
+    stats = pool.kv_stats()
+    waits = sorted(r.first_token_time - r.arrival_time for r in reqs
+                   if r.first_token_time is not None)
+    toks = sum(len(r.output_ids) for r in reqs)
+    hit = int(stats.get("prefix_cache_hit_tokens", 0))
+    query = int(stats.get("prefix_cache_query_tokens", 0))
+    return {
+        "policy": policy,
+        "replicas": replicas,
+        "groups": groups,
+        "fanout": fanout,
+        "prefix_tokens": prefix_len,
+        "hit_tokens": hit,
+        "query_tokens": query,
+        "hit_rate": round(hit / query, 4) if query else 0.0,
+        "queue_wait_p50_s": round(statistics.median(waits), 4),
+        "queue_wait_p95_s": round(waits[int(0.95 * (len(waits) - 1))], 4),
+        "decode_toks_s": round(toks / wall, 2),
+        "routed": list(pool.routed_requests),
+    }
+
+
+def main(argv=None) -> list[dict]:
+    argv = [int(a) for a in (argv if argv is not None else sys.argv[1:])]
+    replicas = argv[0] if len(argv) > 0 else 2
+    groups = argv[1] if len(argv) > 1 else 3
+    fanout = argv[2] if len(argv) > 2 else 5
+    prefix_len = argv[3] if len(argv) > 3 else 128
+
+    import jax
+
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import init_params
+    from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    model = os.environ.get(
+        "ROUTER_AB_MODEL", "llama-3.2-1b" if platform == "tpu" else "tiny")
+    dtype = "bfloat16" if platform == "tpu" else "float32"
+    model_cfg = resolve_config(model)
+    params = init_params(
+        model_cfg, jax.random.key(0),
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    runner = ModelRunner(model_cfg, params)
+    print(f"devices: {jax.devices()}  replicas={replicas} groups={groups} "
+          f"fanout={fanout} prefix={prefix_len} model={model}",
+          file=sys.stderr, flush=True)
+
+    policies = [p for p in os.environ.get(
+        "ROUTER_AB_POLICIES",
+        "round_robin,least_loaded,prefix_affinity").split(",") if p]
+    # Discarded warmup pass: the runner's jit cache is shared by every
+    # pool, so one small run compiles the prefill/chunk/decode shapes and
+    # no measured policy pays them (the FIRST policy otherwise eats tens of
+    # seconds of XLA compile inside its queue-wait numbers).
+    run_policy(policies[0], runner=runner, model_cfg=model_cfg, model=model,
+               dtype=dtype, replicas=replicas, groups=1, fanout=2,
+               prefix_len=prefix_len)
+    results = []
+    for policy in policies:
+        res = run_policy(policy, runner=runner, model_cfg=model_cfg,
+                         model=model, dtype=dtype, replicas=replicas,
+                         groups=groups, fanout=fanout, prefix_len=prefix_len)
+        results.append(res)
+        print(json.dumps(res), flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    main()

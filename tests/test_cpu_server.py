@@ -81,3 +81,33 @@ def test_deterministic_greedy(base_url):
     _, a = post(base_url + "/chat", {"prompt": "abc", "max_tokens": 6})
     _, b = post(base_url + "/chat", {"prompt": "abc", "max_tokens": 6})
     assert a["output"] == b["output"]
+
+
+def test_num_replicas_round_robin(monkeypatch):
+    """LLM_NUM_REPLICAS on the CPU fallback: N independent tiny pipelines
+    rotated per call (TPU EnginePool parity, trivially)."""
+    import agentic_traffic_testing_tpu.serving.cpu_server as cs
+
+    monkeypatch.setattr(cs, "_pipes", [])
+    monkeypatch.setenv("LLM_NUM_REPLICAS", "2")
+    monkeypatch.setenv("LLM_MODEL", "tiny")
+    p1, p2, p3 = cs.get_pipeline(), cs.get_pipeline(), cs.get_pipeline()
+    assert len(cs._pipes) == 2
+    assert p1 is not p2
+    assert p3 is p1  # rotation wraps
+
+
+def test_num_replicas_rejects_hf_model_at_startup(monkeypatch):
+    """Replicas x real HF checkpoint refuse LOUDLY when the pipelines are
+    built (run() builds them eagerly at startup) — never a mid-request
+    500 from an N-fold model load."""
+    import agentic_traffic_testing_tpu.serving.cpu_server as cs
+
+    monkeypatch.setattr(cs, "_pipes", [])
+    monkeypatch.setenv("LLM_NUM_REPLICAS", "2")
+    monkeypatch.setenv("LLM_MODEL", "some-org/some-model")
+    with pytest.raises(RuntimeError, match="LLM_NUM_REPLICAS"):
+        cs.get_pipeline()
+    monkeypatch.setenv("LLM_NUM_REPLICAS", "0")
+    with pytest.raises(RuntimeError, match=">= 1"):
+        cs._num_replicas()

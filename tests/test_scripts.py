@@ -214,6 +214,27 @@ def test_health_check_reports_down_services():
     assert by_name["llm.health"]["error"] == "connection_refused"
 
 
+# --------------------------------------------------------- router A/B
+
+
+def test_router_ab_smoke(monkeypatch):
+    """scripts/dev/router_ab.py end-to-end on the tiny model: one JSON row
+    per policy, prefix_affinity serving strictly more cached prompt tokens
+    than round_robin on the same fan-out workload (in-process so the warm
+    jax/conftest CPU config is reused — a subprocess would re-pay init)."""
+    monkeypatch.setenv("ROUTER_AB_MODEL", "tiny")
+    monkeypatch.setenv("ROUTER_AB_POLICIES", "round_robin,prefix_affinity")
+    router_ab = load_script("scripts/dev/router_ab.py", "router_ab")
+    results = router_ab.main(["2", "1", "3", "48"])
+    assert [r["policy"] for r in results] == ["round_robin", "prefix_affinity"]
+    by_policy = {r["policy"]: r for r in results}
+    for r in results:
+        assert r["replicas"] == 2 and sum(r["routed"]) == 3
+        assert r["queue_wait_p50_s"] >= 0 and r["decode_toks_s"] > 0
+    assert (by_policy["prefix_affinity"]["hit_tokens"]
+            > by_policy["round_robin"]["hit_tokens"])
+
+
 # --------------------------------------------------------- platform guard
 
 

@@ -55,6 +55,8 @@ def test_server_config_env_contract(monkeypatch):
         "LLM_HOST": "127.0.0.9",
         "LLM_PORT": "8123",
         "LLM_TP_SIZE": "2",
+        "LLM_NUM_REPLICAS": "3",
+        "LLM_ROUTER_POLICY": "prefix_affinity",
         "LLM_QUANTIZATION": "int8",
         "LLM_DECODE_STEPS": "32",
         "LLM_PREFILL_CHUNK_TOKENS": "1024",
@@ -83,6 +85,7 @@ def test_server_config_env_contract(monkeypatch):
     assert c.temperature == 0.4
     assert (c.host, c.port) == ("127.0.0.9", 8123)
     assert (c.tp_size, c.quantization, c.decode_steps) == (2, "int8", 32)
+    assert (c.num_replicas, c.router_policy) == (3, "prefix_affinity")
     assert (c.prefill_chunk_tokens, c.prefill_batch_max_len) == (1024, 512)
     assert (c.prefix_caching, c.num_blocks, c.block_size) == (True, 2048, 32)
     assert (c.weights_path, c.allow_random_weights) == ("/ckpts/llama", True)
@@ -328,6 +331,90 @@ def test_pp_serving_branch_builds_and_guards(monkeypatch):
     sp.pp_size = 2
     with pytest.raises(NotImplementedError, match="speculation"):
         LLMServer(sp)
+
+
+def test_replica_pool_server_end_to_end():
+    """LLM_NUM_REPLICAS=2 serving: the /chat contract is unchanged, every
+    pre-pool llm_* family keeps its exact name reporting the POOL AGGREGATE
+    (kv blocks sum across replicas), and the per-replica labeled series
+    appear. Requests spread across both replicas (round_robin)."""
+    cfg = ServerConfig(
+        model="tiny", dtype="float32", max_num_seqs=4, max_model_len=256,
+        num_blocks=128, max_tokens=16, temperature=0.0,
+        num_replicas=2, router_policy="round_robin",
+    )
+    srv = LLMServer(cfg)
+    assert srv.pool is not None and len(srv.pool) == 2
+    srv.pool.start()
+    try:
+        async def go(client):
+            for i in range(4):
+                resp = await client.post(
+                    "/chat", json={"prompt": f"task {i}", "max_tokens": 2})
+                assert resp.status == 200
+                meta = (await resp.json())["meta"]
+                assert meta["completion_tokens"] >= 1
+            resp = await client.get("/metrics")
+            return (await resp.read()).decode()
+
+        text = _run(srv, go)
+        for fam in EXPECTED_METRIC_FAMILIES:
+            assert fam in text, f"missing metric family {fam}"
+        # Aggregate under the pre-pool names: blocks/tokens SUM.
+        total_blocks = sum(e.cache.num_blocks - 1 for e in srv.pool.engines)
+        bs = srv.pool.block_size
+        assert f"llm_kv_cache_num_gpu_blocks {float(total_blocks)}" in text
+        assert f"llm_kv_cache_total_tokens {float(total_blocks * bs)}" in text
+        assert "llm_config_num_replicas 2.0" in text
+        # Per-replica labeled series, one sample per replica.
+        for fam in ("llm_replica_routed_requests_total",
+                    "llm_replica_num_running", "llm_replica_kv_used_blocks"):
+            assert f'{fam}{{replica="0"}}' in text, fam
+            assert f'{fam}{{replica="1"}}' in text, fam
+        assert srv.pool.routed_requests == [2, 2]
+    finally:
+        srv.pool.shutdown()
+
+
+def test_replica_pool_singleton_keeps_single_engine_path():
+    """num_replicas=1 (the default) must not build a pool: the exact
+    pre-pool single-engine path, and /metrics carries NO replica-labeled
+    series (BASELINE dashboard byte-parity)."""
+    cfg = ServerConfig(
+        model="tiny", dtype="float32", max_num_seqs=2, max_model_len=128,
+        num_blocks=64, warmup=False,
+    )
+    srv = LLMServer(cfg)
+    assert srv.pool is None
+    from agentic_traffic_testing_tpu.serving.async_engine import AsyncLLMEngine
+    assert isinstance(srv.async_engine, AsyncLLMEngine)
+    text = srv.metrics.render().decode()
+    assert "llm_replica_" not in text
+    assert "llm_config_num_replicas 1.0" in text
+
+
+def test_num_replicas_env_validation(monkeypatch):
+    """LLM_NUM_REPLICAS=0 must refuse at config parse — it would silently
+    serve single-engine while exporting llm_config_num_replicas 0 (pool
+    capacity formulas read as zero)."""
+    monkeypatch.setenv("LLM_NUM_REPLICAS", "0")
+    with pytest.raises(ValueError, match="LLM_NUM_REPLICAS"):
+        ServerConfig.from_env()
+    monkeypatch.setenv("LLM_NUM_REPLICAS", "-2")
+    with pytest.raises(ValueError, match="LLM_NUM_REPLICAS"):
+        ServerConfig.from_env()
+
+
+def test_replica_pool_refuses_mesh_composition():
+    """Replicas x tp/sp/pp must refuse at startup — a replica is a single-
+    chip engine; nesting meshes would over-subscribe devices silently."""
+    cfg = ServerConfig(
+        model="tiny", dtype="float32", max_num_seqs=2, max_model_len=128,
+        num_blocks=64, warmup=False, num_replicas=2,
+    )
+    cfg.tp_size = 2
+    with pytest.raises(NotImplementedError, match="do not compose"):
+        LLMServer(cfg)
 
 
 def test_bad_weights_path_fails_fast(tmp_path):
