@@ -13,7 +13,7 @@ and the behavioral spec.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK
 
@@ -138,6 +138,13 @@ class PrefixCachingAllocator(BlockAllocator):
         self._evictable: dict[int, None] = {}
         self.hit_tokens = 0
         self.query_tokens = 0
+        # Optional host-RAM tier (runtime/kv_offload.HostKVStore): reclaimed
+        # indexed blocks spill there instead of being dropped, and prefix
+        # matching extends past the device index into the host chain. None
+        # (default) keeps every path bit-identical to the single-tier cache.
+        self._host = None
+        self._on_evict: Optional[Callable[[int, int, tuple], None]] = None
+        self.host_hit_tokens = 0
 
     # -- capacity (evictable blocks count as available) ---------------------
 
@@ -163,6 +170,16 @@ class PrefixCachingAllocator(BlockAllocator):
         while len(taken) < n:  # reclaim LRU cached blocks, dropping their index
             blk = next(iter(self._evictable))
             del self._evictable[blk]
+            if self._on_evict is not None:
+                # Host-tier spill: hand the engine (block, chain key, tokens)
+                # BEFORE unindexing — it slices the pages device-side right
+                # here, so dispatch order puts the read ahead of whatever
+                # write reuses the block.
+                key = self._block_key.get(blk)
+                if key is not None:
+                    entry = self._index.get(key)
+                    if entry is not None and entry[0] == blk:
+                        self._on_evict(blk, key, entry[1])
             self._unindex(blk)
             taken.append(blk)
         for blk in taken:
@@ -260,6 +277,103 @@ class PrefixCachingAllocator(BlockAllocator):
             cached += bs
         return seq, cached
 
+    # -- host tier (runtime/kv_offload.py) ---------------------------------
+
+    def attach_host_store(self, store,
+                          on_evict: Optional[Callable[[int, int, tuple], None]]
+                          = None) -> None:
+        """Wire the host-RAM tier in: reclaimed indexed blocks report to
+        `on_evict(block, chain_key, tokens)` (the engine's save hook) and
+        prefix probing/matching extends into `store`'s chain."""
+        self._host = store
+        self._on_evict = on_evict
+
+    @property
+    def host_store(self):
+        return self._host
+
+    def probe_prefix_tiered(self, prompt_ids: list[int],
+                            keys: Optional[tuple[list[int], list[tuple]]] = None,
+                            ) -> tuple[int, int]:
+        """(device-cached tokens, host-restorable tokens) a tiered match
+        would yield; no state changes. The walk mirrors match_prefix_tiered:
+        each block resolves device-first, then host, stopping at the first
+        miss in both tiers — so a device block sitting past a host-only gap
+        still counts (it is shareable once the gap restores)."""
+        bs = self.block_size
+        ks, toks = keys if keys is not None else self.chain_keys(prompt_ids)
+        dev = host = 0
+        for i in range(self._matchable_blocks(prompt_ids)):
+            if self._lookup(ks[i], toks[i]) is not None:
+                dev += bs
+            elif self._host is not None and self._host.contains(ks[i], toks[i]):
+                host += bs
+            else:
+                break
+        return dev, host
+
+    def match_prefix_tiered(self, prompt_ids: list[int],
+                            keys: Optional[tuple[list[int], list[tuple]]] = None,
+                            ) -> tuple["SequenceBlocks", int, list]:
+        """Acquire the longest cached block chain across BOTH tiers.
+
+        Returns (sequence, cached token count, restore plan). Device-indexed
+        blocks are shared exactly like match_prefix; host-tier blocks get a
+        FRESH device block each (allocated here, so capacity pressure can
+        shorten the restore chain gracefully) and a RestoreBlock entry the
+        engine must apply (host→device page write + register_restored)
+        before the suffix prefills. The caller MUST release the sequence on
+        failure paths — unapplied restore blocks are unindexed, so they
+        return to the free list holding garbage no one can match."""
+        bs = self.block_size
+        ks, toks = keys if keys is not None else self.chain_keys(prompt_ids)
+        seq = SequenceBlocks(self)
+        cached = 0
+        restores: list = []
+        for i in range(self._matchable_blocks(prompt_ids)):
+            blk = self._lookup(ks[i], toks[i])
+            if blk is not None:
+                self._refcount[blk] = self._refcount.get(blk, 0) + 1
+                self._evictable.pop(blk, None)
+                seq.blocks.append(blk)
+                cached += bs
+                continue
+            if self._host is not None:
+                entry = self._host.get(ks[i], toks[i])
+                if entry is not None:
+                    got = self.allocate(1)
+                    if got is None:
+                        break  # pool exhausted: restore what fits, compute the rest
+                    from agentic_traffic_testing_tpu.runtime.kv_offload import (
+                        RestoreBlock,
+                    )
+
+                    restores.append(RestoreBlock(
+                        block=got[0], key=ks[i], tokens=toks[i],
+                        k=entry.k, v=entry.v))
+                    seq.blocks.append(got[0])
+                    cached += bs
+                    continue
+            break
+        return seq, cached, restores
+
+    def register_restored(self, restores: list) -> None:
+        """Index restore blocks whose pages the engine just wrote (dispatch
+        order guarantees any later reader's dispatch sees them). First
+        writer wins, same rule as register_computed."""
+        for rb in restores:
+            if rb.key in self._index:
+                continue
+            if rb.block in self._block_key:
+                continue
+            self._index[rb.key] = (rb.block, rb.tokens)
+            self._block_key[rb.block] = rb.key
+
+    def record_host_hit(self, hit_tokens: int) -> None:
+        """Host-tier hit accounting, called (like record_prefix_stats) once
+        per admission that actually APPLIES the restore plan."""
+        self.host_hit_tokens += hit_tokens
+
     def record_prefix_stats(self, query_tokens: int, hit_tokens: int) -> None:
         """Hit-rate accounting: call once per admission that actually APPLIES
         the cached prefix (counting inside match_prefix would inflate the
@@ -288,11 +402,16 @@ class PrefixCachingAllocator(BlockAllocator):
             self._block_key[blk] = key
 
     def kv_extra_stats(self) -> dict:
-        return {
+        stats = {
             "prefix_cache_hit_tokens": self.hit_tokens,
             "prefix_cache_query_tokens": self.query_tokens,
             "prefix_cache_indexed_blocks": len(self._index),
         }
+        if self._host is not None:
+            # Key present only with a host tier attached: the no-tier stats
+            # dict stays byte-identical to the single-tier cache's.
+            stats["host_cache_hit_tokens"] = self.host_hit_tokens
+        return stats
 
 
 def request_chain_keys(allocator, req):

@@ -1,0 +1,149 @@
+"""Host-RAM tier for evicted prefix-cache KV blocks ("L2 KV cache").
+
+The device prefix cache (block_allocator.PrefixCachingAllocator) is the only
+KV tier the engine had: when capacity pressure reclaims the LRU evictable
+pool, the content is unindexed and the pages are overwritten — the next
+arrival of the same scenario prefix pays a full prefill recompute, the exact
+hot path ROADMAP flags as the worst bench gap (prefill MFU 0.13). HBM is
+small (~16 GB per v5e chip) while host RAM is plentiful, so this module adds
+the second tier PagedAttention's block granularity makes cheap (arXiv:
+2309.06180) and vAttention's residency/kernel decoupling argues for (arXiv:
+2405.04437): evicted full indexed blocks spill device→host and stream back
+into freshly allocated blocks on a later prefix hit, instead of recomputing.
+
+Addressing is the SAME content-hash chain key the device index uses
+(PrefixCachingAllocator.chain_keys), so the two tiers form one lookup chain:
+a prefix probe walks device blocks first, then host blocks, and stops at the
+first miss. Token tuples are stored alongside and compared on every get —
+a 64-bit hash collision degrades to a miss, never serves another prompt's
+KV (the same cross-request-leakage rule the device index enforces).
+
+The store is deliberately host-only and engine-agnostic: it holds numpy
+arrays and does no jax work. The ENGINE owns the copies (engine.py:
+`_queue_block_save` slices pages device-side at eviction time — dispatch
+order puts the read before the reclaiming prefill's write — and drains the
+async host copies off the step loop; `_apply_pending_restore` writes host
+pages into freshly allocated blocks before the uncached tail prefills).
+That split lets ONE store back every replica of an EnginePool: replicas
+share no device state, but a prefix computed (then evicted) on replica 0
+becomes a host hit for replica 1 — the prefix-affinity router's cold-replica
+fallback turns replica misses into restores instead of recomputes.
+
+Thread safety: every public method takes the internal lock. Engines call
+put/get from their step threads and the router probes via contains from the
+HTTP thread; entries are immutable once stored (numpy arrays are written
+once by device_get and only read afterwards).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class HostBlock:
+    """One offloaded KV block: the page pair + its content identity."""
+
+    tokens: tuple           # the block's token ids (collision check)
+    k: np.ndarray           # [L, KH, block_size, hd_phys], cache dtype
+    v: np.ndarray           # same shape/dtype as k
+    nbytes: int
+
+
+@dataclasses.dataclass
+class RestoreBlock:
+    """A planned host→device restore: host pages bound to a freshly
+    allocated device block. Built by match_prefix_tiered, applied by the
+    engine right before the request's first (suffix) prefill chunk."""
+
+    block: int              # device block id the pages will be written into
+    key: int                # chain hash (re-indexed under this key on apply)
+    tokens: tuple
+    k: np.ndarray
+    v: np.ndarray
+
+
+class HostKVStore:
+    """LRU host-RAM store of full prefix blocks, keyed by chain hash.
+
+    Capacity is a byte budget (`LLM_HOST_CACHE_GB` at the serving layer);
+    inserting past it evicts least-recently-used entries. `get` refreshes
+    recency, `contains` (the probe path) does not — a router probe must not
+    reorder the LRU under the step threads.
+    """
+
+    def __init__(self, capacity_bytes: int) -> None:
+        if capacity_bytes <= 0:
+            raise ValueError(
+                f"host KV store needs a positive byte budget, got {capacity_bytes}")
+        self.capacity_bytes = int(capacity_bytes)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict[int, HostBlock] = OrderedDict()
+        self.used_bytes = 0
+        # Cumulative counters (exported as llm_host_cache_* families).
+        self.saved_blocks = 0     # successful put()s
+        self.evicted_blocks = 0   # LRU evictions (capacity pressure)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def contains(self, key: int, tokens: tuple) -> bool:
+        """Read-only probe: no LRU touch (safe for the router/scheduler's
+        per-step re-probe of a waiting head)."""
+        with self._lock:
+            e = self._entries.get(key)
+            return e is not None and e.tokens == tokens
+
+    def get(self, key: int, tokens: tuple) -> Optional[HostBlock]:
+        """Entry for `key`, or None on miss/collision; refreshes recency."""
+        with self._lock:
+            e = self._entries.get(key)
+            if e is None or e.tokens != tokens:
+                return None
+            self._entries.move_to_end(key)
+            return e
+
+    def put(self, key: int, tokens: tuple, k: np.ndarray, v: np.ndarray) -> bool:
+        """Insert (or refresh) one block; False if it can never fit."""
+        nbytes = int(k.nbytes) + int(v.nbytes)
+        if nbytes > self.capacity_bytes:
+            return False
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self.used_bytes -= old.nbytes
+            while self._entries and self.used_bytes + nbytes > self.capacity_bytes:
+                _, ev = self._entries.popitem(last=False)
+                self.used_bytes -= ev.nbytes
+                self.evicted_blocks += 1
+            self._entries[key] = HostBlock(tokens=tokens, k=k, v=v, nbytes=nbytes)
+            self.used_bytes += nbytes
+            self.saved_blocks += 1
+            return True
+
+    def stats(self) -> dict:
+        """Store-level stats under the metric key names. These describe the
+        ONE (possibly pool-shared) store — EnginePool.kv_stats reports them
+        once instead of summing per replica."""
+        with self._lock:
+            return {
+                "host_cache_used_bytes": self.used_bytes,
+                "host_cache_capacity_bytes": self.capacity_bytes,
+                "host_cache_entries": len(self._entries),
+                "host_cache_saved_blocks": self.saved_blocks,
+                "host_cache_evicted_blocks": self.evicted_blocks,
+            }
+
+
+def host_store_from_gb(host_cache_gb: float) -> Optional[HostKVStore]:
+    """ServerConfig/EngineConfig knob -> store (None when the knob is 0,
+    which keeps every existing path bit-identical)."""
+    if not host_cache_gb or host_cache_gb <= 0:
+        return None
+    return HostKVStore(int(host_cache_gb * 1e9))

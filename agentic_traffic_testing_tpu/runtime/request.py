@@ -63,6 +63,11 @@ class Request:
     # Memoized (prompt_len, chain_keys) for prefix caching — see
     # block_allocator.request_chain_keys.
     prefix_keys_cache: Optional[tuple] = None
+    # Host-tier restore plan (list of kv_offload.RestoreBlock) attached at
+    # admission and applied by the engine right before the first suffix
+    # chunk dispatches; cleared on apply and on release (an unapplied plan
+    # refers to blocks that went back to the free list).
+    pending_restore: Optional[list] = None
     # Total tokens sampled so far, *surviving preemption* (preemption folds
     # output_ids back into prompt_ids; sampling keys use (seed, sampling_step)
     # so the regenerated continuation stays reproducible).

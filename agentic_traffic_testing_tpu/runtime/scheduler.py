@@ -210,10 +210,13 @@ class Scheduler:
         # head that _plan_prefill then refuses — or, with the cache discount
         # missing, never admit a cache-hit request whose suffix would fit.
         # (Slightly optimistic when the matched blocks are themselves in the
-        # evictable pool; _plan_prefill just declines that step.)
+        # evictable pool; _plan_prefill just declines that step.) Only the
+        # DEVICE hit discounts: host-tier blocks restore into freshly
+        # allocated blocks, so they still count toward the need.
+        device_cached, _host = self._probe_cached(head)
         need = self.allocator.blocks_needed(
             head.num_prompt_tokens + 1 + self.cfg.decode_lookahead
-        ) - self._probe_cached(head) // self.cfg.block_size
+        ) - device_cached // self.cfg.block_size
         return self.allocator.can_allocate(max(0, need))
 
     def has_pending_chunk(self) -> bool:
@@ -225,29 +228,45 @@ class Scheduler:
         c = self.cfg.prefill_chunk_tokens
         return c is not None and req.num_prompt_tokens > c
 
-    def _probe_cached(self, req: Request) -> int:
-        """Prefix-cache hit size (tokens) admission would get; 0 without a
-        prefix-caching allocator. Chain keys are memoized per request, so the
-        per-step re-probe of a waiting head is a dict walk, not a re-hash."""
+    def _probe_cached(self, req: Request) -> tuple[int, int]:
+        """(device-cached, host-restorable) hit sizes (tokens) admission
+        would get; (0, 0) without a prefix-caching allocator. Chain keys are
+        memoized per request, so the per-step re-probe of a waiting head is
+        a dict walk, not a re-hash."""
         keys = request_chain_keys(self.allocator, req)
         if keys is None:
-            return 0
-        return self.allocator.probe_prefix(req.prompt_ids, keys)
+            return 0, 0
+        return self.allocator.probe_prefix_tiered(req.prompt_ids, keys)
 
-    def _acquire_blocks(self, req: Request, need_tokens: int):
-        """All-or-nothing block acquisition, honoring any cached prefix.
+    def _acquire_blocks(self, req: Request, need_tokens: int,
+                        tiered: bool = True):
+        """All-or-nothing block acquisition, honoring any cached prefix
+        across both tiers.
 
-        Returns (blocks, cached_tokens) or (None, 0) if the pool can't hold
-        the request right now."""
+        Returns (blocks, cached_tokens, restore plan) or (None, 0, []) if
+        the pool can't hold the request right now. Host-tier restores in
+        the plan are freshly allocated blocks whose pages the engine writes
+        before the suffix prefill; on the failure path their release sends
+        them back unindexed (they hold no valid content yet).
+
+        `tiered=False` (the batched-prefill path) matches the DEVICE index
+        only: under a pool-shared host store, another replica's step thread
+        can put a chain key between this plan's probe and match, and a
+        late host hit surfacing mid-batch has no chunk step to ride — the
+        request simply recomputes, which is always correct."""
         keys = request_chain_keys(self.allocator, req)
-        if keys is not None:
+        if keys is not None and tiered:
+            blocks, cached, restores = self.allocator.match_prefix_tiered(
+                req.prompt_ids, keys)
+        elif keys is not None:
             blocks, cached = self.allocator.match_prefix(req.prompt_ids, keys)
+            restores = []
         else:
-            blocks, cached = self.allocator.new_sequence(), 0
+            blocks, cached, restores = self.allocator.new_sequence(), 0, []
         if not blocks.ensure_capacity(need_tokens):
             blocks.release()
-            return None, 0
-        return blocks, cached
+            return None, 0, []
+        return blocks, cached, restores
 
     def _next_chunk(self, req: Request,
                     max_padded: Optional[int] = None) -> Optional[ChunkPrefill]:
@@ -375,12 +394,12 @@ class Scheduler:
         if not self.waiting:
             return None
         head = self.waiting[0]
-        if not (self._needs_chunking(head) or self._probe_cached(head) > 0):
+        if not (self._needs_chunking(head) or sum(self._probe_cached(head)) > 0):
             return None
         if len(self.running) >= self.cfg.max_num_seqs:
             return None
         need_tokens = head.num_prompt_tokens + 1 + self.cfg.decode_lookahead
-        blocks, cached = self._acquire_blocks(head, need_tokens)
+        blocks, cached, restores = self._acquire_blocks(head, need_tokens)
         if blocks is None:
             if not self.running:
                 bad = self.waiting.popleft()
@@ -392,9 +411,13 @@ class Scheduler:
             return None  # no KV room: let decode drain / preemption handle it
         head.blocks = blocks
         head.num_computed_tokens = cached
+        head.pending_restore = restores or None
         record = getattr(self.allocator, "record_prefix_stats", None)
         if record is not None:  # hit tokens are actually applied here
-            record(head.num_prompt_tokens, cached)
+            host_tokens = len(restores) * self.cfg.block_size
+            record(head.num_prompt_tokens, cached - host_tokens)
+            if restores:
+                self.allocator.record_host_hit(host_tokens)
         head.state = RequestState.RUNNING
         self.running.append(self.waiting.popleft())
         return head
@@ -414,7 +437,7 @@ class Scheduler:
         # Probe cost is O(prompt) hashing — done for the HEAD only; later
         # queue entries are re-examined when they reach the head (a cached
         # request slipping into a batch is correct, it just recomputes).
-        if self._needs_chunking(head) or self._probe_cached(head) > 0:
+        if self._needs_chunking(head) or sum(self._probe_cached(head)) > 0:
             head = self._admit_chunk_head()
             if head is None:
                 return None
@@ -423,7 +446,7 @@ class Scheduler:
         bucket_len = 0
         while self.waiting:
             req = self.waiting[0]
-            if self._needs_chunking(req) or self._probe_cached(req) > 0:
+            if self._needs_chunking(req) or sum(self._probe_cached(req)) > 0:
                 # Solo (chunk-path) admission when it reaches the head: a
                 # batched prefill would REWRITE the shared prefix blocks
                 # (from a different compiled bucket -> bitwise-different bf16
@@ -443,11 +466,15 @@ class Scheduler:
             # All-or-nothing KV allocation: prompt + first decode slot +
             # lookahead headroom (keep in sync with can_admit_head).
             need_tokens = req.num_prompt_tokens + 1 + self.cfg.decode_lookahead
-            blocks, cached = self._acquire_blocks(req, need_tokens)
-            # plan() is single-threaded and nothing inserts index entries
-            # between the probe above and this match (allocation only ever
-            # REMOVES entries), so a batched request can never be a late hit.
-            assert cached == 0, "cache hit leaked into the batched-prefill path"
+            # Device-only match (tiered=False): plan() is single-threaded
+            # against its own index and allocation only ever REMOVES
+            # entries, so a batched request can never be a late DEVICE hit;
+            # the shared host store has no such guarantee (another
+            # replica's drain can insert concurrently) and is not consulted.
+            blocks, cached, restores = self._acquire_blocks(
+                req, need_tokens, tiered=False)
+            assert cached == 0 and not restores, (
+                "cache hit leaked into the batched-prefill path")
             if blocks is None:
                 if not self.running and not batch:
                     # The pool is completely idle and the head still cannot
@@ -583,6 +610,9 @@ class Scheduler:
         if req.blocks is not None:
             req.blocks.release()
             req.blocks = None
+        # An unapplied restore plan refers to blocks the release just sent
+        # back to the free list — never let a later re-admission apply it.
+        req.pending_restore = None
 
     # -- accounting (Prometheus) ------------------------------------------
 

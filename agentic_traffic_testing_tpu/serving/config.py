@@ -76,6 +76,13 @@ class ServerConfig:
     # then precompiles every (batch, length) bucket <= the cap at startup.
     prefill_batch_max_len: Optional[int] = None  # LLM_PREFILL_BATCH_MAX_LEN
     prefix_caching: bool = False               # LLM_PREFIX_CACHING
+    # Host-RAM second tier for the prefix cache (runtime/kv_offload.py):
+    # GB of host memory for evicted prefix blocks; restored device-side on
+    # a later hit instead of recomputed. 0 (default) disables the tier and
+    # keeps every existing path bit-identical. Requires LLM_PREFIX_CACHING.
+    # Under LLM_NUM_REPLICAS > 1 the ONE store is shared by every replica,
+    # so a prefix evicted on one replica is a host hit on all of them.
+    host_cache_gb: float = 0.0                 # LLM_HOST_CACHE_GB
     # Hybrid prefill+decode batching budget (tokens per fused ragged
     # dispatch: decode lanes + chunk bucket). 0 disables — the serial
     # prefill-priority schedule, bit-identical to before the knob existed.
@@ -153,6 +160,16 @@ class ServerConfig:
         pbml = os.environ.get("LLM_PREFILL_BATCH_MAX_LEN")
         c.prefill_batch_max_len = int(pbml) if pbml else None
         c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
+        c.host_cache_gb = float(
+            os.environ.get("LLM_HOST_CACHE_GB") or c.host_cache_gb)
+        if c.host_cache_gb < 0:
+            raise ValueError(
+                f"LLM_HOST_CACHE_GB must be >= 0, got {c.host_cache_gb} "
+                f"(unset it to disable the host KV tier)")
+        # host_cache_gb x prefix_caching coherence is checked in from_args
+        # (after CLI overrides — --enable-prefix-caching may repair an
+        # env-only combo) and again at engine build (EngineConfig), which
+        # covers servers constructed straight from from_env.
         c.hybrid_token_budget = int(
             os.environ.get("LLM_HYBRID_TOKEN_BUDGET") or c.hybrid_token_budget)
         c.kv_cache_dtype = os.environ.get("LLM_KV_CACHE_DTYPE") or None
@@ -205,6 +222,9 @@ class ServerConfig:
                        default=c.prefill_batch_max_len)
         p.add_argument("--enable-prefix-caching", dest="prefix_caching",
                        action="store_true", default=c.prefix_caching)
+        p.add_argument("--host-cache-gb", type=float, default=c.host_cache_gb,
+                       help="host-RAM tier for evicted prefix blocks "
+                            "(GB; 0 = off, requires prefix caching)")
         p.add_argument("--hybrid-token-budget", type=int,
                        default=c.hybrid_token_budget,
                        help="fused chunk+decode dispatch budget (0 = off)")
@@ -222,8 +242,14 @@ class ServerConfig:
                   "router_policy", "quantization",
                   "decode_steps", "prefill_chunk_tokens",
                   "prefill_batch_max_len", "prefix_caching",
-                  "hybrid_token_budget",
+                  "host_cache_gb", "hybrid_token_budget",
                   "num_blocks", "block_size", "weights_path",
                   "speculation", "spec_tokens", "spec_ngram"):
             setattr(c, f, getattr(a, f))
+        if c.host_cache_gb and not c.prefix_caching:
+            # The env path validated at parse; re-check after CLI overrides
+            # (--host-cache-gb without --enable-prefix-caching).
+            raise ValueError(
+                "--host-cache-gb requires --enable-prefix-caching (the host "
+                "tier extends the content-addressed prefix cache)")
         return c

@@ -32,7 +32,7 @@ class LLMMetrics:
     content_type = CONTENT_TYPE_LATEST
 
     def __init__(self, prefix: str = "llm", include_tokens: bool = True,
-                 num_replicas: int = 1) -> None:
+                 num_replicas: int = 1, host_cache: bool = False) -> None:
         self.include_tokens = include_tokens
         r = self.registry = CollectorRegistry()
         self.requests_total = Counter(
@@ -155,6 +155,37 @@ class LLMMetrics:
             f"{prefix}_prefix_cache_query_tokens_total",
             "Prompt tokens offered to the prefix cache (cumulative)",
             registry=r)
+        # Host-RAM KV tier (LLM_HOST_CACHE_GB — runtime/kv_offload.py).
+        # Registered ONLY when the tier is configured, mirroring the replica
+        # series rule: with the knob unset/0 the /metrics payload is
+        # byte-identical to the pre-tier backend. Under a replica pool the
+        # store-level gauges (used/capacity bytes) describe the ONE shared
+        # store; hit tokens / restore bytes / queue depth sum per replica.
+        self.host_cache_hit_tokens = None
+        self.host_cache_restore_bytes = None
+        self.host_cache_save_queue_depth = None
+        self.host_cache_used_bytes = None
+        self.host_cache_capacity_bytes = None
+        if host_cache:
+            self.host_cache_hit_tokens = Gauge(
+                f"{prefix}_host_cache_hit_tokens_total",
+                "Prompt tokens restored from the host KV tier instead of "
+                "recomputed (cumulative)", registry=r)
+            self.host_cache_restore_bytes = Gauge(
+                f"{prefix}_host_cache_restore_bytes_total",
+                "KV bytes streamed host→device by prefix restores "
+                "(cumulative)", registry=r)
+            self.host_cache_save_queue_depth = Gauge(
+                f"{prefix}_host_cache_save_queue_depth",
+                "Evicted blocks whose device→host save is still in flight",
+                registry=r)
+            self.host_cache_used_bytes = Gauge(
+                f"{prefix}_host_cache_used_bytes",
+                "Host RAM held by offloaded KV blocks", registry=r)
+            self.host_cache_capacity_bytes = Gauge(
+                f"{prefix}_host_cache_capacity_bytes",
+                "Configured host KV tier budget (LLM_HOST_CACHE_GB)",
+                registry=r)
         # Additive (no reference analog): speculative-decoding acceptance.
         # emitted/iters = mean tokens kept per verify step, in [1, spec+1].
         self.spec_emitted_tokens = Gauge(
@@ -182,6 +213,20 @@ class LLMMetrics:
         if "prefix_cache_hit_tokens" in stats:
             self.prefix_cache_hit_tokens.set(stats["prefix_cache_hit_tokens"])
             self.prefix_cache_query_tokens.set(stats["prefix_cache_query_tokens"])
+
+    def set_host_cache_stats(self, stats: dict) -> None:
+        """Refresh host-tier gauges from engine/pool kv_stats (called on
+        scrape; no-op unless the tier is registered AND active)."""
+        if self.host_cache_hit_tokens is None:
+            return
+        if "host_cache_hit_tokens" not in stats:
+            return
+        self.host_cache_hit_tokens.set(stats["host_cache_hit_tokens"])
+        self.host_cache_restore_bytes.set(stats["host_cache_restore_bytes"])
+        self.host_cache_save_queue_depth.set(
+            stats["host_cache_save_queue_depth"])
+        self.host_cache_used_bytes.set(stats["host_cache_used_bytes"])
+        self.host_cache_capacity_bytes.set(stats["host_cache_capacity_bytes"])
 
     def set_replica_stats(self, replica_stats: list) -> None:
         """Refresh the per-replica labeled series from EnginePool

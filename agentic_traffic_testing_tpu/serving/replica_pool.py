@@ -205,15 +205,34 @@ class EnginePool:
     def block_size(self) -> int:
         return self.engines[0].cache.block_size
 
+    # kv_stats keys that describe ONE shared object rather than per-replica
+    # state: block_size is a config invariant, and the host_cache_* store
+    # gauges describe the single HostKVStore every replica shares
+    # (runtime/kv_offload.py) — summing them would report N× the real
+    # host-RAM footprint.
+    _INVARIANT_KV_KEYS = (
+        "block_size",
+        "host_cache_used_bytes",
+        "host_cache_capacity_bytes",
+        "host_cache_entries",
+        "host_cache_saved_blocks",
+        "host_cache_evicted_blocks",
+    )
+
     def kv_stats(self) -> dict:
-        """Pool view with every per-replica key SUMMED except block_size
-        (a config invariant, identical across replicas). Keys match
-        LLMEngine.kv_stats exactly so the metrics layer is agnostic."""
+        """Pool view with every per-replica key SUMMED except the invariant
+        keys above (reported once). Keys match LLMEngine.kv_stats exactly
+        so the metrics layer is agnostic."""
         agg: dict = {}
-        for e in self.engines:
-            for k, v in e.kv_stats().items():
+        per_replica = [e.kv_stats() for e in self.engines]
+        for stats in per_replica:
+            for k, v in stats.items():
                 agg[k] = agg.get(k, 0) + v
-        agg["block_size"] = self.block_size
+        for key in self._INVARIANT_KV_KEYS:
+            for stats in per_replica:
+                if key in stats:
+                    agg[key] = stats[key]
+                    break
         return agg
 
     def replica_stats(self) -> list[dict]:

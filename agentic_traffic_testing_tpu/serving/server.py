@@ -85,10 +85,20 @@ class LLMServer:
         self.model_loaded = False  # set by _load_params on checkpoint load
         self.metrics = (
             LLMMetrics(cfg.metrics_prefix, cfg.metrics_include_tokens,
-                       num_replicas=cfg.num_replicas)
+                       num_replicas=cfg.num_replicas,
+                       host_cache=cfg.host_cache_gb > 0)
             if cfg.metrics_enabled else None
         )
         on_step = self.metrics.batch_size.observe if self.metrics else None
+        # ONE host KV store for the whole deployment (runtime/kv_offload.py):
+        # under a replica pool every replica shares it, so a prefix evicted
+        # on replica i is a host hit for replica j — the prefix-affinity
+        # router's cold-replica fallback then restores instead of recomputes.
+        from agentic_traffic_testing_tpu.runtime.kv_offload import (
+            host_store_from_gb,
+        )
+
+        self.host_store = host_store_from_gb(cfg.host_cache_gb)
         self.pool = None
         if cfg.num_replicas > 1:
             if engine is not None:
@@ -115,6 +125,15 @@ class LLMServer:
             self.engine = self.pool.engines[0]
             self.async_engine = self.pool
         else:
+            if engine is not None and self.host_store is not None:
+                # An injected engine never passes through _build_engine, so
+                # the store would never attach: the knob would serve
+                # recomputes behind permanently-zero llm_host_cache_*
+                # gauges. Refuse like the replicas case above.
+                raise ValueError(
+                    "an injected engine cannot back LLM_HOST_CACHE_GB > 0 — "
+                    "let the server build the engine (or build the engine "
+                    "with host_store= yourself and unset the knob)")
             self.engine = engine or self._build_engine()
             self.async_engine = AsyncLLMEngine(self.engine, on_step=on_step)
         if cfg.warmup and engine is None:
@@ -182,6 +201,15 @@ class LLMServer:
 
     def _build_engine(self) -> LLMEngine:
         c = self.cfg
+        if self.host_store is not None and (
+                c.tp_size > 1 or c.sp_size > 1 or c.pp_size > 1):
+            # The restore write path (engine._apply_pending_restore) is only
+            # wired for single-device caches; silently skipping the tier on
+            # a mesh would serve recomputes behind a configured knob.
+            raise NotImplementedError(
+                "LLM_HOST_CACHE_GB does not compose with tp/sp/pp meshes "
+                "yet — unset it or serve single-chip (optionally with "
+                "LLM_NUM_REPLICAS)")
         ecfg = EngineConfig(
             model=c.model, dtype=c.dtype, max_num_seqs=c.max_num_seqs,
             max_num_batched_tokens=c.max_num_batched_tokens,
@@ -191,6 +219,7 @@ class LLMServer:
             prefill_chunk_tokens=c.prefill_chunk_tokens,
             prefill_batch_max_len=c.prefill_batch_max_len,
             prefix_caching=c.prefix_caching,
+            host_cache_gb=c.host_cache_gb,
             hybrid_token_budget=c.hybrid_token_budget,
             kv_cache_dtype=c.kv_cache_dtype,
             int4_k_group=c.int4_k_group,
@@ -367,7 +396,8 @@ class LLMServer:
                 model_cfg = None
             if model_cfg is not None:
                 params = self._load_params(model_cfg)
-        return LLMEngine(ecfg, model_cfg=model_cfg, params=params)
+        return LLMEngine(ecfg, model_cfg=model_cfg, params=params,
+                         host_store=self.host_store)
 
     def _params_or_random_init(self, model_cfg):
         """Checkpoint params if configured, else random init honoring the
@@ -482,7 +512,9 @@ class LLMServer:
         # the per-replica values under the single-engine key names, so the
         # pre-pool gauges keep their meaning (totals) at any replica count.
         source = self.pool if self.pool is not None else self.engine
-        self.metrics.set_prefix_cache_stats(source.kv_stats())
+        kv = source.kv_stats()
+        self.metrics.set_prefix_cache_stats(kv)
+        self.metrics.set_host_cache_stats(kv)
         self.metrics.set_spec_stats(emitted=source.spec_emitted,
                                     iters=source.spec_iters)
         if self.pool is not None:

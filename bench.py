@@ -601,6 +601,89 @@ def main() -> None:
                  if r.first_token_time is not None]
         return int(hits), statistics.median(waits)
 
+    # Tiered-KV-cache probe (runtime/kv_offload.py): the recurring-scenario
+    # shape — a scenario prefix computed once, evicted from the device
+    # prefix cache by capacity pressure, then re-requested. With the host
+    # tier ON the re-arrival restores the prefix host→device and prefills
+    # only the suffix; OFF it pays the full prefill recompute (the prefill-
+    # MFU-0.13 hot path). Reports restore-vs-recompute TTFT and the restore
+    # bandwidth. Best-effort like every secondary series; BENCH_OFFLOAD=0
+    # disables.
+    offload_on = os.environ.get("BENCH_OFFLOAD", "1") not in ("0", "false")
+    offload_prefix = int(os.environ.get(
+        "BENCH_OFFLOAD_PREFIX", str(min(fanout_prompt, 512))))
+    offload_pressure = int(os.environ.get("BENCH_OFFLOAD_PRESSURE", "3"))
+    offload_host_mb = float(os.environ.get("BENCH_OFFLOAD_HOST_MB", "1024"))
+
+    def offload_probe(host_mb: float, probe_reps: int = 0):
+        """(re-arrival TTFT p50, host hit tokens, restore bytes, outputs)
+        for the recurring scenario under eviction pressure, tier ON when
+        host_mb > 0. `probe_reps` overrides the bench-wide rep count
+        (the warmup pass only needs one cycle to compile both paths)."""
+        from agentic_traffic_testing_tpu.runtime.kv_offload import HostKVStore
+
+        off_len = offload_prefix + 96
+        store = HostKVStore(int(host_mb * 1e6)) if host_mb > 0 else None
+        # Pool sized to ONE scenario footprint (prompt + completion + the
+        # engine's decode lookahead) plus slack: every pressure prompt
+        # after the first digs into the evictable LRU, guaranteeing the
+        # scenario's blocks are reclaimed (and spilled, tier ON).
+        lookahead = 1 + max(4, 3 * (decode_steps or 1))
+        eng = LLMEngine(EngineConfig(
+            model=model, dtype="bfloat16", max_num_seqs=2,
+            max_model_len=off_len,
+            num_blocks=(-(-(offload_prefix + 8 + lookahead)
+                          // cfg.block_size) + 3) + 1,
+            decode_steps=decode_steps, prefix_caching=True,
+            kv_cache_dtype=kv_cache_dtype,
+        ), model_cfg=engine.model_cfg, runner=engine.runner,
+            host_store=store)
+        wl = np.random.default_rng(23)  # reseeded per arm: same workload
+        scenario = wl.integers(10, vocab - 10, offload_prefix).tolist()
+        pressures = [wl.integers(10, vocab - 10, offload_prefix).tolist()
+                     for _ in range(offload_pressure)]
+        sp = lambda: SamplingParams(temperature=0.0, max_tokens=8,
+                                    ignore_eos=True)
+        eng.generate(scenario, sp())
+        ttfts = []
+        req = None
+        for _ in range(probe_reps or reps):
+            for p in pressures:
+                eng.generate(p, sp())
+            req = eng.generate(scenario, sp())
+            ttfts.append(req.first_token_time - req.arrival_time)
+        stats = eng.kv_stats()
+        return (statistics.median(ttfts),
+                int(stats.get("host_cache_hit_tokens", 0)),
+                int(stats.get("host_cache_restore_bytes", 0)),
+                sum(ttfts), req.generated_ids)
+
+    offload_res = None
+    if offload_on:
+        try:
+            offload_probe(offload_host_mb, probe_reps=1)  # warmup: both paths' shapes
+            on_ttft, on_hits, on_bytes, on_wall, on_out = offload_probe(
+                offload_host_mb)
+            off_ttft, _, _, _, off_out = offload_probe(0)
+            if on_hits <= 0:
+                raise RuntimeError("offload probe produced no host hits "
+                                   "(pool too large for the pressure wave?)")
+            if on_out != off_out:
+                raise RuntimeError("restored completion diverged from "
+                                   "recompute — refusing to report")
+            offload_res = {
+                "offload_prefix_tokens": offload_prefix,
+                "offload_restore_ttft_s": round(on_ttft, 4),
+                "offload_recompute_ttft_s": round(off_ttft, 4),
+                "offload_host_hit_tokens": on_hits,
+                "offload_restore_bytes": on_bytes,
+                "offload_restore_gb_s": round(on_bytes / max(on_wall, 1e-9)
+                                              / 1e9, 3),
+            }
+        except Exception as e:
+            offload_res = None
+            print(f"bench: offload probe dropped ({e!r})", file=sys.stderr)
+
     replica_res = None
     if replicas_on:
         try:
@@ -772,6 +855,7 @@ def main() -> None:
         }),
         **({} if hybrid_res is None else hybrid_res),
         **({} if replica_res is None else replica_res),
+        **({} if offload_res is None else offload_res),
         **({} if prefill_s is None else {
             # Compute-bound half of serving (round-3 flash prefill site).
             # est_mfu counts dense matmul FLOPs (2 * non-embedding params

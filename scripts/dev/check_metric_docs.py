@@ -1,0 +1,109 @@
+#!/usr/bin/env python3
+"""Metric-family ↔ docs parity check.
+
+The north star requires the Prometheus contract to stay identical to the
+reference's (docs/monitoring.md is normative: scrape_metrics.py treats the
+dashboard as a schema and the doc documents every family). Every PR that
+adds a family must document it, and every documented family must exist —
+this script asserts both directions so tier-1 catches drift:
+
+  1. every `llm_*` family registered by serving/metrics.py (ALL conditional
+     sets on: replica pool + host cache) appears in docs/monitoring.md;
+  2. every `llm_*` token in docs/monitoring.md names a registered family
+     (histogram `_bucket`/`_sum`/`_count` suffixes and `llm_foo_*` wildcard
+     prefixes are understood).
+
+Exit 0 on parity, 1 with a report otherwise. Wired into tests/test_scripts.py.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+HIST_SUFFIXES = ("_bucket", "_sum", "_count")
+
+# Tokens that match the family regex but are not metric families: service
+# names from the static IP plan (tcp_* label values, prose mentions).
+KNOWN_NON_FAMILIES = {"llm_backend"}
+
+
+def registered_families(prefix: str = "llm") -> set[str]:
+    """Family names as they appear in a scrape, with every conditional set
+    (replica series, host-cache series) enabled."""
+    from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
+
+    m = LLMMetrics(prefix, include_tokens=True, num_replicas=2,
+                   host_cache=True)
+    fams = set()
+    for fam in m.registry.collect():
+        name = fam.name
+        if fam.type == "counter":
+            name += "_total"  # scrape-visible sample name
+        fams.add(name)
+    return fams
+
+
+def documented_tokens(text: str, prefix: str = "llm") -> tuple[set, set]:
+    """(exact family tokens, wildcard prefixes) mentioned in the doc.
+    A token ending in `_` came from a `llm_foo_*` or `llm_foo_{a,b}`
+    shorthand and is treated as a prefix wildcard. Tokens preceded by a
+    double quote are PromQL label VALUES (e.g. dst_service="llm_backend"),
+    not families."""
+    tokens = set(re.findall(rf'(?<!"){prefix}_[a-z0-9_]+', text))
+    tokens -= KNOWN_NON_FAMILIES
+    exact = {t for t in tokens if not t.endswith("_")}
+    prefixes = {t for t in tokens if t.endswith("_")}
+    return exact, prefixes
+
+
+def main(argv=None) -> int:
+    doc_path = os.path.join(REPO, "docs", "monitoring.md")
+    if argv:
+        doc_path = argv[0]
+    with open(doc_path) as f:
+        text = f.read()
+    reg = registered_families()
+    exact, prefixes = documented_tokens(text)
+
+    missing_from_docs = []
+    for fam in sorted(reg):
+        if fam in exact:
+            continue
+        if any(fam.startswith(p) for p in prefixes):
+            continue
+        missing_from_docs.append(fam)
+
+    unknown_in_docs = []
+    for tok in sorted(exact):
+        if tok in reg:
+            continue
+        if any(tok.endswith(s) and tok[: -len(s)] in reg
+               for s in HIST_SUFFIXES):
+            continue
+        unknown_in_docs.append(tok)
+    for p in sorted(prefixes):
+        if not any(f.startswith(p) for f in reg):
+            unknown_in_docs.append(p + "*")
+
+    ok = not missing_from_docs and not unknown_in_docs
+    if missing_from_docs:
+        print("registered but MISSING from docs/monitoring.md:")
+        for fam in missing_from_docs:
+            print(f"  {fam}")
+    if unknown_in_docs:
+        print("documented but NOT registered by serving/metrics.py:")
+        for tok in unknown_in_docs:
+            print(f"  {tok}")
+    if ok:
+        print(f"metric-docs parity OK: {len(reg)} families, "
+              f"{len(exact)} documented tokens")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,143 @@
+#!/usr/bin/env python3
+"""Host-KV-offload A/B on the recurring-scenario workload.
+
+The engine-level A/B for the tiered-KV-cache claim (runtime/kv_offload.py),
+isolated from the HTTP layer: a scenario prompt is computed once, evicted
+from the device prefix cache by capacity pressure (a KV pool deliberately
+too small to retain it), then re-requested. With the host tier ON the
+re-arrival restores the prefix host→device and prefills only the suffix;
+OFF it pays the full prefill recompute — the exact hot path ROADMAP flags
+(prefill MFU 0.13 makes recompute expensive; host restore is a memcpy-
+shaped stream). One JSON line per mode:
+
+    {"mode": "offload"|"recompute", "rearrival_ttft_s": ...,
+     "host_hit_tokens": ..., "restore_bytes": ..., "restore_gb_s": ...,
+     "outputs_match": true}
+
+`outputs_match` asserts the restored completion is byte-identical to the
+recompute completion (the correctness half of the claim). Numbers feed
+docs/BENCHMARKS.md once measured on hardware.
+
+Usage: python scripts/dev/offload_ab.py [prefix_len] [pressure_prompts] [host_mb]
+Env: OFFLOAD_AB_MODEL (default: tiny fp32 on cpu, llama-3.2-1b bf16 on tpu).
+No reference analog (the reference's vLLM tier is device-only).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def run_mode(host_mb: float, *, runner, model_cfg, model: str, dtype: str,
+             prefix_len: int, pressure: int, reps: int) -> dict:
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.kv_offload import HostKVStore
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+    block_size = 16
+    max_len = prefix_len + 96
+    # Pool sized to ONE scenario footprint plus a little slack: requests
+    # run one at a time, so every pressure prompt after the first must dig
+    # into the evictable LRU — guaranteed reclaim of the scenario's blocks
+    # (and, with the tier ON, guaranteed device→host spills).
+    num_blocks = (-(-(prefix_len + 32) // block_size) + 3) + 1
+    store = HostKVStore(int(host_mb * 1e6)) if host_mb > 0 else None
+    eng = LLMEngine(EngineConfig(
+        model=model, dtype=dtype, max_num_seqs=2, max_model_len=max_len,
+        block_size=block_size, num_blocks=num_blocks, prefix_caching=True,
+    ), model_cfg=model_cfg, runner=runner, host_store=store)
+
+    wl = np.random.default_rng(11)  # reseeded per mode: identical workload
+    vocab = model_cfg.vocab_size
+    scenario = wl.integers(10, vocab - 10, prefix_len).tolist()
+    pressures = [wl.integers(10, vocab - 10, prefix_len).tolist()
+                 for _ in range(pressure)]
+    sp = lambda: SamplingParams(temperature=0.0, max_tokens=8,
+                                ignore_eos=True)
+
+    first = eng.generate(scenario, sp())
+    ttfts = []
+    for _ in range(reps):
+        for p in pressures:  # evict the scenario's blocks (spilling if ON)
+            eng.generate(p, sp())
+        re_req = eng.generate(scenario, sp())
+        ttfts.append(re_req.first_token_time - re_req.arrival_time)
+    stats = eng.kv_stats()
+    ttft = statistics.median(ttfts)
+    restore_bytes = int(stats.get("host_cache_restore_bytes", 0))
+    return {
+        "mode": "offload" if store is not None else "recompute",
+        "prefix_tokens": prefix_len,
+        "pressure_prompts": pressure,
+        "rearrival_ttft_s": round(ttft, 4),
+        "host_hit_tokens": int(stats.get("host_cache_hit_tokens", 0)),
+        "restore_bytes": restore_bytes,
+        "restore_gb_s": (round(restore_bytes / max(sum(ttfts), 1e-9) / 1e9, 3)
+                         if restore_bytes else 0.0),
+        "outputs": re_req.generated_ids,
+        "first_outputs": first.generated_ids,
+    }
+
+
+def main(argv=None) -> list[dict]:
+    argv = [float(a) for a in (argv if argv is not None else sys.argv[1:])]
+    prefix_len = int(argv[0]) if len(argv) > 0 else 128
+    pressure = int(argv[1]) if len(argv) > 1 else 3
+    host_mb = argv[2] if len(argv) > 2 else 256.0
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import init_params
+    from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+    platform = jax.devices()[0].platform
+    model = os.environ.get(
+        "OFFLOAD_AB_MODEL", "llama-3.2-1b" if platform == "tpu" else "tiny")
+    dtype = "bfloat16" if platform == "tpu" else "float32"
+    reps = 3 if platform == "tpu" else 1
+    model_cfg = resolve_config(model)
+    params = init_params(
+        model_cfg, jax.random.key(0),
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    runner = ModelRunner(model_cfg, params)
+    print(f"devices: {jax.devices()}  prefix={prefix_len} "
+          f"pressure={pressure} host_mb={host_mb} model={model}",
+          file=sys.stderr, flush=True)
+
+    common = dict(runner=runner, model_cfg=model_cfg, model=model,
+                  dtype=dtype, prefix_len=prefix_len, pressure=pressure,
+                  reps=reps)
+    # Discarded warmup pass (tier ON, so the restore path's suffix-chunk
+    # shapes compile too) — neither measured mode pays XLA compiles inside
+    # its TTFT.
+    run_mode(host_mb, **{**common, "reps": 1})
+    results = []
+    for mb in (host_mb, 0):
+        results.append(run_mode(mb, **common))
+    # Correctness gate: the restored completion must match the recompute
+    # completion byte-for-byte (and the original computation).
+    outs = {tuple(r["outputs"]) for r in results}
+    outs |= {tuple(r["first_outputs"]) for r in results}
+    for r in results:
+        r["outputs_match"] = len(outs) == 1
+        r.pop("outputs"), r.pop("first_outputs")
+        print(json.dumps(r), flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -24,6 +24,7 @@ from agentic_traffic_testing_tpu.runtime.block_allocator import (
     PrefixCachingAllocator,
 )
 from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+from agentic_traffic_testing_tpu.runtime.kv_offload import HostKVStore
 from agentic_traffic_testing_tpu.runtime.request import SamplingParams
 from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
 
@@ -36,7 +37,7 @@ def params():
     return init_params(CFG, jax.random.key(0), dtype=jnp.float32)
 
 
-def make_engine(params, prefix_caching=True, **kw):
+def make_engine(params, prefix_caching=True, host_store=None, **kw):
     kw.setdefault("model", "tiny")
     kw.setdefault("dtype", "float32")
     kw.setdefault("max_model_len", 256)
@@ -45,7 +46,8 @@ def make_engine(params, prefix_caching=True, **kw):
     kw.setdefault("max_num_seqs", 4)
     ecfg = EngineConfig(prefix_caching=prefix_caching, **kw)
     runner = ModelRunner(CFG, params, decode_steps=1)
-    return LLMEngine(ecfg, model_cfg=CFG, runner=runner)
+    return LLMEngine(ecfg, model_cfg=CFG, runner=runner,
+                     host_store=host_store)
 
 
 def greedy(max_tokens=8, **kw):
@@ -190,6 +192,111 @@ def test_cache_hit_composes_with_chunking(params):
     eng = make_engine(params, prefill_chunk_tokens=32)
     assert eng.generate(prompt, greedy(6)).generated_ids == want
     assert eng.generate(prompt, greedy(6)).generated_ids == want
+
+
+def test_host_store_lru_and_collision():
+    """HostKVStore unit behavior: byte-budget LRU + token-tuple collision
+    check (a hash collision must miss, never serve another prompt's KV)."""
+    import numpy as np
+
+    k = np.zeros((2, 2, 4, 8), np.float32)  # 1 KiB
+    v = np.zeros_like(k)
+    store = HostKVStore(5 * k.nbytes)  # room for two (k, v) pairs + change
+    assert store.put(1, (1,), k, v) and store.put(2, (2,), k, v)
+    assert store.contains(1, (1,)) and not store.contains(1, (9,))
+    assert store.get(2, (9,)) is None  # collision -> miss
+    store.get(1, (1,))  # refresh: key 2 becomes LRU
+    assert store.put(3, (3,), k, v)
+    assert not store.contains(2, (2,)), "LRU entry must have been evicted"
+    assert store.contains(1, (1,)) and store.contains(3, (3,))
+    stats = store.stats()
+    assert stats["host_cache_entries"] == 2
+    assert stats["host_cache_evicted_blocks"] == 1
+    assert stats["host_cache_used_bytes"] <= store.capacity_bytes
+
+
+def test_host_offload_requires_prefix_caching(params):
+    with pytest.raises(ValueError, match="prefix_caching"):
+        EngineConfig(model="tiny", host_cache_gb=1.0)
+    with pytest.raises(ValueError, match="prefix_caching"):
+        make_engine(params, prefix_caching=False,
+                    host_store=HostKVStore(1 << 20))
+
+
+def test_evict_restore_outputs_identical(params):
+    """The tentpole invariant: a prefix evicted under capacity pressure and
+    restored from the host tier produces completions byte-identical to a
+    cold recompute — greedy AND seeded sampling."""
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, CFG.vocab_size, 40).tolist()
+    pressure = [rng.integers(0, CFG.vocab_size, 120).tolist()
+                for _ in range(3)]
+    seeded = lambda: SamplingParams(max_tokens=9, temperature=0.7, top_k=12,
+                                    seed=5)
+
+    cold = make_engine(params, prefix_caching=False, num_blocks=24)
+    want_greedy = cold.generate(prompt, greedy(8)).generated_ids
+    want_seeded = cold.generate(prompt, seeded()).generated_ids
+
+    store = HostKVStore(64 << 20)
+    eng = make_engine(params, num_blocks=24, host_store=store)
+    assert eng.generate(prompt, greedy(8)).generated_ids == want_greedy
+    for p in pressure:  # 120-token prompts over a 23-block pool: reclaim
+        eng.generate(p, greedy(8))
+    assert len(store) > 0, "eviction must have spilled blocks to host"
+    assert eng.allocator.probe_prefix(prompt) == 0, (
+        "device tier must have dropped the prefix")
+    restored = eng.generate(prompt, greedy(8))
+    assert restored.generated_ids == want_greedy
+    stats = eng.kv_stats()
+    assert stats["host_cache_hit_tokens"] >= 32, stats
+    assert stats["host_cache_restore_bytes"] > 0, stats
+    # Restored blocks are re-indexed device-side: the next arrival is a
+    # pure device hit, no further restore traffic.
+    bytes_before = stats["host_cache_restore_bytes"]
+    assert eng.generate(prompt, greedy(8)).generated_ids == want_greedy
+    assert eng.kv_stats()["host_cache_restore_bytes"] == bytes_before
+    # Seeded sampling across another evict/restore cycle.
+    for p in pressure:
+        eng.generate(p, greedy(8))
+    assert eng.generate(prompt, seeded()).generated_ids == want_seeded
+
+
+def test_host_store_shared_across_replicas(params):
+    """One host store behind a 2-replica pool: a prefix computed (then
+    evicted) on replica 0 is host-restored on replica 1 — the cross-replica
+    sharing the shared-nothing device tiers cannot do."""
+    from agentic_traffic_testing_tpu.serving.replica_pool import EnginePool
+
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(0, CFG.vocab_size, 40).tolist()
+    pressure = [rng.integers(0, CFG.vocab_size, 120).tolist()
+                for _ in range(3)]
+
+    cold = make_engine(params, prefix_caching=False, num_blocks=24)
+    want = cold.generate(prompt, greedy(8)).generated_ids
+
+    store = HostKVStore(64 << 20)
+    e0 = make_engine(params, num_blocks=24, host_store=store)
+    e1 = make_engine(params, num_blocks=24, host_store=store)
+    pool = EnginePool([e0, e1], policy="round_robin")
+
+    assert e0.generate(prompt, greedy(8)).generated_ids == want
+    for p in pressure:  # evict on replica 0 -> spill to the shared store
+        e0.generate(p, greedy(8))
+    assert len(store) > 0
+    assert e1.allocator.probe_prefix(prompt) == 0  # replica 1 never saw it
+    r1 = e1.generate(prompt, greedy(8))
+    assert r1.generated_ids == want
+    s1 = e1.kv_stats()
+    assert s1["host_cache_hit_tokens"] >= 32, s1
+    # Pool aggregation: per-replica counters sum, store-level gauges are
+    # reported once (the ONE shared store, not N of them).
+    agg = pool.kv_stats()
+    assert agg["host_cache_hit_tokens"] == (
+        e0.kv_stats()["host_cache_hit_tokens"] + s1["host_cache_hit_tokens"])
+    assert agg["host_cache_capacity_bytes"] == store.capacity_bytes
+    assert agg["host_cache_used_bytes"] == store.stats()["host_cache_used_bytes"]
 
 
 def test_eviction_under_pressure_keeps_outputs(params):

@@ -235,6 +235,38 @@ def test_router_ab_smoke(monkeypatch):
             > by_policy["round_robin"]["hit_tokens"])
 
 
+# --------------------------------------------------------- offload A/B
+
+
+def test_offload_ab_smoke(monkeypatch):
+    """scripts/dev/offload_ab.py end-to-end on the tiny model with a tiny
+    host-cache budget: the offload arm must actually restore from the host
+    tier (hit tokens > 0) and both arms' completions must be byte-identical
+    (in-process for the warm jax/conftest CPU config, like router_ab)."""
+    monkeypatch.setenv("OFFLOAD_AB_MODEL", "tiny")
+    offload_ab = load_script("scripts/dev/offload_ab.py", "offload_ab")
+    results = offload_ab.main(["48", "2", "8"])
+    assert [r["mode"] for r in results] == ["offload", "recompute"]
+    by_mode = {r["mode"]: r for r in results}
+    assert by_mode["offload"]["host_hit_tokens"] > 0
+    assert by_mode["offload"]["restore_bytes"] > 0
+    assert by_mode["recompute"]["host_hit_tokens"] == 0
+    for r in results:
+        assert r["outputs_match"] is True
+        assert r["rearrival_ttft_s"] >= 0
+
+
+# ------------------------------------------------- metric-docs parity
+
+
+def test_metric_docs_parity():
+    """Every llm_* family registered by serving/metrics.py is documented in
+    docs/monitoring.md and vice versa (the north star pins the Prometheus
+    contract; scripts/dev/check_metric_docs.py is the one gate)."""
+    check = load_script("scripts/dev/check_metric_docs.py", "check_metric_docs")
+    assert check.main([]) == 0
+
+
 # --------------------------------------------------------- platform guard
 
 
