@@ -577,6 +577,128 @@ def prefill_chunk_impl(
                                kv_writer_mode, bs)
 
 
+def prefill_pipeline_impl(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jax.Array,        # [B, C] one position-chunk of every row
+    cache: KVCache,           # donated
+    block_tables: jax.Array,  # [B, W]
+    chunk_start: jax.Array,   # scalar i32 — absolute position of tokens[:, 0]
+    seq_lens: jax.Array,      # [B] true prompt lengths (full prompt, not chunk)
+    kv_writer_mode: Optional[str] = None,
+    attn_mode: Optional[str] = None,
+) -> tuple[jax.Array, KVCache]:
+    """One position-chunk of a PIPELINED (solo or batched) prefill.
+
+    The round-6 dispatch-overlap path (engine._run_prefill_pipelined): the
+    prompt splits into K uniform position-chunks and the engine dispatches
+    them back-to-back with NO host synchronization — chunk i+1's dispatch
+    rides the device queue while chunk i computes, so the ~0.1 s
+    axon-tunnel dispatch overhead amortizes to one chunk's worth. This is
+    the batched generalization of prefill_chunk_impl: every row advances
+    through the same [chunk_start, chunk_start + C) window (rows are
+    padded to one bucket, so chunk boundaries are uniform), each chunk
+    attends [prior pages (gathered)] ++ [itself, in register] under the
+    same two-region validity rule, and pages land at the table-column
+    offset chunk_start // block_size. chunk_start is a TRACED scalar, so
+    ONE compiled program serves all K chunks of a bucket.
+
+    Returns (logits [B, V] fp32 — each row read at its LAST REAL token
+    when that token falls inside this chunk, else at a clamped in-chunk
+    index whose sample the runner's carry discards — and the updated
+    cache). Rows whose real length ends before this chunk compute garbage
+    the same way the solo path's tail padding does: their page writes land
+    past seq_len where nothing reads, and causality keeps them out of
+    every real row's softmax.
+    """
+    b, c = tokens.shape
+    bs = cache.block_size
+    if c % bs != 0:
+        raise ValueError(f"chunk length {c} not a multiple of block_size {bs}")
+    if attn_mode is not None:
+        raise ValueError(
+            "prefill_pipeline_impl serves the single-chip site only "
+            f"(attn_mode={attn_mode!r}); mesh runners declare "
+            "supports_prefill_pipeline=False")
+    w = block_tables.shape[1]
+    hd = cfg.head_dim_
+    positions = jnp.broadcast_to(
+        chunk_start + jnp.arange(c, dtype=jnp.int32)[None], (b, c))
+    x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)
+    sin, cos = rope_sin_cos(positions, cfg.head_dim_, cfg.rope_theta,
+                            cfg.rope_scaling)
+
+    # Two-region validity as in prefill_chunk_impl; the in-chunk region
+    # needs no chunk_len clamp — causality alone protects real rows from
+    # tail garbage, exactly the solo-prefill site's contract.
+    page_positions = jnp.arange(w * bs, dtype=jnp.int32)[None]
+    kv_positions = jnp.concatenate(
+        [jnp.broadcast_to(page_positions, (b, w * bs)), positions], axis=1)
+    kv_mask = jnp.concatenate(
+        [jnp.broadcast_to(page_positions < chunk_start, (b, w * bs)),
+         jnp.ones((b, c), bool)], axis=1)
+    import os as _os
+
+    # The flash site is the default ON TPU for this path (unlike the
+    # serial chunk site's opt-in): the pipeline exists to raise device-
+    # plane throughput, and the score-materializing oracle would hand the
+    # win straight back. ATT_CHUNK_ATTENTION=jnp forces the oracle;
+    # =flash engages the kernel off-TPU too (interpret mode, CPU tests).
+    _chunk_env = _os.environ.get("ATT_CHUNK_ATTENTION")
+    use_flash = (_chunk_env == "flash"
+                 or (_chunk_env != "jnp" and jax.default_backend() == "tpu"))
+
+    def attn_site(q, k, v, li):
+        k_prior = kvc.gather_kv(
+            jax.lax.dynamic_index_in_dim(cache.k, li, 0, keepdims=False),
+            block_tables)[..., :hd].astype(k.dtype)
+        v_prior = kvc.gather_kv(
+            jax.lax.dynamic_index_in_dim(cache.v, li, 0, keepdims=False),
+            block_tables)[..., :hd].astype(v.dtype)
+        k_all = jnp.concatenate([k_prior, k], axis=1)
+        v_all = jnp.concatenate([v_prior, v], axis=1)
+        if use_flash:
+            from agentic_traffic_testing_tpu.ops.pallas.chunk_flash import (
+                chunk_flash_attention,
+            )
+
+            return chunk_flash_attention(
+                q, k_all, v_all, chunk_start, prior_len=w * bs,
+                interpret=jax.default_backend() != "tpu")
+        return causal_attention(
+            q, k_all, v_all,
+            q_positions=positions, kv_positions=kv_positions,
+            kv_valid_mask=kv_mask,
+        )
+
+    xs_layers, held = _scan_split(params["layers"])
+
+    def body(x, xs):
+        xs_lp, li = xs
+        lp = _merge_lp(xs_lp, held, li)
+        return _prefill_layer_body(x, lp, li, cfg, sin, cos, attn_site, cache)
+
+    x, (ks, vs) = jax.lax.scan(
+        body, x, (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)))
+    # Traced chunk offset: only the DUS writer supports it (as in
+    # _prefill_chunk_tail).
+    from agentic_traffic_testing_tpu.ops.kv_writer import writer_choice
+
+    mode = kv_writer_mode or writer_choice()
+    kc, vc = write_prompt_pages(
+        cache.k, cache.v, ks, vs, block_tables,
+        mode=("dus" if mode in ("pallas", "interpret") else mode),
+        first_block=chunk_start // bs,
+    )
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    # Per-row last-real-token logits, clamped into this chunk: the clamp
+    # only matters for rows whose final token lives in ANOTHER chunk, and
+    # the runner's carry merge (`mine`) discards those rows' samples.
+    idx = jnp.clip(seq_lens - 1 - chunk_start, 0, c - 1)
+    last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+    return _unembed(last[:, None, :], params, cfg)[:, 0], KVCache(kc, vc)
+
+
 def _prefill_chunk_tail(params, cfg: ModelConfig, x, sin, cos, attn_site,
                         cache: KVCache, block_tables, chunk_start, chunk_len,
                         kv_writer_mode, bs):

@@ -86,7 +86,16 @@ def _library_flash_attention(q: jax.Array, k: jax.Array,
                              v: jax.Array) -> jax.Array:
     """Round-3 path: the jax.experimental TPU flash kernel, kept as the
     ATT_PREFILL_ATTENTION=library escape hatch until the first-party kernel
-    is validated on real Mosaic tiling."""
+    is validated on real Mosaic tiling.
+
+    GQA cost (round-6 advisor fix): the library kernel has no grouped-head
+    support, so K/V are MATERIALIZED per query head via repeat_kv —
+    (H/KH - 1)x extra K+V bytes of dead HBM the first-party kernel never
+    allocates (at Llama-70B's 8:1 grouping and T=8192 that is ~7x the KV
+    footprint, per layer of the scan transient). Bounded by a guard below
+    so a big-model escape-hatch run fails loudly instead of OOMing the
+    pool; raise ATT_LIBRARY_REPEAT_KV_CAP_GB only if you have measured the
+    headroom, or route ATT_PREFILL_ATTENTION=flash|jnp instead."""
     from jax.experimental.pallas.ops.tpu.flash_attention import (
         BlockSizes,
         flash_attention,
@@ -94,9 +103,26 @@ def _library_flash_attention(q: jax.Array, k: jax.Array,
 
     b, tq, h, hd = q.shape
     kh = k.shape[2]
+    if h % kh != 0:
+        # repeat_kv's h // kh grouping would silently drop heads.
+        raise ValueError(
+            f"library flash path needs H % KH == 0, got H={h}, KH={kh}")
+    groups = h // kh
+    if groups > 1:
+        extra_bytes = 2 * (groups - 1) * tq * kh * hd * b * q.dtype.itemsize
+        cap = int(float(os.environ.get(
+            "ATT_LIBRARY_REPEAT_KV_CAP_GB", "2")) * 1e9)
+        if extra_bytes > cap:
+            raise ValueError(
+                f"ATT_PREFILL_ATTENTION=library would materialize "
+                f"{extra_bytes / 1e9:.2f} GB of repeated KV at this GQA "
+                f"shape (H={h}, KH={kh}, T={tq}) — over the "
+                f"{cap / 1e9:.1f} GB ATT_LIBRARY_REPEAT_KV_CAP_GB guard. "
+                f"Use ATT_PREFILL_ATTENTION=flash (grouped heads, no "
+                f"repeat) or =jnp, or raise the cap deliberately.")
     # GQA via head repetition, matching repeat_kv's h // (H/KH) grouping.
-    k = repeat_kv(k, h // kh)
-    v = repeat_kv(v, h // kh)
+    k = repeat_kv(k, groups)
+    v = repeat_kv(v, groups)
     # Large blocks, measured: the library defaults grid far too fine for
     # serving shapes (2048x64: 120 ms/call default vs 3.9 ms at full-T
     # blocks on v5e — docs/BENCHMARKS.md round-3 prefill anatomy). The

@@ -1,0 +1,304 @@
+"""Flash-attention block-size autotuner for the chunk_flash kernel family.
+
+Why: the first-party flash kernels (ops/pallas/chunk_flash.py) shipped with
+hand-picked tiles — `kv_block = 1024`, largest-pow2 `q_block` — measured at
+exactly one shape (2048x64 on v5e, docs/BENCHMARKS.md round-4). The
+Triton-attention anatomy literature (PAPERS.md) shows block-size tuning
+alone is worth integer factors on attention kernels, and the serving bucket
+ladder walks shapes the hand-picked tiles were never measured at. This
+module sweeps the small (q_block, kv_block) candidate lattice per
+(T, Tkv, hd, qpk) shape, times the REAL kernel on the real device, and
+persists the winners to a JSON table keyed by device kind so later
+processes skip the sweep.
+
+Env knob: `ATT_FLASH_TUNE`
+
+  off       (default) today's heuristic blocks — zero behavior change.
+  warmup    sweep lazily at the first trace of each shape. Engine warmup
+            (warmup_prefill_buckets / warmup_chunk_buckets) traces every
+            serving bucket, so in a warmed server the sweep cost lands at
+            startup, not mid-traffic. Winners persist to
+            `default_cache_path()` (atomic rewrite, best-effort) and are
+            reloaded by later processes.
+  <path>    read the JSON table at <path> (as persisted by a warmup run —
+            the production mode: tune once, pin the table). Unknown shapes,
+            a missing file, or a corrupt/mistyped table all fall back to
+            the heuristic — deterministic, never sweeps.
+
+Numerics are untouched by construction: block sizes only change tiling.
+tests/test_autotune.py pins interpret-mode parity of EVERY candidate config
+against the jnp oracle, the cache round-trip, and the corrupt-table
+fallback.
+
+Implementation note: block resolution happens at kernel TRACE time (shapes
+are static there), so a warmup-mode sweep runs while an outer program is
+being traced. That is safe — the sweep calls the kernel wrappers on fresh
+CONCRETE arrays with explicit block sizes, which dispatches independent
+programs — but it is why the sweep never goes through the resolving
+(default-block) entry points: no recursion, no tracer capture.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import tempfile
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# Cap the sweep's per-candidate timing loop; the first call per candidate
+# pays its compile, then `_BENCH_ITERS` timed runs take the minimum (the
+# standard way to strip scheduler noise from a short kernel).
+_BENCH_ITERS = 3
+
+# Conservative VMEM budget for one grid step's working set (q tile + double-
+# buffered k/v tiles + f32 softmax scratch). v5e cores carry ~16 MB; leave
+# headroom for the pipeline's prefetch margin.
+_VMEM_BUDGET_BYTES = 12 * 2**20
+
+
+# -- heuristic (the pre-tuner behavior, and every fallback) -----------------
+
+
+def heuristic_q_block(t: int, qpk: int) -> int:
+    """Largest power-of-two divisor of t capped at 512 tokens and 2048
+    rows (q rows = tokens * qpk must fit VMEM next to kv + f32 scratch).
+    Verbatim the round-4 `_pick_q_block` rule chunk_flash shipped with."""
+    qb = t
+    for cand in (512, 256, 128, 64, 32, 16):
+        if t > 512 and t % cand == 0:
+            qb = cand
+            break
+    while qb > 16 and qb * qpk > 2048:
+        qb //= 2
+    return qb
+
+
+def heuristic_blocks(t: int, tkv: int, qpk: int) -> tuple[int, int]:
+    """(q_block, kv_block) exactly as the untuned kernel picked them."""
+    return heuristic_q_block(t, qpk), (1024 if tkv > 1024 else tkv)
+
+
+# -- candidate lattice ------------------------------------------------------
+
+
+def _tile_vmem_bytes(rows: int, kv_block: int, hd: int,
+                     dtype_bytes: int) -> int:
+    q_tile = rows * hd * dtype_bytes
+    kv_tiles = 2 * 2 * kv_block * hd * dtype_bytes  # k+v, double-buffered
+    scratch = rows * (2 * 128 + hd) * 4             # m/l/acc in f32
+    out_tile = rows * hd * dtype_bytes
+    return q_tile + kv_tiles + scratch + out_tile
+
+
+def candidate_configs(t: int, tkv: int, hd: int, qpk: int,
+                      dtype_bytes: int = 2) -> list[tuple[int, int]]:
+    """The (q_block, kv_block) lattice the sweep times.
+
+    q_block: power-of-two divisors of t (>= 128 where t allows — smaller q
+    tiles underfill the MXU at serving head dims), bounded by the 2048-row
+    VMEM rule. kv_block: powers of two 256..2048, never more than one pow2
+    step past tkv (the kv pad would otherwise stream mostly masked slots).
+    Every candidate is VMEM-feasible; the heuristic config is always in the
+    list, so the sweep can only match or beat it."""
+    q_cands = [qb for qb in (512, 256, 128, 64, 32, 16)
+               if qb <= t and t % qb == 0 and qb * qpk <= 2048
+               and (qb >= 128 or qb == t)]
+    kv_cap = max(256, 1 << (max(1, tkv) - 1).bit_length())
+    kv_cands = [kb for kb in (2048, 1024, 512, 256) if kb <= kv_cap]
+    out = []
+    for qb in q_cands:
+        for kb in kv_cands:
+            if _tile_vmem_bytes(qb * qpk, kb, hd,
+                                dtype_bytes) <= _VMEM_BUDGET_BYTES:
+                out.append((qb, kb))
+    heur = heuristic_blocks(t, tkv, qpk)
+    if heur not in out:
+        out.append(heur)
+    return out
+
+
+# -- table persistence ------------------------------------------------------
+
+
+def default_cache_path() -> str:
+    """Where warmup-mode sweeps persist their table (tests monkeypatch
+    this; operators pin the file via ATT_FLASH_TUNE=<path> afterwards)."""
+    return os.path.join(tempfile.gettempdir(), "att_flash_tune.json")
+
+
+def _device_key() -> str:
+    try:
+        return str(jax.devices()[0].device_kind).replace(" ", "_")
+    except Exception:
+        return "unknown"
+
+
+def shape_key(t: int, tkv: int, hd: int, qpk: int, prior_len: int) -> str:
+    return f"t{t}_kv{tkv}_hd{hd}_g{qpk}" + ("_prior" if prior_len else "")
+
+
+# -- the tuner --------------------------------------------------------------
+
+
+class FlashTuner:
+    """One tuner per ATT_FLASH_TUNE value (see module docstring)."""
+
+    def __init__(self, mode: str) -> None:
+        self.mode = mode            # "off" | "warmup" | a table path
+        self._table: Optional[dict] = None
+        self.sweeps = 0             # test-visible sweep counter
+
+    def _path(self) -> str:
+        return default_cache_path() if self.mode == "warmup" else self.mode
+
+    def _load(self) -> None:
+        if self._table is not None:
+            return
+        self._table = {}
+        try:
+            with open(self._path(), encoding="utf-8") as f:
+                data = json.load(f)
+            shapes = data.get(_device_key(), {}) if isinstance(data, dict) else {}
+            for k, v in (shapes.items() if isinstance(shapes, dict) else ()):
+                # Only well-typed [q_block, kv_block] int pairs survive; a
+                # corrupt or hand-mangled entry degrades to the heuristic
+                # for that shape instead of crashing serving.
+                if (isinstance(v, (list, tuple)) and len(v) == 2
+                        and all(isinstance(x, int) and x > 0 for x in v)):
+                    self._table[k] = (int(v[0]), int(v[1]))
+        except (OSError, ValueError):
+            pass  # missing/corrupt table file: heuristic (off-path) behavior
+
+    def _persist(self) -> None:
+        """Best-effort atomic rewrite: a read-only cache dir or a lost race
+        must never take down the step that triggered the sweep."""
+        path = self._path()
+        try:
+            try:
+                with open(path, encoding="utf-8") as f:
+                    data = json.load(f)
+                if not isinstance(data, dict):
+                    data = {}
+            except (OSError, ValueError):
+                data = {}
+            dev = data.setdefault(_device_key(), {})
+            if not isinstance(dev, dict):
+                dev = data[_device_key()] = {}
+            dev.update({k: list(v) for k, v in self._table.items()})
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = path + f".tmp{os.getpid()}"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(data, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+
+    def blocks(self, *, t: int, tkv: int, hd: int, qpk: int,
+               prior_len: int = 0, dtype=jnp.bfloat16,
+               interpret: bool = False) -> tuple[int, int]:
+        if self.mode == "off":
+            return heuristic_blocks(t, tkv, qpk)
+        self._load()
+        key = shape_key(t, tkv, hd, qpk, prior_len)
+        got = self._table.get(key)
+        if got is not None:
+            qb, kb = got
+            # A table recorded for a different bucket ladder (or edited by
+            # hand) can hold blocks the kernel cannot tile with or fit in
+            # VMEM; fall back rather than fail the trace — the module
+            # contract is that NO table content crashes serving.
+            if (t % qb == 0 and qb * qpk <= 4096 and 16 <= kb <= 4096
+                    and _tile_vmem_bytes(qb * qpk, kb, hd,
+                                         jnp.dtype(dtype).itemsize)
+                    <= _VMEM_BUDGET_BYTES):
+                return got
+            return heuristic_blocks(t, tkv, qpk)
+        if self.mode != "warmup":
+            return heuristic_blocks(t, tkv, qpk)  # pinned table: no sweeps
+        win = self._sweep(t=t, tkv=tkv, hd=hd, qpk=qpk, prior_len=prior_len,
+                          dtype=dtype, interpret=interpret)
+        self._table[key] = win
+        self._persist()
+        return win
+
+    def _sweep(self, *, t, tkv, hd, qpk, prior_len, dtype,
+               interpret) -> tuple[int, int]:
+        self.sweeps += 1
+        dtype_bytes = jnp.dtype(dtype).itemsize
+        cands = candidate_configs(t, tkv, hd, qpk, dtype_bytes)
+        bench = _bench_fn(t=t, tkv=tkv, hd=hd, qpk=qpk, prior_len=prior_len,
+                          dtype=dtype, interpret=interpret)
+        timed = [(bench(qb, kb), (qb, kb)) for qb, kb in cands]
+        best_t, best = min(timed, key=lambda x: x[0])
+        if not math.isfinite(best_t):
+            return heuristic_blocks(t, tkv, qpk)  # every candidate failed
+        return best
+
+
+def _bench_fn(*, t, tkv, hd, qpk, prior_len, dtype, interpret):
+    """Candidate timer on a representative single-(batch, kv-head) shape:
+    the grid's (b, kh) axes are pure parallel multipliers over identical
+    tiles, so per-tile block choice transfers; sweeping at kh=1 keeps the
+    warmup cost linear in shapes, not head counts."""
+    from agentic_traffic_testing_tpu.ops.pallas import chunk_flash
+
+    q = jnp.zeros((1, t, qpk, hd), dtype)
+    kv = jnp.zeros((1, tkv, 1, hd), dtype)
+
+    def run(qb, kb):
+        if prior_len:
+            return chunk_flash.chunk_flash_attention(
+                q, kv, kv, jnp.int32(prior_len), prior_len=prior_len,
+                q_block=qb, kv_block=kb, interpret=interpret)
+        return chunk_flash.causal_flash_attention(
+            q, kv, kv, q_block=qb, kv_block=kb, interpret=interpret)
+
+    def bench(qb, kb) -> float:
+        try:
+            jax.block_until_ready(run(qb, kb))  # pay the compile outside timing
+            best = math.inf
+            for _ in range(_BENCH_ITERS):
+                t0 = time.perf_counter()
+                jax.block_until_ready(run(qb, kb))
+                best = min(best, time.perf_counter() - t0)
+            return best
+        except Exception:
+            # A candidate Mosaic rejects (or interpret chokes on) simply
+            # loses the sweep; it must never take down serving warmup.
+            return math.inf
+
+    return bench
+
+
+# -- module-level resolution (what the kernels call) ------------------------
+
+_tuners: dict[str, FlashTuner] = {}
+
+
+def get_tuner() -> FlashTuner:
+    mode = os.environ.get("ATT_FLASH_TUNE", "off") or "off"
+    tn = _tuners.get(mode)
+    if tn is None:
+        tn = _tuners[mode] = FlashTuner(mode)
+    return tn
+
+
+def reset() -> None:
+    """Drop every cached tuner/table (tests; harmless in production)."""
+    _tuners.clear()
+
+
+def resolve_blocks(*, t: int, tkv: int, hd: int, qpk: int,
+                   prior_len: int = 0, dtype=jnp.bfloat16,
+                   interpret: bool = False) -> tuple[int, int]:
+    """(q_block, kv_block) for a kernel shape, honoring ATT_FLASH_TUNE."""
+    return get_tuner().blocks(t=t, tkv=tkv, hd=hd, qpk=qpk,
+                              prior_len=prior_len, dtype=dtype,
+                              interpret=interpret)

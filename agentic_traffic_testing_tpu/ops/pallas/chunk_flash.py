@@ -31,19 +31,26 @@ solo/batched site, so the whole flash surface is first-party):
     rule at prior_len = chunk_start = 0, which is what makes one kernel
     body cover both sites.
 
-Grid ([B,] KH, Tq/QB, Tkv/KB): one GQA query tile per (kv head, q block),
-kv streamed in KB-token blocks by the BlockSpec pipeline, online softmax
-in f32 scratch that persists across the innermost kv axis — the same
-pattern as the v1 paged decode kernel. KV blocks with no valid slot for
-their q tile (beyond-diagonal, or entirely inside the gather-tail gap)
+Grid (B, KH, Tq/QB, Tkv/KB): one GQA query tile per (batch row, kv head,
+q block), kv streamed in KB-token blocks by the BlockSpec pipeline, online
+softmax in f32 scratch that persists across the innermost kv axis — the
+same pattern as the v1 paged decode kernel. KV blocks with no valid slot
+for their q tile (beyond-diagonal, or entirely inside the gather-tail gap)
 skip their compute via pl.when — the DMA still streams them, but the MXU
 and softmax passes don't run.
+
+Block sizes (QB, KB) come from ops/pallas/autotune.py (round 6): the
+ATT_FLASH_TUNE table when one is loaded, today's heuristic (largest-pow2
+QB, KB=1024) otherwise; explicit q_block/kv_block arguments pin a config
+for the tuner's sweep and the per-candidate parity tests. Tiling is the
+ONLY thing block sizes change — numerics are identical across configs.
 """
 
 from __future__ import annotations
 
 import functools
 import math
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -130,145 +137,34 @@ def _kernel(start_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref, *,
             o_ref.shape)
 
 
-def _pick_q_block(t: int, qpk: int) -> int:
-    """Largest power-of-two divisor of t capped at 512 tokens and 2048
-    rows (q rows = tokens * qpk must fit VMEM next to kv + f32 scratch)."""
-    qb = t
-    for cand in (512, 256, 128, 64, 32, 16):
-        if t > 512 and t % cand == 0:
-            qb = cand
-            break
-    while qb > 16 and qb * qpk > 2048:
-        qb //= 2
-    return qb
+def _flash_grid_call(chunk_start, q_r, k_r, v_r, *, prior_len: int,
+                     q_block: int, kv_block: int, queries_per_kv: int,
+                     interpret: bool) -> jax.Array:
+    """The one pallas_call both sites share: head-major row tiles
+    q_r [B, KH, R, hd] over kv k_r/v_r [B, KH, Tkv, hd] (Tkv % kv_block
+    == 0 — callers pad). The causal site is prior_len = chunk_start = 0.
 
-
-@functools.partial(jax.jit,
-                   static_argnames=("prior_len", "interpret"))
-def chunk_flash_attention(
-    q: jax.Array,            # [1, C, H, hd] — one sequence's chunk queries
-    kv_k: jax.Array,         # [1, Tkv, KH, hd] — gathered prior ++ chunk K
-    kv_v: jax.Array,         # [1, Tkv, KH, hd]
-    chunk_start: jax.Array,  # scalar i32 — absolute position of q[:, 0]
-    *,
-    prior_len: int,          # static: gathered prior width in tokens (W*bs)
-    interpret: bool = False,
-) -> jax.Array:
-    """Returns [1, C, H, hd]; see module docstring for the validity rule."""
-    _, c, h, hd = q.shape
-    kh = kv_k.shape[2]
-    qpk = h // kh
-    scale = 1.0 / math.sqrt(hd)
-    # Pad kv up to a 1024-token tile: padded slots sit past prior_len with
-    # in-chunk offset >= C > any q token, so the validity mask drops them
-    # for free — no caller-side shape constraints.
-    kv_block = 1024 if kv_k.shape[1] > 1024 else kv_k.shape[1]
-    pad = -kv_k.shape[1] % kv_block
-    if pad:
-        kv_k = jnp.pad(kv_k, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        kv_v = jnp.pad(kv_v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-    tkv = kv_k.shape[1]
-    q_block = _pick_q_block(c, qpk)
-    rows = q_block * qpk
-    # Head-major GQA tiles: [KH, C*qpk, hd], row t*qpk + g = token t, group g.
-    q_r = (q[0].reshape(c, kh, qpk, hd).transpose(1, 0, 2, 3)
-           .reshape(kh, c * qpk, hd))
-    k_r = kv_k[0].transpose(1, 0, 2)                         # [KH, Tkv, hd]
-    v_r = kv_v[0].transpose(1, 0, 2)
-
-    grid = (kh, c // q_block, tkv // kv_block)
-
-    # Clamp beyond-diagonal kv blocks (fully invalid: past the prior
-    # region AND past this q tile's last in-chunk row) to the diagonal so
-    # the Mosaic pipeline elides their re-fetch — the compute skip in the
-    # kernel already ignores them. The dynamic gather-tail gap
-    # [chunk_start, prior_len) stays streamed: it is at most one bucket
-    # step wide and its bound is a traced scalar.
-    def kv_index(kh_, qb, kb, s):
-        last_valid = (prior_len + (qb + 1) * q_block - 1) // kv_block
-        return (kh_, jnp.minimum(kb, last_valid), 0)
-
-    out = pl.pallas_call(
-        functools.partial(
-            _kernel, scale=scale, prior_len=prior_len, kv_block=kv_block,
-            q_block=q_block, queries_per_kv=qpk, q_axis=1),
-        grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=1,
-            grid=grid,
-            in_specs=[
-                pl.BlockSpec((1, rows, hd), lambda kh_, qb, kb, s: (kh_, qb, 0)),
-                pl.BlockSpec((1, kv_block, hd), kv_index),
-                pl.BlockSpec((1, kv_block, hd), kv_index),
-            ],
-            out_specs=pl.BlockSpec((1, rows, hd),
-                                   lambda kh_, qb, kb, s: (kh_, qb, 0)),
-            scratch_shapes=[
-                pltpu.VMEM((rows, 128), jnp.float32),
-                pltpu.VMEM((rows, 128), jnp.float32),
-                pltpu.VMEM((rows, hd), jnp.float32),
-            ],
-        ),
-        out_shape=jax.ShapeDtypeStruct((kh, c * qpk, hd), q.dtype),
-        compiler_params=CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
-        ),
-        interpret=interpret,
-    )(jnp.asarray(chunk_start, jnp.int32).reshape(1), q_r, k_r, v_r)
-    # [KH, C*qpk, hd] -> [1, C, H, hd]
-    return (out.reshape(kh, c, qpk, hd).transpose(1, 0, 2, 3)
-            .reshape(1, c, h, hd))
-
-
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def causal_flash_attention(
-    q: jax.Array,            # [B, T, H, hd]
-    k: jax.Array,            # [B, T, KH, hd]
-    v: jax.Array,            # [B, T, KH, hd]
-    *,
-    interpret: bool = False,
-) -> jax.Array:
-    """Plain causal flash attention for the solo/batched prefill site.
-
-    Same kernel body as the chunked site at prior_len = chunk_start = 0
-    (the two-region rule degenerates to kv_pos <= q_tok), batched by a
-    leading grid axis. Contiguity contract as in ops/flash_prefill.py:
-    positions run from 0, padding only at the tail, so causality alone is
-    exact — no kv_valid_len needed. Returns [B, T, H, hd].
+    Beyond-diagonal kv blocks are fully masked (the kernel skips their
+    compute); CLAMP their block index to the diagonal so consecutive grid
+    steps map to the same block and the Mosaic pipeline elides the
+    re-fetch — without this the kernel streams ~2x the causal KV bytes.
+    The dynamic gather-tail gap [chunk_start, prior_len) stays streamed:
+    it is at most one bucket step wide and its bound is a traced scalar.
     """
-    b, t, h, hd = q.shape
-    kh = k.shape[2]
-    qpk = h // kh
+    b, kh, r, hd = q_r.shape
+    rows = q_block * queries_per_kv
+    tkv = k_r.shape[2]
     scale = 1.0 / math.sqrt(hd)
-    kv_block = 1024 if t > 1024 else t
-    pad = -t % kv_block
-    if pad:
-        # Padded kv slots land at positions >= t > any q token: masked by
-        # causality for free.
-        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
-    tkv = k.shape[1]
-    q_block = _pick_q_block(t, qpk)
-    rows = q_block * qpk
-    # Head-major GQA tiles: [B, KH, T*qpk, hd].
-    q_r = (q.reshape(b, t, kh, qpk, hd).transpose(0, 2, 1, 3, 4)
-           .reshape(b, kh, t * qpk, hd))
-    k_r = k.transpose(0, 2, 1, 3)                            # [B, KH, Tkv, hd]
-    v_r = v.transpose(0, 2, 1, 3)
+    grid = (b, kh, r // rows, tkv // kv_block)
 
-    grid = (b, kh, t // q_block, tkv // kv_block)
-
-    # Beyond-diagonal kv blocks are fully masked (the kernel skips their
-    # compute); CLAMP their block index to the diagonal so consecutive
-    # grid steps map to the same block and the Mosaic pipeline elides the
-    # re-fetch — without this the kernel streams ~2x the causal KV bytes.
     def kv_index(b_, kh_, qb, kb, s):
-        last_valid = ((qb + 1) * q_block - 1) // kv_block
+        last_valid = (prior_len + (qb + 1) * q_block - 1) // kv_block
         return (b_, kh_, jnp.minimum(kb, last_valid), 0)
 
-    out = pl.pallas_call(
+    return pl.pallas_call(
         functools.partial(
-            _kernel, scale=scale, prior_len=0, kv_block=kv_block,
-            q_block=q_block, queries_per_kv=qpk, q_axis=2),
+            _kernel, scale=scale, prior_len=prior_len, kv_block=kv_block,
+            q_block=q_block, queries_per_kv=queries_per_kv, q_axis=2),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=grid,
@@ -286,13 +182,112 @@ def causal_flash_attention(
                 pltpu.VMEM((rows, hd), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((b, kh, t * qpk, hd), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((b, kh, r, hd), q_r.dtype),
         compiler_params=CompilerParams(
             dimension_semantics=("parallel", "parallel", "parallel",
                                  "arbitrary"),
         ),
         interpret=interpret,
-    )(jnp.zeros((1,), jnp.int32), q_r, k_r, v_r)
+    )(jnp.asarray(chunk_start, jnp.int32).reshape(1), q_r, k_r, v_r)
+
+
+def _resolve(t: int, tkv: int, hd: int, qpk: int, prior_len: int, dtype,
+             q_block, kv_block, interpret: bool) -> tuple[int, int]:
+    """Block sizes for a site: explicit args pin a config (the autotuner's
+    sweep and the parity tests); otherwise the ATT_FLASH_TUNE resolution
+    (ops/pallas/autotune.py — tuned table, or the round-4 heuristic)."""
+    if q_block is not None and kv_block is not None:
+        return q_block, kv_block
+    from agentic_traffic_testing_tpu.ops.pallas.autotune import resolve_blocks
+
+    return resolve_blocks(t=t, tkv=tkv, hd=hd, qpk=qpk, prior_len=prior_len,
+                          dtype=dtype, interpret=interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("prior_len", "q_block", "kv_block",
+                                    "interpret"))
+def chunk_flash_attention(
+    q: jax.Array,            # [B, C, H, hd] — per-row chunk queries
+    kv_k: jax.Array,         # [B, Tkv, KH, hd] — gathered prior ++ chunk K
+    kv_v: jax.Array,         # [B, Tkv, KH, hd]
+    chunk_start: jax.Array,  # scalar i32 — absolute position of q[:, 0]
+    *,
+    prior_len: int,          # static: gathered prior width in tokens (W*bs)
+    q_block: Optional[int] = None,   # static; None -> autotune/heuristic
+    kv_block: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Returns [B, C, H, hd]; see module docstring for the validity rule.
+
+    B = 1 is the serial chunked-prefill site; the pipelined-prefill path
+    (models/llama.prefill_pipeline_impl) batches rows — every row shares
+    the same chunk_start (uniform position-chunks), which is what lets one
+    scalar prefetch serve the whole batch."""
+    b, c, h, hd = q.shape
+    kh = kv_k.shape[2]
+    qpk = h // kh
+    q_block, kv_block = _resolve(c, kv_k.shape[1], hd, qpk, prior_len,
+                                 q.dtype, q_block, kv_block, interpret)
+    # Pad kv up to a kv_block tile: padded slots sit past prior_len with
+    # in-chunk offset >= C > any q token, so the validity mask drops them
+    # for free — no caller-side shape constraints.
+    pad = -kv_k.shape[1] % kv_block
+    if pad:
+        kv_k = jnp.pad(kv_k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        kv_v = jnp.pad(kv_v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # Head-major GQA tiles: [B, KH, C*qpk, hd], row t*qpk + g = token t,
+    # group g.
+    q_r = (q.reshape(b, c, kh, qpk, hd).transpose(0, 2, 1, 3, 4)
+           .reshape(b, kh, c * qpk, hd))
+    k_r = kv_k.transpose(0, 2, 1, 3)                       # [B, KH, Tkv, hd]
+    v_r = kv_v.transpose(0, 2, 1, 3)
+    out = _flash_grid_call(chunk_start, q_r, k_r, v_r, prior_len=prior_len,
+                           q_block=q_block, kv_block=kv_block,
+                           queries_per_kv=qpk, interpret=interpret)
+    # [B, KH, C*qpk, hd] -> [B, C, H, hd]
+    return (out.reshape(b, kh, c, qpk, hd).transpose(0, 2, 1, 3, 4)
+            .reshape(b, c, h, hd))
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("q_block", "kv_block", "interpret"))
+def causal_flash_attention(
+    q: jax.Array,            # [B, T, H, hd]
+    k: jax.Array,            # [B, T, KH, hd]
+    v: jax.Array,            # [B, T, KH, hd]
+    *,
+    q_block: Optional[int] = None,   # static; None -> autotune/heuristic
+    kv_block: Optional[int] = None,
+    interpret: bool = False,
+) -> jax.Array:
+    """Plain causal flash attention for the solo/batched prefill site.
+
+    Same kernel body as the chunked site at prior_len = chunk_start = 0
+    (the two-region rule degenerates to kv_pos <= q_tok), batched by a
+    leading grid axis. Contiguity contract as in ops/flash_prefill.py:
+    positions run from 0, padding only at the tail, so causality alone is
+    exact — no kv_valid_len needed. Returns [B, T, H, hd].
+    """
+    b, t, h, hd = q.shape
+    kh = k.shape[2]
+    qpk = h // kh
+    q_block, kv_block = _resolve(t, t, hd, qpk, 0, q.dtype, q_block,
+                                 kv_block, interpret)
+    pad = -t % kv_block
+    if pad:
+        # Padded kv slots land at positions >= t > any q token: masked by
+        # causality for free.
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0), (0, 0)))
+    # Head-major GQA tiles: [B, KH, T*qpk, hd].
+    q_r = (q.reshape(b, t, kh, qpk, hd).transpose(0, 2, 1, 3, 4)
+           .reshape(b, kh, t * qpk, hd))
+    k_r = k.transpose(0, 2, 1, 3)                            # [B, KH, Tkv, hd]
+    v_r = v.transpose(0, 2, 1, 3)
+    out = _flash_grid_call(jnp.int32(0), q_r, k_r, v_r, prior_len=0,
+                           q_block=q_block, kv_block=kv_block,
+                           queries_per_kv=qpk, interpret=interpret)
     # [B, KH, T*qpk, hd] -> [B, T, H, hd]
     return (out.reshape(b, kh, t, qpk, hd).transpose(0, 2, 1, 3, 4)
             .reshape(b, t, h, hd))

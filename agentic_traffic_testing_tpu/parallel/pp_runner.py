@@ -247,6 +247,7 @@ class PPRunner(ModelRunner):
     supports_chunked_prefill = False   # no staged chunk jit (and no prefix
     #                                    caching): engine refuses at build
     supports_hybrid = False            # no staged hybrid jit either
+    supports_prefill_pipeline = False  # no staged pipelined-chunk jit
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

@@ -61,8 +61,10 @@ class SPPrefillRunner(ModelRunner):
     # an operator chunks deliberately.
     chunk_attn_mode = "ring_sp"
     supports_chunked_prefill = True
-    # No mesh wrapper for the ragged hybrid step (see TPRunner).
+    # No mesh wrapper for the ragged hybrid step (see TPRunner), nor for
+    # the pipelined-prefill chunk jit; engine refuses both knobs at build.
     supports_hybrid = False
+    supports_prefill_pipeline = False
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,
@@ -132,6 +134,7 @@ class SPTPRunner(TPRunner):
     prefill_attn_mode = "ring_sp"
     chunk_attn_mode = "ring_sp"   # chunk-ring hybrid, heads tp-sharded
     supports_chunked_prefill = True
+    supports_prefill_pipeline = False  # see SPPrefillRunner
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

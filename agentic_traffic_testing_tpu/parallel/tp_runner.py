@@ -59,6 +59,9 @@ class TPRunner(ModelRunner):
     # under tp would all-gather the head-sharded pool. Engine refuses the
     # hybrid_token_budget knob at build instead of degrading silently.
     supports_hybrid = False
+    # No sharded wrapper for the pipelined-prefill chunk jit either; the
+    # engine refuses prefill_pipeline_chunks >= 2 at build.
+    supports_prefill_pipeline = False
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

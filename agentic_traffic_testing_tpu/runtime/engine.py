@@ -105,6 +105,19 @@ class EngineConfig:
     # XLA compile; pair with warmup_prefill_buckets() so a burst never
     # compiles mid-traffic.
     prefill_batch_max_len: Optional[int] = None
+    # Pipelined prefill (round 6 — the prefill-MFU-0.13 dispatch half):
+    # split solo/batched prefills into up to this many position-chunks and
+    # dispatch them back-to-back with NO host synchronization — chunk
+    # i+1's dispatch rides the device queue while chunk i computes, so the
+    # ~0.1 s axon-tunnel dispatch overhead amortizes to one chunk's worth,
+    # with donated carry buffers and a single first-token readback at the
+    # tail. 0/1 (default 0) keeps the single-dispatch path bit-identical;
+    # on, outputs are token-identical and KV pages byte-identical
+    # (tests/test_prefill_pipeline.py pins both). Chunks reuse the chunked
+    # -prefill model impl, so one compiled program serves every chunk of a
+    # bucket. Single-chip runners only; refused with speculation (the
+    # spec prefill needs its synchronous first-token readback).
+    prefill_pipeline_chunks: int = 0
     # Hybrid prefill+decode batching (Sarathi-style chunked piggyback over
     # the ragged Pallas kernel): when > 0, a pending prefill chunk and the
     # decode batch fuse into ONE ragged dispatch whose padded token total
@@ -184,6 +197,17 @@ class EngineConfig:
         if self.hybrid_token_budget < 0:
             raise ValueError(
                 f"hybrid_token_budget must be >= 0, got {self.hybrid_token_budget}")
+        if self.prefill_pipeline_chunks < 0:
+            raise ValueError(
+                f"prefill_pipeline_chunks must be >= 0, "
+                f"got {self.prefill_pipeline_chunks}")
+        if self.prefill_pipeline_chunks > 1 and self.speculation:
+            # The speculative prefill reads its first token synchronously
+            # to seed the device-resident n-gram history; a pipelined
+            # prefill's whole point is NOT synchronizing until the tail.
+            raise ValueError(
+                "prefill_pipeline_chunks x speculation is not wired — "
+                "disable one of them")
         if self.host_cache_gb < 0:
             raise ValueError(
                 f"host_cache_gb must be >= 0, got {self.host_cache_gb}")
@@ -209,9 +233,21 @@ class EngineConfig:
         return self.spec_tokens if self.speculation == "ngram" else 0
 
     def resolved_decode_steps(self, platform: str) -> int:
+        """Fused decode steps per dispatch when LLM_DECODE_STEPS is unset.
+
+        Auto now SCALES WITH BATCH on TPU (ROADMAP item 2, round 6): at
+        bs32 the per-dispatch host work (table refresh, readback
+        bookkeeping) grows with B while per-step device time stays
+        weight-streaming-bound, so a larger K amortizes the growing host
+        term over more tokens — bench measured bs8 flat across K=16/32/64
+        but bs32 losing roofline fraction at K=16. Fused-K output stays
+        token-identical to K single steps (tests/test_multistep_decode.py
+        pins the parity at the bs32 auto value)."""
         if self.decode_steps is not None:
             return max(1, self.decode_steps)
-        return 16 if platform == "tpu" else 1
+        if platform != "tpu":
+            return 1
+        return 32 if self.max_num_seqs >= 32 else 16
 
     def scheduler_config(self, decode_steps: int = 1) -> SchedulerConfig:
         # Lookahead must cover every KV write a lagged in-flight dispatch can
@@ -354,6 +390,14 @@ class LLMEngine:
                 f"{type(self.runner).__name__} does not support the fused "
                 f"hybrid prefill+decode path — build the engine with "
                 f"hybrid_token_budget=0")
+        if cfg.prefill_pipeline_chunks > 1 and not getattr(
+                self.runner, "supports_prefill_pipeline", False):
+            # Same rule as hybrid: the mesh runners have no sharded wrapper
+            # for the pipelined-prefill chunk jit.
+            raise ValueError(
+                f"{type(self.runner).__name__} does not support the "
+                f"pipelined-prefill path — build the engine with "
+                f"prefill_pipeline_chunks=0 (unset LLM_PREFILL_PIPELINE)")
 
         num_blocks = cfg.num_blocks or self._default_num_blocks()
         kv_dtype = (jnp.float8_e4m3fn if cfg.kv_cache_dtype in ("fp8", "fp8_e4m3")
@@ -408,6 +452,9 @@ class LLMEngine:
             else pow2_buckets(4, self.table_width))
 
         self._inflight: deque[_Inflight] = deque()
+        # Pipelined-prefill chunk dispatches issued (cumulative; the
+        # llm_prefill_pipeline_dispatches_total gauge).
+        self.num_pipeline_dispatches = 0
         # Memoized SamplingArrays keyed by the (padded, per-lane params)
         # composition: recurring waves of identical generation params (the
         # bench shape, and any steady fan-out traffic) reuse the uploaded
@@ -559,6 +606,20 @@ class LLMEngine:
                 tables = jnp.full((b, self.table_width), TRASH_BLOCK, jnp.int32)
                 seq_lens = jnp.ones((b,), jnp.int32)
                 samp = self._sampling_arrays([], b)
+                split = self._pipeline_split(t)
+                if split is not None:
+                    # Pipelined path live: warm ITS program for this
+                    # bucket (one chunk suffices — chunk_start is traced,
+                    # so every chunk of the bucket shares the compile).
+                    width = bucket_up(-(-t // self.cfg.block_size),
+                                      self._chunk_width_buckets)
+                    self.cache, carry = self.runner.prefill_pipeline(
+                        tokens[:, :split], self.cache, tables[:, :width],
+                        jnp.int32(0), seq_lens, jnp.zeros((b,), jnp.int32),
+                        samp, jnp.zeros((b,), jnp.int32))
+                    jax.block_until_ready(carry)
+                    n += 1
+                    continue
                 state, self.cache, out = self.runner.prefill(
                     tokens, self.cache, tables, seq_lens, samp,
                     jnp.zeros((b,), jnp.int32))
@@ -734,7 +795,31 @@ class LLMEngine:
 
     # -- prefill -----------------------------------------------------------
 
-    def _run_prefill(self, plan: PrefillBatch) -> None:
+    def _pipeline_split(self, t: int) -> Optional[int]:
+        """Chunk length for the pipelined-prefill path at padded length t,
+        or None for the single-dispatch path.
+
+        Splits t into the most chunks <= prefill_pipeline_chunks that keep
+        every chunk equal-length AND block-aligned (uniform chunks are what
+        let one compiled program — chunk_start is traced — serve the whole
+        prefill; a ragged tail chunk would be a second program AND could
+        page-write past the table). Serving buckets are pow2/block-aligned,
+        so K = 2..8 always splits cleanly above 2 blocks; shapes that
+        don't split fall back to the single dispatch, which is always
+        correct."""
+        k = self.cfg.prefill_pipeline_chunks
+        if k < 2 or getattr(self.runner, "spec_tokens", 0) > 0:
+            return None
+        bs = self.cfg.block_size
+        for kk in range(min(k, t // bs), 1, -1):
+            if t % kk == 0 and (t // kk) % bs == 0:
+                return t // kk
+        return None
+
+    def _prefill_host_arrays(self, plan: PrefillBatch):
+        """Host-side batch assembly shared by the single-dispatch and
+        pipelined prefill paths: (tokens [B, T], seq_lens [B], full-width
+        tables [B, W], sampling steps [B]) as numpy arrays."""
         reqs = plan.requests
         b, t = plan.padded_batch, plan.padded_len
         tokens = np.zeros((b, t), np.int32)
@@ -746,6 +831,16 @@ class LLMEngine:
             seq_lens[i] = r.num_prompt_tokens
             steps[i] = r.sampling_step
         self._fill_tables(reqs, tables)
+        return tokens, seq_lens, tables, steps
+
+    def _run_prefill(self, plan: PrefillBatch) -> None:
+        split = self._pipeline_split(plan.padded_len)
+        if split is not None:
+            self._run_prefill_pipelined(plan, split)
+            return
+        reqs = plan.requests
+        b = plan.padded_batch
+        tokens, seq_lens, tables, steps = self._prefill_host_arrays(plan)
         tables_dev = jnp.asarray(tables)
         samp = self._sampling_arrays(reqs, b)
         state, self.cache, out = self.runner.prefill(
@@ -779,6 +874,57 @@ class LLMEngine:
             pass
         self._decode_requests = list(reqs)
         self._decode_state = state
+        self._decode_tables = tables_dev
+        self._decode_samp = samp
+        self._decode_block_counts = [r.blocks.num_blocks for r in reqs]
+        self._inflight.append(_Inflight(first, list(reqs)))
+
+    def _run_prefill_pipelined(self, plan: PrefillBatch, c: int) -> None:
+        """The round-6 dispatch-overlap path: K = T/c position-chunks of
+        the (solo or batched) prefill dispatched back-to-back with NO host
+        synchronization — chunk i+1's host-side dispatch (and its tunnel
+        transfer) overlaps chunk i's device compute, so the per-dispatch
+        overhead is paid once, not K times, and the whole prompt still
+        reads back exactly ONE [B] token array at the tail. The sampled
+        first token rides a donated device carry across chunks
+        (runner.prefill_pipeline); the decode handoff below is identical
+        to _run_prefill's async path."""
+        reqs = plan.requests
+        b, t = plan.padded_batch, plan.padded_len
+        tokens, seq_lens, tables, steps = self._prefill_host_arrays(plan)
+        from agentic_traffic_testing_tpu.runtime.scheduler import bucket_up
+
+        # The chunk impl gathers prior pages over the width it is given
+        # (as in _run_chunk): bound it to the bucket covering this prompt.
+        need_cols = -(-t // self.cfg.block_size)
+        width = bucket_up(need_cols, self._chunk_width_buckets)
+        chunk_tables = jnp.asarray(tables[:, :width])
+        tables_dev = jnp.asarray(tables)   # full width for the decode handoff
+        samp = self._sampling_arrays(reqs, b)
+        seq_dev = jnp.asarray(seq_lens)
+        steps_dev = jnp.asarray(steps)
+        tokens_dev = jnp.asarray(tokens)   # ONE host upload; chunks slice on device
+        carry = jnp.zeros((b,), jnp.int32)
+        for start in range(0, t, c):
+            self.cache, carry = self.runner.prefill_pipeline(
+                tokens_dev[:, start:start + c], self.cache, chunk_tables,
+                jnp.int32(start), seq_dev, carry, samp, steps_dev,
+            )
+            self.num_pipeline_dispatches += 1
+        for r in reqs:
+            r.num_computed_tokens = r.num_prompt_tokens
+            self._register_prefix(r)
+        # Tail: same async prefill -> decode handoff as _run_prefill (the
+        # speculation branch is unreachable — config refuses the combo and
+        # _pipeline_split checks the runner).
+        first = carry[:, None]
+        try:
+            first.copy_to_host_async()
+        except Exception:
+            pass
+        self._decode_requests = list(reqs)
+        self._decode_state = DecodeState(tokens=carry, positions=seq_dev,
+                                         steps=steps_dev + 1)
         self._decode_tables = tables_dev
         self._decode_samp = samp
         self._decode_block_counts = [r.blocks.num_blocks for r in reqs]

@@ -29,6 +29,7 @@ from agentic_traffic_testing_tpu.models.llama import (
     hybrid_step_impl,
     prefill_chunk_impl,
     prefill_impl,
+    prefill_pipeline_impl,
     verify_step_impl,
 )
 from agentic_traffic_testing_tpu.ops.sampling import make_row_keys, sample
@@ -101,6 +102,31 @@ def _prefill_chunk_sample_impl(params, cfg: ModelConfig, tokens, cache,
     keys = make_row_keys(samp.seeds, steps)
     out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
     return cache, out
+
+
+def _prefill_pipeline_sample_impl(params, cfg: ModelConfig, tokens, cache,
+                                  block_tables, chunk_start, seq_lens, carry,
+                                  samp: SamplingArrays, steps,
+                                  kv_writer_mode=None, attn_mode=None):
+    """One position-chunk of a pipelined prefill + carry-merged sampling.
+
+    Every chunk samples its per-row logits with the SAME (seed, step) keys
+    the single-dispatch prefill would use, then merges into `carry` only
+    the rows whose last real token fell inside this chunk — so after the
+    final chunk, `carry` holds exactly the tokens the fused prefill+sample
+    dispatch would have produced, with zero host synchronization between
+    chunks (engine reads `carry` back once, at the tail). `cache` and
+    `carry` are donated: the K dispatches chain device-side buffers.
+    """
+    logits, cache = prefill_pipeline_impl(
+        params, cfg, tokens, cache, block_tables, chunk_start, seq_lens,
+        kv_writer_mode=kv_writer_mode, attn_mode=attn_mode)
+    keys = make_row_keys(samp.seeds, steps)
+    out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
+    c = tokens.shape[1]
+    last = seq_lens - 1
+    mine = jnp.logical_and(last >= chunk_start, last < chunk_start + c)
+    return cache, jnp.where(mine, out, carry)
 
 
 def _hybrid_sample_impl(params, cfg: ModelConfig, dec_tokens, chunk_tokens,
@@ -241,6 +267,12 @@ class ModelRunner:
                     attn_mode=self.hybrid_attn_mode),
             donate_argnames=("cache",),
         )
+        self._prefill_pipeline = jax.jit(
+            partial(_prefill_pipeline_sample_impl, cfg=cfg,
+                    kv_writer_mode=self.kv_writer_mode,
+                    attn_mode=self.pipeline_attn_mode),
+            donate_argnames=("cache", "carry"),
+        )
         if self.spec_tokens > 0:
             self._decode = jax.jit(
                 partial(_spec_decode_sample_impl, cfg=cfg,
@@ -295,6 +327,16 @@ class ModelRunner:
     #: would all-gather the head-sharded pool (parallel/ runners set
     #: False).
     supports_hybrid: bool = True
+    #: attention mode baked into the pipelined-prefill chunk jit (None =
+    #: auto: flash on TPU / jnp oracle; no mesh mode exists — see below)
+    pipeline_attn_mode: Optional[str] = None
+    #: whether this runner serves the engine's pipelined-prefill path
+    #: (prefill_pipeline_chunks >= 2). The mesh runners don't: their
+    #: prefill parallelism (ring sp, staged pp, head-sharded tp) has no
+    #: pipelined-chunk wrapper yet, and silently running the single-chip
+    #: jit replicated would serve the knob's name without its meaning
+    #: (parallel/ runners set False).
+    supports_prefill_pipeline: bool = True
 
     def prepare_cache(self, cache: KVCache) -> KVCache:
         """Hook for placing a freshly allocated cache (TP runner shards it)."""
@@ -313,6 +355,20 @@ class ModelRunner:
             self.params, tokens=tokens, cache=cache, block_tables=block_tables,
             chunk_start=chunk_start, chunk_len=chunk_len, samp=samp, steps=steps,
         )
+
+    def prefill_pipeline(self, tokens, cache, block_tables, chunk_start,
+                         seq_lens, carry, samp, steps):
+        """One position-chunk of a pipelined prefill -> (cache, carry).
+
+        `carry` [B] i32 accumulates each row's sampled first token (merged
+        on the chunk containing the row's last real token); `chunk_start`
+        is a traced scalar, so all K chunks of a (batch, chunk) bucket
+        share ONE compiled program. cache and carry are donated — the
+        engine dispatches chunks back-to-back and reads carry once."""
+        return self._prefill_pipeline(
+            self.params, tokens=tokens, cache=cache,
+            block_tables=block_tables, chunk_start=chunk_start,
+            seq_lens=seq_lens, carry=carry, samp=samp, steps=steps)
 
     def hybrid(self, dec_tokens, chunk_tokens, cache, block_tables,
                positions, chunk_start, chunk_len, samp, steps):

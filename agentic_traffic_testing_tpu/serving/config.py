@@ -75,6 +75,13 @@ class ServerConfig:
     # bursts (one weight-streaming pass instead of solo prefills); warmup
     # then precompiles every (batch, length) bucket <= the cap at startup.
     prefill_batch_max_len: Optional[int] = None  # LLM_PREFILL_BATCH_MAX_LEN
+    # Pipelined prefill (round 6): split solo/batched prefills into up to
+    # this many position-chunks dispatched back-to-back with no host sync,
+    # amortizing the per-dispatch tunnel overhead to one chunk's worth
+    # (runtime/engine.py _run_prefill_pipelined). 0 (default) keeps the
+    # single-dispatch prefill bit-identical; single-chip runners only
+    # (tp/sp/pp refuse at engine build), not wired with LLM_SPECULATION.
+    prefill_pipeline_chunks: int = 0           # LLM_PREFILL_PIPELINE
     prefix_caching: bool = False               # LLM_PREFIX_CACHING
     # Host-RAM second tier for the prefix cache (runtime/kv_offload.py):
     # GB of host memory for evicted prefix blocks; restored device-side on
@@ -159,6 +166,14 @@ class ServerConfig:
             os.environ.get("LLM_PREFILL_CHUNK_TOKENS") or c.prefill_chunk_tokens)
         pbml = os.environ.get("LLM_PREFILL_BATCH_MAX_LEN")
         c.prefill_batch_max_len = int(pbml) if pbml else None
+        c.prefill_pipeline_chunks = int(
+            os.environ.get("LLM_PREFILL_PIPELINE")
+            or c.prefill_pipeline_chunks)
+        if c.prefill_pipeline_chunks < 0:
+            raise ValueError(
+                f"LLM_PREFILL_PIPELINE must be >= 0, got "
+                f"{c.prefill_pipeline_chunks} (unset it for the "
+                f"single-dispatch prefill)")
         c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
         c.host_cache_gb = float(
             os.environ.get("LLM_HOST_CACHE_GB") or c.host_cache_gb)
@@ -220,6 +235,10 @@ class ServerConfig:
                        default=c.prefill_chunk_tokens)
         p.add_argument("--prefill-batch-max-len", type=int,
                        default=c.prefill_batch_max_len)
+        p.add_argument("--prefill-pipeline-chunks", type=int,
+                       default=c.prefill_pipeline_chunks,
+                       help="pipelined-prefill position-chunk count "
+                            "(0 = single-dispatch prefill)")
         p.add_argument("--enable-prefix-caching", dest="prefix_caching",
                        action="store_true", default=c.prefix_caching)
         p.add_argument("--host-cache-gb", type=float, default=c.host_cache_gb,
@@ -241,7 +260,8 @@ class ServerConfig:
                   "temperature", "host", "port", "tp_size", "num_replicas",
                   "router_policy", "quantization",
                   "decode_steps", "prefill_chunk_tokens",
-                  "prefill_batch_max_len", "prefix_caching",
+                  "prefill_batch_max_len", "prefill_pipeline_chunks",
+                  "prefix_caching",
                   "host_cache_gb", "hybrid_token_budget",
                   "num_blocks", "block_size", "weights_path",
                   "speculation", "spec_tokens", "spec_ngram"):

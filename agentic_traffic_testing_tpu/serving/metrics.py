@@ -79,6 +79,17 @@ class LLMMetrics:
         self.config_num_replicas = Gauge(
             f"{prefix}_config_num_replicas",
             "Data-parallel replica count (LLM_NUM_REPLICAS)", registry=r)
+        self.config_prefill_pipeline_chunks = Gauge(
+            f"{prefix}_config_prefill_pipeline_chunks",
+            "Pipelined-prefill position-chunk count (LLM_PREFILL_PIPELINE; "
+            "0 = single-dispatch prefill)", registry=r)
+        # Additive (no reference analog): pipelined-prefill activity. Stays
+        # 0 unless LLM_PREFILL_PIPELINE >= 2 routes prefills through the
+        # chunk-dispatch path (runtime/engine.py _run_prefill_pipelined).
+        self.prefill_pipeline_dispatches = Gauge(
+            f"{prefix}_prefill_pipeline_dispatches_total",
+            "Pipelined-prefill chunk dispatches issued (cumulative)",
+            registry=r)
         # Per-replica labeled series exist ONLY under a replica pool: at
         # num_replicas=1 no replica-labeled family appears (the one
         # addition to the single-engine payload is the config gauge above).
@@ -246,6 +257,11 @@ class LLMMetrics:
             self.replica_prefix_hits.labels(replica=label).set(
                 stats.get("prefix_cache_hit_tokens", 0))
 
+    def set_prefill_pipeline_stats(self, *, dispatches: int) -> None:
+        """Refresh the pipelined-prefill dispatch counter (called on
+        scrape; stays 0 while the knob is off)."""
+        self.prefill_pipeline_dispatches.set(dispatches)
+
     def set_spec_stats(self, *, emitted: int, iters: int) -> None:
         """Refresh speculation-acceptance gauges (called on scrape; zeros
         until a speculative engine has decoded something)."""
@@ -268,7 +284,8 @@ class LLMMetrics:
     def set_config_gauges(self, *, max_num_seqs: int, max_num_batched_tokens: int,
                           memory_utilization: float, max_tokens: int,
                           tp_size: int = 1, sp_size: int = 1,
-                          pp_size: int = 1, num_replicas: int = 1) -> None:
+                          pp_size: int = 1, num_replicas: int = 1,
+                          prefill_pipeline_chunks: int = 0) -> None:
         # max_num_seqs/max_num_batched_tokens stay PER-REPLICA values (the
         # configured knob, a config snapshot — docs/monitoring.md); the
         # pool-wide seat count is num_replicas * max_num_seqs.
@@ -280,6 +297,7 @@ class LLMMetrics:
         self.config_sp_size.set(sp_size)
         self.config_pp_size.set(pp_size)
         self.config_num_replicas.set(num_replicas)
+        self.config_prefill_pipeline_chunks.set(prefill_pipeline_chunks)
 
     def set_kv_gauges(self, *, num_blocks: int, block_size: int,
                       max_model_len: int, max_num_seqs: int) -> None:

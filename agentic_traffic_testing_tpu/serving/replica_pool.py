@@ -192,6 +192,10 @@ class EnginePool:
         return sum(e.spec_iters for e in self.engines)
 
     @property
+    def num_pipeline_dispatches(self) -> int:
+        return sum(e.num_pipeline_dispatches for e in self.engines)
+
+    @property
     def usable_tokens(self) -> int:
         return sum(e.cache.usable_tokens for e in self.engines)
 

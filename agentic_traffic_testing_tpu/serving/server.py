@@ -178,6 +178,7 @@ class LLMServer:
                 sp_size=cfg.sp_size,
                 pp_size=cfg.pp_size,
                 num_replicas=cfg.num_replicas,
+                prefill_pipeline_chunks=cfg.prefill_pipeline_chunks,
             )
             if self.pool is not None:
                 # Pool aggregate under the EXACT pre-pool names: blocks and
@@ -218,6 +219,7 @@ class LLMServer:
             decode_steps=c.decode_steps, quantization=c.quantization,
             prefill_chunk_tokens=c.prefill_chunk_tokens,
             prefill_batch_max_len=c.prefill_batch_max_len,
+            prefill_pipeline_chunks=c.prefill_pipeline_chunks,
             prefix_caching=c.prefix_caching,
             host_cache_gb=c.host_cache_gb,
             hybrid_token_budget=c.hybrid_token_budget,
@@ -517,6 +519,8 @@ class LLMServer:
         self.metrics.set_host_cache_stats(kv)
         self.metrics.set_spec_stats(emitted=source.spec_emitted,
                                     iters=source.spec_iters)
+        self.metrics.set_prefill_pipeline_stats(
+            dispatches=getattr(source, "num_pipeline_dispatches", 0))
         if self.pool is not None:
             self.metrics.set_replica_stats(self.pool.replica_stats())
         return web.Response(body=self.metrics.render(),

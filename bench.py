@@ -44,6 +44,17 @@ testbed generates (BASELINE.md §2 "Fan-out workload"):
      over `BENCH_REPS` (default 3) repetitions — single-run numbers
      through the axon tunnel drift ±10-20%.
 
+A best-effort prefill-anatomy probe (round 6) decomposes the solo-prefill
+wall into host/tunnel dispatch vs device compute (timed re-dispatch of the
+already-compiled step, back-to-back dispatch amortization for the device
+term) and reports per-phase seconds plus the recomputed device-side MFU
+(prefill_dispatch_s / prefill_device_s / prefill_device_est_mfu), a
+tuned-vs-heuristic flash-block kernel A/B (prefill_flash_* keys,
+ATT_FLASH_TUNE), and — BENCH_PREFILL_PIPELINE chunks, default 4 on TPU —
+the pipelined-prefill TTFT (prefill_pipeline_* keys, the
+LLM_PREFILL_PIPELINE dispatch-overlap path) against the single-dispatch
+prefill_s.
+
 A best-effort replica probe measures data-parallel scale-out
 (serving/replica_pool.py): aggregate decode tok/s of a 2-replica pool vs
 1 replica at the same per-replica lane count (replicas{1,2}_decode_toks_s,
@@ -453,6 +464,136 @@ def main() -> None:
             raise
         return req.first_token_time - req.arrival_time
 
+    def prefill_anatomy(nonembed_params: int) -> Optional[dict]:
+        """Decompose the solo-prefill wall into host/tunnel dispatch vs
+        device compute, plus a tuned-vs-heuristic flash-block kernel A/B —
+        the round-6 scoreboard for the prefill_est_mfu=0.13 gap, so this
+        and future PRs can see WHICH term moved.
+
+        Method: against the already-compiled prefill program (trash-block
+        tables, exactly warmup's shape — run_prefill above compiled it):
+        `single_dispatch_s` = min wall of one dispatch + blocking readback
+        (what a cold solo prefill pays); `device_s` = wall of N back-to-
+        back dispatches / N (dispatch i+1 rides the queue while i
+        computes, so the per-dispatch host/tunnel term amortizes away —
+        the same mechanism LLM_PREFILL_PIPELINE applies INSIDE one
+        prompt); dispatch_s is the difference. prefill_device_est_mfu is
+        the recomputed MFU with the dispatch term excluded. The kernel A/B
+        times the flash site alone at this shape with heuristic vs
+        ATT_FLASH_TUNE-resolved blocks (equal when tuning is off)."""
+        if fan_engine is None:
+            return None
+        from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK
+        from agentic_traffic_testing_tpu.runtime.scheduler import bucket_up
+
+        jnp = jax.numpy
+        eng = fan_engine
+        scfg = eng.scheduler.cfg
+        bs = eng.cfg.block_size
+        t = -(-bucket_up(prefill_len, scfg.prefill_buckets) // bs) * bs
+        tokens = jnp.zeros((1, t), jnp.int32)
+        tables = jnp.full((1, eng.table_width), TRASH_BLOCK, jnp.int32)
+        seq = jnp.full((1,), t, jnp.int32)
+        samp = eng._sampling_arrays([], 1)
+        steps0 = jnp.zeros((1,), jnp.int32)
+
+        def one():
+            _, eng.cache, out = eng.runner.prefill(
+                tokens, eng.cache, tables, seq, samp, steps0)
+            return out
+
+        jax.block_until_ready(one())  # already compiled; settle the queue
+        singles = []
+        for _ in range(3):
+            t0 = time.monotonic()
+            jax.block_until_ready(one())
+            singles.append(time.monotonic() - t0)
+        single_s = min(singles)
+        depth = 4
+        t0 = time.monotonic()
+        jax.block_until_ready([one() for _ in range(depth)])
+        device_s = (time.monotonic() - t0) / depth
+        dispatch_s = max(0.0, single_s - device_s)
+        res = {
+            "prefill_anatomy_tokens": t,
+            "prefill_single_dispatch_s": round(single_s, 4),
+            "prefill_device_s": round(device_s, 4),
+            "prefill_dispatch_s": round(dispatch_s, 4),
+            "prefill_device_toks_s": round(t / device_s, 1),
+            "prefill_device_est_mfu": round(
+                2 * nonembed_params * t / device_s / 197e12, 3),
+        }
+        if platform != "tpu":
+            return res  # the flash kernel doesn't serve the CPU site
+        from agentic_traffic_testing_tpu.ops.pallas import autotune
+        from agentic_traffic_testing_tpu.ops.pallas.chunk_flash import (
+            causal_flash_attention,
+        )
+
+        mcfg = engine.model_cfg
+        h, kh, hd = mcfg.num_heads, mcfg.num_kv_heads, mcfg.head_dim_
+        qpk = h // kh
+        q = jnp.zeros((1, t, h, hd), jnp.bfloat16)
+        kv = jnp.zeros((1, t, kh, hd), jnp.bfloat16)
+
+        def kernel_s(qb: int, kb: int) -> float:
+            run = lambda: causal_flash_attention(q, kv, kv, q_block=qb,
+                                                 kv_block=kb)
+            jax.block_until_ready(run())  # compile
+            best = float("inf")
+            for _ in range(5):
+                k0 = time.monotonic()
+                jax.block_until_ready(run())
+                best = min(best, time.monotonic() - k0)
+            return best
+
+        heur = autotune.heuristic_blocks(t, t, qpk)
+        tuned = autotune.resolve_blocks(t=t, tkv=t, hd=hd, qpk=qpk)
+        th = kernel_s(*heur)
+        res["prefill_flash_heuristic_blocks"] = list(heur)
+        res["prefill_flash_heuristic_toks_s"] = round(t / th, 1)
+        tt = th if tuned == heur else kernel_s(*tuned)
+        res["prefill_flash_tuned_blocks"] = list(tuned)
+        res["prefill_flash_tuned_toks_s"] = round(t / tt, 1)
+        return res
+
+    # Pipelined-prefill probe (LLM_PREFILL_PIPELINE): the solo long-prompt
+    # TTFT with the prompt split into BENCH_PREFILL_PIPELINE back-to-back
+    # chunk dispatches vs the single-dispatch prefill_s measured above —
+    # the engine-level A/B of the dispatch-overlap claim. 0 disables
+    # (default off-TPU: the overlap targets tunnel dispatch overhead,
+    # which the CPU path doesn't have). Best-effort like every secondary
+    # series.
+    pipeline_k = int(os.environ.get(
+        "BENCH_PREFILL_PIPELINE", "4" if platform == "tpu" else "0"))
+
+    def run_prefill_pipeline() -> float:
+        from agentic_traffic_testing_tpu.runtime.engine import (
+            EngineConfig as _EC,
+            LLMEngine as _LE,
+        )
+
+        pipe_len = max(1024, prefill_len + 80)
+        eng = _LE(_EC(
+            model=model, dtype="bfloat16", max_num_seqs=2,
+            max_model_len=pipe_len,
+            num_blocks=2 * (-(-pipe_len // cfg.block_size) + 4),
+            decode_steps=decode_steps,
+            prefill_pipeline_chunks=pipeline_k,
+            kv_cache_dtype=kv_cache_dtype,
+        ), model_cfg=engine.model_cfg, runner=engine.runner)
+        ids = rng.integers(10, vocab - 10, prefill_len).tolist()
+        sp = lambda: SamplingParams(temperature=0.0, max_tokens=1,
+                                    ignore_eos=True)
+        eng.generate(ids, sp())  # warmup: compile the chunk program
+        waits = []
+        for _ in range(reps):
+            req = eng.generate(ids, sp())
+            waits.append(req.first_token_time - req.arrival_time)
+        if not eng.num_pipeline_dispatches:
+            raise RuntimeError("pipeline probe never took the chunked path")
+        return statistics.median(waits)
+
     # Hybrid prefill+decode probe (ragged fused dispatch): a mixed arrival
     # stream — short requests decoding while chunked long prompts arrive —
     # measured with the fusion ON (hybrid_token_budget set) vs OFF. The
@@ -812,6 +953,29 @@ def main() -> None:
     hdp = engine.cache.k.shape[-1]
     mean_ctx = prompt_len + decode_tokens / 2
 
+    # Prefill anatomy + pipelined-prefill A/B (round 6): best-effort like
+    # every secondary series — a failure drops only these keys.
+    anatomy_res = None
+    if prefill_ok:
+        try:
+            anatomy_res = prefill_anatomy(nonembed_params)
+        except Exception as e:
+            print(f"bench: prefill anatomy dropped ({e!r})", file=sys.stderr)
+    pipeline_res = None
+    if pipeline_k >= 2 and fan_engine is not None:
+        try:
+            pp = run_prefill_pipeline()
+            pipeline_res = {
+                "prefill_pipeline_chunks": pipeline_k,
+                "prefill_pipeline_s": round(pp, 4),
+                "prefill_pipeline_toks_s": round(prefill_len / pp, 1),
+                "prefill_pipeline_est_mfu": round(
+                    2 * nonembed_params * prefill_len / pp / 197e12, 3),
+            }
+        except Exception as e:
+            print(f"bench: prefill pipeline probe dropped ({e!r})",
+                  file=sys.stderr)
+
     def roofline_for(bs: int) -> float:
         kv_bytes_step = (bs * mean_ctx * mcfg.num_layers * 2
                          * mcfg.num_kv_heads * hdp
@@ -868,6 +1032,8 @@ def main() -> None:
             "prefill_est_mfu": round(
                 2 * nonembed_params * prefill_len / prefill_s / 197e12, 3),
         }),
+        **({} if anatomy_res is None else anatomy_res),
+        **({} if pipeline_res is None else pipeline_res),
         "reps": reps,
     }))
 

@@ -1,0 +1,141 @@
+#!/usr/bin/env python3
+"""Pipelined-prefill A/B: dispatch overlap on/off x tuned/untuned blocks.
+
+The engine-level A/B for the round-6 prefill claims, isolated from the
+HTTP layer: a solo long-prompt prefill (the prefill_est_mfu=0.13 shape
+ROADMAP flags) measured with the single blocking dispatch (`serial`) vs
+K back-to-back position-chunk dispatches with one tail readback
+(`pipeline`, LLM_PREFILL_PIPELINE) — and, when PIPELINE_AB_TUNE is set
+(`warmup` or a table path), each arm repeated with ATT_FLASH_TUNE engaged
+so the flash-block autotuner's contribution separates from the overlap's.
+One JSON line per arm:
+
+    {"mode": "serial"|"pipeline", "tune": "off"|..., "prefill_ttft_s": ...,
+     "prefill_toks_s": ..., "pipeline_dispatches": N, "outputs_match": true}
+
+`outputs_match` asserts every arm's completion is token-identical (the
+correctness half of the claim; the engine suite additionally pins page
+bytes — tests/test_prefill_pipeline.py). Each arm builds a FRESH runner:
+block sizes and the pipeline program bake in at trace time, so arms must
+not share compiled programs. Numbers feed docs/BENCHMARKS.md once measured
+on hardware.
+
+Usage: python scripts/dev/prefill_pipeline_ab.py [prompt_len] [chunks] [max_tokens]
+Env: PIPELINE_AB_MODEL (default: tiny fp32 on cpu, llama-3.2-1b bf16 on tpu),
+     PIPELINE_AB_TUNE (unset = untuned arms only).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def run_arm(pipeline_chunks: int, tune: str, *, model_cfg, params, model: str,
+            dtype: str, prompt_len: int, max_tokens: int, reps: int) -> dict:
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.ops.pallas import autotune
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+    from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+    prev = os.environ.get("ATT_FLASH_TUNE")
+    if tune == "off":
+        os.environ.pop("ATT_FLASH_TUNE", None)
+    else:
+        os.environ["ATT_FLASH_TUNE"] = tune
+    autotune.reset()
+    try:
+        block_size = 16
+        max_len = max(256, prompt_len + max_tokens + 64)
+        eng = LLMEngine(EngineConfig(
+            model=model, dtype=dtype, max_num_seqs=2, max_model_len=max_len,
+            block_size=block_size,
+            num_blocks=2 * (-(-max_len // block_size) + 4),
+            prefill_pipeline_chunks=pipeline_chunks,
+        ), model_cfg=model_cfg,
+            runner=ModelRunner(model_cfg, params, decode_steps=1))
+
+        wl = np.random.default_rng(17)  # reseeded per arm: identical workload
+        vocab = model_cfg.vocab_size
+        prompt = wl.integers(10, vocab - 10, prompt_len).tolist()
+        sp = lambda: SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                                    ignore_eos=True)
+        eng.generate(prompt, sp())  # warmup: pay every compile outside timing
+        ttfts = []
+        req = None
+        for _ in range(reps):
+            req = eng.generate(prompt, sp())
+            ttfts.append(req.first_token_time - req.arrival_time)
+        ttft = statistics.median(ttfts)
+        return {
+            "mode": "pipeline" if pipeline_chunks >= 2 else "serial",
+            "tune": tune,
+            "prompt_tokens": prompt_len,
+            "pipeline_chunks": pipeline_chunks,
+            "prefill_ttft_s": round(ttft, 4),
+            "prefill_toks_s": round(prompt_len / ttft, 1),
+            "pipeline_dispatches": eng.num_pipeline_dispatches,
+            "outputs": req.generated_ids,
+        }
+    finally:
+        if prev is None:
+            os.environ.pop("ATT_FLASH_TUNE", None)
+        else:
+            os.environ["ATT_FLASH_TUNE"] = prev
+        autotune.reset()
+
+
+def main(argv=None) -> list[dict]:
+    argv = [int(a) for a in (argv if argv is not None else sys.argv[1:])]
+    prompt_len = argv[0] if len(argv) > 0 else 2048
+    chunks = argv[1] if len(argv) > 1 else 4
+    max_tokens = argv[2] if len(argv) > 2 else 4
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import init_params
+
+    platform = jax.devices()[0].platform
+    model = os.environ.get(
+        "PIPELINE_AB_MODEL", "llama-3.2-1b" if platform == "tpu" else "tiny")
+    dtype = "bfloat16" if platform == "tpu" else "float32"
+    reps = 3 if platform == "tpu" else 1
+    tune = os.environ.get("PIPELINE_AB_TUNE")
+    model_cfg = resolve_config(model)
+    params = init_params(
+        model_cfg, jax.random.key(0),
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    print(f"devices: {jax.devices()}  prompt={prompt_len} chunks={chunks} "
+          f"model={model} tune={tune or 'off'}", file=sys.stderr, flush=True)
+
+    common = dict(model_cfg=model_cfg, params=params, model=model,
+                  dtype=dtype, prompt_len=prompt_len, max_tokens=max_tokens,
+                  reps=reps)
+    arms = [(0, "off"), (chunks, "off")]
+    if tune:
+        arms += [(0, tune), (chunks, tune)]
+    results = [run_arm(k, tn, **common) for k, tn in arms]
+    # Correctness gate: every arm must produce the identical completion.
+    outs = {tuple(r["outputs"]) for r in results}
+    for r in results:
+        r["outputs_match"] = len(outs) == 1
+        r.pop("outputs")
+        print(json.dumps(r), flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    main()

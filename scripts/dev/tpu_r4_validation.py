@@ -10,8 +10,8 @@ several paths ship CPU/interpret-verified only):
      jax.experimental library kernel): correctness vs the jnp oracle at
      solo/batched/odd-bucket shapes on real Mosaic tiling, plus a timing
      probe against the round-3 library-kernel figure (~0.54 ms/layer at
-     T=2048 on the 1B head layout — if the in-tree kernel is slower, tune
-     _pick_q_block / kv_block in ops/pallas/chunk_flash.py),
+     T=2048 on the 1B head layout — if the in-tree kernel is slower, run
+     the block autotuner: ATT_FLASH_TUNE=warmup, ops/pallas/autotune.py),
   3. (--sweep) the verdict-item-3 batch-scaling sweep: bf16/int8/int4
      x bs {8,16,32} on the 1B and 8B + an fp8-KV row, by invoking
      bench.py per config and appending its JSON lines to

@@ -1,0 +1,212 @@
+"""Flash block autotuner (ops/pallas/autotune.py).
+
+Three contracts pinned here:
+
+  1. NUMERICS: every candidate (q_block, kv_block) config the sweep can
+     pick produces oracle-exact attention (block sizes only change tiling)
+     — interpret-mode parity across the causal and chunked sites.
+  2. TABLE: the JSON cache round-trips (write -> reload -> same choice),
+     an explicit ATT_FLASH_TUNE=<path> table deterministically pins the
+     blocks with NO sweeping, and a corrupt or missing table file degrades
+     to the heuristic instead of crashing the trace.
+  3. SWEEP (marked slow — tier-1 runs `-m 'not slow'`): warmup mode times
+     the candidates once per shape, persists the winner, and never
+     re-sweeps a shape it already knows.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
+from agentic_traffic_testing_tpu.ops.pallas import autotune
+from agentic_traffic_testing_tpu.ops.pallas.chunk_flash import (
+    causal_flash_attention,
+    chunk_flash_attention,
+)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tuner(monkeypatch):
+    """Each test sees a clean tuner registry and the default (off) mode."""
+    monkeypatch.delenv("ATT_FLASH_TUNE", raising=False)
+    autotune.reset()
+    yield
+    autotune.reset()
+
+
+def _mk(shape, seed=0):
+    return jax.random.normal(jax.random.key(seed), shape, jnp.float32)
+
+
+# ------------------------------------------------------ candidate numerics
+
+
+CAUSAL = dict(t=512, hd=64, qpk=2)
+
+
+@pytest.mark.parametrize(
+    "qb,kb", autotune.candidate_configs(CAUSAL["t"], CAUSAL["t"],
+                                        CAUSAL["hd"], CAUSAL["qpk"], 4))
+def test_every_causal_candidate_matches_oracle(qb, kb):
+    t, hd, qpk = CAUSAL["t"], CAUSAL["hd"], CAUSAL["qpk"]
+    kh = 2
+    q = _mk((1, t, kh * qpk, hd), 0)
+    k = _mk((1, t, kh, hd), 1)
+    v = _mk((1, t, kh, hd), 2)
+    pos = jnp.broadcast_to(jnp.arange(t, dtype=jnp.int32)[None], (1, t))
+    want = causal_attention(q, k, v, q_positions=pos,
+                            kv_valid_len=jnp.full((1,), t, jnp.int32))
+    got = causal_flash_attention(q, k, v, q_block=qb, kv_block=kb,
+                                 interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize(
+    "qb,kb", autotune.candidate_configs(128, 256, 64, 2, 4))
+def test_every_chunk_candidate_matches_oracle(qb, kb):
+    """Chunked site, BATCHED (the round-6 pipelined-prefill grid): prior
+    region + gather-tail gap + in-chunk causality, for every candidate."""
+    c, prior, hd, kh, qpk = 128, 128, 64, 1, 2
+    chunk_start = 96  # gap [96, 128) in the prior region must be masked
+    b = 2
+    q = _mk((b, c, kh * qpk, hd), 3)
+    k = _mk((b, prior + c, kh, hd), 4)
+    v = _mk((b, prior + c, kh, hd), 5)
+    pos = jnp.broadcast_to(
+        chunk_start + jnp.arange(c, dtype=jnp.int32)[None], (b, c))
+    kv_pos = jnp.concatenate(
+        [jnp.broadcast_to(jnp.arange(prior, dtype=jnp.int32)[None],
+                          (b, prior)), pos], axis=1)
+    kv_mask = jnp.concatenate(
+        [jnp.broadcast_to(jnp.arange(prior)[None] < chunk_start, (b, prior)),
+         jnp.ones((b, c), bool)], axis=1)
+    want = causal_attention(q, k, v, q_positions=pos, kv_positions=kv_pos,
+                            kv_valid_mask=kv_mask)
+    got = chunk_flash_attention(q, k, v, jnp.int32(chunk_start),
+                                prior_len=prior, q_block=qb, kv_block=kb,
+                                interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_candidates_include_heuristic():
+    for t, tkv, qpk in ((256, 256, 1), (2048, 2048, 4), (128, 640, 2)):
+        cands = autotune.candidate_configs(t, tkv, 64, qpk)
+        assert autotune.heuristic_blocks(t, tkv, qpk) in cands
+        for qb, kb in cands:
+            assert t % qb == 0
+
+
+# ------------------------------------------------------------ table logic
+
+
+def test_deterministic_table_pins_blocks(tmp_path, monkeypatch):
+    """Tier-1 fast unit: an ATT_FLASH_TUNE=<path> table deterministically
+    selects its recorded config — no sweep, no device timing."""
+    path = tmp_path / "tune.json"
+    key = autotune.shape_key(256, 256, 64, 2, 0)
+    path.write_text(json.dumps({autotune._device_key(): {key: [128, 256]}}))
+    monkeypatch.setenv("ATT_FLASH_TUNE", str(path))
+    autotune.reset()
+    got = autotune.resolve_blocks(t=256, tkv=256, hd=64, qpk=2)
+    assert got == (128, 256)
+    assert got != autotune.heuristic_blocks(256, 256, 2)
+    assert autotune.get_tuner().sweeps == 0
+    # Unknown shape in the same table: heuristic, still no sweep.
+    assert (autotune.resolve_blocks(t=512, tkv=512, hd=64, qpk=2)
+            == autotune.heuristic_blocks(512, 512, 2))
+    assert autotune.get_tuner().sweeps == 0
+
+
+def test_cache_roundtrip_same_choice(tmp_path, monkeypatch):
+    """write -> reload -> same choice, through the persist/load pair the
+    warmup sweep uses."""
+    path = str(tmp_path / "roundtrip.json")
+    monkeypatch.setenv("ATT_FLASH_TUNE", path)
+    autotune.reset()
+    tuner = autotune.get_tuner()
+    tuner._load()
+    key = autotune.shape_key(640, 640, 128, 4, 0)
+    tuner._table[key] = (128, 512)
+    tuner._persist()
+    autotune.reset()  # fresh tuner = fresh process
+    assert autotune.resolve_blocks(t=640, tkv=640, hd=128, qpk=4) == (128, 512)
+
+
+def test_corrupt_and_missing_tables_fall_back(tmp_path, monkeypatch):
+    heur = autotune.heuristic_blocks(256, 256, 2)
+    # Missing file.
+    monkeypatch.setenv("ATT_FLASH_TUNE", str(tmp_path / "nope.json"))
+    autotune.reset()
+    assert autotune.resolve_blocks(t=256, tkv=256, hd=64, qpk=2) == heur
+    # Corrupt JSON.
+    bad = tmp_path / "bad.json"
+    bad.write_text("{not json at all")
+    monkeypatch.setenv("ATT_FLASH_TUNE", str(bad))
+    autotune.reset()
+    assert autotune.resolve_blocks(t=256, tkv=256, hd=64, qpk=2) == heur
+    # Well-formed JSON, mistyped entries (strings, wrong arity, wrong type).
+    ugly = tmp_path / "ugly.json"
+    key = autotune.shape_key(256, 256, 64, 2, 0)
+    ugly.write_text(json.dumps({autotune._device_key(): {
+        key: "128x256", "other": [1, 2, 3], "another": None}}))
+    monkeypatch.setenv("ATT_FLASH_TUNE", str(ugly))
+    autotune.reset()
+    assert autotune.resolve_blocks(t=256, tkv=256, hd=64, qpk=2) == heur
+    # An entry whose q_block cannot tile t (table from another ladder).
+    off = tmp_path / "offladder.json"
+    off.write_text(json.dumps({autotune._device_key(): {key: [96, 256]}}))
+    monkeypatch.setenv("ATT_FLASH_TUNE", str(off))
+    autotune.reset()
+    assert autotune.resolve_blocks(t=256, tkv=256, hd=64, qpk=2) == heur
+    # A well-typed entry whose kv_block can never fit VMEM: must degrade,
+    # not hand Mosaic an un-compilable tile at serving warmup.
+    huge = tmp_path / "huge.json"
+    huge.write_text(json.dumps({autotune._device_key(): {key: [128, 1048576]}}))
+    monkeypatch.setenv("ATT_FLASH_TUNE", str(huge))
+    autotune.reset()
+    assert autotune.resolve_blocks(t=256, tkv=256, hd=64, qpk=2) == heur
+
+
+def test_off_mode_is_heuristic_and_sweepless():
+    assert (autotune.resolve_blocks(t=2048, tkv=2048, hd=64, qpk=4)
+            == autotune.heuristic_blocks(2048, 2048, 4))
+    assert autotune.get_tuner().sweeps == 0
+
+
+# ------------------------------------------------------------- the sweep
+
+
+@pytest.mark.slow
+def test_warmup_sweep_times_persists_and_memoizes(tmp_path, monkeypatch):
+    """warmup mode: one sweep per shape, winner persisted to the default
+    cache, later tuners (new processes) reload it without sweeping.
+    Interpret-mode timing on CPU — slow tier (the real sweep runs on
+    device at server warmup)."""
+    cache = str(tmp_path / "warm.json")
+    monkeypatch.setattr(autotune, "default_cache_path", lambda: cache)
+    monkeypatch.setenv("ATT_FLASH_TUNE", "warmup")
+    autotune.reset()
+    shape = dict(t=128, tkv=128, hd=64, qpk=1)
+    got = autotune.resolve_blocks(**shape, interpret=True)
+    tuner = autotune.get_tuner()
+    assert tuner.sweeps == 1
+    assert got in autotune.candidate_configs(128, 128, 64, 1)
+    assert os.path.exists(cache)
+    data = json.loads(open(cache).read())
+    assert data[autotune._device_key()][
+        autotune.shape_key(128, 128, 64, 1, 0)] == list(got)
+    # Same shape again: memoized, no second sweep.
+    assert autotune.resolve_blocks(**shape, interpret=True) == got
+    assert tuner.sweeps == 1
+    # Fresh process: reloads the persisted table instead of sweeping.
+    autotune.reset()
+    assert autotune.resolve_blocks(**shape, interpret=True) == got
+    assert autotune.get_tuner().sweeps == 0

@@ -144,6 +144,24 @@ def test_preemption_with_multistep(params):
     assert eng.scheduler.num_preemptions > 0
 
 
+def test_bs32_auto_decode_steps_parity(params):
+    """ROADMAP item 2 (round 6): with LLM_DECODE_STEPS unset, the TPU auto
+    scales the fused dispatch length with the lane count (32 at bs>=32,
+    16 below — the per-step host work grows with B, so a larger K
+    amortizes it). The parity half: the fused K the bs32 auto resolves to
+    must stay token-exact vs single-step decode, same as every other K."""
+    k32 = EngineConfig(max_num_seqs=32).resolved_decode_steps("tpu")
+    assert k32 == 32
+    assert EngineConfig(max_num_seqs=8).resolved_decode_steps("tpu") == 16
+    rng = np.random.default_rng(8)
+    prompt = rng.integers(0, CFG.vocab_size, 9).tolist()
+    want = oracle(params, prompt, greedy(k32 + 1))  # K+1: crosses a K block
+    eng = make_engine(params, decode_steps=k32, max_model_len=64)
+    req = eng.generate(prompt, greedy(k32 + 1))
+    assert req.generated_ids == want
+    assert req.finish_reason == FinishReason.LENGTH
+
+
 def test_no_wasted_trailing_dispatches(params, monkeypatch):
     """Once every lane's budget is in flight, the engine drains instead of
     dispatching: exactly ceil(max_tokens / K) decode dispatches for a

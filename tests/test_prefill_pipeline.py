@@ -1,0 +1,247 @@
+"""Pipelined prefill (LLM_PREFILL_PIPELINE): dispatch overlap must be a pure
+performance knob.
+
+The round-6 path splits solo/batched prefills into K position-chunks
+dispatched back-to-back with no host synchronization (engine.
+_run_prefill_pipelined -> runner.prefill_pipeline -> models/llama.
+prefill_pipeline_impl). Invariants pinned here:
+
+  * knob OFF (default): the single-dispatch path runs exactly as before —
+    one runner.prefill call, zero pipeline dispatches, oracle-equal output.
+  * knob ON: outputs are token-identical to the single-dispatch engine for
+    greedy and seeded sampling, solo and batched (mixed real lengths in one
+    bucket), with decode and KV accounting unaffected.
+  * the ASYNC pipelining itself is free: pages after the tail readback are
+    byte-identical to the same chunk dispatches run with a host sync after
+    each. Cross-path (pipeline vs single dispatch) pages agree to fp
+    tolerance with layer 0 exact — the chunked attention site reduces its
+    softmax over a different kv width than the in-register site, which
+    costs last-ulp differences (the same structural property the serial
+    chunked-prefill suite pins token-identity across).
+  * config guards: speculation x pipeline refused; decode_steps auto-scale
+    (ROADMAP bs32 nibble) resolves as documented.
+"""
+
+import numpy as np
+import pytest
+
+# Heavyweight tier: CPU jit compiles dominate (pytest.ini tiering).
+pytestmark = pytest.mark.full
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.models.config import PRESETS
+from agentic_traffic_testing_tpu.models.llama import init_params
+from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+CFG = PRESETS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def params():
+    return init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+
+
+def make_engine(params, pipeline, **kw):
+    kw.setdefault("model", "tiny")
+    kw.setdefault("dtype", "float32")
+    kw.setdefault("max_model_len", 128)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_num_seqs", 4)
+    ecfg = EngineConfig(prefill_pipeline_chunks=pipeline, **kw)
+    runner = ModelRunner(CFG, params, decode_steps=1)
+    return LLMEngine(ecfg, model_cfg=CFG, runner=runner)
+
+
+def greedy(max_tokens=8, **kw):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0, **kw)
+
+
+def run_all(engine, reqs):
+    for _ in range(10_000):
+        engine.step()
+        if all(r.is_finished() for r in reqs):
+            return
+        if not engine.has_work():
+            break
+    assert all(r.is_finished() for r in reqs), [r.state for r in reqs]
+
+
+def oracle(params, prompt, sampling):
+    eng = make_engine(params, pipeline=0)
+    return eng.generate(prompt, sampling).generated_ids
+
+
+def test_knob_off_is_single_dispatch(params, monkeypatch):
+    """Default off: ONE runner.prefill dispatch, pipeline program never
+    touched — the bit-identical-to-main contract's observable half."""
+    eng = make_engine(params, pipeline=0)
+    calls = {"prefill": 0, "pipeline": 0}
+    orig = eng.runner.prefill
+
+    def counting(*a, **kw):
+        calls["prefill"] += 1
+        return orig(*a, **kw)
+
+    monkeypatch.setattr(eng.runner, "prefill", counting)
+    monkeypatch.setattr(
+        eng.runner, "prefill_pipeline",
+        lambda *a, **kw: calls.__setitem__("pipeline", calls["pipeline"] + 1))
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(0, CFG.vocab_size, 20).tolist()
+    want = oracle(params, prompt, greedy(6))
+    req = eng.generate(prompt, greedy(6))
+    assert req.generated_ids == want
+    assert calls == {"prefill": 1, "pipeline": 0}
+    assert eng.num_pipeline_dispatches == 0
+
+
+@pytest.mark.parametrize("plen", [20, 28])
+def test_pipeline_token_identical_greedy(params, plen):
+    rng = np.random.default_rng(1)
+    prompt = rng.integers(0, CFG.vocab_size, plen).tolist()
+    want = oracle(params, prompt, greedy(8))
+    eng = make_engine(params, pipeline=2)
+    req = eng.generate(prompt, greedy(8))
+    assert req.generated_ids == want
+    assert eng.num_pipeline_dispatches == 2  # 32-token bucket / 16-chunks
+
+
+def test_pipeline_seeded_sampling_matches(params):
+    rng = np.random.default_rng(2)
+    prompt = rng.integers(0, CFG.vocab_size, 30).tolist()
+    sp = lambda: SamplingParams(max_tokens=8, temperature=0.8, top_k=20,
+                                seed=9)
+    want = oracle(params, prompt, sp())
+    eng = make_engine(params, pipeline=2)
+    req = eng.generate(prompt, sp())
+    assert req.generated_ids == want
+
+
+def test_pipeline_batched_mixed_lengths(params):
+    """Rows of one padded bucket with different REAL lengths: each row's
+    first token must merge from the chunk holding ITS last real token."""
+    rng = np.random.default_rng(3)
+    prompts = [rng.integers(0, CFG.vocab_size, n).tolist()
+               for n in (6, 17, 30)]  # last tokens land in chunk 0 and 1
+    wants = [oracle(params, p, greedy(6)) for p in prompts]
+    eng = make_engine(params, pipeline=2)
+    reqs = [eng.add_request(p, greedy(6)) for p in prompts]
+    run_all(eng, reqs)
+    assert [r.generated_ids for r in reqs] == wants
+    assert eng.num_pipeline_dispatches > 0
+    assert eng.kv_stats()["used_blocks"] == 0
+
+
+def _prefill_pages(eng, prompt, sync_each_chunk=False):
+    """Run ONE prefill step and return the request's real KV page slots.
+
+    `sync_each_chunk` forces a host sync after every pipelined chunk
+    dispatch (the anti-pipelining control arm)."""
+    if sync_each_chunk:
+        orig = eng.runner.prefill_pipeline
+
+        def synced(*a, **kw):
+            cache, carry = orig(*a, **kw)
+            jax.block_until_ready(carry)
+            return cache, carry
+
+        eng.runner.prefill_pipeline = synced
+    r = eng.add_request(prompt, greedy(4))
+    eng.step()
+    row = r.blocks.table_row(eng.table_width)
+    n, bs = len(prompt), eng.cfg.block_size
+    nb = -(-n // bs)
+    kp = np.asarray(jax.device_get(eng.cache.k))[:, :, row[:nb]]
+    vp = np.asarray(jax.device_get(eng.cache.v))[:, :, row[:nb]]
+    # [L, KH, nb, bs, hdp] -> position-ordered slots, real tokens only
+    kp = kp.reshape(kp.shape[0], kp.shape[1], -1, kp.shape[-1])[:, :, :n]
+    vp = vp.reshape(vp.shape[0], vp.shape[1], -1, vp.shape[-1])[:, :, :n]
+    return kp, vp
+
+
+def test_async_pipelining_pages_byte_identical(params):
+    """The tail readback observes EXACTLY the pages a fully synchronized
+    run of the same chunk dispatches produces — the overlap mechanism
+    (queued dispatches, donated carry) adds or loses nothing."""
+    rng = np.random.default_rng(4)
+    prompt = rng.integers(0, CFG.vocab_size, 28).tolist()
+    k_async, v_async = _prefill_pages(make_engine(params, pipeline=2), prompt)
+    k_sync, v_sync = _prefill_pages(make_engine(params, pipeline=2), prompt,
+                                    sync_each_chunk=True)
+    assert np.array_equal(k_async, k_sync)
+    assert np.array_equal(v_async, v_sync)
+
+
+def test_pipeline_pages_match_single_dispatch(params):
+    """Cross-path pages: layer 0 (no attention upstream of its K/V) must be
+    byte-identical; deeper layers agree to fp32 tolerance (the chunk site's
+    softmax reduces over a different kv width — last-ulp only)."""
+    rng = np.random.default_rng(5)
+    prompt = rng.integers(0, CFG.vocab_size, 28).tolist()
+    k0, v0 = _prefill_pages(make_engine(params, pipeline=0), prompt)
+    k2, v2 = _prefill_pages(make_engine(params, pipeline=2), prompt)
+    assert np.array_equal(k0[0], k2[0])
+    assert np.array_equal(v0[0], v2[0])
+    np.testing.assert_allclose(k2, k0, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(v2, v0, rtol=1e-5, atol=1e-5)
+
+
+def test_pipeline_with_multistep_decode(params):
+    rng = np.random.default_rng(6)
+    prompt = rng.integers(0, CFG.vocab_size, 25).tolist()
+    want = oracle(params, prompt, greedy(9))
+    ecfg = EngineConfig(model="tiny", dtype="float32", max_model_len=128,
+                        block_size=8, num_blocks=64, max_num_seqs=4,
+                        prefill_pipeline_chunks=2, decode_steps=4)
+    runner = ModelRunner(CFG, params, decode_steps=4)
+    eng = LLMEngine(ecfg, model_cfg=CFG, runner=runner)
+    req = eng.generate(prompt, greedy(9))
+    assert req.generated_ids == want
+
+
+def test_warmup_covers_pipeline_program(params, monkeypatch):
+    """warmup_prefill_buckets warms the PIPELINE program (not the dead
+    single-dispatch one) when the knob routes live prefills there."""
+    eng = make_engine(params, pipeline=2)
+    calls = {"pipeline": 0, "prefill": 0}
+    orig = eng.runner.prefill_pipeline
+    monkeypatch.setattr(
+        eng.runner, "prefill_pipeline",
+        lambda *a, **kw: calls.__setitem__(
+            "pipeline", calls["pipeline"] + 1) or orig(*a, **kw))
+    origp = eng.runner.prefill
+    monkeypatch.setattr(
+        eng.runner, "prefill",
+        lambda *a, **kw: calls.__setitem__(
+            "prefill", calls["prefill"] + 1) or origp(*a, **kw))
+    n = eng.warmup_prefill_buckets(max_len=32)
+    assert n > 0
+    assert calls["pipeline"] == n and calls["prefill"] == 0
+
+
+def test_pipeline_rejects_speculation():
+    with pytest.raises(ValueError, match="speculation"):
+        EngineConfig(prefill_pipeline_chunks=2, speculation="ngram")
+
+
+def test_pipeline_rejects_negative():
+    with pytest.raises(ValueError, match="prefill_pipeline_chunks"):
+        EngineConfig(prefill_pipeline_chunks=-1)
+
+
+def test_resolved_decode_steps_scales_with_batch():
+    """ROADMAP item 2 (bs32 nibble): unset LLM_DECODE_STEPS auto-scales
+    the fused dispatch length with the lane count on TPU; explicit values
+    and non-TPU platforms are untouched."""
+    assert EngineConfig(max_num_seqs=8).resolved_decode_steps("tpu") == 16
+    assert EngineConfig(max_num_seqs=12).resolved_decode_steps("tpu") == 16
+    assert EngineConfig(max_num_seqs=32).resolved_decode_steps("tpu") == 32
+    assert EngineConfig(max_num_seqs=64).resolved_decode_steps("tpu") == 32
+    assert EngineConfig(max_num_seqs=32).resolved_decode_steps("cpu") == 1
+    assert EngineConfig(max_num_seqs=32,
+                        decode_steps=16).resolved_decode_steps("tpu") == 16

@@ -256,6 +256,29 @@ def test_offload_ab_smoke(monkeypatch):
         assert r["rearrival_ttft_s"] >= 0
 
 
+# ------------------------------------------------ prefill-pipeline A/B
+
+
+def test_prefill_pipeline_ab_smoke(monkeypatch):
+    """scripts/dev/prefill_pipeline_ab.py end-to-end on the tiny model:
+    one JSON row per arm, the pipeline arm actually takes the chunked-
+    dispatch path (dispatches >= 2), the serial arm never does, and both
+    arms' completions are token-identical (in-process for the warm
+    jax/conftest CPU config, like router_ab/offload_ab)."""
+    monkeypatch.setenv("PIPELINE_AB_MODEL", "tiny")
+    monkeypatch.delenv("PIPELINE_AB_TUNE", raising=False)
+    pipeline_ab = load_script("scripts/dev/prefill_pipeline_ab.py",
+                              "prefill_pipeline_ab")
+    results = pipeline_ab.main(["48", "2", "4"])
+    assert [r["mode"] for r in results] == ["serial", "pipeline"]
+    by_mode = {r["mode"]: r for r in results}
+    assert by_mode["pipeline"]["pipeline_dispatches"] >= 2
+    assert by_mode["serial"]["pipeline_dispatches"] == 0
+    for r in results:
+        assert r["outputs_match"] is True
+        assert r["prefill_ttft_s"] >= 0
+
+
 # ------------------------------------------------- metric-docs parity
 
 
