@@ -8,10 +8,12 @@ backend). Override with ATT_TPU_ATTENTION:
     auto      (default) dma2 on TPU, gather on CPU/GPU
     dma2      grid-(B,) kernel, each page DMA carries all KV heads (8x fewer
               descriptors than dma — the decisive cost at short context)
-    dma3      grid-(B,C) kernel: the chunk walk is the second grid dim and
-              each real chunk prefetches the next across sequence
-              boundaries, so chunk-0 DMA latency is exposed once per call
-              instead of once per sequence
+    dma3      grid-(B,KH,C) lane-parallel kernel: one double-buffered chunk
+              walk per (sequence, kv-head) lane with batch and head dims
+              marked "parallel", so lanes split across megacore
+              TensorCores (the old (B,C) cross-sequence pipeline was
+              pinned to one core); per-head page DMAs trade descriptor
+              count for lane parallelism
     ragged    q-block-grid ragged kernel (ops/pallas/ragged_paged_attention)
               — the hybrid prefill+decode batch path; on the decode shape
               it runs every lane as a 1-token ragged row (interpret mode

@@ -123,3 +123,33 @@ def write_prompt_kv_pallas(
         ),
         interpret=interpret,
     )(block_tables.astype(jnp.int32), new_k, new_v, pool_k, pool_v)
+
+
+@jax.jit
+def update_table_cells(
+    tables: jax.Array,   # [B, W] i32 — the device-resident block table
+    rows: jax.Array,     # [N] i32 — lane index per updated cell
+    cols: jax.Array,     # [N] i32 — table column per updated cell
+    vals: jax.Array,     # [N] i32 — new block id per updated cell
+) -> jax.Array:
+    """Device-side incremental block-table maintenance (round 7).
+
+    Decode grows each lane's block list by at most a couple of blocks per
+    fused dispatch, but the engine used to rebuild the WHOLE [B, W] table
+    host-side and re-upload it every time any lane crossed a block
+    boundary — at bs32/W=256 that is a 32 KB host assembly + transfer per
+    dispatch, pure per-step host work that scales with B (the ROADMAP
+    bs32 roofline_frac culprit). This helper keeps the table resident on
+    device and scatters ONLY the changed cells: the upload is the [N]
+    triple of row/col/val arrays (a few dozen bytes), and the scatter
+    reads the old table once.
+
+    NOT donated on purpose: in-flight decode dispatches still read the
+    previous table buffer, and while device FIFO ordering would make an
+    in-place update safe on TPU, the defensive copy is one [B, W] i32
+    move (~32 KB) — noise next to the host rebuild it replaces. Callers
+    pad (rows, cols, vals) to a bucketed length by REPEATING a real
+    triple (the scatter is idempotent per cell), so the jit compiles one
+    program per bucket, not one per update count.
+    """
+    return tables.at[rows, cols].set(vals, mode="drop")

@@ -553,163 +553,154 @@ def _dma3_decode_kernel(
     q_per_seq: int = 1,
     queries_per_kv: int = 1,
 ):
-    """Decode kernel v4: grid (B, C) — the chunk walk IS the second grid
-    dim, and each step prefetches the NEXT grid step's chunk (even across
-    sequence boundaries).
+    """Decode kernel v4 (round 7: lane-parallel): grid (B, KH, C) — one
+    double-buffered chunk walk per (sequence, kv-head) lane, with the
+    sequence AND head dimensions marked "parallel".
 
-    v3 (_dma2_decode_kernel) runs one grid program per sequence with the
-    chunk loop inside: the first chunk's DMA latency is exposed at every
-    program start, and at bench.py's shapes (B=32, ~2 chunks/seq) those 32
-    serial stalls are most of the kernel's off-roofline time (~2 us x 32 of
-    a ~69 us call). Here the double-buffered chunk pipeline spans the whole
-    grid walk in linear step order t = b*C + ci, so only chunk t=0 ever
-    stalls; the flash-softmax running stats ride VMEM scratch between chunk
-    steps of the same sequence.
+    The previous v4 ran grid (B, C) with a cross-sequence chunk pipeline in
+    strict linear order, which forced `dimension_semantics=("arbitrary",
+    "arbitrary")`: on megacore parts (v4/v5p) the whole kernel serialized
+    onto ONE TensorCore, and the compiler could not overlap lanes at all —
+    the ROADMAP's "grid over more lanes" decode gap. Here every (b, kh)
+    lane is an independent program chain: its flash-softmax stats are
+    private scratch, its chunk walk (innermost dim, "arbitrary") keeps the
+    double-buffered DMA prefetch within the lane, and the B*KH lane grid
+    parallelizes across cores. The trade vs the old v4: chunk-0 DMA
+    latency is exposed once per LANE rather than once per call, and each
+    page DMA moves one head's [bs, hd] slice instead of all heads — at
+    B=32/KH=8 that is 8x the descriptors of dma2, bought back by lane
+    parallelism; scripts/dev/paged_decode_ab.py is the hardware arbiter.
 
-    Tail chunks (ci*cp >= n_pages) issue no DMA at all — their compute runs
-    fully masked on whatever the buffers hold (finite by the one-time V
-    zero-fill below + K's mask-replaces-NaN property).
+    Tail chunks (ci*cp >= n_pages) issue no DMA at all — their compute is
+    skipped entirely; the lane's finalize reads the running stats off
+    scratch at the last chunk step (all real chunks precede it in the
+    lane's sequential walk).
 
     Ref order: [layer_ref?], block_tables_ref [B, W] (SMEM), ctx_lens_ref
-    [B, 1] (SMEM), q_ref [1, KH, rows, hd] (VMEM), k_hbm/v_hbm (ANY: full
-    pool), o_ref [1, KH, rows, hd], k_buf/v_buf [2, KH, CP*bs, hd] VMEM
-    scratch, m_buf/l_buf [KH, R, 128] f32 scratch, acc_buf [KH, R, hd] f32
-    scratch, rc_ref [1] i32 SMEM scratch (the real-chunk counter that
-    drives buffer-slot parity — see _prologue), sems DMA-semaphore array
-    [2, 2]."""
+    [B, 1] (SMEM), q_ref [1, 1, rows, hd] (VMEM), k_hbm/v_hbm (ANY: full
+    pool), o_ref [1, 1, rows, hd], k_buf/v_buf [2, CP*bs, hd] VMEM
+    scratch, m_buf/l_buf [R, 128] f32 scratch, acc_buf [R, hd] f32
+    scratch, sems DMA-semaphore array [2, 2]."""
     if stacked:
         layer_ref = refs[0]
         (bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
-         k_buf, v_buf, m_buf, l_buf, acc_buf, rc_ref, sems) = refs[1:]
+         k_buf, v_buf, m_buf, l_buf, acc_buf, sems) = refs[1:]
     else:
         layer_ref = None
         (bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
-         k_buf, v_buf, m_buf, l_buf, acc_buf, rc_ref, sems) = refs
+         k_buf, v_buf, m_buf, l_buf, acc_buf, sems) = refs
     bi = pl.program_id(0)
-    ci = pl.program_id(1)
-    n_b = pl.num_programs(0)
+    h = pl.program_id(1)
+    ci = pl.program_id(2)
     c = n_chunk_steps
     cp = pages_per_chunk
-    kh = k_buf.shape[1]
-    bs = k_buf.shape[2] // cp
-    hd = k_buf.shape[3]
+    bs = k_buf.shape[1] // cp
+    hd = k_buf.shape[2]
     rows = q_ref.shape[2]
     w = bt_ref.shape[1]
-    t = bi * c + ci
+    ctx = cl_ref[bi, 0]
+    n_pages = jax.lax.div(ctx + (q_per_seq - 1) + bs - 1, bs)
 
-    def n_pages_of(b):
-        return jax.lax.div(cl_ref[b, 0] + (q_per_seq - 1) + bs - 1, bs)
-
-    def page_copy(b, cj, p, slot, kv_hbm, buf, sem_col):
+    def page_copy(cj, p, slot, kv_hbm, buf, sem_col):
         pi = jnp.minimum(cj * cp + p, w - 1)
-        blk = bt_ref[b, pi]
+        blk = bt_ref[bi, pi]
         if stacked:
-            src = kv_hbm.at[layer_ref[0], :, blk]      # [KH, bs, hd] strided
+            src = kv_hbm.at[layer_ref[0], h, blk]          # [bs, hd]
         else:
-            src = kv_hbm.at[:, blk]
+            src = kv_hbm.at[h, blk]
         return pltpu.make_async_copy(
-            src, buf.at[slot, :, pl.ds(p * bs, bs), :], sems.at[slot, sem_col]
+            src, buf.at[slot, pl.ds(p * bs, bs), :], sems.at[slot, sem_col]
         )
 
-    def issue(b, cj, slot):
-        np_b = n_pages_of(b)
+    def issue(cj, slot):
         for p in range(cp):
-            @pl.when(cj * cp + p < np_b)
+            @pl.when(cj * cp + p < n_pages)
             def _start(p=p):
-                page_copy(b, cj, p, slot, k_hbm, k_buf, 0).start()
-                page_copy(b, cj, p, slot, v_hbm, v_buf, 1).start()
+                page_copy(cj, p, slot, k_hbm, k_buf, 0).start()
+                page_copy(cj, p, slot, v_hbm, v_buf, 1).start()
 
-    def wait(b, cj, slot):
-        np_b = n_pages_of(b)
+    def wait(cj, slot):
         for p in range(cp):
-            @pl.when(cj * cp + p < np_b)
+            @pl.when(cj * cp + p < n_pages)
             def _wait(p=p):
-                page_copy(b, cj, p, slot, k_hbm, k_buf, 0).wait()
-                page_copy(b, cj, p, slot, v_hbm, v_buf, 1).wait()
+                page_copy(cj, p, slot, k_hbm, k_buf, 0).wait()
+                page_copy(cj, p, slot, v_hbm, v_buf, 1).wait()
 
-    np_bi = n_pages_of(bi)
-    real = ci * cp < np_bi        # this chunk holds >= 1 real page
-
-    # First grid step: make every stale V slot finite forever (see the
-    # _dma2_decode_kernel note — masked p_ is exactly 0.0 but 0 * NaN from
-    # uninitialized VMEM would poison `p_ @ v`), then start the pipeline.
-    # rc_ref counts REAL chunks processed: buffer slots alternate on that
-    # count (not on t — masked steps issue no DMA and must not flip parity).
-    @pl.when(t == 0)
+    # Lane prologue (ci == 0 is always a real chunk: ctx >= 1). Zero the
+    # last real chunk's never-DMA'd V page slots in both buffer slots (see
+    # the _dma2_decode_kernel note — masked p_ is exactly 0.0 but 0 * NaN
+    # from stale VMEM would poison `p_ @ v`; stale K is harmless, the pos
+    # mask replaces NaN scores), then start the lane's pipeline. Per-lane
+    # (not per-call) so megacore halves with separate scratch each
+    # initialize their own buffers.
+    @pl.when(ci == 0)
     def _prologue():
-        rc_ref[0] = 0
-        v_buf[...] = jnp.zeros_like(v_buf)
-        issue(0, 0, 0)
+        last_c = jax.lax.div(n_pages + cp - 1, cp) - 1
+        for p in range(cp):
+            @pl.when(last_c * cp + p >= n_pages)
+            def _zero_tail(p=p):
+                v_buf[:, pl.ds(p * bs, bs), :] = jnp.zeros(
+                    (2, bs, hd), v_buf.dtype)
+        m_buf[:rows, :] = jnp.full((rows, m_buf.shape[1]), _NEG_INF,
+                                   jnp.float32)
+        l_buf[:rows, :] = jnp.zeros((rows, l_buf.shape[1]), jnp.float32)
+        acc_buf[:rows, :] = jnp.zeros((rows, hd), jnp.float32)
+        issue(0, 0)
 
-    @pl.when(real)
+    # Real chunks are a prefix of the lane's ci range, so buffer-slot
+    # parity is simply ci % 2 (masked chunks issue no DMA and never flip a
+    # slot). Chunk ci+1's pages were prefetched during step ci-1's compute
+    # window... no: they are issued HERE, before waiting on chunk ci — the
+    # DMA engine fills the other slot while the MXU works on this one,
+    # exactly the _dma2_decode_kernel pipeline with grid steps in place of
+    # fori_loop iterations.
+    @pl.when(ci * cp < n_pages)
     def _real_chunk():
-        rc = rc_ref[0]
-        slot = jax.lax.rem(rc, 2)
+        slot = jax.lax.rem(ci, 2)
 
-        # Prefetch real chunk rc+1 — (bi, ci+1) if this row has one, else
-        # (bi+1, 0) (every row has >= 1 real chunk: ctx >= 1 always).
-        # Issued into the other buffer slot, whose previous occupant
-        # (real chunk rc-1) was consumed in an earlier grid step.
-        same_row = (ci + 1) * cp < np_bi
-        nb = jnp.where(same_row, bi, bi + 1)
-        nc = jnp.where(same_row, ci + 1, 0)
-
-        @pl.when(nb < n_b)
+        @pl.when((ci + 1) * cp < n_pages)
         def _prefetch():
-            issue(nb, nc, jax.lax.rem(rc + 1, 2))
+            issue(ci + 1, jax.lax.rem(ci + 1, 2))
 
-        wait(bi, ci, slot)
+        wait(ci, slot)
 
-        ctx = cl_ref[bi, 0]
-        q = q_ref[0].astype(jnp.float32) * scale             # [KH, rows, hd]
-        k = k_buf[slot].astype(jnp.float32)                  # [KH, cp*bs, hd]
+        q = q_ref[0, 0].astype(jnp.float32) * scale          # [rows, hd]
+        k = k_buf[slot].astype(jnp.float32)                  # [cp*bs, hd]
         v = v_buf[slot].astype(jnp.float32)
-        s = jax.lax.dot_general(                             # [KH, rows, cp*bs]
-            q, k, (((2,), (2,)), ((0,), (0,))),
+        s = jax.lax.dot_general(                             # [rows, cp*bs]
+            q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
         pos = ci * cp * bs + jax.lax.broadcasted_iota(
-            jnp.int32, (kh, rows, cp * bs), 2)
+            jnp.int32, (rows, cp * bs), 1)
         row_off = (jax.lax.broadcasted_iota(
-            jnp.int32, (kh, rows, cp * bs), 1) // queries_per_kv)
+            jnp.int32, (rows, cp * bs), 0) // queries_per_kv)
         s = jnp.where(pos < ctx + row_off, s, _NEG_INF)
 
-        @pl.when(ci == 0)
-        def _init_stats():
-            m_buf[:, :rows, :] = jnp.full(
-                (kh, rows, m_buf.shape[2]), _NEG_INF, jnp.float32)
-            l_buf[:, :rows, :] = jnp.zeros(
-                (kh, rows, l_buf.shape[2]), jnp.float32)
-            acc_buf[:, :rows, :] = jnp.zeros((kh, rows, hd), jnp.float32)
-
-        m = m_buf[:, :rows, :1]                              # [KH, rows, 1]
-        l = l_buf[:, :rows, :1]
-        acc = acc_buf[:, :rows, :]
+        m = m_buf[:rows, :1]                                 # [rows, 1]
+        l = l_buf[:rows, :1]
+        acc = acc_buf[:rows, :]
         m_cur = jnp.max(s, axis=-1, keepdims=True)
         m_new = jnp.maximum(m, m_cur)
         alpha = jnp.exp(m - m_new)
         p_ = jnp.exp(s - m_new)
         l_new = l * alpha + jnp.sum(p_, axis=-1, keepdims=True)
-        pv = jax.lax.dot_general(                            # [KH, rows, hd]
-            p_, v, (((2,), (1,)), ((0,), (0,))),
+        pv = jax.lax.dot_general(                            # [rows, hd]
+            p_, v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
         )
-        m_buf[:, :rows, :] = jnp.broadcast_to(
-            m_new, (kh, rows, m_buf.shape[2]))
-        l_buf[:, :rows, :] = jnp.broadcast_to(
-            l_new, (kh, rows, l_buf.shape[2]))
-        acc_buf[:, :rows, :] = acc * alpha + pv
-        rc_ref[0] = rc + 1
+        m_buf[:rows, :] = jnp.broadcast_to(m_new, (rows, m_buf.shape[1]))
+        l_buf[:rows, :] = jnp.broadcast_to(l_new, (rows, l_buf.shape[1]))
+        acc_buf[:rows, :] = acc * alpha + pv
 
-    # Masked chunks (ci*cp >= n_pages) cost only this branch check; the
-    # finalize still runs on the row's last step, reading the running stats
-    # back out of scratch (the row's real chunks all precede it in grid
-    # order, so the scratch is complete by now).
+    # Masked chunks (ci*cp >= n_pages) cost only the branch checks; the
+    # finalize runs on the lane's last chunk step, reading the running
+    # stats back out of scratch (complete: all real chunks precede it).
     @pl.when(ci == c - 1)
     def _finish():
-        o_ref[0] = (acc_buf[:, :rows, :]
-                    / jnp.maximum(l_buf[:, :rows, :1], 1e-30)
-                    ).astype(o_ref.dtype)
+        o_ref[0, 0] = (acc_buf[:rows, :]
+                       / jnp.maximum(l_buf[:rows, :1], 1e-30)
+                       ).astype(o_ref.dtype)
 
 
 @functools.partial(
@@ -727,17 +718,19 @@ def paged_attention_decode_dma3(
     pages_per_chunk: int = 16,
     interpret: bool = False,
 ) -> jax.Array:
-    """Decode paged attention, cross-sequence-pipelined variant
-    (_dma3_decode_kernel). Same contract as paged_attention_decode_dma2;
-    grid is (B, ceil(max_blocks/pages_per_chunk)) and each real chunk
-    prefetches the next real chunk (across sequence boundaries), so
-    chunk-0 DMA latency is exposed once per call instead of once per
-    sequence. Chunks past a sequence's last page skip DMA and compute
-    entirely. Default pages_per_chunk=16 (vs dma2's 8): the per-chunk
-    dot dispatch overhead on the tiny GQA row tile is the next cost
-    after DMA, so fewer, wider chunks should win — A/B on hardware with
-    scripts/dev/paged_decode_ab.py (the pre-fix v5e numbers predate the
-    rc_ref scratch repair and are not to be trusted)."""
+    """Decode paged attention, lane-parallel variant (_dma3_decode_kernel).
+    Same contract as paged_attention_decode_dma2; grid is
+    (B, KH, ceil(max_blocks/pages_per_chunk)) with the sequence and
+    kv-head dimensions marked "parallel" — every (b, kh) lane is an
+    independent double-buffered chunk walk over its own private softmax
+    scratch, so the compiler may split lanes across megacore TensorCores
+    (the old (B, C) cross-sequence pipeline was pinned to one core by its
+    "arbitrary" batch dim). Chunks past a sequence's last page skip DMA
+    and compute entirely. Default pages_per_chunk=16 (vs dma2's 8): the
+    per-chunk dot dispatch overhead on the tiny GQA row tile is the next
+    cost after DMA, so fewer, wider chunks should win — A/B on hardware
+    with scripts/dev/paged_decode_ab.py (pre-widening v5e numbers predate
+    the lane-parallel grid and are not to be trusted)."""
     stacked = k_pages.ndim == 5
     if stacked and layer is None:
         raise ValueError("stacked (5D) pages require a layer index")
@@ -754,30 +747,29 @@ def paged_attention_decode_dma3(
     hd = hd_page
     r_pad = max(rows, _MIN_SUBLANES)
     if stacked:
-        def q_map(bi, ci, lay, bt, cl):
-            return (bi, 0, 0, 0)
+        def q_map(bi, hi, ci, lay, bt, cl):
+            return (bi, hi, 0, 0)
         prefetch_args = (jnp.asarray(layer, jnp.int32).reshape(1),)
     else:
-        def q_map(bi, ci, bt, cl):
-            return (bi, 0, 0, 0)
+        def q_map(bi, hi, ci, bt, cl):
+            return (bi, hi, 0, 0)
         prefetch_args = ()
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2 + len(prefetch_args),
-        grid=(b, c),
+        grid=(b, kh, c),
         in_specs=[
-            pl.BlockSpec((1, kh, rows, hd), q_map),
+            pl.BlockSpec((1, 1, rows, hd), q_map),
             pl.BlockSpec(memory_space=pltpu.ANY),
             pl.BlockSpec(memory_space=pltpu.ANY),
         ],
-        out_specs=pl.BlockSpec((1, kh, rows, hd), q_map),
+        out_specs=pl.BlockSpec((1, 1, rows, hd), q_map),
         scratch_shapes=[
-            pltpu.VMEM((2, kh, cp * bs, hd), k_pages.dtype),
-            pltpu.VMEM((2, kh, cp * bs, hd), k_pages.dtype),
-            pltpu.VMEM((kh, r_pad, _STAT_LANES), jnp.float32),
-            pltpu.VMEM((kh, r_pad, _STAT_LANES), jnp.float32),
-            pltpu.VMEM((kh, r_pad, hd), jnp.float32),
-            pltpu.SMEM((1,), jnp.int32),
+            pltpu.VMEM((2, cp * bs, hd), k_pages.dtype),
+            pltpu.VMEM((2, cp * bs, hd), k_pages.dtype),
+            pltpu.VMEM((r_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((r_pad, _STAT_LANES), jnp.float32),
+            pltpu.VMEM((r_pad, hd), jnp.float32),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
@@ -791,10 +783,10 @@ def paged_attention_decode_dma3(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
         compiler_params=CompilerParams(
-            # sequential grid order is load-bearing: the cross-step
-            # prefetch and the one-time V zero-fill both assume linear
-            # t = b*C + ci execution.
-            dimension_semantics=("arbitrary", "arbitrary"),
+            # Lanes are independent (private scratch, per-lane prologue
+            # and DMA pipeline); only the chunk walk within a lane is
+            # order-dependent.
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(*prefetch_args, block_tables.astype(jnp.int32),

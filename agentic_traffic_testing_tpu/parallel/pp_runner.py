@@ -248,6 +248,7 @@ class PPRunner(ModelRunner):
     #                                    caching): engine refuses at build
     supports_hybrid = False            # no staged hybrid jit either
     supports_prefill_pipeline = False  # no staged pipelined-chunk jit
+    supports_decode_overlap = False    # no donated-state staged decode jit
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

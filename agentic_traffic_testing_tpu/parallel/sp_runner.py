@@ -62,9 +62,11 @@ class SPPrefillRunner(ModelRunner):
     chunk_attn_mode = "ring_sp"
     supports_chunked_prefill = True
     # No mesh wrapper for the ragged hybrid step (see TPRunner), nor for
-    # the pipelined-prefill chunk jit; engine refuses both knobs at build.
+    # the pipelined-prefill chunk jit, nor a donated-state decode jit for
+    # the overlapped loop; engine refuses all three knobs at build.
     supports_hybrid = False
     supports_prefill_pipeline = False
+    supports_decode_overlap = False
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,
@@ -135,6 +137,7 @@ class SPTPRunner(TPRunner):
     chunk_attn_mode = "ring_sp"   # chunk-ring hybrid, heads tp-sharded
     supports_chunked_prefill = True
     supports_prefill_pipeline = False  # see SPPrefillRunner
+    supports_decode_overlap = False    # see SPPrefillRunner
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

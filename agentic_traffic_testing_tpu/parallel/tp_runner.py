@@ -62,6 +62,9 @@ class TPRunner(ModelRunner):
     # No sharded wrapper for the pipelined-prefill chunk jit either; the
     # engine refuses prefill_pipeline_chunks >= 2 at build.
     supports_prefill_pipeline = False
+    # No donated-state sharded decode jit for the overlapped decode loop;
+    # the engine refuses decode_overlap=1 at build.
+    supports_decode_overlap = False
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

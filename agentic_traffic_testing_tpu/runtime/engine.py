@@ -30,7 +30,7 @@ import dataclasses
 import logging
 import time
 import uuid
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Optional
 
 import jax
@@ -127,6 +127,23 @@ class EngineConfig:
     # the serial scheduler. Pair with warmup_hybrid_buckets() so the
     # (batch, chunk) shapes never compile mid-traffic.
     hybrid_token_budget: int = 0
+    # Overlapped decode loop (round 7 — the bs32 roofline_frac culprit's
+    # host half): while fused-step N executes on device, the engine
+    # dispatches fused-step N+1 against the PREDICTED composition (decode
+    # composition only changes on EOS/stop/admission, which the host
+    # observes one readback late anyway) — the scheduler's
+    # composition_stable hint skips the full per-dispatch plan() pass,
+    # block tables stay device-resident and grow by an incremental scatter
+    # of only the changed cells (ops/pallas/kv_write.update_table_cells)
+    # instead of a host rebuild + [B, W] upload, and the DecodeState carry
+    # is donated (runner.decode_overlapped's two-slot ping-pong). On a
+    # mispredict (a stop landed, an admission opened) the speculative
+    # dispatch's post-stop outputs are discarded at harvest and the step
+    # re-runs on the corrected batch via the normal drain + re-plan, so
+    # token streams are identical to the serial loop. 0 (default) keeps
+    # every path bit-identical to today. Single-chip, non-speculative
+    # runners only (tp/sp/pp and speculation refuse at build).
+    decode_overlap: int = 0
     # Content-addressed reuse of full prompt blocks (vLLM automatic-prefix-
     # caching analog); cached requests prefill only their suffix.
     prefix_caching: bool = False
@@ -208,6 +225,16 @@ class EngineConfig:
             raise ValueError(
                 "prefill_pipeline_chunks x speculation is not wired — "
                 "disable one of them")
+        if self.decode_overlap not in (0, 1):
+            raise ValueError(
+                f"decode_overlap must be 0 or 1, got {self.decode_overlap}")
+        if self.decode_overlap and self.speculation:
+            # The overlap fast path skips the per-dispatch host sync the
+            # speculative history re-upload depends on, and the spec jit
+            # has no donated-state variant; refuse at build, not first step.
+            raise ValueError(
+                "decode_overlap x speculation is not wired — disable one "
+                "of them")
         if self.host_cache_gb < 0:
             raise ValueError(
                 f"host_cache_gb must be >= 0, got {self.host_cache_gb}")
@@ -279,15 +306,19 @@ class _Inflight:
 
     `counts` is None for plain decode (every token row is fully emitted);
     for speculative decode it is the [B, K] per-iteration emitted-token
-    counts matching tokens [B, K, spec_tokens+1]."""
+    counts matching tokens [B, K, spec_tokens+1]. `predicted` marks an
+    overlap fast-path dispatch (issued against the predicted composition
+    without a plan() reconcile — the mispredict accounting's unit)."""
 
-    __slots__ = ("tokens", "requests", "counts")
+    __slots__ = ("tokens", "requests", "counts", "predicted")
 
     def __init__(self, tokens: jax.Array, requests: list[Request],
-                 counts: Optional[jax.Array] = None) -> None:
+                 counts: Optional[jax.Array] = None,
+                 predicted: bool = False) -> None:
         self.tokens = tokens
         self.requests = requests
         self.counts = counts
+        self.predicted = predicted
 
 
 class LLMEngine:
@@ -398,6 +429,16 @@ class LLMEngine:
                 f"{type(self.runner).__name__} does not support the "
                 f"pipelined-prefill path — build the engine with "
                 f"prefill_pipeline_chunks=0 (unset LLM_PREFILL_PIPELINE)")
+        if cfg.decode_overlap and (
+                not getattr(self.runner, "supports_decode_overlap", False)
+                or getattr(self.runner, "spec_tokens", 0) > 0):
+            # Mesh runners have no donated-state decode jit; a caller-
+            # supplied speculative runner reaches here even though the
+            # config validator already refuses the cfg-level combination.
+            raise ValueError(
+                f"{type(self.runner).__name__} does not support the "
+                f"overlapped decode loop — build the engine with "
+                f"decode_overlap=0 (unset LLM_DECODE_OVERLAP)")
 
         num_blocks = cfg.num_blocks or self._default_num_blocks()
         kv_dtype = (jnp.float8_e4m3fn if cfg.kv_cache_dtype in ("fp8", "fp8_e4m3")
@@ -455,13 +496,26 @@ class LLMEngine:
         # Pipelined-prefill chunk dispatches issued (cumulative; the
         # llm_prefill_pipeline_dispatches_total gauge).
         self.num_pipeline_dispatches = 0
+        # Overlapped-decode accounting (round 7): fast-path dispatches
+        # issued against a predicted composition, and mispredict events —
+        # a churn (stop/admission/abort) surfacing while predicted
+        # dispatches were still in flight, i.e. speculative device work
+        # whose post-stop tail the harvest discarded
+        # (llm_decode_overlap_mispredicts_total).
+        self.num_overlap_dispatches = 0
+        self.num_overlap_mispredicts = 0
+        self._overlap_unharvested = 0   # predicted dispatches not yet applied
+        self._decode_epoch = -1         # scheduler epoch the armed batch saw
         # Memoized SamplingArrays keyed by the (padded, per-lane params)
         # composition: recurring waves of identical generation params (the
         # bench shape, and any steady fan-out traffic) reuse the uploaded
         # device arrays instead of rebuilding four host arrays + four
         # transfers per composition change (ROADMAP bs32 host-overhead
-        # nibble).
-        self._samp_cache: dict = {}
+        # nibble). An OrderedDict so the capacity bound evicts LRU
+        # (move-to-end on hit) instead of the old wholesale clear(),
+        # which made a churning composition mix periodically re-pay every
+        # rebuild the memo existed to avoid.
+        self._samp_cache: OrderedDict = OrderedDict()
         self._decode_requests: list[Request] = []   # composition of device state
         self._decode_state: Optional[DecodeState] = None
         self._decode_tables: Optional[jax.Array] = None
@@ -544,7 +598,14 @@ class LLMEngine:
                 state = DecodeState(tokens=tokens, positions=positions,
                                     steps=steps)
             samp = self._sampling_arrays([], b)
-            result = self.runner.decode(self.cache, tables, state, samp)
+            # Warm the program the live loop will actually run: the
+            # overlapped (donated-state) jit under decode_overlap, the
+            # plain one otherwise — else the first fast-path dispatch
+            # would cold-compile mid-traffic.
+            decode = (self.runner.decode_overlapped
+                      if self.cfg.decode_overlap and spec == 0
+                      else self.runner.decode)
+            result = decode(self.cache, tables, state, samp)
             # decode donates the cache: keep the returned one (dummy writes
             # went to the trash block; real pages are untouched).
             self.cache = result[1]
@@ -687,6 +748,10 @@ class LLMEngine:
         # Mark aborted BEFORE draining: _apply_inflight_host skips
         # non-RUNNING lanes, so no token computed-but-unharvested at abort
         # time lands on the request.
+        if self._overlap_unharvested > 0 and req in self._decode_requests:
+            # Overlap mispredict: speculative dispatches in flight carry
+            # tokens for the aborted lane that the drain below discards.
+            self.num_overlap_mispredicts += 1
         req.state = RequestState.ABORTED
         req.finish_reason = FinishReason.ABORT
         req.finish_time = time.monotonic()
@@ -877,6 +942,7 @@ class LLMEngine:
         self._decode_tables = tables_dev
         self._decode_samp = samp
         self._decode_block_counts = [r.blocks.num_blocks for r in reqs]
+        self._decode_epoch = self.scheduler.composition_epoch
         self._inflight.append(_Inflight(first, list(reqs)))
 
     def _run_prefill_pipelined(self, plan: PrefillBatch, c: int) -> None:
@@ -928,6 +994,7 @@ class LLMEngine:
         self._decode_tables = tables_dev
         self._decode_samp = samp
         self._decode_block_counts = [r.blocks.num_blocks for r in reqs]
+        self._decode_epoch = self.scheduler.composition_epoch
         self._inflight.append(_Inflight(first, list(reqs)))
 
     def _register_prefix(self, r: Request) -> None:
@@ -1173,6 +1240,7 @@ class LLMEngine:
         self._decode_tables = jnp.asarray(tables)
         self._decode_samp = self._sampling_arrays(reqs, b)
         self._decode_block_counts = [r.blocks.num_blocks for r in reqs]
+        self._decode_epoch = self.scheduler.composition_epoch
 
     def _refresh_decode_tables(self) -> None:
         """Re-upload block tables if any sequence grew into new blocks.
@@ -1190,6 +1258,57 @@ class LLMEngine:
         self._fill_tables(self._decode_requests, tables)
         self._decode_tables = jnp.asarray(tables)
         self._decode_block_counts = counts
+
+    def _refresh_decode_tables_incremental(self) -> None:
+        """Overlap fast-path table maintenance: the [B, W] table stays
+        device-resident and only the cells where a lane grew into new
+        blocks are scattered in (ops/pallas/kv_write.update_table_cells) —
+        an O(changed) upload instead of the serial path's full host
+        rebuild + [B, W] transfer per block-boundary crossing (at bs32 /
+        K=32 every lane crosses every dispatch, so that rebuild was pure
+        per-step host work scaling with B)."""
+        counts = [r.blocks.num_blocks for r in self._decode_requests]
+        if counts == self._decode_block_counts:
+            return
+        rows: list[int] = []
+        cols: list[int] = []
+        vals: list[int] = []
+        for i, (r, old, new) in enumerate(zip(
+                self._decode_requests, self._decode_block_counts, counts)):
+            if new < old:
+                # A shrink cannot happen on a stable composition; if it
+                # somehow does, the full rebuild is always correct.
+                self._refresh_decode_tables()
+                return
+            if new == old:
+                continue
+            # One property read per grown lane: with the native allocator
+            # .blocks marshals the whole block list across FFI, so reading
+            # it per CELL would re-pay O(num_blocks) per new block.
+            blk = r.blocks.blocks
+            for j in range(old, min(new, self.table_width)):
+                rows.append(i)
+                cols.append(j)
+                vals.append(blk[j])
+        self._decode_block_counts = counts
+        if not rows:
+            return  # growth past the table width only (table_row clamps too)
+        from agentic_traffic_testing_tpu.ops.pallas.kv_write import (
+            update_table_cells,
+        )
+
+        # Pad to a pow2 length by repeating the first triple (idempotent
+        # per cell): one compiled scatter per bucket, not per update count.
+        n = 1 << (len(rows) - 1).bit_length()
+        pad = n - len(rows)
+        if pad:
+            rows += rows[:1] * pad
+            cols += cols[:1] * pad
+            vals += vals[:1] * pad
+        self._decode_tables = update_table_cells(
+            self._decode_tables,
+            jnp.asarray(rows, jnp.int32), jnp.asarray(cols, jnp.int32),
+            jnp.asarray(vals, jnp.int32))
 
     def _decode_budget_satisfied(self) -> bool:
         """True when no running decode lane still needs tokens beyond what
@@ -1223,10 +1342,35 @@ class LLMEngine:
     def _dispatch_decode(self) -> None:
         if self._decode_state is None:
             return
+        if (self.cfg.decode_overlap
+                and self.scheduler.composition_stable(self._decode_epoch)):
+            # Overlap fast path: the composition epoch is unchanged since
+            # this batch was armed, so plan() would hand back the same
+            # DecodeBatch — dispatch fused-step N+1 against that predicted
+            # composition NOW (while step N executes), paying only the
+            # O(B) capacity grow and the incremental table scatter instead
+            # of the full sorted plan + host table rebuild. Reconciliation
+            # happens at harvest: a stop/admission surfacing there
+            # invalidates the pipeline, discards the speculative tail, and
+            # the next step re-plans the corrected batch — token streams
+            # stay identical to the serial loop.
+            if self.scheduler.extend_decode(self._decode_requests):
+                self._refresh_decode_tables_incremental()
+                self._do_decode_dispatch(predicted=True)
+                return
+            # KV pool exhausted mid-wave: fall through to the full plan,
+            # which re-grows survivors and preempts exactly as the serial
+            # schedule would.
         # KV headroom for this step (may preempt; then state must be rebuilt).
         plan = self.scheduler.plan()
         if isinstance(plan, DecodeBatch) and plan.requests == self._decode_requests:
             self._refresh_decode_tables()
+            # Same composition confirmed by a full plan: re-arm the
+            # overlap hint (an unadmittable arrival bumps the epoch
+            # without changing the decode batch — without this re-snapshot
+            # one such arrival would force the slow path for the rest of
+            # the wave).
+            self._decode_epoch = self.scheduler.composition_epoch
             self._do_decode_dispatch()
             return
         # Composition changed (preemption / drain-out): sync fully first.
@@ -1241,8 +1385,16 @@ class LLMEngine:
         # members and released their blocks — so re-plan from current state.
         self._plan_and_dispatch()
 
-    def _do_decode_dispatch(self) -> None:
-        result = self.runner.decode(
+    def _do_decode_dispatch(self, predicted: bool = False) -> None:
+        # Under decode_overlap every decode dispatch runs the donated-state
+        # jit (spec is refused at build), so ONE program serves both the
+        # armed first dispatch and the fast-path ones — no duplicate
+        # compiles per bucket. The old state leaves are consumed by the
+        # donation; nothing else references them (the handoff's readback
+        # entry is a separate [B, 1] buffer).
+        decode = (self.runner.decode_overlapped if self.cfg.decode_overlap
+                  else self.runner.decode)
+        result = decode(
             self.cache, self._decode_tables, self._decode_state, self._decode_samp
         )
         counts = None
@@ -1255,8 +1407,12 @@ class LLMEngine:
                 arr.copy_to_host_async()
             except Exception:
                 pass
+        if predicted:
+            self.num_overlap_dispatches += 1
+            self._overlap_unharvested += 1
         self._inflight.append(
-            _Inflight(out, list(self._decode_requests), counts))
+            _Inflight(out, list(self._decode_requests), counts,
+                      predicted=predicted))
 
     def _sampling_arrays(self, reqs: list[Request], padded: int) -> SamplingArrays:
         # Memoized on the full per-lane param composition: identical
@@ -1269,6 +1425,7 @@ class LLMEngine:
             for r in reqs))
         cached = self._samp_cache.get(key)
         if cached is not None:
+            self._samp_cache.move_to_end(key)  # LRU bump
             return cached
         # None entries are padding gaps (the hybrid step places the chunk's
         # request at lane `padded_batch`, past the real decode lanes).
@@ -1287,8 +1444,11 @@ class LLMEngine:
             temperature=jnp.asarray(temp), top_k=jnp.asarray(top_k),
             top_p=jnp.asarray(top_p), seeds=jnp.asarray(seeds),
         )
-        if len(self._samp_cache) >= 256:  # bound the memo under churn
-            self._samp_cache.clear()
+        if len(self._samp_cache) >= 256:
+            # Bound the memo under churn by evicting LRU — a wholesale
+            # clear() here used to make a churning composition mix
+            # periodically re-pay every rebuild it had memoized.
+            self._samp_cache.popitem(last=False)
         self._samp_cache[key] = arrays
         return arrays
 
@@ -1327,6 +1487,11 @@ class LLMEngine:
             toks = np.asarray(next(fetched))
             counts = (np.asarray(next(fetched))
                       if inf.counts is not None else None)
+            if inf.predicted:
+                # Decrement BEFORE applying: if this entry's tokens finish
+                # a lane, the mispredict check must see only the
+                # speculative dispatches issued AFTER this one.
+                self._overlap_unharvested -= 1
             self._apply_inflight_host(inf.requests, toks, counts)
 
     def _any_request_gone(self, inf: _Inflight) -> bool:
@@ -1388,6 +1553,16 @@ class LLMEngine:
         # composition — harvesting a previous (early-released) wave's finish
         # must not stall the wave already decoding.
         if r in self._decode_requests:  # identity: Request is eq=False
+            if self._overlap_unharvested > 0:
+                # Overlap mispredict: a stop landed while fast-path
+                # dispatches issued AFTER it were still in flight — their
+                # post-stop tails for this lane are discarded at harvest
+                # and the next step re-plans the corrected batch
+                # (llm_decode_overlap_mispredicts_total). The wave-release
+                # and budget-satisfied teardowns never reach here with
+                # outstanding predicted work that isn't still needed, so
+                # this counts only genuinely wasted speculation.
+                self.num_overlap_mispredicts += 1
             self._invalidate_decode_state()
 
     def _invalidate_decode_state(self) -> None:

@@ -282,12 +282,27 @@ class ModelRunner:
                         attn_axis=self.attn_axis),
                 donate_argnames=("cache",),
             )
+            self._decode_overlapped = None  # engine refuses overlap x spec
         else:
             self._decode = jax.jit(
                 partial(_decode_sample_impl, cfg=cfg, num_steps=self.decode_steps,
                         attn_mode=self.attn_mode, attn_mesh=self.attn_mesh,
                         attn_axis=self.attn_axis),
                 donate_argnames=("cache",),
+            )
+            # Overlapped-decode variant (LLM_DECODE_OVERLAP): identical
+            # numerics, but the DecodeState carry is DONATED too. With the
+            # engine dispatching fused-step N+1 while N still executes,
+            # XLA then ping-pongs exactly two state buffer sets (the
+            # "two-slot carry") instead of allocating fresh [B] leaves per
+            # dispatch — no host-side array churn in the hot loop. A
+            # separate jit so the default path's programs stay
+            # byte-identical to pre-knob builds.
+            self._decode_overlapped = jax.jit(
+                partial(_decode_sample_impl, cfg=cfg, num_steps=self.decode_steps,
+                        attn_mode=self.attn_mode, attn_mesh=self.attn_mesh,
+                        attn_axis=self.attn_axis),
+                donate_argnames=("cache", "state"),
             )
 
     #: chips the KV cache is sharded across (overridden by parallel/tp_runner.py)
@@ -337,6 +352,14 @@ class ModelRunner:
     #: jit replicated would serve the knob's name without its meaning
     #: (parallel/ runners set False).
     supports_prefill_pipeline: bool = True
+    #: whether this runner serves the engine's overlapped decode loop
+    #: (decode_overlap=1, round 7): the fast path needs the donated
+    #: two-slot decode jit above. The mesh runners don't — their sharded
+    #: decode wrappers were built without state donation, and the fast
+    #: path's device-resident table scatter has no shard_map rule, so the
+    #: engine refuses the knob at build (parallel/ runners set False),
+    #: matching the hybrid/pipeline precedent.
+    supports_decode_overlap: bool = True
 
     def prepare_cache(self, cache: KVCache) -> KVCache:
         """Hook for placing a freshly allocated cache (TP runner shards it)."""
@@ -394,6 +417,16 @@ class ModelRunner:
         [B, decode_steps]) — the engine keeps counts[b, k] tokens of row k."""
         return self._decode(self.params, cache=cache, block_tables=block_tables,
                             state=state, samp=samp)
+
+    def decode_overlapped(self, cache, block_tables, state, samp):
+        """decode() with the DecodeState carry donated (LLM_DECODE_OVERLAP
+        hot loop; non-speculative only). Callers must treat `state` as
+        consumed — the engine replaces its reference with the returned
+        state, and the in-flight token outputs are separate buffers, so
+        the donation is invisible outside the dispatch."""
+        return self._decode_overlapped(
+            self.params, cache=cache, block_tables=block_tables,
+            state=state, samp=samp)
 
     def compile_stats(self) -> dict:
         return {

@@ -173,6 +173,14 @@ class Scheduler:
         self.num_scheduled_prefills = 0
         self.num_scheduled_decodes = 0
         self.num_scheduled_hybrid = 0  # fused chunk+decode steps
+        # Composition epoch (round 7, the overlapped-decode hint): bumped
+        # whenever the waiting/running membership changes — admission,
+        # finish, abort, preemption, a new arrival. The engine snapshots it
+        # when it arms a decode batch; an unchanged epoch means plan()
+        # would return the same DecodeBatch, so the overlap fast path can
+        # dispatch against the predicted composition via extend_decode()
+        # without paying the full sorted capacity pass per dispatch.
+        self.composition_epoch = 0
 
     # -- admission ---------------------------------------------------------
 
@@ -194,6 +202,32 @@ class Scheduler:
             )
         req.state = RequestState.WAITING
         self.waiting.append(req)
+        self.composition_epoch += 1
+
+    def composition_stable(self, epoch: int) -> bool:
+        """True when no membership change has happened since `epoch` was
+        read off `composition_epoch` — the overlapped-decode loop's
+        no-churn hint (a stale epoch sends the engine back through the
+        full plan()/reconcile path)."""
+        return epoch == self.composition_epoch
+
+    def extend_decode(self, requests: list[Request]) -> bool:
+        """Grow per-lane KV capacity for ONE more fused decode dispatch
+        over an unchanged composition, skipping plan()'s arrival sort and
+        preemption pass (the per-dispatch host work that scales with B —
+        the bs32 roofline_frac culprit). Capacity targets are identical
+        to _plan_decode's, and growth is idempotent, so a False return
+        (pool exhausted, or a lane no longer RUNNING) simply falls back
+        to the full pass, which re-grows the survivors and preempts
+        exactly as the serial schedule would have."""
+        for r in requests:
+            if (r.state is not RequestState.RUNNING or r.blocks is None
+                    or r.is_prefilling):
+                return False
+            if not self._ensure_decode_capacity(r):
+                return False
+        self.num_scheduled_decodes += 1
+        return True
 
     def can_admit_head(self) -> bool:
         """Cheap check: could plan() admit the head of the waiting queue right
@@ -308,6 +342,7 @@ class Scheduler:
                             padded_len=padded)
 
     def abort(self, req: Request) -> None:
+        self.composition_epoch += 1
         if req in self.running:
             self.running.remove(req)
         try:
@@ -420,6 +455,7 @@ class Scheduler:
                 self.allocator.record_host_hit(host_tokens)
         head.state = RequestState.RUNNING
         self.running.append(self.waiting.popleft())
+        self.composition_epoch += 1
         return head
 
     def _plan_prefill(self) -> Union[PrefillBatch, ChunkPrefill, None]:
@@ -494,6 +530,7 @@ class Scheduler:
         if not batch:
             return None
         record = getattr(self.allocator, "record_prefix_stats", None)
+        self.composition_epoch += 1
         for r in batch:
             if record is not None:  # cache misses still count as queries
                 record(r.num_prompt_tokens, 0)
@@ -585,6 +622,7 @@ class Scheduler:
 
     def _preempt(self, req: Request) -> None:
         """Evict to the waiting queue; its KV is recomputed on re-admission."""
+        self.composition_epoch += 1
         self._release(req)
         req.state = RequestState.PREEMPTED
         req.num_preemptions += 1
@@ -602,6 +640,7 @@ class Scheduler:
     # -- completion --------------------------------------------------------
 
     def finish(self, req: Request) -> None:
+        self.composition_epoch += 1
         if req in self.running:
             self.running.remove(req)
         self._release(req)

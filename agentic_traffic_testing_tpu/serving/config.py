@@ -82,6 +82,15 @@ class ServerConfig:
     # single-dispatch prefill bit-identical; single-chip runners only
     # (tp/sp/pp refuse at engine build), not wired with LLM_SPECULATION.
     prefill_pipeline_chunks: int = 0           # LLM_PREFILL_PIPELINE
+    # Overlapped decode loop (round 7): dispatch fused-step N+1 against
+    # the predicted composition while step N executes — skips the full
+    # per-dispatch schedule pass, keeps block tables device-resident
+    # (incremental scatter), donates the DecodeState carry. 0 (default)
+    # keeps the serial decode loop bit-identical; 1 is token-identical
+    # under EOS/admission/abort churn (runtime/engine.py). Single-chip,
+    # non-speculative runners only: refused here and at engine build
+    # with LLM_SPECULATION or tp/sp/pp meshes, not at first step.
+    decode_overlap: int = 0                    # LLM_DECODE_OVERLAP
     prefix_caching: bool = False               # LLM_PREFIX_CACHING
     # Host-RAM second tier for the prefix cache (runtime/kv_offload.py):
     # GB of host memory for evicted prefix blocks; restored device-side on
@@ -174,6 +183,18 @@ class ServerConfig:
                 f"LLM_PREFILL_PIPELINE must be >= 0, got "
                 f"{c.prefill_pipeline_chunks} (unset it for the "
                 f"single-dispatch prefill)")
+        c.decode_overlap = int(
+            os.environ.get("LLM_DECODE_OVERLAP") or c.decode_overlap)
+        if c.decode_overlap not in (0, 1):
+            raise ValueError(
+                f"LLM_DECODE_OVERLAP must be 0 or 1, got {c.decode_overlap} "
+                f"(unset it for the serial decode loop)")
+        if c.decode_overlap and (os.environ.get("LLM_SPECULATION") or None):
+            # Same refusal the engine makes at build — surfaced at env
+            # parse so a compose file learns before any model loads.
+            raise ValueError(
+                "LLM_DECODE_OVERLAP x LLM_SPECULATION is not wired — "
+                "disable one of them")
         c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
         c.host_cache_gb = float(
             os.environ.get("LLM_HOST_CACHE_GB") or c.host_cache_gb)
@@ -239,6 +260,9 @@ class ServerConfig:
                        default=c.prefill_pipeline_chunks,
                        help="pipelined-prefill position-chunk count "
                             "(0 = single-dispatch prefill)")
+        p.add_argument("--decode-overlap", type=int, default=c.decode_overlap,
+                       help="1 = overlapped decode loop (speculative "
+                            "next-step dispatch; 0 = serial)")
         p.add_argument("--enable-prefix-caching", dest="prefix_caching",
                        action="store_true", default=c.prefix_caching)
         p.add_argument("--host-cache-gb", type=float, default=c.host_cache_gb,
@@ -261,7 +285,7 @@ class ServerConfig:
                   "router_policy", "quantization",
                   "decode_steps", "prefill_chunk_tokens",
                   "prefill_batch_max_len", "prefill_pipeline_chunks",
-                  "prefix_caching",
+                  "decode_overlap", "prefix_caching",
                   "host_cache_gb", "hybrid_token_budget",
                   "num_blocks", "block_size", "weights_path",
                   "speculation", "spec_tokens", "spec_ngram"):
@@ -272,4 +296,12 @@ class ServerConfig:
             raise ValueError(
                 "--host-cache-gb requires --enable-prefix-caching (the host "
                 "tier extends the content-addressed prefix cache)")
+        if c.decode_overlap not in (0, 1):
+            raise ValueError(
+                f"--decode-overlap must be 0 or 1, got {c.decode_overlap}")
+        if c.decode_overlap and c.speculation:
+            # Re-check after CLI overrides (--speculation may arrive here).
+            raise ValueError(
+                "--decode-overlap does not compose with --speculation — "
+                "disable one of them")
         return c

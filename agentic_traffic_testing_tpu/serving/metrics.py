@@ -90,6 +90,20 @@ class LLMMetrics:
             f"{prefix}_prefill_pipeline_dispatches_total",
             "Pipelined-prefill chunk dispatches issued (cumulative)",
             registry=r)
+        self.config_decode_overlap = Gauge(
+            f"{prefix}_config_decode_overlap",
+            "Overlapped decode loop enabled (LLM_DECODE_OVERLAP; 0 = serial "
+            "decode dispatch)", registry=r)
+        # Additive (no reference analog): overlapped-decode reconciliation.
+        # Stays 0 unless LLM_DECODE_OVERLAP=1 routes decode through the
+        # predicted-composition fast path (runtime/engine.py
+        # _dispatch_decode) AND a stop/admission/abort lands while
+        # speculative dispatches are in flight.
+        self.decode_overlap_mispredicts = Gauge(
+            f"{prefix}_decode_overlap_mispredicts_total",
+            "Overlapped-decode mispredict events: composition churn "
+            "discarding in-flight speculative dispatch output (cumulative)",
+            registry=r)
         # Per-replica labeled series exist ONLY under a replica pool: at
         # num_replicas=1 no replica-labeled family appears (the one
         # addition to the single-engine payload is the config gauge above).
@@ -262,6 +276,11 @@ class LLMMetrics:
         scrape; stays 0 while the knob is off)."""
         self.prefill_pipeline_dispatches.set(dispatches)
 
+    def set_decode_overlap_stats(self, *, mispredicts: int) -> None:
+        """Refresh the overlapped-decode mispredict counter (called on
+        scrape; stays 0 while the knob is off)."""
+        self.decode_overlap_mispredicts.set(mispredicts)
+
     def set_spec_stats(self, *, emitted: int, iters: int) -> None:
         """Refresh speculation-acceptance gauges (called on scrape; zeros
         until a speculative engine has decoded something)."""
@@ -285,7 +304,8 @@ class LLMMetrics:
                           memory_utilization: float, max_tokens: int,
                           tp_size: int = 1, sp_size: int = 1,
                           pp_size: int = 1, num_replicas: int = 1,
-                          prefill_pipeline_chunks: int = 0) -> None:
+                          prefill_pipeline_chunks: int = 0,
+                          decode_overlap: int = 0) -> None:
         # max_num_seqs/max_num_batched_tokens stay PER-REPLICA values (the
         # configured knob, a config snapshot — docs/monitoring.md); the
         # pool-wide seat count is num_replicas * max_num_seqs.
@@ -298,6 +318,7 @@ class LLMMetrics:
         self.config_pp_size.set(pp_size)
         self.config_num_replicas.set(num_replicas)
         self.config_prefill_pipeline_chunks.set(prefill_pipeline_chunks)
+        self.config_decode_overlap.set(decode_overlap)
 
     def set_kv_gauges(self, *, num_blocks: int, block_size: int,
                       max_model_len: int, max_num_seqs: int) -> None:

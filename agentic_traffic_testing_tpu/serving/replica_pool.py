@@ -196,6 +196,14 @@ class EnginePool:
         return sum(e.num_pipeline_dispatches for e in self.engines)
 
     @property
+    def num_overlap_dispatches(self) -> int:
+        return sum(e.num_overlap_dispatches for e in self.engines)
+
+    @property
+    def num_overlap_mispredicts(self) -> int:
+        return sum(e.num_overlap_mispredicts for e in self.engines)
+
+    @property
     def usable_tokens(self) -> int:
         return sum(e.cache.usable_tokens for e in self.engines)
 

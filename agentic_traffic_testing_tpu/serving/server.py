@@ -179,6 +179,7 @@ class LLMServer:
                 pp_size=cfg.pp_size,
                 num_replicas=cfg.num_replicas,
                 prefill_pipeline_chunks=cfg.prefill_pipeline_chunks,
+                decode_overlap=cfg.decode_overlap,
             )
             if self.pool is not None:
                 # Pool aggregate under the EXACT pre-pool names: blocks and
@@ -220,6 +221,7 @@ class LLMServer:
             prefill_chunk_tokens=c.prefill_chunk_tokens,
             prefill_batch_max_len=c.prefill_batch_max_len,
             prefill_pipeline_chunks=c.prefill_pipeline_chunks,
+            decode_overlap=c.decode_overlap,
             prefix_caching=c.prefix_caching,
             host_cache_gb=c.host_cache_gb,
             hybrid_token_budget=c.hybrid_token_budget,
@@ -521,6 +523,8 @@ class LLMServer:
                                     iters=source.spec_iters)
         self.metrics.set_prefill_pipeline_stats(
             dispatches=getattr(source, "num_pipeline_dispatches", 0))
+        self.metrics.set_decode_overlap_stats(
+            mispredicts=getattr(source, "num_overlap_mispredicts", 0))
         if self.pool is not None:
             self.metrics.set_replica_stats(self.pool.replica_stats())
         return web.Response(body=self.metrics.render(),

@@ -976,6 +976,156 @@ def main() -> None:
             print(f"bench: prefill pipeline probe dropped ({e!r})",
                   file=sys.stderr)
 
+    # Decode anatomy + overlapped-decode A/B (round 7): the decode twin of
+    # prefill_anatomy, scoring the bs32 roofline_frac gap (0.546 vs 0.794
+    # at bs8 in BENCH_r05). Splits the per-dispatch decode wall into
+    # host_s (schedule + table maintenance + readback bookkeeping — the
+    # term that grows with B) vs device_s (timed back-to-back re-dispatch
+    # of the compiled fused step, dispatch overhead amortized away), then
+    # A/Bs the engine loop with LLM_DECODE_OVERLAP on vs off under a
+    # token-identity gate. Best-effort like every secondary series;
+    # BENCH_DECODE_ANATOMY=0 disables.
+    decode_anatomy_on = os.environ.get(
+        "BENCH_DECODE_ANATOMY", "1") not in ("0", "false")
+
+    def decode_anatomy_for(target: LLMEngine, bs: int, prefix: str) -> dict:
+        """Per-dispatch host/device split for one engine's decode loop."""
+        import jax.numpy as jnp
+
+        from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK
+        from agentic_traffic_testing_tpu.runtime.runner import DecodeState
+
+        k = target.runner.decode_steps
+        tables = jnp.full((bs, target.table_width), TRASH_BLOCK, jnp.int32)
+        samp = target._sampling_arrays([], bs)
+        state = DecodeState(tokens=jnp.zeros((bs,), jnp.int32),
+                            positions=jnp.zeros((bs,), jnp.int32),
+                            steps=jnp.zeros((bs,), jnp.int32))
+
+        def one(st):
+            st, target.cache, out = target.runner.decode(
+                target.cache, tables, st, samp)
+            return st, out
+
+        state, out = one(state)  # already compiled by the warm wave; settle
+        jax.block_until_ready(out)
+        singles = []
+        for _ in range(3):
+            t0 = time.monotonic()
+            state, out = one(state)
+            jax.block_until_ready(out)
+            singles.append(time.monotonic() - t0)
+        single_s = min(singles)
+        depth = 8
+        t0 = time.monotonic()
+        outs = []
+        for _ in range(depth):
+            state, out = one(state)
+            outs.append(out)
+        jax.block_until_ready(outs)
+        device_s = (time.monotonic() - t0) / depth
+
+        # Engine-loop wall per dispatch: a full wave, timed from the first
+        # scheduled decode so prefill stays out of the denominator.
+        reqs = [target.add_request(
+            rng.integers(10, vocab - 10, prompt_len).tolist(),
+            SamplingParams(temperature=0.0, max_tokens=decode_tokens,
+                           ignore_eos=True)) for _ in range(bs)]
+        d0 = target.scheduler.num_scheduled_decodes
+        while (target.scheduler.num_scheduled_decodes == d0
+               and target.has_work()):
+            target.step()
+        d1 = target.scheduler.num_scheduled_decodes
+        t0 = time.monotonic()
+        while target.has_work() and not all(r.is_finished() for r in reqs):
+            target.step()
+        wall = time.monotonic() - t0
+        n = max(1, target.scheduler.num_scheduled_decodes - d1)
+        step_wall_s = wall / n
+        host_s = max(0.0, step_wall_s - device_s)
+        return {
+            f"{prefix}decode_anatomy_batch": bs,
+            f"{prefix}decode_single_dispatch_s": round(single_s, 5),
+            f"{prefix}decode_device_s": round(device_s, 5),
+            f"{prefix}decode_host_s": round(host_s, 5),
+            f"{prefix}decode_host_frac": round(
+                host_s / max(step_wall_s, 1e-9), 3),
+            f"{prefix}decode_device_toks_s": round(bs * k / device_s, 1),
+        }
+
+    def overlap_ab(bs: int) -> dict:
+        """Engine-isolated overlap on/off A/B at `bs` lanes with a
+        token-identity gate (greedy, fixed workload per arm)."""
+        ab_len = max(512, prompt_len + decode_tokens + 16)
+
+        def build(ov: int) -> LLMEngine:
+            return LLMEngine(EngineConfig(
+                model=model, dtype="bfloat16", max_num_seqs=bs,
+                max_model_len=ab_len,
+                num_blocks=max(512, bs * (-(-ab_len // cfg.block_size) + 4)),
+                decode_steps=decode_steps,
+                decode_overlap=ov,
+                kv_cache_dtype=kv_cache_dtype,
+            ), model_cfg=engine.model_cfg, runner=engine.runner)
+
+        out = {}
+        outputs = {}
+        for ov in (0, 1):
+            eng = build(ov)
+            wl = np.random.default_rng(31)  # reseeded: identical workload
+            prompts = [wl.integers(10, vocab - 10, prompt_len).tolist()
+                       for _ in range(2 * bs)]
+            sp = lambda: SamplingParams(temperature=0.0,
+                                        max_tokens=decode_tokens,
+                                        ignore_eos=True)
+            warm = [eng.add_request(p, sp()) for p in prompts[:bs]]
+            while eng.has_work() and not all(r.is_finished() for r in warm):
+                eng.step()
+            vals = []
+            for _ in range(reps):
+                reqs = [eng.add_request(p, sp()) for p in prompts]
+                t0 = time.monotonic()
+                while eng.has_work() and not all(
+                        r.is_finished() for r in reqs):
+                    eng.step()
+                dt = time.monotonic() - t0
+                vals.append(sum(len(r.output_ids) for r in reqs) / dt)
+            outputs[ov] = [r.output_ids for r in reqs]
+            key = "decode_overlap_toks_s" if ov else "decode_serial_toks_s"
+            out[key] = round(statistics.median(vals), 2)
+            if ov:
+                out["decode_overlap_dispatches"] = eng.num_overlap_dispatches
+                out["decode_overlap_mispredicts"] = (
+                    eng.num_overlap_mispredicts)
+        if outputs[0] != outputs[1]:
+            raise RuntimeError("overlap arm diverged from serial — "
+                               "refusing to report")
+        if not out.get("decode_overlap_dispatches"):
+            raise RuntimeError("overlap arm never took the fast path")
+        return out
+
+    decode_res = None
+    if decode_anatomy_on:
+        # Anatomy and the overlap A/B fail independently (like round 6's
+        # anatomy_res vs pipeline_res): a diverging/never-fast-path A/B
+        # must not discard the already-measured host/device split — that
+        # split is the attribution data the next hardware session records.
+        try:
+            decode_res = decode_anatomy_for(engine, batch, "")
+            if small_engine is not None:
+                decode_res.update(decode_anatomy_for(
+                    small_engine, small_batch, f"bs{small_batch}_"))
+        except Exception as e:
+            decode_res = None
+            print(f"bench: decode anatomy probe dropped ({e!r})",
+                  file=sys.stderr)
+        try:
+            ab = overlap_ab(batch)
+            decode_res = {**(decode_res or {}), **ab}
+        except Exception as e:
+            print(f"bench: decode overlap A/B dropped ({e!r})",
+                  file=sys.stderr)
+
     def roofline_for(bs: int) -> float:
         kv_bytes_step = (bs * mean_ctx * mcfg.num_layers * 2
                          * mcfg.num_kv_heads * hdp
@@ -1034,6 +1184,7 @@ def main() -> None:
         }),
         **({} if anatomy_res is None else anatomy_res),
         **({} if pipeline_res is None else pipeline_res),
+        **({} if decode_res is None else decode_res),
         "reps": reps,
     }))
 

@@ -1,0 +1,155 @@
+#!/usr/bin/env python3
+"""Overlapped-decode A/B: LLM_DECODE_OVERLAP on/off, engine-isolated.
+
+The engine-level A/B for the round-7 decode claims, isolated from the
+HTTP layer: a sustained multi-wave decode workload (the bs32
+roofline_frac shape ROADMAP flags) measured with the serial per-dispatch
+plan/table-rebuild loop (`serial`) vs the overlapped fast path
+(`overlap`, LLM_DECODE_OVERLAP=1 — speculative next-step dispatch against
+the predicted composition, incremental device-side table scatter, donated
+DecodeState carry). One JSON line per arm:
+
+    {"mode": "serial"|"overlap", "decode_toks_s": ...,
+     "overlap_dispatches": N, "mispredicts": M, "outputs_match": true}
+
+The workload deliberately churns: more requests than seats (admission
+mid-decode), mixed greedy/seeded sampling, mixed max_tokens, and an EOS
+stop token picked from a deterministic probe pass so some lanes stop
+mid-dispatch — exercising exactly the mispredict reconciliation the
+overlap path must get right. `outputs_match` asserts every arm's
+completions are token-identical (the correctness half of the claim; the
+engine suite additionally pins the serial path bit-identical —
+tests/test_decode_overlap.py). Both arms share ONE ModelRunner: the
+serial and overlapped decode programs are separate jits on the same
+runner, so sharing compiles each exactly once without cross-arm state.
+Numbers feed docs/BENCHMARKS.md once measured on hardware.
+
+Usage: python scripts/dev/decode_overlap_ab.py [n_requests] [prompt_len] [max_tokens]
+Env: OVERLAP_AB_MODEL (default: tiny fp32 on cpu, llama-3.2-1b bf16 on tpu),
+     OVERLAP_AB_SEATS (default 4 on cpu, 32 on tpu).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def run_arm(overlap: int, *, runner, model_cfg, model: str, dtype: str,
+            seats: int, n_requests: int, prompt_len: int, max_tokens: int,
+            reps: int) -> dict:
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+    block_size = 16
+    max_len = max(256, prompt_len + max_tokens + 64)
+    eng = LLMEngine(EngineConfig(
+        model=model, dtype=dtype, max_num_seqs=seats, max_model_len=max_len,
+        block_size=block_size,
+        num_blocks=max(256, seats * (-(-max_len // block_size) + 4)),
+        decode_overlap=overlap,
+    ), model_cfg=model_cfg, runner=runner)
+
+    wl = np.random.default_rng(29)  # reseeded per arm: identical workload
+    vocab = model_cfg.vocab_size
+    prompts = [wl.integers(10, vocab - 10, prompt_len).tolist()
+               for _ in range(n_requests)]
+
+    # Deterministic probe: one greedy completion picks the EOS token the
+    # churn wave will stop on — identical across arms by construction.
+    probe = eng.generate(prompts[0], SamplingParams(
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True))
+    stop_tok = probe.output_ids[len(probe.output_ids) // 2]
+
+    def sampling(i: int) -> SamplingParams:
+        # Mixed stop lengths + mixed greedy/seeded + a reachable stop
+        # token on the greedy lanes: stops land mid-dispatch, admissions
+        # follow, and the overlap path must reconcile both.
+        if i % 2 == 0:
+            return SamplingParams(temperature=0.0,
+                                  max_tokens=max_tokens - (i % 3),
+                                  stop_token_ids=[stop_tok])
+        return SamplingParams(temperature=0.8, top_k=20, seed=5 + i,
+                              max_tokens=max_tokens // 2 + (i % 4),
+                              ignore_eos=True)
+
+    def wave():
+        reqs = [eng.add_request(p, sampling(i))
+                for i, p in enumerate(prompts)]
+        t0 = time.monotonic()
+        while eng.has_work() and not all(r.is_finished() for r in reqs):
+            eng.step()
+        dt = time.monotonic() - t0
+        return reqs, sum(len(r.output_ids) for r in reqs) / dt
+
+    wave()  # warmup: pay every compile outside timing
+    vals = []
+    reqs = None
+    for _ in range(reps):
+        reqs, toks_s = wave()
+        vals.append(toks_s)
+    return {
+        "mode": "overlap" if overlap else "serial",
+        "requests": n_requests,
+        "seats": seats,
+        "decode_toks_s": round(statistics.median(vals), 2),
+        "overlap_dispatches": eng.num_overlap_dispatches,
+        "mispredicts": eng.num_overlap_mispredicts,
+        "outputs": [r.output_ids for r in reqs],
+    }
+
+
+def main(argv=None) -> list[dict]:
+    argv = [int(a) for a in (argv if argv is not None else sys.argv[1:])]
+    n_requests = argv[0] if len(argv) > 0 else 6
+    prompt_len = argv[1] if len(argv) > 1 else 32
+    max_tokens = argv[2] if len(argv) > 2 else 12
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import init_params
+    from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+    platform = jax.devices()[0].platform
+    model = os.environ.get(
+        "OVERLAP_AB_MODEL", "llama-3.2-1b" if platform == "tpu" else "tiny")
+    dtype = "bfloat16" if platform == "tpu" else "float32"
+    seats = int(os.environ.get(
+        "OVERLAP_AB_SEATS", "32" if platform == "tpu" else "4"))
+    reps = 3 if platform == "tpu" else 1
+    model_cfg = resolve_config(model)
+    params = init_params(
+        model_cfg, jax.random.key(0),
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    runner = ModelRunner(model_cfg, params, decode_steps=1 if platform != "tpu" else 32)
+    print(f"devices: {jax.devices()}  requests={n_requests} seats={seats} "
+          f"model={model}", file=sys.stderr, flush=True)
+
+    common = dict(runner=runner, model_cfg=model_cfg, model=model,
+                  dtype=dtype, seats=seats, n_requests=n_requests,
+                  prompt_len=prompt_len, max_tokens=max_tokens, reps=reps)
+    results = [run_arm(ov, **common) for ov in (0, 1)]
+    # Correctness gate: both arms must produce identical completions.
+    outs = {json.dumps(r["outputs"]) for r in results}
+    for r in results:
+        r["outputs_match"] = len(outs) == 1
+        r.pop("outputs")
+        print(json.dumps(r), flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,350 @@
+"""Overlapped decode loop (LLM_DECODE_OVERLAP): speculation about the NEXT
+step's composition must be a pure performance knob.
+
+The round-7 fast path dispatches fused-step N+1 against the predicted
+composition while step N executes (engine._dispatch_decode fast path →
+scheduler.extend_decode + the incremental device-side table scatter +
+runner.decode_overlapped's donated two-slot DecodeState carry). Invariants
+pinned here, in the DEFAULT tier on CPU (acceptance criterion):
+
+  * knob OFF (default): the serial loop runs exactly as before — the
+    overlapped jit is never touched, plan() runs per dispatch, zero
+    overlap counters, oracle-equal output.
+  * knob ON: token-identical to the serial engine under EOS mid-batch,
+    admission mid-decode, and abort — the three churn shapes whose
+    reconciliation (discard + re-plan) the prediction must survive —
+    for greedy and seeded sampling.
+  * the dma3 widened (B, KH, C) lane-parallel grid matches dma2 and the
+    jnp oracle in interpret mode for every head-count shape in the mode
+    table.
+  * config guards: tp/sp/pp runners and speculation refuse the knob at
+    build, not at first step; the sampling-array memo evicts LRU instead
+    of clearing wholesale.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.models.config import PRESETS
+from agentic_traffic_testing_tpu.models.llama import init_params
+from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+CFG = PRESETS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def runner():
+    # ONE runner for the whole module: serial and overlapped engines run
+    # different jit objects on it, so every program compiles exactly once
+    # (keeps this suite in the default tier's budget).
+    params = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+    return ModelRunner(CFG, params, decode_steps=1)
+
+
+def make_engine(runner, overlap, **kw):
+    kw.setdefault("max_model_len", 128)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_num_seqs", 4)
+    return LLMEngine(EngineConfig(model="tiny", dtype="float32",
+                                  decode_overlap=overlap, **kw),
+                     model_cfg=CFG, runner=runner)
+
+
+def greedy(max_tokens=8, **kw):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0, **kw)
+
+
+def drive(engine, reqs):
+    for _ in range(10_000):
+        engine.step()
+        if all(r.is_finished() for r in reqs):
+            return
+        if not engine.has_work():
+            break
+    assert all(r.is_finished() for r in reqs), [r.state for r in reqs]
+
+
+PROMPT_LENS = (12, 20, 9)
+
+
+def prompts():
+    rng = np.random.default_rng(3)
+    return [rng.integers(0, CFG.vocab_size, n).tolist() for n in PROMPT_LENS]
+
+
+# ------------------------------------------------- knob off: serial pin
+
+
+def test_knob_off_is_serial_loop(runner, monkeypatch):
+    """Default off: the overlapped jit is never invoked, no fast-path
+    dispatch happens, and output matches — the bit-identical-to-main
+    contract's observable half."""
+    eng = make_engine(runner, overlap=0)
+    monkeypatch.setattr(
+        runner, "decode_overlapped",
+        lambda *a, **kw: pytest.fail("overlapped jit ran with the knob off"))
+    reqs = [eng.add_request(p, greedy(6)) for p in prompts()]
+    drive(eng, reqs)
+    assert eng.num_overlap_dispatches == 0
+    assert eng.num_overlap_mispredicts == 0
+    want = make_engine(runner, overlap=0)
+    wreqs = [want.add_request(p, greedy(6)) for p in prompts()]
+    drive(want, wreqs)
+    assert [r.generated_ids for r in reqs] == [
+        r.generated_ids for r in wreqs]
+
+
+# ------------------------------------- knob on: token identity under churn
+
+
+def _run(runner, overlap, sampling_for, n_seats=4, mid_abort=False,
+         late_arrival=None):
+    eng = make_engine(runner, overlap, max_num_seqs=n_seats)
+    ps = prompts()
+    reqs = [eng.add_request(p, sampling_for(i)) for i, p in enumerate(ps)]
+    for _ in range(5):
+        eng.step()
+    if mid_abort:
+        eng.abort_request(reqs[1])
+    if late_arrival is not None:
+        reqs.append(eng.add_request(ps[0][:7], late_arrival))
+    drive(eng, [r for r in reqs if r not in
+                ([reqs[1]] if mid_abort else [])])
+    return [r.generated_ids for r in reqs], eng
+
+
+def test_overlap_token_identical_mixed_stops(runner):
+    """Mixed max_tokens: lanes stop at different dispatches, so the fast
+    path repeatedly predicts through LENGTH churn."""
+    samp = lambda i: greedy((10, 4, 7)[i])
+    want, _ = _run(runner, 0, samp)
+    got, eng = _run(runner, 1, samp)
+    assert got == want
+    assert eng.num_overlap_dispatches > 0
+
+
+def test_overlap_token_identical_seeded(runner):
+    samp = lambda i: SamplingParams(max_tokens=8, temperature=0.9, top_k=20,
+                                    seed=7 + i)
+    want, _ = _run(runner, 0, samp)
+    got, eng = _run(runner, 1, samp)
+    assert got == want
+    assert eng.num_overlap_dispatches > 0
+
+
+def test_overlap_token_identical_eos_mid_batch(runner):
+    """An EOS landing mid-batch while speculative dispatches are in flight
+    is THE mispredict shape: the post-stop tail must be discarded and the
+    corrected batch re-planned, token streams unchanged."""
+    base, _ = _run(runner, 0, lambda i: greedy(10))
+    stop_tok = base[0][2]  # reachable greedy token → a real mid-stream stop
+    samp = lambda i: greedy(10, stop_token_ids=[stop_tok])
+    want, _ = _run(runner, 0, samp)
+    got, eng = _run(runner, 1, samp)
+    assert got == want
+    assert eng.num_overlap_dispatches > 0
+    assert eng.num_overlap_mispredicts >= 1
+    assert eng._overlap_unharvested == 0  # accounting drained clean
+
+
+def test_overlap_token_identical_admission_mid_decode(runner):
+    """A late arrival admitted into a decoding wave (2 seats, request 3
+    waits) — the prediction window must close and reopen around the
+    admission without corrupting either wave's streams."""
+    samp = lambda i: greedy(12)
+    late = greedy(6)
+    want, _ = _run(runner, 0, samp, n_seats=2, late_arrival=late)
+    got, eng = _run(runner, 1, samp, n_seats=2, late_arrival=late)
+    assert got == want
+    assert eng.num_overlap_dispatches > 0
+
+
+def test_overlap_token_identical_abort(runner):
+    samp = lambda i: greedy(12)
+    want, _ = _run(runner, 0, samp, mid_abort=True)
+    got, eng = _run(runner, 1, samp, mid_abort=True)
+    # The aborted lane's stream is whatever had been harvested pre-abort
+    # on each arm; survivors must match exactly.
+    assert [want[0], want[2]] == [got[0], got[2]]
+    assert eng._overlap_unharvested == 0
+
+
+def test_overlap_uses_incremental_table_scatter(runner, monkeypatch):
+    """The fast path must maintain tables via the device-side scatter, not
+    the host rebuild (long decode crosses block boundaries: block_size=8,
+    12 tokens of growth ⇒ counts change mid-wave)."""
+    import agentic_traffic_testing_tpu.runtime.engine as engine_mod
+
+    eng = make_engine(runner, overlap=1)
+    calls = {"full": 0}
+    orig = engine_mod.LLMEngine._refresh_decode_tables
+
+    def counting(self):
+        calls["full"] += 1
+        return orig(self)
+
+    monkeypatch.setattr(engine_mod.LLMEngine, "_refresh_decode_tables",
+                        counting)
+    reqs = [eng.add_request(p, greedy(14, ignore_eos=True))
+            for p in prompts()]
+    drive(eng, reqs)
+    assert eng.num_overlap_dispatches > 0
+    # The serial engine refreshes via the full rebuild on every boundary
+    # crossing; the overlap engine's fast-path dispatches must not.
+    serial = make_engine(runner, overlap=0)
+    scalls = {"full": 0}
+
+    def scounting(self):
+        scalls["full"] += 1
+        return orig(self)
+
+    monkeypatch.setattr(engine_mod.LLMEngine, "_refresh_decode_tables",
+                        scounting)
+    sreqs = [serial.add_request(p, greedy(14, ignore_eos=True))
+             for p in prompts()]
+    drive(serial, sreqs)
+    assert [r.generated_ids for r in reqs] == [
+        r.generated_ids for r in sreqs]
+    assert calls["full"] < scalls["full"]
+
+
+# --------------------------------------------------------- config guards
+
+
+def test_refused_with_speculation():
+    with pytest.raises(ValueError, match="speculation"):
+        EngineConfig(decode_overlap=1, speculation="ngram")
+
+
+def test_refused_on_unsupporting_runner(runner):
+    class NoOverlapRunner(ModelRunner):
+        supports_decode_overlap = False
+
+    no = NoOverlapRunner(CFG, runner.params, decode_steps=1)
+    with pytest.raises(ValueError, match="overlapped decode"):
+        make_engine(no, overlap=1)
+    make_engine(no, overlap=0)  # knob off still builds
+
+
+def test_mesh_runners_declare_no_overlap():
+    """tp/sp/pp runners refuse at build through the support flag — the
+    class attributes are the contract (construction needs a device mesh,
+    but the flag consultation does not)."""
+    from agentic_traffic_testing_tpu.parallel.pp_runner import PPRunner
+    from agentic_traffic_testing_tpu.parallel.sp_runner import (
+        SPPrefillRunner,
+        SPTPRunner,
+    )
+    from agentic_traffic_testing_tpu.parallel.tp_runner import TPRunner
+
+    for cls in (TPRunner, SPPrefillRunner, SPTPRunner, PPRunner):
+        assert cls.supports_decode_overlap is False, cls.__name__
+
+
+def test_rejects_bad_knob_values():
+    with pytest.raises(ValueError, match="decode_overlap"):
+        EngineConfig(decode_overlap=2)
+
+
+# ---------------------------------------------------- samp-cache LRU
+
+
+def test_samp_cache_evicts_lru(runner):
+    """The memo bound must evict least-recently-used, not clear wholesale:
+    a composition re-touched every step (the steady decode batch) survives
+    300 cold insertions, so a churning mix never re-pays its rebuild."""
+    eng = make_engine(runner, overlap=0)
+    hot = eng._sampling_arrays([], 2)
+    for i in range(300):
+        eng._sampling_arrays([], 1000 + i)  # cold: distinct padded width
+        # ...while steady traffic keeps touching the hot composition.
+        assert eng._sampling_arrays([], 2) is hot
+    assert eng._sampling_arrays([], 2) is hot
+    assert len(eng._samp_cache) <= 256
+    # And the oldest cold entries really were evicted, not the hot one.
+    assert (1000, ()) not in eng._samp_cache
+
+
+# --------------------------------- dma3 widened-grid parity (mode table)
+
+
+from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
+from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
+    paged_attention_decode_dma2,
+    paged_attention_decode_dma3,
+)
+from agentic_traffic_testing_tpu.runtime.kv_cache import (
+    TRASH_BLOCK,
+    gather_kv,
+)
+
+
+def _paged_case(rng, *, b, h, kh, hd, bs, ctx_lens):
+    max_blocks = max(-(-ln // bs) for ln in ctx_lens) + 2
+    num_blocks = 1 + sum(-(-ln // bs) for ln in ctx_lens) + 1
+    q = jnp.asarray(rng.standard_normal((b, h, hd)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((kh, num_blocks, bs, hd)),
+                     jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((kh, num_blocks, bs, hd)),
+                     jnp.float32)
+    bt = np.full((b, max_blocks), TRASH_BLOCK, np.int32)
+    nxt = 1
+    for i, ln in enumerate(ctx_lens):
+        n = -(-ln // bs)
+        bt[i, :n] = np.arange(nxt, nxt + n)
+        nxt += n
+    return q, kp, vp, jnp.asarray(bt), jnp.asarray(ctx_lens, jnp.int32)
+
+
+@pytest.mark.parametrize(
+    "b,h,kh,hd,bs,ctx_lens",
+    [
+        # Every head-count shape the backend mode table serves: MQA (kh=1),
+        # GQA 2:1 / 4:1, MHA — ragged contexts, block-boundary lengths,
+        # a near-dead lane, and a multi-chunk walk per lane.
+        (1, 8, 1, 32, 4, [13]),             # MQA
+        (2, 4, 2, 16, 4, [5, 9]),           # GQA 2:1
+        (3, 8, 2, 16, 4, [1, 8, 17]),       # GQA 4:1, boundary lengths
+        (2, 8, 8, 16, 8, [3, 40]),          # MHA, long second lane
+        (4, 16, 4, 16, 4, [7, 1, 30, 12]),  # mixed, one lane nearly dead
+    ],
+)
+def test_dma3_widened_grid_parity(b, h, kh, hd, bs, ctx_lens):
+    rng = np.random.default_rng(11)
+    q, kp, vp, bt, cl = _paged_case(rng, b=b, h=h, kh=kh, hd=hd, bs=bs,
+                                    ctx_lens=ctx_lens)
+    want = causal_attention(
+        q[:, None], gather_kv(kp, bt), gather_kv(vp, bt),
+        q_positions=(cl - 1)[:, None], kv_valid_len=cl)[:, 0]
+    # pages_per_chunk=2 forces multi-chunk walks (the double-buffer slots
+    # actually alternate) at these tiny contexts.
+    got3 = paged_attention_decode_dma3(q, kp, vp, bt, cl, interpret=True,
+                                       pages_per_chunk=2)
+    got2 = paged_attention_decode_dma2(q, kp, vp, bt, cl, interpret=True,
+                                       pages_per_chunk=2)
+    np.testing.assert_allclose(np.asarray(got3), np.asarray(want),
+                               atol=2e-5, rtol=2e-5)
+    np.testing.assert_allclose(np.asarray(got3), np.asarray(got2),
+                               atol=2e-5, rtol=2e-5)
+
+
+def test_dma3_widened_grid_verify_layout():
+    """The speculative-verify 4D q layout (S queries per sequence) rides
+    the same widened grid."""
+    rng = np.random.default_rng(12)
+    b, h, kh, hd, bs = 2, 8, 2, 16, 4
+    q, kp, vp, bt, cl = _paged_case(rng, b=b, h=h, kh=kh, hd=hd, bs=bs,
+                                    ctx_lens=[6, 11])
+    q4 = jnp.asarray(rng.standard_normal((b, 3, h, hd)), jnp.float32)
+    got3 = paged_attention_decode_dma3(q4, kp, vp, bt, cl, interpret=True,
+                                       pages_per_chunk=2)
+    got2 = paged_attention_decode_dma2(q4, kp, vp, bt, cl, interpret=True,
+                                       pages_per_chunk=2)
+    np.testing.assert_allclose(np.asarray(got3), np.asarray(got2),
+                               atol=2e-5, rtol=2e-5)

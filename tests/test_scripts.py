@@ -279,6 +279,33 @@ def test_prefill_pipeline_ab_smoke(monkeypatch):
         assert r["prefill_ttft_s"] >= 0
 
 
+# ------------------------------------------------ decode-overlap A/B
+
+
+def test_decode_overlap_ab_smoke(monkeypatch):
+    """scripts/dev/decode_overlap_ab.py end-to-end on the tiny model:
+    one JSON row per arm, the overlap arm actually takes the predicted-
+    composition fast path (dispatches > 0) and reconciles churn
+    (mispredicts counted — the workload stops lanes mid-dispatch on
+    purpose), the serial arm never does, and both arms' completions are
+    token-identical (in-process for the warm jax/conftest CPU config,
+    like router_ab/offload_ab)."""
+    monkeypatch.setenv("OVERLAP_AB_MODEL", "tiny")
+    monkeypatch.setenv("OVERLAP_AB_SEATS", "4")
+    overlap_ab = load_script("scripts/dev/decode_overlap_ab.py",
+                             "decode_overlap_ab")
+    results = overlap_ab.main(["6", "24", "10"])
+    assert [r["mode"] for r in results] == ["serial", "overlap"]
+    by_mode = {r["mode"]: r for r in results}
+    assert by_mode["overlap"]["overlap_dispatches"] > 0
+    assert by_mode["overlap"]["mispredicts"] >= 1
+    assert by_mode["serial"]["overlap_dispatches"] == 0
+    assert by_mode["serial"]["mispredicts"] == 0
+    for r in results:
+        assert r["outputs_match"] is True
+        assert r["decode_toks_s"] > 0
+
+
 # ------------------------------------------------- metric-docs parity
 
 
