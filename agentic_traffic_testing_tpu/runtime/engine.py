@@ -898,6 +898,7 @@ class LLMEngine:
         self._fill_tables(reqs, tables)
         return tokens, seq_lens, tables, steps
 
+    # statics: hot-region(prefill-dispatch)
     def _run_prefill(self, plan: PrefillBatch) -> None:
         split = self._pipeline_split(plan.padded_len)
         if split is not None:
@@ -918,7 +919,7 @@ class LLMEngine:
         if getattr(self.runner, "spec_tokens", 0) > 0:
             # Speculative decode builds its host-side history from the first
             # token, so the readback stays synchronous here.
-            toks = np.asarray(jax.device_get(out))
+            toks = jax.device_get(out)  # statics: allow-host-sync(spec history needs the first token before the next dispatch)
             now = time.monotonic()
             for i, r in enumerate(reqs):
                 if r.first_token_time is None:
@@ -945,6 +946,7 @@ class LLMEngine:
         self._decode_epoch = self.scheduler.composition_epoch
         self._inflight.append(_Inflight(first, list(reqs)))
 
+    # statics: hot-region(prefill-pipeline)
     def _run_prefill_pipelined(self, plan: PrefillBatch, c: int) -> None:
         """The round-6 dispatch-overlap path: K = T/c position-chunks of
         the (solo or batched) prefill dispatched back-to-back with NO host
@@ -1035,6 +1037,7 @@ class LLMEngine:
                 pass
         self._save_pending.append((key, tokens, k, v))
 
+    # statics: hot-region(host-tier-drain)
     def _flush_saves(self) -> None:
         """Drain the save queue into the host store with ONE batched host
         transfer (the slices' async copies started at evict time, so this
@@ -1046,10 +1049,9 @@ class LLMEngine:
         for _, _, k, v in pending:
             leaves.append(k)
             leaves.append(v)
-        fetched = iter(jax.device_get(leaves))
+        fetched = iter(jax.device_get(leaves))  # statics: allow-host-sync(batched host-tier save drain; async copies started at evict time)
         for key, tokens, _, _ in pending:
-            self._host_store.put(key, tokens, np.asarray(next(fetched)),
-                                 np.asarray(next(fetched)))
+            self._host_store.put(key, tokens, next(fetched), next(fetched))
 
     def _apply_pending_restore(self, r: Request) -> None:
         """Write a request's host-tier restore plan into its freshly
@@ -1078,6 +1080,7 @@ class LLMEngine:
         self.host_restore_bytes += sum(
             int(rb.k.nbytes) + int(rb.v.nbytes) for rb in restores)
 
+    # statics: hot-region(chunk-dispatch)
     def _run_chunk(self, plan: ChunkPrefill) -> None:
         """One chunk of a chunked prefill (single long prompt, solo)."""
         r = plan.request
@@ -1102,6 +1105,7 @@ class LLMEngine:
         # Intermediate chunk samples stay on device and are simply dropped.
         self._invalidate_decode_state()
 
+    # statics: hot-region(chunk-dispatch)
     def _apply_chunk_result(self, plan: ChunkPrefill, out) -> None:
         """Chunk bookkeeping shared by the serial and hybrid paths —
         progress accounting plus, on the FINAL chunk, prefix registration
@@ -1112,7 +1116,7 @@ class LLMEngine:
         r.num_computed_tokens += plan.chunk_len
         if plan.is_final:
             self._register_prefix(r)
-            toks = np.asarray(jax.device_get(out))
+            toks = jax.device_get(out)  # statics: allow-host-sync(final-chunk sample IS the first token; TTFT stamps on its arrival)
             now = time.monotonic()
             if r.first_token_time is None:
                 r.first_token_time = now
@@ -1120,6 +1124,7 @@ class LLMEngine:
 
     # -- hybrid (fused chunk + decode) -------------------------------------
 
+    # statics: hot-region(hybrid-dispatch)
     def _run_hybrid(self, plan: HybridBatch) -> None:
         """ONE fused ragged dispatch: every decode lane advances a token
         while one prefill chunk computes in the same device program
@@ -1203,6 +1208,7 @@ class LLMEngine:
 
     # -- decode ------------------------------------------------------------
 
+    # statics: hot-region(decode-loop)
     def _setup_decode(self, plan: DecodeBatch) -> None:
         reqs = plan.requests
         b = plan.padded_batch
@@ -1242,6 +1248,7 @@ class LLMEngine:
         self._decode_block_counts = [r.blocks.num_blocks for r in reqs]
         self._decode_epoch = self.scheduler.composition_epoch
 
+    # statics: hot-region(decode-loop)
     def _refresh_decode_tables(self) -> None:
         """Re-upload block tables if any sequence grew into new blocks.
 
@@ -1259,6 +1266,7 @@ class LLMEngine:
         self._decode_tables = jnp.asarray(tables)
         self._decode_block_counts = counts
 
+    # statics: hot-region(decode-loop)
     def _refresh_decode_tables_incremental(self) -> None:
         """Overlap fast-path table maintenance: the [B, W] table stays
         device-resident and only the cells where a lane grew into new
@@ -1339,6 +1347,7 @@ class LLMEngine:
                 return False
         return True
 
+    # statics: hot-region(decode-loop)
     def _dispatch_decode(self) -> None:
         if self._decode_state is None:
             return
@@ -1385,6 +1394,7 @@ class LLMEngine:
         # members and released their blocks — so re-plan from current state.
         self._plan_and_dispatch()
 
+    # statics: hot-region(decode-loop)
     def _do_decode_dispatch(self, predicted: bool = False) -> None:
         # Under decode_overlap every decode dispatch runs the donated-state
         # jit (spec is refused at build), so ONE program serves both the
@@ -1470,6 +1480,7 @@ class LLMEngine:
         self._inflight.clear()
         self._retire(batch)
 
+    # statics: hot-region(harvest)
     def _retire(self, infs: list[_Inflight]) -> None:
         """Fetch + apply in-flight entries with ONE batched host transfer:
         each separate device_get is a full host<->device round trip (tens of
@@ -1482,11 +1493,10 @@ class LLMEngine:
             leaves.append(inf.tokens)
             if inf.counts is not None:
                 leaves.append(inf.counts)
-        fetched = iter(jax.device_get(leaves))
+        fetched = iter(jax.device_get(leaves))  # statics: allow-host-sync(THE harvest readback: one batched transfer retires the whole in-flight wave)
         for inf in infs:
-            toks = np.asarray(next(fetched))
-            counts = (np.asarray(next(fetched))
-                      if inf.counts is not None else None)
+            toks = next(fetched)  # device_get already returned numpy
+            counts = next(fetched) if inf.counts is not None else None
             if inf.predicted:
                 # Decrement BEFORE applying: if this entry's tokens finish
                 # a lane, the mispredict check must see only the

@@ -365,12 +365,14 @@ class ModelRunner:
         """Hook for placing a freshly allocated cache (TP runner shards it)."""
         return cache
 
+    # statics: hot-region(dispatch-wrappers)
     def prefill(self, tokens, cache, block_tables, seq_lens, samp, steps):
         """-> (DecodeState, cache, sampled_first_tokens [B])."""
         return self._prefill(self.params, tokens=tokens, cache=cache,
                              block_tables=block_tables, seq_lens=seq_lens,
                              samp=samp, steps=steps)
 
+    # statics: hot-region(dispatch-wrappers)
     def prefill_chunk(self, tokens, cache, block_tables, chunk_start,
                       chunk_len, samp, steps):
         """-> (cache, sampled_last_chunk_tokens [1])."""
@@ -379,6 +381,7 @@ class ModelRunner:
             chunk_start=chunk_start, chunk_len=chunk_len, samp=samp, steps=steps,
         )
 
+    # statics: hot-region(dispatch-wrappers)
     def prefill_pipeline(self, tokens, cache, block_tables, chunk_start,
                          seq_lens, carry, samp, steps):
         """One position-chunk of a pipelined prefill -> (cache, carry).
@@ -393,6 +396,7 @@ class ModelRunner:
             block_tables=block_tables, chunk_start=chunk_start,
             seq_lens=seq_lens, carry=carry, samp=samp, steps=steps)
 
+    # statics: hot-region(dispatch-wrappers)
     def hybrid(self, dec_tokens, chunk_tokens, cache, block_tables,
                positions, chunk_start, chunk_len, samp, steps):
         """One fused hybrid dispatch: B decode lanes + one prefill chunk.
@@ -407,6 +411,7 @@ class ModelRunner:
             steps=steps,
         )
 
+    # statics: hot-region(dispatch-wrappers)
     def decode(self, cache, block_tables, state, samp):
         """One fused dispatch covering `decode_steps` model steps.
 
@@ -418,6 +423,7 @@ class ModelRunner:
         return self._decode(self.params, cache=cache, block_tables=block_tables,
                             state=state, samp=samp)
 
+    # statics: hot-region(dispatch-wrappers)
     def decode_overlapped(self, cache, block_tables, state, samp):
         """decode() with the DecodeState carry donated (LLM_DECODE_OVERLAP
         hot loop; non-speculative only). Callers must treat `state` as

@@ -1,0 +1,103 @@
+"""The statics plane: AST-based invariant checkers for the serving stack.
+
+Five checkers, one runner (`scripts/dev/statics_all.py`), one pragma
+syntax (`# statics: allow-<rule>(<reason>)`) — see docs/statics.md:
+
+  knobs         env-knob registry parity (code <-> registry <-> docs)
+  capabilities  supports_* matrix parity + build-time refusal guards
+  host-sync     no host synchronization inside marked hot regions
+  donation      no reads of donated buffers after a runner dispatch
+  metric-docs   Prometheus family <-> docs/monitoring.md parity
+                (scripts/dev/check_metric_docs.py behind a thin shim)
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import io
+import os
+import sys
+from contextlib import redirect_stdout
+from typing import Optional
+
+from agentic_traffic_testing_tpu.statics import (  # noqa: F401
+    capabilities,
+    donation,
+    host_sync,
+    knobs,
+)
+from agentic_traffic_testing_tpu.statics.common import Finding, repo_root
+
+
+def check_metric_docs(root: Optional[str] = None) -> list[Finding]:
+    """Thin shim over scripts/dev/check_metric_docs.py (the pre-existing
+    fifth gate): run it in-process, fold its report into findings."""
+    root = root or repo_root()
+    path = os.path.join(root, "scripts", "dev", "check_metric_docs.py")
+    spec = importlib.util.spec_from_file_location("check_metric_docs", path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("check_metric_docs", mod)
+    spec.loader.exec_module(mod)
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = mod.main([])
+    if rc == 0:
+        return []
+    return [Finding("metric-docs", os.path.join("docs", "monitoring.md"), 1,
+                    "metric <-> docs parity failed:\n" + buf.getvalue())]
+
+
+CHECKERS = (
+    ("knobs", lambda root: knobs.check(root)),
+    ("capabilities", lambda root: capabilities.check(root)),
+    ("host-sync", lambda root: host_sync.check(root)),
+    ("donation", lambda root: donation.check(root)),
+    ("metric-docs", lambda root: check_metric_docs(root)),
+)
+
+
+def run_all(root: Optional[str] = None) -> dict:
+    """Run every checker; the JSON-shaped report statics_all.py emits."""
+    root = root or repo_root()
+    report: dict = {"ok": True, "checkers": {}}
+    seen: set = set()
+    for name, fn in CHECKERS:
+        try:
+            findings = fn(root)
+        except Exception as exc:  # a crashed checker must fail the gate
+            findings = [Finding(name + "-crashed", "<internal>", 0,
+                                f"{type(exc).__name__}: {exc}")]
+        # Checkers share scan surfaces (engine.py is in three of them), so
+        # file-level findings like pragma-missing-reason would otherwise
+        # repeat once per checker. The message is part of the key because
+        # distinct findings can share a location (every knob-dead points
+        # at the registry's line 1).
+        uniq = []
+        for f in findings:
+            key = (f.rule, f.path, f.line, f.message)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(f)
+        findings = uniq
+        report["checkers"][name] = {
+            "ok": not findings,
+            "findings": [f.as_dict() for f in findings],
+        }
+        if findings:
+            report["ok"] = False
+    return report
+
+
+def write_docs(root: Optional[str] = None) -> list[str]:
+    """Regenerate the generated doc surfaces; returns the paths written."""
+    root = root or repo_root()
+    written = []
+    for relpath, content in (
+        (knobs.DOC_RELPATH, knobs.render_doc()),
+        (capabilities.DOC_RELPATH, capabilities.render(root)),
+    ):
+        path = os.path.join(root, relpath)
+        with open(path, "w", encoding="utf-8") as f:
+            f.write(content)
+        written.append(relpath)
+    return written

@@ -1,0 +1,259 @@
+"""Checker 2 — capability-matrix parity.
+
+The runner contract declares its feature surface as `supports_*` class
+attributes on `runtime/runner.py:ModelRunner`; the mesh runners
+(parallel/{tp,sp,pp}_runner.py) override the ones they cannot serve, and
+the engine/config layer must refuse — at build, not first step — every
+knob whose capability some runner declares False. Four failure modes:
+
+  capability-unknown-flag   a runner assigns a supports_* flag the base
+                            ModelRunner never declares (typo'd override:
+                            the engine's getattr default would silently
+                            win)
+  capability-missing-guard  a flag is declared False on some runner but
+                            no build-time refusal (an `if` that raises,
+                            referencing the flag) exists in
+                            runtime/engine.py / serving/config.py
+  capability-non-literal    a flag is assigned a computed value — the
+                            matrix (and the guard audit) must be
+                            statically resolvable, so declarations are
+                            required to be bool literals
+  capability-docs-stale     docs/capabilities.md does not match the
+                            regenerated feature x runner matrix
+
+The matrix is resolved statically through the class hierarchy (bases are
+looked up among the scanned runner classes), so docs/capabilities.md
+always reflects what `getattr(runner, flag)` returns at run time.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional
+
+from agentic_traffic_testing_tpu.statics.common import (
+    Finding,
+    SourceFile,
+    doc_drift_finding,
+    dotted,
+    repo_root,
+)
+
+RUNNER_RELPATH = os.path.join("agentic_traffic_testing_tpu", "runtime",
+                              "runner.py")
+MESH_RELPATHS = (
+    os.path.join("agentic_traffic_testing_tpu", "parallel", "tp_runner.py"),
+    os.path.join("agentic_traffic_testing_tpu", "parallel", "sp_runner.py"),
+    os.path.join("agentic_traffic_testing_tpu", "parallel", "pp_runner.py"),
+)
+GUARD_RELPATHS = (
+    os.path.join("agentic_traffic_testing_tpu", "runtime", "engine.py"),
+    os.path.join("agentic_traffic_testing_tpu", "serving", "config.py"),
+)
+BASE_CLASS = "ModelRunner"
+DOC_RELPATH = os.path.join("docs", "capabilities.md")
+
+
+def _class_flags(cls: ast.ClassDef) -> dict[str, Optional[bool]]:
+    """supports_* class attributes assigned at class level (True/False,
+    or None when the value is not a plain bool literal)."""
+    flags: dict[str, Optional[bool]] = {}
+    for stmt in cls.body:
+        targets = []
+        value = None
+        if isinstance(stmt, ast.Assign):
+            targets, value = stmt.targets, stmt.value
+        elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+            targets, value = [stmt.target], stmt.value
+        for t in targets:
+            if isinstance(t, ast.Name) and t.id.startswith("supports_"):
+                flags[t.id] = (value.value
+                               if isinstance(value, ast.Constant)
+                               and isinstance(value.value, bool) else None)
+    return flags
+
+
+def scan_runners(srcs: Iterable[SourceFile],
+                 base_class: str = BASE_CLASS):
+    """(classes, bases, declarations): per-class declared supports_* flags
+    plus the single-inheritance base-name chain, for every class that
+    descends from `base_class` (the base itself included)."""
+    decls: dict[str, dict[str, Optional[bool]]] = {}
+    bases: dict[str, str] = {}
+    where: dict[str, SourceFile] = {}
+    for src in srcs:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            # Module-qualified bases (`runner.ModelRunner`) resolve by
+            # their last segment so the chain walk stays name-based.
+            base_names = [d.split(".")[-1]
+                          for d in (dotted(b) for b in node.bases) if d]
+            if node.name != base_class and not base_names:
+                continue
+            decls[node.name] = _class_flags(node)
+            where[node.name] = src
+            if base_names:
+                bases[node.name] = base_names[0]
+
+    def descends(name: str) -> bool:
+        seen = set()
+        while name not in seen:
+            if name == base_class:
+                return True
+            seen.add(name)
+            name = bases.get(name, "")
+        return False
+
+    runners = {n: f for n, f in decls.items() if descends(n)}
+    return runners, bases, where
+
+
+def resolve_matrix(runners: dict, bases: dict, base_class: str = BASE_CLASS):
+    """flag -> {runner class -> effective bool} via the base chain."""
+    flags = sorted(runners.get(base_class, {}))
+    matrix: dict[str, dict[str, Optional[bool]]] = {f: {} for f in flags}
+    for cls in runners:
+        for flag in flags:
+            name = cls
+            val: Optional[bool] = None
+            while True:
+                if flag in runners.get(name, {}):
+                    val = runners[name][flag]
+                    break
+                nxt = bases.get(name)
+                if nxt is None or nxt not in runners:
+                    break
+                name = nxt
+            matrix[flag][cls] = val
+    return matrix
+
+
+def _guarded_flags(srcs: Iterable[SourceFile]) -> set[str]:
+    """supports_* flags tested by an `if` that raises — the build-time
+    refusal shape both the engine and config use. The raise must be a
+    top-level statement of the if's body (or else-branch), so a feature
+    branch that merely contains some nested raise does not count as a
+    refusal guard for the flag it reads."""
+    guarded: set[str] = set()
+    for src in srcs:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.If):
+                continue
+            has_raise = any(isinstance(s, ast.Raise)
+                            for s in node.body + node.orelse)
+            if not has_raise:
+                continue
+            for sub in ast.walk(node.test):
+                if (isinstance(sub, ast.Attribute)
+                        and sub.attr.startswith("supports_")):
+                    guarded.add(sub.attr)
+                elif (isinstance(sub, ast.Constant)
+                      and isinstance(sub.value, str)
+                      and sub.value.startswith("supports_")):
+                    guarded.add(sub.value)
+    return guarded
+
+
+def render_doc(matrix: dict, runner_order: list[str]) -> str:
+    lines = [
+        "# Runner capability matrix",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand. -->",
+        "<!-- Source of truth: `supports_*` class attributes on "
+        "runtime/runner.py and parallel/*_runner.py; -->",
+        "<!-- regenerate with `python scripts/dev/statics_all.py "
+        "--write-docs`. -->",
+        "",
+        "Which engine feature each runner class serves. A ✗ means the",
+        "engine refuses the feature's knob at build for that runner",
+        "(statics/capabilities.py verifies the refusal guard exists).",
+        "",
+        "| Capability | " + " | ".join(f"`{r}`" for r in runner_order)
+        + " |",
+        "|---|" + "---|" * len(runner_order),
+    ]
+    for flag in sorted(matrix):
+        cells = []
+        for r in runner_order:
+            v = matrix[flag].get(r)
+            cells.append("✓" if v else ("✗" if v is False else "?"))
+        lines.append(f"| `{flag}` | " + " | ".join(cells) + " |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def check(root: Optional[str] = None,
+          runner_path: Optional[str] = None,
+          mesh_paths: Optional[Iterable[str]] = None,
+          guard_paths: Optional[Iterable[str]] = None,
+          doc_path: Optional[str] = None,
+          base_class: str = BASE_CLASS) -> list[Finding]:
+    root = root or repo_root()
+    runner_path = runner_path or os.path.join(root, RUNNER_RELPATH)
+    mesh_paths = list(mesh_paths) if mesh_paths is not None else [
+        os.path.join(root, p) for p in MESH_RELPATHS]
+    guard_paths = list(guard_paths) if guard_paths is not None else [
+        os.path.join(root, p) for p in GUARD_RELPATHS]
+
+    srcs = [SourceFile(p, root) for p in [runner_path] + mesh_paths]
+    runners, bases, where = scan_runners(srcs, base_class)
+    findings: list[Finding] = []
+    if base_class not in runners:
+        return [Finding("capability-unknown-flag",
+                        os.path.relpath(runner_path, root), 1,
+                        f"base runner class {base_class} not found")]
+    declared = set(runners[base_class])
+
+    for cls, flags in runners.items():
+        for flag, val in flags.items():
+            if cls != base_class and flag not in declared:
+                findings.append(Finding(
+                    "capability-unknown-flag", where[cls].path, 1,
+                    f"{cls} assigns {flag} but {base_class} never declares "
+                    f"it — typo'd capability override (the engine's getattr "
+                    f"default would silently win)"))
+            if val is None:
+                # A computed value resolves to '?' and would dodge the
+                # missing-guard check entirely — declarations must be
+                # literal so the matrix (and the guard audit) is static.
+                findings.append(Finding(
+                    "capability-non-literal", where[cls].path, 1,
+                    f"{cls}.{flag} is not a True/False literal — statics "
+                    f"cannot resolve the capability matrix or audit its "
+                    f"refusal guard; declare the flag as a bool literal"))
+
+    matrix = resolve_matrix(runners, bases, base_class)
+    guarded = _guarded_flags(SourceFile(p, root) for p in guard_paths)
+    guard_names = ", ".join(os.path.relpath(p, root) for p in guard_paths)
+    for flag, row in sorted(matrix.items()):
+        if any(v is False for v in row.values()) and flag not in guarded:
+            findings.append(Finding(
+                "capability-missing-guard",
+                os.path.relpath(runner_path, root), 1,
+                f"{flag} is declared False on "
+                f"{sorted(c for c, v in row.items() if v is False)} but no "
+                f"build-time refusal (an `if` that raises, referencing the "
+                f"flag) exists in {guard_names}"))
+
+    # Stable column order: base first, then subclasses in scan order.
+    order = [base_class] + [c for c in runners if c != base_class]
+    want = render_doc(matrix, order)
+    doc_abs = doc_path or os.path.join(root, DOC_RELPATH)
+    drift = doc_drift_finding("capability-docs-stale", doc_abs, DOC_RELPATH,
+                              want, "the supports_* declarations")
+    if drift is not None:
+        findings.append(drift)
+    return findings
+
+
+def render(root: Optional[str] = None) -> str:
+    """The up-to-date docs/capabilities.md content."""
+    root = root or repo_root()
+    srcs = [SourceFile(os.path.join(root, RUNNER_RELPATH), root)] + [
+        SourceFile(os.path.join(root, p), root) for p in MESH_RELPATHS]
+    runners, bases, _ = scan_runners(srcs)
+    matrix = resolve_matrix(runners, bases)
+    order = [BASE_CLASS] + [c for c in runners if c != BASE_CLASS]
+    return render_doc(matrix, order)

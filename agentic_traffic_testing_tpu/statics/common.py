@@ -1,0 +1,181 @@
+"""Shared machinery for the statics plane (AST checkers).
+
+Every checker produces `Finding`s; a finding is suppressed by a pragma
+comment on (any line of) the offending statement:
+
+    # statics: allow-<rule>(<reason>)
+
+The reason is mandatory — a bare allow is itself a finding, so every
+suppression documents WHY the invariant is intentionally broken at that
+site (the same contract code review used to enforce from memory).
+
+Hot regions (host-sync checker) are marked in source with
+
+    # statics: hot-region(<name>)
+
+on the `def` line (or the line directly above it); the marker covers the
+whole function body.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from functools import lru_cache
+from typing import Iterable, Optional
+
+STATICS_COMMENT_RE = re.compile(r"#\s*statics:\s*(?P<body>.*)$")
+# One `# statics:` comment may carry several allow tokens:
+#     # statics: allow-host-sync(why) allow-donation(why)
+# The reason group is optional so a bare / empty-reason allow still
+# indexes — as a pragma-missing-reason finding, never as a suppression.
+ALLOW_RE = re.compile(
+    r"allow-(?P<rule>[a-z0-9-]+)(?:\((?P<reason>[^)]*)\))?(?![a-z0-9(-])")
+HOT_REGION_RE = re.compile(r"#\s*statics:\s*hot-region\((?P<name>[^)]*)\)")
+
+
+@dataclasses.dataclass
+class Finding:
+    """One statics violation: rule id, repo-relative path, 1-based line."""
+
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """A parsed python file plus its pragma/marker index."""
+
+    def __init__(self, path: str, repo_root: str,
+                 text: Optional[str] = None) -> None:
+        self.abspath = os.path.abspath(path)
+        self.path = os.path.relpath(self.abspath, repo_root)
+        if text is None:
+            with open(self.abspath, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=self.path)
+        # line -> set of allowed rule names (with a reason).
+        self.pragmas: dict[int, set[str]] = {}
+        # line -> rules allowed WITHOUT a reason (reported as findings).
+        self.bare_pragmas: dict[int, set[str]] = {}
+        self.hot_markers: dict[int, str] = {}  # line -> region name
+        for i, line in enumerate(self.lines, start=1):
+            c = STATICS_COMMENT_RE.search(line)
+            if c:
+                for m in ALLOW_RE.finditer(c.group("body")):
+                    reason = (m.group("reason") or "").strip()
+                    target = self.pragmas if reason else self.bare_pragmas
+                    target.setdefault(i, set()).add(m.group("rule"))
+            h = HOT_REGION_RE.search(line)
+            if h:
+                self.hot_markers[i] = h.group("name").strip()
+
+    def allowed(self, rule: str, node: ast.AST) -> bool:
+        """True if a pragma for `rule` sits on any line the node spans."""
+        start = getattr(node, "lineno", None)
+        if start is None:
+            return False
+        end = getattr(node, "end_lineno", start) or start
+        for ln in range(start, end + 1):
+            if rule in self.pragmas.get(ln, ()):
+                return True
+        return False
+
+    def hot_functions(self) -> list[tuple[str, ast.FunctionDef]]:
+        """(region name, function) for every function marked
+        `# statics: hot-region(...)` — marker on the def line or the line
+        directly above it. Region names may repeat (one logical region can
+        span several functions)."""
+        out: list[tuple[str, ast.FunctionDef]] = []
+        for node in ast.walk(self.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            first = min([node.lineno]
+                        + [d.lineno for d in node.decorator_list])
+            if first in self.hot_markers or (first - 1) in self.hot_markers:
+                name = self.hot_markers.get(first,
+                                            self.hot_markers.get(first - 1))
+                out.append((name or node.name, node))
+        return out
+
+
+def bare_pragma_findings(src: SourceFile) -> list[Finding]:
+    """A pragma without a reason is a finding — suppressions must say why."""
+    return [
+        Finding("pragma-missing-reason", src.path, ln,
+                f"allow-{rule} pragma has no (reason)")
+        for ln, rules in sorted(src.bare_pragmas.items())
+        for rule in sorted(rules)
+    ]
+
+
+def iter_python_files(paths: Iterable[str]) -> list[str]:
+    """Expand files/dirs into a sorted list of .py files."""
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p) and p.endswith(".py"):
+            out.append(p)
+        elif os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__" and not d.startswith(".")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+    return sorted(set(out))
+
+
+@lru_cache(maxsize=None)
+def repo_root() -> str:
+    """The repository root (three levels up from this file)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def doc_drift_finding(rule: str, doc_abs: str, doc_relpath: str,
+                      want: str, source_name: str) -> Optional[Finding]:
+    """The regenerate-and-diff gate shared by the generated-doc checkers:
+    None when `doc_abs` matches the freshly rendered `want`, else one
+    finding pointing at the --write-docs recovery command."""
+    try:
+        with open(doc_abs, encoding="utf-8") as f:
+            have = f.read()
+    except FileNotFoundError:
+        have = None
+    if have is not None and have.strip() == want.strip():
+        return None
+    state = ("is missing" if have is None
+             else f"does not match {source_name}")
+    return Finding(rule, doc_relpath, 1,
+                   f"{doc_relpath} {state} — run "
+                   f"`python scripts/dev/statics_all.py --write-docs`")
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    """The literal string value of a Constant node, else None."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """Render a Name/Attribute chain as 'a.b.c'; None for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None

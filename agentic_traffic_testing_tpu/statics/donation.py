@@ -1,0 +1,381 @@
+"""Checker 4 — donation safety for the runner's jitted dispatches.
+
+`runtime/runner.py` donates buffers into its jitted programs
+(`jax.jit(..., donate_argnames=("cache", ...))`): after the dispatch the
+caller's binding refers to a buffer XLA may already have aliased into
+the output — reading it is undefined behavior that *usually* works on
+CPU tests and corrupts silently on TPU (the bug class the
+`update_table_cells` "NOT donated — in-flight readers" comment dodges
+by hand).
+
+The checker derives the donated-parameter map from runner.py itself
+(every `self._x = jax.jit(..., donate_argnames=...)` site, mapped to the
+public method that dispatches `self._x`), then walks each caller
+function in the engine layer: a call to a donating method taints the
+argument bindings bound to donated parameters (`self.cache`, a local
+`state`, ...); any Load of a tainted binding before it is reassigned is
+a finding (`# statics: allow-donation(<reason>)` suppresses).
+
+The dataflow is intentionally simple — statement-ordered within one
+function, branches analyzed independently and merged (a binding stays
+tainted unless EVERY branch reassigns it), loop bodies walked twice so
+an iteration-order read of a value donated by the previous iteration is
+caught. Aliases of the form `f = self.runner.X` / `f = (a if c else b)`
+resolve to the union of the aliased methods' donations. Cross-function
+escapes are out of scope: the engine's contract is that every dispatch
+site rebinds donated state in the same statement or the statements
+immediately following.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional
+
+from agentic_traffic_testing_tpu.statics.common import (
+    Finding,
+    SourceFile,
+    bare_pragma_findings,
+    dotted,
+    repo_root,
+)
+
+RULE = "donation"
+
+RUNNER_RELPATH = os.path.join("agentic_traffic_testing_tpu", "runtime",
+                              "runner.py")
+CALLER_RELPATHS = (
+    os.path.join("agentic_traffic_testing_tpu", "runtime", "engine.py"),
+)
+
+
+# --------------------------------------------------------------- runner map
+
+
+def donation_map(src: SourceFile) -> dict[str, set[str]]:
+    """public method name -> donated parameter names.
+
+    Derived from the runner source: collect every `self._x = jax.jit(...,
+    donate_argnames=(...))` assignment (all assignments to the same attr
+    union — the spec/non-spec `_decode` variants differ), then map each
+    method whose body calls `self._x(...)` to `donate_argnames ∩ the
+    method's own parameter names`.
+    """
+    jit_donates: dict[str, set[str]] = {}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            continue
+        call = node.value
+        if dotted(call.func) not in ("jax.jit", "jit"):
+            continue
+        donated: set[str] = set()
+        for kw in call.keywords:
+            if kw.arg in ("donate_argnames", "donate_argnums") and isinstance(
+                    kw.value, (ast.Tuple, ast.List)):
+                for elt in kw.value.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                            elt.value, str):
+                        donated.add(elt.value)
+        if not donated:
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Attribute) and isinstance(
+                    t.value, ast.Name) and t.value.id == "self":
+                jit_donates.setdefault(t.attr, set()).update(donated)
+
+    methods: dict[str, set[str]] = {}
+    for node in ast.walk(src.tree):
+        if not isinstance(node, ast.FunctionDef):
+            continue
+        params = {a.arg for a in node.args.args if a.arg != "self"}
+        called: set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call):
+                d = dotted(sub.func)
+                if d and d.startswith("self._"):
+                    called.add(d.split(".", 1)[1])
+        donated = set()
+        for attr in called:
+            donated |= jit_donates.get(attr, set())
+        donated &= params
+        if donated:
+            methods[node.name] = donated
+    return methods
+
+
+def method_signatures(src: SourceFile) -> dict[str, list[str]]:
+    """method name -> positional parameter names (self excluded)."""
+    sigs: dict[str, list[str]] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.FunctionDef):
+            sigs[node.name] = [a.arg for a in node.args.args
+                               if a.arg != "self"]
+    return sigs
+
+
+# --------------------------------------------------------------- caller walk
+
+
+def _binding(node: ast.AST) -> Optional[str]:
+    """A trackable binding: a bare Name or a dotted self-attribute chain."""
+    d = dotted(node)
+    if d is None:
+        return None
+    # Only track plain locals and self.* attributes; anything deeper
+    # (subscripts, call results) is untrackable and skipped.
+    return d
+
+
+class _CallerWalker:
+    """Statement-ordered taint walk over one caller function."""
+
+    def __init__(self, src: SourceFile, fn: ast.FunctionDef,
+                 donations: dict[str, set[str]],
+                 sigs: dict[str, list[str]]) -> None:
+        self.src = src
+        self.fn = fn
+        self.donations = donations
+        self.sigs = sigs
+        self.aliases: dict[str, set[str]] = {}  # local name -> method names
+        self.tainted: dict[str, int] = {}       # binding -> donation line
+        # Monotonic record of every donation seen, surviving rebinds —
+        # the entry state for except handlers, which may run after a
+        # donation the body later rebound.
+        self.ever_tainted: dict[str, int] = {}
+        self.findings: list[Finding] = []
+        self._reported: set[tuple[str, int]] = set()
+
+    # -- alias tracking ----------------------------------------------------
+
+    def _methods_of(self, expr: ast.AST) -> set[str]:
+        """Donating runner methods an expression may evaluate to."""
+        out: set[str] = set()
+        d = dotted(expr)
+        if d is not None:
+            tail = d.split(".")[-1]
+            if tail in self.donations and (
+                    ".runner." in d or d.startswith("runner.")
+                    or d in self.aliases):
+                out.add(tail)
+            out |= self.aliases.get(d, set())
+        if isinstance(expr, ast.IfExp):
+            out |= self._methods_of(expr.body)
+            out |= self._methods_of(expr.orelse)
+        return out
+
+    # -- taint machinery ---------------------------------------------------
+
+    def _loads_in(self, node: ast.AST) -> list[tuple[str, ast.AST]]:
+        loads = []
+        for sub in ast.walk(node):
+            if isinstance(sub, (ast.Name, ast.Attribute)) and isinstance(
+                    getattr(sub, "ctx", None), ast.Load):
+                b = _binding(sub)
+                if b in self.tainted:
+                    loads.append((b, sub))
+        # Outermost chains only: self.cache reports once, not also `self`.
+        return loads
+
+    def _report(self, binding: str, node: ast.AST, donated_line: int) -> None:
+        key = (binding, node.lineno)
+        if key in self._reported:
+            return
+        self._reported.add(key)
+        if self.src.allowed(RULE, node):
+            return
+        self.findings.append(Finding(
+            RULE, self.src.path, node.lineno,
+            f"`{binding}` was donated to a runner dispatch at line "
+            f"{donated_line} and is read here before being rebound — the "
+            f"buffer may already be aliased into the dispatch's output "
+            f"(rebind it from the dispatch result, or pragma with the "
+            f"reason it is safe)"))
+
+    def _store_targets(self, stmt: ast.AST) -> set[str]:
+        targets: set[str] = set()
+        tnodes: list[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            tnodes = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            tnodes = [stmt.target]
+        elif isinstance(stmt, ast.For):
+            tnodes = [stmt.target]
+        for t in tnodes:
+            for sub in ast.walk(t):
+                # Only Store-context nodes rebind: `state.steps = 0`
+                # stores `state.steps` while its prefix `state` is a
+                # plain Load and keeps its taint (the donated buffer was
+                # mutated, not replaced).
+                if not isinstance(getattr(sub, "ctx", None), ast.Store):
+                    continue
+                b = _binding(sub)
+                if b is not None:
+                    targets.add(b)
+        return targets
+
+    def _handle_calls(self, stmt: ast.AST) -> set[str]:
+        """Taint donated argument bindings of runner-dispatch calls.
+        Returns the alias names recorded from this statement."""
+        recorded: set[str] = set()
+        for sub in ast.walk(stmt):
+            if isinstance(sub, ast.Assign) and self._methods_of(
+                    sub.value):
+                # Alias assignment: f = self.runner.decode / IfExp of them.
+                for t in sub.targets:
+                    if isinstance(t, ast.Name):
+                        self.aliases[t.id] = self._methods_of(sub.value)
+                        recorded.add(t.id)
+            if not isinstance(sub, ast.Call):
+                continue
+            methods = self._methods_of(sub.func)
+            for m in methods:
+                donated = self.donations[m]
+                sig = self.sigs.get(m, [])
+                for i, arg in enumerate(sub.args):
+                    if i < len(sig) and sig[i] in donated:
+                        b = _binding(arg)
+                        if b is not None:
+                            self.tainted[b] = sub.lineno
+                            self.ever_tainted[b] = sub.lineno
+                for kw in sub.keywords:
+                    if kw.arg in donated:
+                        b = _binding(kw.value)
+                        if b is not None:
+                            self.tainted[b] = sub.lineno
+                            self.ever_tainted[b] = sub.lineno
+        return recorded
+
+    def _walk_block(self, body: list[ast.stmt]) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt)
+
+    def _walk_stmt(self, stmt: ast.stmt) -> None:
+        if isinstance(stmt, ast.If):
+            for b, node in self._loads_in(stmt.test):
+                self._report(b, node, self.tainted[b])
+            self._handle_calls(stmt.test)
+            before = dict(self.tainted)
+            self._walk_block(stmt.body)
+            after_body = self.tainted
+            self.tainted = dict(before)
+            self._walk_block(stmt.orelse)
+            after_else = self.tainted
+            # A binding survives unless every branch rebound it.
+            self.tainted = {b: ln for b, ln in before.items()
+                            if b in after_body or b in after_else}
+            for d in (after_body, after_else):
+                for b, ln in d.items():
+                    self.tainted.setdefault(b, ln)
+            return
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.While):
+                for b, node in self._loads_in(stmt.test):
+                    self._report(b, node, self.tainted[b])
+                self._handle_calls(stmt.test)
+            else:
+                for b, node in self._loads_in(stmt.iter):
+                    self._report(b, node, self.tainted[b])
+                self._handle_calls(stmt.iter)
+            # Two passes: the second catches reads at the top of the body
+            # of a value donated near the bottom by the prior iteration.
+            for _ in range(2):
+                # A for target rebinds at the top of every iteration.
+                for t in self._store_targets(stmt):
+                    self.tainted.pop(t, None)
+                self._walk_block(stmt.body)
+                # A while test re-evaluates after every iteration, so it
+                # reads taint the body introduced.
+                if isinstance(stmt, ast.While):
+                    for b, node in self._loads_in(stmt.test):
+                        self._report(b, node, self.tainted[b])
+                    self._handle_calls(stmt.test)
+            self._walk_block(stmt.orelse)
+            return
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return  # nested defs analyzed separately / out of scope
+        if isinstance(stmt, (ast.Try,)):
+            before = dict(self.tainted)
+            ever_before = set(self.ever_tainted)
+            self._walk_block(stmt.body)
+            self._walk_block(stmt.orelse)
+            after_body = self.tainted
+            # A handler can run from ANY point inside the body — after a
+            # donation but before the body's rebind — so it enters with
+            # the union of pre-try taint and every donation the body made,
+            # including ones the body rebound on its success path.
+            entry = dict(after_body)
+            for b, ln in self.ever_tainted.items():
+                if b not in ever_before:
+                    entry.setdefault(b, ln)
+            for b, ln in before.items():
+                entry.setdefault(b, ln)
+            outs = [after_body]
+            for h in stmt.handlers:
+                self.tainted = dict(entry)
+                self._walk_block(h.body)
+                outs.append(self.tainted)
+            # After the try: a binding stays tainted unless EVERY exit
+            # path (body+else, or each handler) rebound it.
+            merged: dict[str, int] = {}
+            for d in outs:
+                for b, ln in d.items():
+                    merged.setdefault(b, ln)
+            self.tainted = merged
+            self._walk_block(stmt.finalbody)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                for b, node in self._loads_in(item.context_expr):
+                    self._report(b, node, self.tainted[b])
+                self._handle_calls(item.context_expr)
+            self._walk_block(stmt.body)
+            return
+
+        # Flat statement: report tainted loads, then apply new taints from
+        # dispatch calls, then apply stores (targets rebind AFTER the RHS
+        # ran, which is also when donation takes effect). A store also
+        # invalidates a stale alias — `decode = something_else` must stop
+        # resolving to the dispatch method — unless this very statement is
+        # the alias assignment _handle_calls just recorded.
+        for b, node in self._loads_in(stmt):
+            self._report(b, node, self.tainted[b])
+        just_aliased = self._handle_calls(stmt)
+        for b in self._store_targets(stmt):
+            self.tainted.pop(b, None)
+            if b not in just_aliased:
+                self.aliases.pop(b, None)
+
+    def run(self) -> list[Finding]:
+        self._walk_block(self.fn.body)
+        return self.findings
+
+
+def check(root: Optional[str] = None,
+          runner_path: Optional[str] = None,
+          caller_paths: Optional[Iterable[str]] = None) -> list[Finding]:
+    root = root or repo_root()
+    runner_path = runner_path or os.path.join(root, RUNNER_RELPATH)
+    if caller_paths is None:
+        caller_paths = [os.path.join(root, p) for p in CALLER_RELPATHS]
+    runner_src = SourceFile(runner_path, root)
+    donations = donation_map(runner_src)
+    sigs = method_signatures(runner_src)
+    findings: list[Finding] = []
+    if not donations:
+        findings.append(Finding(
+            RULE, runner_src.path, 1,
+            "no jit(..., donate_argnames=...) sites found in the runner — "
+            "the donation map is empty, which almost certainly means the "
+            "checker's site pattern no longer matches the source"))
+        return findings
+    for p in caller_paths:
+        src = SourceFile(p, root)
+        findings.extend(bare_pragma_findings(src))
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.FunctionDef):
+                findings.extend(
+                    _CallerWalker(src, node, donations, sigs).run())
+    return findings

@@ -1,0 +1,102 @@
+"""Checker 3 — host-sync lint for the dispatch hot paths.
+
+The whole round-4..7 performance story is that the decode loop, prefill
+pipeline, and hybrid dispatch never synchronize with the device: one
+stray `jax.device_get` (or an implicit transfer via `np.asarray` /
+`.item()` / `float()` on a device array) re-serializes the pipeline and
+silently erases the overlap win — the bug class PR 5 had to hand-audit.
+
+Functions on the hot path are marked in source with
+
+    # statics: hot-region(<name>)
+
+on (or directly above) their `def` line; inside a marked function the
+following are findings unless pragma'd with
+`# statics: allow-host-sync(<reason>)`:
+
+  * `jax.device_get(...)` / `jax.block_until_ready(...)`
+  * any `.block_until_ready()` / `.item()` method call
+  * `np.asarray(...)` / `np.array(...)` / `np.copy(...)`
+    (device->host copy when handed a jax array; the hot paths keep all
+    host staging in prebuilt numpy, so any occurrence is suspect)
+  * `float(...)` / `bool(...)` on a non-literal argument
+
+Uploads (`jnp.asarray`, `copy_to_host_async`) are NOT flagged: they
+enqueue without blocking. The intentional sync points (the batched
+harvest readback, the final-chunk TTFT stamp, the speculative-prefill
+history seed, the host-tier save drain) carry pragmas whose reasons
+document why each one is allowed to block.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterable, Optional
+
+from agentic_traffic_testing_tpu.statics.common import (
+    Finding,
+    SourceFile,
+    bare_pragma_findings,
+    dotted,
+    repo_root,
+)
+
+RULE = "host-sync"
+
+#: files whose hot-region markers the default check scans
+HOT_RELPATHS = (
+    os.path.join("agentic_traffic_testing_tpu", "runtime", "engine.py"),
+    os.path.join("agentic_traffic_testing_tpu", "runtime", "runner.py"),
+)
+
+_NP_SYNC_FUNCS = {"asarray", "array", "copy"}
+_JAX_SYNC_FUNCS = {"device_get", "block_until_ready"}
+
+
+def _sync_call(node: ast.Call) -> Optional[str]:
+    """A human-readable description if this call can block on the device."""
+    fn = node.func
+    name = dotted(fn)
+    if name is not None:
+        head, _, tail = name.partition(".")
+        if head == "jax" and tail in _JAX_SYNC_FUNCS:
+            return f"jax.{tail}()"
+        if head in ("np", "numpy") and tail in _NP_SYNC_FUNCS:
+            return (f"{head}.{tail}() — an implicit device->host copy "
+                    f"when handed a jax array")
+    if isinstance(fn, ast.Attribute):
+        if fn.attr in ("block_until_ready", "item"):
+            return f".{fn.attr}()"
+    if isinstance(fn, ast.Name) and fn.id in ("float", "bool"):
+        if node.args and not isinstance(node.args[0], ast.Constant):
+            return f"{fn.id}() conversion"
+    return None
+
+
+def check(root: Optional[str] = None,
+          paths: Optional[Iterable[str]] = None) -> list[Finding]:
+    root = root or repo_root()
+    if paths is None:
+        paths = [os.path.join(root, p) for p in HOT_RELPATHS]
+    findings: list[Finding] = []
+    for p in paths:
+        src = SourceFile(p, root)
+        findings.extend(bare_pragma_findings(src))
+        for region, fn in sorted(src.hot_functions(),
+                                 key=lambda rf: (rf[0], rf[1].lineno)):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                desc = _sync_call(node)
+                if desc is None:
+                    continue
+                if src.allowed(RULE, node):
+                    continue
+                findings.append(Finding(
+                    RULE, src.path, node.lineno,
+                    f"{desc} inside hot region '{region}' ({fn.name}) — "
+                    f"a host sync here re-serializes the dispatch "
+                    f"pipeline; move it out or pragma the intentional "
+                    f"sync point"))
+    return findings

@@ -1,0 +1,259 @@
+"""Declarative registry of every LLM_*/ATT_*/BENCH_* env knob.
+
+This table is the single source of truth the statics plane checks code
+and docs against (statics/knobs.py): every knob read in
+`agentic_traffic_testing_tpu/`, `bench.py`, or `scripts/` must have an
+entry here, every entry must still be read somewhere, and docs/knobs.md
+is generated verbatim from this table
+(`python scripts/dev/statics_all.py --write-docs`).
+
+Adding a knob = add the `os.environ` read, add a `Knob` row, regenerate
+the doc. Removing one = delete all three. The checker fails tier-1 on
+any drift between the three surfaces.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+
+class Knob(NamedTuple):
+    name: str
+    type: str      # int | float | bool | str | enum | path
+    default: str   # rendered default ("unset" = no value; "auto" = derived)
+    owner: str     # module whose read defines the knob's behavior
+    doc: str       # one-line description (becomes the docs/knobs.md row)
+
+
+#: helper functions whose first literal argument is an env knob name —
+#: the scanner treats calls to these as env reads.
+WRAPPER_READERS = frozenset({"_env_bool", "_env_int", "env_url"})
+
+KNOBS: tuple[Knob, ...] = (
+    # ------------------------------------------------------------- LLM_*
+    Knob("LLM_MODEL", "str", "tiny", "serving/config.py",
+         "Model name served (models/config.py catalog)."),
+    Knob("LLM_DTYPE", "str", "bfloat16", "serving/config.py",
+         "Serving dtype (bfloat16/float32)."),
+    Knob("LLM_MAX_NUM_SEQS", "int", "12", "serving/config.py",
+         "Max concurrent sequences (continuous-batching seat count)."),
+    Knob("LLM_MAX_NUM_BATCHED_TOKENS", "int", "8192", "serving/config.py",
+         "Per-step token budget across prefill batches."),
+    Knob("LLM_GPU_MEMORY_UTILIZATION", "float", "0.90", "serving/config.py",
+         "Fraction of free HBM profiled into KV blocks (name kept for "
+         "reference-compose compatibility; HBM on TPU)."),
+    Knob("LLM_MAX_TOKENS", "int", "512", "serving/config.py",
+         "Default completion token cap (per-request override wins)."),
+    Knob("LLM_MAX_MODEL_LEN", "int", "4096", "serving/config.py",
+         "Context window: prompt + completion ceiling."),
+    Knob("LLM_PROMPT_SAFETY_MARGIN_TOKENS", "int", "128", "serving/config.py",
+         "Tokens reserved when agents budget prompt size against the "
+         "window (also read by the agent-side guardrail math)."),
+    Knob("LLM_TEMPERATURE", "float", "0.2", "serving/config.py",
+         "Default sampling temperature."),
+    Knob("LLM_METRICS_ENABLED", "bool", "1", "serving/config.py",
+         "Export the Prometheus /metrics surface."),
+    Knob("LLM_METRICS_INCLUDE_TOKENS", "bool", "1", "serving/config.py",
+         "Include token histograms in /metrics."),
+    Knob("LLM_METRICS_PREFIX", "str", "llm", "serving/config.py",
+         "Metric family prefix (reference dashboards expect `llm`)."),
+    Knob("LLM_APPLY_CHAT_TEMPLATE", "bool", "1", "serving/config.py",
+         "Wrap /chat prompts in the model's chat template."),
+    Knob("LLM_DEFAULT_SYSTEM_PROMPT", "str", "built-in", "serving/config.py",
+         "System prompt used when a /chat request sends none."),
+    Knob("LLM_LOG_MAX_CHARS", "int", "500", "serving/config.py",
+         "Truncation bound for request/response logging."),
+    Knob("LLM_HOST", "str", "0.0.0.0", "serving/config.py",
+         "Server bind host (cpu_server falls back to HOST)."),
+    Knob("LLM_PORT", "int", "8000", "serving/config.py",
+         "Server bind port (cpu_server falls back to PORT)."),
+    Knob("LLM_TP_SIZE", "int", "1", "serving/config.py",
+         "Tensor-parallel degree (parallel/tp_runner.py)."),
+    Knob("LLM_SP_SIZE", "int", "1", "serving/config.py",
+         "Sequence-parallel prefill degree (parallel/sp_runner.py)."),
+    Knob("LLM_PP_SIZE", "int", "1", "serving/config.py",
+         "Pipeline-parallel serving degree (parallel/pp_runner.py); "
+         "mutually exclusive with tp/sp."),
+    Knob("LLM_NUM_REPLICAS", "int", "1", "serving/config.py",
+         "Data-parallel replica count (serving/replica_pool.py); does not "
+         "compose with tp/sp/pp."),
+    Knob("LLM_ROUTER_POLICY", "enum", "round_robin", "serving/config.py",
+         "Replica router: round_robin | least_loaded | prefix_affinity."),
+    Knob("LLM_QUANTIZATION", "enum", "unset", "serving/config.py",
+         "Weight-only quantization: int8 | int4 (models/quant.py)."),
+    Knob("LLM_DECODE_STEPS", "int", "auto", "serving/config.py",
+         "Fused decode steps per dispatch (auto: 16 on TPU, 32 at "
+         "bs>=32, 1 elsewhere)."),
+    Knob("LLM_PREFILL_CHUNK_TOKENS", "int", "4096", "serving/config.py",
+         "Prompts longer than this prefill in fixed chunks (0 = off); "
+         "also consulted by the server's sp-branch wiring."),
+    Knob("LLM_PREFILL_BATCH_MAX_LEN", "int", "unset", "serving/config.py",
+         "Padded-length cap for multi-request prefill batches "
+         "(unset = scheduler default 128)."),
+    Knob("LLM_PREFILL_PIPELINE", "int", "0", "serving/config.py",
+         "Pipelined prefill position-chunk count (round 6; 0/1 = single "
+         "blocking dispatch; single-chip runners only)."),
+    Knob("LLM_DECODE_OVERLAP", "int", "0", "serving/config.py",
+         "1 = overlapped decode loop (round 7 speculative next-step "
+         "dispatch); single-chip, non-speculative runners only."),
+    Knob("LLM_PREFIX_CACHING", "bool", "0", "serving/config.py",
+         "Content-addressed reuse of full prompt blocks."),
+    Knob("LLM_HOST_CACHE_GB", "float", "0", "serving/config.py",
+         "Host-RAM second tier for evicted prefix blocks (GB; requires "
+         "LLM_PREFIX_CACHING)."),
+    Knob("LLM_HYBRID_TOKEN_BUDGET", "int", "0", "serving/config.py",
+         "Fused prefill-chunk + decode ragged dispatch budget (0 = "
+         "serial schedule; single-chip runners only)."),
+    Knob("LLM_KV_CACHE_DTYPE", "enum", "unset", "serving/config.py",
+         "fp8 stores KV pages as float8_e4m3 (double capacity, half the "
+         "decode KV stream)."),
+    Knob("LLM_INT4_K_GROUP", "int", "0", "serving/config.py",
+         "AWQ-style K-group size for int4 scales (0 = per-column)."),
+    Knob("LLM_NUM_BLOCKS", "int", "auto", "serving/config.py",
+         "KV block count (unset = HBM profile at engine build)."),
+    Knob("LLM_BLOCK_SIZE", "int", "16", "serving/config.py",
+         "KV block size in tokens."),
+    Knob("LLM_WEIGHTS_PATH", "path", "unset", "serving/config.py",
+         "Local safetensors checkpoint directory."),
+    Knob("LLM_ALLOW_RANDOM_WEIGHTS", "bool", "0", "serving/config.py",
+         "Serve randomly initialized weights when the checkpoint load "
+         "fails (explicit opt-in, never a fallback)."),
+    Knob("LLM_MOE_CAPACITY_FACTOR", "float", "unset", "serving/config.py",
+         "MoE expert-capacity override (unset = model default)."),
+    Knob("LLM_WARMUP", "bool", "1", "serving/config.py",
+         "Precompile decode/chunk bucket programs at startup."),
+    Knob("LLM_SPECULATION", "enum", "unset", "serving/config.py",
+         "ngram enables prompt-lookup speculative decoding "
+         "(ops/speculative.py)."),
+    Knob("LLM_SPEC_TOKENS", "int", "3", "serving/config.py",
+         "Drafts verified per speculative step."),
+    Knob("LLM_SPEC_NGRAM", "int", "3", "serving/config.py",
+         "Trailing n-gram length matched against history."),
+    Knob("LLM_PROFILE_DIR", "path", "/tmp/att_tpu_profile",
+         "serving/server.py",
+         "jax.profiler trace directory for the /profile/start endpoint."),
+    Knob("LLM_SERVER_URL", "str", "http://localhost:8000/chat",
+         "agents/common/llm_client.py",
+         "Backend /chat URL the agents (and health checks) call."),
+    Knob("LLM_REQUEST_TIMEOUT_S", "float", "300",
+         "agents/common/llm_client.py",
+         "Agent-side HTTP timeout per LLM call."),
+    Knob("LLM_COST_PER_1K_PROMPT_TOKENS", "float", "0.0005",
+         "agents/common/llm_client.py",
+         "Synthetic cost accounting: $/1k prompt tokens."),
+    Knob("LLM_COST_PER_1K_COMPLETION_TOKENS", "float", "0.0015",
+         "agents/common/llm_client.py",
+         "Synthetic cost accounting: $/1k completion tokens."),
+    Knob("LLM_EVAL_MAX_TOKENS", "int", "1024",
+         "agents/agent_a/orchestrator.py",
+         "Token cap for the orchestrator's evaluator calls."),
+    Knob("LLM_FINAL_MAX_TOKENS", "int", "auto",
+         "agents/agent_a/orchestrator.py",
+         "Token cap for the final-answer call (0/unset = half the "
+         "context window)."),
+    Knob("LLM_TOKENIZER_PATH", "path", "unset",
+         "agents/agent_a/orchestrator.py",
+         "Tokenizer for token-aware eval guardrails ('byte' = 1 "
+         "token/char proxy)."),
+    # ------------------------------------------------------------- ATT_*
+    Knob("ATT_TPU_ATTENTION", "enum", "auto", "ops/attention_backend.py",
+         "Decode paged-attention kernel: auto | dma2 | dma3 | dma | v1 | "
+         "jnp."),
+    Knob("ATT_TP_ATTENTION", "enum", "unset", "parallel/tp_runner.py",
+         "TP decode attention override: shard_dma | gather "
+         "(unset = auto per platform)."),
+    Knob("ATT_PREFILL_ATTENTION", "enum", "flash", "ops/flash_prefill.py",
+         "Prefill attention impl: flash | library | jnp."),
+    Knob("ATT_LIBRARY_REPEAT_KV_CAP_GB", "float", "2",
+         "ops/flash_prefill.py",
+         "GB guard on the library-attention escape hatch's GQA repeat_kv "
+         "materialization (refuses over the cap instead of OOMing)."),
+    Knob("ATT_CHUNK_ATTENTION", "enum", "unset", "models/llama.py",
+         "Chunked/pipelined-prefill attention site: flash | jnp "
+         "(unset = auto: flash for pipeline chunks on TPU)."),
+    Knob("ATT_FLASH_TUNE", "enum", "off", "ops/pallas/autotune.py",
+         "Flash block autotune: off | warmup | <table path> (unknown "
+         "shapes and corrupt tables degrade to the heuristic)."),
+    Knob("ATT_TPU_KV_WRITER", "enum", "auto", "ops/kv_writer.py",
+         "Prompt-page KV writer impl: auto | dus | scatter."),
+    Knob("ATT_TPU_NATIVE", "bool", "1", "native/__init__.py",
+         "0 disables the C++ native core (pure-Python allocator)."),
+    Knob("ATT_MULTIHOST", "bool", "0", "parallel/distributed.py",
+         "Force jax.distributed multi-host initialization."),
+    Knob("ATT_COORDINATOR_ADDRESS", "str", "unset",
+         "parallel/distributed.py",
+         "Multi-host coordinator host:port (implies multihost init)."),
+    Knob("ATT_NUM_PROCESSES", "int", "unset", "parallel/distributed.py",
+         "Process count for the multi-host bootstrap."),
+    Knob("ATT_PROCESS_ID", "int", "unset", "parallel/distributed.py",
+         "This process's index in the multi-host bootstrap."),
+    Knob("ATT_LOCAL_DEVICE_IDS", "str", "unset", "parallel/distributed.py",
+         "Comma-separated local device ids for the multi-host bootstrap."),
+    # ----------------------------------------------------------- BENCH_*
+    Knob("BENCH_MODEL", "str", "llama-3.2-1b (tpu) / debug-512", "bench.py",
+         "Model the bench (and profile scripts) build."),
+    Knob("BENCH_BATCH", "int", "32 (tpu) / 8", "bench.py",
+         "Primary decode batch size."),
+    Knob("BENCH_SMALL_BATCH", "int", "8", "bench.py",
+         "Secondary round-1/2-comparable batch size (0 disables; also "
+         "read by scripts/dev/tpu_r4_validation.py)."),
+    Knob("BENCH_TOTAL_REQUESTS", "int", "3*batch", "bench.py",
+         "Requests per throughput rep."),
+    Knob("BENCH_PROMPT_LEN", "int", "128", "bench.py",
+         "Prompt length of the throughput workload."),
+    Knob("BENCH_DECODE_TOKENS", "int", "64", "bench.py",
+         "Completion length of the throughput workload."),
+    Knob("BENCH_DECODE_STEPS", "int", "32 (tpu) / auto", "bench.py",
+         "Fused decode steps for the bench engines."),
+    Knob("BENCH_REPS", "int", "3 (tpu) / 1", "bench.py",
+         "Measurement repetitions per series."),
+    Knob("BENCH_FANOUT", "int", "5", "bench.py",
+         "Fan-out width of the shared-prefix TTFT probe."),
+    Knob("BENCH_FANOUT_PROMPT_LEN", "int", "512", "bench.py",
+         "Scenario prompt length of the fan-out probe."),
+    Knob("BENCH_PREFILL_LEN", "int", "2048", "bench.py",
+         "Solo-prompt length of the prefill anatomy probe."),
+    Knob("BENCH_PREFILL_PIPELINE", "int", "4 (tpu) / 0", "bench.py",
+         "Pipelined-prefill chunk count for the pipeline TTFT probe."),
+    Knob("BENCH_QUANTIZATION", "enum", "unset", "bench.py",
+         "Weight quantization for the bench engines (int8 | int4)."),
+    Knob("BENCH_KV_CACHE_DTYPE", "enum", "unset", "bench.py",
+         "KV page dtype for the bench engines (fp8)."),
+    Knob("BENCH_HYBRID", "bool", "1", "bench.py",
+         "0 disables the hybrid on/off A/B series."),
+    Knob("BENCH_HYBRID_BUDGET", "int", "256 (tpu) / 48", "bench.py",
+         "Hybrid fused-dispatch token budget for the A/B."),
+    Knob("BENCH_HYBRID_CHUNK", "int", "128 (tpu) / 32", "bench.py",
+         "Prefill chunk size of the hybrid A/B workload."),
+    Knob("BENCH_HYBRID_LANES", "int", "8", "bench.py",
+         "Decode lanes of the hybrid A/B workload."),
+    Knob("BENCH_REPLICAS", "bool", "1", "bench.py",
+         "0 disables the replica-scaling + router A/B series."),
+    Knob("BENCH_REPLICA_LANES", "int", "min(8, batch)", "bench.py",
+         "Per-replica decode lanes in the replica series."),
+    Knob("BENCH_ROUTER_GROUPS", "int", "3", "bench.py",
+         "Shared-prefix scenario groups in the router A/B."),
+    Knob("BENCH_OFFLOAD", "bool", "1", "bench.py",
+         "0 disables the host-KV-offload restore-vs-recompute probe."),
+    Knob("BENCH_OFFLOAD_PREFIX", "int", "min(fanout_prompt, 512)",
+         "bench.py",
+         "Shared-prefix length of the offload probe."),
+    Knob("BENCH_OFFLOAD_PRESSURE", "int", "3", "bench.py",
+         "Eviction-pressure waves of the offload probe."),
+    Knob("BENCH_OFFLOAD_HOST_MB", "float", "1024", "bench.py",
+         "Host-tier budget (MB) of the offload probe."),
+    Knob("BENCH_DECODE_ANATOMY", "bool", "1", "bench.py",
+         "0 disables the decode host/device split + overlap A/B probe."),
+    Knob("BENCH_NO_RECORDED", "bool", "unset", "bench.py",
+         "1 disables the recorded-result fallback when no TPU is "
+         "reachable."),
+    Knob("BENCH_ATTEMPTS", "int", "3", "bench.py",
+         "Outer launcher retries around the inner bench process."),
+    Knob("BENCH_ATTEMPT_TIMEOUT", "float", "1500", "bench.py",
+         "Per-attempt timeout (s) of the outer launcher."),
+    Knob("BENCH_PROBE_TIMEOUT", "float", "300", "bench.py",
+         "TPU-reachability probe timeout (s) of the outer launcher."),
+    Knob("BENCH_INNER", "bool", "unset", "bench.py",
+         "Internal: set by the launcher to mark the re-exec'd inner "
+         "bench process."),
+)

@@ -1,0 +1,164 @@
+"""Checker 1 — knob registry.
+
+Every `LLM_*` / `ATT_*` / `BENCH_*` environment knob read anywhere in the
+serving/bench/scripts surface must be declared in
+`statics/knob_registry.py`, and the declarative table is the single
+source docs/knobs.md is generated from. Three failure modes:
+
+  knob-unregistered  a read of a knob the registry does not declare
+  knob-dead          a registry entry no scanned code ever reads
+  knob-docs-stale    docs/knobs.md does not match the registry render
+
+A read is: `os.environ.get("X", ...)`, `os.getenv("X")`, `os.environ["X"]`
+(load context), `<anything>.get("X")` where X matches the knob pattern
+(covers env-dict copies handed to subprocesses), or a call to one of the
+registered wrapper helpers (`_env_bool(...)` etc. — see
+knob_registry.WRAPPER_READERS). Writes (`environ["X"] = ...`, `pop`,
+subprocess env dict literals) are not reads: registration is keyed on
+where a knob's value enters program behavior.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from agentic_traffic_testing_tpu.statics.common import (
+    Finding,
+    SourceFile,
+    bare_pragma_findings,
+    const_str,
+    doc_drift_finding,
+    dotted,
+    iter_python_files,
+    repo_root,
+)
+from agentic_traffic_testing_tpu.statics.knob_registry import (
+    KNOBS,
+    WRAPPER_READERS,
+    Knob,
+)
+
+KNOB_RE = re.compile(r"^(LLM|ATT|BENCH)_[A-Z0-9_]+$")
+
+#: the default scan surface, relative to the repo root
+SCAN_PATHS = ("agentic_traffic_testing_tpu", "bench.py", "scripts")
+
+DOC_RELPATH = os.path.join("docs", "knobs.md")
+
+
+def knob_name(node: ast.AST) -> Optional[str]:
+    s = const_str(node)
+    if s is not None and KNOB_RE.match(s):
+        return s
+    return None
+
+
+def scan_reads(files: Iterable[SourceFile],
+               wrappers: frozenset = WRAPPER_READERS,
+               ) -> list[tuple[str, SourceFile, ast.AST]]:
+    """All literal knob reads: (knob, source file, AST node)."""
+    reads: list[tuple[str, SourceFile, ast.AST]] = []
+    for src in files:
+        for node in ast.walk(src.tree):
+            name = None
+            if isinstance(node, ast.Call) and node.args:
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and fn.attr in ("get",
+                                                                 "getenv"):
+                    name = knob_name(node.args[0])
+                elif isinstance(fn, ast.Name) and (
+                        fn.id == "getenv" or fn.id in wrappers):
+                    name = knob_name(node.args[0])
+            elif (isinstance(node, ast.Subscript)
+                  and isinstance(node.ctx, ast.Load)):
+                base = dotted(node.value)
+                if base and base.split(".")[-1] == "environ":
+                    name = knob_name(node.slice)
+            if name is not None:
+                reads.append((name, src, node))
+    return reads
+
+
+def render_doc(knobs: tuple[Knob, ...] = KNOBS) -> str:
+    """The generated docs/knobs.md content (regenerate via
+    `python scripts/dev/statics_all.py --write-docs`)."""
+    lines = [
+        "# Environment knobs",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand. -->",
+        "<!-- Source of truth: agentic_traffic_testing_tpu/statics/"
+        "knob_registry.py; -->",
+        "<!-- regenerate with `python scripts/dev/statics_all.py "
+        "--write-docs`. -->",
+        "",
+        "Every `LLM_*` / `ATT_*` / `BENCH_*` environment variable the",
+        "serving stack, `bench.py`, or `scripts/` reads. The statics plane",
+        "(`scripts/dev/statics_all.py`) fails tier-1 when a knob is read",
+        "but missing here, or listed here but never read.",
+        "",
+    ]
+    by_prefix = {"LLM": [], "ATT": [], "BENCH": []}
+    for k in knobs:
+        by_prefix[k.name.split("_", 1)[0]].append(k)
+    titles = {
+        "LLM": "## `LLM_*` — serving configuration",
+        "ATT": "## `ATT_*` — kernel / accelerator plumbing",
+        "BENCH": "## `BENCH_*` — bench.py probe shaping",
+    }
+    for prefix in ("LLM", "ATT", "BENCH"):
+        lines.append(titles[prefix])
+        lines.append("")
+        lines.append("| Knob | Type | Default | Owner | Description |")
+        lines.append("|---|---|---|---|---|")
+        for k in sorted(by_prefix[prefix], key=lambda k: k.name):
+            lines.append(f"| `{k.name}` | {k.type} | `{k.default}` | "
+                         f"`{k.owner}` | {k.doc} |")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def check(root: Optional[str] = None,
+          knobs: tuple[Knob, ...] = KNOBS,
+          paths: Optional[Iterable[str]] = None,
+          wrappers: frozenset = WRAPPER_READERS,
+          doc_path: Optional[str] = None) -> list[Finding]:
+    root = root or repo_root()
+    if paths is None:
+        paths = [os.path.join(root, p) for p in SCAN_PATHS]
+    files = [SourceFile(p, root) for p in iter_python_files(paths)]
+    findings: list[Finding] = []
+    for src in files:
+        findings.extend(bare_pragma_findings(src))
+
+    registered = {k.name for k in knobs}
+    seen: set[str] = set()
+    for name, src, node in scan_reads(files, wrappers):
+        seen.add(name)
+        if name in registered:
+            continue
+        if src.allowed("knob-unregistered", node):
+            continue
+        findings.append(Finding(
+            "knob-unregistered", src.path, node.lineno,
+            f"env knob {name} is read here but not declared in "
+            f"statics/knob_registry.py (add a Knob entry + regenerate "
+            f"docs/knobs.md)"))
+    reg_path = os.path.join("agentic_traffic_testing_tpu", "statics",
+                            "knob_registry.py")
+    for k in knobs:
+        if k.name not in seen:
+            findings.append(Finding(
+                "knob-dead", reg_path, 1,
+                f"registered knob {k.name} is never read by "
+                f"{'/'.join(SCAN_PATHS)} — delete the entry or the knob's "
+                f"dead read path"))
+
+    doc_abs = doc_path or os.path.join(root, DOC_RELPATH)
+    drift = doc_drift_finding("knob-docs-stale", doc_abs, DOC_RELPATH,
+                              render_doc(knobs), "the knob registry")
+    if drift is not None:
+        findings.append(drift)
+    return findings

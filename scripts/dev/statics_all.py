@@ -1,0 +1,69 @@
+#!/usr/bin/env python3
+"""Run the statics plane: every AST invariant checker, one JSON report.
+
+The five checkers (agentic_traffic_testing_tpu/statics/):
+
+  knobs         every LLM_*/ATT_*/BENCH_* env read is registered in
+                statics/knob_registry.py, no registry entry is dead, and
+                docs/knobs.md matches the registry
+  capabilities  supports_* flags resolve consistently across runner
+                classes, every False flag has a build-time refusal
+                guard, and docs/capabilities.md matches the declarations
+  host-sync     no blocking host<->device synchronization inside the
+                marked hot regions of engine.py/runner.py
+  donation      no caller reads a buffer after donating it to a runner
+                dispatch
+  metric-docs   Prometheus families <-> docs/monitoring.md parity
+                (scripts/dev/check_metric_docs.py behind a thin shim)
+
+Usage:
+  python scripts/dev/statics_all.py              # check; JSON report
+  python scripts/dev/statics_all.py --write-docs # regenerate the
+                                                 # generated docs first
+
+Exit 0 when every checker is clean (all findings either fixed or
+pragma'd with `# statics: allow-<rule>(<reason>)`), 1 otherwise.
+Wired into tests/test_scripts.py as a default-tier smoke, so tier-1
+fails on any new unregistered knob, missing guard, hot-region sync,
+post-donation read, or matrix/doc drift.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, REPO)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--write-docs", action="store_true",
+                   help="regenerate docs/knobs.md + docs/capabilities.md "
+                        "from their source-of-truth surfaces before "
+                        "checking")
+    p.add_argument("--quiet", action="store_true",
+                   help="suppress the JSON report; exit code only")
+    a = p.parse_args(argv)
+
+    from agentic_traffic_testing_tpu.statics import run_all, write_docs
+
+    if a.write_docs:
+        for rel in write_docs(REPO):
+            print(f"wrote {rel}", file=sys.stderr)
+    report = run_all(REPO)
+    if not a.quiet:
+        print(json.dumps(report, indent=2))
+    if not report["ok"]:
+        total = sum(len(c["findings"]) for c in report["checkers"].values())
+        print(f"statics: {total} finding(s) — see report above "
+              f"(pragma syntax: # statics: allow-<rule>(<reason>))",
+              file=sys.stderr)
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -317,6 +317,28 @@ def test_metric_docs_parity():
     assert check.main([]) == 0
 
 
+# --------------------------------------------------------- statics plane
+
+
+def test_statics_all_smoke(capsys):
+    """scripts/dev/statics_all.py exits 0 on the tree with zero
+    unsuppressed findings — tier-1 therefore fails on any new
+    unregistered env knob, supports_* flag without a refusal guard,
+    un-pragma'd host sync in a hot region, post-donation buffer read,
+    or knob/capability doc drift (the per-checker behavior is pinned in
+    tests/test_statics.py against fixture trees)."""
+    statics_all = load_script("scripts/dev/statics_all.py", "statics_all")
+    rc = statics_all.main([])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    import json as json_mod
+
+    report = json_mod.loads(out)
+    assert report["ok"] is True
+    assert set(report["checkers"]) == {
+        "knobs", "capabilities", "host-sync", "donation", "metric-docs"}
+
+
 # --------------------------------------------------------- platform guard
 
 

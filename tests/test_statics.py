@@ -1,0 +1,654 @@
+"""The statics plane (agentic_traffic_testing_tpu/statics/).
+
+Each checker is exercised against fixture source trees with seeded
+violations — an unregistered knob read, a mesh runner missing its
+refusal guard, an un-pragma'd host sync in a hot region, a post-dispatch
+read of a donated buffer — plus clean-tree and pragma-suppression
+negatives, and the generated-doc round trips (regenerate-and-diff).
+
+Pure AST work on tmp files: no jax arrays, no engines — these run in
+milliseconds in the default tier.
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from agentic_traffic_testing_tpu.statics import (
+    capabilities,
+    donation,
+    host_sync,
+    knobs,
+    run_all,
+    write_docs,
+)
+from agentic_traffic_testing_tpu.statics.common import (
+    Finding,
+    SourceFile,
+    bare_pragma_findings,
+    repo_root,
+)
+from agentic_traffic_testing_tpu.statics.knob_registry import KNOBS, Knob
+
+REPO = repo_root()
+
+
+def write(tmp_path, relpath: str, body: str) -> str:
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def rules(findings: list[Finding]) -> list[str]:
+    return sorted(f.rule for f in findings)
+
+
+# ------------------------------------------------------------------ pragmas
+
+
+def test_pragma_requires_reason(tmp_path):
+    p = write(tmp_path, "m.py", """\
+        import os
+        x = os.environ.get("LLM_BOGUS_KNOB")  # statics: allow-knob-unregistered
+    """)
+    src = SourceFile(p, str(tmp_path))
+    fs = bare_pragma_findings(src)
+    assert rules(fs) == ["pragma-missing-reason"]
+    # And the bare pragma does NOT suppress the underlying finding.
+    assert not src.allowed("knob-unregistered", src.tree.body[1].value)
+
+
+def test_pragma_empty_reason_is_bare(tmp_path):
+    """`allow-rule()` is a reasonless allow, not a valid suppression."""
+    p = write(tmp_path, "m.py", """\
+        import os
+        x = os.environ.get("LLM_BOGUS_KNOB")  # statics: allow-knob-unregistered()
+    """)
+    src = SourceFile(p, str(tmp_path))
+    assert rules(bare_pragma_findings(src)) == ["pragma-missing-reason"]
+    assert not src.allowed("knob-unregistered", src.tree.body[1].value)
+
+
+def test_pragma_two_rules_one_comment(tmp_path):
+    """One statics comment can suppress two rules on the same statement."""
+    p = write(tmp_path, "m.py", """\
+        import os
+        x = os.environ.get("K")  # statics: allow-host-sync(a) allow-donation(b)
+    """)
+    src = SourceFile(p, str(tmp_path))
+    node = src.tree.body[1].value
+    assert src.allowed("host-sync", node)
+    assert src.allowed("donation", node)
+    assert bare_pragma_findings(src) == []
+
+
+def test_pragma_spans_multiline_statement(tmp_path):
+    p = write(tmp_path, "m.py", """\
+        import os
+        x = os.environ.get(
+            "LLM_BOGUS_KNOB",  # statics: allow-knob-unregistered(fixture)
+            "0")
+    """)
+    fs = knobs.check(root=str(tmp_path), knobs=(), paths=[p],
+                     doc_path=str(tmp_path / "knobs.md"))
+    assert rules(fs) == ["knob-docs-stale"]  # only the missing doc
+
+
+# ------------------------------------------------------------------- knobs
+
+
+FIXTURE_KNOBS = (
+    Knob("LLM_FIXTURE_A", "int", "1", "m.py", "registered and read."),
+)
+
+
+def _knob_check(tmp_path, body: str, registry=FIXTURE_KNOBS):
+    p = write(tmp_path, "m.py", body)
+    doc = tmp_path / "knobs.md"
+    doc.write_text(knobs.render_doc(registry))
+    return knobs.check(root=str(tmp_path), knobs=registry, paths=[p],
+                       doc_path=str(doc))
+
+
+def test_knob_clean_tree(tmp_path):
+    assert _knob_check(tmp_path, """\
+        import os
+        a = os.environ.get("LLM_FIXTURE_A", "1")
+    """) == []
+
+
+def test_knob_unregistered_read_fires(tmp_path):
+    fs = _knob_check(tmp_path, """\
+        import os
+        a = os.environ.get("LLM_FIXTURE_A", "1")
+        b = os.environ.get("BENCH_FIXTURE_UNREGISTERED")
+    """)
+    assert rules(fs) == ["knob-unregistered"]
+    assert "BENCH_FIXTURE_UNREGISTERED" in fs[0].message
+    assert fs[0].line == 3
+
+
+@pytest.mark.parametrize("read", [
+    'os.getenv("BENCH_FIXTURE_UNREGISTERED")',
+    'os.environ["BENCH_FIXTURE_UNREGISTERED"]',
+    'env.get("BENCH_FIXTURE_UNREGISTERED", "0")',
+    '_env_bool("BENCH_FIXTURE_UNREGISTERED")',
+])
+def test_knob_read_shapes_detected(tmp_path, read):
+    """Every env-read idiom in the tree is seen: os.getenv, subscript,
+    env-dict .get, and the registered wrapper helpers."""
+    fs = _knob_check(tmp_path, f"""\
+        import os
+        a = os.environ.get("LLM_FIXTURE_A", "1")
+        env = dict(os.environ)
+        b = {read}
+    """)
+    assert rules(fs) == ["knob-unregistered"]
+
+
+def test_knob_write_is_not_a_read(tmp_path):
+    assert _knob_check(tmp_path, """\
+        import os
+        a = os.environ.get("LLM_FIXTURE_A", "1")
+        os.environ["BENCH_FIXTURE_UNREGISTERED"] = "1"
+        os.environ.pop("BENCH_FIXTURE_UNREGISTERED", None)
+    """) == []
+
+
+def test_knob_pragma_suppresses(tmp_path):
+    assert _knob_check(tmp_path, """\
+        import os
+        a = os.environ.get("LLM_FIXTURE_A", "1")
+        b = os.environ.get("BENCH_FIXTURE_UNREGISTERED")  # statics: allow-knob-unregistered(fixture reason)
+    """) == []
+
+
+def test_knob_dead_entry_fires(tmp_path):
+    registry = FIXTURE_KNOBS + (
+        Knob("LLM_FIXTURE_DEAD", "int", "0", "m.py", "never read."),)
+    fs = _knob_check(tmp_path, """\
+        import os
+        a = os.environ.get("LLM_FIXTURE_A", "1")
+    """, registry=registry)
+    assert rules(fs) == ["knob-dead"]
+    assert "LLM_FIXTURE_DEAD" in fs[0].message
+
+
+def test_knob_doc_round_trip(tmp_path):
+    p = write(tmp_path, "m.py", """\
+        import os
+        a = os.environ.get("LLM_FIXTURE_A", "1")
+    """)
+    doc = tmp_path / "knobs.md"
+    # Missing doc -> stale; regenerated doc -> clean; edited doc -> stale.
+    fs = knobs.check(root=str(tmp_path), knobs=FIXTURE_KNOBS, paths=[p],
+                     doc_path=str(doc))
+    assert rules(fs) == ["knob-docs-stale"]
+    doc.write_text(knobs.render_doc(FIXTURE_KNOBS))
+    assert knobs.check(root=str(tmp_path), knobs=FIXTURE_KNOBS, paths=[p],
+                       doc_path=str(doc)) == []
+    doc.write_text(doc.read_text().replace("LLM_FIXTURE_A", "LLM_EDITED"))
+    fs = knobs.check(root=str(tmp_path), knobs=FIXTURE_KNOBS, paths=[p],
+                     doc_path=str(doc))
+    assert rules(fs) == ["knob-docs-stale"]
+
+
+# ------------------------------------------------------------ capabilities
+
+
+RUNNER_FIXTURE = """\
+    class ModelRunner:
+        supports_fast_path: bool = True
+        supports_other = True
+
+    class MeshRunner(ModelRunner):
+        supports_fast_path = False
+
+    class MeshierRunner(MeshRunner):
+        pass
+"""
+
+ENGINE_GUARDED = """\
+    class Engine:
+        def __init__(self, cfg, runner):
+            if cfg.fast_path and not getattr(
+                    runner, "supports_fast_path", False):
+                raise ValueError("no fast path on this runner")
+"""
+
+
+def _cap_check(tmp_path, runner_body=RUNNER_FIXTURE,
+               engine_body=ENGINE_GUARDED, write_doc=True):
+    rp = write(tmp_path, "runner.py", runner_body)
+    ep = write(tmp_path, "engine.py", engine_body)
+    doc = tmp_path / "capabilities.md"
+    if write_doc:
+        srcs = [SourceFile(rp, str(tmp_path))]
+        runners, bases, _ = capabilities.scan_runners(srcs)
+        matrix = capabilities.resolve_matrix(runners, bases)
+        order = ["ModelRunner"] + [c for c in runners if c != "ModelRunner"]
+        doc.write_text(capabilities.render_doc(matrix, order))
+    return capabilities.check(
+        root=str(tmp_path), runner_path=rp, mesh_paths=[],
+        guard_paths=[ep], doc_path=str(doc))
+
+
+def test_capability_clean_tree(tmp_path):
+    assert _cap_check(tmp_path) == []
+
+
+def test_capability_missing_guard_fires(tmp_path):
+    fs = _cap_check(tmp_path, engine_body="""\
+        class Engine:
+            def __init__(self, cfg, runner):
+                pass
+    """)
+    assert rules(fs) == ["capability-missing-guard"]
+    assert "supports_fast_path" in fs[0].message
+    assert "MeshRunner" in fs[0].message
+
+
+def test_capability_non_literal_flag_fires(tmp_path):
+    """A computed flag value would resolve to '?' and dodge the
+    missing-guard audit — it must be its own finding."""
+    fs = _cap_check(tmp_path, runner_body=RUNNER_FIXTURE + """\
+
+    class ComputedRunner(ModelRunner):
+        supports_fast_path = _FAST_OK
+    """, write_doc=False)
+    assert "capability-non-literal" in rules(fs)
+
+
+def test_capability_feature_branch_is_not_a_guard(tmp_path):
+    """An `if` that READS the flag to take a feature path doesn't become a
+    refusal guard just because some nested statement raises."""
+    fs = _cap_check(tmp_path, engine_body="""\
+        class Engine:
+            def __init__(self, cfg, runner):
+                if runner.supports_fast_path:
+                    for step in cfg.steps:
+                        if step < 0:
+                            raise ValueError("bad step count")
+    """)
+    assert rules(fs) == ["capability-missing-guard"]
+
+
+def test_capability_unknown_flag_fires(tmp_path):
+    fs = _cap_check(tmp_path, runner_body=RUNNER_FIXTURE + """\
+
+    class TypoRunner(ModelRunner):
+        supports_fastpath = False  # typo'd: base declares supports_fast_path
+    """, write_doc=False)
+    assert "capability-unknown-flag" in rules(fs)
+
+
+def test_capability_inheritance_resolves(tmp_path):
+    """MeshierRunner declares nothing itself; the matrix must resolve its
+    fast-path flag False through MeshRunner, not fall back to the base."""
+    rp = write(tmp_path, "runner.py", RUNNER_FIXTURE)
+    srcs = [SourceFile(rp, str(tmp_path))]
+    runners, bases, _ = capabilities.scan_runners(srcs)
+    matrix = capabilities.resolve_matrix(runners, bases)
+    assert matrix["supports_fast_path"]["MeshierRunner"] is False
+    assert matrix["supports_other"]["MeshierRunner"] is True
+
+
+def test_capability_attribute_base_resolves(tmp_path):
+    """A module-qualified base (`runner.ModelRunner`) keeps the subclass in
+    the matrix — and its typo'd flags visible to the unknown-flag check."""
+    fs = _cap_check(tmp_path, runner_body=RUNNER_FIXTURE + """\
+
+    class QualifiedRunner(runner.MeshRunner):
+        supports_fastpath = False  # typo'd: base declares supports_fast_path
+    """, write_doc=False)
+    assert "capability-unknown-flag" in rules(fs)
+
+
+def test_capability_doc_round_trip(tmp_path):
+    fs = _cap_check(tmp_path, write_doc=False)
+    assert rules(fs) == ["capability-docs-stale"]
+
+
+# ---------------------------------------------------------------- host-sync
+
+
+HOT_CLEAN = """\
+    import jax
+    import jax.numpy as jnp
+
+    class E:
+        # statics: hot-region(decode-loop)
+        def dispatch(self, state):
+            tables = jnp.asarray([1, 2])          # upload: fine
+            out = self.runner.decode(state, tables)
+            out.copy_to_host_async()              # async: fine
+            return out
+
+        def cold(self, out):
+            return jax.device_get(out)            # unmarked function: fine
+"""
+
+
+def test_host_sync_clean_tree(tmp_path):
+    p = write(tmp_path, "e.py", HOT_CLEAN)
+    assert host_sync.check(root=str(tmp_path), paths=[p]) == []
+
+
+@pytest.mark.parametrize("sync,expect", [
+    ("jax.device_get(out)", "jax.device_get"),
+    ("out.block_until_ready()", ".block_until_ready()"),
+    ("np.asarray(out)", "np.asarray"),
+    ("out.item()", ".item()"),
+    ("float(out)", "float() conversion"),
+])
+def test_host_sync_fires_in_hot_region(tmp_path, sync, expect):
+    p = write(tmp_path, "e.py", f"""\
+        import jax
+        import numpy as np
+
+        class E:
+            # statics: hot-region(decode-loop)
+            def dispatch(self, out):
+                x = {sync}
+                return x
+    """)
+    fs = host_sync.check(root=str(tmp_path), paths=[p])
+    assert rules(fs) == ["host-sync"]
+    assert expect in fs[0].message
+    assert "decode-loop" in fs[0].message
+
+
+def test_host_sync_pragma_suppresses(tmp_path):
+    p = write(tmp_path, "e.py", """\
+        import jax
+
+        class E:
+            # statics: hot-region(harvest)
+            def retire(self, leaves):
+                return jax.device_get(leaves)  # statics: allow-host-sync(the one batched readback)
+    """)
+    assert host_sync.check(root=str(tmp_path), paths=[p]) == []
+
+
+def test_host_sync_repo_hot_regions_marked():
+    """The live tree keeps its decode/prefill/hybrid dispatch paths marked
+    — an empty marker set would silently disable the whole lint."""
+    src = SourceFile(os.path.join(
+        REPO, "agentic_traffic_testing_tpu", "runtime", "engine.py"), REPO)
+    regions = {name for name, _ in src.hot_functions()}
+    assert {"decode-loop", "prefill-pipeline", "hybrid-dispatch",
+            "harvest"} <= regions
+
+
+# ----------------------------------------------------------------- donation
+
+
+RUNNER_DONATING = """\
+    import jax
+    from functools import partial
+
+    def _decode_impl(params, cache, state):
+        return state, cache, None
+
+    class ModelRunner:
+        def __init__(self):
+            self._decode = jax.jit(
+                partial(_decode_impl),
+                donate_argnames=("cache", "state"),
+            )
+
+        def decode(self, cache, state):
+            return self._decode(self.params, cache=cache, state=state)
+"""
+
+
+def _donation_check(tmp_path, engine_body):
+    rp = write(tmp_path, "runner.py", RUNNER_DONATING)
+    ep = write(tmp_path, "engine.py", engine_body)
+    return donation.check(root=str(tmp_path), runner_path=rp,
+                          caller_paths=[ep])
+
+
+def test_donation_clean_rebind(tmp_path):
+    assert _donation_check(tmp_path, """\
+        class Engine:
+            def step(self):
+                self._state, self.cache, out = self.runner.decode(
+                    self.cache, self._state)
+                return out
+    """) == []
+
+
+def test_donation_post_dispatch_read_fires(tmp_path):
+    fs = _donation_check(tmp_path, """\
+        class Engine:
+            def step(self):
+                result = self.runner.decode(self.cache, self._state)
+                stale = self._state.tokens    # reads the donated buffer
+                self._state, self.cache, out = result
+                return out, stale
+    """)
+    assert rules(fs) == ["donation"]
+    assert "self._state" in fs[0].message
+    assert fs[0].line == 4
+
+
+def test_donation_keyword_arg_tracked(tmp_path):
+    fs = _donation_check(tmp_path, """\
+        class Engine:
+            def step(self):
+                result = self.runner.decode(cache=self.cache,
+                                            state=self._state)
+                leak = self.cache.k           # donated via keyword
+                self._state, self.cache, out = result
+                return leak
+    """)
+    assert rules(fs) == ["donation"]
+    assert "self.cache" in fs[0].message
+
+
+def test_donation_branchwise_rebind_is_clean(tmp_path):
+    """The engine's real shape: the rebind happens inside an if/else —
+    taint must clear only when EVERY branch rebinds."""
+    assert _donation_check(tmp_path, """\
+        class Engine:
+            def step(self, spec):
+                result = self.runner.decode(self.cache, self._state)
+                if spec:
+                    self._state, self.cache, out, counts = result
+                else:
+                    self._state, self.cache, out = result
+                return self.cache, self._state
+    """) == []
+
+
+def test_donation_one_armed_rebind_still_tainted(tmp_path):
+    fs = _donation_check(tmp_path, """\
+        class Engine:
+            def step(self, spec):
+                result = self.runner.decode(self.cache, self._state)
+                if spec:
+                    self._state, self.cache, out = result
+                return self._state
+    """)
+    assert rules(fs) == ["donation"]
+
+
+def test_donation_loop_carried_read_fires(tmp_path):
+    """Reading the donated binding at the top of the NEXT iteration."""
+    fs = _donation_check(tmp_path, """\
+        class Engine:
+            def steps(self, n):
+                for _ in range(n):
+                    stale = self._state
+                    out = self.runner.decode(self.cache, self._state)
+                    self.cache = out[1]
+                return stale
+    """)
+    # Two reads of the donated state: the top-of-loop snapshot AND the
+    # re-pass into the next dispatch (both stale after iteration 1).
+    assert set(rules(fs)) == {"donation"} and len(fs) == 2
+
+
+def test_donation_attribute_store_keeps_taint(tmp_path):
+    """`state.attr = x` mutates the donated buffer, it doesn't rebind
+    `state` — reads after it must still be flagged."""
+    fs = _donation_check(tmp_path, """\
+        class Engine:
+            def step(self):
+                result = self.runner.decode(self.cache, self._state)
+                self._state.steps = 0
+                stale = self._state.tokens
+                self._state, self.cache, out = result
+                return out, stale
+    """)
+    assert set(rules(fs)) == {"donation"}
+    assert {f.line for f in fs} == {4, 5}  # the mutation's read AND the later read
+
+
+def test_donation_for_target_rebinds(tmp_path):
+    """A for target rebinds its name every iteration — reads of it in the
+    body are fresh, not stale reads of the donated buffer."""
+    assert _donation_check(tmp_path, """\
+        class Engine:
+            def steps(self, plans):
+                out = self.runner.decode(self.cache, states)
+                for states in plans:
+                    use = states.tokens
+                return use
+    """) == []
+
+
+def test_donation_while_test_read_fires(tmp_path):
+    """The while test re-evaluates after each iteration, so a binding
+    donated by the body is stale when the test reads it again."""
+    fs = _donation_check(tmp_path, """\
+        class Engine:
+            def steps(self):
+                while self._state.ready:
+                    out = self.runner.decode(self.cache, self._state)
+                    self.cache = out[1]
+    """)
+    assert set(rules(fs)) == {"donation"}
+    assert any(f.line == 3 for f in fs)  # the loop-test read itself
+
+
+def test_donation_alias_dispatch_tracked(tmp_path):
+    fs = _donation_check(tmp_path, """\
+        class Engine:
+            def step(self):
+                decode = self.runner.decode
+                result = decode(self.cache, self._state)
+                leak = self._state
+                self._state, self.cache, out = result
+                return leak
+    """)
+    assert rules(fs) == ["donation"]
+
+
+def test_donation_except_handler_read_fires(tmp_path):
+    """A handler can run after the donation but before the body's rebind,
+    so its read of the donated binding is stale even though the body
+    rebinds on the success path."""
+    fs = _donation_check(tmp_path, """\
+        class Engine:
+            def step(self):
+                try:
+                    out = self.runner.decode(self.cache, self._state)
+                    self._state, self.cache, res = out
+                except Exception:
+                    self.recover(self._state)
+                return res
+    """)
+    assert rules(fs) == ["donation"]
+    assert fs[0].line == 7  # the handler's read
+
+
+def test_donation_dispatch_in_if_test_taints(tmp_path):
+    """A dispatch buried in a condition expression still donates."""
+    fs = _donation_check(tmp_path, """\
+        class Engine:
+            def step(self):
+                if self.runner.decode(self.cache, self._state)[2] is None:
+                    return None
+                return self.cache.k
+    """)
+    assert rules(fs) == ["donation"]
+    assert "self.cache" in fs[0].message
+    assert fs[0].line == 5
+
+
+def test_donation_alias_rebind_invalidates(tmp_path):
+    """Rebinding an alias name to a non-dispatch callable must stop calls
+    through it from tainting their arguments."""
+    assert _donation_check(tmp_path, """\
+        class Engine:
+            def step(self):
+                decode = self.runner.decode
+                out = decode(self.cache, self._state)
+                self._state, self.cache, res = out
+                decode = self._lookup_table.get
+                val = decode(self.key)
+                return res, self.key, val
+    """) == []
+
+
+def test_donation_pragma_suppresses(tmp_path):
+    assert _donation_check(tmp_path, """\
+        class Engine:
+            def step(self):
+                result = self.runner.decode(self.cache, self._state)
+                stale = self._state  # statics: allow-donation(fixture: provably unreachable buffer)
+                self._state, self.cache, out = result
+                return stale
+    """) == []
+
+
+# ------------------------------------------------------------ whole plane
+
+
+def test_run_all_green_on_tree():
+    """The acceptance gate: zero unsuppressed findings on the live tree.
+    (test_scripts.py::test_statics_all_smoke additionally runs the CLI.)"""
+    report = run_all(REPO)
+    assert report["ok"], {
+        name: c["findings"] for name, c in report["checkers"].items()
+        if c["findings"]}
+    assert set(report["checkers"]) == {
+        "knobs", "capabilities", "host-sync", "donation", "metric-docs"}
+
+
+def test_run_all_dedups_repeats_not_distinct_findings(monkeypatch):
+    """Cross-checker repeats of the same finding collapse; two findings
+    sharing a location but differing in message both survive."""
+    import agentic_traffic_testing_tpu.statics as statics_pkg
+    shared = Finding("pragma-missing-reason", "engine.py", 7, "no reason")
+    dead_a = Finding("knob-dead", "knob_registry.py", 1, "LLM_A is dead")
+    dead_b = Finding("knob-dead", "knob_registry.py", 1, "LLM_B is dead")
+    monkeypatch.setattr(statics_pkg, "CHECKERS", (
+        ("first", lambda root: [shared, dead_a, dead_b]),
+        ("second", lambda root: [shared]),
+    ))
+    report = statics_pkg.run_all(REPO)
+    assert len(report["checkers"]["first"]["findings"]) == 3
+    assert report["checkers"]["second"]["findings"] == []
+
+
+def test_generated_docs_round_trip(tmp_path):
+    """write_docs output == committed docs (the regenerate-and-diff gate,
+    exercised through the real --write-docs file-writing path)."""
+    # Mirror the runner sources into a tmp root so write_docs() runs its
+    # actual path joins and file writes without touching the repo.
+    for rel in (capabilities.RUNNER_RELPATH,) + capabilities.MESH_RELPATHS:
+        dst = tmp_path / rel
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text(open(os.path.join(REPO, rel)).read())
+    (tmp_path / "docs").mkdir()
+    written = write_docs(str(tmp_path))
+    assert sorted(written) == sorted(
+        [knobs.DOC_RELPATH, capabilities.DOC_RELPATH])
+    for rel in written:
+        committed = open(os.path.join(REPO, rel)).read()
+        assert (tmp_path / rel).read_text() == committed
