@@ -64,6 +64,21 @@ from agentic_traffic_testing_tpu.runtime.scheduler import (
     Scheduler,
     SchedulerConfig,
 )
+from agentic_traffic_testing_tpu.runtime.telemetry import (
+    EVENT_HOST_RESTORE,
+    EVENT_HOST_SAVE,
+    EVENT_MISPREDICT,
+    NULL_ANNOTATION,
+    PHASE_CHUNK,
+    PHASE_DECODE,
+    PHASE_HYBRID,
+    PHASE_OVERLAPPED_DECODE,
+    PHASE_PIPELINED_PREFILL,
+    PHASE_PREFILL,
+    REQ_ADMITTED,
+    REQ_PREFILL_CHUNK,
+    REQ_RESTORE,
+)
 
 log = logging.getLogger("att_tpu.engine")
 
@@ -144,6 +159,26 @@ class EngineConfig:
     # every path bit-identical to today. Single-chip, non-speculative
     # runners only (tp/sp/pp and speculation refuse at build).
     decode_overlap: int = 0
+    # Step-clock telemetry plane (round 8 — runtime/telemetry.py): 0
+    # (default) keeps the hot loop byte-identical and allocation-free —
+    # the engine holds NO recorder and every hook is one `is not None`
+    # test. 1 records one bounded ring-buffer entry per device dispatch
+    # and drain (phase kind, batch composition, token counts, dispatch
+    # vs drain wall split, overlap mispredicts, host-tier save/restore
+    # events) plus a per-request phase timeline (queued → admitted →
+    # prefill chunks → restores → first token → decode → retired), all
+    # from time.monotonic() stamps already on the host path — no device
+    # syncs, so the statics host-sync lint stays green. Values >= 2
+    # additionally set the step-ring capacity (default 4096).
+    step_trace: int = 0
+    # SLO classes for the telemetry plane's attainment accounting
+    # (llm_slo_attainment_total{slo,status}): per-request TTFT and
+    # mean-ITL caps in milliseconds. 0 (default) = no SLO on that axis,
+    # nothing emitted. Per-request overrides ride SamplingParams
+    # (slo_ttft_ms / slo_itl_ms — the HTTP body fields). Only measured
+    # when step_trace is on (the recorder is the measurement plane).
+    slo_ttft_ms: float = 0.0
+    slo_itl_ms: float = 0.0
     # Content-addressed reuse of full prompt blocks (vLLM automatic-prefix-
     # caching analog); cached requests prefill only their suffix.
     prefix_caching: bool = False
@@ -235,6 +270,13 @@ class EngineConfig:
             raise ValueError(
                 "decode_overlap x speculation is not wired — disable one "
                 "of them")
+        if self.step_trace < 0:
+            raise ValueError(
+                f"step_trace must be >= 0, got {self.step_trace}")
+        if self.slo_ttft_ms < 0 or self.slo_itl_ms < 0:
+            raise ValueError(
+                f"SLO caps must be >= 0 ms, got ttft={self.slo_ttft_ms} "
+                f"itl={self.slo_itl_ms}")
         if self.host_cache_gb < 0:
             raise ValueError(
                 f"host_cache_gb must be >= 0, got {self.host_cache_gb}")
@@ -528,6 +570,34 @@ class LLMEngine:
         # emitted/iters = mean tokens per verify step in [1, spec_tokens+1].
         self.spec_iters = 0
         self.spec_emitted = 0
+        # Step-clock telemetry (runtime/telemetry.py): None unless the
+        # knob is on, so the hot loop stays byte-identical and every
+        # hook below costs one `is not None` test with the plane off.
+        self.telemetry = None
+        if cfg.step_trace:
+            self.enable_step_trace(
+                capacity=cfg.step_trace if cfg.step_trace >= 2 else 4096)
+
+    def enable_step_trace(self, capacity: int = 4096):
+        """Install a StepClock recorder (host-only state, safe on any
+        runner): LLM_STEP_TRACE routes here at construction; bench probes
+        attach one to an already-built engine. Returns the recorder."""
+        from agentic_traffic_testing_tpu.runtime.telemetry import StepClock
+
+        self.telemetry = StepClock(capacity=capacity,
+                                   slo_ttft_ms=self.cfg.slo_ttft_ms,
+                                   slo_itl_ms=self.cfg.slo_itl_ms)
+        self.scheduler.on_admit = self._record_admission
+        return self.telemetry
+
+    def _record_admission(self, req: Request) -> None:
+        """Scheduler admission callback (wired only when tracing): the
+        exact instant a request turned RUNNING, with its cached-token
+        discount."""
+        rec = self.telemetry
+        if rec is not None:
+            rec.request_event(req.request_id, REQ_ADMITTED,
+                              time.monotonic(), req.num_computed_tokens)
 
     def _default_num_blocks(self) -> int:
         """Budget KV blocks from device memory, vLLM-profiling style."""
@@ -732,6 +802,8 @@ class LLMEngine:
         )
         self.scheduler.add_request(req)
         self._requests[req.request_id] = req
+        if self.telemetry is not None:
+            self.telemetry.request_queued(req.request_id, req.arrival_time)
         return req
 
     def abort_request(self, req: Request) -> list[StepOutput]:
@@ -752,6 +824,9 @@ class LLMEngine:
             # Overlap mispredict: speculative dispatches in flight carry
             # tokens for the aborted lane that the drain below discards.
             self.num_overlap_mispredicts += 1
+            if self.telemetry is not None:
+                self.telemetry.record_instant(EVENT_MISPREDICT,
+                                              time.monotonic())
         req.state = RequestState.ABORTED
         req.finish_reason = FinishReason.ABORT
         req.finish_time = time.monotonic()
@@ -760,6 +835,11 @@ class LLMEngine:
         self._requests.pop(req.request_id, None)
         self._new_tokens.pop(req.request_id, None)
         self._invalidate_decode_state()
+        if self.telemetry is not None:
+            # Sibling retirements ride _flush_events; the aborted lane
+            # itself never reaches it (its _new_tokens entry was popped).
+            self.telemetry.request_retired(
+                req.request_id, req.finish_time, reason="abort")
         return self._flush_events()
 
     def has_work(self) -> bool:
@@ -909,10 +989,18 @@ class LLMEngine:
         tokens, seq_lens, tables, steps = self._prefill_host_arrays(plan)
         tables_dev = jnp.asarray(tables)
         samp = self._sampling_arrays(reqs, b)
-        state, self.cache, out = self.runner.prefill(
-            jnp.asarray(tokens), self.cache, tables_dev,
-            jnp.asarray(seq_lens), samp, jnp.asarray(steps),
-        )
+        rec = self.telemetry
+        t0 = time.monotonic() if rec is not None else 0.0
+        span = rec.annotation(PHASE_PREFILL) if rec is not None else NULL_ANNOTATION
+        with span:
+            state, self.cache, out = self.runner.prefill(
+                jnp.asarray(tokens), self.cache, tables_dev,
+                jnp.asarray(seq_lens), samp, jnp.asarray(steps),
+            )
+        if rec is not None:
+            rec.record_dispatch(
+                PHASE_PREFILL, t0, time.monotonic(), len(reqs),
+                sum(r.num_prompt_tokens for r in reqs))
         for r in reqs:
             r.num_computed_tokens = r.num_prompt_tokens
             self._register_prefix(r)
@@ -924,6 +1012,8 @@ class LLMEngine:
             for i, r in enumerate(reqs):
                 if r.first_token_time is None:
                     r.first_token_time = now
+                if rec is not None:
+                    rec.request_tokens(r.request_id, now, 1)
                 self._append_token(r, int(toks[i]))
             self._invalidate_decode_state()
             return
@@ -973,12 +1063,20 @@ class LLMEngine:
         steps_dev = jnp.asarray(steps)
         tokens_dev = jnp.asarray(tokens)   # ONE host upload; chunks slice on device
         carry = jnp.zeros((b,), jnp.int32)
+        rec = self.telemetry
         for start in range(0, t, c):
-            self.cache, carry = self.runner.prefill_pipeline(
-                tokens_dev[:, start:start + c], self.cache, chunk_tables,
-                jnp.int32(start), seq_dev, carry, samp, steps_dev,
-            )
+            t0 = time.monotonic() if rec is not None else 0.0
+            span = (rec.annotation(PHASE_PIPELINED_PREFILL)
+                    if rec is not None else NULL_ANNOTATION)
+            with span:
+                self.cache, carry = self.runner.prefill_pipeline(
+                    tokens_dev[:, start:start + c], self.cache, chunk_tables,
+                    jnp.int32(start), seq_dev, carry, samp, steps_dev,
+                )
             self.num_pipeline_dispatches += 1
+            if rec is not None:
+                rec.record_dispatch(PHASE_PIPELINED_PREFILL, t0,
+                                    time.monotonic(), len(reqs), b * c)
         for r in reqs:
             r.num_computed_tokens = r.num_prompt_tokens
             self._register_prefix(r)
@@ -1036,6 +1134,8 @@ class LLMEngine:
             except Exception:
                 pass
         self._save_pending.append((key, tokens, k, v))
+        if self.telemetry is not None:
+            self.telemetry.record_instant(EVENT_HOST_SAVE, time.monotonic())
 
     # statics: hot-region(host-tier-drain)
     def _flush_saves(self) -> None:
@@ -1077,8 +1177,14 @@ class LLMEngine:
             v=self.cache.v.at[:, :, blks].set(v_new),
         )
         self.allocator.register_restored(restores)
-        self.host_restore_bytes += sum(
-            int(rb.k.nbytes) + int(rb.v.nbytes) for rb in restores)
+        nbytes = sum(int(rb.k.nbytes) + int(rb.v.nbytes) for rb in restores)
+        self.host_restore_bytes += nbytes
+        if self.telemetry is not None:
+            now = time.monotonic()
+            self.telemetry.record_instant(EVENT_HOST_RESTORE, now,
+                                          len(restores))
+            self.telemetry.request_event(r.request_id, REQ_RESTORE, now,
+                                         nbytes)
 
     # statics: hot-region(chunk-dispatch)
     def _run_chunk(self, plan: ChunkPrefill) -> None:
@@ -1096,11 +1202,20 @@ class LLMEngine:
         need_cols = -(-(plan.chunk_start + c) // self.cfg.block_size)
         tables = tables[:, : bucket_up(need_cols, self._chunk_width_buckets)]
         samp = self._sampling_arrays([r], 1)
-        self.cache, out = self.runner.prefill_chunk(
-            jnp.asarray(tokens), self.cache, jnp.asarray(tables),
-            jnp.int32(plan.chunk_start), jnp.int32(plan.chunk_len),
-            samp, jnp.asarray([r.sampling_step], jnp.int32),
-        )
+        rec = self.telemetry
+        t0 = time.monotonic() if rec is not None else 0.0
+        span = rec.annotation(PHASE_CHUNK) if rec is not None else NULL_ANNOTATION
+        with span:
+            self.cache, out = self.runner.prefill_chunk(
+                jnp.asarray(tokens), self.cache, jnp.asarray(tables),
+                jnp.int32(plan.chunk_start), jnp.int32(plan.chunk_len),
+                samp, jnp.asarray([r.sampling_step], jnp.int32),
+            )
+        if rec is not None:
+            rec.record_dispatch(PHASE_CHUNK, t0, time.monotonic(), 1,
+                                plan.chunk_len)
+            rec.request_event(r.request_id, REQ_PREFILL_CHUNK, t0,
+                              plan.chunk_len)
         self._apply_chunk_result(plan, out)
         # Intermediate chunk samples stay on device and are simply dropped.
         self._invalidate_decode_state()
@@ -1120,6 +1235,8 @@ class LLMEngine:
             now = time.monotonic()
             if r.first_token_time is None:
                 r.first_token_time = now
+            if self.telemetry is not None:
+                self.telemetry.request_tokens(r.request_id, now, 1)
             self._append_token(r, int(toks[0]))
 
     # -- hybrid (fused chunk + decode) -------------------------------------
@@ -1153,12 +1270,21 @@ class LLMEngine:
         chunk_tok[0, : len(seg)] = seg
         samp = self._sampling_arrays(
             list(reqs) + [None] * (b - len(reqs)) + [r], b + 1)
-        _, self.cache, dec_out, chunk_out = self.runner.hybrid(
-            jnp.asarray(tokens), jnp.asarray(chunk_tok), self.cache,
-            jnp.asarray(tables), jnp.asarray(positions),
-            jnp.int32(ck.chunk_start), jnp.int32(ck.chunk_len),
-            samp, jnp.asarray(steps),
-        )
+        rec = self.telemetry
+        t0 = time.monotonic() if rec is not None else 0.0
+        span = rec.annotation(PHASE_HYBRID) if rec is not None else NULL_ANNOTATION
+        with span:
+            _, self.cache, dec_out, chunk_out = self.runner.hybrid(
+                jnp.asarray(tokens), jnp.asarray(chunk_tok), self.cache,
+                jnp.asarray(tables), jnp.asarray(positions),
+                jnp.int32(ck.chunk_start), jnp.int32(ck.chunk_len),
+                samp, jnp.asarray(steps),
+            )
+        if rec is not None:
+            rec.record_dispatch(PHASE_HYBRID, t0, time.monotonic(),
+                                len(reqs), len(reqs) + ck.chunk_len)
+            rec.request_event(r.request_id, REQ_PREFILL_CHUNK, t0,
+                              ck.chunk_len)
         self._apply_chunk_result(ck, chunk_out)
         # Decode lanes' tokens land via the normal async harvest; the
         # composition changes next step anyway (the chunk continues, or
@@ -1404,9 +1530,20 @@ class LLMEngine:
         # entry is a separate [B, 1] buffer).
         decode = (self.runner.decode_overlapped if self.cfg.decode_overlap
                   else self.runner.decode)
-        result = decode(
-            self.cache, self._decode_tables, self._decode_state, self._decode_samp
-        )
+        rec = self.telemetry
+        t0 = time.monotonic() if rec is not None else 0.0
+        kind = PHASE_OVERLAPPED_DECODE if predicted else PHASE_DECODE
+        span = rec.annotation(kind) if rec is not None else NULL_ANNOTATION
+        with span:
+            result = decode(
+                self.cache, self._decode_tables, self._decode_state,
+                self._decode_samp
+            )
+        if rec is not None:
+            b = len(self._decode_requests)
+            rec.record_dispatch(kind, t0, time.monotonic(), b,
+                                b * self.runner.decode_steps,
+                                predicted=predicted)
         counts = None
         if getattr(self.runner, "spec_tokens", 0) > 0:
             self._decode_state, self.cache, out, counts = result
@@ -1488,6 +1625,9 @@ class LLMEngine:
         turn the pipeline tail into N round trips."""
         if not infs:
             return
+        rec = self.telemetry
+        t0 = time.monotonic() if rec is not None else 0.0
+        drained_tokens = 0
         leaves: list = []
         for inf in infs:
             leaves.append(inf.tokens)
@@ -1497,12 +1637,16 @@ class LLMEngine:
         for inf in infs:
             toks = next(fetched)  # device_get already returned numpy
             counts = next(fetched) if inf.counts is not None else None
+            if rec is not None:
+                drained_tokens += int(toks.size)
             if inf.predicted:
                 # Decrement BEFORE applying: if this entry's tokens finish
                 # a lane, the mispredict check must see only the
                 # speculative dispatches issued AFTER this one.
                 self._overlap_unharvested -= 1
             self._apply_inflight_host(inf.requests, toks, counts)
+        if rec is not None:
+            rec.record_drain(t0, time.monotonic(), len(infs), drained_tokens)
 
     def _any_request_gone(self, inf: _Inflight) -> bool:
         return any(r.is_finished() for r in inf.requests)
@@ -1514,16 +1658,21 @@ class LLMEngine:
         # counts [B, K] — only the first counts[b, k] entries of iteration k
         # were accepted on device.
         now = time.monotonic()
+        rec = self.telemetry
         for i, r in enumerate(requests):
             if r.is_finished() or r.state is not RequestState.RUNNING:
                 continue  # stopped at an earlier lagged step, or preempted
             if r.first_token_time is None:
                 r.first_token_time = now
+            n0 = r.sampling_step
             if counts is None:
                 for tok in toks[i]:
                     self._append_token(r, int(tok))
                     if r.is_finished():
                         break  # device tokens past the stop point are dropped
+                if rec is not None and r.sampling_step > n0:
+                    rec.request_tokens(r.request_id, now,
+                                       r.sampling_step - n0)
             else:
                 # Acceptance gauges count only consumed iterations and kept
                 # tokens — post-stop garbage rows would otherwise dominate
@@ -1537,6 +1686,9 @@ class LLMEngine:
                         self.spec_emitted += 1
                         if r.is_finished():
                             break
+                if rec is not None and r.sampling_step > n0:
+                    rec.request_tokens(r.request_id, now,
+                                       r.sampling_step - n0)
 
     def _append_token(self, r: Request, tok: int) -> None:
         r.output_ids.append(tok)
@@ -1564,6 +1716,9 @@ class LLMEngine:
         # must not stall the wave already decoding.
         if r in self._decode_requests:  # identity: Request is eq=False
             if self._overlap_unharvested > 0:
+                if self.telemetry is not None:
+                    self.telemetry.record_instant(EVENT_MISPREDICT,
+                                                  time.monotonic())
                 # Overlap mispredict: a stop landed while fast-path
                 # dispatches issued AFTER it were still in flight — their
                 # post-stop tails for this lane are discarded at harvest
@@ -1588,11 +1743,22 @@ class LLMEngine:
             # been in flight since evict time.
             self._flush_saves()
         events = []
+        rec = self.telemetry
         for rid, toks in self._new_tokens.items():
             req = self._requests[rid]
             events.append(StepOutput(request=req, new_token_ids=toks,
                                      finished=req.is_finished()))
             if req.is_finished():
+                if rec is not None:
+                    # Retired HERE (not in _finish) so the burst that
+                    # carried the final token is already on the timeline
+                    # when the SLO attainment math runs.
+                    rec.request_retired(
+                        rid, req.finish_time or time.monotonic(),
+                        reason=(req.finish_reason.value
+                                if req.finish_reason else None),
+                        slo_ttft_ms=req.sampling.slo_ttft_ms,
+                        slo_itl_ms=req.sampling.slo_itl_ms)
                 del self._requests[rid]
         self._new_tokens.clear()
         return events

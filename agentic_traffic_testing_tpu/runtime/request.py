@@ -22,6 +22,13 @@ class SamplingParams:
     seed: int = 0
     stop_token_ids: tuple[int, ...] = ()
     ignore_eos: bool = False
+    # Per-request SLO class overrides for the step-clock telemetry plane
+    # (runtime/telemetry.py): TTFT / mean-ITL caps in milliseconds. None
+    # falls back to the engine-level LLM_SLO_TTFT_MS / LLM_SLO_ITL_MS
+    # knobs; only read when LLM_STEP_TRACE is on (no recorder, no SLO
+    # accounting). Never touches sampling math or the device arrays.
+    slo_ttft_ms: Optional[float] = None
+    slo_itl_ms: Optional[float] = None
 
 
 class RequestState(enum.Enum):

@@ -181,6 +181,12 @@ class Scheduler:
         # dispatch against the predicted composition via extend_decode()
         # without paying the full sorted capacity pass per dispatch.
         self.composition_epoch = 0
+        # Admission observer (round 8, the step-clock telemetry plane):
+        # called with each request the instant it turns RUNNING — both
+        # admission paths below fire it, so the per-request timeline's
+        # queued→admitted boundary is exact. None (default) costs one
+        # attribute test per admission and nothing else.
+        self.on_admit = None
 
     # -- admission ---------------------------------------------------------
 
@@ -456,6 +462,8 @@ class Scheduler:
         head.state = RequestState.RUNNING
         self.running.append(self.waiting.popleft())
         self.composition_epoch += 1
+        if self.on_admit is not None:
+            self.on_admit(head)
         return head
 
     def _plan_prefill(self) -> Union[PrefillBatch, ChunkPrefill, None]:
@@ -536,6 +544,8 @@ class Scheduler:
                 record(r.num_prompt_tokens, 0)
             r.state = RequestState.RUNNING
             self.running.append(r)
+            if self.on_admit is not None:
+                self.on_admit(r)
         return PrefillBatch(
             requests=batch,
             padded_len=bucket_len,

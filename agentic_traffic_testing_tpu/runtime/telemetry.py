@@ -1,0 +1,440 @@
+"""Step-clock telemetry plane: bounded request-lifecycle + dispatch tracing.
+
+The engine between `enqueue` and `finish_time` used to be a black box:
+`serving/metrics.py` reproduces the reference's request-level families
+(reference: llm/serve_llm.py:92-167) but nothing recorded *where inside
+the engine* a request's latency went — queue vs prefill vs host-tier
+restore vs decode — or what each device dispatch actually was. This
+module is that instrument (ROADMAP item 2 needs per-request TTFT/ITL
+classes as a first-class metric before the round-8 admission policy can
+act on them; the vLLM-vs-TGI serving comparison in PAPERS.md frames
+exactly these percentiles as the numbers that arbitrate serving designs).
+
+Design constraints, in priority order:
+
+  * OFF BY DEFAULT and absent from the hot loop: the engine holds
+    `telemetry = None` unless `LLM_STEP_TRACE` is set, and every hook in
+    engine.py is behind an `if rec is not None` guard — with the knob off
+    the dispatch paths run byte-identically and the recorder performs
+    ZERO per-step allocations (tests/test_telemetry.py pins this).
+  * Allocation-light when ON: one `StepRecord` (a __slots__ object of
+    scalars) per device dispatch / drain, appended to a bounded
+    `deque(maxlen=...)` ring; per-request timelines are flat event
+    tuples, retired into a second bounded ring. Nothing here ever calls
+    into jax except `jax.profiler.TraceAnnotation` (a host-side trace
+    label), so the statics host-sync lint stays green: every stamp is
+    `time.monotonic()` on values already on the host path.
+  * Thread-safe: the engine thread records, the HTTP thread reads. The
+    exporter drain queues are lock-free (deque append/popleft are atomic
+    under the GIL; the worst outcome is a sample landing in the next
+    scrape), but the step ring and the timeline containers are iterated
+    by readers, so a small mutex guards mutation and snapshotting —
+    uncontended in the engine thread, and absent entirely with the knob
+    off.
+
+Three export surfaces read this recorder:
+
+  1. Prometheus — `serving/metrics.py` drains the sample queues on
+     scrape into `llm_ttft_seconds` / `llm_itl_seconds` /
+     `llm_step_duration_seconds{phase}` / `llm_batch_occupancy` /
+     `llm_slo_attainment_total{slo,status}`.
+  2. Chrome trace-event JSON — `chrome_trace()` renders one track per
+     replica (the step clock) plus one per request (phase spans),
+     loadable in Perfetto; served by `GET /debug/timeline` and
+     `scripts/dev/dump_timeline.py`.
+  3. OTel — `utils/tracing.py emit_phase_spans` replays a retired
+     request's timeline as child spans of the server's HTTP span.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict, deque
+from typing import Optional
+
+# Dispatch phase kinds (one per engine dispatch site). `DRAIN` is the
+# harvest readback — the other half of the wall-time split.
+PHASE_PREFILL = "prefill"
+PHASE_PIPELINED_PREFILL = "pipelined_prefill"
+PHASE_CHUNK = "chunk"
+PHASE_HYBRID = "hybrid"
+PHASE_DECODE = "decode"
+PHASE_OVERLAPPED_DECODE = "overlapped_decode"
+PHASE_DRAIN = "drain"
+
+#: every phase a StepRecord can carry — the exporter pre-touches these
+#: label values so a scrape shows zeroed series before traffic.
+STEP_PHASES = (
+    PHASE_PREFILL,
+    PHASE_PIPELINED_PREFILL,
+    PHASE_CHUNK,
+    PHASE_HYBRID,
+    PHASE_DECODE,
+    PHASE_OVERLAPPED_DECODE,
+    PHASE_DRAIN,
+)
+
+# Instant (zero-duration) engine-track events.
+EVENT_HOST_SAVE = "host_save"
+EVENT_HOST_RESTORE = "host_restore"
+EVENT_MISPREDICT = "overlap_mispredict"
+
+# Per-request lifecycle event names, in their canonical order. `TOKENS`
+# events repeat (one per harvest application); `RESTORE` is optional.
+REQ_QUEUED = "queued"
+REQ_ADMITTED = "admitted"
+REQ_PREFILL_CHUNK = "prefill_chunk"
+REQ_RESTORE = "restore"
+REQ_FIRST_TOKEN = "first_token"
+REQ_TOKENS = "tokens"
+REQ_RETIRED = "retired"
+
+
+class StepRecord:
+    """One engine dispatch (or drain): the step clock's unit.
+
+    `dur_s` is host wall time inside the engine's dispatch call — for
+    async dispatches that is the host/tunnel cost of issuing the step
+    (device compute overlaps); for `drain` it is the blocking readback.
+    `predicted` marks an overlapped-decode fast-path dispatch."""
+
+    __slots__ = ("seq", "kind", "t", "dur_s", "batch", "tokens", "predicted")
+
+    def __init__(self, seq: int, kind: str, t: float, dur_s: float,
+                 batch: int, tokens: int, predicted: bool = False) -> None:
+        self.seq = seq
+        self.kind = kind
+        self.t = t
+        self.dur_s = dur_s
+        self.batch = batch
+        self.tokens = tokens
+        self.predicted = predicted
+
+
+class RequestTimeline:
+    """Flat per-request phase timeline: (event, t, value) tuples in
+    arrival order. `value` is event-specific (token count for `tokens`,
+    restored bytes for `restore`, cached tokens for `admitted`)."""
+
+    __slots__ = ("request_id", "events", "first_token_t", "last_token_t",
+                 "queued_t", "finish_reason")
+
+    def __init__(self, request_id: str, queued_t: float) -> None:
+        self.request_id = request_id
+        self.queued_t = queued_t
+        self.events: list[tuple[str, float, float]] = [(REQ_QUEUED, queued_t, 0.0)]
+        self.first_token_t: Optional[float] = None
+        self.last_token_t: Optional[float] = None
+        self.finish_reason: Optional[str] = None
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.queued_t
+
+
+class _NullContext:
+    """Reusable, state-free context manager for the trace-off path (a
+    fresh contextlib.nullcontext() per dispatch would be an allocation)."""
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *a):
+        return False
+
+
+NULL_ANNOTATION = _NullContext()
+
+
+class StepClock:
+    """The recorder: bounded step ring + per-request timelines + drain
+    queues for the Prometheus exporter.
+
+    One per engine (a replica pool has one per replica; the chrome trace
+    merges them onto per-replica pids). All capacities are hard bounds —
+    a recorder left running under traffic with nobody scraping holds a
+    fixed working set and drops oldest-first."""
+
+    def __init__(self, capacity: int = 4096,
+                 slo_ttft_ms: float = 0.0,
+                 slo_itl_ms: float = 0.0,
+                 retired_capacity: int = 256,
+                 sample_capacity: int = 8192) -> None:
+        if capacity < 2:
+            raise ValueError(f"step ring capacity must be >= 2, got {capacity}")
+        self.capacity = capacity
+        # Live-timeline budget is decoupled from the step ring: the
+        # LLM_STEP_TRACE>=2 knob tunes dispatch-record history, and a
+        # small ring must NOT evict still-running requests' timelines
+        # (that would silently drop their TTFT/ITL/SLO samples).
+        self.live_capacity = max(capacity, 4096)
+        self.slo_ttft_ms = slo_ttft_ms
+        self.slo_itl_ms = slo_itl_ms
+        self.steps: deque[StepRecord] = deque(maxlen=capacity)
+        self._seq = 0
+        # Guards the step ring + timeline containers against HTTP-thread
+        # readers iterating mid-mutation (the exporter drain queues stay
+        # lock-free).
+        self._lock = threading.Lock()
+        # monotonic -> wall-clock offset, captured once: chrome traces and
+        # OTel spans need absolute timestamps while every stamp in the
+        # engine is time.monotonic().
+        self.epoch_ns = time.time_ns() - int(time.monotonic() * 1e9)
+        # Per-request timelines: live (keyed by request id) + a bounded
+        # retire ring. OrderedDict so an overflow of live entries (a
+        # caller that never retires) evicts oldest-first.
+        self._live: "OrderedDict[str, RequestTimeline]" = OrderedDict()
+        self._retired: deque[RequestTimeline] = deque(maxlen=retired_capacity)
+        # Exporter drain queues (popped by the scrape thread).
+        self.ttft_samples: deque[float] = deque(maxlen=sample_capacity)
+        self.itl_samples: deque[float] = deque(maxlen=sample_capacity)
+        # (slo_kind, met) events; empty unless an SLO is configured for
+        # the request (knob default or per-request override).
+        self.slo_events: deque[tuple[str, bool]] = deque(maxlen=sample_capacity)
+        self.step_samples: deque[tuple[str, float]] = deque(maxlen=sample_capacity)
+        # Most recent decode-dispatch occupancy (lanes), for the gauge.
+        self.last_decode_batch = 0
+        # Cumulative counters (cheap ints; survive ring eviction).
+        self.num_dispatches = 0
+        self.num_drains = 0
+        self.num_requests_retired = 0
+
+    # -- step clock (engine track) ----------------------------------------
+
+    def annotation(self, kind: str):
+        """`jax.profiler.TraceAnnotation` for a dispatch site, so XLA
+        device traces line up with step records; degrades to the shared
+        null context when the profiler is unavailable."""
+        try:
+            import jax
+
+            return jax.profiler.TraceAnnotation(f"step_clock/{kind}")
+        except Exception:  # pragma: no cover - profiler always importable with jax
+            return NULL_ANNOTATION
+
+    def record_dispatch(self, kind: str, t0: float, t1: float, batch: int,
+                        tokens: int, predicted: bool = False) -> None:
+        with self._lock:
+            self._seq += 1
+            self.num_dispatches += 1
+            self.steps.append(StepRecord(self._seq, kind, t0, t1 - t0, batch,
+                                         tokens, predicted))
+        self.step_samples.append((kind, t1 - t0))
+        if kind in (PHASE_DECODE, PHASE_OVERLAPPED_DECODE):
+            self.last_decode_batch = batch
+
+    def record_drain(self, t0: float, t1: float, entries: int,
+                     tokens: int) -> None:
+        with self._lock:
+            self._seq += 1
+            self.num_drains += 1
+            self.steps.append(StepRecord(self._seq, PHASE_DRAIN, t0, t1 - t0,
+                                         entries, tokens))
+        self.step_samples.append((PHASE_DRAIN, t1 - t0))
+
+    def record_instant(self, kind: str, t: float, value: float = 0.0) -> None:
+        """Zero-duration engine-track event (host-tier save/restore,
+        overlap mispredict): rides the same ring, dur_s = 0."""
+        with self._lock:
+            self._seq += 1
+            self.steps.append(StepRecord(self._seq, kind, t, 0.0, 0,
+                                         int(value)))
+
+    # -- request lifecycle --------------------------------------------------
+
+    def request_queued(self, request_id: str, t: float) -> None:
+        with self._lock:
+            if len(self._live) >= self.live_capacity:
+                # Bounded even against a caller that never retires: evict
+                # the oldest live timeline into the retired ring unfinished.
+                _, tl = self._live.popitem(last=False)
+                self._retired.append(tl)
+            self._live[request_id] = RequestTimeline(request_id, t)
+
+    def request_event(self, request_id: str, name: str, t: float,
+                      value: float = 0.0) -> None:
+        tl = self._live.get(request_id)
+        if tl is None:
+            return  # retired already (an abort's trailing drain), or evicted
+        tl.events.append((name, t, value))
+
+    def request_tokens(self, request_id: str, t: float, n: int) -> None:
+        """`n` tokens landed on host for this request at time `t` (one
+        harvest application). Stamps first-token, derives ITL samples —
+        a fused-K dispatch lands K tokens at one instant, so the honest
+        host-side ITL spreads the inter-arrival gap over the burst."""
+        if n <= 0:
+            return
+        tl = self._live.get(request_id)
+        if tl is None:
+            return
+        if tl.first_token_t is None:
+            tl.first_token_t = t
+            tl.events.append((REQ_FIRST_TOKEN, t, 0.0))
+            self.ttft_samples.append(t - tl.queued_t)
+            gap_tokens = n - 1  # tokens after the first in this burst
+        else:
+            gap_tokens = n
+        if gap_tokens > 0 and tl.last_token_t is not None:
+            per_tok = max(0.0, t - tl.last_token_t) / gap_tokens
+            for _ in range(gap_tokens):
+                self.itl_samples.append(per_tok)
+        tl.last_token_t = t
+        tl.events.append((REQ_TOKENS, t, float(n)))
+
+    def request_retired(self, request_id: str, t: float,
+                        reason: Optional[str] = None,
+                        slo_ttft_ms: Optional[float] = None,
+                        slo_itl_ms: Optional[float] = None) -> None:
+        """Close a request's timeline; emits SLO attainment events using
+        the per-request override when given, else the recorder defaults
+        (0/None = no SLO for that axis, nothing emitted)."""
+        with self._lock:
+            tl = self._live.pop(request_id, None)
+            if tl is None:
+                return
+            tl.finish_reason = reason
+            tl.events.append((REQ_RETIRED, t, 0.0))
+            self.num_requests_retired += 1
+            self._retired.append(tl)
+        if reason in ("abort", "error"):
+            return  # an aborted/unservable request attains no SLO verdict
+        ttft_cap = slo_ttft_ms if slo_ttft_ms is not None else self.slo_ttft_ms
+        if ttft_cap and tl.ttft_s is not None:
+            self.slo_events.append(("ttft", tl.ttft_s <= ttft_cap / 1e3))
+        itl_cap = slo_itl_ms if slo_itl_ms is not None else self.slo_itl_ms
+        if itl_cap and tl.first_token_t is not None and tl.last_token_t is not None:
+            n_after_first = sum(
+                v for name, _, v in tl.events if name == REQ_TOKENS) - 1
+            if n_after_first > 0:
+                mean_itl = (tl.last_token_t - tl.first_token_t) / n_after_first
+                self.slo_events.append(("itl", mean_itl <= itl_cap / 1e3))
+
+    # -- exporter drains (scrape thread) ------------------------------------
+
+    @staticmethod
+    def _drain(dq: deque) -> list:
+        out = []
+        while True:
+            try:
+                out.append(dq.popleft())
+            except IndexError:
+                return out
+
+    def drain_ttft_samples(self) -> list[float]:
+        return self._drain(self.ttft_samples)
+
+    def drain_itl_samples(self) -> list[float]:
+        return self._drain(self.itl_samples)
+
+    def drain_slo_events(self) -> list[tuple[str, bool]]:
+        return self._drain(self.slo_events)
+
+    def drain_step_samples(self) -> list[tuple[str, float]]:
+        return self._drain(self.step_samples)
+
+    # -- timeline lookups ----------------------------------------------------
+
+    def timeline_for(self, request_id: str) -> Optional[RequestTimeline]:
+        with self._lock:
+            tl = self._live.get(request_id)
+            if tl is not None:
+                return tl
+            for tl in reversed(self._retired):
+                if tl.request_id == request_id:
+                    return tl
+            return None
+
+    def timelines(self) -> list[RequestTimeline]:
+        """Every timeline the recorder still holds, retired first."""
+        with self._lock:
+            return list(self._retired) + list(self._live.values())
+
+    # -- Chrome trace-event export -------------------------------------------
+
+    def _us(self, mono_t: float) -> float:
+        """monotonic seconds -> absolute wall-clock microseconds."""
+        return (self.epoch_ns + mono_t * 1e9) / 1e3
+
+    def chrome_trace(self, pid: int = 0, name: str = "replica0") -> list[dict]:
+        """Trace-event JSON objects (the `traceEvents` list entries):
+        tid 0 = the engine step clock (one `X` slice per dispatch/drain,
+        `i` instants for save/restore/mispredict), tid >= 1 = one track
+        per request (phase slices queued/prefill/decode + token instants).
+        Loadable in Perfetto / chrome://tracing."""
+        events: list[dict] = [
+            {"ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+             "args": {"name": name}},
+            {"ph": "M", "name": "thread_name", "pid": pid, "tid": 0,
+             "args": {"name": "engine step clock"}},
+        ]
+        with self._lock:
+            step_snapshot = list(self.steps)
+        for rec in step_snapshot:
+            if rec.kind in STEP_PHASES:
+                events.append({
+                    "ph": "X", "name": rec.kind, "cat": "engine",
+                    "ts": self._us(rec.t), "dur": max(rec.dur_s, 0.0) * 1e6,
+                    "pid": pid, "tid": 0,
+                    "args": {"batch": rec.batch, "tokens": rec.tokens,
+                             "predicted": rec.predicted, "seq": rec.seq},
+                })
+            else:
+                events.append({
+                    "ph": "i", "name": rec.kind, "cat": "engine",
+                    "ts": self._us(rec.t), "pid": pid, "tid": 0, "s": "t",
+                    "args": {"value": rec.tokens},
+                })
+        tid = 1
+        for tl in self.timelines():
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid,
+                           "args": {"name": f"req {tl.request_id}"}})
+            events.extend(self._request_slices(tl, pid, tid))
+            tid += 1
+        return events
+
+    def _request_slices(self, tl: RequestTimeline, pid: int,
+                        tid: int) -> list[dict]:
+        """Phase slices for one request track: queued (arrival ->
+        admission), prefill (admission -> first token), decode (first
+        token -> retire), plus instants for restores and token bursts."""
+        out: list[dict] = []
+        by_name: dict[str, float] = {}
+        for name, t, value in tl.events:
+            if name not in by_name:
+                by_name[name] = t
+            if name in (REQ_RESTORE, REQ_TOKENS):
+                out.append({"ph": "i", "name": name, "cat": "request",
+                            "ts": self._us(t), "pid": pid, "tid": tid,
+                            "s": "t", "args": {"value": value}})
+        end_t = by_name.get(REQ_RETIRED, tl.last_token_t or tl.queued_t)
+
+        def slice_(name: str, t0: Optional[float], t1: Optional[float]):
+            if t0 is None or t1 is None or t1 < t0:
+                return
+            out.append({"ph": "X", "name": name, "cat": "request",
+                        "ts": self._us(t0), "dur": (t1 - t0) * 1e6,
+                        "pid": pid, "tid": tid,
+                        "args": {"request_id": tl.request_id}})
+
+        admitted = by_name.get(REQ_ADMITTED)
+        slice_("queued", tl.queued_t, admitted or tl.first_token_t or end_t)
+        slice_("prefill", admitted, tl.first_token_t or end_t)
+        slice_("decode", tl.first_token_t, end_t)
+        return out
+
+
+def chrome_trace_document(recorders: list, names: Optional[list[str]] = None) -> dict:
+    """Merge per-replica recorders into one Chrome trace JSON document
+    (`{"traceEvents": [...]}`), pid = replica index."""
+    events: list[dict] = []
+    for i, rec in enumerate(recorders):
+        if rec is None:
+            continue
+        label = names[i] if names and i < len(names) else f"replica{i}"
+        events.extend(rec.chrome_trace(pid=i, name=label))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}

@@ -91,6 +91,19 @@ class ServerConfig:
     # non-speculative runners only: refused here and at engine build
     # with LLM_SPECULATION or tp/sp/pp meshes, not at first step.
     decode_overlap: int = 0                    # LLM_DECODE_OVERLAP
+    # Step-clock telemetry plane (round 8 — runtime/telemetry.py): 0
+    # (default) keeps the engine hot loop byte-identical and allocation-
+    # free (no recorder exists); 1 records per-dispatch step records +
+    # per-request phase timelines, feeding llm_ttft_seconds /
+    # llm_itl_seconds / llm_step_duration_seconds / llm_slo_attainment
+    # and the GET /debug/timeline Chrome-trace endpoint. Values >= 2 set
+    # the step-ring capacity. Works on every runner (host-side only).
+    step_trace: int = 0                        # LLM_STEP_TRACE
+    # SLO classes for the attainment accounting (ms; 0 = no SLO on that
+    # axis). Per-request overrides ride the HTTP body's slo_ttft_ms /
+    # slo_itl_ms fields. Measured only when step_trace is on.
+    slo_ttft_ms: float = 0.0                   # LLM_SLO_TTFT_MS
+    slo_itl_ms: float = 0.0                    # LLM_SLO_ITL_MS
     prefix_caching: bool = False               # LLM_PREFIX_CACHING
     # Host-RAM second tier for the prefix cache (runtime/kv_offload.py):
     # GB of host memory for evicted prefix blocks; restored device-side on
@@ -195,6 +208,18 @@ class ServerConfig:
             raise ValueError(
                 "LLM_DECODE_OVERLAP x LLM_SPECULATION is not wired — "
                 "disable one of them")
+        c.step_trace = int(os.environ.get("LLM_STEP_TRACE") or c.step_trace)
+        if c.step_trace < 0:
+            raise ValueError(
+                f"LLM_STEP_TRACE must be >= 0, got {c.step_trace} "
+                f"(unset it to disable the step-clock telemetry plane)")
+        c.slo_ttft_ms = float(
+            os.environ.get("LLM_SLO_TTFT_MS") or c.slo_ttft_ms)
+        c.slo_itl_ms = float(os.environ.get("LLM_SLO_ITL_MS") or c.slo_itl_ms)
+        if c.slo_ttft_ms < 0 or c.slo_itl_ms < 0:
+            raise ValueError(
+                f"LLM_SLO_TTFT_MS / LLM_SLO_ITL_MS must be >= 0 ms, got "
+                f"{c.slo_ttft_ms} / {c.slo_itl_ms}")
         c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
         c.host_cache_gb = float(
             os.environ.get("LLM_HOST_CACHE_GB") or c.host_cache_gb)
@@ -263,6 +288,16 @@ class ServerConfig:
         p.add_argument("--decode-overlap", type=int, default=c.decode_overlap,
                        help="1 = overlapped decode loop (speculative "
                             "next-step dispatch; 0 = serial)")
+        p.add_argument("--step-trace", type=int, default=c.step_trace,
+                       help="1 = step-clock telemetry plane (per-dispatch "
+                            "records, request timelines, /debug/timeline; "
+                            "0 = off, hot loop untouched)")
+        p.add_argument("--slo-ttft-ms", type=float, default=c.slo_ttft_ms,
+                       help="TTFT SLO class in ms for llm_slo_attainment "
+                            "(0 = no SLO; needs --step-trace)")
+        p.add_argument("--slo-itl-ms", type=float, default=c.slo_itl_ms,
+                       help="mean-ITL SLO class in ms for "
+                            "llm_slo_attainment (0 = no SLO)")
         p.add_argument("--enable-prefix-caching", dest="prefix_caching",
                        action="store_true", default=c.prefix_caching)
         p.add_argument("--host-cache-gb", type=float, default=c.host_cache_gb,
@@ -285,7 +320,8 @@ class ServerConfig:
                   "router_policy", "quantization",
                   "decode_steps", "prefill_chunk_tokens",
                   "prefill_batch_max_len", "prefill_pipeline_chunks",
-                  "decode_overlap", "prefix_caching",
+                  "decode_overlap", "step_trace", "slo_ttft_ms",
+                  "slo_itl_ms", "prefix_caching",
                   "host_cache_gb", "hybrid_token_budget",
                   "num_blocks", "block_size", "weights_path",
                   "speculation", "spec_tokens", "spec_ngram"):
@@ -304,4 +340,11 @@ class ServerConfig:
             raise ValueError(
                 "--decode-overlap does not compose with --speculation — "
                 "disable one of them")
+        if c.step_trace < 0:
+            raise ValueError(
+                f"--step-trace must be >= 0, got {c.step_trace}")
+        if c.slo_ttft_ms < 0 or c.slo_itl_ms < 0:
+            raise ValueError(
+                f"--slo-ttft-ms / --slo-itl-ms must be >= 0, got "
+                f"{c.slo_ttft_ms} / {c.slo_itl_ms}")
         return c

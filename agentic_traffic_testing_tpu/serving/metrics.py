@@ -24,6 +24,16 @@ from prometheus_client import (
 LATENCY_BUCKETS = [0.5, 1.0, 2.5, 5.0, 10.0, 15.0, 20.0, 30.0, 45.0, 60.0, 90.0, 120.0, 180.0]
 BATCH_BUCKETS = [1, 2, 3, 4, 5, 6, 8, 10, 12, 16, 20, 32]
 INTERARRIVAL_BUCKETS = [0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0]
+# Step-clock families (round 8, runtime/telemetry.py). TTFT needs finer
+# low-end resolution than the reference's 0.5 s-floored LATENCY_BUCKETS
+# (a warm prefill lands in tens of ms); ITL and per-dispatch step
+# durations live another order of magnitude down.
+TTFT_BUCKETS = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+                10.0, 30.0, 60.0]
+ITL_BUCKETS = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+               0.5, 1.0, 2.5]
+STEP_BUCKETS = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5, 5.0]
 
 
 class LLMMetrics:
@@ -228,6 +238,65 @@ class LLMMetrics:
             f"{prefix}_model_loaded",
             "Whether checkpoint weights are loaded (1) vs random init (0)",
             registry=r)
+        # Step-clock telemetry plane (round 8 — runtime/telemetry.py).
+        # Always registered (like the spec gauges) so the scrape contract
+        # is stable, but every series stays empty/zero unless
+        # LLM_STEP_TRACE=1 gives the engine a recorder to drain:
+        # llm_queue_wait_seconds stays the reference's HTTP-layer TTFT
+        # proxy; llm_ttft_seconds is the ENGINE-measured arrival→first-
+        # token (same stamps as meta.queue_wait_s, minus the event-loop
+        # hop), and llm_itl_seconds the host-observed inter-token gap
+        # (fused-K bursts spread over their K tokens).
+        self.ttft = Histogram(
+            f"{prefix}_ttft_seconds",
+            "Engine-measured time to first token (arrival -> first token "
+            "on host); empty unless LLM_STEP_TRACE=1",
+            buckets=TTFT_BUCKETS, registry=r)
+        self.itl = Histogram(
+            f"{prefix}_itl_seconds",
+            "Engine-measured inter-token latency (host-side decode token "
+            "gaps); empty unless LLM_STEP_TRACE=1",
+            buckets=ITL_BUCKETS, registry=r)
+        self.step_duration = Histogram(
+            f"{prefix}_step_duration_seconds",
+            "Host wall time per engine step, by phase (dispatch phases "
+            "measure issue cost — device compute overlaps; drain is the "
+            "blocking harvest readback); empty unless LLM_STEP_TRACE=1",
+            ["phase"], buckets=STEP_BUCKETS, registry=r)
+        self.batch_occupancy = Gauge(
+            f"{prefix}_batch_occupancy",
+            "Decode lanes occupied in the most recent decode dispatch "
+            "(pool: summed across replicas); 0 unless LLM_STEP_TRACE=1",
+            registry=r)
+        self.slo_attainment = Counter(
+            f"{prefix}_slo_attainment",
+            "Per-request SLO verdicts by axis (slo=ttft|itl) and outcome "
+            "(status=met|violated); requires LLM_STEP_TRACE=1 plus an SLO "
+            "class (LLM_SLO_TTFT_MS / LLM_SLO_ITL_MS or per-request "
+            "slo_ttft_ms / slo_itl_ms body fields)",
+            ["slo", "status"], registry=r)
+        self.config_step_trace = Gauge(
+            f"{prefix}_config_step_trace",
+            "Step-clock telemetry enabled (LLM_STEP_TRACE; 0 = recorder "
+            "absent, trace surfaces empty)", registry=r)
+        self.config_slo_ttft_ms = Gauge(
+            f"{prefix}_config_slo_ttft_ms",
+            "Default TTFT SLO class in ms (LLM_SLO_TTFT_MS; 0 = no SLO)",
+            registry=r)
+        self.config_slo_itl_ms = Gauge(
+            f"{prefix}_config_slo_itl_ms",
+            "Default mean-ITL SLO class in ms (LLM_SLO_ITL_MS; 0 = no SLO)",
+            registry=r)
+        # Pre-touch every label combination so a scrape shows zeroed
+        # series (deterministic payload) instead of families appearing
+        # only after first traffic.
+        from agentic_traffic_testing_tpu.runtime.telemetry import STEP_PHASES
+
+        for phase in STEP_PHASES:
+            self.step_duration.labels(phase=phase)
+        for slo in ("ttft", "itl"):
+            for status in ("met", "violated"):
+                self.slo_attainment.labels(slo=slo, status=status)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -252,6 +321,32 @@ class LLMMetrics:
             stats["host_cache_save_queue_depth"])
         self.host_cache_used_bytes.set(stats["host_cache_used_bytes"])
         self.host_cache_capacity_bytes.set(stats["host_cache_capacity_bytes"])
+
+    def observe_step_clock(self, recorders: list) -> None:
+        """Drain per-engine StepClock recorders (runtime/telemetry.py)
+        into the step-clock families — called on scrape. Under a replica
+        pool every replica's recorder drains into the SAME families
+        (merged histograms, like llm_batch_size); the occupancy gauge
+        sums the replicas' last decode compositions. No-op with tracing
+        off (the list holds no recorders)."""
+        occupancy = 0
+        seen = False
+        for rec in recorders:
+            if rec is None:
+                continue
+            seen = True
+            occupancy += rec.last_decode_batch
+            for s in rec.drain_ttft_samples():
+                self.ttft.observe(s)
+            for s in rec.drain_itl_samples():
+                self.itl.observe(s)
+            for phase, dur in rec.drain_step_samples():
+                self.step_duration.labels(phase=phase).observe(dur)
+            for slo, met in rec.drain_slo_events():
+                self.slo_attainment.labels(
+                    slo=slo, status="met" if met else "violated").inc()
+        if seen:
+            self.batch_occupancy.set(occupancy)
 
     def set_replica_stats(self, replica_stats: list) -> None:
         """Refresh the per-replica labeled series from EnginePool
@@ -305,7 +400,10 @@ class LLMMetrics:
                           tp_size: int = 1, sp_size: int = 1,
                           pp_size: int = 1, num_replicas: int = 1,
                           prefill_pipeline_chunks: int = 0,
-                          decode_overlap: int = 0) -> None:
+                          decode_overlap: int = 0,
+                          step_trace: int = 0,
+                          slo_ttft_ms: float = 0.0,
+                          slo_itl_ms: float = 0.0) -> None:
         # max_num_seqs/max_num_batched_tokens stay PER-REPLICA values (the
         # configured knob, a config snapshot — docs/monitoring.md); the
         # pool-wide seat count is num_replicas * max_num_seqs.
@@ -319,6 +417,9 @@ class LLMMetrics:
         self.config_num_replicas.set(num_replicas)
         self.config_prefill_pipeline_chunks.set(prefill_pipeline_chunks)
         self.config_decode_overlap.set(decode_overlap)
+        self.config_step_trace.set(step_trace)
+        self.config_slo_ttft_ms.set(slo_ttft_ms)
+        self.config_slo_itl_ms.set(slo_itl_ms)
 
     def set_kv_gauges(self, *, num_blocks: int, block_size: int,
                       max_model_len: int, max_num_seqs: int) -> None:

@@ -204,6 +204,21 @@ class EnginePool:
         return sum(e.num_overlap_mispredicts for e in self.engines)
 
     @property
+    def telemetry_recorders(self) -> list:
+        """Per-replica StepClock recorders (runtime/telemetry.py); empty
+        unless LLM_STEP_TRACE built the engines with tracing on."""
+        return [e.telemetry for e in self.engines if e.telemetry is not None]
+
+    def chrome_trace(self) -> dict:
+        """Merged Chrome trace document: one pid per replica, so a pool's
+        step clocks land side by side in Perfetto."""
+        from agentic_traffic_testing_tpu.runtime.telemetry import (
+            chrome_trace_document,
+        )
+
+        return chrome_trace_document([e.telemetry for e in self.engines])
+
+    @property
     def usable_tokens(self) -> int:
         return sum(e.cache.usable_tokens for e in self.engines)
 

@@ -180,6 +180,9 @@ class LLMServer:
                 num_replicas=cfg.num_replicas,
                 prefill_pipeline_chunks=cfg.prefill_pipeline_chunks,
                 decode_overlap=cfg.decode_overlap,
+                step_trace=cfg.step_trace,
+                slo_ttft_ms=cfg.slo_ttft_ms,
+                slo_itl_ms=cfg.slo_itl_ms,
             )
             if self.pool is not None:
                 # Pool aggregate under the EXACT pre-pool names: blocks and
@@ -222,6 +225,9 @@ class LLMServer:
             prefill_batch_max_len=c.prefill_batch_max_len,
             prefill_pipeline_chunks=c.prefill_pipeline_chunks,
             decode_overlap=c.decode_overlap,
+            step_trace=c.step_trace,
+            slo_ttft_ms=c.slo_ttft_ms,
+            slo_itl_ms=c.slo_itl_ms,
             prefix_caching=c.prefix_caching,
             host_cache_gb=c.host_cache_gb,
             hybrid_token_budget=c.hybrid_token_budget,
@@ -525,10 +531,41 @@ class LLMServer:
             dispatches=getattr(source, "num_pipeline_dispatches", 0))
         self.metrics.set_decode_overlap_stats(
             mispredicts=getattr(source, "num_overlap_mispredicts", 0))
+        self.metrics.observe_step_clock(self._recorders())
         if self.pool is not None:
             self.metrics.set_replica_stats(self.pool.replica_stats())
         return web.Response(body=self.metrics.render(),
                             headers={"Content-Type": self.metrics.content_type})
+
+    def _engines(self) -> list:
+        return self.pool.engines if self.pool is not None else [self.engine]
+
+    def _recorders(self) -> list:
+        """Per-replica StepClock recorders (empty list when the step-trace
+        plane is off)."""
+        if self.pool is not None:
+            return self.pool.telemetry_recorders
+        return ([self.engine.telemetry]
+                if self.engine.telemetry is not None else [])
+
+    async def handle_debug_timeline(self, request: web.Request) -> web.Response:
+        """Chrome trace-event JSON of the step-clock rings: one track per
+        replica (engine dispatch/drain slices) + one per request (phase
+        spans). Load the response body in Perfetto (ui.perfetto.dev) or
+        chrome://tracing. 409 until LLM_STEP_TRACE enables the recorder,
+        mirroring the /profile endpoints' not-active contract."""
+        recorders = self._recorders()
+        if not recorders:
+            return web.json_response(
+                {"error": "step trace not enabled (set LLM_STEP_TRACE=1)"},
+                status=409)
+        if self.pool is not None:
+            return web.json_response(self.pool.chrome_trace())
+        from agentic_traffic_testing_tpu.runtime.telemetry import (
+            chrome_trace_document,
+        )
+
+        return web.json_response(chrome_trace_document(recorders))
 
     async def handle_profile_start(self, request: web.Request) -> web.Response:
         """Start a jax.profiler trace (device + host timelines) — the
@@ -662,11 +699,26 @@ class LLMServer:
                                                  self.cfg.temperature))
                 except (TypeError, ValueError):
                     temperature = self.cfg.temperature
+                def _slo_ms(field: str) -> Optional[float]:
+                    # Per-request SLO class override (step-clock telemetry
+                    # plane); malformed/negative values fall back to the
+                    # server-level knob rather than 400ing the request.
+                    v = data.get(field)
+                    if v is None:
+                        return None
+                    try:
+                        v = float(v)
+                    except (TypeError, ValueError):
+                        return None
+                    return v if v >= 0 else None
+
                 sampling = SamplingParams(
                     max_tokens=max(1, effective_max),
                     temperature=temperature,
                     stop_token_ids=tuple(self.tokenizer.eos_ids),
                     seed=hash(request_id) & 0x7FFFFFFF,
+                    slo_ttft_ms=_slo_ms("slo_ttft_ms"),
+                    slo_itl_ms=_slo_ms("slo_itl_ms"),
                 )
             except web.HTTPException:
                 raise
@@ -700,6 +752,11 @@ class LLMServer:
                     if prompt_tokens is not None:
                         span.set_attribute("llm.total_tokens",
                                            prompt_tokens + completion_tokens)
+                # Step-clock -> OTel: replay the engine-side phase
+                # timeline (queue/prefill/decode/restores) as child spans
+                # of this HTTP span, so Jaeger shows where the latency
+                # went INSIDE the engine. No-op unless LLM_STEP_TRACE=1.
+                self._emit_phase_spans(request_id)
             except Exception as exc:
                 status = "error"
                 await _done()
@@ -740,6 +797,19 @@ class LLMServer:
                 "otel": span_metadata(span),
             }
             return web.json_response({"output": text, "meta": meta})
+
+    def _emit_phase_spans(self, request_id: str) -> None:
+        """Emit per-phase OTel child spans for a finished request from
+        its recorder timeline (whichever replica served it). Timestamps
+        are the recorder's monotonic stamps mapped to wall-clock ns, so
+        the spans nest correctly under the live HTTP span."""
+        from agentic_traffic_testing_tpu.utils.tracing import emit_phase_spans
+
+        for rec in self._recorders():
+            tl = rec.timeline_for(request_id)
+            if tl is not None:
+                emit_phase_spans(self.tracer, tl.events, rec.epoch_ns)
+                return
 
     async def _generate(self, prompt_ids: list[int], sampling: SamplingParams,
                         request_id: str, span) -> tuple[str, float, int]:
@@ -788,6 +858,7 @@ class LLMServer:
         app.router.add_get("/metrics", self.handle_metrics)
         app.router.add_post("/profile/start", self.handle_profile_start)
         app.router.add_post("/profile/stop", self.handle_profile_stop)
+        app.router.add_get("/debug/timeline", self.handle_debug_timeline)
         app.router.add_post("/chat", self.handle_chat)
         app.router.add_post("/completion", self.handle_chat)
         app.router.add_post("/generate", self.handle_chat)

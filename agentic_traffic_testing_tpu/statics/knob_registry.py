@@ -96,6 +96,20 @@ KNOBS: tuple[Knob, ...] = (
     Knob("LLM_DECODE_OVERLAP", "int", "0", "serving/config.py",
          "1 = overlapped decode loop (round 7 speculative next-step "
          "dispatch); single-chip, non-speculative runners only."),
+    Knob("LLM_STEP_TRACE", "int", "0", "serving/config.py",
+         "Step-clock telemetry plane (runtime/telemetry.py): 1 records "
+         "per-dispatch step records + per-request phase timelines "
+         "(feeds llm_ttft/itl/step_duration/slo_attainment and GET "
+         "/debug/timeline); >= 2 also sets the ring capacity; 0 keeps "
+         "the hot loop recorder-free."),
+    Knob("LLM_SLO_TTFT_MS", "float", "0", "serving/config.py",
+         "Default TTFT SLO class (ms) for llm_slo_attainment; 0 = no "
+         "SLO; per-request slo_ttft_ms body field overrides; needs "
+         "LLM_STEP_TRACE."),
+    Knob("LLM_SLO_ITL_MS", "float", "0", "serving/config.py",
+         "Default mean-ITL SLO class (ms) for llm_slo_attainment; 0 = "
+         "no SLO; per-request slo_itl_ms body field overrides; needs "
+         "LLM_STEP_TRACE."),
     Knob("LLM_PREFIX_CACHING", "bool", "0", "serving/config.py",
          "Content-addressed reuse of full prompt blocks."),
     Knob("LLM_HOST_CACHE_GB", "float", "0", "serving/config.py",

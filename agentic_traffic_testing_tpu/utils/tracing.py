@@ -76,14 +76,19 @@ def inject_context(headers: Dict[str, str]) -> Dict[str, str]:
 
 def span_metadata(span: Any) -> Dict[str, Any]:
     """Span ids/attributes as JSON-safe dict for response `meta.otel`
-    (reference: llm/serve_llm.py:690-712, agents/common/tracing.py)."""
+    (reference: llm/serve_llm.py:690-712, agents/common/tracing.py).
+
+    A noop span (no SDK) returns `{}` cleanly: `get_span_context()` is
+    None there by contract — the blanket except below guards only
+    genuinely malformed third-party spans, not the expected no-SDK path."""
     meta: Dict[str, Any] = {}
     try:
         ctx = span.get_span_context()
-        meta["trace_id"] = f"{int(ctx.trace_id):032x}"
-        meta["span_id"] = f"{int(ctx.span_id):016x}"
-        meta["trace_flags"] = int(getattr(ctx, "trace_flags", 0))
-        meta["is_remote"] = bool(getattr(ctx, "is_remote", False))
+        if ctx is not None:
+            meta["trace_id"] = f"{int(ctx.trace_id):032x}"
+            meta["span_id"] = f"{int(ctx.span_id):016x}"
+            meta["trace_flags"] = int(getattr(ctx, "trace_flags", 0))
+            meta["is_remote"] = bool(getattr(ctx, "is_remote", False))
     except Exception:
         pass
     attrs: Dict[str, Any] = {}
@@ -107,9 +112,11 @@ class _NoopSpan:
         pass
 
     def get_span_context(self):
-        raise RuntimeError("noop")
+        # None, not a raise: span_metadata() on a noop span must return
+        # {} cleanly rather than ride the blanket malformed-span except.
+        return None
 
-    def end(self):
+    def end(self, *a, **k):
         pass
 
 
@@ -119,3 +126,52 @@ class _NoopTracer:
 
     def start_span(self, *a, **k):
         return _NoopSpan()
+
+
+# -- step-clock phase spans (runtime/telemetry.py timelines) ----------------
+
+#: timeline event names -> emitted child-span names; the queue/prefill/
+#: decode boundary derivation matches StepClock._request_slices so
+#: Jaeger and Perfetto show the same phases.
+_PHASE_SPAN_NAMES = ("llm.queue", "llm.prefill", "llm.decode")
+
+
+def emit_phase_spans(tracer: Any, events, epoch_ns: int) -> None:
+    """Replay a request's recorder timeline as retroactive child spans of
+    the CURRENT span: queue (arrival -> admitted), prefill (admitted ->
+    first token), decode (first token -> retired), plus one llm.restore
+    span per host-tier restore. `events` is the RequestTimeline.events
+    list; `epoch_ns` maps its monotonic stamps to wall-clock ns. Safe on
+    the noop tracer (every call degrades to no-ops)."""
+    def ns(mono_t: float) -> int:
+        return int(epoch_ns + mono_t * 1e9)
+
+    by_name: Dict[str, float] = {}
+    restores = []
+    for name, t, value in events:
+        if name not in by_name:
+            by_name[name] = t
+        if name == "restore":
+            restores.append((t, value))
+    queued = by_name.get("queued")
+    admitted = by_name.get("admitted")
+    first = by_name.get("first_token")
+    retired = by_name.get("retired")
+    bounds = [(queued, admitted or first or retired),
+              (admitted, first or retired),
+              (first, retired)]
+    for span_name, (t0, t1) in zip(_PHASE_SPAN_NAMES, bounds):
+        if t0 is None or t1 is None or t1 < t0:
+            continue
+        try:
+            span = tracer.start_span(span_name, start_time=ns(t0))
+            span.end(end_time=ns(t1))
+        except Exception:  # pragma: no cover - exporter quirks must not 500
+            pass
+    for t, nbytes in restores:
+        try:
+            span = tracer.start_span("llm.restore", start_time=ns(t))
+            span.set_attribute("llm.restore_bytes", int(nbytes))
+            span.end(end_time=ns(t))
+        except Exception:  # pragma: no cover
+            pass

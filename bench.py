@@ -424,6 +424,11 @@ def main() -> None:
             # prompt length would silently fall back to solo prefills.
             prefill_batch_max_len=max(
                 128, 1 << (fanout_prompt - 1).bit_length()),
+            # Step-clock recorder on (round 8): the TTFT probes below read
+            # the recorder's samples instead of re-deriving
+            # first_token_time - arrival_time by hand — same stamps, one
+            # source of truth (runtime/telemetry.py).
+            step_trace=1,
             # No quantization field: the shared runner already carries the
             # (possibly quantized) params; cfg.quantization only matters
             # when the engine builds params itself.
@@ -433,7 +438,11 @@ def main() -> None:
         print(f"bench: fan-out engine dropped ({e!r})", file=sys.stderr)
 
     def run_fanout() -> float:
-        """p50 enqueue->first-token wait across `fanout` concurrent arrivals."""
+        """p50 enqueue->first-token wait across `fanout` concurrent
+        arrivals, read from the step-clock recorder's TTFT samples — the
+        exact arrival/first-token stamps the old ad-hoc per-request
+        subtraction used, now sourced from the one instrument."""
+        fan_engine.telemetry.drain_ttft_samples()  # discard prior probes
         reqs = []
         for _ in range(fanout):
             ids = rng.integers(10, vocab - 10, fanout_prompt).tolist()
@@ -442,8 +451,7 @@ def main() -> None:
                                     ignore_eos=True)))
         while fan_engine.has_work() and not all(r.is_finished() for r in reqs):
             fan_engine.step()
-        waits = [r.first_token_time - r.arrival_time for r in reqs
-                 if r.first_token_time is not None]
+        waits = fan_engine.telemetry.drain_ttft_samples()
         return statistics.median(waits)
 
     prefill_len = prefill_probe_len
@@ -1026,7 +1034,14 @@ def main() -> None:
         device_s = (time.monotonic() - t0) / depth
 
         # Engine-loop wall per dispatch: a full wave, timed from the first
-        # scheduled decode so prefill stays out of the denominator.
+        # scheduled decode so prefill stays out of the denominator. The
+        # dispatch count and host-issue times come from the step-clock
+        # recorder's per-dispatch records (round 8, runtime/telemetry.py)
+        # instead of re-deriving them from scheduler counters — one
+        # record per _do_decode_dispatch matches one num_scheduled_decodes
+        # increment on both the planned and extend_decode paths.
+        rec = (target.telemetry if target.telemetry is not None
+               else target.enable_step_trace())
         reqs = [target.add_request(
             rng.integers(10, vocab - 10, prompt_len).tolist(),
             SamplingParams(temperature=0.0, max_tokens=decode_tokens,
@@ -1035,12 +1050,15 @@ def main() -> None:
         while (target.scheduler.num_scheduled_decodes == d0
                and target.has_work()):
             target.step()
-        d1 = target.scheduler.num_scheduled_decodes
+        rec.drain_step_samples()  # pre-wave records (incl. the boundary dispatch)
         t0 = time.monotonic()
         while target.has_work() and not all(r.is_finished() for r in reqs):
             target.step()
         wall = time.monotonic() - t0
-        n = max(1, target.scheduler.num_scheduled_decodes - d1)
+        decode_kinds = ("decode", "overlapped_decode")
+        issue = sorted(dur for kind, dur in rec.drain_step_samples()
+                       if kind in decode_kinds)
+        n = max(1, len(issue))
         step_wall_s = wall / n
         host_s = max(0.0, step_wall_s - device_s)
         return {
@@ -1051,6 +1069,11 @@ def main() -> None:
             f"{prefix}decode_host_frac": round(
                 host_s / max(step_wall_s, 1e-9), 3),
             f"{prefix}decode_device_toks_s": round(bs * k / device_s, 1),
+            # Direct per-dispatch host issue time (recorder p50): the
+            # schedule+upload+enqueue term alone, without the readback
+            # bookkeeping the subtraction above folds in.
+            f"{prefix}decode_dispatch_issue_p50_s": round(
+                issue[len(issue) // 2], 6) if issue else 0.0,
         }
 
     def overlap_ab(bs: int) -> dict:

@@ -1,0 +1,126 @@
+#!/usr/bin/env python3
+"""Dump a step-clock Chrome trace (runtime/telemetry.py) to JSON.
+
+Two modes:
+
+  * in-process (default): build a tiny engine with the step-trace plane
+    on, run a small mixed workload (batched prefill + decode + one abort
+    so the timeline shows real churn), and write the merged
+    `{"traceEvents": [...]}` document — the zero-setup way to see what
+    the recorder captures. Load the file at ui.perfetto.dev or
+    chrome://tracing: one track is the engine step clock (dispatch/drain
+    slices), one track per request shows its queued/prefill/decode spans.
+  * --url http://host:8000 : fetch a LIVE server's `GET /debug/timeline`
+    instead (the server must run with LLM_STEP_TRACE=1).
+
+Usage: python scripts/dev/dump_timeline.py [out.json] [n_requests] [max_tokens]
+Env: TIMELINE_MODEL (default: tiny fp32 on cpu, llama-3.2-1b bf16 on tpu).
+
+Exits non-zero if the dumped document fails the trace-event schema check
+(every event carries ph/pid/tid, every X slice ts+dur) — the same check
+tests/test_scripts.py smokes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def validate_trace(doc: dict) -> None:
+    """Assert the minimal Chrome trace-event schema Perfetto needs."""
+    events = doc.get("traceEvents")
+    assert isinstance(events, list) and events, "empty traceEvents"
+    for e in events:
+        assert e.get("ph") in ("X", "i", "M"), f"bad ph in {e}"
+        assert "pid" in e and "tid" in e, f"missing pid/tid in {e}"
+        if e["ph"] in ("X", "i"):
+            assert isinstance(e.get("ts"), (int, float)), f"missing ts in {e}"
+        if e["ph"] == "X":
+            assert isinstance(e.get("dur"), (int, float)), f"missing dur in {e}"
+    json.dumps(doc)  # must be serializable as-is
+
+
+def fetch_live(url: str) -> dict:
+    import urllib.request
+
+    with urllib.request.urlopen(f"{url.rstrip('/')}/debug/timeline",
+                                timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def run_local(n_requests: int, max_tokens: int) -> dict:
+    import jax
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+    from agentic_traffic_testing_tpu.runtime.telemetry import (
+        chrome_trace_document,
+    )
+
+    platform = jax.devices()[0].platform
+    model = os.environ.get("TIMELINE_MODEL") or (
+        "llama-3.2-1b" if platform == "tpu" else "tiny")
+    dtype = "bfloat16" if platform == "tpu" else "float32"
+    eng = LLMEngine(EngineConfig(
+        model=model, dtype=dtype, max_num_seqs=max(4, n_requests),
+        max_model_len=256, block_size=16, num_blocks=256,
+        step_trace=1))
+    rng = np.random.default_rng(0)
+    vocab = eng.model_cfg.vocab_size
+    reqs = [eng.add_request(
+        rng.integers(10, vocab - 10, 16 + 2 * i).tolist(),
+        SamplingParams(temperature=0.0, max_tokens=max_tokens,
+                       ignore_eos=True))
+        for i in range(n_requests)]
+    # Abort one mid-flight so the dump shows a non-happy-path timeline.
+    aborted = False
+    for _ in range(10_000):
+        eng.step()
+        if not aborted and any(r.output_ids for r in reqs):
+            eng.abort_request(reqs[-1])
+            aborted = True
+        if all(r.is_finished() for r in reqs):
+            break
+        if not eng.has_work():
+            break
+    return chrome_trace_document([eng.telemetry])
+
+
+def main(argv=None) -> dict:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    url = None
+    if "--url" in argv:
+        i = argv.index("--url")
+        url = argv[i + 1]
+        del argv[i:i + 2]
+    out_path = argv[0] if len(argv) > 0 else "/tmp/step_clock_timeline.json"
+    n_requests = int(argv[1]) if len(argv) > 1 else 3
+    max_tokens = int(argv[2]) if len(argv) > 2 else 8
+    doc = fetch_live(url) if url else run_local(n_requests, max_tokens)
+    validate_trace(doc)
+    with open(out_path, "w") as f:
+        json.dump(doc, f)
+    n_req_tracks = sum(1 for e in doc["traceEvents"]
+                       if e.get("ph") == "M"
+                       and e.get("name") == "thread_name"
+                       and str(e.get("args", {}).get("name", "")).startswith("req "))
+    print(json.dumps({
+        "out": out_path,
+        "events": len(doc["traceEvents"]),
+        "request_tracks": n_req_tracks,
+        "pids": sorted({e["pid"] for e in doc["traceEvents"]}),
+    }))
+    return doc
+
+
+if __name__ == "__main__":
+    main()

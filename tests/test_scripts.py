@@ -306,6 +306,31 @@ def test_decode_overlap_ab_smoke(monkeypatch):
         assert r["decode_toks_s"] > 0
 
 
+# ------------------------------------------------ step-clock timeline dump
+
+
+def test_dump_timeline_smoke(tmp_path, monkeypatch):
+    """scripts/dev/dump_timeline.py end-to-end on the tiny model: a small
+    traced CPU generate (with one mid-flight abort) dumped as Chrome
+    trace-event JSON — the file parses, every event passes the
+    ph/ts/pid/tid schema check, and a track exists per request
+    (in-process for the warm jax/conftest CPU config, like the *_ab
+    smokes)."""
+    monkeypatch.setenv("TIMELINE_MODEL", "tiny")
+    dump = load_script("scripts/dev/dump_timeline.py", "dump_timeline")
+    out = str(tmp_path / "timeline.json")
+    doc = dump.main([out, "3", "6"])
+    on_disk = json.load(open(out))
+    assert on_disk["traceEvents"]
+    dump.validate_trace(on_disk)  # the same check the script exits on
+    events = doc["traceEvents"]
+    names = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert sum(1 for n in names if n.startswith("req ")) == 3
+    kinds = {e["name"] for e in events if e["ph"] == "X" and e["tid"] == 0}
+    assert {"prefill", "decode", "drain"} <= kinds
+
+
 # ------------------------------------------------- metric-docs parity
 
 

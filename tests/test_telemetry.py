@@ -1,0 +1,408 @@
+"""Step-clock telemetry plane (runtime/telemetry.py, round 8).
+
+Pins the plane's two contracts: OFF means absent (no recorder object, no
+per-step allocations, token streams byte-identical to the untraced
+engine) and ON means faithful (per-request phase ordering under churn,
+bounded rings, Perfetto-loadable Chrome trace schema, TTFT == the
+request's own queue_wait stamps, histogram + SLO emission through
+serving/metrics.py, replica-pool aggregation).
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentic_traffic_testing_tpu.models.config import PRESETS
+from agentic_traffic_testing_tpu.models.llama import init_params
+from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+from agentic_traffic_testing_tpu.runtime import telemetry
+from agentic_traffic_testing_tpu.runtime.telemetry import (
+    REQ_ADMITTED,
+    REQ_FIRST_TOKEN,
+    REQ_QUEUED,
+    REQ_RETIRED,
+    REQ_TOKENS,
+    STEP_PHASES,
+    StepClock,
+    chrome_trace_document,
+)
+
+CFG = PRESETS["tiny"]
+
+
+@pytest.fixture(scope="module")
+def runner():
+    # ONE runner for the whole module (the decode_overlap suite's trick):
+    # every engine below shares its compiled programs, keeping this file
+    # inside the default tier's budget.
+    params = init_params(CFG, jax.random.key(0), dtype=jnp.float32)
+    return ModelRunner(CFG, params, decode_steps=1)
+
+
+def make_engine(runner, **kw):
+    kw.setdefault("model", "tiny")
+    kw.setdefault("dtype", "float32")
+    kw.setdefault("max_model_len", 128)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("num_blocks", 64)
+    kw.setdefault("max_num_seqs", 4)
+    return LLMEngine(EngineConfig(**kw), model_cfg=CFG, runner=runner)
+
+
+def greedy(max_tokens=8, **kw):
+    return SamplingParams(max_tokens=max_tokens, temperature=0.0, **kw)
+
+
+def drive(engine, reqs):
+    for _ in range(10_000):
+        engine.step()
+        if all(r.is_finished() for r in reqs):
+            return
+        if not engine.has_work():
+            break
+    assert all(r.is_finished() for r in reqs), [r.state for r in reqs]
+
+
+def prompts(n=3):
+    rng = np.random.default_rng(3)
+    return [rng.integers(0, CFG.vocab_size, ln).tolist()
+            for ln in (12, 20, 9, 15, 7)[:n]]
+
+
+# ------------------------------------------------------- recorder unit level
+
+
+def test_ring_buffer_bound_enforced():
+    rec = StepClock(capacity=8)
+    for i in range(100):
+        rec.record_dispatch("decode", i * 1.0, i * 1.0 + 0.001, 2, 2)
+    assert len(rec.steps) == 8
+    # Oldest evicted: the surviving seqs are the last 8.
+    assert [r.seq for r in rec.steps] == list(range(93, 101))
+    assert rec.num_dispatches == 100  # cumulative counter survives eviction
+
+    # Live-timeline budget is decoupled from the step ring: a small ring
+    # (dispatch history) must NOT evict still-running requests' timelines.
+    for i in range(3 * 8):
+        rec.request_queued(f"r{i}", float(i))
+    assert len(rec._live) == 3 * 8
+    # ...but the live map is still hard-bounded against a caller that
+    # never retires: past live_capacity the oldest evict unfinished.
+    assert rec.live_capacity == 4096
+    for i in range(3 * 8, rec.live_capacity + 10):
+        rec.request_queued(f"r{i}", float(i))
+    assert len(rec._live) == rec.live_capacity
+
+    # Sample queues are bounded too.
+    small = StepClock(capacity=4, sample_capacity=16)
+    for i in range(100):
+        small.step_samples.append(("decode", 0.001))
+    assert len(small.drain_step_samples()) == 16
+
+
+def test_small_ring_keeps_ttft_of_concurrent_requests():
+    # Regression: live timelines used to share the STEP-ring capacity, so
+    # LLM_STEP_TRACE=<small ring> under concurrency silently dropped
+    # still-running requests' TTFT/SLO samples.
+    rec = StepClock(capacity=2, slo_ttft_ms=1000.0)
+    for i in range(200):
+        rec.request_queued(f"r{i}", 0.0)
+    rec.request_tokens("r0", 0.5, 1)
+    rec.request_retired("r0", 0.6, "stop")
+    assert rec.drain_ttft_samples() == [0.5]
+    assert rec.drain_slo_events() == [("ttft", True)]
+
+
+def test_concurrent_reader_never_raises():
+    # Regression: the HTTP thread iterates the retired ring / live map /
+    # step ring (timeline_for, timelines, chrome_trace) while the engine
+    # thread mutates them; unsynchronized iteration raised RuntimeError
+    # ("deque mutated during iteration") and 500'd successful requests.
+    rec = StepClock(capacity=64)
+    stop = threading.Event()
+
+    def writer():
+        i = 0
+        while not stop.is_set():
+            rid = f"r{i}"
+            rec.request_queued(rid, float(i))
+            rec.request_event(rid, REQ_ADMITTED, i + 0.1)
+            rec.request_tokens(rid, i + 0.2, 2)
+            rec.record_dispatch("decode", float(i), i + 0.01, 1, 1)
+            rec.request_retired(rid, i + 0.3, "stop")
+            i += 1
+
+    t = threading.Thread(target=writer, daemon=True)
+    t.start()
+    deadline = time.monotonic() + 0.5
+    try:
+        while time.monotonic() < deadline:
+            rec.timeline_for("r1")  # walks the retired ring
+            rec.timelines()
+            rec.chrome_trace()
+            rec.drain_ttft_samples()
+    finally:
+        stop.set()
+        t.join(timeout=2.0)
+
+
+def test_capacity_validation():
+    with pytest.raises(ValueError):
+        StepClock(capacity=1)
+    with pytest.raises(ValueError):
+        EngineConfig(step_trace=-1)
+    with pytest.raises(ValueError):
+        EngineConfig(slo_ttft_ms=-1.0)
+
+
+# ------------------------------------------------------------- off-path pin
+
+
+def test_off_by_default_no_recorder_no_allocations(runner, monkeypatch):
+    """LLM_STEP_TRACE=0 (the default) must leave the engine without any
+    recorder and make ZERO telemetry allocations per step: constructing
+    ANY telemetry object is made to explode, then a full generate runs."""
+    eng = make_engine(runner)
+    assert eng.telemetry is None
+    assert eng.scheduler.on_admit is None
+
+    def boom(*a, **k):
+        raise AssertionError("telemetry allocated with step_trace=0")
+
+    monkeypatch.setattr(telemetry.StepRecord, "__init__", boom)
+    monkeypatch.setattr(telemetry.RequestTimeline, "__init__", boom)
+    monkeypatch.setattr(telemetry.StepClock, "__init__", boom)
+    req = eng.generate(prompts(1)[0], greedy(6))
+    assert len(req.generated_ids) == 6
+
+
+def test_traced_tokens_identical_to_untraced(runner):
+    ps = prompts(3)
+    base = make_engine(runner)
+    want = [base.generate(p, greedy(8)).generated_ids for p in ps]
+
+    eng = make_engine(runner, step_trace=1)
+    reqs = [eng.add_request(p, greedy(8)) for p in ps]
+    drive(eng, reqs)
+    assert [r.generated_ids for r in reqs] == want
+    assert eng.telemetry.num_dispatches > 0
+    assert eng.telemetry.num_requests_retired == 3
+
+
+# ------------------------------------------------- request phase ordering
+
+
+def _phase_names(tl):
+    return [name for name, _, _ in tl.events]
+
+
+def _assert_ordered(tl, finished=True):
+    names = _phase_names(tl)
+    assert names[0] == REQ_QUEUED
+    ts = [t for _, t, _ in tl.events]
+    assert ts == sorted(ts), f"non-monotonic timeline: {tl.events}"
+    if finished:
+        assert names[-1] == REQ_RETIRED
+        assert names.index(REQ_ADMITTED) < names.index(REQ_FIRST_TOKEN)
+        assert names.index(REQ_FIRST_TOKEN) < names.index(REQ_RETIRED)
+        assert names.count(REQ_ADMITTED) >= 1
+
+
+def test_phase_ordering_eos_mid_batch(runner):
+    """EOS mid-batch: every retired timeline stays queued -> admitted ->
+    first_token -> tokens* -> retired even when lanes stop at different
+    dispatches and the batch re-plans around them."""
+    base = make_engine(runner)
+    probe = base.generate(prompts(1)[0], greedy(10))
+    stop_tok = probe.generated_ids[2]
+    eng = make_engine(runner, step_trace=1)
+    reqs = [eng.add_request(p, greedy(10, stop_token_ids=(stop_tok,)))
+            for p in prompts(3)]
+    drive(eng, reqs)
+    rec = eng.telemetry
+    for r in reqs:
+        tl = rec.timeline_for(r.request_id)
+        assert tl is not None
+        _assert_ordered(tl)
+        # Engine stamps and recorder stamps are the SAME monotonic reads.
+        assert tl.ttft_s == pytest.approx(r.queue_wait_s, abs=1e-9)
+        assert tl.finish_reason in ("stop", "length")
+
+
+def test_phase_ordering_admission_mid_decode(runner):
+    """2 seats, 3 requests: the third admits mid-wave; its queued span
+    must cover the wait and its ordering stay canonical."""
+    eng = make_engine(runner, step_trace=1, max_num_seqs=2)
+    reqs = [eng.add_request(p, greedy(10)) for p in prompts(2)]
+    for _ in range(5):
+        eng.step()
+    late = eng.add_request(prompts(3)[2], greedy(4))
+    drive(eng, reqs + [late])
+    rec = eng.telemetry
+    for r in reqs + [late]:
+        _assert_ordered(rec.timeline_for(r.request_id))
+    tl = rec.timeline_for(late.request_id)
+    names = _phase_names(tl)
+    assert names.index(REQ_ADMITTED) >= 1
+
+
+def test_phase_ordering_abort(runner):
+    eng = make_engine(runner, step_trace=1)
+    reqs = [eng.add_request(p, greedy(12)) for p in prompts(3)]
+    for _ in range(5):
+        eng.step()
+    eng.abort_request(reqs[1])
+    drive(eng, [reqs[0], reqs[2]])
+    rec = eng.telemetry
+    tl = rec.timeline_for(reqs[1].request_id)
+    assert tl.finish_reason == "abort"
+    assert _phase_names(tl)[-1] == REQ_RETIRED
+    for r in (reqs[0], reqs[2]):
+        _assert_ordered(rec.timeline_for(r.request_id))
+    # Aborted requests attain no SLO verdict even with classes set.
+    assert all(kind in ("ttft", "itl")
+               for kind, _ in rec.drain_slo_events())
+
+
+# ------------------------------------------------------- chrome trace schema
+
+
+def test_chrome_trace_schema(runner):
+    eng = make_engine(runner, step_trace=1)
+    reqs = [eng.add_request(p, greedy(6)) for p in prompts(2)]
+    drive(eng, reqs)
+    doc = chrome_trace_document([eng.telemetry])
+    json.dumps(doc)  # serializable as-is
+    events = doc["traceEvents"]
+    assert events
+    for e in events:
+        assert e["ph"] in ("X", "i", "M")
+        assert "pid" in e and "tid" in e
+        if e["ph"] in ("X", "i"):
+            assert isinstance(e["ts"], (int, float))
+        if e["ph"] == "X":
+            assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+    # One engine track + one track per request, named.
+    names = {e["args"]["name"] for e in events
+             if e["ph"] == "M" and e["name"] == "thread_name"}
+    assert "engine step clock" in names
+    assert sum(1 for n in names if n.startswith("req ")) == 2
+    # Dispatch slices carry the phase kinds the engine actually ran.
+    kinds = {e["name"] for e in events if e["ph"] == "X" and e["tid"] == 0}
+    assert "prefill" in kinds and "decode" in kinds and "drain" in kinds
+    assert kinds <= set(STEP_PHASES)
+
+
+def test_dispatch_vs_drain_split_recorded(runner):
+    eng = make_engine(runner, step_trace=1)
+    drive(eng, [eng.add_request(prompts(1)[0], greedy(6))])
+    kinds = [s.kind for s in eng.telemetry.steps]
+    assert kinds.count("drain") >= 1
+    assert kinds.count("decode") >= 1
+    for s in eng.telemetry.steps:
+        assert s.dur_s >= 0
+
+
+# ---------------------------------------------- Prometheus family emission
+
+
+def test_histograms_and_slo_emission(runner):
+    from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
+
+    eng = make_engine(runner, step_trace=1, slo_ttft_ms=60_000.0,
+                      slo_itl_ms=1e-4)
+    reqs = [eng.add_request(p, greedy(8)) for p in prompts(2)]
+    # One per-request override: an absurdly lax ITL class -> met.
+    lax = eng.add_request(prompts(3)[2],
+                          greedy(8, slo_itl_ms=1e6))
+    drive(eng, reqs + [lax])
+    m = LLMMetrics("llm")
+    m.observe_step_clock([eng.telemetry])
+    text = m.render().decode()
+    assert "llm_ttft_seconds_count 3.0" in text
+    assert "llm_itl_seconds_count" in text  # 7 tokens/request after first
+    assert 'llm_step_duration_seconds_bucket{le="+Inf",phase="decode"}' in text
+    assert 'llm_slo_attainment_total{slo="ttft",status="met"} 3.0' in text
+    assert 'llm_slo_attainment_total{slo="itl",status="met"} 1.0' in text
+    assert 'llm_slo_attainment_total{slo="itl",status="violated"} 2.0' in text
+    assert "llm_batch_occupancy" in text
+    # Drained: a second scrape adds nothing.
+    m.observe_step_clock([eng.telemetry])
+    assert "llm_ttft_seconds_count 3.0" in m.render().decode()
+
+
+def test_ttft_matches_queue_wait(runner):
+    """Acceptance pin: recorder TTFT == the request's queue_wait_s (the
+    meta.queue_wait_s source) — same stamps, zero drift."""
+    eng = make_engine(runner, step_trace=1)
+    req = eng.generate(prompts(1)[0], greedy(6))
+    tl = eng.telemetry.timeline_for(req.request_id)
+    assert abs(tl.ttft_s - req.queue_wait_s) < 1e-3  # identical stamps
+
+
+# -------------------------------------------------- replica-pool aggregation
+
+
+def test_engine_pool_aggregation(runner):
+    from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
+    from agentic_traffic_testing_tpu.serving.replica_pool import EnginePool
+
+    pool = EnginePool([make_engine(runner, step_trace=1) for _ in range(2)],
+                      policy="round_robin")
+    reqs = [pool.add_request(p, greedy(6)) for p in prompts(4)]
+    for _ in range(10_000):
+        pool.step()
+        if all(r.is_finished() for r in reqs):
+            break
+    assert len(pool.telemetry_recorders) == 2
+    m = LLMMetrics("llm", num_replicas=2)
+    m.observe_step_clock(pool.telemetry_recorders)
+    text = m.render().decode()
+    assert "llm_ttft_seconds_count 4.0" in text  # both replicas drained
+    doc = pool.chrome_trace()
+    pids = {e["pid"] for e in doc["traceEvents"]}
+    assert pids == {0, 1}  # one track set per replica
+
+
+# ----------------------------------------------------- tracing noop (no SDK)
+
+
+def test_noop_span_metadata_clean():
+    """Satellite fix: span_metadata() on a noop span returns {} cleanly —
+    get_span_context is None by contract, not a RuntimeError swallowed by
+    the blanket except."""
+    from agentic_traffic_testing_tpu.utils.tracing import (
+        _NoopSpan,
+        _NoopTracer,
+        span_metadata,
+    )
+
+    span = _NoopSpan()
+    assert span.get_span_context() is None
+    assert span_metadata(span) == {}
+    # end() tolerates the explicit-timestamp kwarg emit_phase_spans uses.
+    span.end(end_time=123)
+    tracer = _NoopTracer()
+    assert span_metadata(tracer.start_span("x", start_time=1)) == {}
+
+
+def test_emit_phase_spans_noop_tracer():
+    """emit_phase_spans degrades to no-ops on the no-SDK path and accepts
+    a churned timeline (missing admitted, restore events)."""
+    from agentic_traffic_testing_tpu.utils.tracing import (
+        _NoopTracer,
+        emit_phase_spans,
+    )
+
+    events = [("queued", 1.0, 0.0), ("first_token", 2.0, 0.0),
+              ("restore", 1.5, 4096.0), ("tokens", 2.5, 3.0),
+              ("retired", 3.0, 0.0)]
+    emit_phase_spans(_NoopTracer(), events, epoch_ns=0)  # must not raise
