@@ -61,6 +61,7 @@ from agentic_traffic_testing_tpu.runtime.scheduler import (
     DecodeBatch,
     HybridBatch,
     PrefillBatch,
+    QueueFullError,
     Scheduler,
     SchedulerConfig,
 )
@@ -179,6 +180,26 @@ class EngineConfig:
     # when step_trace is on (the recorder is the measurement plane).
     slo_ttft_ms: float = 0.0
     slo_itl_ms: float = 0.0
+    # Bounded wait queue (round 9 — the robustness plane's overload
+    # policy): add_request raises scheduler.QueueFullError once this many
+    # requests are already waiting; the serving layer maps it to 503 +
+    # Retry-After. 0 (default) keeps the queue unbounded.
+    max_queue: int = 0
+    # Default per-request completion deadline in milliseconds, measured
+    # from arrival: the engine's step sweep aborts queued AND running
+    # requests past it (FinishReason.DEADLINE) through the abort path, so
+    # a stalled queue cannot hold client work forever. 0 (default) = no
+    # deadline and no per-step sweep state at all; per-request
+    # sampling.deadline_ms (the HTTP body field) overrides.
+    deadline_ms: float = 0.0
+    # Deterministic fault injection (runtime/faultinject.py): a spec
+    # string ("dispatch_error:p=0.05;restore_error:p=0.1") compiled into
+    # named hooks at the dispatch and restore sites. Empty (default) =
+    # no injector object exists and every hook is one `is not None`
+    # test — the hot path is byte-identical. Seeded by fault_seed (the
+    # replica pool offsets it per replica).
+    fault_spec: str = ""
+    fault_seed: int = 0
     # Content-addressed reuse of full prompt blocks (vLLM automatic-prefix-
     # caching analog); cached requests prefill only their suffix.
     prefix_caching: bool = False
@@ -280,6 +301,20 @@ class EngineConfig:
         if self.host_cache_gb < 0:
             raise ValueError(
                 f"host_cache_gb must be >= 0, got {self.host_cache_gb}")
+        if self.max_queue < 0:
+            raise ValueError(
+                f"max_queue must be >= 0, got {self.max_queue}")
+        if self.deadline_ms < 0:
+            raise ValueError(
+                f"deadline_ms must be >= 0, got {self.deadline_ms}")
+        if self.fault_spec:
+            # Compile-check at config time: a typo'd chaos spec must fail
+            # the build, not silently inject nothing.
+            from agentic_traffic_testing_tpu.runtime.faultinject import (
+                parse_fault_spec,
+            )
+
+            parse_fault_spec(self.fault_spec)
         if self.host_cache_gb and not self.prefix_caching:
             # The host tier is addressed by the prefix cache's chain keys;
             # without the device index there is nothing to spill or match.
@@ -329,6 +364,7 @@ class EngineConfig:
             decode_lookahead=max(4, (self.pipeline_depth + 1) * decode_steps),
             prefill_chunk_tokens=self.prefill_chunk_tokens or None,
             hybrid_token_budget=self.hybrid_token_budget,
+            max_queue=self.max_queue,
             **({"prefill_batch_max_len": self.prefill_batch_max_len}
                if self.prefill_batch_max_len is not None else {}),
         )
@@ -361,6 +397,20 @@ class _Inflight:
         self.requests = requests
         self.counts = counts
         self.predicted = predicted
+
+
+def _plan_requests(plan) -> list[Request]:
+    """Every request a step plan would dispatch (the failure domain of
+    one dispatch exception — see LLMEngine._fail_dispatch)."""
+    if isinstance(plan, PrefillBatch):
+        return list(plan.requests)
+    if isinstance(plan, HybridBatch):
+        return list(plan.decode.requests) + [plan.chunk.request]
+    if isinstance(plan, ChunkPrefill):
+        return [plan.request]
+    if isinstance(plan, DecodeBatch):
+        return list(plan.requests)
+    return []
 
 
 class LLMEngine:
@@ -566,6 +616,25 @@ class LLMEngine:
         self._requests: dict[str, Request] = {}  # live (unreported-finish) requests
         # Cumulative counters for metrics
         self.num_steps = 0
+        # Robustness plane (round 9): per-batch dispatch-failure isolation,
+        # deadline sweep, host-restore fallback, admission shedding.
+        self.num_dispatch_failures = 0   # dispatches that failed their batch
+        self.num_deadline_expired = 0    # requests aborted past deadline
+        self.num_restore_fallbacks = 0   # host restores degraded to recompute
+        self.num_shed = 0                # add_request refusals (bounded queue)
+        # request_ids carrying a deadline: empty (the common case — knob
+        # off, no body overrides) makes the per-step sweep one falsy test.
+        self._deadline_ids: set[str] = set()
+        # Deterministic fault injector (runtime/faultinject.py); None when
+        # LLM_FAULT_SPEC is unset — every hook is one `is not None` test.
+        self._faults = None
+        if cfg.fault_spec:
+            from agentic_traffic_testing_tpu.runtime.faultinject import (
+                FaultInjector,
+            )
+
+            self._faults = FaultInjector.from_spec(cfg.fault_spec,
+                                                   cfg.fault_seed)
         # Speculation acceptance accounting (live request lanes only):
         # emitted/iters = mean tokens per verify step in [1, spec_tokens+1].
         self.spec_iters = 0
@@ -800,7 +869,19 @@ class LLMEngine:
             prompt_ids=list(prompt_ids),
             sampling=sampling or SamplingParams(),
         )
-        self.scheduler.add_request(req)
+        try:
+            self.scheduler.add_request(req)
+        except QueueFullError:
+            self.num_shed += 1
+            raise
+        # Deadline: per-request override, else the engine default (0 = no
+        # deadline — nothing is tracked and the step sweep stays one test).
+        dl_ms = req.sampling.deadline_ms
+        if dl_ms is None and self.cfg.deadline_ms > 0:
+            dl_ms = self.cfg.deadline_ms
+        if dl_ms is not None and dl_ms > 0:
+            req.deadline = req.arrival_time + dl_ms / 1000.0
+            self._deadline_ids.add(req.request_id)
         self._requests[req.request_id] = req
         if self.telemetry is not None:
             self.telemetry.request_queued(req.request_id, req.arrival_time)
@@ -834,6 +915,8 @@ class LLMEngine:
         self.scheduler.abort(req)
         self._requests.pop(req.request_id, None)
         self._new_tokens.pop(req.request_id, None)
+        if self._deadline_ids:
+            self._deadline_ids.discard(req.request_id)
         self._invalidate_decode_state()
         if self.telemetry is not None:
             # Sibling retirements ride _flush_events; the aborted lane
@@ -850,6 +933,8 @@ class LLMEngine:
     def step(self) -> list[StepOutput]:
         """Advance by one device dispatch (or drain); return request events."""
         self.num_steps += 1
+        if self._deadline_ids:
+            self._expire_deadlines()
 
         # Only tear the decode pipeline down for admission when the head of
         # the waiting queue could actually be admitted — an unadmittable
@@ -902,20 +987,91 @@ class LLMEngine:
                 or bool(self.scheduler.failed))
 
     def _plan_and_dispatch(self) -> None:
-        """Plan against *current* (post-drain) state and run the step."""
+        """Plan against *current* (post-drain) state and run the step.
+
+        Dispatch exceptions (injected faults included) fail ONLY the
+        planned batch's requests — a structured error reaches each
+        stream via the normal event flush, the scheduler reconciles
+        through the abort path, and the step loop keeps serving every
+        other request (round 9; the async layer's fail-all remains the
+        escalation for failures outside any batch)."""
         plan = self.scheduler.plan()
         self._fail_unservable()
-        if isinstance(plan, PrefillBatch):
-            self._run_prefill(plan)
-        elif isinstance(plan, HybridBatch):
-            self._run_hybrid(plan)
-        elif isinstance(plan, ChunkPrefill):
-            self._run_chunk(plan)
-        elif isinstance(plan, DecodeBatch):
-            self._setup_decode(plan)
-            self._do_decode_dispatch()
-        else:
+        try:
+            if isinstance(plan, PrefillBatch):
+                self._run_prefill(plan)
+            elif isinstance(plan, HybridBatch):
+                self._run_hybrid(plan)
+            elif isinstance(plan, ChunkPrefill):
+                self._run_chunk(plan)
+            elif isinstance(plan, DecodeBatch):
+                self._setup_decode(plan)
+                self._do_decode_dispatch()
+            else:
+                self._invalidate_decode_state()
+        except Exception as exc:
+            self._fail_dispatch(_plan_requests(plan), exc)
+
+    def _expire_deadlines(self) -> None:
+        """Abort every live request past its deadline (queued or running)
+        through the abort machinery: in-flight tokens drain first (they
+        belong to the client), blocks release, and the stream gets a
+        terminal FinishReason.DEADLINE event via the normal flush."""
+        now = time.monotonic()
+        expired = []
+        for rid in self._deadline_ids:
+            req = self._requests.get(rid)
+            if (req is not None and not req.is_finished()
+                    and req.deadline is not None and now >= req.deadline):
+                expired.append(req)
+        if not expired:
+            return
+        self._drain_all()
+        now = time.monotonic()
+        teardown = False
+        for req in expired:
+            if req.is_finished():
+                continue  # the drain delivered its final token in time
+            teardown = teardown or req in self._decode_requests
+            self.scheduler.abort(req)
+            req.state = RequestState.ABORTED
+            req.finish_reason = FinishReason.DEADLINE
+            req.finish_time = now
+            req.error = (f"deadline exceeded after "
+                         f"{(now - req.arrival_time) * 1000:.0f} ms")
+            self.num_deadline_expired += 1
+            # An empty increment keys the terminal event for the stream.
+            self._new_tokens.setdefault(req.request_id, [])
+        if teardown:
             self._invalidate_decode_state()
+
+    def _fail_dispatch(self, reqs: list[Request], exc: Exception) -> None:
+        """Fail exactly one batch: the requests whose dispatch raised.
+
+        In-flight entries predate the failure and carry valid tokens, so
+        they drain first; each still-live member then aborts through the
+        scheduler (blocks released, queues consistent) and reports a
+        structured error event. Waiting requests and other waves are
+        untouched — the next step re-plans from clean state. Injected
+        faults (runtime/faultinject.py) raise BEFORE the runner call, so
+        this path never sees half-donated buffers; real mid-execution
+        failures recover best-effort and escalate to the async layer's
+        fail-all if the drain itself is poisoned."""
+        self.num_dispatch_failures += 1
+        log.warning("dispatch failed; failing %d request(s): %s",
+                    len(reqs), exc)
+        self._drain_all()
+        now = time.monotonic()
+        for r in reqs:
+            if r.is_finished():
+                continue  # the drain finished it normally first
+            self.scheduler.abort(r)
+            r.state = RequestState.ABORTED
+            r.finish_reason = FinishReason.ERROR
+            r.finish_time = now
+            r.error = f"dispatch failed: {exc}"
+            self._new_tokens.setdefault(r.request_id, [])
+        self._invalidate_decode_state()
 
     def _fail_unservable(self) -> None:
         for req in self.scheduler.failed:
@@ -980,6 +1136,8 @@ class LLMEngine:
 
     # statics: hot-region(prefill-dispatch)
     def _run_prefill(self, plan: PrefillBatch) -> None:
+        if self._faults is not None:  # before any donation/state mutation
+            self._faults.maybe_raise("dispatch_error")
         split = self._pipeline_split(plan.padded_len)
         if split is not None:
             self._run_prefill_pipelined(plan, split)
@@ -1153,29 +1311,52 @@ class LLMEngine:
         for key, tokens, _, _ in pending:
             self._host_store.put(key, tokens, next(fetched), next(fetched))
 
-    def _apply_pending_restore(self, r: Request) -> None:
+    def _apply_pending_restore(self, r: Request) -> bool:
         """Write a request's host-tier restore plan into its freshly
         allocated device blocks, then index them for sharing. Runs right
         before the request's first suffix chunk dispatches, so every
         subsequent reader (the chunk's prior-page gather included) orders
-        after the writes."""
+        after the writes.
+
+        Returns False when the restore failed (corrupt pages, injected
+        restore_error) and the request was degraded to the recompute
+        path (_restore_fallback) — the caller must skip its dispatch
+        this step; the request is already back at the head of the queue."""
         restores = r.pending_restore
         if not restores:
-            return
+            return True
         r.pending_restore = None
-        blks = jnp.asarray([rb.block for rb in restores], jnp.int32)
-        # .at[].set on TPU lowers as copy-pool-then-update (~2 ms/GB, the
-        # reason per-step KV writes are DUS chains — kv_cache.py). Here it
-        # runs ONCE per admission against a >= 100 ms prefill recompute, and
-        # a donated/jitted DUS chain would compile per restore length — the
-        # scatter is the right trade at this call rate.
-        # [N, L, KH, bs, hd] -> pool axes [L, KH, N, bs, hd]
-        k_new = np.stack([rb.k for rb in restores]).transpose(1, 2, 0, 3, 4)
-        v_new = np.stack([rb.v for rb in restores]).transpose(1, 2, 0, 3, 4)
-        self.cache = self.cache._replace(
-            k=self.cache.k.at[:, :, blks].set(k_new),
-            v=self.cache.v.at[:, :, blks].set(v_new),
-        )
+        try:
+            if self._faults is not None:
+                self._faults.maybe_raise("restore_error")
+            # Validate against the live pool's page geometry BEFORE any
+            # write: a corrupt host block must degrade to recompute, not
+            # scatter garbage-shaped pages (or raise) mid-step.
+            shape = self.cache.k.shape[:2] + self.cache.k.shape[3:]
+            for rb in restores:
+                if (rb.k.shape != shape or rb.v.shape != shape
+                        or rb.k.dtype != self.cache.k.dtype
+                        or rb.v.dtype != self.cache.v.dtype):
+                    raise ValueError(
+                        f"host block {rb.key} pages {rb.k.shape}/"
+                        f"{rb.k.dtype} do not match the pool page "
+                        f"{shape}/{self.cache.k.dtype}")
+            blks = jnp.asarray([rb.block for rb in restores], jnp.int32)
+            # .at[].set on TPU lowers as copy-pool-then-update (~2 ms/GB,
+            # the reason per-step KV writes are DUS chains — kv_cache.py).
+            # Here it runs ONCE per admission against a >= 100 ms prefill
+            # recompute, and a donated/jitted DUS chain would compile per
+            # restore length — the scatter is the right trade at this call
+            # rate. [N, L, KH, bs, hd] -> pool axes [L, KH, N, bs, hd]
+            k_new = np.stack([rb.k for rb in restores]).transpose(1, 2, 0, 3, 4)
+            v_new = np.stack([rb.v for rb in restores]).transpose(1, 2, 0, 3, 4)
+            self.cache = self.cache._replace(
+                k=self.cache.k.at[:, :, blks].set(k_new),
+                v=self.cache.v.at[:, :, blks].set(v_new),
+            )
+        except Exception as exc:
+            self._restore_fallback(r, restores, exc)
+            return False
         self.allocator.register_restored(restores)
         nbytes = sum(int(rb.k.nbytes) + int(rb.v.nbytes) for rb in restores)
         self.host_restore_bytes += nbytes
@@ -1185,12 +1366,43 @@ class LLMEngine:
                                           len(restores))
             self.telemetry.request_event(r.request_id, REQ_RESTORE, now,
                                          nbytes)
+        return True
+
+    def _restore_fallback(self, r: Request, restores: list,
+                          exc: Exception) -> None:
+        """Degrade a failed host-tier restore to the recompute path.
+
+        The offending store entries are invalidated (re-admission must
+        not re-match them) and the WHOLE admission is torn down and
+        re-queued at the head rather than patched in place: blocks after
+        the failed restore can be device-shared, and recomputing into
+        them would rewrite shared KV under live sharers. Re-admission
+        recomputes exactly what the tier can no longer supply — the
+        preempt-and-recompute fallback PagedAttention treats as the
+        universal correctness escape (PAPERS.md)."""
+        self.num_restore_fallbacks += 1
+        log.warning("host-tier restore failed for %s; degrading to "
+                    "recompute: %s", r.request_id, exc)
+        if self._host_store is not None:
+            for rb in restores:
+                self._host_store.invalidate(rb.key)
+        self.scheduler.abort(r)  # releases blocks, removes from running
+        r.state = RequestState.WAITING
+        r.num_computed_tokens = 0
+        self.scheduler.waiting.appendleft(r)
 
     # statics: hot-region(chunk-dispatch)
     def _run_chunk(self, plan: ChunkPrefill) -> None:
         """One chunk of a chunked prefill (single long prompt, solo)."""
         r = plan.request
-        self._apply_pending_restore(r)
+        if not self._apply_pending_restore(r):
+            # Restore degraded to recompute: the request went back to the
+            # head of the queue; this step idles and the next plan()
+            # re-admits it against whatever the host tier still holds.
+            self._invalidate_decode_state()
+            return
+        if self._faults is not None:
+            self._faults.maybe_raise("dispatch_error")
         c = plan.padded_len
         tokens = np.zeros((1, c), np.int32)
         chunk = r.prompt_ids[plan.chunk_start : plan.chunk_start + plan.chunk_len]
@@ -1252,7 +1464,13 @@ class LLMEngine:
         reqs = dec.requests
         b = dec.padded_batch
         r = ck.request
-        self._apply_pending_restore(r)
+        if not self._apply_pending_restore(r):
+            # Restore fallback re-queued the chunk request; the decode
+            # lanes lose one idle step and re-plan next step.
+            self._invalidate_decode_state()
+            return
+        if self._faults is not None:
+            self._faults.maybe_raise("dispatch_error")
         c = ck.padded_len
         tokens = np.zeros((b,), np.int32)
         positions = np.zeros((b,), np.int32)
@@ -1490,8 +1708,12 @@ class LLMEngine:
             # the next step re-plans the corrected batch — token streams
             # stay identical to the serial loop.
             if self.scheduler.extend_decode(self._decode_requests):
-                self._refresh_decode_tables_incremental()
-                self._do_decode_dispatch(predicted=True)
+                batch = self._decode_requests
+                try:
+                    self._refresh_decode_tables_incremental()
+                    self._do_decode_dispatch(predicted=True)
+                except Exception as exc:
+                    self._fail_dispatch(list(batch), exc)
                 return
             # KV pool exhausted mid-wave: fall through to the full plan,
             # which re-grows survivors and preempts exactly as the serial
@@ -1499,14 +1721,17 @@ class LLMEngine:
         # KV headroom for this step (may preempt; then state must be rebuilt).
         plan = self.scheduler.plan()
         if isinstance(plan, DecodeBatch) and plan.requests == self._decode_requests:
-            self._refresh_decode_tables()
-            # Same composition confirmed by a full plan: re-arm the
-            # overlap hint (an unadmittable arrival bumps the epoch
-            # without changing the decode batch — without this re-snapshot
-            # one such arrival would force the slow path for the rest of
-            # the wave).
-            self._decode_epoch = self.scheduler.composition_epoch
-            self._do_decode_dispatch()
+            try:
+                self._refresh_decode_tables()
+                # Same composition confirmed by a full plan: re-arm the
+                # overlap hint (an unadmittable arrival bumps the epoch
+                # without changing the decode batch — without this
+                # re-snapshot one such arrival would force the slow path
+                # for the rest of the wave).
+                self._decode_epoch = self.scheduler.composition_epoch
+                self._do_decode_dispatch()
+            except Exception as exc:
+                self._fail_dispatch(list(plan.requests), exc)
             return
         # Composition changed (preemption / drain-out): sync fully first.
         self._drain_all()
@@ -1514,7 +1739,10 @@ class LLMEngine:
             # Not stale: plan() just admitted these requests and they hold
             # their blocks regardless of what harvesting finished.
             self._fail_unservable()
-            self._run_prefill(plan)
+            try:
+                self._run_prefill(plan)
+            except Exception as exc:
+                self._fail_dispatch(list(plan.requests), exc)
             return
         # A decode plan IS stale after draining — harvest may have finished
         # members and released their blocks — so re-plan from current state.
@@ -1522,6 +1750,8 @@ class LLMEngine:
 
     # statics: hot-region(decode-loop)
     def _do_decode_dispatch(self, predicted: bool = False) -> None:
+        if self._faults is not None:  # before the donated-state call below
+            self._faults.maybe_raise("dispatch_error")
         # Under decode_overlap every decode dispatch runs the donated-state
         # jit (spec is refused at build), so ONE program serves both the
         # armed first dispatch and the fast-path ones — no duplicate
@@ -1749,6 +1979,8 @@ class LLMEngine:
             events.append(StepOutput(request=req, new_token_ids=toks,
                                      finished=req.is_finished()))
             if req.is_finished():
+                if self._deadline_ids:
+                    self._deadline_ids.discard(rid)
                 if rec is not None:
                     # Retired HERE (not in _finish) so the burst that
                     # carried the final token is already on the timeline

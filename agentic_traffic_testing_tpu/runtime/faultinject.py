@@ -1,0 +1,151 @@
+"""Deterministic, knob-driven fault injection for the serving plane.
+
+Every robustness behavior the round-9 fault-tolerant serving plane adds —
+per-batch dispatch failure isolation (engine), host-tier restore fallback
+(engine + kv_offload), replica quarantine and retry-once failover
+(replica_pool) — is only trustworthy if it is *testable on CPU in tier-1*
+and soak-testable under load. Real TPUs fail rarely and unreproducibly;
+this module makes failure a first-class, seeded input instead.
+
+`LLM_FAULT_SPEC` compiles a spec string into named fault points consulted
+at the three call sites the robustness plane hardens:
+
+    dispatch_error:p=0.05    engine device-dispatch sites (prefill, chunk,
+                             hybrid, decode) raise InjectedFault with
+                             probability p BEFORE the runner call — i.e.
+                             before any donated buffer is consumed, so the
+                             recovery path under test is the real one
+    restore_error:p=0.1      host-tier restore application fails with
+                             probability p, exercising the recompute
+                             fallback (engine._apply_pending_restore)
+    slow_replica:idx=1,ms=200  replica `idx`'s step loop sleeps `ms` before
+                             every dispatch (replica_pool wiring) — the
+                             stuck/degraded-replica shape health routing
+                             and the watchdog must absorb
+
+Grammar: `point[:k=v[,k=v...]][;point...]` — semicolon-separated points,
+comma-separated key=value params, numbers parsed as float (int when
+integral). Unknown point names or malformed params raise at compile time
+(a typo'd chaos spec silently injecting nothing would "pass" every chaos
+run). `p` defaults to 1.0 when a probabilistic point is named bare.
+
+Determinism: each point draws from its OWN `random.Random(seed ^
+crc(name))` stream, so two runs with the same spec, seed and dispatch
+sequence inject the exact same fault pattern — the chaos suite
+(tests/test_faults.py) pins this, and the identity gate in
+scripts/dev/chaos_ab.py depends on it. Seed comes from `LLM_FAULT_SEED`
+(+ replica index under a pool, so replicas don't fault in lockstep).
+
+Cost when off: the engine/pool hold no injector at all (`_faults is
+None`, the same contract as the step-clock recorder), so the hot path is
+byte-identical with the knob unset.
+"""
+
+from __future__ import annotations
+
+import random
+import zlib
+from typing import Optional
+
+#: the complete set of compile-time-valid fault point names.
+FAULT_POINTS = ("dispatch_error", "restore_error", "slow_replica")
+
+
+class InjectedFault(RuntimeError):
+    """Raised by a firing fault point; carries the point name so handlers
+    and tests can attribute the failure."""
+
+    def __init__(self, point: str) -> None:
+        super().__init__(f"injected fault: {point}")
+        self.point = point
+
+
+def _parse_value(raw: str) -> float:
+    v = float(raw)
+    return int(v) if v.is_integer() else v
+
+
+def parse_fault_spec(spec: str) -> dict[str, dict]:
+    """`"a:p=0.05;b:idx=1,ms=200"` -> `{"a": {"p": 0.05}, "b": {...}}`.
+
+    Raises ValueError on unknown points, malformed params, or
+    out-of-range probabilities — loud at compile, never at fire time.
+    """
+    points: dict[str, dict] = {}
+    for part in filter(None, (s.strip() for s in spec.split(";"))):
+        name, _, rest = part.partition(":")
+        name = name.strip()
+        if name not in FAULT_POINTS:
+            raise ValueError(
+                f"unknown fault point {name!r} in LLM_FAULT_SPEC; "
+                f"supported: {', '.join(FAULT_POINTS)}")
+        params: dict = {}
+        for kv in filter(None, (s.strip() for s in rest.split(","))):
+            key, sep, raw = kv.partition("=")
+            if not sep:
+                raise ValueError(
+                    f"malformed fault param {kv!r} for {name!r} "
+                    f"(expected key=value)")
+            try:
+                params[key.strip()] = _parse_value(raw.strip())
+            except ValueError:
+                raise ValueError(
+                    f"non-numeric fault param {kv!r} for {name!r}") from None
+        if name in ("dispatch_error", "restore_error"):
+            p = params.setdefault("p", 1.0)
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(
+                    f"fault point {name!r} needs 0 <= p <= 1, got {p}")
+        if name == "slow_replica":
+            if "ms" not in params:
+                raise ValueError("slow_replica needs ms=<delay>")
+            params.setdefault("idx", 0)
+        points[name] = params
+    return points
+
+
+class FaultInjector:
+    """Compiled fault points with per-point seeded RNG streams."""
+
+    def __init__(self, points: dict[str, dict], seed: int = 0) -> None:
+        self.points = dict(points)
+        self.seed = int(seed)
+        self._rng = {
+            name: random.Random(self.seed ^ zlib.crc32(name.encode()))
+            for name in self.points
+        }
+        # Fired-count accounting per point: the chaos suite's "every
+        # injected fault is accounted for" gate reads these.
+        self.fired: dict[str, int] = {name: 0 for name in self.points}
+
+    @classmethod
+    def from_spec(cls, spec: Optional[str],
+                  seed: int = 0) -> Optional["FaultInjector"]:
+        """Compile a spec string; None/empty -> None (no injector exists,
+        the zero-cost off state)."""
+        if not spec:
+            return None
+        return cls(parse_fault_spec(spec), seed=seed)
+
+    def fire(self, point: str) -> bool:
+        """Draw the point's RNG; True = inject now. Unconfigured points
+        never fire and never draw (the configured points' streams stay
+        aligned regardless of which sites consult the injector)."""
+        params = self.points.get(point)
+        if params is None:
+            return False
+        if self._rng[point].random() < params.get("p", 1.0):
+            self.fired[point] += 1
+            return True
+        return False
+
+    def maybe_raise(self, point: str) -> None:
+        if self.fire(point):
+            raise InjectedFault(point)
+
+    def delay_s(self, idx: int) -> float:
+        """slow_replica delay for replica `idx` (0.0 for everyone else)."""
+        params = self.points.get("slow_replica")
+        if params is None or int(params.get("idx", 0)) != idx:
+            return 0.0
+        return float(params["ms"]) / 1000.0

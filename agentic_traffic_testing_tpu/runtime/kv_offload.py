@@ -85,9 +85,18 @@ class HostKVStore:
         self._lock = threading.Lock()
         self._entries: OrderedDict[int, HostBlock] = OrderedDict()
         self.used_bytes = 0
+        # Page geometry attested by the first put(): every later block must
+        # match it, and every get() re-checks — a corrupt entry degrades to
+        # a MISS (dropped + counted), it never raises into the admission
+        # path that is probing it (scheduler._acquire_blocks runs inside
+        # plan(); an exception there used to fail the whole step).
+        self._page_shape: Optional[tuple] = None
+        self._page_dtypes: Optional[tuple] = None
         # Cumulative counters (exported as llm_host_cache_* families).
         self.saved_blocks = 0     # successful put()s
         self.evicted_blocks = 0   # LRU evictions (capacity pressure)
+        self.corrupt_dropped = 0  # validation failures degraded to misses
+        self.invalidated_blocks = 0  # explicit drops (restore fallback)
 
     def __len__(self) -> int:
         with self._lock:
@@ -100,21 +109,61 @@ class HostKVStore:
             e = self._entries.get(key)
             return e is not None and e.tokens == tokens
 
+    def _valid(self, e: HostBlock) -> bool:
+        """Restore-side validation: the entry's pages must still match the
+        store's attested geometry. Anything off — wrong shape, dtype, a
+        k/v pair that disagrees — is corruption, not a servable block."""
+        if not (isinstance(e.k, np.ndarray) and isinstance(e.v, np.ndarray)):
+            return False
+        if e.k.shape != e.v.shape or e.k.shape != self._page_shape:
+            return False
+        return (e.k.dtype, e.v.dtype) == self._page_dtypes
+
     def get(self, key: int, tokens: tuple) -> Optional[HostBlock]:
-        """Entry for `key`, or None on miss/collision; refreshes recency."""
+        """Entry for `key`, or None on miss/collision/corruption;
+        refreshes recency. Validation failures DROP the entry and count
+        in `corrupt_dropped` — the caller sees a plain miss and takes the
+        recompute path, never an exception mid-admission."""
         with self._lock:
             e = self._entries.get(key)
             if e is None or e.tokens != tokens:
                 return None
+            if not self._valid(e):
+                del self._entries[key]
+                self.used_bytes -= e.nbytes
+                self.corrupt_dropped += 1
+                return None
             self._entries.move_to_end(key)
             return e
 
+    def invalidate(self, key: int) -> bool:
+        """Drop one entry (the engine's restore-fallback path: a block
+        that failed to apply must not be re-matched on re-admission).
+        Counted separately from corrupt_dropped — a fallback plan can
+        invalidate healthy siblings of the one bad block, and conflating
+        them would make the corruption metric lie. True if it existed."""
+        with self._lock:
+            e = self._entries.pop(key, None)
+            if e is None:
+                return False
+            self.used_bytes -= e.nbytes
+            self.invalidated_blocks += 1
+            return True
+
     def put(self, key: int, tokens: tuple, k: np.ndarray, v: np.ndarray) -> bool:
-        """Insert (or refresh) one block; False if it can never fit."""
+        """Insert (or refresh) one block; False if it can never fit (or
+        fails the geometry attestation a first put established)."""
         nbytes = int(k.nbytes) + int(v.nbytes)
         if nbytes > self.capacity_bytes:
             return False
         with self._lock:
+            if self._page_shape is None:
+                self._page_shape = k.shape
+                self._page_dtypes = (k.dtype, v.dtype)
+            elif (k.shape != self._page_shape or v.shape != k.shape
+                  or (k.dtype, v.dtype) != self._page_dtypes):
+                self.corrupt_dropped += 1
+                return False
             old = self._entries.pop(key, None)
             if old is not None:
                 self.used_bytes -= old.nbytes
@@ -138,6 +187,8 @@ class HostKVStore:
                 "host_cache_entries": len(self._entries),
                 "host_cache_saved_blocks": self.saved_blocks,
                 "host_cache_evicted_blocks": self.evicted_blocks,
+                "host_cache_corrupt_dropped": self.corrupt_dropped,
+                "host_cache_invalidated_blocks": self.invalidated_blocks,
             }
 
 

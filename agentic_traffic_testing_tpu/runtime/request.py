@@ -29,6 +29,13 @@ class SamplingParams:
     # accounting). Never touches sampling math or the device arrays.
     slo_ttft_ms: Optional[float] = None
     slo_itl_ms: Optional[float] = None
+    # Per-request completion deadline in milliseconds (wall clock from
+    # arrival; the robustness plane's abort budget — engine step sweeps
+    # expire queued AND running requests past it through the abort path,
+    # FinishReason.DEADLINE). None falls back to the engine-level
+    # LLM_DEADLINE_MS knob; 0/unset there means no deadline at all, which
+    # keeps every path cost-free (the engine tracks no deadline set).
+    deadline_ms: Optional[float] = None
 
 
 class RequestState(enum.Enum):
@@ -43,7 +50,9 @@ class FinishReason(enum.Enum):
     STOP = "stop"          # hit an EOS/stop token
     LENGTH = "length"      # max_tokens or max_model_len
     ABORT = "abort"
-    ERROR = "error"        # unservable (e.g. can never fit the KV pool)
+    ERROR = "error"        # unservable, or a dispatch failed under it
+    DEADLINE = "deadline"  # request deadline expired (queued or running)
+    SHED = "shed"          # rejected at admission (bounded queue)
 
 
 @dataclasses.dataclass(eq=False)  # identity semantics: a request is not its field values
@@ -79,6 +88,17 @@ class Request:
     # output_ids back into prompt_ids; sampling keys use (seed, sampling_step)
     # so the regenerated continuation stays reproducible).
     sampling_step: int = 0
+    # Absolute monotonic instant after which the request must be aborted
+    # (None = no deadline). Stamped by the engine at add_request from
+    # sampling.deadline_ms / the LLM_DEADLINE_MS default.
+    deadline: Optional[float] = None
+    # Waiting-queue depth of the OWNING replica at enqueue (stamped by
+    # scheduler.add_request). The serving layer's per-slot wait EWMA
+    # divides the measured queue wait by this — it must be the depth the
+    # request actually waited behind, not the pool-minimum the admission
+    # pre-check reads (a round-robin route to a deeper replica would
+    # otherwise inflate the EWMA and shed spuriously).
+    depth_at_enqueue: int = 0
 
     def __post_init__(self) -> None:
         # Preemption folds generated tokens into prompt_ids for recompute

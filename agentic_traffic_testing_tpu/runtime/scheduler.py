@@ -33,6 +33,13 @@ from agentic_traffic_testing_tpu.runtime.block_allocator import (
 from agentic_traffic_testing_tpu.runtime.request import Request, RequestState
 
 
+class QueueFullError(RuntimeError):
+    """add_request refused: the bounded wait queue (`max_queue`) is at
+    capacity. The serving layer maps this to 503 + Retry-After (load
+    shedding beats admitting work that will sit past its SLO); the
+    preemption path never raises it — admitted work is never dropped."""
+
+
 def pow2_buckets(lo: int, hi: int) -> list[int]:
     out, v = [], lo
     while v < hi:
@@ -124,6 +131,12 @@ class SchedulerConfig:
     # must. 0 (default) disables fusion entirely: planning is bit-identical
     # to the serial prefill-priority policy.
     hybrid_token_budget: int = 0
+    # Bounded wait queue (round 9 — the overload-policy half of ROADMAP
+    # item 2): add_request raises QueueFullError once this many requests
+    # are already waiting. 0 (default) keeps the queue unbounded, exactly
+    # as before the knob existed. Preemption re-queues bypass the bound
+    # (appendleft in _preempt): shedding applies to NEW work only.
+    max_queue: int = 0
     # Multi-request prefill batches only form for buckets up to this length.
     # Longer prompts prefill solo: a (batch, long-bucket) combination is a
     # fresh XLA compile (~tens of seconds) that a burst of concurrent
@@ -191,6 +204,9 @@ class Scheduler:
     # -- admission ---------------------------------------------------------
 
     def add_request(self, req: Request) -> None:
+        if self.cfg.max_queue and len(self.waiting) >= self.cfg.max_queue:
+            raise QueueFullError(
+                f"wait queue at capacity ({self.cfg.max_queue}); retry later")
         if req.num_prompt_tokens == 0:
             raise ValueError("empty prompt: nothing to prefill")
         if req.num_prompt_tokens >= self.cfg.max_model_len:
@@ -207,6 +223,7 @@ class Scheduler:
                 f"{self.allocator.num_blocks - 1}; raise num_blocks or shrink the prompt"
             )
         req.state = RequestState.WAITING
+        req.depth_at_enqueue = len(self.waiting)
         self.waiting.append(req)
         self.composition_epoch += 1
 

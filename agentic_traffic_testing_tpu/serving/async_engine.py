@@ -25,6 +25,7 @@ import dataclasses
 import logging
 import queue
 import threading
+import time
 import uuid
 from typing import AsyncIterator, Callable, Optional
 
@@ -63,9 +64,20 @@ class AsyncLLMEngine:
     """Threaded asyncio wrapper. Create, then `await start()`."""
 
     def __init__(self, engine: LLMEngine,
-                 on_step: Optional[Callable[[int], None]] = None) -> None:
+                 on_step: Optional[Callable[[int], None]] = None,
+                 health=None) -> None:
         self.engine = engine
         self._on_step = on_step          # per-step batch-size observer (metrics)
+        # Replica health observer (serving/replica_pool.ReplicaHealth):
+        # the pool wires one per replica so the step loop's outcomes —
+        # clean step, per-batch dispatch failure, step exception, wedged
+        # step — drive the healthy → degraded → quarantined machine.
+        # None (single-engine default) costs one `is not None` per step.
+        self._health = health
+        # Injected step latency (LLM_FAULT_SPEC slow_replica point, wired
+        # by the pool): simulates a wedged/slow chip so the watchdog and
+        # load-aware routing are testable. 0.0 = no sleep ever.
+        self.step_delay_s = 0.0
         self._submit_q: queue.Queue = queue.Queue()
         self._streams: dict[str, _Stream] = {}
         self._stop = threading.Event()
@@ -115,19 +127,64 @@ class AsyncLLMEngine:
             block = False  # only the first get may block
             rid, prompt_ids, sampling, stream = item
             self._streams[rid] = stream
-            self.engine.add_request(prompt_ids, sampling, request_id=rid)
+            try:
+                self.engine.add_request(prompt_ids, sampling, request_id=rid)
+            except Exception as exc:
+                # An admission refusal (bounded queue, unservable prompt)
+                # must terminate THIS stream, never the engine thread: the
+                # HTTP layer's own pre-checks race against other handlers,
+                # so the authoritative refusal lands here.
+                from agentic_traffic_testing_tpu.runtime.request import (
+                    FinishReason,
+                    Request,
+                    RequestState,
+                )
+                from agentic_traffic_testing_tpu.runtime.scheduler import (
+                    QueueFullError,
+                )
+
+                req = Request(request_id=rid, prompt_ids=list(prompt_ids),
+                              sampling=sampling)
+                req.state = RequestState.ABORTED
+                req.finish_reason = (FinishReason.SHED
+                                     if isinstance(exc, QueueFullError)
+                                     else FinishReason.ERROR)
+                req.error = str(exc)
+                del self._streams[rid]
+                stream.push(TokenEvent([], True, req))
 
     def _run(self) -> None:
         while not self._stop.is_set():
             self._drain_submissions(block=not self.engine.has_work())
             if not self.engine.has_work():
                 continue
+            h = self._health
+            pre_failures = h and self.engine.num_dispatch_failures
+            if h is not None:
+                h.step_started()
+            if self.step_delay_s > 0.0:
+                # Injected slow-replica fault — INSIDE the step_started
+                # window, so the stuck-step watchdog can observe it (the
+                # whole point of the slow_replica fault shape).
+                time.sleep(self.step_delay_s)
             try:
                 events = self.engine.step()
             except Exception:
+                if h is not None:
+                    h.step_done()
+                    h.record_error()
                 log.exception("engine step failed; failing all live requests")
                 self._fail_all()
                 continue
+            if h is not None:
+                h.step_done()
+                if self.engine.num_dispatch_failures > pre_failures:
+                    # The step survived but a batch dispatch failed inside
+                    # it (engine-level isolation): still a replica-health
+                    # signal — consecutive ones quarantine.
+                    h.record_error()
+                else:
+                    h.record_ok()
             if self._on_step is not None and events:
                 self._on_step(sum(1 for e in events if e.new_token_ids))
             # Work-list, not a plain for: an abort's drain can FINISH sibling

@@ -104,6 +104,26 @@ class ServerConfig:
     # slo_itl_ms fields. Measured only when step_trace is on.
     slo_ttft_ms: float = 0.0                   # LLM_SLO_TTFT_MS
     slo_itl_ms: float = 0.0                    # LLM_SLO_ITL_MS
+    # Bounded per-replica wait queue (round 9 robustness plane): a new
+    # request arriving while this many are already waiting on EVERY
+    # replica is shed with 503 + Retry-After (and the engine-level bound
+    # is the authoritative backstop against handler races). 0 (default)
+    # keeps queues unbounded, exactly as before the knob existed.
+    max_queue: int = 0                         # LLM_MAX_QUEUE
+    # Default per-request completion deadline in ms (0 = none). Queued or
+    # running requests past it abort with FinishReason.DEADLINE (HTTP
+    # 504); the per-request `deadline_ms` body field overrides. Also used
+    # for admission projection: a request whose projected queue wait
+    # already exceeds its deadline is shed up front with 429.
+    deadline_ms: float = 0.0                   # LLM_DEADLINE_MS
+    # Deterministic fault injection (runtime/faultinject.py): spec string
+    # compiled into dispatch/restore/replica fault hooks, e.g.
+    # "dispatch_error:p=0.05;restore_error:p=0.1;slow_replica:idx=1,ms=200".
+    # Empty (default) = no injector exists anywhere, hot paths untouched.
+    # NEVER set in production — this is the chaos-testing surface.
+    fault_spec: str = ""                       # LLM_FAULT_SPEC
+    # Seed for the per-point fault RNG streams (replica i uses seed + i).
+    fault_seed: int = 0                        # LLM_FAULT_SEED
     prefix_caching: bool = False               # LLM_PREFIX_CACHING
     # Host-RAM second tier for the prefix cache (runtime/kv_offload.py):
     # GB of host memory for evicted prefix blocks; restored device-side on
@@ -220,6 +240,27 @@ class ServerConfig:
             raise ValueError(
                 f"LLM_SLO_TTFT_MS / LLM_SLO_ITL_MS must be >= 0 ms, got "
                 f"{c.slo_ttft_ms} / {c.slo_itl_ms}")
+        c.max_queue = int(os.environ.get("LLM_MAX_QUEUE") or c.max_queue)
+        if c.max_queue < 0:
+            raise ValueError(
+                f"LLM_MAX_QUEUE must be >= 0, got {c.max_queue} "
+                f"(unset it for an unbounded wait queue)")
+        c.deadline_ms = float(
+            os.environ.get("LLM_DEADLINE_MS") or c.deadline_ms)
+        if c.deadline_ms < 0:
+            raise ValueError(
+                f"LLM_DEADLINE_MS must be >= 0, got {c.deadline_ms} "
+                f"(unset it to disable request deadlines)")
+        c.fault_spec = os.environ.get("LLM_FAULT_SPEC") or c.fault_spec
+        if c.fault_spec:
+            # Compile-check at env parse: a typo'd chaos spec must fail
+            # before any model loads, not silently inject nothing.
+            from agentic_traffic_testing_tpu.runtime.faultinject import (
+                parse_fault_spec,
+            )
+
+            parse_fault_spec(c.fault_spec)
+        c.fault_seed = int(os.environ.get("LLM_FAULT_SEED") or c.fault_seed)
         c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
         c.host_cache_gb = float(
             os.environ.get("LLM_HOST_CACHE_GB") or c.host_cache_gb)
@@ -298,6 +339,16 @@ class ServerConfig:
         p.add_argument("--slo-itl-ms", type=float, default=c.slo_itl_ms,
                        help="mean-ITL SLO class in ms for "
                             "llm_slo_attainment (0 = no SLO)")
+        p.add_argument("--max-queue", type=int, default=c.max_queue,
+                       help="bounded wait queue: shed (503) past this many "
+                            "waiting requests per replica (0 = unbounded)")
+        p.add_argument("--deadline-ms", type=float, default=c.deadline_ms,
+                       help="default per-request completion deadline in ms "
+                            "(0 = none; body deadline_ms overrides)")
+        p.add_argument("--fault-spec", default=c.fault_spec,
+                       help="deterministic fault injection spec (chaos "
+                            "testing only), e.g. 'dispatch_error:p=0.05'")
+        p.add_argument("--fault-seed", type=int, default=c.fault_seed)
         p.add_argument("--enable-prefix-caching", dest="prefix_caching",
                        action="store_true", default=c.prefix_caching)
         p.add_argument("--host-cache-gb", type=float, default=c.host_cache_gb,
@@ -321,7 +372,8 @@ class ServerConfig:
                   "decode_steps", "prefill_chunk_tokens",
                   "prefill_batch_max_len", "prefill_pipeline_chunks",
                   "decode_overlap", "step_trace", "slo_ttft_ms",
-                  "slo_itl_ms", "prefix_caching",
+                  "slo_itl_ms", "max_queue", "deadline_ms",
+                  "fault_spec", "fault_seed", "prefix_caching",
                   "host_cache_gb", "hybrid_token_budget",
                   "num_blocks", "block_size", "weights_path",
                   "speculation", "spec_tokens", "spec_ngram"):
@@ -335,6 +387,16 @@ class ServerConfig:
         if c.decode_overlap not in (0, 1):
             raise ValueError(
                 f"--decode-overlap must be 0 or 1, got {c.decode_overlap}")
+        if c.max_queue < 0 or c.deadline_ms < 0:
+            raise ValueError(
+                f"--max-queue / --deadline-ms must be >= 0, got "
+                f"{c.max_queue} / {c.deadline_ms}")
+        if c.fault_spec:
+            from agentic_traffic_testing_tpu.runtime.faultinject import (
+                parse_fault_spec,
+            )
+
+            parse_fault_spec(c.fault_spec)  # re-check after CLI override
         if c.decode_overlap and c.speculation:
             # Re-check after CLI overrides (--speculation may arrive here).
             raise ValueError(

@@ -287,6 +287,45 @@ class LLMMetrics:
             f"{prefix}_config_slo_itl_ms",
             "Default mean-ITL SLO class in ms (LLM_SLO_ITL_MS; 0 = no SLO)",
             registry=r)
+        # Fault-tolerant serving plane (round 9). Always registered, like
+        # the step-clock families, so the scrape contract is stable; every
+        # series stays zero until the overload/failure policies act.
+        self.requests_shed = Counter(
+            f"{prefix}_requests_shed",
+            "Requests rejected at admission by reason: queue_full (bounded "
+            "wait queue, 503), slo_unattainable / deadline_unattainable "
+            "(projected queue wait past the request's TTFT SLO class or "
+            "deadline, 429)", ["reason"], registry=r)
+        self.deadline_exceeded = Gauge(
+            f"{prefix}_request_deadline_exceeded_total",
+            "Requests aborted past their deadline (LLM_DEADLINE_MS or the "
+            "per-request deadline_ms body field; cumulative)", registry=r)
+        self.request_retries = Gauge(
+            f"{prefix}_request_retries_total",
+            "Un-started requests retried once on an alternate replica "
+            "after a dispatch failure (cumulative; 0 without a pool)",
+            registry=r)
+        self.host_restore_fallback = Gauge(
+            f"{prefix}_host_restore_fallback_total",
+            "Host-tier KV restores that failed (corrupt/missing pages) and "
+            "degraded to the prefill recompute path (cumulative)",
+            registry=r)
+        self.dispatch_failures = Gauge(
+            f"{prefix}_dispatch_failures_total",
+            "Device dispatches that raised and failed only their batch "
+            "(engine-level fault isolation; cumulative)", registry=r)
+        # Per-replica health as a labeled gauge: 1 healthy, 0.5 degraded,
+        # 0 quarantined. Registered ONLY under a replica pool — the
+        # pinned replica-series rule (no llm_replica_* family exists at
+        # num_replicas=1) wins over the always-registered default the
+        # other round-9 families follow: health is a property OF replicas.
+        self.replica_health = None
+        if num_replicas > 1:
+            self.replica_health = Gauge(
+                f"{prefix}_replica_health",
+                "Replica health state machine: 1 = healthy, 0.5 = degraded, "
+                "0 = quarantined (router skips quarantined replicas)",
+                ["replica"], registry=r)
         # Pre-touch every label combination so a scrape shows zeroed
         # series (deterministic payload) instead of families appearing
         # only after first traffic.
@@ -297,6 +336,12 @@ class LLMMetrics:
         for slo in ("ttft", "itl"):
             for status in ("met", "violated"):
                 self.slo_attainment.labels(slo=slo, status=status)
+        for reason in ("queue_full", "slo_unattainable",
+                       "deadline_unattainable"):
+            self.requests_shed.labels(reason=reason)
+        if self.replica_health is not None:
+            for i in range(num_replicas):
+                self.replica_health.labels(replica=str(i))
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -375,6 +420,31 @@ class LLMMetrics:
         """Refresh the overlapped-decode mispredict counter (called on
         scrape; stays 0 while the knob is off)."""
         self.decode_overlap_mispredicts.set(mispredicts)
+
+    _HEALTH_VALUES = {"healthy": 1.0, "degraded": 0.5, "quarantined": 0.0}
+
+    def record_shed(self, reason: str) -> None:
+        """One admission rejection (server-side, at shed time)."""
+        self.requests_shed.labels(reason=reason).inc()
+
+    def set_robustness_stats(self, *, deadline_expired: int, retries: int,
+                             restore_fallbacks: int,
+                             dispatch_failures: int) -> None:
+        """Refresh the round-9 cumulative counters from engine/pool state
+        (called on scrape; all zero while the policies never fire)."""
+        self.deadline_exceeded.set(deadline_expired)
+        self.request_retries.set(retries)
+        self.host_restore_fallback.set(restore_fallbacks)
+        self.dispatch_failures.set(dispatch_failures)
+
+    def set_replica_health(self, states: list) -> None:
+        """Refresh llm_replica_health from EnginePool health states
+        (called on scrape; no family without a pool)."""
+        if self.replica_health is None:
+            return
+        for i, state in enumerate(states):
+            self.replica_health.labels(replica=str(i)).set(
+                self._HEALTH_VALUES.get(state, 0.0))
 
     def set_spec_stats(self, *, emitted: int, iters: int) -> None:
         """Refresh speculation-acceptance gauges (called on scrape; zeros

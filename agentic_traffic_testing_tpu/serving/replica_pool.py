@@ -31,10 +31,15 @@ Two driving modes, mirroring LLMEngine/AsyncLLMEngine:
 from __future__ import annotations
 
 import logging
+import time
 from typing import AsyncIterator, Callable, List, Optional
 
 from agentic_traffic_testing_tpu.runtime.engine import LLMEngine, StepOutput
-from agentic_traffic_testing_tpu.runtime.request import Request, SamplingParams
+from agentic_traffic_testing_tpu.runtime.request import (
+    FinishReason,
+    Request,
+    SamplingParams,
+)
 from agentic_traffic_testing_tpu.serving.async_engine import (
     AsyncLLMEngine,
     TokenEvent,
@@ -42,6 +47,150 @@ from agentic_traffic_testing_tpu.serving.async_engine import (
 from agentic_traffic_testing_tpu.serving.router import make_router
 
 log = logging.getLogger("att_tpu.replica_pool")
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+QUARANTINED = "quarantined"
+
+
+class ReplicaHealth:
+    """Per-replica health state machine: healthy → degraded → quarantined.
+
+    Driven by the replica's OWN step loop (AsyncLLMEngine wires itself to
+    one of these): a clean step records ok, a step exception or an
+    engine-isolated batch-dispatch failure records an error, and
+    `error_threshold` consecutive errors quarantine the replica for an
+    exponentially backed-off cooldown. A stuck-step watchdog quarantines a
+    replica whose CURRENT dispatch has been running longer than
+    `watchdog_s` (a wedged chip never reports an error — it just stops
+    finishing steps). Quarantined replicas are skipped by the router
+    (EnginePool.eligible_replicas); the background probe
+    (EnginePool.health_probe) re-admits them after cooldown into DEGRADED
+    probation, where one more error re-quarantines with doubled backoff
+    and one clean step restores HEALTHY.
+
+    Lock-free on purpose, like engine.load_snapshot: every field is one
+    attribute read/write (atomic under the GIL). The engine thread writes
+    step outcomes; the HTTP thread reads state and applies the watchdog.
+    A stale read costs one routing decision, never correctness."""
+
+    # Default watchdog sits well past the repo's documented first-bucket
+    # XLA compile stall (~35-60 s blocking the step thread mid-traffic,
+    # scheduler.py prefill_batch_max_len history): a replica legitimately
+    # compiling a cold shape must not be quarantined as wedged. Warmup
+    # precompiles the ladder in production; deployments that disable it
+    # should raise this further (or pass watchdog_s=0 to disable).
+    def __init__(self, error_threshold: int = 3, watchdog_s: float = 120.0,
+                 cooldown_s: float = 2.0, max_cooldown_s: float = 60.0) -> None:
+        if error_threshold < 1:
+            raise ValueError(
+                f"error_threshold must be >= 1, got {error_threshold}")
+        self.error_threshold = error_threshold
+        self.watchdog_s = watchdog_s        # 0 disables the stuck check
+        self.cooldown_s = cooldown_s
+        self.max_cooldown_s = max_cooldown_s
+        self.state = HEALTHY
+        self.consecutive_errors = 0
+        self.quarantined_until = 0.0
+        self.num_quarantines = 0            # cumulative (drives the backoff)
+        self._cause: Optional[str] = None
+        self._step_started_t: Optional[float] = None
+
+    # -- engine-thread side -------------------------------------------------
+
+    def step_started(self) -> None:
+        self._step_started_t = time.monotonic()
+
+    def step_done(self) -> None:
+        self._step_started_t = None
+
+    def record_ok(self) -> None:
+        # Lazy probation first: eligible() re-admits a quarantined replica
+        # the moment its cooldown lapses, possibly before the background
+        # probe tick (or without any probe loop at all — direct EnginePool
+        # embedding). Without this, step outcomes on lazily re-admitted
+        # work dead-end in QUARANTINED: record_error early-returns (no
+        # doubled backoff) and record_ok refuses to heal.
+        self.probe()
+        self.consecutive_errors = 0
+        if self.state is not QUARANTINED or self._cause == "stuck":
+            # A clean step heals degraded/probation state immediately; a
+            # stuck-quarantine also lifts (the wedge resolved on its own).
+            # An error-quarantine waits for the cooldown instead — old
+            # queued work draining through a sick replica must not flap
+            # it straight back into the rotation.
+            self.state = HEALTHY
+            self._cause = None
+
+    def record_error(self) -> None:
+        self.probe()  # lazy probation — see record_ok
+        self.consecutive_errors += 1
+        if self.state is QUARANTINED:
+            return  # cooldown still running; probation decides re-admission
+        if self.consecutive_errors >= self.error_threshold:
+            self._quarantine("errors")
+        else:
+            self.state = DEGRADED
+
+    # -- router/probe side --------------------------------------------------
+
+    def _quarantine(self, cause: str) -> None:
+        self.state = QUARANTINED
+        self._cause = cause
+        self.num_quarantines += 1
+        backoff = min(self.cooldown_s * (2 ** (self.num_quarantines - 1)),
+                      self.max_cooldown_s)
+        self.quarantined_until = time.monotonic() + backoff
+        log.warning("replica quarantined (%s) for %.1fs", cause, backoff)
+
+    def check_stuck(self, now: Optional[float] = None) -> bool:
+        """Watchdog: quarantine if the current step has been running past
+        watchdog_s. Called from the routing path (the wedged engine thread
+        cannot report on itself)."""
+        if self.watchdog_s <= 0 or self.state is QUARANTINED:
+            return False
+        t0 = self._step_started_t
+        if t0 is not None and (now or time.monotonic()) - t0 > self.watchdog_s:
+            self._quarantine("stuck")
+            return True
+        return False
+
+    def _still_wedged(self, t: float) -> bool:
+        """Is the engine thread STILL inside an overlong step right now?
+        A wedged thread never calls step_done(), so a lapsed cooldown
+        alone must not re-admit it — work routed there would sit in its
+        submit queue with no terminal event ever arriving (and the
+        deadline sweep can't run either: it lives on the blocked
+        thread)."""
+        t0 = self._step_started_t
+        return (self.watchdog_s > 0 and t0 is not None
+                and t - t0 > self.watchdog_s)
+
+    def eligible(self, now: Optional[float] = None) -> bool:
+        """May the router place NEW work here? Quarantined replicas become
+        eligible again once their cooldown lapses (the lazy counterpart of
+        the background probe, so routing never depends on probe timing) —
+        unless the step that got them quarantined is still running."""
+        if self.state is not QUARANTINED:
+            return True
+        t = now or time.monotonic()
+        return t >= self.quarantined_until and not self._still_wedged(t)
+
+    def probe(self, now: Optional[float] = None) -> bool:
+        """Re-admit after cooldown: QUARANTINED → DEGRADED probation. One
+        more error re-quarantines (doubled backoff); one clean step
+        restores HEALTHY. True when a transition happened. A replica
+        still wedged in the quarantining step stays out (the wedge
+        resolving is observable: step_done clears the stamp)."""
+        t = now or time.monotonic()
+        if (self.state is QUARANTINED and t >= self.quarantined_until
+                and not self._still_wedged(t)):
+            self.state = DEGRADED
+            self._cause = None
+            self.consecutive_errors = self.error_threshold - 1
+            log.info("quarantined replica re-admitted on probation")
+            return True
+        return False
 
 
 def replica_devices(num_replicas: int):
@@ -72,7 +221,9 @@ class EnginePool:
 
     def __init__(self, engines: List[LLMEngine], policy: str = "round_robin",
                  on_step: Optional[Callable[[int], None]] = None,
-                 devices: Optional[list] = None) -> None:
+                 devices: Optional[list] = None,
+                 fault_spec: str = "", fault_seed: int = 0,
+                 health_params: Optional[dict] = None) -> None:
         self.engines = list(engines)
         self.policy = policy
         self.router = make_router(policy, self.engines)
@@ -80,13 +231,33 @@ class EnginePool:
         # Routing decisions per replica (exported as the per-replica
         # labeled series; plain int increments under the GIL).
         self.routed_requests = [0] * len(self.engines)
-        self._async = [AsyncLLMEngine(e, on_step=on_step)
-                       for e in self.engines]
+        # Per-replica health machines (round 9): each replica's step loop
+        # drives its own; the router skips quarantined replicas and a
+        # failed un-started request retries once on a survivor.
+        self.health = [ReplicaHealth(**(health_params or {}))
+                       for _ in self.engines]
+        self.request_retries = 0   # retry-once failovers (llm_request_retries_total)
+        self._async = [AsyncLLMEngine(e, on_step=on_step, health=h)
+                       for e, h in zip(self.engines, self.health)]
+        if fault_spec:
+            # slow_replica fault point (runtime/faultinject.py): the
+            # replica-call-site injection — a per-step sleep on one
+            # replica's loop, the wedged-chip shape the watchdog and
+            # load-aware routing must absorb.
+            from agentic_traffic_testing_tpu.runtime.faultinject import (
+                FaultInjector,
+            )
+
+            inj = FaultInjector.from_spec(fault_spec, fault_seed)
+            for i, a in enumerate(self._async):
+                a.step_delay_s = inj.delay_s(i)
 
     @classmethod
     def build(cls, engine_factory: Callable[[int], LLMEngine],
               num_replicas: int, policy: str = "round_robin",
-              on_step: Optional[Callable[[int], None]] = None) -> "EnginePool":
+              on_step: Optional[Callable[[int], None]] = None,
+              fault_spec: str = "", fault_seed: int = 0,
+              health_params: Optional[dict] = None) -> "EnginePool":
         """Construct N replicas, slicing devices on multichip.
 
         `engine_factory(i)` builds replica i's engine; on multichip it runs
@@ -110,16 +281,52 @@ class EnginePool:
                 engine.cache = jax.device_put(engine.cache, dev)
                 log.info("replica %d pinned to %s", i, dev)
             engines.append(engine)
-        return cls(engines, policy=policy, on_step=on_step, devices=devices)
+        return cls(engines, policy=policy, on_step=on_step, devices=devices,
+                   fault_spec=fault_spec, fault_seed=fault_seed,
+                   health_params=health_params)
 
     def __len__(self) -> int:
         return len(self.engines)
 
     # -- routing -----------------------------------------------------------
 
+    def eligible_replicas(self) -> list[int]:
+        """Replica indices the router may place new work on: everything
+        not quarantined (the stuck watchdog fires lazily here — a wedged
+        engine thread cannot report on itself). Fails OPEN to all
+        replicas when everyone is quarantined: degraded service beats
+        refusing the entire pool."""
+        now = time.monotonic()
+        for h in self.health:
+            h.check_stuck(now)
+        ok = [i for i, h in enumerate(self.health) if h.eligible(now)]
+        return ok or list(range(len(self.engines)))
+
+    def health_probe(self) -> int:
+        """Background re-admission probe (the server runs this
+        periodically): quarantined replicas whose cooldown lapsed move to
+        DEGRADED probation. Returns how many transitioned."""
+        now = time.monotonic()
+        return sum(1 for h in self.health if h.probe(now))
+
     def route(self, prompt_ids: list[int],
               request_id: Optional[str] = None) -> int:
-        idx = self.router.select(prompt_ids, request_id)
+        idx = self.router.select(prompt_ids, request_id,
+                                 eligible=self.eligible_replicas())
+        self.routed_requests[idx] += 1
+        return idx
+
+    def _alternate(self, tried: list[int]) -> Optional[int]:
+        """Least-loaded eligible replica outside `tried` (the retry-once
+        target), or None when no alternate exists."""
+        cands = [i for i in self.eligible_replicas() if i not in tried]
+        if not cands:
+            return None
+        def _load(i: int) -> tuple:
+            s = self.engines[i].load_snapshot()
+            return (s["num_waiting"] + s["num_running"], i)
+
+        idx = min(cands, key=_load)
         self.routed_requests[idx] += 1
         return idx
 
@@ -175,11 +382,47 @@ class EnginePool:
     ) -> AsyncIterator[TokenEvent]:
         """Route once, then stream from the owning replica. The delegated
         AsyncLLMEngine keeps its own dead-stream abort handling, so a
-        disconnected client aborts on (and only on) its replica."""
+        disconnected client aborts on (and only on) its replica.
+
+        Failover (round 9): a request that fails with an ERROR or SHED
+        before emitting ANY token retries exactly once on a least-loaded
+        alternate replica — un-started work is side-effect-free to move,
+        and the wait-queue bound is PER-replica, so a shed on one full
+        replica says nothing about a less-loaded survivor (under global
+        overload the retry sheds again and the 503 surfaces). A stream
+        that already emitted tokens never retries (replaying tokens
+        silently would corrupt the client's text); its terminal error
+        passes through and the client decides. Deadline terminals never
+        retry (the wall clock moves with the request)."""
         idx = self.route(prompt_ids, request_id)
-        async for ev in self._async[idx].generate(prompt_ids, sampling,
-                                                  request_id):
-            yield ev
+        tried = [idx]
+        while True:
+            emitted = False
+            retry_ev: Optional[TokenEvent] = None
+            async for ev in self._async[idx].generate(prompt_ids, sampling,
+                                                      request_id):
+                if ev.new_token_ids:
+                    emitted = True
+                if (ev.finished and not emitted and len(tried) == 1
+                        and ev.request.finish_reason in (FinishReason.ERROR,
+                                                         FinishReason.SHED)
+                        and len(self.engines) > 1):
+                    retry_ev = ev
+                    break
+                yield ev
+                if ev.finished:
+                    return
+            if retry_ev is None:
+                return  # defensive: stream ended without a terminal event
+            alt = self._alternate(tried)
+            if alt is None:
+                yield retry_ev  # no survivor to retry on: surface the error
+                return
+            self.request_retries += 1
+            log.warning("request %s failed un-started on replica %d; "
+                        "retrying once on replica %d", request_id, idx, alt)
+            idx = alt
+            tried.append(alt)
 
     # -- aggregation (metrics layer) ---------------------------------------
 
@@ -202,6 +445,32 @@ class EnginePool:
     @property
     def num_overlap_mispredicts(self) -> int:
         return sum(e.num_overlap_mispredicts for e in self.engines)
+
+    # Robustness-plane counters (round 9), summed like every llm_* total.
+
+    @property
+    def num_dispatch_failures(self) -> int:
+        return sum(e.num_dispatch_failures for e in self.engines)
+
+    @property
+    def num_deadline_expired(self) -> int:
+        return sum(e.num_deadline_expired for e in self.engines)
+
+    @property
+    def num_restore_fallbacks(self) -> int:
+        return sum(e.num_restore_fallbacks for e in self.engines)
+
+    @property
+    def num_shed(self) -> int:
+        return sum(e.num_shed for e in self.engines)
+
+    def replica_health_states(self) -> list[str]:
+        """Per-replica health for the llm_replica_health labeled gauge
+        (watchdog applied first, so a scrape sees wedges promptly)."""
+        now = time.monotonic()
+        for h in self.health:
+            h.check_stuck(now)
+        return [h.state for h in self.health]
 
     @property
     def telemetry_recorders(self) -> list:
@@ -244,6 +513,8 @@ class EnginePool:
         "host_cache_entries",
         "host_cache_saved_blocks",
         "host_cache_evicted_blocks",
+        "host_cache_corrupt_dropped",
+        "host_cache_invalidated_blocks",
     )
 
     def kv_stats(self) -> dict:
@@ -265,8 +536,11 @@ class EnginePool:
     def replica_stats(self) -> list[dict]:
         """Per-replica snapshot for the `llm_replica_*` labeled series."""
         out = []
+        health = self.replica_health_states()
         for i, e in enumerate(self.engines):
             stats = e.kv_stats()
             stats["routed_requests"] = self.routed_requests[i]
+            stats["health"] = health[i]
+            stats["consecutive_errors"] = self.health[i].consecutive_errors
             out.append(stats)
         return out

@@ -26,6 +26,12 @@ Three policies, selected by `LLM_ROUTER_POLICY`:
                     beats a cache hit that would sit behind max_num_seqs
                     other requests.
 
+Every policy accepts an `eligible` replica-index subset (round 9): the
+EnginePool passes its health-filtered list so quarantined replicas are
+skipped, and each policy degrades gracefully — round_robin rotates over
+the survivors, prefix_affinity rendezvous-hashes by ORIGINAL index so a
+replica returning from quarantine reclaims exactly its old keys.
+
 Routers only READ engine state, through the lock-free snapshot methods the
 engine exposes for exactly this (engine.load_snapshot / probe_prefix_tokens):
 single dict/len reads under the GIL, safe against the step thread, never
@@ -52,17 +58,19 @@ def prefix_route_key(prompt_ids: Sequence[int], block_size: int) -> bytes:
     return ",".join(str(int(t)) for t in head).encode()
 
 
-def rendezvous_pick(key: bytes, n: int) -> int:
-    """Highest-random-weight (rendezvous) hash: key -> replica in [0, n).
+def rendezvous_pick(key: bytes, n) -> int:
+    """Highest-random-weight (rendezvous) hash: key -> replica.
 
-    Consistent under membership change: removing a replica only remaps the
-    keys that replica owned; every other key keeps its assignment (the
-    property plain `hash % n` lacks — resizing would reshuffle everything
+    `n` is a replica count (pick in [0, n)) or an explicit candidate index
+    sequence (pick among them, scoring by ORIGINAL index — so quarantining
+    a replica only remaps the keys it owned, the same consistency property
+    that makes removal cheap: plain `hash % n` would reshuffle everything
     and cold-start every prefix cache)."""
-    if n <= 0:
+    cands = list(range(n)) if isinstance(n, int) else list(n)
+    if not cands:
         raise ValueError("rendezvous over an empty replica set")
-    best, best_score = 0, b""
-    for i in range(n):
+    best, best_score = cands[0], b""
+    for i in cands:
         score = hashlib.sha1(key + b"#%d" % i).digest()
         if score > best_score:
             best, best_score = i, score
@@ -93,8 +101,21 @@ class Router:
         s = self.engines[i].load_snapshot()
         return s["num_waiting"] >= max(1, s["max_num_seqs"])
 
+    def _candidates(self, eligible) -> list[int]:
+        """Replica indices a selection may consider. `eligible=None` (the
+        default, and the poolless test path) means all; the pool passes
+        its health-filtered index list, which is never empty (it fails
+        open to all replicas when everyone is quarantined)."""
+        if eligible is None:
+            return list(range(len(self.engines)))
+        cands = list(eligible)
+        if not cands:
+            raise ValueError("select over an empty eligible set")
+        return cands
+
     def select(self, prompt_ids: Sequence[int],
-               request_id: Optional[str] = None) -> int:
+               request_id: Optional[str] = None,
+               eligible: Optional[Sequence[int]] = None) -> int:
         raise NotImplementedError
 
 
@@ -105,17 +126,20 @@ class RoundRobinRouter(Router):
         super().__init__(engines)
         self._counter = itertools.count()
 
-    def select(self, prompt_ids, request_id=None) -> int:
+    def select(self, prompt_ids, request_id=None, eligible=None) -> int:
         # itertools.count.__next__ is a single C call — atomic under the
-        # GIL, so concurrent handlers never double-assign a slot.
-        return next(self._counter) % len(self.engines)
+        # GIL, so concurrent handlers never double-assign a slot. With a
+        # filtered eligible set the rotation walks the survivors (full
+        # eligibility reduces to the plain modulo rotation).
+        cands = self._candidates(eligible)
+        return cands[next(self._counter) % len(cands)]
 
 
 class LeastLoadedRouter(Router):
     name = "least_loaded"
 
-    def select(self, prompt_ids, request_id=None) -> int:
-        return min(range(len(self.engines)), key=self._load)
+    def select(self, prompt_ids, request_id=None, eligible=None) -> int:
+        return min(self._candidates(eligible), key=self._load)
 
 
 class PrefixAffinityRouter(Router):
@@ -131,27 +155,30 @@ class PrefixAffinityRouter(Router):
             return None
         return chain(prompt_ids)
 
-    def select(self, prompt_ids, request_id=None) -> int:
-        n = len(self.engines)
-        if n == 1:
-            return 0
+    def select(self, prompt_ids, request_id=None, eligible=None) -> int:
+        cands = self._candidates(eligible)
+        if len(cands) == 1:
+            return cands[0]
         keys = self._chain_keys(prompt_ids)
-        hits = [e.probe_prefix_tokens(prompt_ids, keys) for e in self.engines]
-        best = max(hits)
+        hits = {i: self.engines[i].probe_prefix_tokens(prompt_ids, keys)
+                for i in cands}
+        best = max(hits.values())
         if best > 0:
             # Deepest hit wins; equal hits break on load, then index.
-            pick = min((i for i in range(n) if hits[i] == best),
+            pick = min((i for i in cands if hits[i] == best),
                        key=self._load)
         else:
-            # Cold prefix: rendezvous hash co-locates future siblings.
+            # Cold prefix: rendezvous hash co-locates future siblings
+            # (scored by original index, so a quarantined replica coming
+            # back reclaims exactly its old keys).
             block_size = self.engines[0].load_snapshot().get("block_size", 16)
             pick = rendezvous_pick(
-                prefix_route_key(prompt_ids, block_size), n)
+                prefix_route_key(prompt_ids, block_size), cands)
         if not self._saturated(pick):
             return pick
         # Saturation overflow: a cache hit buried behind a full extra wave
         # loses to a cold replica that can start now.
-        unsaturated = [i for i in range(n) if not self._saturated(i)]
+        unsaturated = [i for i in cands if not self._saturated(i)]
         if not unsaturated:
             return pick  # everyone is saturated: affinity is still best
         return min(unsaturated, key=self._load)

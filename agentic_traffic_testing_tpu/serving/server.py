@@ -59,6 +59,18 @@ from agentic_traffic_testing_tpu.utils.tracing import (
 
 log = logging.getLogger("att_tpu.server")
 PROGRESS_INTERVAL_S = 2.0
+HEALTH_PROBE_INTERVAL_S = 1.0
+
+
+class DeadlineExceededError(RuntimeError):
+    """The engine aborted the request past its deadline (FinishReason
+    .DEADLINE) — mapped to HTTP 504, distinct from a generation fault."""
+
+
+class RequestShedError(RuntimeError):
+    """The engine refused admission (bounded queue race backstop —
+    FinishReason.SHED) — mapped to HTTP 503 + Retry-After, exactly like
+    the server-side pre-check it races against."""
 
 
 def validate_sp_serving_config(c) -> None:
@@ -118,8 +130,9 @@ class LLMServer:
             )
 
             self.pool = EnginePool.build(
-                lambda i: self._build_engine(), cfg.num_replicas,
-                policy=cfg.router_policy, on_step=on_step)
+                lambda i: self._build_engine(replica_idx=i), cfg.num_replicas,
+                policy=cfg.router_policy, on_step=on_step,
+                fault_spec=cfg.fault_spec, fault_seed=cfg.fault_seed)
             # Compatibility handle (tests, introspection): replica 0. Every
             # metrics/aggregation path below goes through the pool instead.
             self.engine = self.pool.engines[0]
@@ -136,6 +149,19 @@ class LLMServer:
                     "with host_store= yourself and unset the knob)")
             self.engine = engine or self._build_engine()
             self.async_engine = AsyncLLMEngine(self.engine, on_step=on_step)
+            if cfg.fault_spec:
+                # slow_replica wiring for the single-engine path —
+                # EnginePool.__init__ does this for pools; without it a
+                # valid `slow_replica:idx=0` spec would inject nothing,
+                # exactly the silent-no-injection mode faultinject.py
+                # forbids.
+                from agentic_traffic_testing_tpu.runtime.faultinject import (
+                    FaultInjector,
+                )
+
+                inj = FaultInjector.from_spec(cfg.fault_spec, cfg.fault_seed)
+                if inj is not None:
+                    self.async_engine.step_delay_s = inj.delay_s(0)
         if cfg.warmup and engine is None:
             import jax
 
@@ -168,6 +194,13 @@ class LLMServer:
         # runtime concurrency probe (reference: serve_llm.py:224-340).
         self._ctx_window: deque[int] = deque(maxlen=256)
         self._probe_task: Optional[asyncio.Task] = None
+        self._health_task: Optional[asyncio.Task] = None
+        # EWMA of measured queue wait per queue slot (seconds), fed by
+        # finished requests: the SLO-aware shedding projection
+        # (`_admission_check`) multiplies it by the live queue depth —
+        # reject early when the wait a request is about to buy already
+        # blows its TTFT SLO class or deadline. None until traffic.
+        self._wait_per_slot: Optional[float] = None
         if self.metrics:
             self.metrics.set_config_gauges(
                 max_num_seqs=cfg.max_num_seqs,
@@ -204,7 +237,7 @@ class LLMServer:
                 )
             self.metrics.model_loaded.set(1 if self.model_loaded else 0)
 
-    def _build_engine(self) -> LLMEngine:
+    def _build_engine(self, replica_idx: int = 0) -> LLMEngine:
         c = self.cfg
         if self.host_store is not None and (
                 c.tp_size > 1 or c.sp_size > 1 or c.pp_size > 1):
@@ -228,6 +261,13 @@ class LLMServer:
             step_trace=c.step_trace,
             slo_ttft_ms=c.slo_ttft_ms,
             slo_itl_ms=c.slo_itl_ms,
+            max_queue=c.max_queue,
+            deadline_ms=c.deadline_ms,
+            fault_spec=c.fault_spec,
+            # Replicas must not fault in lockstep: each gets its own
+            # deterministic stream (the pool's slow_replica wiring keys
+            # off the shared base seed independently).
+            fault_seed=c.fault_seed + replica_idx,
             prefix_caching=c.prefix_caching,
             host_cache_gb=c.host_cache_gb,
             hybrid_token_budget=c.hybrid_token_budget,
@@ -501,6 +541,72 @@ class LLMServer:
               f"dropped={dropped}", flush=True)
         return ids, True, dropped
 
+    # -- admission control (round 9: SLO-aware shedding) --------------------
+
+    def _queue_depth(self) -> int:
+        """Best-case queue depth a new arrival faces: the SHALLOWEST
+        replica queue (the router can always do at least that well).
+        Lock-free snapshot reads, same contract as the routers'."""
+        return min(e.load_snapshot()["num_waiting"] for e in self._engines())
+
+    def _projected_wait_s(self, depth: int) -> Optional[float]:
+        """Projected queue wait at `depth` waiting requests, from the
+        per-slot EWMA; None until traffic has calibrated it (unknown wait
+        never sheds — admission stays optimistic while cold)."""
+        per_slot = self._wait_per_slot
+        if per_slot is None:
+            return None
+        return per_slot * (depth + 1)
+
+    def _note_queue_wait(self, wait_s: float, depth_at_enqueue: int) -> None:
+        """Fold one finished request's measured queue wait into the
+        per-slot EWMA (alpha 0.2; single float write, GIL-atomic)."""
+        per_slot = wait_s / (depth_at_enqueue + 1)
+        w = self._wait_per_slot
+        self._wait_per_slot = (per_slot if w is None
+                               else 0.8 * w + 0.2 * per_slot)
+
+    def _admission_check(self, depth: int, sampling: SamplingParams):
+        """Shed decision for a new request, or None to admit.
+
+        Returns (http_status, reason, retry_after_s, message):
+          * queue_full          — 503: every replica's wait queue is at the
+                                  LLM_MAX_QUEUE bound (the engine-level
+                                  bound backstops handler races)
+          * slo_unattainable    — 429: projected queue wait already exceeds
+                                  the request's TTFT SLO class (body
+                                  slo_ttft_ms or LLM_SLO_TTFT_MS) — work
+                                  guaranteed to miss is cheaper to refuse
+                                  than to serve late (the degradation
+                                  regime the vLLM-vs-TGI comparison
+                                  measures)
+          * deadline_unattainable — 429: projected wait exceeds the
+                                  request's whole deadline
+        """
+        c = self.cfg
+        if c.max_queue > 0 and depth >= c.max_queue:
+            proj = self._projected_wait_s(depth)
+            retry = max(1, round(proj)) if proj else 1
+            return (503, "queue_full", retry,
+                    f"wait queue at capacity ({c.max_queue} per replica); "
+                    f"retry later")
+        proj = self._projected_wait_s(depth)
+        if proj is None:
+            return None
+        slo_ttft = (sampling.slo_ttft_ms if sampling.slo_ttft_ms is not None
+                    else (c.slo_ttft_ms or None))
+        if slo_ttft and proj * 1000.0 > slo_ttft:
+            return (429, "slo_unattainable", max(1, round(proj)),
+                    f"projected queue wait {proj * 1000:.0f} ms exceeds the "
+                    f"TTFT SLO class {slo_ttft:.0f} ms")
+        deadline = (sampling.deadline_ms if sampling.deadline_ms is not None
+                    else (c.deadline_ms or None))
+        if deadline and proj * 1000.0 > deadline:
+            return (429, "deadline_unattainable", max(1, round(proj)),
+                    f"projected queue wait {proj * 1000:.0f} ms exceeds the "
+                    f"request deadline {deadline:.0f} ms")
+        return None
+
     def _log_prompt(self, source: str, prompt: str) -> None:
         if not self.cfg.log_requests:
             return
@@ -531,9 +637,19 @@ class LLMServer:
             dispatches=getattr(source, "num_pipeline_dispatches", 0))
         self.metrics.set_decode_overlap_stats(
             mispredicts=getattr(source, "num_overlap_mispredicts", 0))
+        self.metrics.set_robustness_stats(
+            deadline_expired=getattr(source, "num_deadline_expired", 0),
+            retries=getattr(source, "request_retries", 0),
+            restore_fallbacks=getattr(source, "num_restore_fallbacks", 0),
+            dispatch_failures=getattr(source, "num_dispatch_failures", 0))
         self.metrics.observe_step_clock(self._recorders())
         if self.pool is not None:
-            self.metrics.set_replica_stats(self.pool.replica_stats())
+            # One health/watchdog pass per scrape: replica_stats() already
+            # folds replica_health_states() in, and a second pass could
+            # disagree with the first within a single payload.
+            rs = self.pool.replica_stats()
+            self.metrics.set_replica_stats(rs)
+            self.metrics.set_replica_health([s["health"] for s in rs])
         return web.Response(body=self.metrics.render(),
                             headers={"Content-Type": self.metrics.content_type})
 
@@ -719,7 +835,9 @@ class LLMServer:
                     seed=hash(request_id) & 0x7FFFFFFF,
                     slo_ttft_ms=_slo_ms("slo_ttft_ms"),
                     slo_itl_ms=_slo_ms("slo_itl_ms"),
+                    deadline_ms=_slo_ms("deadline_ms"),
                 )
+                stream_mode = bool(data.get("stream", False))
             except web.HTTPException:
                 raise
             except Exception as exc:
@@ -728,12 +846,39 @@ class LLMServer:
                 return web.json_response(
                     {"error": f"Bad request: {exc}"}, status=400)
 
+            # SLO-aware shedding (round 9): refuse work that is already
+            # guaranteed to miss, BEFORE it costs a queue slot.
+            depth0 = self._queue_depth()
+            shed = self._admission_check(depth0, sampling)
+            if shed is not None:
+                http_status, reason, retry_after, msg = shed
+                await _done()
+                if self.metrics:
+                    self.metrics.record_shed(reason)
+                print(f"[llm] req={request_id} SHED reason={reason} "
+                      f"queue_depth={depth0}", flush=True)
+                span.set_attribute("app.shed_reason", reason)
+                return web.json_response(
+                    {"error": msg, "reason": reason},
+                    status=http_status,
+                    headers={"Retry-After": str(retry_after)})
+
+            if stream_mode:
+                # SSE streaming: the handler below owns inflight/metrics
+                # finalization and ALWAYS emits a terminal event —
+                # {"finished": true} with meta on success, {"error": ...,
+                # "finished": true} on any failure — so clients can
+                # distinguish truncation from completion.
+                return await self._stream_generate(
+                    request, prompt_ids, sampling, request_id, span,
+                    start, _done, depth0)
+
             status = "success"
             text = ""
             queue_wait_s = 0.0
             prompt_tokens = completion_tokens = None
             try:
-                text, queue_wait_s, n_tokens = await self._generate(
+                text, queue_wait_s, n_tokens, depth_enq = await self._generate(
                     prompt_ids, sampling, request_id, span)
                 # Feed the concurrency probe's context-envelope window
                 # (tracked regardless of metrics_include_tokens: it budgets
@@ -757,6 +902,29 @@ class LLMServer:
                 # of this HTTP span, so Jaeger shows where the latency
                 # went INSIDE the engine. No-op unless LLM_STEP_TRACE=1.
                 self._emit_phase_spans(request_id)
+                self._note_queue_wait(queue_wait_s, depth_enq)
+            except DeadlineExceededError as exc:
+                await _done()
+                latency_s = time.monotonic() - start
+                print(f"[llm] req={request_id} DEADLINE after "
+                      f"{int(latency_s * 1000)}ms: {exc}", flush=True)
+                if self.metrics:
+                    self.metrics.record_request("deadline", latency_s,
+                                                queue_wait_s, prompt_tokens,
+                                                completion_tokens)
+                return web.json_response(
+                    {"error": str(exc), "reason": "deadline"}, status=504)
+            except RequestShedError as exc:
+                # The engine-side bounded-queue backstop fired (two
+                # handlers raced past the pre-check): same 503 contract.
+                await _done()
+                if self.metrics:
+                    self.metrics.record_shed("queue_full")
+                print(f"[llm] req={request_id} SHED reason=queue_full "
+                      f"(engine backstop)", flush=True)
+                return web.json_response(
+                    {"error": str(exc), "reason": "queue_full"},
+                    status=503, headers={"Retry-After": "1"})
             except Exception as exc:
                 status = "error"
                 await _done()
@@ -812,8 +980,10 @@ class LLMServer:
                 return
 
     async def _generate(self, prompt_ids: list[int], sampling: SamplingParams,
-                        request_id: str, span) -> tuple[str, float, int]:
-        """Consume the token stream; returns (text, queue_wait_s, n_tokens)."""
+                        request_id: str, span) -> tuple[str, float, int, int]:
+        """Consume the token stream; returns (text, queue_wait_s, n_tokens,
+        depth_at_enqueue — the owning replica's queue depth the request
+        actually waited behind, for the per-slot EWMA)."""
         dec = IncrementalDecoder(self.tokenizer)
         enqueue_t = time.monotonic()
         first_token_t: Optional[float] = None
@@ -843,8 +1013,159 @@ class LLMServer:
         if finish_reason is FinishReason.ERROR:
             raise RuntimeError(ev.request.error or "request unservable "
                                "(prompt cannot fit the KV cache)")
+        if finish_reason is FinishReason.DEADLINE:
+            raise DeadlineExceededError(
+                ev.request.error or "deadline exceeded")
+        if finish_reason is FinishReason.SHED:
+            raise RequestShedError(ev.request.error or "wait queue full")
         queue_wait_s = (first_token_t or time.monotonic()) - enqueue_t
-        return dec.text(), queue_wait_s, n_tokens
+        return (dec.text(), queue_wait_s, n_tokens,
+                getattr(ev.request, "depth_at_enqueue", 0))
+
+    async def _stream_generate(self, request: web.Request,
+                               prompt_ids: list[int],
+                               sampling: SamplingParams, request_id: str,
+                               span, start: float, done,
+                               depth0: int) -> web.StreamResponse:
+        """SSE streaming (`"stream": true`): one `data:` event per token
+        increment, plus EXACTLY one terminal event.
+
+        The terminal-event contract is the point (round 9 satellite): a
+        failure mid-generation used to leave a truncated stream a client
+        could not tell from a short completion. Every exit path here —
+        success, engine fault, deadline, shed, even a transport error
+        while writing — ends with a best-effort structured
+        `{"finished": true}` event carrying either `meta` or `error`.
+        A client whose writes fail stops being served (we stop consuming;
+        the engine's remaining work for this request is bounded by
+        max_tokens) but costs no other stream anything."""
+        resp = web.StreamResponse(headers={
+            "Content-Type": "text/event-stream",
+            "Cache-Control": "no-cache",
+            "X-Accel-Buffering": "no",
+        })
+        await resp.prepare(request)
+
+        async def _emit(payload: Dict[str, Any]) -> bool:
+            try:
+                await resp.write(b"data: " + json.dumps(payload).encode()
+                                 + b"\n\n")
+                return True
+            except (ConnectionError, OSError):
+                return False
+
+        dec = IncrementalDecoder(self.tokenizer)
+        enqueue_t = time.monotonic()
+        first_token_t: Optional[float] = None
+        n_tokens = 0
+        sent_chars = 0
+        status = "success"
+        error: Optional[str] = None
+        reason: Optional[str] = None
+        stop_set = set(sampling.stop_token_ids)
+        writable = True
+        depth_enq = depth0
+        try:
+            async for ev in self.async_engine.generate(prompt_ids, sampling,
+                                                       request_id):
+                now = time.monotonic()
+                depth_enq = getattr(ev.request, "depth_at_enqueue", depth0)
+                delta_ids = []
+                delta_parts = []
+                for t in ev.new_token_ids:
+                    if t in stop_set:
+                        continue
+                    n_tokens += 1
+                    # push() returns only the STABLE decoded prefix; an
+                    # undecodable multibyte tail is held back until it
+                    # resolves. (dec.text() includes that unstable tail —
+                    # slicing it per event would stream replacement chars
+                    # the client could never un-see.)
+                    delta_parts.append(dec.push(t))
+                    delta_ids.append(t)
+                if delta_ids and first_token_t is None:
+                    first_token_t = now
+                delta = "".join(delta_parts)
+                sent_chars += len(delta)
+                if writable and (delta or delta_ids):
+                    writable = await _emit({"text": delta,
+                                            "token_ids": delta_ids,
+                                            "finished": False})
+                    if not writable:
+                        # Client gone: stop consuming (the engine's
+                        # remaining work for this request is bounded by
+                        # max_tokens; there is no thread-safe mid-step
+                        # abort from the event loop). NOT a success: the
+                        # client never saw a terminal event, and a
+                        # truncated request must not calibrate the wait
+                        # EWMA or count as a served completion.
+                        status = "disconnected"
+                        error = "client disconnected mid-stream"
+                        break
+                if ev.finished:
+                    fr = ev.request.finish_reason
+                    if fr is FinishReason.ERROR:
+                        status, error = "error", (ev.request.error
+                                                  or "generation failed")
+                    elif fr is FinishReason.DEADLINE:
+                        status = "deadline"
+                        error = ev.request.error or "deadline exceeded"
+                        reason = "deadline"
+                    elif fr is FinishReason.SHED:
+                        status = "shed"
+                        error = ev.request.error or "wait queue full"
+                        reason = "queue_full"
+                    break
+        except Exception as exc:  # engine/transport failure mid-stream
+            log.exception("stream generation failed req=%s", request_id)
+            status, error = "error", f"Generation failed: {exc}"
+
+        latency_s = time.monotonic() - start
+        queue_wait_s = (first_token_t or time.monotonic()) - enqueue_t
+        prompt_tokens = (len(prompt_ids) if self.cfg.metrics_include_tokens
+                         else None)
+        completion_tokens = (n_tokens if self.cfg.metrics_include_tokens
+                             else None)
+        if error is not None:
+            terminal: Dict[str, Any] = {"error": error, "finished": True}
+            if reason is not None:
+                terminal["reason"] = reason
+        else:
+            self._ctx_window.append(len(prompt_ids) + n_tokens)
+            self._emit_phase_spans(request_id)
+            self._note_queue_wait(queue_wait_s, depth_enq)
+            terminal = {"finished": True, "meta": {
+                "request_id": request_id,
+                "latency_ms": int(latency_s * 1000),
+                "queue_wait_s": round(queue_wait_s, 4),
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": completion_tokens,
+                "otel": span_metadata(span),
+            }}
+            # Flush any held-back decode tail (a multibyte sequence cut
+            # by max_tokens never resolves mid-stream) so the
+            # concatenation of all `text` fields equals the non-stream
+            # output.
+            tail = dec.text()[sent_chars:]
+            if tail:
+                terminal["text"] = tail
+        if writable:
+            await _emit(terminal)
+        await done()
+        if self.metrics:
+            if status == "shed":
+                self.metrics.record_shed("queue_full")
+            else:
+                self.metrics.record_request(status, latency_s, queue_wait_s,
+                                            prompt_tokens, completion_tokens)
+        print(f"[llm] req={request_id} STREAM-{status.upper()} "
+              f"latency={int(latency_s * 1000)}ms tokens={n_tokens}",
+              flush=True)
+        try:
+            await resp.write_eof()
+        except (ConnectionError, OSError):
+            pass
+        return resp
 
     # -- app ----------------------------------------------------------------
 
@@ -869,15 +1190,34 @@ class LLMServer:
                 if self.metrics:
                     self._probe_task = asyncio.ensure_future(
                         self._probe_max_concurrency())
+                if self.pool is not None:
+                    # Background re-admission probe: quarantined replicas
+                    # return to DEGRADED probation once their cooldown
+                    # lapses (serving/replica_pool.ReplicaHealth).
+                    self._health_task = asyncio.ensure_future(
+                        self._health_probe_loop())
 
             async def _stop(app):
                 if self._probe_task:
                     self._probe_task.cancel()
+                if self._health_task:
+                    self._health_task.cancel()
                 self.async_engine.shutdown()
 
             app.on_startup.append(_start)
             app.on_cleanup.append(_stop)
         return app
+
+    async def _health_probe_loop(self) -> None:
+        """Periodic quarantined-replica re-admission (pool only)."""
+        try:
+            while True:
+                await asyncio.sleep(HEALTH_PROBE_INTERVAL_S)
+                n = self.pool.health_probe()
+                if n:
+                    log.info("health probe re-admitted %d replica(s)", n)
+        except asyncio.CancelledError:
+            pass
 
     async def _probe_max_concurrency(self) -> None:
         """Background task: refresh concurrency gauges from the LIVE engine.

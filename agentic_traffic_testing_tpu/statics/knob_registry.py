@@ -110,6 +110,20 @@ KNOBS: tuple[Knob, ...] = (
          "Default mean-ITL SLO class (ms) for llm_slo_attainment; 0 = "
          "no SLO; per-request slo_itl_ms body field overrides; needs "
          "LLM_STEP_TRACE."),
+    Knob("LLM_MAX_QUEUE", "int", "0", "serving/config.py",
+         "Bounded wait queue: shed new requests (503 + Retry-After) past "
+         "this many waiting per replica (0 = unbounded)."),
+    Knob("LLM_DEADLINE_MS", "float", "0", "serving/config.py",
+         "Default per-request completion deadline (ms); expired queued/"
+         "running requests abort with 504 (per-request deadline_ms body "
+         "field overrides; 0 = none)."),
+    Knob("LLM_FAULT_SPEC", "str", "unset", "serving/config.py",
+         "Deterministic fault injection spec (runtime/faultinject.py), "
+         "e.g. dispatch_error:p=0.05;restore_error:p=0.1;slow_replica:"
+         "idx=1,ms=200 — chaos testing only, never production."),
+    Knob("LLM_FAULT_SEED", "int", "0", "serving/config.py",
+         "Seed for the per-point fault-injection RNG streams (replica i "
+         "offsets by +i)."),
     Knob("LLM_PREFIX_CACHING", "bool", "0", "serving/config.py",
          "Content-addressed reuse of full prompt blocks."),
     Knob("LLM_HOST_CACHE_GB", "float", "0", "serving/config.py",

@@ -1,0 +1,229 @@
+#!/usr/bin/env python3
+"""Chaos soak driver for the round-9 fault-tolerant serving plane.
+
+Engine-level A/B isolated from the HTTP layer: the SAME churn workload
+(more requests than seats, mixed greedy/seeded sampling, mixed stop
+lengths) runs twice — `clean` (no faults, no deadlines, unbounded queue)
+and `chaos` (a seeded LLM_FAULT_SPEC-style spec plus a bounded queue and
+per-request deadlines on a slice of the workload). One JSON line per arm:
+
+    {"mode": "clean"|"chaos", "completed": N, "errored": N, "shed": N,
+     "deadline_expired": N, "dispatch_failures": N, "all_terminated": true,
+     "unaffected_identical": true, ...}
+
+Gates (the acceptance criteria of ISSUE 8, machine-checked here and in
+tests/test_scripts.py::test_chaos_ab_smoke):
+
+  * all_terminated      — every request reached a terminal state (completed,
+                          shed, deadline, or structured error); none hung.
+  * unaffected_identical — every request that COMPLETED under chaos produced
+                          the clean arm's exact token stream (fault isolation:
+                          a failing batch must not perturb survivors).
+  * faults_accounted    — every fired injection shows up in a counter
+                          (dispatch_failures + restore section's fallbacks).
+
+A second section exercises the host-tier restore fallback: a scenario
+prefix is computed, evicted to the host tier by capacity pressure
+(offload_ab's recipe), then re-requested under restore_error:p=1 — the
+restore degrades to recompute, the completion stays byte-identical, and
+llm_host_restore_fallback_total accounts for it.
+
+Usage: python scripts/dev/chaos_ab.py [n_requests] [prompt_len] [max_tokens]
+Env: CHAOS_AB_MODEL (default: tiny fp32 on cpu, llama-3.2-1b bf16 on tpu),
+     CHAOS_AB_SEATS (default 4 on cpu, 16 on tpu),
+     CHAOS_AB_FAULT_SPEC (default "dispatch_error:p=0.05").
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def run_arm(chaos: bool, *, runner, model_cfg, model: str, dtype: str,
+            seats: int, n_requests: int, prompt_len: int, max_tokens: int,
+            fault_spec: str) -> dict:
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import (
+        FinishReason,
+        SamplingParams,
+    )
+    from agentic_traffic_testing_tpu.runtime.scheduler import QueueFullError
+
+    block_size = 16
+    max_len = max(256, prompt_len + max_tokens + 64)
+    eng = LLMEngine(EngineConfig(
+        model=model, dtype=dtype, max_num_seqs=seats, max_model_len=max_len,
+        block_size=block_size,
+        num_blocks=max(256, seats * (-(-max_len // block_size) + 4)),
+        fault_spec=fault_spec if chaos else "",
+        fault_seed=29,
+        # Bound the queue only in the chaos arm: the clean arm is the
+        # identity baseline and must admit everything.
+        max_queue=n_requests if chaos else 0,
+    ), model_cfg=model_cfg, runner=runner)
+
+    wl = np.random.default_rng(31)  # reseeded per arm: identical workload
+    vocab = model_cfg.vocab_size
+    prompts = [wl.integers(10, vocab - 10, prompt_len).tolist()
+               for _ in range(n_requests)]
+
+    def sampling(i: int) -> SamplingParams:
+        # Mixed greedy/seeded + mixed stop lengths = composition churn;
+        # every 5th request in the chaos arm carries a generous deadline
+        # (loose enough that only a fault-stalled queue can miss it —
+        # the sweep machinery runs either way).
+        deadline = 30_000.0 if (chaos and i % 5 == 4) else None
+        if i % 2 == 0:
+            return SamplingParams(temperature=0.0,
+                                  max_tokens=max_tokens - (i % 3),
+                                  ignore_eos=True, deadline_ms=deadline)
+        return SamplingParams(temperature=0.8, top_k=20, seed=5 + i,
+                              max_tokens=max_tokens // 2 + (i % 4),
+                              ignore_eos=True, deadline_ms=deadline)
+
+    reqs, shed = [], 0
+    for i, p in enumerate(prompts):
+        try:
+            reqs.append(eng.add_request(p, sampling(i)))
+        except QueueFullError:
+            shed += 1
+    t0 = time.monotonic()
+    steps = 0
+    step_cap = 200 * n_requests  # hang backstop: the gate below reports it
+    while eng.has_work() and steps < step_cap:
+        eng.step()
+        steps += 1
+    dt = time.monotonic() - t0
+
+    completed = [r for r in reqs if r.finish_reason in
+                 (FinishReason.STOP, FinishReason.LENGTH)]
+    errored = [r for r in reqs if r.finish_reason is FinishReason.ERROR]
+    deadline = [r for r in reqs if r.finish_reason is FinishReason.DEADLINE]
+    return {
+        "mode": "chaos" if chaos else "clean",
+        "requests": n_requests,
+        "seats": seats,
+        "wall_s": round(dt, 3),
+        "completed": len(completed),
+        "errored": len(errored),
+        "deadline_expired": len(deadline),
+        "shed": shed + eng.num_shed,
+        "dispatch_failures": eng.num_dispatch_failures,
+        "all_terminated": all(r.is_finished() for r in reqs),
+        "outputs": {i: r.output_ids for i, r in enumerate(reqs)
+                    if r.finish_reason in (FinishReason.STOP,
+                                           FinishReason.LENGTH)},
+    }
+
+
+def run_restore_section(*, runner, model_cfg, model: str,
+                        dtype: str) -> dict:
+    """Host-tier restore fallback under restore_error:p=1 (offload_ab's
+    evict-then-rearrive recipe): the re-arrival degrades to recompute,
+    stays byte-identical, and the fallback counter accounts for it."""
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.kv_offload import HostKVStore
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+    block_size, prefix_len = 16, 96
+    num_blocks = (-(-(prefix_len + 32) // block_size) + 3) + 1
+    outs = {}
+    counters = {}
+    for mode in ("restore", "fallback"):
+        eng = LLMEngine(EngineConfig(
+            model=model, dtype=dtype, max_num_seqs=2,
+            max_model_len=prefix_len + 96, block_size=block_size,
+            num_blocks=num_blocks, prefix_caching=True,
+            fault_spec="restore_error:p=1" if mode == "fallback" else "",
+        ), model_cfg=model_cfg, runner=runner,
+            host_store=HostKVStore(int(64e6)))
+        wl = np.random.default_rng(11)
+        vocab = model_cfg.vocab_size
+        scenario = wl.integers(10, vocab - 10, prefix_len).tolist()
+        pressures = [wl.integers(10, vocab - 10, prefix_len).tolist()
+                     for _ in range(3)]
+        sp = lambda: SamplingParams(temperature=0.0, max_tokens=8,
+                                    ignore_eos=True)
+        eng.generate(scenario, sp())
+        for p in pressures:  # evict the scenario's blocks to the host tier
+            eng.generate(p, sp())
+        re_req = eng.generate(scenario, sp())
+        outs[mode] = re_req.generated_ids
+        counters[mode] = eng.num_restore_fallbacks
+    return {
+        "mode": "restore_fallback",
+        "fallbacks": counters["fallback"],
+        "clean_restores_fell_back": counters["restore"],
+        "outputs_match": outs["restore"] == outs["fallback"],
+    }
+
+
+def main(argv=None) -> list[dict]:
+    argv = [int(a) for a in (argv if argv is not None else sys.argv[1:])]
+    n_requests = argv[0] if len(argv) > 0 else 8
+    prompt_len = argv[1] if len(argv) > 1 else 24
+    max_tokens = argv[2] if len(argv) > 2 else 10
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import init_params
+    from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+    platform = jax.devices()[0].platform
+    model = os.environ.get(
+        "CHAOS_AB_MODEL", "llama-3.2-1b" if platform == "tpu" else "tiny")
+    dtype = "bfloat16" if platform == "tpu" else "float32"
+    seats = int(os.environ.get(
+        "CHAOS_AB_SEATS", "16" if platform == "tpu" else "4"))
+    fault_spec = os.environ.get("CHAOS_AB_FAULT_SPEC",
+                                "dispatch_error:p=0.05")
+    model_cfg = resolve_config(model)
+    params = init_params(
+        model_cfg, jax.random.key(0),
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    runner = ModelRunner(model_cfg, params,
+                         decode_steps=1 if platform != "tpu" else 16)
+    print(f"devices: {jax.devices()}  requests={n_requests} seats={seats} "
+          f"model={model} spec={fault_spec!r}", file=sys.stderr, flush=True)
+
+    common = dict(runner=runner, model_cfg=model_cfg, model=model,
+                  dtype=dtype, seats=seats, n_requests=n_requests,
+                  prompt_len=prompt_len, max_tokens=max_tokens,
+                  fault_spec=fault_spec)
+    results = [run_arm(chaos, **common) for chaos in (False, True)]
+    clean_out = results[0].pop("outputs")
+    chaos_out = results[1].pop("outputs")
+    # Identity gate: every request that COMPLETED under chaos matches the
+    # clean arm's stream exactly (failing batches must not perturb
+    # survivors — per-lane sampling keys make recompute deterministic).
+    identical = all(chaos_out[i] == clean_out.get(i) for i in chaos_out)
+    for r in results:
+        r["unaffected_identical"] = identical
+        print(json.dumps(r), flush=True)
+    restore = run_restore_section(runner=runner, model_cfg=model_cfg,
+                                  model=model, dtype=dtype)
+    print(json.dumps(restore), flush=True)
+    results.append(restore)
+    return results
+
+
+if __name__ == "__main__":
+    main()

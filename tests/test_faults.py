@@ -1,0 +1,684 @@
+"""Chaos suite for the round-9 fault-tolerant serving plane.
+
+Covers the ISSUE-8 acceptance gates on CPU:
+  * seeded, deterministic injection per fault point;
+  * zero hung requests under faults (every request terminates);
+  * streams unaffected by a failing batch are token-identical to a
+    fault-free run;
+  * all-knobs-off leaves the hot path untouched (machinery pinned
+    never-invoked);
+  * quarantine → re-admit round trip + retry-once failover;
+  * shed / deadline / fallback metrics account for every injected fault.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from agentic_traffic_testing_tpu.models.config import resolve_config
+from agentic_traffic_testing_tpu.models.llama import init_params
+from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+from agentic_traffic_testing_tpu.runtime.faultinject import (
+    FaultInjector,
+    InjectedFault,
+    parse_fault_spec,
+)
+from agentic_traffic_testing_tpu.runtime.kv_offload import HostKVStore
+from agentic_traffic_testing_tpu.runtime.request import (
+    FinishReason,
+    SamplingParams,
+)
+from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+from agentic_traffic_testing_tpu.runtime.scheduler import QueueFullError
+from agentic_traffic_testing_tpu.serving.replica_pool import (
+    DEGRADED,
+    HEALTHY,
+    QUARANTINED,
+    EnginePool,
+    ReplicaHealth,
+)
+
+MODEL = "tiny"
+DTYPE = "float32"
+
+
+@pytest.fixture(scope="module")
+def runner():
+    """One shared ModelRunner: every engine below reuses its compiled
+    programs (the ab-script idiom), keeping the suite inside the tier-1
+    wall budget."""
+    import jax
+    import jax.numpy as jnp
+
+    cfg = resolve_config(MODEL)
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    return cfg, ModelRunner(cfg, params, decode_steps=1)
+
+
+def make_engine(runner, **kw):
+    model_cfg, r = runner
+    defaults = dict(model=MODEL, dtype=DTYPE, max_num_seqs=4,
+                    max_model_len=256, block_size=16, num_blocks=128)
+    defaults.update(kw)
+    return LLMEngine(EngineConfig(**defaults), model_cfg=model_cfg, runner=r)
+
+
+def churn_prompts(n, length=16):
+    wl = np.random.default_rng(97)
+    return [wl.integers(10, 200, length).tolist() for _ in range(n)]
+
+
+def churn_sampling(i, max_tokens=6):
+    if i % 2 == 0:
+        return SamplingParams(temperature=0.0, max_tokens=max_tokens - (i % 2),
+                              ignore_eos=True)
+    return SamplingParams(temperature=0.8, top_k=20, seed=5 + i,
+                          max_tokens=max_tokens - 2, ignore_eos=True)
+
+
+def drive(eng, reqs, cap=2000):
+    steps = 0
+    while eng.has_work() and steps < cap:
+        eng.step()
+        steps += 1
+    assert steps < cap, "engine failed to drain (hung requests)"
+    return reqs
+
+
+# ---------------------------------------------------------- fault injector
+
+
+def test_fault_spec_grammar():
+    spec = parse_fault_spec(
+        "dispatch_error:p=0.05;restore_error;slow_replica:idx=1,ms=200")
+    assert spec["dispatch_error"] == {"p": 0.05}
+    assert spec["restore_error"] == {"p": 1.0}
+    assert spec["slow_replica"] == {"idx": 1, "ms": 200}
+    for bad in ("bogus", "dispatch_error:p=2", "slow_replica:idx=1",
+                "dispatch_error:p", "restore_error:p=x"):
+        with pytest.raises(ValueError):
+            parse_fault_spec(bad)
+    assert FaultInjector.from_spec("", 0) is None
+    assert FaultInjector.from_spec(None, 0) is None
+
+
+def test_fault_injection_deterministic_per_point():
+    mk = lambda: FaultInjector.from_spec(
+        "dispatch_error:p=0.3;restore_error:p=0.3", seed=11)
+    a, b = mk(), mk()
+    seq_a = [(a.fire("dispatch_error"), a.fire("restore_error"))
+             for _ in range(50)]
+    seq_b = [(b.fire("dispatch_error"), b.fire("restore_error"))
+             for _ in range(50)]
+    assert seq_a == seq_b  # same seed -> identical per-point streams
+    assert a.fired == b.fired and a.fired["dispatch_error"] > 0
+    # Unconfigured points never fire and never perturb configured streams.
+    c = FaultInjector.from_spec("dispatch_error:p=0.3", seed=11)
+    interleaved = []
+    for _ in range(50):
+        assert c.fire("restore_error") is False
+        interleaved.append(c.fire("dispatch_error"))
+    assert interleaved == [x[0] for x in seq_a]
+    with pytest.raises(InjectedFault):
+        FaultInjector.from_spec("dispatch_error", 0).maybe_raise(
+            "dispatch_error")
+
+
+# ------------------------------------------------------- engine isolation
+
+
+def test_defaults_touch_no_robustness_machinery(runner, monkeypatch):
+    """All-knobs-off pin: a default engine constructs NO fault injector,
+    tracks NO deadlines, bounds NO queue, and never enters the failure
+    handlers — the hot path is the pre-round-9 one."""
+    def boom(*a, **k):
+        raise AssertionError("robustness machinery touched at defaults")
+
+    monkeypatch.setattr(LLMEngine, "_fail_dispatch", boom)
+    monkeypatch.setattr(LLMEngine, "_restore_fallback", boom)
+    monkeypatch.setattr(FaultInjector, "__init__", boom)
+    eng = make_engine(runner)
+    assert eng._faults is None and not eng._deadline_ids
+    assert eng.scheduler.cfg.max_queue == 0
+    req = eng.generate(churn_prompts(1)[0], churn_sampling(0))
+    assert req.finish_reason is FinishReason.LENGTH
+    assert (eng.num_dispatch_failures, eng.num_deadline_expired,
+            eng.num_restore_fallbacks, eng.num_shed) == (0, 0, 0, 0)
+
+
+def test_dispatch_fault_fails_only_its_batch(runner):
+    """Seeded dispatch faults: deterministic failure pattern, every
+    request terminates, and survivors are token-identical to a fault-free
+    run of the same workload."""
+    prompts = churn_prompts(8)
+
+    def run(spec):
+        eng = make_engine(runner, fault_spec=spec, fault_seed=29)
+        reqs = [eng.add_request(p, churn_sampling(i))
+                for i, p in enumerate(prompts)]
+        drive(eng, reqs)
+        return eng, reqs
+
+    _, clean = run("")
+    assert all(r.finish_reason in (FinishReason.STOP, FinishReason.LENGTH)
+               for r in clean)
+    eng_a, chaos_a = run("dispatch_error:p=0.05")
+    eng_b, chaos_b = run("dispatch_error:p=0.05")
+
+    # Deterministic: the same requests fail on both chaos runs.
+    pattern = [r.finish_reason for r in chaos_a]
+    assert pattern == [r.finish_reason for r in chaos_b]
+    assert eng_a.num_dispatch_failures == eng_b.num_dispatch_failures > 0
+    errored = [r for r in chaos_a if r.finish_reason is FinishReason.ERROR]
+    survived = [r for r in chaos_a
+                if r.finish_reason in (FinishReason.STOP, FinishReason.LENGTH)]
+    assert errored and survived, "need both failures and survivors"
+    for r in errored:
+        assert r.is_finished() and "dispatch failed" in (r.error or "")
+    # Fault isolation: survivors match the clean streams exactly.
+    for r, c in zip(chaos_a, clean):
+        if r in survived:
+            assert r.output_ids == c.output_ids
+
+
+def test_dispatch_fault_events_reach_streams(runner):
+    """The failing batch's requests surface FINISHED error events through
+    the normal flush (the async layer forwards these as terminal stream
+    events — no silent truncation)."""
+    eng = make_engine(runner, fault_spec="dispatch_error:p=1")
+    req = eng.add_request(churn_prompts(1)[0], churn_sampling(0))
+    events = eng.step()
+    assert [e.request.request_id for e in events if e.finished] == \
+        [req.request_id]
+    assert req.finish_reason is FinishReason.ERROR
+    assert not eng.has_work()  # state reconciled: nothing left to serve
+
+
+# ------------------------------------------------------ deadlines + queue
+
+
+def test_deadline_expires_queued_and_running(runner):
+    eng = make_engine(runner, max_num_seqs=1)
+    # Two requests: one runs, one waits; both carry a microscopic deadline.
+    sp = lambda: SamplingParams(temperature=0.0, max_tokens=64,
+                                ignore_eos=True, deadline_ms=0.1)
+    reqs = [eng.add_request(p, sp()) for p in churn_prompts(2)]
+    assert len(eng._deadline_ids) == 2
+    time.sleep(0.005)
+    drive(eng, reqs)
+    assert [r.finish_reason for r in reqs] == [FinishReason.DEADLINE] * 2
+    assert eng.num_deadline_expired == 2
+    assert all("deadline exceeded" in r.error for r in reqs)
+    assert not eng._deadline_ids and not eng.has_work()
+
+
+def test_deadline_default_knob_applies(runner):
+    eng = make_engine(runner, deadline_ms=0.1)
+    req = eng.add_request(churn_prompts(1)[0],
+                          SamplingParams(max_tokens=64, ignore_eos=True))
+    time.sleep(0.005)
+    drive(eng, [req])
+    assert req.finish_reason is FinishReason.DEADLINE
+    # Per-request override beats the engine default.
+    eng2 = make_engine(runner, deadline_ms=0.1)
+    req2 = eng2.add_request(
+        churn_prompts(1)[0],
+        SamplingParams(temperature=0.0, max_tokens=4, ignore_eos=True,
+                       deadline_ms=60_000.0))
+    drive(eng2, [req2])
+    assert req2.finish_reason is FinishReason.LENGTH
+
+
+def test_bounded_queue_sheds(runner):
+    eng = make_engine(runner, max_queue=2)
+    prompts = churn_prompts(4)
+    for p in prompts[:2]:
+        eng.add_request(p, churn_sampling(0))
+    with pytest.raises(QueueFullError):
+        eng.add_request(prompts[2], churn_sampling(0))
+    assert eng.num_shed == 1
+    # Admitted work is never dropped: draining frees the queue again.
+    drive(eng, [])
+    eng.add_request(prompts[3], churn_sampling(0))
+    drive(eng, [])
+
+
+# -------------------------------------------------- host-restore fallback
+
+
+def _evict_and_rearrive(runner, fault_spec):
+    """offload_ab's recipe: compute a scenario prefix, evict it to the
+    host tier via capacity pressure, re-request it."""
+    model_cfg, _ = runner
+    prefix_len, bs = 96, 16
+    eng = make_engine(
+        runner, max_num_seqs=2, max_model_len=prefix_len + 96,
+        num_blocks=(-(-(prefix_len + 32) // bs) + 3) + 1,
+        prefix_caching=True, host_cache_gb=0.05, fault_spec=fault_spec)
+    wl = np.random.default_rng(11)
+    scenario = wl.integers(10, 200, prefix_len).tolist()
+    pressures = [wl.integers(10, 200, prefix_len).tolist() for _ in range(3)]
+    sp = lambda: SamplingParams(temperature=0.0, max_tokens=6,
+                                ignore_eos=True)
+    eng.generate(scenario, sp())
+    for p in pressures:
+        eng.generate(p, sp())
+    re_req = eng.generate(scenario, sp())
+    return eng, re_req
+
+
+def test_restore_error_degrades_to_recompute(runner):
+    eng_ok, clean = _evict_and_rearrive(runner, "")
+    assert eng_ok.num_restore_fallbacks == 0
+    assert eng_ok.host_restore_bytes > 0, "recipe must actually restore"
+    eng, re_req = _evict_and_rearrive(runner, "restore_error:p=1")
+    assert eng.num_restore_fallbacks >= 1
+    assert re_req.finish_reason in (FinishReason.STOP, FinishReason.LENGTH)
+    assert re_req.generated_ids == clean.generated_ids
+    # The offending entries were invalidated: no restore was applied.
+    assert eng.host_restore_bytes == 0
+
+
+def test_corrupt_host_block_degrades_to_miss():
+    store = HostKVStore(1 << 20)
+    k = np.ones((2, 1, 16, 4), np.float32)
+    assert store.put(1, (1, 2), k, k)
+    assert store.get(1, (1, 2)) is not None
+    # Corrupt the entry in place (simulates host-RAM rot / writer bug).
+    store._entries[1].k = np.ones((2, 1, 8, 4), np.float32)
+    assert store.get(1, (1, 2)) is None          # miss, not an exception
+    assert store.corrupt_dropped == 1 and len(store) == 0
+    # Geometry attestation: a later put of a different shape is refused.
+    assert store.put(2, (3, 4), k, k)
+    assert not store.put(3, (5, 6), k[:, :, :8], k[:, :, :8])
+    assert store.invalidate(2) and not store.invalidate(2)
+    stats = store.stats()
+    # Explicit invalidations (restore fallback) are NOT corruption.
+    assert stats["host_cache_corrupt_dropped"] == 2
+    assert stats["host_cache_invalidated_blocks"] == 1
+
+
+# ------------------------------------------------- replica health + pool
+
+
+def test_replica_health_state_machine():
+    h = ReplicaHealth(error_threshold=2, watchdog_s=0.05, cooldown_s=0.02)
+    assert h.state == HEALTHY and h.eligible()
+    h.record_error()
+    assert h.state == DEGRADED and h.eligible()
+    h.record_ok()
+    assert h.state == HEALTHY
+    h.record_error()
+    h.record_error()
+    assert h.state == QUARANTINED and not h.eligible()
+    until_1 = h.quarantined_until
+    time.sleep(0.03)
+    assert h.eligible()          # cooldown lapsed: lazily eligible again
+    assert h.probe()             # background probe: -> probation
+    assert h.state == DEGRADED
+    h.record_error()             # one probation error -> re-quarantined
+    assert h.state == QUARANTINED
+    assert h.quarantined_until - time.monotonic() > until_1 - time.monotonic()
+    time.sleep(0.05)
+    assert h.probe()
+    h.record_ok()                # clean probation step -> healthy
+    assert h.state == HEALTHY and h.consecutive_errors == 0
+
+
+def test_lazy_readmission_drives_probation():
+    """eligible() re-admits a quarantined replica once its cooldown
+    lapses, possibly before any probe() tick (or with no probe loop at
+    all). Step outcomes on that lazily re-admitted work must drive the
+    machine exactly like post-probe probation: an error re-quarantines
+    with doubled backoff, a clean step heals — neither dead-ends in
+    QUARANTINED."""
+    h = ReplicaHealth(error_threshold=2, cooldown_s=0.02)
+    h.record_error()
+    h.record_error()
+    assert h.state == QUARANTINED and h.num_quarantines == 1
+    time.sleep(0.03)
+    assert h.eligible()          # lazy re-admission, NO probe() call
+    h.record_error()             # probation error -> re-quarantined
+    assert h.state == QUARANTINED and h.num_quarantines == 2
+    time.sleep(0.05)
+    assert h.eligible()
+    h.record_ok()                # clean lazily-probed step -> healthy
+    assert h.state == HEALTHY and h.consecutive_errors == 0
+
+
+def test_depth_at_enqueue_stamped_per_replica(runner):
+    """The scheduler stamps each request with the waiting-queue depth it
+    actually joined behind (its OWN replica's, not a pool minimum) — the
+    basis the serving layer's per-slot wait EWMA divides by."""
+    eng = make_engine(runner, max_num_seqs=1)
+    prompts = churn_prompts(3)
+    reqs = [eng.add_request(p, SamplingParams(max_tokens=2, ignore_eos=True))
+            for p in prompts]
+    assert [r.depth_at_enqueue for r in reqs] == [0, 1, 2]
+    while eng.has_work():
+        eng.step()
+
+
+def test_replica_watchdog_quarantines_stuck_step():
+    h = ReplicaHealth(error_threshold=3, watchdog_s=0.02, cooldown_s=10.0)
+    h.step_started()
+    assert not h.check_stuck()   # not past the timeout yet
+    time.sleep(0.03)
+    assert h.check_stuck() and h.state == QUARANTINED
+    # The wedge resolving (step completes cleanly) lifts the quarantine.
+    h.step_done()
+    h.record_ok()
+    assert h.state == HEALTHY
+
+
+def test_pool_quarantine_failover_and_readmit(runner):
+    """2-replica pool, replica 1 fault-injected to fail every dispatch:
+    un-started requests retry once onto replica 0 (no hung streams),
+    replica 1 quarantines, its load is absorbed, and after the fault
+    clears the probe re-admits it and it serves again."""
+    model_cfg, r = runner
+
+    def factory(i):
+        return LLMEngine(EngineConfig(
+            model=MODEL, dtype=DTYPE, max_num_seqs=4, max_model_len=256,
+            block_size=16, num_blocks=128,
+            fault_spec="dispatch_error:p=1" if i == 1 else "",
+            fault_seed=i), model_cfg=model_cfg, runner=r)
+
+    pool = EnginePool.build(
+        factory, 2, policy="round_robin",
+        health_params=dict(error_threshold=1, cooldown_s=0.05,
+                           watchdog_s=0.0))
+    pool.start()
+    try:
+        async def go():
+            prompts = churn_prompts(4)
+            outs = []
+            for i, p in enumerate(prompts):
+                toks = []
+                async for ev in pool.generate(p, churn_sampling(i),
+                                              request_id=f"r{i}"):
+                    toks.extend(ev.new_token_ids)
+                    if ev.finished:
+                        assert ev.request.finish_reason in (
+                            FinishReason.STOP, FinishReason.LENGTH), \
+                            ev.request.error
+                outs.append(toks)
+            return outs
+
+        outs = asyncio.run(go())
+        assert all(outs), "every stream must deliver tokens"
+        assert pool.request_retries >= 1
+        assert pool.health[1].state == QUARANTINED
+        assert pool.health[0].state == HEALTHY
+        # Quarantined replica is skipped while its cooldown holds.
+        pool.health[1].quarantined_until = time.monotonic() + 60
+        assert pool.eligible_replicas() == [0]
+
+        # Fault clears (the "repaired chip"); probe re-admits after
+        # cooldown and the replica serves again.
+        pool.engines[1]._faults = None
+        pool.health[1].quarantined_until = time.monotonic()
+        assert pool.health_probe() == 1
+        assert pool.health[1].state == DEGRADED
+
+        async def direct():
+            toks = []
+            async for ev in pool._async[1].generate(
+                    churn_prompts(1)[0], churn_sampling(0), "re"):
+                toks.extend(ev.new_token_ids)
+                if ev.finished:
+                    return toks, ev.request.finish_reason
+
+        toks, reason = asyncio.run(direct())
+        assert toks and reason in (FinishReason.STOP, FinishReason.LENGTH)
+        assert pool.health[1].state == HEALTHY  # clean probation step
+    finally:
+        pool.shutdown()
+
+
+# --------------------------------------------------------- HTTP contract
+
+
+@pytest.fixture(scope="module")
+def server():
+    from agentic_traffic_testing_tpu.serving.config import ServerConfig
+    from agentic_traffic_testing_tpu.serving.server import LLMServer
+
+    cfg = ServerConfig(model="tiny", dtype="float32", max_num_seqs=4,
+                       max_model_len=256, num_blocks=128, max_tokens=8,
+                       temperature=0.0, warmup=False)
+    srv = LLMServer(cfg)
+    srv.async_engine.start()
+    yield srv
+    srv.async_engine.shutdown()
+
+
+def _http(server, coro_fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    async def wrapper():
+        app = server.make_app(manage_engine=False)
+        async with TestClient(TestServer(app)) as client:
+            return await coro_fn(client)
+
+    return asyncio.run(wrapper())
+
+
+def test_http_queue_full_shed(server, monkeypatch):
+    """Bounded-queue shedding: 503 + Retry-After + structured reason, and
+    llm_requests_shed_total{reason="queue_full"} increments."""
+    monkeypatch.setattr(server.cfg, "max_queue", 1)
+    monkeypatch.setattr(server, "_queue_depth", lambda: 5)
+
+    async def go(client):
+        resp = await client.post("/chat", json={"prompt": "hi"})
+        assert resp.status == 503
+        assert int(resp.headers["Retry-After"]) >= 1
+        assert (await resp.json())["reason"] == "queue_full"
+        m = await client.get("/metrics")
+        text = (await m.read()).decode()
+        assert 'llm_requests_shed_total{reason="queue_full"} 1.0' in text
+
+    _http(server, go)
+
+
+def test_http_slo_projection_shed(server, monkeypatch):
+    """SLO-aware shedding: a projected queue wait past the request's TTFT
+    class rejects with 429 before the request costs a queue slot."""
+    monkeypatch.setattr(server, "_wait_per_slot", 10.0)  # 10 s per slot
+
+    async def go(client):
+        resp = await client.post(
+            "/chat", json={"prompt": "hi", "slo_ttft_ms": 50})
+        assert resp.status == 429
+        body = await resp.json()
+        assert body["reason"] == "slo_unattainable"
+        resp = await client.post(
+            "/chat", json={"prompt": "hi", "deadline_ms": 50})
+        assert resp.status == 429
+        assert (await resp.json())["reason"] == "deadline_unattainable"
+        m = await client.get("/metrics")
+        text = (await m.read()).decode()
+        assert 'llm_requests_shed_total{reason="slo_unattainable"} 1.0' in text
+        assert ('llm_requests_shed_total{reason="deadline_unattainable"} 1.0'
+                in text)
+
+    _http(server, go)
+
+
+def test_http_deadline_504_and_metric(server, monkeypatch):
+    monkeypatch.setattr(server, "_wait_per_slot", None)  # never shed
+
+    async def go(client):
+        resp = await client.post(
+            "/chat", json={"prompt": "hi", "deadline_ms": 0.1,
+                           "max_tokens": 64})
+        assert resp.status == 504
+        body = await resp.json()
+        assert body["reason"] == "deadline"
+        assert "deadline exceeded" in body["error"]
+        m = await client.get("/metrics")
+        text = (await m.read()).decode()
+        import re
+
+        val = re.search(r"llm_request_deadline_exceeded_total (\d+)", text)
+        assert val and int(val.group(1)) >= 1
+
+    _http(server, go)
+
+
+def _sse_events(raw: bytes) -> list:
+    import json as _json
+
+    return [_json.loads(line[len(b"data: "):])
+            for line in raw.split(b"\n\n") if line.startswith(b"data: ")]
+
+
+def test_sse_stream_success_terminal(server, monkeypatch):
+    monkeypatch.setattr(server, "_wait_per_slot", None)
+
+    async def go(client):
+        resp = await client.post(
+            "/chat", json={"prompt": "hi", "stream": True, "max_tokens": 4})
+        assert resp.status == 200
+        assert resp.headers["Content-Type"].startswith("text/event-stream")
+        events = _sse_events(await resp.read())
+        assert events, "stream must carry events"
+        assert all(ev["finished"] is False for ev in events[:-1])
+        final = events[-1]
+        assert final["finished"] is True and "error" not in final
+        assert final["meta"]["completion_tokens"] >= 1
+        assert sum(len(ev.get("token_ids", [])) for ev in events[:-1]) \
+            == final["meta"]["completion_tokens"]
+
+    _http(server, go)
+
+
+def test_sse_stream_text_matches_nonstream(server, monkeypatch):
+    """The concatenation of every SSE `text` field (terminal tail
+    included) equals the non-stream output for the same greedy request.
+    In particular a multibyte sequence split across token boundaries
+    must stream as its resolved character once complete — never as a
+    replacement char frozen into the client's transcript (deltas come
+    from the decoder's stable prefix, not a slice of the unstable
+    tail)."""
+    monkeypatch.setattr(server, "_wait_per_slot", None)
+
+    async def go(client):
+        body = {"prompt": "hello robustness", "max_tokens": 8,
+                "temperature": 0.0}
+        resp = await client.post("/chat", json=body)
+        assert resp.status == 200
+        plain = (await resp.json())["output"]
+        resp = await client.post("/chat", json=dict(body, stream=True))
+        assert resp.status == 200
+        events = _sse_events(await resp.read())
+        assert events[-1]["finished"] is True
+        streamed = "".join(ev.get("text", "") for ev in events)
+        assert streamed == plain
+
+    _http(server, go)
+
+
+def test_wedged_replica_stays_ineligible_after_cooldown():
+    """A replica still inside the overlong step that got it quarantined
+    must NOT become routing-eligible (or probe-re-admitted) when its
+    cooldown lapses — work routed there would hang with no terminal
+    event, defeating the zero-hung-requests gate. The wedge resolving
+    (step_done) restores the normal lazy re-admission."""
+    h = ReplicaHealth(error_threshold=3, watchdog_s=0.02, cooldown_s=0.01)
+    h.step_started()
+    time.sleep(0.03)
+    assert h.check_stuck() and h.state == QUARANTINED
+    time.sleep(0.02)                 # cooldown lapsed; step STILL running
+    assert not h.eligible()
+    assert not h.probe()
+    h.step_done()                    # wedge resolves
+    assert h.eligible()
+    assert h.probe() and h.state == DEGRADED
+
+
+def test_slow_replica_wired_on_single_engine_server(runner):
+    """`slow_replica:idx=0` must inject on a 1-replica server too — only
+    EnginePool wired the delay before, so a valid spec against the
+    single-engine path passed validation yet injected nothing (the
+    silent-no-injection mode faultinject.py forbids)."""
+    from agentic_traffic_testing_tpu.serving.config import ServerConfig
+    from agentic_traffic_testing_tpu.serving.server import LLMServer
+
+    cfg = ServerConfig(model=MODEL, dtype=DTYPE, max_num_seqs=4,
+                       max_model_len=256, num_blocks=128, warmup=False,
+                       fault_spec="slow_replica:idx=0,ms=50")
+    srv = LLMServer(cfg, engine=make_engine(runner))
+    assert srv.async_engine.step_delay_s == pytest.approx(0.05)
+
+
+def test_sse_stream_failure_has_terminal_event(server, monkeypatch):
+    """The round-9 satellite: a failed generation must end the SSE stream
+    with a structured {"error": ..., "finished": true} terminal event, so
+    truncation is distinguishable from completion."""
+    monkeypatch.setattr(server, "_wait_per_slot", None)
+
+    async def go(client):
+        resp = await client.post(
+            "/chat", json={"prompt": "hi", "stream": True, "max_tokens": 64,
+                           "deadline_ms": 0.1})
+        assert resp.status == 200  # stream already committed: error rides SSE
+        events = _sse_events(await resp.read())
+        final = events[-1]
+        assert final["finished"] is True
+        assert "deadline exceeded" in final["error"]
+        assert final["reason"] == "deadline"
+
+    _http(server, go)
+
+
+def test_started_streams_never_retry(runner):
+    """A stream that already emitted tokens gets its error terminal
+    passed through instead of a retry (no silent token replay)."""
+    model_cfg, r = runner
+
+    def factory(i):
+        return LLMEngine(EngineConfig(
+            model=MODEL, dtype=DTYPE, max_num_seqs=4, max_model_len=256,
+            block_size=16, num_blocks=128), model_cfg=model_cfg, runner=r)
+
+    pool = EnginePool.build(factory, 2, policy="round_robin")
+    pool.start()
+    try:
+        async def go():
+            # Poison the owning engine AFTER the prefill emitted the first
+            # token: decode dispatches then fail, mid-stream.
+            ev_reasons, toks = [], []
+            first = True
+            async for ev in pool.generate(
+                    churn_prompts(1)[0],
+                    SamplingParams(temperature=0.0, max_tokens=32,
+                                   ignore_eos=True), request_id="mid"):
+                toks.extend(ev.new_token_ids)
+                if first and toks:
+                    first = False
+                    from agentic_traffic_testing_tpu.runtime.faultinject import (
+                        FaultInjector,
+                    )
+
+                    for e in pool.engines:
+                        e._faults = FaultInjector.from_spec(
+                            "dispatch_error:p=1", 0)
+                if ev.finished:
+                    ev_reasons.append(ev.request.finish_reason)
+            return ev_reasons, toks
+
+        reasons, toks = asyncio.run(go())
+        assert toks, "stream started"
+        assert reasons == [FinishReason.ERROR]
+        assert pool.request_retries == 0
+    finally:
+        for e in pool.engines:
+            e._faults = None
+        pool.shutdown()

@@ -306,6 +306,32 @@ def test_decode_overlap_ab_smoke(monkeypatch):
         assert r["decode_toks_s"] > 0
 
 
+# --------------------------------------------------------- chaos soak A/B
+
+
+def test_chaos_ab_smoke(monkeypatch):
+    """scripts/dev/chaos_ab.py end-to-end on the tiny model: the clean arm
+    completes everything, the chaos arm injects at least one dispatch
+    fault yet every request terminates and the surviving completions are
+    token-identical to the clean arm; the restore section degrades a
+    fault-injected host-tier restore to a byte-identical recompute
+    (in-process for the warm jax/conftest CPU config, like router_ab)."""
+    monkeypatch.setenv("CHAOS_AB_MODEL", "tiny")
+    monkeypatch.setenv("CHAOS_AB_SEATS", "4")
+    chaos_ab = load_script("scripts/dev/chaos_ab.py", "chaos_ab")
+    clean, chaos, restore = chaos_ab.main(["8", "24", "10"])
+    assert (clean["mode"], chaos["mode"]) == ("clean", "chaos")
+    assert clean["completed"] == 8 and clean["dispatch_failures"] == 0
+    assert chaos["dispatch_failures"] >= 1
+    assert chaos["completed"] >= 1 and chaos["errored"] >= 1
+    assert chaos["all_terminated"] and clean["all_terminated"]
+    assert chaos["unaffected_identical"] is True
+    assert restore["mode"] == "restore_fallback"
+    assert restore["fallbacks"] >= 1
+    assert restore["clean_restores_fell_back"] == 0
+    assert restore["outputs_match"] is True
+
+
 # ------------------------------------------------ step-clock timeline dump
 
 
