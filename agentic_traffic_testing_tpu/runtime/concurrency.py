@@ -1,0 +1,212 @@
+"""Runtime ownership sanitizer (`LLM_CONCURRENCY_CHECK=1`).
+
+The static half of the concurrency plane (statics/concurrency.py) proves
+the *declared* thread discipline lexically; this module asserts it on the
+*live* process, compiled from the SAME registry
+(statics/ownership_registry.py): `install()` wraps `__setattr__` on every
+registered class so that
+
+  * a context-owned attribute (e.g. every LLMEngine counter, owner
+    `engine-loop`) binds to the first thread that writes it after
+    construction and raises `OwnershipViolation` on a write from any
+    other thread — binding (rather than thread *names*) makes both
+    serving mode (the AsyncLLMEngine dispatch thread owns the engine)
+    and sync bench/test mode (the driving thread IS the engine loop)
+    assert correctly;
+  * a lock-guarded attribute (e.g. every ReplicaHealth field, lock
+    `_mu`) raises when written while its declared lock is not held.
+    Caveat: a plain `threading.Lock` cannot report WHO holds it, so the
+    assertion is `lock.locked()` — it catches writes while the lock is
+    idle (the common unguarded-write bug) but not a racy write landing
+    while ANOTHER thread legitimately holds the lock. The static
+    checker's lexical containment rule is the sound half of that
+    guarantee; this runtime check is its best-effort shadow.
+
+Off by default and ZERO cost when off: `maybe_install()` is one
+`os.environ` read at engine construction — with the knob unset no class
+is touched, no wrapper exists, and the hot loop is byte-identical
+(tests/test_statics_concurrency.py pins the class dicts untouched).
+When on, every attribute write pays one dict lookup — a debugging mode
+for churn/chaos tests (tests_faults-style workloads double as a dynamic
+race detector), never production serving.
+
+Ownership is asserted per OS thread; contexts that share the event-loop
+thread (`handler` / `health-probe` / `scrape`) form one thread class
+(`ownership_registry.THREAD_CLASS`) — distinguishing them is the static
+checker's job. Container mutations (`self.x.append(...)`) don't pass
+through `__setattr__` and stay checker-only; rebinds and augmented
+assignments (every counter) are asserted here.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_INSTALLED: list = []       # (cls, had_setattr, orig_setattr, had_init, orig_init)
+num_checks = 0              # writes inspected (cheap observability for tests)
+num_violations = 0          # raised OwnershipViolations (pre-raise count)
+
+_INIT_FLAG = "_concurrency_in_init"
+_BIND_FLAG = "_concurrency_owner_threads"
+
+
+class OwnershipViolation(AssertionError):
+    """A registered attribute was written from the wrong thread / outside
+    its declared lock while LLM_CONCURRENCY_CHECK=1."""
+
+
+def enabled() -> bool:
+    # Same accepted truthy spellings as serving/config.py's _env_bool —
+    # "false"/"off"/"no" must not install a production sanitizer.
+    return os.environ.get("LLM_CONCURRENCY_CHECK", "0").lower() in (
+        "1", "true", "yes", "on")
+
+
+def installed() -> bool:
+    return bool(_INSTALLED)
+
+
+def maybe_install() -> bool:
+    """Install the sanitizer iff the knob is on (idempotent). Called once
+    per engine construction — with the knob off this is a single env
+    read and nothing else happens."""
+    if not enabled():
+        return False
+    install()
+    return True
+
+
+def _build_specs() -> dict:
+    """class name -> {attr: ("ctx", thread_class) | ("lock", lock_name)}
+    from the shared ownership registry (imported lazily: with the
+    sanitizer off the statics package never loads)."""
+    from agentic_traffic_testing_tpu.statics.ownership_registry import (
+        ANY,
+        INIT,
+        OWNED_ATTRS,
+        THREAD_CLASS,
+    )
+
+    specs: dict[str, dict] = {}
+    for a in OWNED_ATTRS:
+        if a.lock:
+            spec = ("lock", a.lock)
+        elif a.owner in (ANY, INIT):
+            # `any` is a documented multi-context contract; `init` writes
+            # happen before publication — neither is thread-assertable.
+            continue
+        else:
+            spec = ("ctx", THREAD_CLASS[a.owner])
+        specs.setdefault(a.cls, {})[a.attr] = spec
+    return specs
+
+
+def _wrap_class(cls, attr_specs: dict) -> None:
+    orig_setattr = cls.__setattr__
+    orig_init = cls.__init__
+    had_setattr = "__setattr__" in cls.__dict__
+    had_init = "__init__" in cls.__dict__
+
+    def init(self, *args, **kwargs):
+        d = object.__getattribute__(self, "__dict__")
+        d[_INIT_FLAG] = True
+        try:
+            orig_init(self, *args, **kwargs)
+        finally:
+            d.pop(_INIT_FLAG, None)
+
+    def setattr_(self, name, value):
+        spec = attr_specs.get(name)
+        if spec is not None:
+            d = object.__getattribute__(self, "__dict__")
+            if _INIT_FLAG not in d:
+                global num_checks, num_violations
+                num_checks += 1
+                kind, want = spec
+                if kind == "lock":
+                    # An attribute-CREATING write is construction, even
+                    # without the init flag: install() can land mid-way
+                    # through an enclosing __init__ (the server builds
+                    # its engine — which installs — before its own later
+                    # fields), so the first write of each field must not
+                    # assert.
+                    lock = d.get(want) if name in d else None
+                    if lock is not None and not lock.locked():
+                        num_violations += 1
+                        raise OwnershipViolation(
+                            f"{type(self).__name__}.{name} written without "
+                            f"holding {want} (declared in "
+                            f"statics/ownership_registry.py)")
+                else:
+                    me = threading.current_thread()
+                    binds = d.get(_BIND_FLAG)
+                    if binds is None:
+                        binds = d[_BIND_FLAG] = {}
+                    owner = binds.get(want)
+                    if owner is None:
+                        binds[want] = me
+                    elif owner is not me:
+                        num_violations += 1
+                        raise OwnershipViolation(
+                            f"{type(self).__name__}.{name} is owned by the "
+                            f"'{want}' thread class, bound to "
+                            f"{owner.name!r}, but was written from "
+                            f"{me.name!r} — a cross-thread write the "
+                            f"ownership registry forbids")
+        orig_setattr(self, name, value)
+
+    cls.__init__ = init
+    cls.__setattr__ = setattr_
+    _INSTALLED.append((cls, had_setattr, orig_setattr, had_init, orig_init))
+
+
+def install() -> int:
+    """Wrap every importable registered class; returns how many were
+    wrapped. Idempotent. Classes whose module cannot import in this
+    environment (e.g. aiohttp missing for LLMServer) are skipped — the
+    sanitizer must never make a deployment less runnable than the code
+    it audits."""
+    if _INSTALLED:
+        return len(_INSTALLED)
+    import importlib
+
+    from agentic_traffic_testing_tpu.statics.ownership_registry import (
+        REGISTERED_CLASSES,
+    )
+
+    specs = _build_specs()
+    for cls_name, path in REGISTERED_CLASSES.items():
+        attr_specs = specs.get(cls_name)
+        if not attr_specs:
+            continue
+        mod_name, _, qual = path.partition(":")
+        try:
+            cls = getattr(importlib.import_module(mod_name), qual)
+        except Exception:
+            continue
+        _wrap_class(cls, attr_specs)
+    return len(_INSTALLED)
+
+
+def uninstall() -> None:
+    """Restore every wrapped class (tests MUST call this — the wrap is
+    class-global and would otherwise leak across the suite)."""
+    while _INSTALLED:
+        cls, had_setattr, orig_setattr, had_init, orig_init = _INSTALLED.pop()
+        if had_setattr:
+            cls.__setattr__ = orig_setattr
+        else:
+            del cls.__setattr__
+        if had_init:
+            cls.__init__ = orig_init
+        else:
+            del cls.__init__
+
+
+def rebind(obj) -> None:
+    """Forget an object's thread bindings (the publication handover:
+    AsyncLLMEngine.start() hands an engine from the constructing thread
+    to its real engine-loop thread, which then binds on its first
+    write)."""
+    object.__getattribute__(obj, "__dict__").pop(_BIND_FLAG, None)

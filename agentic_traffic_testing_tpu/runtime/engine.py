@@ -424,6 +424,14 @@ class LLMEngine:
         runner: Optional[ModelRunner] = None,
         host_store=None,
     ) -> None:
+        # Runtime ownership sanitizer (LLM_CONCURRENCY_CHECK=1): installs
+        # __setattr__ assertions compiled from statics/ownership_registry
+        # on the serving-plane classes. Off (default) = one env read here
+        # and NOTHING else — no wrapper exists, the hot loop is
+        # byte-identical (pinned by tests/test_statics_concurrency.py).
+        from agentic_traffic_testing_tpu.runtime import concurrency
+
+        concurrency.maybe_install()
         self.cfg = cfg
         self.model_cfg = model_cfg or resolve_config(cfg.model)
         if (cfg.moe_capacity_factor is not None and self.model_cfg.num_experts
@@ -659,6 +667,7 @@ class LLMEngine:
         self.scheduler.on_admit = self._record_admission
         return self.telemetry
 
+    # statics: thread(engine-loop)
     def _record_admission(self, req: Request) -> None:
         """Scheduler admission callback (wired only when tracing): the
         exact instant a request turned RUNNING, with its cached-token
@@ -858,6 +867,7 @@ class LLMEngine:
 
     # -- request API -------------------------------------------------------
 
+    # statics: thread(engine-loop)
     def add_request(
         self,
         prompt_ids: list[int],
@@ -887,6 +897,7 @@ class LLMEngine:
             self.telemetry.request_queued(req.request_id, req.arrival_time)
         return req
 
+    # statics: thread(engine-loop)
     def abort_request(self, req: Request) -> list[StepOutput]:
         """Abort one request. Returns any SIBLING events the abort produced:
         the drain applies in-flight tokens, which can finish other lanes —
@@ -930,6 +941,7 @@ class LLMEngine:
 
     # -- the step loop -----------------------------------------------------
 
+    # statics: thread(engine-loop)
     def step(self) -> list[StepOutput]:
         """Advance by one device dispatch (or drain); return request events."""
         self.num_steps += 1
@@ -1266,6 +1278,7 @@ class LLMEngine:
 
     # -- host-tier KV offload (runtime/kv_offload.py) ----------------------
 
+    # statics: thread(engine-loop)
     def _queue_block_save(self, blk: int, key: int, tokens: tuple) -> None:
         """Eviction hook: slice the reclaimed block's pages and start their
         device→host copy. Called from inside allocator.allocate() — i.e.
@@ -1997,6 +2010,7 @@ class LLMEngine:
 
     # -- offline convenience ----------------------------------------------
 
+    # statics: thread(engine-loop)
     def generate(
         self,
         prompt_ids: list[int],
@@ -2010,6 +2024,7 @@ class LLMEngine:
                 break
         return req
 
+    # statics: thread(scrape)
     def kv_stats(self) -> dict:
         stats = self.scheduler.kv_stats()
         if self._host_store is not None:
@@ -2020,6 +2035,7 @@ class LLMEngine:
 
     # -- router-facing snapshots (read from OTHER threads) -----------------
 
+    # statics: thread(handler)
     def load_snapshot(self) -> dict:
         """Lock-free load view for the replica router (serving/router.py).
 
@@ -2038,6 +2054,7 @@ class LLMEngine:
             "block_size": self.cfg.block_size,
         }
 
+    # statics: thread(handler)
     def chain_keys_for(self, prompt_ids: list[int]):
         """Content-addressing chain keys for a prompt, or None without a
         prefix-caching allocator. Computed once by the router and shared
@@ -2047,6 +2064,7 @@ class LLMEngine:
             return None
         return chain(list(prompt_ids))
 
+    # statics: thread(handler)
     def probe_prefix_tokens(self, prompt_ids: list[int], keys=None) -> int:
         """Read-only prefix-cache probe: cached tokens a prompt would reuse
         on THIS replica right now; 0 without prefix caching.

@@ -102,6 +102,7 @@ class HostKVStore:
         with self._lock:
             return len(self._entries)
 
+    # statics: thread(engine-loop, handler)
     def contains(self, key: int, tokens: tuple) -> bool:
         """Read-only probe: no LRU touch (safe for the router/scheduler's
         per-step re-probe of a waiting head)."""
@@ -119,6 +120,7 @@ class HostKVStore:
             return False
         return (e.k.dtype, e.v.dtype) == self._page_dtypes
 
+    # statics: thread(engine-loop, handler)
     def get(self, key: int, tokens: tuple) -> Optional[HostBlock]:
         """Entry for `key`, or None on miss/collision/corruption;
         refreshes recency. Validation failures DROP the entry and count
@@ -136,6 +138,7 @@ class HostKVStore:
             self._entries.move_to_end(key)
             return e
 
+    # statics: thread(engine-loop)
     def invalidate(self, key: int) -> bool:
         """Drop one entry (the engine's restore-fallback path: a block
         that failed to apply must not be re-matched on re-admission).
@@ -150,6 +153,7 @@ class HostKVStore:
             self.invalidated_blocks += 1
             return True
 
+    # statics: thread(engine-loop)
     def put(self, key: int, tokens: tuple, k: np.ndarray, v: np.ndarray) -> bool:
         """Insert (or refresh) one block; False if it can never fit (or
         fails the geometry attestation a first put established)."""
@@ -176,6 +180,7 @@ class HostKVStore:
             self.saved_blocks += 1
             return True
 
+    # statics: thread(scrape)
     def stats(self) -> dict:
         """Store-level stats under the metric key names. These describe the
         ONE (possibly pool-shared) store — EnginePool.kv_stats reports them

@@ -215,6 +215,7 @@ class StepClock:
         except Exception:  # pragma: no cover - profiler always importable with jax
             return NULL_ANNOTATION
 
+    # statics: thread(engine-loop)
     def record_dispatch(self, kind: str, t0: float, t1: float, batch: int,
                         tokens: int, predicted: bool = False) -> None:
         with self._lock:
@@ -226,6 +227,7 @@ class StepClock:
         if kind in (PHASE_DECODE, PHASE_OVERLAPPED_DECODE):
             self.last_decode_batch = batch
 
+    # statics: thread(engine-loop)
     def record_drain(self, t0: float, t1: float, entries: int,
                      tokens: int) -> None:
         with self._lock:
@@ -235,6 +237,7 @@ class StepClock:
                                          entries, tokens))
         self.step_samples.append((PHASE_DRAIN, t1 - t0))
 
+    # statics: thread(engine-loop)
     def record_instant(self, kind: str, t: float, value: float = 0.0) -> None:
         """Zero-duration engine-track event (host-tier save/restore,
         overlap mispredict): rides the same ring, dur_s = 0."""
@@ -245,6 +248,7 @@ class StepClock:
 
     # -- request lifecycle --------------------------------------------------
 
+    # statics: thread(engine-loop)
     def request_queued(self, request_id: str, t: float) -> None:
         with self._lock:
             if len(self._live) >= self.live_capacity:
@@ -254,6 +258,7 @@ class StepClock:
                 self._retired.append(tl)
             self._live[request_id] = RequestTimeline(request_id, t)
 
+    # statics: thread(engine-loop)
     def request_event(self, request_id: str, name: str, t: float,
                       value: float = 0.0) -> None:
         tl = self._live.get(request_id)
@@ -261,6 +266,7 @@ class StepClock:
             return  # retired already (an abort's trailing drain), or evicted
         tl.events.append((name, t, value))
 
+    # statics: thread(engine-loop)
     def request_tokens(self, request_id: str, t: float, n: int) -> None:
         """`n` tokens landed on host for this request at time `t` (one
         harvest application). Stamps first-token, derives ITL samples —
@@ -285,6 +291,7 @@ class StepClock:
         tl.last_token_t = t
         tl.events.append((REQ_TOKENS, t, float(n)))
 
+    # statics: thread(engine-loop)
     def request_retired(self, request_id: str, t: float,
                         reason: Optional[str] = None,
                         slo_ttft_ms: Optional[float] = None,
@@ -324,20 +331,25 @@ class StepClock:
             except IndexError:
                 return out
 
+    # statics: thread(scrape)
     def drain_ttft_samples(self) -> list[float]:
         return self._drain(self.ttft_samples)
 
+    # statics: thread(scrape)
     def drain_itl_samples(self) -> list[float]:
         return self._drain(self.itl_samples)
 
+    # statics: thread(scrape)
     def drain_slo_events(self) -> list[tuple[str, bool]]:
         return self._drain(self.slo_events)
 
+    # statics: thread(scrape)
     def drain_step_samples(self) -> list[tuple[str, float]]:
         return self._drain(self.step_samples)
 
     # -- timeline lookups ----------------------------------------------------
 
+    # statics: thread(handler)
     def timeline_for(self, request_id: str) -> Optional[RequestTimeline]:
         with self._lock:
             tl = self._live.get(request_id)
@@ -348,6 +360,7 @@ class StepClock:
                     return tl
             return None
 
+    # statics: thread(handler)
     def timelines(self) -> list[RequestTimeline]:
         """Every timeline the recorder still holds, retired first."""
         with self._lock:
@@ -359,6 +372,7 @@ class StepClock:
         """monotonic seconds -> absolute wall-clock microseconds."""
         return (self.epoch_ns + mono_t * 1e9) / 1e3
 
+    # statics: thread(handler)
     def chrome_trace(self, pid: int = 0, name: str = "replica0") -> list[dict]:
         """Trace-event JSON objects (the `traceEvents` list entries):
         tid 0 = the engine step clock (one `X` slice per dispatch/drain,

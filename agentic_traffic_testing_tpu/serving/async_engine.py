@@ -87,11 +87,21 @@ class AsyncLLMEngine:
 
     # -- lifecycle ---------------------------------------------------------
 
+    # statics: thread(handler)
     def start(self) -> None:
         if not self._started:
             self._started = True
+            from agentic_traffic_testing_tpu.runtime import concurrency
+
+            if concurrency.installed():
+                # Publication point for the ownership sanitizer: the
+                # building thread legitimately wrote engine state until
+                # now (construction, warmup); from here the engine-loop
+                # thread owns it, and binds on its first write.
+                concurrency.rebind(self.engine)
             self._thread.start()
 
+    # statics: thread(handler)
     def shutdown(self) -> None:
         self._stop.set()
         if self._started:
@@ -99,6 +109,7 @@ class AsyncLLMEngine:
 
     # -- request API (event loop side) -------------------------------------
 
+    # statics: thread(handler)
     async def generate(
         self,
         prompt_ids: list[int],
@@ -153,6 +164,7 @@ class AsyncLLMEngine:
                 del self._streams[rid]
                 stream.push(TokenEvent([], True, req))
 
+    # statics: thread(engine-loop)
     def _run(self) -> None:
         while not self._stop.is_set():
             self._drain_submissions(block=not self.engine.has_work())

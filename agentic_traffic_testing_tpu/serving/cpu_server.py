@@ -78,7 +78,8 @@ def _build_hf(model_name: str):
 
 
 _pipes: list = []
-_pipe_lock = threading.Lock()
+_pipe_lock = threading.Lock()        # guards the _pipes registry (brief)
+_build_lock = threading.Lock()       # serializes cold-start builds (long)
 _rr = itertools.count()
 
 _OFFLINE_MODELS = ("tiny", "debug-512")
@@ -102,20 +103,37 @@ def _num_replicas() -> int:
 
 
 def get_pipeline():
+    # Build OUTSIDE _pipe_lock: a HF build downloads the checkpoint
+    # (minutes on a cold cache), and holding the registry lock across it
+    # would stall every other handler thread of the ThreadingHTTPServer
+    # behind one request (the lock-discipline statics rule,
+    # thread-blocking-under-lock). _build_lock serializes the build
+    # itself so racing first requests wait for ONE build instead of each
+    # loading their own N-fold copy of the model; handlers arriving
+    # after the install never touch it.
     with _pipe_lock:
-        if not _pipes:
-            model = os.environ.get("LLM_MODEL") or os.environ.get("MODEL_NAME", "tiny")
-            n = _num_replicas()
-            if model in _OFFLINE_MODELS:
-                _pipes.extend(_build_tiny() for _ in range(n))
-            else:
-                if n > 1:
-                    raise RuntimeError(
-                        f"LLM_NUM_REPLICAS={n} on the CPU fallback is only "
-                        f"supported for the offline tiny model; unset it (or "
-                        f"set 1) when LLM_MODEL={model!r}")
-                _pipes.append(_build_hf(model))
-    return _pipes[next(_rr) % len(_pipes)]
+        pipes = list(_pipes)
+    if not pipes:
+        with _build_lock:
+            with _pipe_lock:
+                pipes = list(_pipes)
+            if not pipes:
+                model = os.environ.get("LLM_MODEL") or os.environ.get(
+                    "MODEL_NAME", "tiny")
+                n = _num_replicas()
+                if model in _OFFLINE_MODELS:
+                    built = [_build_tiny() for _ in range(n)]  # statics: allow-thread-blocking-under-lock(serializing the cold-start build is _build_lock's entire purpose; serving handlers never contend it)
+                else:
+                    if n > 1:
+                        raise RuntimeError(
+                            f"LLM_NUM_REPLICAS={n} on the CPU fallback is "
+                            f"only supported for the offline tiny model; "
+                            f"unset it (or set 1) when LLM_MODEL={model!r}")
+                    built = [_build_hf(model)]  # statics: allow-thread-blocking-under-lock(serializing the cold-start build is _build_lock's entire purpose; serving handlers never contend it)
+                with _pipe_lock:
+                    _pipes.extend(built)
+                    pipes = list(_pipes)
+    return pipes[next(_rr) % len(pipes)]
 
 
 class CPUFallbackHandler(BaseHTTPRequestHandler):
@@ -133,12 +151,14 @@ class CPUFallbackHandler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
+    # statics: thread(handler)
     def do_GET(self) -> None:
         if self.path in ("/health", "/ready", "/live"):
             self._json(200, {"status": "ok", "backend": "cpu-fallback"})
         else:
             self._json(404, {"error": "Not found"})
 
+    # statics: thread(handler)
     def do_POST(self) -> None:
         if self.path not in ("/chat", "/generate", "/completion"):
             self._json(404, {"error": "Not found"})

@@ -367,6 +367,7 @@ class LLMMetrics:
         self.host_cache_used_bytes.set(stats["host_cache_used_bytes"])
         self.host_cache_capacity_bytes.set(stats["host_cache_capacity_bytes"])
 
+    # statics: thread(scrape)
     def observe_step_clock(self, recorders: list) -> None:
         """Drain per-engine StepClock recorders (runtime/telemetry.py)
         into the step-clock families — called on scrape. Under a replica
@@ -423,6 +424,7 @@ class LLMMetrics:
 
     _HEALTH_VALUES = {"healthy": 1.0, "degraded": 0.5, "quarantined": 0.0}
 
+    # statics: thread(handler)
     def record_shed(self, reason: str) -> None:
         """One admission rejection (server-side, at shed time)."""
         self.requests_shed.labels(reason=reason).inc()
@@ -452,6 +454,7 @@ class LLMMetrics:
         self.spec_emitted_tokens.set(emitted)
         self.spec_verify_iters.set(iters)
 
+    # statics: thread(handler)
     def record_request(self, status: str, latency_s: float, queue_wait_s: float,
                        prompt_tokens: Optional[int],
                        completion_tokens: Optional[int]) -> None:

@@ -31,6 +31,7 @@ Two driving modes, mirroring LLMEngine/AsyncLLMEngine:
 from __future__ import annotations
 
 import logging
+import threading
 import time
 from typing import AsyncIterator, Callable, List, Optional
 
@@ -69,10 +70,16 @@ class ReplicaHealth:
     probation, where one more error re-quarantines with doubled backoff
     and one clean step restores HEALTHY.
 
-    Lock-free on purpose, like engine.load_snapshot: every field is one
-    attribute read/write (atomic under the GIL). The engine thread writes
-    step outcomes; the HTTP thread reads state and applies the watchdog.
-    A stale read costs one routing decision, never correctness."""
+    Three contexts drive the machine concurrently — the engine thread
+    records step outcomes, the routing path applies the watchdog, the
+    background probe re-admits — so every TRANSITION holds `_mu` (round
+    10: the transitions used to be unlocked read-modify-writes, and two
+    contexts quarantining at once could double the backoff exponent or
+    overwrite a fresh quarantine with HEALTHY). The lock is uncontended
+    and bounds nothing hot: one acquire per step outcome / routing
+    decision, never per token. Plain single-field READS (the
+    replica_stats snapshot path) stay lock-free: a stale read still
+    costs one routing decision, never correctness."""
 
     # Default watchdog sits well past the repo's documented first-bucket
     # XLA compile stall (~35-60 s blocking the step thread mid-traffic,
@@ -95,66 +102,81 @@ class ReplicaHealth:
         self.num_quarantines = 0            # cumulative (drives the backoff)
         self._cause: Optional[str] = None
         self._step_started_t: Optional[float] = None
+        self._mu = threading.Lock()         # serializes every transition
 
     # -- engine-thread side -------------------------------------------------
 
+    # statics: thread(engine-loop)
     def step_started(self) -> None:
-        self._step_started_t = time.monotonic()
+        with self._mu:
+            self._step_started_t = time.monotonic()
 
+    # statics: thread(engine-loop)
     def step_done(self) -> None:
-        self._step_started_t = None
+        with self._mu:
+            self._step_started_t = None
 
+    # statics: thread(engine-loop)
     def record_ok(self) -> None:
-        # Lazy probation first: eligible() re-admits a quarantined replica
-        # the moment its cooldown lapses, possibly before the background
-        # probe tick (or without any probe loop at all — direct EnginePool
-        # embedding). Without this, step outcomes on lazily re-admitted
-        # work dead-end in QUARANTINED: record_error early-returns (no
-        # doubled backoff) and record_ok refuses to heal.
-        self.probe()
-        self.consecutive_errors = 0
-        if self.state is not QUARANTINED or self._cause == "stuck":
-            # A clean step heals degraded/probation state immediately; a
-            # stuck-quarantine also lifts (the wedge resolved on its own).
-            # An error-quarantine waits for the cooldown instead — old
-            # queued work draining through a sick replica must not flap
-            # it straight back into the rotation.
-            self.state = HEALTHY
-            self._cause = None
+        with self._mu:
+            # Lazy probation first: eligible() re-admits a quarantined
+            # replica the moment its cooldown lapses, possibly before the
+            # background probe tick (or without any probe loop at all —
+            # direct EnginePool embedding). Without this, step outcomes on
+            # lazily re-admitted work dead-end in QUARANTINED:
+            # record_error early-returns (no doubled backoff) and
+            # record_ok refuses to heal.
+            self._probe_locked(time.monotonic())
+            self.consecutive_errors = 0
+            if self.state is not QUARANTINED or self._cause == "stuck":
+                # A clean step heals degraded/probation state immediately;
+                # a stuck-quarantine also lifts (the wedge resolved on its
+                # own). An error-quarantine waits for the cooldown instead
+                # — old queued work draining through a sick replica must
+                # not flap it straight back into the rotation.
+                self.state = HEALTHY
+                self._cause = None
 
+    # statics: thread(engine-loop)
     def record_error(self) -> None:
-        self.probe()  # lazy probation — see record_ok
-        self.consecutive_errors += 1
-        if self.state is QUARANTINED:
-            return  # cooldown still running; probation decides re-admission
-        if self.consecutive_errors >= self.error_threshold:
-            self._quarantine("errors")
-        else:
-            self.state = DEGRADED
+        with self._mu:
+            now = time.monotonic()
+            self._probe_locked(now)  # lazy probation — see record_ok
+            self.consecutive_errors += 1
+            if self.state is QUARANTINED:
+                return  # cooldown running; probation decides re-admission
+            if self.consecutive_errors >= self.error_threshold:
+                self._quarantine(now, "errors")
+            else:
+                self.state = DEGRADED
 
     # -- router/probe side --------------------------------------------------
 
-    def _quarantine(self, cause: str) -> None:
+    # statics: locked(_mu)
+    def _quarantine(self, now: float, cause: str) -> None:
         self.state = QUARANTINED
         self._cause = cause
         self.num_quarantines += 1
         backoff = min(self.cooldown_s * (2 ** (self.num_quarantines - 1)),
                       self.max_cooldown_s)
-        self.quarantined_until = time.monotonic() + backoff
+        self.quarantined_until = now + backoff
         log.warning("replica quarantined (%s) for %.1fs", cause, backoff)
 
     def check_stuck(self, now: Optional[float] = None) -> bool:
         """Watchdog: quarantine if the current step has been running past
         watchdog_s. Called from the routing path (the wedged engine thread
         cannot report on itself)."""
-        if self.watchdog_s <= 0 or self.state is QUARANTINED:
+        with self._mu:
+            if self.watchdog_s <= 0 or self.state is QUARANTINED:
+                return False
+            t0 = self._step_started_t
+            t = now or time.monotonic()
+            if t0 is not None and t - t0 > self.watchdog_s:
+                self._quarantine(t, "stuck")
+                return True
             return False
-        t0 = self._step_started_t
-        if t0 is not None and (now or time.monotonic()) - t0 > self.watchdog_s:
-            self._quarantine("stuck")
-            return True
-        return False
 
+    # statics: locked(_mu)
     def _still_wedged(self, t: float) -> bool:
         """Is the engine thread STILL inside an overlong step right now?
         A wedged thread never calls step_done(), so a lapsed cooldown
@@ -171,10 +193,11 @@ class ReplicaHealth:
         eligible again once their cooldown lapses (the lazy counterpart of
         the background probe, so routing never depends on probe timing) —
         unless the step that got them quarantined is still running."""
-        if self.state is not QUARANTINED:
-            return True
-        t = now or time.monotonic()
-        return t >= self.quarantined_until and not self._still_wedged(t)
+        with self._mu:
+            if self.state is not QUARANTINED:
+                return True
+            t = now or time.monotonic()
+            return t >= self.quarantined_until and not self._still_wedged(t)
 
     def probe(self, now: Optional[float] = None) -> bool:
         """Re-admit after cooldown: QUARANTINED → DEGRADED probation. One
@@ -182,7 +205,11 @@ class ReplicaHealth:
         restores HEALTHY. True when a transition happened. A replica
         still wedged in the quarantining step stays out (the wedge
         resolving is observable: step_done clears the stamp)."""
-        t = now or time.monotonic()
+        with self._mu:
+            return self._probe_locked(now or time.monotonic())
+
+    # statics: locked(_mu)
+    def _probe_locked(self, t: float) -> bool:
         if (self.state is QUARANTINED and t >= self.quarantined_until
                 and not self._still_wedged(t)):
             self.state = DEGRADED
@@ -290,6 +317,7 @@ class EnginePool:
 
     # -- routing -----------------------------------------------------------
 
+    # statics: thread(handler)
     def eligible_replicas(self) -> list[int]:
         """Replica indices the router may place new work on: everything
         not quarantined (the stuck watchdog fires lazily here — a wedged
@@ -302,6 +330,7 @@ class EnginePool:
         ok = [i for i, h in enumerate(self.health) if h.eligible(now)]
         return ok or list(range(len(self.engines)))
 
+    # statics: thread(health-probe)
     def health_probe(self) -> int:
         """Background re-admission probe (the server runs this
         periodically): quarantined replicas whose cooldown lapsed move to
@@ -309,6 +338,7 @@ class EnginePool:
         now = time.monotonic()
         return sum(1 for h in self.health if h.probe(now))
 
+    # statics: thread(handler)
     def route(self, prompt_ids: list[int],
               request_id: Optional[str] = None) -> int:
         idx = self.router.select(prompt_ids, request_id,
@@ -316,6 +346,7 @@ class EnginePool:
         self.routed_requests[idx] += 1
         return idx
 
+    # statics: thread(handler)
     def _alternate(self, tried: list[int]) -> Optional[int]:
         """Least-loaded eligible replica outside `tried` (the retry-once
         target), or None when no alternate exists."""
@@ -332,6 +363,7 @@ class EnginePool:
 
     # -- sync API (bench, tests) -------------------------------------------
 
+    # statics: thread(engine-loop)
     def add_request(self, prompt_ids: list[int],
                     sampling: Optional[SamplingParams] = None,
                     request_id: Optional[str] = None) -> Request:
@@ -339,6 +371,7 @@ class EnginePool:
         return self.engines[idx].add_request(prompt_ids, sampling,
                                              request_id=request_id)
 
+    # statics: thread(engine-loop)
     def step(self) -> list[StepOutput]:
         """One dispatch per replica that has work; concatenated events.
 
@@ -354,6 +387,7 @@ class EnginePool:
     def has_work(self) -> bool:
         return any(e.has_work() for e in self.engines)
 
+    # statics: thread(engine-loop)
     def abort_request(self, req: Request) -> list[StepOutput]:
         """Abort on whichever replica owns the request. Sibling drain
         events come back exactly like LLMEngine.abort_request's — and only
@@ -366,14 +400,17 @@ class EnginePool:
 
     # -- async API (serving layer) -----------------------------------------
 
+    # statics: thread(handler)
     def start(self) -> None:
         for a in self._async:
             a.start()
 
+    # statics: thread(handler)
     def shutdown(self) -> None:
         for a in self._async:
             a.shutdown()
 
+    # statics: thread(handler)
     async def generate(
         self,
         prompt_ids: list[int],
@@ -464,6 +501,7 @@ class EnginePool:
     def num_shed(self) -> int:
         return sum(e.num_shed for e in self.engines)
 
+    # statics: thread(scrape)
     def replica_health_states(self) -> list[str]:
         """Per-replica health for the llm_replica_health labeled gauge
         (watchdog applied first, so a scrape sees wedges promptly)."""
@@ -478,6 +516,7 @@ class EnginePool:
         unless LLM_STEP_TRACE built the engines with tracing on."""
         return [e.telemetry for e in self.engines if e.telemetry is not None]
 
+    # statics: thread(handler)
     def chrome_trace(self) -> dict:
         """Merged Chrome trace document: one pid per replica, so a pool's
         step clocks land side by side in Perfetto."""
@@ -517,6 +556,7 @@ class EnginePool:
         "host_cache_invalidated_blocks",
     )
 
+    # statics: thread(scrape)
     def kv_stats(self) -> dict:
         """Pool view with every per-replica key SUMMED except the invariant
         keys above (reported once). Keys match LLMEngine.kv_stats exactly
@@ -533,6 +573,7 @@ class EnginePool:
                     break
         return agg
 
+    # statics: thread(scrape)
     def replica_stats(self) -> list[dict]:
         """Per-replica snapshot for the `llm_replica_*` labeled series."""
         out = []

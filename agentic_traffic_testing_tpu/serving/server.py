@@ -618,9 +618,11 @@ class LLMServer:
 
     # -- handlers -----------------------------------------------------------
 
+    # statics: thread(handler)
     async def handle_health(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})
 
+    # statics: thread(scrape)
     async def handle_metrics(self, request: web.Request) -> web.Response:
         if self.metrics is None:
             return web.json_response({"error": "Metrics disabled"}, status=503)
@@ -664,6 +666,7 @@ class LLMServer:
         return ([self.engine.telemetry]
                 if self.engine.telemetry is not None else [])
 
+    # statics: thread(handler)
     async def handle_debug_timeline(self, request: web.Request) -> web.Response:
         """Chrome trace-event JSON of the step-clock rings: one track per
         replica (engine dispatch/drain slices) + one per request (phase
@@ -683,6 +686,7 @@ class LLMServer:
 
         return web.json_response(chrome_trace_document(recorders))
 
+    # statics: thread(handler)
     async def handle_profile_start(self, request: web.Request) -> web.Response:
         """Start a jax.profiler trace (device + host timelines) — the
         TPU-idiomatic equivalent of the GPU-side profilers the reference
@@ -712,6 +716,7 @@ class LLMServer:
         _set_active_profile_dir(log_dir)
         return web.json_response({"status": "profiling", "log_dir": log_dir})
 
+    # statics: thread(handler)
     async def handle_profile_stop(self, request: web.Request) -> web.Response:
         log_dir = _active_profile_dir()
         if log_dir is None:
@@ -731,6 +736,7 @@ class LLMServer:
         _set_active_profile_dir(None)
         return web.json_response({"status": "stopped", "log_dir": log_dir})
 
+    # statics: thread(handler)
     async def handle_chat(self, request: web.Request) -> web.Response:
         ctx = extract_context(request.headers)
         with self.tracer.start_as_current_span(
@@ -1186,6 +1192,14 @@ class LLMServer:
 
         if manage_engine:
             async def _start(app):
+                from agentic_traffic_testing_tpu.runtime import concurrency
+
+                if concurrency.installed():
+                    # Ownership-sanitizer publication point: the server
+                    # was built on whatever thread constructed it; from
+                    # here the event-loop thread owns the handler-side
+                    # state and binds on its first write.
+                    concurrency.rebind(self)
                 self.async_engine.start()
                 if self.metrics:
                     self._probe_task = asyncio.ensure_future(
@@ -1208,6 +1222,7 @@ class LLMServer:
             app.on_cleanup.append(_stop)
         return app
 
+    # statics: thread(health-probe)
     async def _health_probe_loop(self) -> None:
         """Periodic quarantined-replica re-admission (pool only)."""
         try:
@@ -1219,6 +1234,7 @@ class LLMServer:
         except asyncio.CancelledError:
             pass
 
+    # statics: thread(health-probe)
     async def _probe_max_concurrency(self) -> None:
         """Background task: refresh concurrency gauges from the LIVE engine.
 

@@ -1,12 +1,15 @@
 """The statics plane: AST-based invariant checkers for the serving stack.
 
-Five checkers, one runner (`scripts/dev/statics_all.py`), one pragma
+Six checkers, one runner (`scripts/dev/statics_all.py`), one pragma
 syntax (`# statics: allow-<rule>(<reason>)`) — see docs/statics.md:
 
   knobs         env-knob registry parity (code <-> registry <-> docs)
   capabilities  supports_* matrix parity + build-time refusal guards
   host-sync     no host synchronization inside marked hot regions
   donation      no reads of donated buffers after a runner dispatch
+  concurrency   thread-ownership map + lock discipline for the serving
+                plane (statics/ownership_registry.py, docs/threading.md;
+                the runtime half is LLM_CONCURRENCY_CHECK=1)
   metric-docs   Prometheus family <-> docs/monitoring.md parity
                 (scripts/dev/check_metric_docs.py behind a thin shim)
 """
@@ -17,11 +20,13 @@ import importlib.util
 import io
 import os
 import sys
+import time
 from contextlib import redirect_stdout
-from typing import Optional
+from typing import Iterable, Optional
 
 from agentic_traffic_testing_tpu.statics import (  # noqa: F401
     capabilities,
+    concurrency,
     donation,
     host_sync,
     knobs,
@@ -52,16 +57,29 @@ CHECKERS = (
     ("capabilities", lambda root: capabilities.check(root)),
     ("host-sync", lambda root: host_sync.check(root)),
     ("donation", lambda root: donation.check(root)),
+    ("concurrency", lambda root: concurrency.check(root)),
     ("metric-docs", lambda root: check_metric_docs(root)),
 )
 
 
-def run_all(root: Optional[str] = None) -> dict:
-    """Run every checker; the JSON-shaped report statics_all.py emits."""
+def run_all(root: Optional[str] = None,
+            only: Optional[Iterable[str]] = None) -> dict:
+    """Run every checker (or the `only` subset, by name); the JSON-shaped
+    report statics_all.py emits, with per-checker wall time."""
     root = root or repo_root()
+    if only is not None:
+        only = set(only)
+        unknown = only - {name for name, _ in CHECKERS}
+        if unknown:
+            raise ValueError(
+                f"unknown checker(s) {sorted(unknown)}; available: "
+                f"{', '.join(name for name, _ in CHECKERS)}")
     report: dict = {"ok": True, "checkers": {}}
     seen: set = set()
     for name, fn in CHECKERS:
+        if only is not None and name not in only:
+            continue
+        t0 = time.monotonic()
         try:
             findings = fn(root)
         except Exception as exc:  # a crashed checker must fail the gate
@@ -82,6 +100,7 @@ def run_all(root: Optional[str] = None) -> dict:
         report["checkers"][name] = {
             "ok": not findings,
             "findings": [f.as_dict() for f in findings],
+            "wall_time_s": round(time.monotonic() - t0, 4),
         }
         if findings:
             report["ok"] = False
@@ -95,6 +114,7 @@ def write_docs(root: Optional[str] = None) -> list[str]:
     for relpath, content in (
         (knobs.DOC_RELPATH, knobs.render_doc()),
         (capabilities.DOC_RELPATH, capabilities.render(root)),
+        (concurrency.DOC_RELPATH, concurrency.render(root)),
     ):
         path = os.path.join(root, relpath)
         with open(path, "w", encoding="utf-8") as f:

@@ -1,0 +1,847 @@
+"""Checker 6 — concurrency statics: thread ownership + lock discipline.
+
+Rounds 7-9 made the serving stack concurrent (per-replica engine-loop
+threads, asyncio handlers, a health-probe task, /metrics scrapes), held
+together by docstring contracts nothing machine-checked. This checker
+encodes them:
+
+  thread-context map      `# statics: thread(<ctx>[, <ctx>...])` markers
+                          (on or directly above a `def`, mirroring the
+                          hot-region pragma machinery) classify functions
+                          into the four serving contexts (engine-loop /
+                          handler / health-probe / scrape); the call
+                          graph propagates contexts to unmarked helpers.
+  attribute ownership     every non-__init__ write to `self.<attr>` of a
+                          registered class (statics/ownership_registry)
+                          must match the attribute's declared owner
+                          context or hold its declared guarding lock.
+  lock-free contracts     a method whose docstring declares "lock-free"
+                          must not mutate self state (non-atomic
+                          read-modify-writes hide there) and must not
+                          read the same mutable attribute twice (TOCTOU:
+                          snapshot to a local instead).
+  lock discipline         nested lock acquisition must be cycle-free;
+                          no blocking call (time.sleep, jax.device_get,
+                          .block_until_ready(), engine .step(), HTTP /
+                          from_pretrained downloads — directly or
+                          through a scanned callee) while holding a
+                          threading lock; no `await` under a held
+                          threading.Lock (the event loop would deadlock
+                          against the thread waiting on it).
+
+Rules: thread-unknown-context, thread-attr-unregistered,
+thread-class-unregistered, thread-unowned-write, thread-owner-dead,
+thread-lockfree-mutation, thread-lockfree-read, thread-lock-order
+(acquisition-order cycles, same-lock re-acquisition, cross-function
+self-deadlock through the call graph), thread-blocking-under-lock,
+thread-await-under-lock, thread-locked-helper, thread-docs-stale.
+Suppression: `# statics: allow-<rule>(<reason>)` on the statement.
+docs/threading.md is generated from the markers + registry
+(`python scripts/dev/statics_all.py --write-docs`).
+
+The runtime half (`LLM_CONCURRENCY_CHECK=1`, runtime/concurrency.py)
+compiles the SAME registry into ownership-asserting `__setattr__`
+wrappers, so churn tests double as a dynamic race detector.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Iterable, Optional
+
+from agentic_traffic_testing_tpu.statics.common import (
+    Finding,
+    SourceFile,
+    bare_pragma_findings,
+    dotted,
+    repo_root,
+)
+from agentic_traffic_testing_tpu.statics.ownership_registry import (
+    ANY,
+    CONTEXTS,
+    INIT,
+    LOCKS,
+    OWNED_ATTRS,
+    REGISTERED_CLASSES,
+)
+
+RULE_CTX = "thread-unknown-context"
+RULE_UNREG = "thread-attr-unregistered"
+RULE_CLASS = "thread-class-unregistered"
+RULE_WRITE = "thread-unowned-write"
+RULE_DEAD = "thread-owner-dead"
+RULE_LF_MUT = "thread-lockfree-mutation"
+RULE_LF_READ = "thread-lockfree-read"
+RULE_ORDER = "thread-lock-order"
+RULE_BLOCK = "thread-blocking-under-lock"
+RULE_AWAIT = "thread-await-under-lock"
+RULE_LOCKED = "thread-locked-helper"
+RULE_DOCS = "thread-docs-stale"
+
+THREAD_RE = re.compile(r"#\s*statics:\s*thread\((?P<body>[^)]*)\)")
+# `# statics: locked(<lock>)` on a def: every caller holds <lock>, so
+# writes inside count as under it — and the checker VERIFIES the claim
+# at every resolved call site (thread-locked-helper).
+LOCKED_RE = re.compile(r"#\s*statics:\s*locked\((?P<body>[^)]*)\)")
+
+#: the serving-plane files whose thread discipline the default check scans
+SCAN_RELPATHS = (
+    os.path.join("agentic_traffic_testing_tpu", "runtime", "engine.py"),
+    os.path.join("agentic_traffic_testing_tpu", "runtime", "telemetry.py"),
+    os.path.join("agentic_traffic_testing_tpu", "runtime", "kv_offload.py"),
+    os.path.join("agentic_traffic_testing_tpu", "serving", "async_engine.py"),
+    os.path.join("agentic_traffic_testing_tpu", "serving", "server.py"),
+    os.path.join("agentic_traffic_testing_tpu", "serving", "replica_pool.py"),
+    os.path.join("agentic_traffic_testing_tpu", "serving", "metrics.py"),
+    os.path.join("agentic_traffic_testing_tpu", "serving", "cpu_server.py"),
+)
+
+DOC_RELPATH = os.path.join("docs", "threading.md")
+
+_INIT_NAMES = ("__init__", "__post_init__", "__new__")
+
+# Blocking-call denylist. Dotted names match exactly; attribute tails
+# match any receiver (`.block_until_ready()` on a jax array, `.step()`
+# on an engine, `.from_pretrained()` HF downloads). Method names common
+# on builtin containers stay out (`.get()`, `.popitem()`, ...).
+_BLOCKING_DOTTED = {
+    "time.sleep": "time.sleep()",
+    "jax.device_get": "jax.device_get()",
+    "jax.block_until_ready": "jax.block_until_ready()",
+    "urllib.request.urlopen": "urlopen() HTTP round trip",
+}
+_BLOCKING_ATTRS = {
+    "block_until_ready": ".block_until_ready() device sync",
+    "item": ".item() device sync",
+    "step": ".step() engine dispatch",
+    "from_pretrained": ".from_pretrained() model/tokenizer download",
+    "urlopen": "urlopen() HTTP round trip",
+}
+_BLOCKING_MODULE_CALLS = {
+    "requests": {"get", "post", "put", "delete", "head", "request"},
+}
+
+# Container mutators: a call `self.<attr>.<m>(...)` with one of these
+# method names counts as a WRITE to <attr> (list/dict/set/deque state is
+# exactly where cross-thread mutation hides). Thread-safe-by-design
+# channels (queue.Queue.put/get) are deliberately absent.
+_MUTATING_METHODS = frozenset({
+    "append", "appendleft", "extend", "insert", "add", "discard",
+    "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "move_to_end",
+})
+
+# Method names too generic for unique-name call-graph resolution
+# (dict.get, list.append, prometheus .observe, ... would otherwise
+# alias onto scanned classes that happen to define the name).
+_GENERIC_METHOD_NAMES = frozenset({
+    "get", "set", "put", "pop", "append", "clear", "update", "items",
+    "keys", "values", "copy", "join", "start", "close", "read", "write",
+    "send", "encode", "decode", "observe", "inc", "dec", "labels",
+    "render", "select", "plan", "finish", "abort",
+})
+
+
+class _Func:
+    """One scanned function: identity, marker, and everything the walk
+    collected (writes, calls, lock edges, awaits, blocking calls)."""
+
+    __slots__ = ("src", "cls", "name", "node", "declared", "contexts",
+                 "writes", "reads", "calls", "under_lock_calls",
+                 "blocking", "awaits", "lockfree", "assumed", "acquires")
+
+    def __init__(self, src: SourceFile, cls: str, name: str,
+                 node: ast.AST, declared: Optional[frozenset],
+                 assumed: frozenset = frozenset()) -> None:
+        self.src = src
+        self.cls = cls                    # "" for module-level functions
+        self.name = name
+        self.node = node
+        self.declared = declared          # marker contexts (None = unmarked)
+        self.assumed = assumed            # locks every caller holds
+        self.contexts: set[str] = set(declared or ())
+        # (attr, node, frozenset of held lock keys, is_augassign)
+        self.writes: list[tuple] = []
+        self.reads: dict[str, list[ast.AST]] = {}   # self-attr loads
+        self.calls: list[tuple] = []      # (callee ref, node, held keys)
+        self.under_lock_calls: list[tuple] = []  # (ref, node, lock keys)
+        self.blocking: list[tuple] = []   # (node, desc, held threading locks)
+        self.awaits: list[tuple] = []     # (node, held threading lock keys)
+        self.acquires: set = set()        # lock keys this body takes itself
+        doc = ast.get_docstring(node) if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef)) else None
+        self.lockfree = bool(doc and "lock-free" in doc.lower())
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+def _thread_markers(src: SourceFile) -> dict[int, tuple[str, ...]]:
+    """line -> declared contexts for every `# statics: thread(...)`."""
+    return _line_markers(src, THREAD_RE)
+
+
+def _line_markers(src: SourceFile, rx) -> dict[int, tuple[str, ...]]:
+    out: dict[int, tuple[str, ...]] = {}
+    for i, line in enumerate(src.lines, start=1):
+        m = rx.search(line)
+        if m:
+            out[i] = tuple(p.strip() for p in m.group("body").split(",")
+                           if p.strip())
+    return out
+
+
+def _marker_for(node, markers: dict) -> Optional[tuple[tuple, int]]:
+    """(contexts, marker line) when a thread marker sits on the def line
+    (or directly above it, accounting for decorators)."""
+    first = min([node.lineno] + [d.lineno for d in node.decorator_list])
+    for ln in (first, first - 1):
+        if ln in markers:
+            return markers[ln], ln
+    return None
+
+
+def _self_attr_targets(t: ast.AST) -> list[str]:
+    """Attribute names a store/delete target mutates on `self`: plain
+    rebinds (`self.x = ...`), container item stores (`self.x[k] = ...`),
+    and tuple-unpack members."""
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+            and t.value.id == "self":
+        return [t.attr]
+    if isinstance(t, ast.Subscript):
+        v = t.value
+        if isinstance(v, ast.Attribute) and isinstance(v.value, ast.Name) \
+                and v.value.id == "self":
+            return [v.attr]
+        return []
+    if isinstance(t, (ast.Tuple, ast.List)):
+        out = []
+        for e in t.elts:
+            out.extend(_self_attr_targets(e))
+        return out
+    return []
+
+
+def _blocking_desc(node: ast.Call) -> Optional[str]:
+    fn = node.func
+    d = dotted(fn)
+    if d is not None:
+        if d in _BLOCKING_DOTTED:
+            return _BLOCKING_DOTTED[d]
+        head, _, tail = d.partition(".")
+        if head in _BLOCKING_MODULE_CALLS and \
+                tail in _BLOCKING_MODULE_CALLS[head]:
+            return f"{d}() HTTP round trip"
+    if isinstance(fn, ast.Attribute) and fn.attr in _BLOCKING_ATTRS:
+        return _BLOCKING_ATTRS[fn.attr]
+    return None
+
+
+class _Scanner:
+    """Parses the scan surface into _Func records + the lock-edge graph."""
+
+    def __init__(self, srcs: list[SourceFile], lock_keys: dict) -> None:
+        self.srcs = srcs
+        self.lock_keys = lock_keys        # (cls, attr) -> kind
+        self.funcs: list[_Func] = []
+        # name -> [funcs] (class methods only, for unique-name resolution)
+        self.method_index: dict[str, list[_Func]] = {}
+        self.module_index: dict[tuple, _Func] = {}  # (src path, name)
+        self.by_class: dict[str, list[_Func]] = {}
+        # lock-order edges: outer key -> {(inner key, src, line)}
+        self.lock_edges: dict[tuple, set] = {}
+        # same-lock re-acquisition sites: (key, func, line)
+        self.reacquisitions: list[tuple] = []
+        self.marker_findings: list[Finding] = []
+
+    # -- collection --------------------------------------------------------
+
+    def scan(self) -> None:
+        for src in self.srcs:
+            markers = _thread_markers(src)
+            locked = _line_markers(src, LOCKED_RE)
+            used: set[int] = set()
+            for node in src.tree.body:
+                self._collect(src, node, "", markers, locked, used)
+            for ln in sorted(set(markers) - used):
+                self.marker_findings.append(Finding(
+                    RULE_CTX, src.path, ln,
+                    "thread(...) marker is not attached to a function "
+                    "def (put it on the def line or directly above)"))
+        for f in self.funcs:
+            self._walk_function(f)
+
+    def _collect(self, src, node, cls, markers, locked, used) -> None:
+        if isinstance(node, ast.ClassDef):
+            for stmt in node.body:
+                self._collect(src, stmt, node.name, markers, locked, used)
+            return
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return
+        declared = None
+        hit = _marker_for(node, markers)
+        if hit is not None:
+            ctxs, ln = hit
+            used.add(ln)
+            bad = [c for c in ctxs if c not in CONTEXTS]
+            for c in bad:
+                self.marker_findings.append(Finding(
+                    RULE_CTX, src.path, ln,
+                    f"unknown thread context {c!r} — declared contexts "
+                    f"are {', '.join(CONTEXTS)}"))
+            declared = frozenset(c for c in ctxs if c in CONTEXTS) or None
+        assumed = set()
+        lk = _marker_for(node, locked)
+        if lk is not None:
+            for name in lk[0]:
+                key = (cls, name) if (cls, name) in self.lock_keys \
+                    else ("", name)
+                if key in self.lock_keys:
+                    assumed.add(key)
+                else:
+                    self.marker_findings.append(Finding(
+                        RULE_CTX, src.path, lk[1],
+                        f"locked({name}) names no declared lock — add a "
+                        f"LockDecl row in statics/ownership_registry.py"))
+        f = _Func(src, cls, node.name, node, declared, frozenset(assumed))
+        self.funcs.append(f)
+        if cls:
+            self.method_index.setdefault(node.name, []).append(f)
+            self.by_class.setdefault(cls, []).append(f)
+        else:
+            self.module_index[(src.path, node.name)] = f
+
+    # -- per-function walk --------------------------------------------------
+
+    def _lock_key(self, expr, cls: str) -> Optional[tuple]:
+        d = dotted(expr)
+        if d is None:
+            return None
+        if d.startswith("self.") and "." not in d[5:]:
+            key = (cls, d[5:])
+        elif "." not in d:
+            key = ("", d)
+        else:
+            return None
+        return key if key in self.lock_keys else None
+
+    def _walk_function(self, f: _Func) -> None:
+        # stack entries: (lock key, kind)
+        def held_threading(stack):
+            return frozenset(k for k, kind in stack if kind == "threading")
+
+        def all_held(stack):
+            return frozenset(k for k, _ in stack)
+
+        def walk(node, stack):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node is not f.node:
+                # A nested def's body runs later, not under the enclosing
+                # with: reset the lock stack (writes still attribute to
+                # the outer function for registry coverage).
+                for child in ast.iter_child_nodes(node):
+                    walk(child, [])
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                entered = list(stack)
+                for item in node.items:
+                    key = self._lock_key(item.context_expr, f.cls)
+                    if key is None:
+                        # A non-lock context manager: its expression (and
+                        # any `as` target) evaluates under the locks held
+                        # so far — `with requests.get(u) as r:` inside a
+                        # lock is still a blocking call under the lock.
+                        walk(item.context_expr, entered)
+                        if item.optional_vars is not None:
+                            for attr in _self_attr_targets(
+                                    item.optional_vars):
+                                f.writes.append((attr, node,
+                                                 all_held(entered), False))
+                        continue
+                    for outer, _kind in entered:
+                        if outer == key:
+                            # threading.Lock is not reentrant: taking a
+                            # lock already held deadlocks immediately.
+                            self.reacquisitions.append((key, f, node))
+                        else:
+                            self.lock_edges.setdefault(
+                                outer, set()).add((key, f, node))
+                    f.acquires.add(key)
+                    entered.append((key, self.lock_keys[key]))
+                for child in node.body:
+                    walk(child, entered)
+                return
+            if isinstance(node, ast.Await):
+                locks = held_threading(stack)
+                if locks:
+                    f.awaits.append((node, locks))
+            elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for attr in _self_attr_targets(t):
+                        f.writes.append((attr, node, all_held(stack),
+                                         isinstance(node, ast.AugAssign)))
+            elif isinstance(node, ast.Delete):
+                for t in node.targets:
+                    for attr in _self_attr_targets(t):
+                        f.writes.append((attr, node, all_held(stack), False))
+            elif isinstance(node, ast.Call):
+                fn_expr = node.func
+                if (isinstance(fn_expr, ast.Attribute)
+                        and fn_expr.attr in _MUTATING_METHODS
+                        and isinstance(fn_expr.value, ast.Attribute)
+                        and isinstance(fn_expr.value.value, ast.Name)
+                        and fn_expr.value.value.id == "self"):
+                    f.writes.append((fn_expr.value.attr, node,
+                                     all_held(stack), False))
+                desc = _blocking_desc(node)
+                if desc is not None:
+                    f.blocking.append((node, desc, held_threading(stack)))
+                ref = self._resolve_call(node, f)
+                if ref is not None:
+                    f.calls.append((ref, node, all_held(stack)))
+                    locks = held_threading(stack)
+                    if locks:
+                        f.under_lock_calls.append((ref, node, locks))
+            elif isinstance(node, ast.Attribute) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and isinstance(node.value, ast.Name) \
+                    and node.value.id == "self":
+                f.reads.setdefault(node.attr, []).append(node)
+            for child in ast.iter_child_nodes(node):
+                walk(child, stack)
+
+        base = [(k, self.lock_keys[k]) for k in sorted(f.assumed)]
+        for child in ast.iter_child_nodes(f.node):
+            walk(child, list(base))
+
+    def _resolve_call(self, node: ast.Call, f: _Func) -> Optional[_Func]:
+        fn = node.func
+        if isinstance(fn, ast.Name):
+            return self.module_index.get((f.src.path, fn.id))
+        if isinstance(fn, ast.Attribute):
+            name = fn.attr
+            if isinstance(fn.value, ast.Name) and fn.value.id == "self" \
+                    and f.cls:
+                for cand in self.method_index.get(name, ()):
+                    if cand.cls == f.cls:
+                        return cand
+            if name in _GENERIC_METHOD_NAMES:
+                return None
+            cands = self.method_index.get(name, ())
+            if len(cands) == 1:
+                return cands[0]
+        return None
+
+    # -- context propagation ------------------------------------------------
+
+    def propagate(self) -> None:
+        """Unmarked functions inherit the union of their callers'
+        contexts (fixpoint over the call graph); declared markers are
+        authoritative and never widened."""
+        changed = True
+        while changed:
+            changed = False
+            for f in self.funcs:
+                if not f.contexts:
+                    continue
+                for ref, _node, _held in f.calls:
+                    if ref.declared is None and not f.contexts <= ref.contexts:
+                        ref.contexts |= f.contexts
+                        changed = True
+
+    # -- transitive lock acquisition ----------------------------------------
+
+    def transitive_acquires(self) -> dict:
+        """func -> {lock keys acquired somewhere in its call closure}."""
+        trans: dict[_Func, set] = {f: set(f.acquires) for f in self.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for f in self.funcs:
+                for ref, _node, _held in f.calls:
+                    add = trans[ref] - trans[f]
+                    if add:
+                        trans[f] |= add
+                        changed = True
+        return trans
+
+    # -- transitive blocking ------------------------------------------------
+
+    def transitive_blocking(self) -> dict:
+        """func -> {blocking descriptions reachable through its body}."""
+        trans: dict[_Func, set[str]] = {
+            f: {desc for _n, desc, _l in f.blocking} for f in self.funcs}
+        changed = True
+        while changed:
+            changed = False
+            for f in self.funcs:
+                for ref, _node, _held in f.calls:
+                    add = {f"{d} (via {ref.qualname})"
+                           for d in trans[ref]} - trans[f]
+                    # Keep chains one level deep in the description; the
+                    # reachability set itself is fully transitive.
+                    plain = {d.split(" (via ", 1)[0] for d in trans[f]}
+                    add = {d for d in add
+                           if d.split(" (via ", 1)[0] not in plain}
+                    if add:
+                        trans[f] |= add
+                        changed = True
+        return trans
+
+
+def _lock_cycles(edges: dict) -> list[tuple]:
+    """Edges (outer -> inner) that participate in an acquisition-order
+    cycle: (outer, inner, func, with-node)."""
+
+    def reaches(a, b, seen) -> bool:
+        if a == b:
+            return True
+        if a in seen:
+            return False
+        seen.add(a)
+        return any(reaches(nxt, b, seen)
+                   for nxt, _f, _n in edges.get(a, ()))
+
+    out = []
+    for outer, inners in sorted(edges.items()):
+        for inner, func, node in sorted(
+                inners, key=lambda e: (e[0], e[2].lineno)):
+            if reaches(inner, outer, set()):
+                out.append((outer, inner, func, node))
+    return out
+
+
+def _fmt_lock(key: tuple) -> str:
+    cls, attr = key
+    return f"{cls}.{attr}" if cls else attr
+
+
+def check(root: Optional[str] = None,
+          paths: Optional[Iterable[str]] = None,
+          attrs: tuple = OWNED_ATTRS,
+          locks: tuple = LOCKS,
+          registered: Optional[dict] = None,
+          doc_path: Optional[str] = None) -> list[Finding]:
+    root = root or repo_root()
+    if paths is None:
+        paths = [os.path.join(root, p) for p in SCAN_RELPATHS]
+    registered = REGISTERED_CLASSES if registered is None else registered
+    srcs = [SourceFile(p, root) for p in paths]
+    findings: list[Finding] = []
+    for src in srcs:
+        findings.extend(bare_pragma_findings(src))
+
+    lock_keys = {(ld.cls, ld.attr): ld.kind for ld in locks}
+    spec = {(a.cls, a.attr): a for a in attrs}
+
+    scanner = _Scanner(srcs, lock_keys)
+    scanner.scan()
+    scanner.propagate()
+    findings.extend(scanner.marker_findings)
+
+    def allowed(rule, f, node) -> bool:
+        return f.src.allowed(rule, node)
+
+    # -- ownership ----------------------------------------------------------
+    written: set[tuple] = set()
+    for f in scanner.funcs:
+        is_init = f.name in _INIT_NAMES
+        for attr, node, held, _aug in f.writes:
+            if f.cls in registered and not is_init:
+                written.add((f.cls, attr))
+            if is_init or f.cls not in registered:
+                continue
+            a = spec.get((f.cls, attr))
+            if a is None:
+                if not allowed(RULE_UNREG, f, node):
+                    findings.append(Finding(
+                        RULE_UNREG, f.src.path, node.lineno,
+                        f"{f.cls}.{attr} is written here but has no "
+                        f"OwnedAttr row in statics/ownership_registry.py "
+                        f"— declare its owner context or guarding lock"))
+                continue
+            if a.lock:
+                want = (f.cls, a.lock) if (f.cls, a.lock) in lock_keys \
+                    else ("", a.lock)
+                if want not in held and not allowed(RULE_WRITE, f, node):
+                    findings.append(Finding(
+                        RULE_WRITE, f.src.path, node.lineno,
+                        f"{f.cls}.{attr} is declared guarded by "
+                        f"{a.lock} but this write in {f.qualname} does "
+                        f"not hold it"))
+                continue
+            if a.owner == ANY or not f.contexts:
+                continue
+            if a.owner == INIT:
+                if not allowed(RULE_WRITE, f, node):
+                    findings.append(Finding(
+                        RULE_WRITE, f.src.path, node.lineno,
+                        f"{f.cls}.{attr} is construction-only (owner "
+                        f"'init') but {f.qualname} writes it from "
+                        f"runtime context(s) {sorted(f.contexts)}"))
+                continue
+            if f.contexts - {a.owner}:
+                if not allowed(RULE_WRITE, f, node):
+                    others = sorted(f.contexts - {a.owner})
+                    findings.append(Finding(
+                        RULE_WRITE, f.src.path, node.lineno,
+                        f"{f.cls}.{attr} is owned by context "
+                        f"'{a.owner}' but {f.qualname} also runs in "
+                        f"{others} — move the write to the owner, guard "
+                        f"it with a declared lock, or re-declare "
+                        f"ownership"))
+
+    # A scanned class with runtime self-writes that the registry does not
+    # cover at all would silently dodge every ownership rule.
+    seen_classes = {f.cls for f in scanner.funcs
+                    if f.cls and any(fn.name not in _INIT_NAMES
+                                     and fn.writes
+                                     for fn in scanner.by_class[f.cls])}
+    for cls in sorted(seen_classes):
+        if cls in registered:
+            continue
+        if any(a.cls == cls for a in attrs) or \
+                any(ld.cls == cls for ld in locks):
+            continue
+        fns = [fn for fn in scanner.by_class[cls]
+               if fn.name not in _INIT_NAMES and fn.writes]
+        node = fns[0].writes[0][1]
+        if not fns[0].src.allowed(RULE_CLASS, node):
+            findings.append(Finding(
+                RULE_CLASS, fns[0].src.path, node.lineno,
+                f"class {cls} mutates self state outside __init__ but "
+                f"is not in ownership_registry.REGISTERED_CLASSES — "
+                f"register it (with OwnedAttr rows) or pragma why its "
+                f"state is single-threaded"))
+
+    for (cls, attr), a in sorted(spec.items()):
+        if cls in registered and (cls, attr) not in written:
+            findings.append(Finding(
+                RULE_DEAD,
+                os.path.join("agentic_traffic_testing_tpu", "statics",
+                             "ownership_registry.py"), 1,
+                f"registered attribute {cls}.{attr} is never written "
+                f"outside __init__ in the scanned files — delete the "
+                f"row or the dead write path"))
+
+    # -- lock-free contracts ------------------------------------------------
+    for f in scanner.funcs:
+        if not f.lockfree:
+            continue
+        for attr, node, _held, aug in f.writes:
+            if not allowed(RULE_LF_MUT, f, node):
+                shape = ("read-modify-write" if aug
+                         else "mutation")
+                findings.append(Finding(
+                    RULE_LF_MUT, f.src.path, node.lineno,
+                    f"{f.qualname} documents a lock-free contract but "
+                    f"performs a {shape} of self.{attr} — lock-free "
+                    f"methods must be pure snapshots (move the mutation "
+                    f"behind a lock or drop the contract)"))
+        for attr, nodes in sorted(f.reads.items()):
+            if (f.cls, attr) not in spec or len(nodes) < 2:
+                continue
+            node = nodes[1]
+            if not allowed(RULE_LF_READ, f, node):
+                findings.append(Finding(
+                    RULE_LF_READ, f.src.path, node.lineno,
+                    f"{f.qualname} documents a lock-free contract but "
+                    f"reads self.{attr} more than once — another thread "
+                    f"can change it between reads; snapshot it into a "
+                    f"local first"))
+
+    # -- lock discipline ----------------------------------------------------
+    for key, f, node in scanner.reacquisitions:
+        if allowed(RULE_ORDER, f, node):
+            continue
+        findings.append(Finding(
+            RULE_ORDER, f.src.path, node.lineno,
+            f"{f.qualname} re-acquires {_fmt_lock(key)} while already "
+            f"holding it — threading.Lock is not reentrant; this "
+            f"deadlocks the thread immediately"))
+    trans_acq = scanner.transitive_acquires()
+    for f in scanner.funcs:
+        for ref, node, held in f.under_lock_calls:
+            again = trans_acq[ref] & held
+            if again and not allowed(RULE_ORDER, f, node):
+                findings.append(Finding(
+                    RULE_ORDER, f.src.path, node.lineno,
+                    f"call to {ref.qualname}() holds "
+                    f"{', '.join(sorted(_fmt_lock(k) for k in again))} "
+                    f"which the callee (transitively) acquires again — "
+                    f"threading.Lock is not reentrant; this deadlocks "
+                    f"(use a locked(...) helper that assumes the lock "
+                    f"instead)"))
+    for outer, inner, f, node in _lock_cycles(scanner.lock_edges):
+        if allowed(RULE_ORDER, f, node):
+            continue
+        findings.append(Finding(
+            RULE_ORDER, f.src.path, node.lineno,
+            f"acquiring {_fmt_lock(inner)} while holding "
+            f"{_fmt_lock(outer)} participates in an acquisition-order "
+            f"cycle — two threads taking the locks in opposite order "
+            f"deadlock; impose one global order"))
+
+    trans = scanner.transitive_blocking()
+    for f in scanner.funcs:
+        for node, desc, held in f.blocking:
+            if held and not allowed(RULE_BLOCK, f, node):
+                findings.append(Finding(
+                    RULE_BLOCK, f.src.path, node.lineno,
+                    f"{desc} while holding "
+                    f"{', '.join(sorted(_fmt_lock(k) for k in held))} — "
+                    f"every other thread contending the lock stalls "
+                    f"behind it; move the blocking work outside"))
+        for ref, node, held in f.under_lock_calls:
+            if not trans[ref]:
+                continue
+            if allowed(RULE_BLOCK, f, node):
+                continue
+            via = sorted(trans[ref])[0]
+            findings.append(Finding(
+                RULE_BLOCK, f.src.path, node.lineno,
+                f"call to {ref.qualname}() holds "
+                f"{', '.join(sorted(_fmt_lock(k) for k in held))} while "
+                f"the callee (transitively) performs {via} — move the "
+                f"blocking work outside the lock"))
+        for ref, node, held in f.calls:
+            missing = ref.assumed - held
+            if missing and not allowed(RULE_LOCKED, f, node):
+                findings.append(Finding(
+                    RULE_LOCKED, f.src.path, node.lineno,
+                    f"{ref.qualname} is declared locked("
+                    f"{', '.join(sorted(_fmt_lock(k) for k in missing))}) "
+                    f"but this call site in {f.qualname} does not hold "
+                    f"it — take the lock first (or drop the helper's "
+                    f"locked(...) marker)"))
+        for node, held in f.awaits:
+            if not allowed(RULE_AWAIT, f, node):
+                findings.append(Finding(
+                    RULE_AWAIT, f.src.path, node.lineno,
+                    f"await while holding threading lock "
+                    f"{', '.join(sorted(_fmt_lock(k) for k in held))} — "
+                    f"the suspended coroutine keeps the lock held across "
+                    f"arbitrary event-loop turns (use asyncio.Lock, or "
+                    f"release before awaiting)"))
+
+    # -- generated doc ------------------------------------------------------
+    doc_abs = doc_path or os.path.join(root, DOC_RELPATH)
+    from agentic_traffic_testing_tpu.statics.common import doc_drift_finding
+
+    drift = doc_drift_finding(
+        RULE_DOCS, doc_abs, DOC_RELPATH,
+        render(root, paths=paths, attrs=attrs, locks=locks, srcs=srcs),
+        "the thread markers + ownership registry")
+    if drift is not None:
+        findings.append(drift)
+    return findings
+
+
+# -- docs/threading.md -------------------------------------------------------
+
+
+def render(root: Optional[str] = None,
+           paths: Optional[Iterable[str]] = None,
+           attrs: tuple = OWNED_ATTRS,
+           locks: tuple = LOCKS,
+           srcs: Optional[list] = None) -> str:
+    """The generated docs/threading.md content: the declared context map
+    plus the ownership + lock tables (regenerate via
+    `python scripts/dev/statics_all.py --write-docs`). `srcs` lets
+    check() hand over its already-parsed SourceFiles instead of paying
+    the 8-file parse a second time for the drift diff."""
+    root = root or repo_root()
+    if paths is None:
+        paths = [os.path.join(root, p) for p in SCAN_RELPATHS]
+    lines = [
+        "# Thread model (serving plane)",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand. -->",
+        "<!-- Source of truth: `# statics: thread(...)` markers + "
+        "agentic_traffic_testing_tpu/statics/ownership_registry.py; -->",
+        "<!-- regenerate with `python scripts/dev/statics_all.py "
+        "--write-docs`. -->",
+        "",
+        "Four execution contexts touch serving state; "
+        "`statics/concurrency.py` machine-checks the discipline below "
+        "and `LLM_CONCURRENCY_CHECK=1` asserts it at runtime "
+        "(docs/statics.md):",
+        "",
+        "| Context | Thread | Role |",
+        "|---|---|---|",
+        "| `engine-loop` | one OS thread per replica "
+        "(`AsyncLLMEngine._run`) | every device dispatch and all engine "
+        "mutation |",
+        "| `handler` | the asyncio event-loop thread | request "
+        "admission, routing, streaming |",
+        "| `health-probe` | event-loop thread (background tasks) | "
+        "quarantine re-admission, concurrency probe |",
+        "| `scrape` | event-loop thread (`GET /metrics`) | pool "
+        "aggregation, recorder drains |",
+        "",
+        "## Declared context map",
+        "",
+        "Functions carrying a `# statics: thread(...)` marker; unmarked",
+        "helpers inherit the union of their callers' contexts through",
+        "the call graph.",
+        "",
+        "| Function | Context(s) | File |",
+        "|---|---|---|",
+    ]
+    rows = []
+    for i, p in enumerate(paths):
+        src = srcs[i] if srcs is not None else SourceFile(p, root)
+        markers = _thread_markers(src)
+
+        def visit(node, cls):
+            for stmt in (node.body if isinstance(
+                    node, (ast.ClassDef, ast.Module)) else ()):
+                if isinstance(stmt, ast.ClassDef):
+                    visit(stmt, stmt.name)
+                elif isinstance(stmt,
+                                (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    hit = _marker_for(stmt, markers)
+                    if hit is not None:
+                        qual = (f"{cls}.{stmt.name}" if cls
+                                else stmt.name)
+                        rows.append((src.path, qual,
+                                     ", ".join(hit[0])))
+
+        visit(src.tree, "")
+    for path, qual, ctxs in rows:
+        lines.append(f"| `{qual}` | {ctxs} | `{path}` |")
+    lines += [
+        "",
+        "## Attribute ownership",
+        "",
+        "Every non-`__init__` write to these attributes must come from",
+        "the owner context or hold the guarding lock "
+        "(`thread-unowned-write`).",
+        "`init` = construction-only; `any` = documented multi-context",
+        "lock-free contract.",
+        "",
+        "| Class | Attribute | Owner | Lock | Note |",
+        "|---|---|---|---|---|",
+    ]
+    for a in attrs:
+        owner = a.owner or "—"
+        lock = f"`{a.lock}`" if a.lock else "—"
+        lines.append(f"| `{a.cls}` | `{a.attr}` | {owner} | {lock} | "
+                     f"{a.note} |")
+    lines += [
+        "",
+        "## Locks",
+        "",
+        "| Lock | Kind | Note |",
+        "|---|---|---|",
+    ]
+    for ld in locks:
+        name = f"{ld.cls}.{ld.attr}" if ld.cls else ld.attr
+        lines.append(f"| `{name}` | {ld.kind} | {ld.note} |")
+    lines.append("")
+    return "\n".join(lines)

@@ -124,6 +124,11 @@ KNOBS: tuple[Knob, ...] = (
     Knob("LLM_FAULT_SEED", "int", "0", "serving/config.py",
          "Seed for the per-point fault-injection RNG streams (replica i "
          "offsets by +i)."),
+    Knob("LLM_CONCURRENCY_CHECK", "bool", "0", "runtime/concurrency.py",
+         "1 installs runtime thread-ownership assertions compiled from "
+         "statics/ownership_registry.py (docs/threading.md); 0 = no "
+         "wrappers, hot paths byte-identical — debugging/chaos-test "
+         "only."),
     Knob("LLM_PREFIX_CACHING", "bool", "0", "serving/config.py",
          "Content-addressed reuse of full prompt blocks."),
     Knob("LLM_HOST_CACHE_GB", "float", "0", "serving/config.py",

@@ -1,0 +1,266 @@
+"""Declarative thread-ownership registry for the concurrency statics.
+
+Rounds 7-9 made the server a genuinely concurrent system: per-replica
+engine threads (serving/async_engine.py `engine-loop`), asyncio request
+handlers, a background health-probe task and the /metrics scrape all
+touch `LLMEngine` / `EnginePool` / `ReplicaHealth` / `StepClock` /
+`HostKVStore` state. The discipline holding that together used to be
+docstrings ("lock-free on purpose", "single dict read under the GIL");
+this table makes it machine-checked: every mutable attribute of the
+registered classes declares WHO may write it — one thread context, or a
+guarding lock — and `statics/concurrency.py` fails tier-1 on any write
+that breaks the declaration. `runtime/concurrency.py` compiles the SAME
+table into runtime ownership assertions (`LLM_CONCURRENCY_CHECK=1`), so
+churn tests double as a dynamic race detector.
+
+Adding an owned attribute = add the write, add an `OwnedAttr` row,
+regenerate docs/threading.md (`statics_all.py --write-docs`). The
+checker fails on unregistered writes, dead rows, and doc drift —
+exactly the knob-registry contract (statics/knob_registry.py).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+# -- thread contexts ---------------------------------------------------------
+#
+# The four execution contexts of the serving plane (docs/threading.md).
+# `engine-loop` is its own OS thread (one per replica); the other three
+# are logical roles of the asyncio event-loop thread — distinct for the
+# static map (who calls what) and grouped by the runtime sanitizer
+# (which can only observe OS threads).
+
+ENGINE_LOOP = "engine-loop"    # AsyncLLMEngine._run dispatch thread (per replica)
+HANDLER = "handler"            # asyncio request handlers + routing path
+HEALTH_PROBE = "health-probe"  # background probe/concurrency-probe tasks
+SCRAPE = "scrape"              # GET /metrics aggregation path
+
+CONTEXTS = (ENGINE_LOOP, HANDLER, HEALTH_PROBE, SCRAPE)
+
+#: special owners: "init" = construction only (any runtime write is a
+#: finding); "any" = documented multi-context lock-free contract (the
+#: lock-free rules still apply to methods that declare the contract).
+INIT = "init"
+ANY = "any"
+
+#: sanitizer thread classes: every context maps to the OS-thread role the
+#: runtime sanitizer can actually distinguish (runtime/concurrency.py).
+THREAD_CLASS = {
+    ENGINE_LOOP: "engine",
+    HANDLER: "serving",
+    HEALTH_PROBE: "serving",
+    SCRAPE: "serving",
+}
+
+
+class OwnedAttr(NamedTuple):
+    cls: str    # class declaring the attribute
+    attr: str   # attribute name
+    owner: str  # owning context, "init", "any", or "" when lock-guarded
+    lock: str   # guarding lock attribute ("" = ownership is the guard)
+    note: str   # one-line why (becomes the docs/threading.md row)
+
+
+class LockDecl(NamedTuple):
+    cls: str    # declaring class; "" for a module-level lock
+    attr: str   # lock attribute / global name
+    kind: str   # "threading" | "asyncio"
+    note: str
+
+
+#: classes the concurrency checker audits: every non-__init__ write to a
+#: `self.<attr>` of these classes must have an OwnedAttr row. Maps class
+#: name -> "module:Class" import path for the runtime sanitizer.
+REGISTERED_CLASSES = {
+    "LLMEngine": "agentic_traffic_testing_tpu.runtime.engine:LLMEngine",
+    "AsyncLLMEngine":
+        "agentic_traffic_testing_tpu.serving.async_engine:AsyncLLMEngine",
+    "EnginePool":
+        "agentic_traffic_testing_tpu.serving.replica_pool:EnginePool",
+    "ReplicaHealth":
+        "agentic_traffic_testing_tpu.serving.replica_pool:ReplicaHealth",
+    "LLMServer": "agentic_traffic_testing_tpu.serving.server:LLMServer",
+    "StepClock": "agentic_traffic_testing_tpu.runtime.telemetry:StepClock",
+    "HostKVStore":
+        "agentic_traffic_testing_tpu.runtime.kv_offload:HostKVStore",
+}
+
+
+LOCKS: tuple[LockDecl, ...] = (
+    LockDecl("ReplicaHealth", "_mu", "threading",
+             "serializes health transitions: engine-thread step outcomes "
+             "vs routing-path watchdog vs background probe"),
+    LockDecl("StepClock", "_lock", "threading",
+             "guards the step ring + timeline containers against "
+             "HTTP-thread readers iterating mid-mutation"),
+    LockDecl("HostKVStore", "_lock", "threading",
+             "one store shared by every replica's step thread + the "
+             "router's probe path"),
+    LockDecl("LLMServer", "_arrival_lock", "asyncio",
+             "interarrival histogram stamp (handlers only)"),
+    LockDecl("LLMServer", "_inflight_lock", "asyncio",
+             "inflight gauge increments (handlers only)"),
+    LockDecl("", "_pipe_lock", "threading",
+             "cpu_server pipeline registry (ThreadingHTTPServer handler "
+             "threads race on first build)"),
+    LockDecl("", "_build_lock", "threading",
+             "cpu_server cold-start build serializer: held across the "
+             "(blocking, pragma'd) model build so racers wait for one "
+             "build instead of N-fold loading; never contended by "
+             "handlers once pipelines exist"),
+)
+
+
+OWNED_ATTRS: tuple[OwnedAttr, ...] = (
+    # -- LLMEngine (runtime/engine.py) -----------------------------------
+    # The engine is intentionally single-threaded: ONE thread (the
+    # engine-loop, or the bench/test driver standing in for it) owns
+    # every mutation; other threads read through the lock-free snapshot
+    # methods (load_snapshot / probe_prefix_tokens / kv_stats).
+    OwnedAttr("LLMEngine", "cache", ENGINE_LOOP,
+              "", "KV pool handle; rebound on every donated dispatch"),
+    OwnedAttr("LLMEngine", "_inflight", ENGINE_LOOP,
+              "", "dispatched-step queue (len() is read by load_snapshot)"),
+    OwnedAttr("LLMEngine", "_requests", ENGINE_LOOP,
+              "", "live request map (abort path keys on it)"),
+    OwnedAttr("LLMEngine", "_new_tokens", ENGINE_LOOP,
+              "", "per-step event accumulator flushed by _flush_events"),
+    OwnedAttr("LLMEngine", "_decode_requests", ENGINE_LOOP,
+              "", "composition of the armed decode state"),
+    OwnedAttr("LLMEngine", "_decode_state", ENGINE_LOOP,
+              "", "device-resident DecodeState carry"),
+    OwnedAttr("LLMEngine", "_decode_tables", ENGINE_LOOP,
+              "", "device-resident [B, W] block tables"),
+    OwnedAttr("LLMEngine", "_decode_samp", ENGINE_LOOP,
+              "", "armed SamplingArrays"),
+    OwnedAttr("LLMEngine", "_decode_block_counts", ENGINE_LOOP,
+              "", "per-lane block counts backing the table refresh"),
+    OwnedAttr("LLMEngine", "_decode_epoch", ENGINE_LOOP,
+              "", "scheduler epoch the armed batch saw (overlap hint)"),
+    OwnedAttr("LLMEngine", "_samp_cache", ENGINE_LOOP,
+              "", "SamplingArrays LRU memo"),
+    OwnedAttr("LLMEngine", "_save_pending", ENGINE_LOOP,
+              "", "host-tier save queue drained by _flush_saves"),
+    OwnedAttr("LLMEngine", "_deadline_ids", ENGINE_LOOP,
+              "", "request ids carrying a deadline (step sweep input)"),
+    OwnedAttr("LLMEngine", "host_restore_bytes", ENGINE_LOOP,
+              "", "cumulative host-tier restore bytes (scrape reads)"),
+    OwnedAttr("LLMEngine", "num_steps", ENGINE_LOOP,
+              "", "cumulative step counter"),
+    OwnedAttr("LLMEngine", "num_pipeline_dispatches", ENGINE_LOOP,
+              "", "pipelined-prefill dispatch counter (scrape reads)"),
+    OwnedAttr("LLMEngine", "num_overlap_dispatches", ENGINE_LOOP,
+              "", "overlap fast-path dispatch counter (scrape reads)"),
+    OwnedAttr("LLMEngine", "num_overlap_mispredicts", ENGINE_LOOP,
+              "", "overlap mispredict counter (scrape reads)"),
+    OwnedAttr("LLMEngine", "_overlap_unharvested", ENGINE_LOOP,
+              "", "predicted dispatches not yet applied"),
+    OwnedAttr("LLMEngine", "num_dispatch_failures", ENGINE_LOOP,
+              "", "batch-isolated dispatch failures (scrape reads)"),
+    OwnedAttr("LLMEngine", "num_deadline_expired", ENGINE_LOOP,
+              "", "deadline sweep aborts (scrape reads)"),
+    OwnedAttr("LLMEngine", "num_restore_fallbacks", ENGINE_LOOP,
+              "", "host-tier restores degraded to recompute (scrape reads)"),
+    OwnedAttr("LLMEngine", "num_shed", ENGINE_LOOP,
+              "", "bounded-queue admission refusals (scrape reads)"),
+    OwnedAttr("LLMEngine", "spec_iters", ENGINE_LOOP,
+              "", "speculative verify iterations (scrape reads)"),
+    OwnedAttr("LLMEngine", "spec_emitted", ENGINE_LOOP,
+              "", "speculative emitted tokens (scrape reads)"),
+    OwnedAttr("LLMEngine", "telemetry", ENGINE_LOOP,
+              "", "StepClock recorder; attached at build or by bench "
+              "probes before stepping"),
+    # -- AsyncLLMEngine (serving/async_engine.py) ------------------------
+    OwnedAttr("AsyncLLMEngine", "_streams", ENGINE_LOOP,
+              "", "request-id -> stream map; the engine thread is the "
+              "only mutator (submissions ride the queue)"),
+    OwnedAttr("AsyncLLMEngine", "_started", HANDLER,
+              "", "start() latch (app startup, event-loop thread)"),
+    # -- EnginePool (serving/replica_pool.py) ----------------------------
+    OwnedAttr("EnginePool", "routed_requests", HANDLER,
+              "", "per-replica routing counters; single-writer on the "
+              "event loop (sync bench drives are single-threaded)"),
+    OwnedAttr("EnginePool", "request_retries", HANDLER,
+              "", "retry-once failovers (scrape reads)"),
+    # -- ReplicaHealth (serving/replica_pool.py) -------------------------
+    # Written from three contexts by design (engine-thread step outcomes,
+    # routing-path watchdog, background probe): every transition holds
+    # _mu (round 10 — the transitions used to be racy read-modify-writes).
+    OwnedAttr("ReplicaHealth", "state", "", "_mu",
+              "healthy/degraded/quarantined machine state"),
+    OwnedAttr("ReplicaHealth", "consecutive_errors", "", "_mu",
+              "error streak driving quarantine"),
+    OwnedAttr("ReplicaHealth", "quarantined_until", "", "_mu",
+              "cooldown deadline"),
+    OwnedAttr("ReplicaHealth", "num_quarantines", "", "_mu",
+              "cumulative count driving the exponential backoff"),
+    OwnedAttr("ReplicaHealth", "_cause", "", "_mu",
+              "errors|stuck (stuck-quarantines heal on a clean step)"),
+    OwnedAttr("ReplicaHealth", "_step_started_t", "", "_mu",
+              "watchdog stamp (engine thread writes, routing path reads)"),
+    # -- LLMServer (serving/server.py) -----------------------------------
+    OwnedAttr("LLMServer", "_inflight", HANDLER, "_inflight_lock",
+              "inflight gauge mirror"),
+    OwnedAttr("LLMServer", "_last_arrival", HANDLER, "_arrival_lock",
+              "interarrival stamp"),
+    OwnedAttr("LLMServer", "_wait_per_slot", HANDLER,
+              "", "queue-wait EWMA: read-modify-write is safe because "
+              "handlers share one event-loop thread and never await "
+              "inside the update"),
+    OwnedAttr("LLMServer", "_probe_task", HANDLER,
+              "", "concurrency-probe task handle (startup/cleanup)"),
+    OwnedAttr("LLMServer", "_health_task", HANDLER,
+              "", "health-probe task handle (startup/cleanup)"),
+    OwnedAttr("LLMServer", "model_loaded", INIT,
+              "", "checkpoint-vs-random flag set during engine build"),
+    OwnedAttr("LLMServer", "_ctx_window", HANDLER,
+              "", "finished-request context lengths feeding the "
+              "concurrency probe (bounded deque; probe task reads)"),
+    # -- StepClock (runtime/telemetry.py) --------------------------------
+    OwnedAttr("StepClock", "_seq", "", "_lock",
+              "step-record sequence number"),
+    OwnedAttr("StepClock", "num_dispatches", "", "_lock",
+              "cumulative dispatch count"),
+    OwnedAttr("StepClock", "num_drains", "", "_lock",
+              "cumulative drain count"),
+    OwnedAttr("StepClock", "num_requests_retired", "", "_lock",
+              "cumulative retired-timeline count"),
+    OwnedAttr("StepClock", "_live", "", "_lock",
+              "live per-request timelines (HTTP thread snapshots them)"),
+    OwnedAttr("StepClock", "steps", "", "_lock",
+              "bounded step-record ring (HTTP thread snapshots it)"),
+    OwnedAttr("StepClock", "_retired", "", "_lock",
+              "retired-timeline ring"),
+    OwnedAttr("StepClock", "last_decode_batch", ENGINE_LOOP,
+              "", "most recent decode occupancy (gauge; single write)"),
+    # Exporter drain queues: engine-loop appends, the scrape thread
+    # drains via popleft on a LOCAL reference (deque ops are atomic
+    # under the GIL; worst outcome is a sample landing next scrape).
+    OwnedAttr("StepClock", "ttft_samples", ENGINE_LOOP,
+              "", "TTFT sample drain queue (lock-free deque contract)"),
+    OwnedAttr("StepClock", "itl_samples", ENGINE_LOOP,
+              "", "ITL sample drain queue (lock-free deque contract)"),
+    OwnedAttr("StepClock", "slo_events", ENGINE_LOOP,
+              "", "SLO verdict drain queue (lock-free deque contract)"),
+    OwnedAttr("StepClock", "step_samples", ENGINE_LOOP,
+              "", "per-phase duration drain queue (lock-free deque "
+              "contract)"),
+    # -- HostKVStore (runtime/kv_offload.py) -----------------------------
+    OwnedAttr("HostKVStore", "_entries", "", "_lock",
+              "LRU entry map (every replica's step thread + router probe)"),
+    OwnedAttr("HostKVStore", "used_bytes", "", "_lock",
+              "byte budget accounting"),
+    OwnedAttr("HostKVStore", "saved_blocks", "", "_lock",
+              "cumulative successful put()s"),
+    OwnedAttr("HostKVStore", "evicted_blocks", "", "_lock",
+              "cumulative LRU evictions"),
+    OwnedAttr("HostKVStore", "corrupt_dropped", "", "_lock",
+              "validation failures degraded to misses"),
+    OwnedAttr("HostKVStore", "invalidated_blocks", "", "_lock",
+              "explicit restore-fallback drops"),
+    OwnedAttr("HostKVStore", "_page_shape", "", "_lock",
+              "page geometry attested by the first put()"),
+    OwnedAttr("HostKVStore", "_page_dtypes", "", "_lock",
+              "page dtype pair attested by the first put()"),
+)

@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Run the statics plane: every AST invariant checker, one JSON report.
 
-The five checkers (agentic_traffic_testing_tpu/statics/):
+The six checkers (agentic_traffic_testing_tpu/statics/):
 
   knobs         every LLM_*/ATT_*/BENCH_* env read is registered in
                 statics/knob_registry.py, no registry entry is dead, and
@@ -13,6 +13,11 @@ The five checkers (agentic_traffic_testing_tpu/statics/):
                 marked hot regions of engine.py/runner.py
   donation      no caller reads a buffer after donating it to a runner
                 dispatch
+  concurrency   thread-ownership lint + lock discipline for the serving
+                plane (thread-context markers, attribute ownership vs
+                statics/ownership_registry.py, lock-order cycles,
+                blocking-under-lock, await-under-threading-lock,
+                docs/threading.md parity)
   metric-docs   Prometheus families <-> docs/monitoring.md parity
                 (scripts/dev/check_metric_docs.py behind a thin shim)
 
@@ -20,12 +25,17 @@ Usage:
   python scripts/dev/statics_all.py              # check; JSON report
   python scripts/dev/statics_all.py --write-docs # regenerate the
                                                  # generated docs first
+  python scripts/dev/statics_all.py --only concurrency   # one checker
+
+The report carries per-checker `wall_time_s` so CI can spot a checker
+whose scan cost regressed.
 
 Exit 0 when every checker is clean (all findings either fixed or
 pragma'd with `# statics: allow-<rule>(<reason>)`), 1 otherwise.
 Wired into tests/test_scripts.py as a default-tier smoke, so tier-1
 fails on any new unregistered knob, missing guard, hot-region sync,
-post-donation read, or matrix/doc drift.
+post-donation read, unowned cross-thread write, lock-discipline
+violation, or matrix/doc drift.
 """
 
 from __future__ import annotations
@@ -42,11 +52,15 @@ sys.path.insert(0, REPO)
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--write-docs", action="store_true",
-                   help="regenerate docs/knobs.md + docs/capabilities.md "
-                        "from their source-of-truth surfaces before "
-                        "checking")
+                   help="regenerate docs/knobs.md, docs/capabilities.md "
+                        "+ docs/threading.md from their source-of-truth "
+                        "surfaces before checking")
     p.add_argument("--quiet", action="store_true",
                    help="suppress the JSON report; exit code only")
+    p.add_argument("--only", action="append", metavar="CHECKER",
+                   help="run only this checker (repeatable); names are "
+                        "the report keys (knobs, capabilities, "
+                        "host-sync, donation, concurrency, metric-docs)")
     a = p.parse_args(argv)
 
     from agentic_traffic_testing_tpu.statics import run_all, write_docs
@@ -54,7 +68,11 @@ def main(argv=None) -> int:
     if a.write_docs:
         for rel in write_docs(REPO):
             print(f"wrote {rel}", file=sys.stderr)
-    report = run_all(REPO)
+    try:
+        report = run_all(REPO, only=a.only)
+    except ValueError as exc:   # unknown --only name
+        print(str(exc), file=sys.stderr)
+        return 2
     if not a.quiet:
         print(json.dumps(report, indent=2))
     if not report["ok"]:

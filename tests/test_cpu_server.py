@@ -111,3 +111,57 @@ def test_num_replicas_rejects_hf_model_at_startup(monkeypatch):
     monkeypatch.setenv("LLM_NUM_REPLICAS", "0")
     with pytest.raises(RuntimeError, match=">= 1"):
         cs._num_replicas()
+
+
+def test_pipeline_build_never_holds_lock(monkeypatch):
+    """Round-10 lock-discipline fix (statics thread-blocking-under-lock):
+    the pipeline build — an HF checkpoint download on real models —
+    happens OUTSIDE _pipe_lock, so concurrent handler threads are never
+    serialized behind one cold-start build."""
+    import agentic_traffic_testing_tpu.serving.cpu_server as cs
+
+    monkeypatch.setattr(cs, "_pipes", [])
+    monkeypatch.setenv("LLM_NUM_REPLICAS", "1")
+    monkeypatch.setenv("LLM_MODEL", "tiny")
+    built = []
+
+    def fake_build():
+        assert not cs._pipe_lock.locked(), "pipeline built under _pipe_lock"
+        built.append(object())
+        return built[-1]
+
+    monkeypatch.setattr(cs, "_build_tiny", fake_build)
+    p = cs.get_pipeline()
+    assert p is built[0] and len(cs._pipes) == 1
+
+
+def test_pipeline_build_race_builds_exactly_once(monkeypatch):
+    """Threads racing the first request serialize on _build_lock: exactly
+    ONE build runs (losers wait, re-check the registry, and reuse it) —
+    no N-fold model loads on a cold start, and no double install."""
+    import threading as th
+    import time as time_mod
+
+    import agentic_traffic_testing_tpu.serving.cpu_server as cs
+
+    monkeypatch.setattr(cs, "_pipes", [])
+    monkeypatch.setenv("LLM_NUM_REPLICAS", "1")
+    monkeypatch.setenv("LLM_MODEL", "tiny")
+    calls = []
+
+    def fake_build():
+        calls.append(th.current_thread().name)
+        time_mod.sleep(0.2)   # wide window for the racers to pile up
+        return object()
+
+    monkeypatch.setattr(cs, "_build_tiny", fake_build)
+    out = []
+    ts = [th.Thread(target=lambda: out.append(cs.get_pipeline()))
+          for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(10)
+    assert len(calls) == 1          # one build, not one per racer
+    assert len(cs._pipes) == 1
+    assert all(p is cs._pipes[0] for p in out)

@@ -375,6 +375,56 @@ def test_replica_watchdog_quarantines_stuck_step():
     assert h.state == HEALTHY
 
 
+def test_replica_health_transitions_serialize():
+    """Round-10 race fix (concurrency statics): every ReplicaHealth
+    transition holds _mu, so an engine-thread step outcome cannot
+    interleave with the routing-path watchdog or the probe — the
+    double-backoff / HEALTHY-overwrites-fresh-QUARANTINE shapes the
+    unlocked read-modify-writes allowed."""
+    import threading
+
+    h = ReplicaHealth(error_threshold=1, cooldown_s=60.0)
+    started = threading.Event()
+    done = threading.Event()
+
+    def engine_side():
+        started.set()
+        h.record_error()             # must wait for _mu
+        done.set()
+
+    with h._mu:
+        t = threading.Thread(target=engine_side, name="engine-loop-t")
+        t.start()
+        assert started.wait(1)
+        assert not done.wait(0.05)   # transition blocked on the held lock
+    t.join(1)
+    assert done.is_set()
+    assert h.state == QUARANTINED and h.num_quarantines == 1
+
+
+def test_replica_health_concurrent_errors_quarantine_once():
+    """N threads reporting errors at once produce exactly ONE quarantine
+    (threshold=1): before the lock, two racers could both pass the
+    `state is QUARANTINED` check and both _quarantine, doubling the
+    backoff exponent per extra thread."""
+    import threading
+
+    h = ReplicaHealth(error_threshold=1, cooldown_s=60.0)
+    barrier = threading.Barrier(8, timeout=5)
+
+    def hammer():
+        barrier.wait()
+        h.record_error()
+
+    ts = [threading.Thread(target=hammer) for _ in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(5)
+    assert h.state == QUARANTINED
+    assert h.num_quarantines == 1
+
+
 def test_pool_quarantine_failover_and_readmit(runner):
     """2-replica pool, replica 1 fault-injected to fail every dispatch:
     un-started requests retry once onto replica 0 (no hung streams),

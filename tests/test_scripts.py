@@ -376,8 +376,10 @@ def test_statics_all_smoke(capsys):
     unsuppressed findings — tier-1 therefore fails on any new
     unregistered env knob, supports_* flag without a refusal guard,
     un-pragma'd host sync in a hot region, post-donation buffer read,
-    or knob/capability doc drift (the per-checker behavior is pinned in
-    tests/test_statics.py against fixture trees)."""
+    unowned cross-thread attribute write, lock-discipline violation, or
+    knob/capability/threading doc drift (the per-checker behavior is
+    pinned in tests/test_statics.py and tests/test_statics_concurrency.py
+    against fixture trees)."""
     statics_all = load_script("scripts/dev/statics_all.py", "statics_all")
     rc = statics_all.main([])
     out = capsys.readouterr().out
@@ -387,7 +389,26 @@ def test_statics_all_smoke(capsys):
     report = json_mod.loads(out)
     assert report["ok"] is True
     assert set(report["checkers"]) == {
-        "knobs", "capabilities", "host-sync", "donation", "metric-docs"}
+        "knobs", "capabilities", "host-sync", "donation", "concurrency",
+        "metric-docs"}
+    # Per-checker wall time rides the report so CI can spot a checker
+    # whose scan cost regressed.
+    for entry in report["checkers"].values():
+        assert isinstance(entry["wall_time_s"], float)
+
+
+def test_statics_all_only_flag(capsys):
+    """--only runs a single checker (fast edit-loop mode) and rejects
+    unknown names with exit 2."""
+    statics_all = load_script("scripts/dev/statics_all.py", "statics_all")
+    rc = statics_all.main(["--only", "concurrency"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    import json as json_mod
+
+    report = json_mod.loads(out)
+    assert set(report["checkers"]) == {"concurrency"}
+    assert statics_all.main(["--only", "nonesuch", "--quiet"]) == 2
 
 
 # --------------------------------------------------------- platform guard

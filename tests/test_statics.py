@@ -617,7 +617,8 @@ def test_run_all_green_on_tree():
         name: c["findings"] for name, c in report["checkers"].items()
         if c["findings"]}
     assert set(report["checkers"]) == {
-        "knobs", "capabilities", "host-sync", "donation", "metric-docs"}
+        "knobs", "capabilities", "host-sync", "donation", "concurrency",
+        "metric-docs"}
 
 
 def test_run_all_dedups_repeats_not_distinct_findings(monkeypatch):
@@ -639,16 +640,21 @@ def test_run_all_dedups_repeats_not_distinct_findings(monkeypatch):
 def test_generated_docs_round_trip(tmp_path):
     """write_docs output == committed docs (the regenerate-and-diff gate,
     exercised through the real --write-docs file-writing path)."""
-    # Mirror the runner sources into a tmp root so write_docs() runs its
-    # actual path joins and file writes without touching the repo.
-    for rel in (capabilities.RUNNER_RELPATH,) + capabilities.MESH_RELPATHS:
+    # Mirror the runner + serving-plane sources into a tmp root so
+    # write_docs() runs its actual path joins and file writes without
+    # touching the repo.
+    from agentic_traffic_testing_tpu.statics import concurrency
+
+    for rel in ((capabilities.RUNNER_RELPATH,) + capabilities.MESH_RELPATHS
+                + concurrency.SCAN_RELPATHS):
         dst = tmp_path / rel
         dst.parent.mkdir(parents=True, exist_ok=True)
         dst.write_text(open(os.path.join(REPO, rel)).read())
     (tmp_path / "docs").mkdir()
     written = write_docs(str(tmp_path))
     assert sorted(written) == sorted(
-        [knobs.DOC_RELPATH, capabilities.DOC_RELPATH])
+        [knobs.DOC_RELPATH, capabilities.DOC_RELPATH,
+         concurrency.DOC_RELPATH])
     for rel in written:
         committed = open(os.path.join(REPO, rel)).read()
         assert (tmp_path / rel).read_text() == committed

@@ -1,0 +1,690 @@
+"""Concurrency statics (statics/concurrency.py) + the runtime ownership
+sanitizer (runtime/concurrency.py, LLM_CONCURRENCY_CHECK).
+
+Checker rules are exercised against fixture source trees with seeded
+violations — an unowned write in every write shape, a lock-order cycle,
+blocking/await under a threading lock, a non-atomic "lock-free" method —
+plus clean-tree / pragma-suppression negatives and the generated-doc
+round trip, mirroring tests/test_statics.py. Sanitizer tests pin the
+off-by-default zero-cost contract and both trip shapes (outside-lock
+write, cross-thread write), and run a real-engine churn under the knob
+as a dynamic race detector.
+"""
+
+from __future__ import annotations
+
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+from agentic_traffic_testing_tpu.statics import concurrency
+from agentic_traffic_testing_tpu.statics.common import Finding
+from agentic_traffic_testing_tpu.statics.ownership_registry import (
+    LockDecl,
+    OwnedAttr,
+)
+from agentic_traffic_testing_tpu.runtime import concurrency as sanitizer
+
+FIX_ATTRS = (
+    OwnedAttr("Eng", "counter", "engine-loop", "", "fixture"),
+    OwnedAttr("Eng", "items", "engine-loop", "", "fixture"),
+    OwnedAttr("Eng", "guarded", "", "_lock", "fixture"),
+    OwnedAttr("Eng", "frozen", "init", "", "fixture"),
+    OwnedAttr("Eng", "free", "any", "", "fixture"),
+)
+FIX_LOCKS = (
+    LockDecl("Eng", "_lock", "threading", "fixture"),
+    LockDecl("Eng", "_lock2", "threading", "fixture"),
+    LockDecl("", "_mod_lock", "threading", "fixture"),
+)
+FIX_REG = {"Eng": "fixture:Eng"}
+
+HEADER = """\
+    class Eng:
+        def __init__(self):
+            self.counter = 0
+            self.items = []
+            self.guarded = 0
+            self.frozen = 1
+            self.free = 0
+            self._lock = object()
+            self._lock2 = object()
+
+        # Touches every registered attribute once so the thread-owner-dead
+        # rule stays quiet in minimal fixtures (each test seeds only its
+        # own violation).
+        # statics: thread(engine-loop)
+        def _keepalive(self):
+            with self._lock:
+                self.guarded += 1
+            self.counter = 0
+            self.items = []
+            self.free = 0
+            self.frozen = 1  # statics: allow-thread-unowned-write(fixture keepalive)
+"""
+
+
+def rules(findings: list[Finding]) -> list[str]:
+    return sorted(f.rule for f in findings)
+
+
+def check_fixture(tmp_path, body: str, attrs=FIX_ATTRS, locks=FIX_LOCKS,
+                  registered=FIX_REG, with_doc: bool = True):
+    p = tmp_path / "m.py"
+    p.write_text(textwrap.dedent(body))
+    doc = tmp_path / "threading.md"
+    if with_doc:
+        doc.write_text(concurrency.render(
+            str(tmp_path), paths=[str(p)], attrs=attrs, locks=locks))
+    return concurrency.check(root=str(tmp_path), paths=[str(p)],
+                             attrs=attrs, locks=locks,
+                             registered=registered, doc_path=str(doc))
+
+
+# --------------------------------------------------------- context markers
+
+
+def test_clean_fixture(tmp_path):
+    assert check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def step(self):
+            self.counter += 1
+            self.items.append(1)
+""") == []
+
+
+def test_unknown_context_marker(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-lop)
+        def step(self):
+            self.counter += 1
+""")
+    assert "thread-unknown-context" in rules(fs)
+
+
+def test_detached_marker_is_a_finding(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+
+        def lost_marker_gap(self):
+            pass
+""")
+    assert rules(fs) == ["thread-unknown-context"]
+
+
+@pytest.mark.parametrize("write", [
+    "self.counter = 2",          # plain rebind
+    "self.counter += 1",         # augmented read-modify-write
+    "self.items[0] = 1",         # container item store
+    "self.items.append(1)",      # container mutator call
+    "del self.items[0]",         # container delete
+])
+def test_unowned_write_every_shape(tmp_path, write):
+    """Every write shape from a non-owner context is flagged."""
+    fs = check_fixture(tmp_path, HEADER + f"""\
+
+        # statics: thread(handler)
+        def handler_path(self):
+            {write}
+""")
+    assert rules(fs) == ["thread-unowned-write"]
+    assert "handler" in fs[0].message or "owned by context" in fs[0].message
+
+
+def test_context_propagates_to_unmarked_helper(tmp_path):
+    """An unmarked helper inherits its caller's context through the call
+    graph — the write inside it is flagged there."""
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(handler)
+        def handler_path(self):
+            self._helper()
+
+        def _helper(self):
+            self.counter += 1
+""")
+    assert rules(fs) == ["thread-unowned-write"]
+    assert "_helper" in fs[0].message
+
+
+def test_multi_context_write_flagged(tmp_path):
+    """A helper reachable from owner AND non-owner contexts is a finding
+    (the non-owner path is the race)."""
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def step(self):
+            self._helper()
+
+        # statics: thread(scrape)
+        def scrape_path(self):
+            self._helper()
+
+        def _helper(self):
+            self.counter += 1
+""")
+    assert rules(fs) == ["thread-unowned-write"]
+
+
+def test_owner_context_write_ok_and_any_owner(tmp_path):
+    assert check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def step(self):
+            self.counter += 1
+
+        # statics: thread(scrape)
+        def scrape_path(self):
+            self.free = 3
+""") == []
+
+
+def test_init_owned_attr_runtime_write_flagged(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(handler)
+        def handler_path(self):
+            self.frozen = 2
+""")
+    assert rules(fs) == ["thread-unowned-write"]
+    assert "construction-only" in fs[0].message
+
+
+def test_unregistered_attr_write(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def step(self):
+            self.surprise = 1
+""")
+    assert rules(fs) == ["thread-attr-unregistered"]
+
+
+def test_unregistered_class_with_runtime_writes(tmp_path):
+    fs = check_fixture(tmp_path, """\
+        class Rogue:
+            def __init__(self):
+                self.x = 0
+
+            def mutate(self):
+                self.x = 1
+""", attrs=(), registered={})
+    assert rules(fs) == ["thread-class-unregistered"]
+
+
+def test_dead_registry_row(tmp_path):
+    attrs = FIX_ATTRS + (OwnedAttr("Eng", "ghost", "engine-loop", "",
+                                   "never written"),)
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def step(self):
+            self.counter += 1
+            self.items.append(1)
+""", attrs=attrs)
+    assert rules(fs) == ["thread-owner-dead"]
+    assert "ghost" in fs[0].message
+
+
+# ------------------------------------------------------------- lock rules
+
+
+def test_lock_guarded_write_requires_lock(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def good(self):
+            with self._lock:
+                self.guarded += 1
+
+        # statics: thread(engine-loop)
+        def bad(self):
+            self.guarded += 1
+""")
+    assert rules(fs) == ["thread-unowned-write"]
+    assert "does not hold" in fs[0].message
+
+
+def test_locked_helper_marker(tmp_path):
+    """locked(_lock) lets a helper write under a caller-held lock — and
+    the checker verifies every call site actually holds it."""
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: locked(_lock)
+        def _apply(self):
+            self.guarded += 1
+
+        # statics: thread(engine-loop)
+        def good(self):
+            with self._lock:
+                self._apply()
+
+        # statics: thread(engine-loop)
+        def bad(self):
+            self._apply()
+""")
+    assert rules(fs) == ["thread-locked-helper"]
+    assert "bad" in fs[0].message
+
+
+def test_lock_order_cycle(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def ab(self):
+            with self._lock:
+                with self._lock2:
+                    self.counter += 1
+
+        # statics: thread(engine-loop)
+        def ba(self):
+            with self._lock2:
+                with self._lock:
+                    self.counter += 1
+""")
+    assert "thread-lock-order" in rules(fs)
+
+
+def test_nested_locks_one_order_is_clean(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def ab(self):
+            with self._lock:
+                with self._lock2:
+                    self.counter += 1
+""")
+    assert fs == []
+
+
+def test_blocking_under_lock_direct(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def bad(self):
+            import time
+            with self._lock:
+                time.sleep(1)
+""")
+    assert rules(fs) == ["thread-blocking-under-lock"]
+
+
+def test_blocking_under_lock_transitive(tmp_path):
+    """A blocking call reached THROUGH a scanned callee is still caught
+    (the cpu_server get_pipeline shape)."""
+    fs = check_fixture(tmp_path, """\
+        import time
+        import threading
+
+        _mod_lock = threading.Lock()
+
+
+        def _slow():
+            time.sleep(1)
+
+
+        def racy():
+            with _mod_lock:
+                _slow()
+""", registered={})
+    assert rules(fs) == ["thread-blocking-under-lock"]
+    assert "_slow" in fs[0].message
+
+
+def test_await_under_threading_lock(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(handler)
+        async def bad(self):
+            with self._lock:
+                await something()
+""")
+    assert rules(fs) == ["thread-await-under-lock"]
+
+
+def test_await_under_asyncio_lock_is_clean(tmp_path):
+    locks = FIX_LOCKS + (LockDecl("Eng", "_alock", "asyncio", "fixture"),)
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(handler)
+        async def fine(self):
+            async with self._alock:
+                await something()
+""", locks=locks)
+    assert fs == []
+
+
+# ------------------------------------------------------ lock-free contract
+
+
+def test_lockfree_docstring_mutation(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        def snapshot(self):
+            \"\"\"Lock-free load view.\"\"\"
+            self.counter += 1
+            return self.counter
+""")
+    assert rules(fs) == ["thread-lockfree-mutation"]
+
+
+def test_lockfree_double_read(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        def snapshot(self):
+            \"\"\"Lock-free probe.\"\"\"
+            if self.counter is not None:
+                return self.counter
+            return 0
+""")
+    assert rules(fs) == ["thread-lockfree-read"]
+
+
+def test_lockfree_single_assignment_snapshot_clean(tmp_path):
+    assert check_fixture(tmp_path, HEADER + """\
+
+        def snapshot(self):
+            \"\"\"Lock-free load view: single reads only.\"\"\"
+            return {"c": self.counter, "n": len(self.items)}
+""") == []
+
+
+# ------------------------------------------------------ pragmas and docs
+
+
+def test_pragma_suppresses_with_reason(tmp_path):
+    assert check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(handler)
+        def handler_path(self):
+            self.counter += 1  # statics: allow-thread-unowned-write(fixture knows better)
+""") == []
+
+
+def test_doc_drift(tmp_path):
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def step(self):
+            self.counter += 1
+            self.items.append(1)
+""", with_doc=False)
+    assert rules(fs) == ["thread-docs-stale"]
+
+
+def test_real_tree_is_clean():
+    """The repository itself carries no unsuppressed concurrency finding
+    (the acceptance gate: every finding fixed or reason-pragma'd)."""
+    assert concurrency.check() == []
+
+
+def test_real_doc_matches_tree():
+    from agentic_traffic_testing_tpu.statics.common import repo_root
+    import os
+
+    with open(os.path.join(repo_root(), concurrency.DOC_RELPATH)) as f:
+        assert f.read().strip() == concurrency.render().strip()
+
+
+# ------------------------------------------------------- runtime sanitizer
+
+
+@pytest.fixture
+def installed(monkeypatch):
+    monkeypatch.setenv("LLM_CONCURRENCY_CHECK", "1")
+    assert sanitizer.install() > 0
+    yield
+    sanitizer.uninstall()
+
+
+def test_sanitizer_off_by_default_zero_cost():
+    """Knob unset: maybe_install touches nothing — no wrapper exists on
+    any registered class, so the hot loop is byte-identical and pays no
+    per-step cost (there is literally no installed code)."""
+    from agentic_traffic_testing_tpu.serving.replica_pool import ReplicaHealth
+    from agentic_traffic_testing_tpu.runtime.telemetry import StepClock
+
+    assert not sanitizer.enabled()
+    assert sanitizer.maybe_install() is False
+    assert not sanitizer.installed()
+    for cls in (ReplicaHealth, StepClock):
+        assert "__setattr__" not in cls.__dict__
+        assert "__init__" in cls.__dict__  # the real one, unwrapped
+        assert cls.__init__.__qualname__.startswith(cls.__name__)
+
+
+def test_sanitizer_lock_trip(installed):
+    from agentic_traffic_testing_tpu.serving.replica_pool import ReplicaHealth
+
+    h = ReplicaHealth()
+    h.record_ok()          # transitions hold _mu: fine
+    h.check_stuck()
+    assert h.probe() is False
+    with pytest.raises(sanitizer.OwnershipViolation):
+        h.state = "healthy"   # naked write outside _mu
+
+
+def test_sanitizer_cross_thread_trip(installed):
+    from agentic_traffic_testing_tpu.runtime.telemetry import StepClock
+
+    clk = StepClock()
+    t = threading.Thread(
+        target=lambda: clk.record_dispatch("decode", 0.0, 0.1, 4, 64),
+        name="engine-loop-test")
+    t.start()
+    t.join()
+    with pytest.raises(sanitizer.OwnershipViolation):
+        clk.last_decode_batch = 9   # engine-class attr from MainThread
+
+
+def test_sanitizer_uninstall_restores():
+    from agentic_traffic_testing_tpu.serving.replica_pool import ReplicaHealth
+
+    sanitizer.install()
+    try:
+        h = ReplicaHealth()
+        with pytest.raises(sanitizer.OwnershipViolation):
+            h.state = "degraded"
+    finally:
+        sanitizer.uninstall()
+    assert "__setattr__" not in ReplicaHealth.__dict__
+    h2 = ReplicaHealth()
+    h2.state = "degraded"   # unwrapped again
+
+
+def test_sanitizer_engine_churn_clean(installed):
+    """A real engine churn (the tests_faults workload shape) under
+    LLM_CONCURRENCY_CHECK=1: the sanitizer observes every attribute
+    write of the step loop and raises on none — the dynamic counterpart
+    of test_real_tree_is_clean."""
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+    checks0 = sanitizer.num_checks
+    violations0 = sanitizer.num_violations
+    eng = LLMEngine(EngineConfig(model="tiny", dtype="float32",
+                                 max_num_seqs=4, max_model_len=128,
+                                 block_size=16, num_blocks=64))
+    wl = np.random.default_rng(7)
+    reqs = [eng.add_request(wl.integers(10, 200, 12).tolist(),
+                            SamplingParams(temperature=0.0, max_tokens=4,
+                                           ignore_eos=True))
+            for _ in range(5)]
+    steps = 0
+    while eng.has_work() and steps < 500:
+        eng.step()
+        steps += 1
+    assert steps < 500
+    assert all(r.is_finished() for r in reqs)
+    assert sanitizer.num_checks > checks0      # it really was watching
+    assert sanitizer.num_violations == violations0
+
+
+def test_sanitizer_async_handover(installed):
+    """Serving mode: the building thread constructs + owns the engine
+    until AsyncLLMEngine.start() publishes it; the engine-loop thread
+    then binds ownership, and the handler thread streaming results never
+    trips. This is the engine-loop vs handler split the registry
+    declares, asserted live."""
+    import asyncio
+
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+    from agentic_traffic_testing_tpu.serving.async_engine import AsyncLLMEngine
+
+    violations0 = sanitizer.num_violations
+    eng = LLMEngine(EngineConfig(model="tiny", dtype="float32",
+                                 max_num_seqs=2, max_model_len=128,
+                                 block_size=16, num_blocks=64))
+    # Pre-publication write from the building thread (the warmup shape).
+    eng.num_steps = eng.num_steps
+    a = AsyncLLMEngine(eng)
+
+    async def run():
+        a.start()
+        toks = []
+        async for ev in a.generate([5, 6, 7, 8],
+                                   SamplingParams(temperature=0.0,
+                                                  max_tokens=3,
+                                                  ignore_eos=True)):
+            toks.extend(ev.new_token_ids)
+            if ev.finished:
+                break
+        return toks
+
+    try:
+        toks = asyncio.run(run())
+        assert len(toks) == 3
+        assert sanitizer.num_violations == violations0
+    finally:
+        a.shutdown()
+
+
+def test_lock_reacquisition_deadlock(tmp_path):
+    """Taking a non-reentrant lock already held — lexically nested — is
+    an immediate self-deadlock finding."""
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def bad(self):
+            with self._lock:
+                with self._lock:
+                    self.counter += 1
+""")
+    assert "thread-lock-order" in rules(fs)
+    assert "re-acquires" in [f for f in fs
+                             if f.rule == "thread-lock-order"][0].message
+
+
+def test_cross_function_self_deadlock(tmp_path):
+    """Calling a function that (transitively) acquires a lock the caller
+    already holds deadlocks at runtime even though no single function
+    nests the acquisition — the call-graph closure catches it."""
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def outer(self):
+            with self._lock:
+                self._inner()
+
+        def _inner(self):
+            with self._lock:
+                self.counter += 1
+""")
+    assert "thread-lock-order" in rules(fs)
+    assert any("acquires again" in f.message for f in fs
+               if f.rule == "thread-lock-order")
+
+
+def test_blocking_call_in_with_context_expr(tmp_path):
+    """A blocking call used AS a context manager under a lock is still a
+    finding (`with requests.get(u) as r:` evaluates the HTTP round trip
+    while the lock is held)."""
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def bad(self):
+            import requests
+            with self._lock:
+                with requests.get("http://x") as r:
+                    self.counter += 1
+""")
+    assert "thread-blocking-under-lock" in rules(fs)
+
+
+def test_with_as_self_attr_is_a_write(tmp_path):
+    """`with open(p) as self.fh:` binds a self attribute — recorded as a
+    write, so an unregistered target is flagged."""
+    fs = check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def step(self):
+            with open("p") as self.fh:
+                self.counter += 1
+""")
+    assert "thread-attr-unregistered" in rules(fs)
+
+
+def test_sanitizer_attr_creating_write_is_construction(installed):
+    """install() can land mid-way through an enclosing __init__ (the
+    server builds its engine — which installs — before its own later
+    fields), so the FIRST write of a lock-guarded field must not assert;
+    rewrites of an existing field must."""
+    from agentic_traffic_testing_tpu.serving.replica_pool import (
+        HEALTHY,
+        ReplicaHealth,
+    )
+
+    h = ReplicaHealth.__new__(ReplicaHealth)   # no wrapped __init__ ran
+    h.state = HEALTHY           # attr-creating write: construction shape
+    h._mu = threading.Lock()
+    with pytest.raises(sanitizer.OwnershipViolation):
+        h.state = HEALTHY       # now it exists: the lock rule applies
+
+
+def test_lock_order_findings_honor_pragmas(tmp_path):
+    """Every thread-lock-order shape is pragma-suppressable (the module's
+    suppression contract) — a justified nesting doesn't wedge tier-1."""
+    assert check_fixture(tmp_path, HEADER + """\
+
+        # statics: thread(engine-loop)
+        def ab(self):
+            with self._lock:
+                with self._lock2:  # statics: allow-thread-lock-order(fixture says this order is global)
+                    self.counter += 1
+
+        # statics: thread(engine-loop)
+        def ba(self):
+            with self._lock2:
+                with self._lock:  # statics: allow-thread-lock-order(fixture says this order is global)
+                    self.counter += 1
+
+        # statics: thread(engine-loop)
+        def re(self):
+            with self._lock:
+                with self._lock:  # statics: allow-thread-lock-order(fixture re-entry is mocked)
+                    self.counter += 1
+""") == []
+
+
+def test_sanitizer_enabled_bool_spellings(monkeypatch):
+    """LLM_CONCURRENCY_CHECK parses like every other bool knob
+    (_env_bool): explicit 'false'/'off'/'0' must NOT install a
+    production sanitizer."""
+    for off in ("0", "", "false", "off", "no"):
+        monkeypatch.setenv("LLM_CONCURRENCY_CHECK", off)
+        assert not sanitizer.enabled(), off
+    for on in ("1", "true", "yes", "on", "TRUE"):
+        monkeypatch.setenv("LLM_CONCURRENCY_CHECK", on)
+        assert sanitizer.enabled(), on
