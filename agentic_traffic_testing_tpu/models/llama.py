@@ -281,6 +281,25 @@ def _unembed(x: jax.Array, params: Params, cfg: ModelConfig) -> jax.Array:
     return dense(x, params["unembed"]).astype(jnp.float32)
 
 
+def _gather_prior_kv(cache: KVCache, li, block_tables, hd: int, dtype):
+    """Gather one layer's prior pages for the chunk-attention sites,
+    dequantizing the scaled int8 pool when present. Returns (k, v) of
+    shape [B, W*bs, KH->transposed...] exactly like kvc.gather_kv."""
+    k_l = jax.lax.dynamic_index_in_dim(cache.k, li, 0, keepdims=False)
+    v_l = jax.lax.dynamic_index_in_dim(cache.v, li, 0, keepdims=False)
+    if cache.quantized:
+        ks_l = jax.lax.dynamic_index_in_dim(cache.k_scale, li, 0,
+                                            keepdims=False)
+        vs_l = jax.lax.dynamic_index_in_dim(cache.v_scale, li, 0,
+                                            keepdims=False)
+        k = kvc.gather_kv_dequant(k_l, ks_l, block_tables)[..., :hd]
+        v = kvc.gather_kv_dequant(v_l, vs_l, block_tables)[..., :hd]
+    else:
+        k = kvc.gather_kv(k_l, block_tables)[..., :hd]
+        v = kvc.gather_kv(v_l, block_tables)[..., :hd]
+    return k.astype(dtype), v.astype(dtype)
+
+
 # ---------------------------------------------------------------------------
 # Full forward (no cache): training and golden-logit tests
 # ---------------------------------------------------------------------------
@@ -353,6 +372,8 @@ def _prefill_layer_body(x, lp, li, cfg: ModelConfig, sin, cos, attn_site, cache)
     Emits the layer's K/V as lane-padded, head-major page tiles so the caller
     can bulk-write them post-scan (ops/kv_writer.py). Keeping ONE body keeps
     chunked and unchunked prefill numerics identical by construction.
+    Quantized (int8) pools keep the tiles in compute dtype here — the bulk
+    writer quantizes per page, where the per-page absmax lives.
     """
     b, t = x.shape[:2]
     hd, hdp = cfg.head_dim_, cache.k.shape[-1]
@@ -368,6 +389,8 @@ def _prefill_layer_body(x, lp, li, cfg: ModelConfig, sin, cos, attn_site, cache)
     pad = ((0, 0), (0, 0), (0, 0), (0, hdp - hd))
     k_pages = jnp.pad(k.transpose(0, 2, 1, 3), pad)  # [B, KH, T, hdp]
     v_pages = jnp.pad(v.transpose(0, 2, 1, 3), pad)
+    if cache.quantized:
+        return x, (k_pages, v_pages)
     return x, (k_pages.astype(cache.k.dtype), v_pages.astype(cache.v.dtype))
 
 
@@ -444,11 +467,21 @@ def prefill_impl(
 
     x, (ks, vs) = jax.lax.scan(
         body, x, (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)))
-    kc, vc = write_prompt_pages(cache.k, cache.v, ks, vs, block_tables,
-                                mode=kv_writer_mode)
+    if cache.quantized:
+        from agentic_traffic_testing_tpu.ops.kv_writer import (
+            write_prompt_pages_quant,
+        )
+
+        new_cache = KVCache(*write_prompt_pages_quant(
+            cache.k, cache.v, cache.k_scale, cache.v_scale, ks, vs,
+            block_tables))
+    else:
+        kc, vc = write_prompt_pages(cache.k, cache.v, ks, vs, block_tables,
+                                    mode=kv_writer_mode)
+        new_cache = KVCache(kc, vc)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jnp.take_along_axis(x, jnp.maximum(seq_lens - 1, 0)[:, None, None], axis=1)[:, 0]
-    return _unembed(last[:, None, :], params, cfg)[:, 0], KVCache(kc, vc)
+    return _unembed(last[:, None, :], params, cfg)[:, 0], new_cache
 
 
 def prefill_chunk_impl(
@@ -512,12 +545,8 @@ def prefill_chunk_impl(
             # Tail padding is safe by causality (padded suffix slots sit
             # at positions past every real query); rows past chunk_len
             # produce garbage nothing reads, as in the flash site.
-            k_prior = kvc.gather_kv(
-                jax.lax.dynamic_index_in_dim(cache.k, li, 0, keepdims=False),
-                block_tables)[..., :hd].astype(k.dtype)
-            v_prior = kvc.gather_kv(
-                jax.lax.dynamic_index_in_dim(cache.v, li, 0, keepdims=False),
-                block_tables)[..., :hd].astype(v.dtype)
+            k_prior, v_prior = _gather_prior_kv(cache, li, block_tables,
+                                                hd, k.dtype)
             return ring_chunk(q, k, v, k_prior, v_prior, chunk_start)
 
         return _prefill_chunk_tail(params, cfg, x, sin, cos, attn_site,
@@ -537,12 +566,8 @@ def prefill_chunk_impl(
          jnp.arange(c, dtype=jnp.int32)[None] < chunk_len], axis=1)
 
     def attn_site(q, k, v, li):
-        k_prior = kvc.gather_kv(
-            jax.lax.dynamic_index_in_dim(cache.k, li, 0, keepdims=False),
-            block_tables)[..., :hd].astype(k.dtype)
-        v_prior = kvc.gather_kv(
-            jax.lax.dynamic_index_in_dim(cache.v, li, 0, keepdims=False),
-            block_tables)[..., :hd].astype(v.dtype)
+        k_prior, v_prior = _gather_prior_kv(cache, li, block_tables,
+                                            hd, k.dtype)
         k_all = jnp.concatenate([k_prior, k], axis=1)
         v_all = jnp.concatenate([v_prior, v], axis=1)
         import os as _os
@@ -649,12 +674,8 @@ def prefill_pipeline_impl(
                  or (_chunk_env != "jnp" and jax.default_backend() == "tpu"))
 
     def attn_site(q, k, v, li):
-        k_prior = kvc.gather_kv(
-            jax.lax.dynamic_index_in_dim(cache.k, li, 0, keepdims=False),
-            block_tables)[..., :hd].astype(k.dtype)
-        v_prior = kvc.gather_kv(
-            jax.lax.dynamic_index_in_dim(cache.v, li, 0, keepdims=False),
-            block_tables)[..., :hd].astype(v.dtype)
+        k_prior, v_prior = _gather_prior_kv(cache, li, block_tables,
+                                            hd, k.dtype)
         k_all = jnp.concatenate([k_prior, k], axis=1)
         v_all = jnp.concatenate([v_prior, v], axis=1)
         if use_flash:
@@ -680,8 +701,31 @@ def prefill_pipeline_impl(
 
     x, (ks, vs) = jax.lax.scan(
         body, x, (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)))
-    # Traced chunk offset: only the DUS writer supports it (as in
-    # _prefill_chunk_tail).
+    new_cache = _write_chunk_pages(cache, ks, vs, block_tables, chunk_start,
+                                   bs, kv_writer_mode)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    # Per-row last-real-token logits, clamped into this chunk: the clamp
+    # only matters for rows whose final token lives in ANOTHER chunk, and
+    # the runner's carry merge (`mine`) discards those rows' samples.
+    idx = jnp.clip(seq_lens - 1 - chunk_start, 0, c - 1)
+    last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
+    return _unembed(last[:, None, :], params, cfg)[:, 0], new_cache
+
+
+def _write_chunk_pages(cache: KVCache, ks, vs, block_tables, chunk_start,
+                       bs, kv_writer_mode) -> KVCache:
+    """Offset page write shared by the chunk and pipelined-prefill tails:
+    quantizing per page for the int8 pool, the DUS writer otherwise (the
+    chunk offset is a traced scalar, which only the DUS writer supports —
+    the env- or caller-chosen pallas/interpret writer remaps to it)."""
+    if cache.quantized:
+        from agentic_traffic_testing_tpu.ops.kv_writer import (
+            write_prompt_pages_quant,
+        )
+
+        return KVCache(*write_prompt_pages_quant(
+            cache.k, cache.v, cache.k_scale, cache.v_scale, ks, vs,
+            block_tables, first_block=chunk_start // bs))
     from agentic_traffic_testing_tpu.ops.kv_writer import writer_choice
 
     mode = kv_writer_mode or writer_choice()
@@ -690,13 +734,7 @@ def prefill_pipeline_impl(
         mode=("dus" if mode in ("pallas", "interpret") else mode),
         first_block=chunk_start // bs,
     )
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    # Per-row last-real-token logits, clamped into this chunk: the clamp
-    # only matters for rows whose final token lives in ANOTHER chunk, and
-    # the runner's carry merge (`mine`) discards those rows' samples.
-    idx = jnp.clip(seq_lens - 1 - chunk_start, 0, c - 1)
-    last = jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0]
-    return _unembed(last[:, None, :], params, cfg)[:, 0], KVCache(kc, vc)
+    return KVCache(kc, vc)
 
 
 def _prefill_chunk_tail(params, cfg: ModelConfig, x, sin, cos, attn_site,
@@ -713,19 +751,11 @@ def _prefill_chunk_tail(params, cfg: ModelConfig, x, sin, cos, attn_site,
 
     x, (ks, vs) = jax.lax.scan(
         body, x, (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)))
-    # The chunk offset is a traced scalar, which only the DUS writer supports
-    # — remap the (env- or caller-chosen) pallas/interpret writer to it.
-    from agentic_traffic_testing_tpu.ops.kv_writer import writer_choice
-
-    mode = kv_writer_mode or writer_choice()
-    kc, vc = write_prompt_pages(
-        cache.k, cache.v, ks, vs, block_tables,
-        mode=("dus" if mode in ("pallas", "interpret") else mode),
-        first_block=chunk_start // bs,
-    )
+    new_cache = _write_chunk_pages(cache, ks, vs, block_tables, chunk_start,
+                                   bs, kv_writer_mode)
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     last = jnp.take_along_axis(x, jnp.maximum(chunk_len - 1, 0)[None, None, None], axis=1)[:, 0]
-    return _unembed(last[:, None, :], params, cfg)[:, 0], KVCache(kc, vc)
+    return _unembed(last[:, None, :], params, cfg)[:, 0], new_cache
 
 
 # ---------------------------------------------------------------------------
@@ -743,6 +773,7 @@ def decode_step_impl(
     attn_mode: Optional[str] = None,  # static; see ops/attention_backend.py
     attn_mesh=None,           # static Mesh + axis for attn_mode="shard_dma"
     attn_axis: Optional[str] = None,
+    fused_kv_write: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """Returns (next-token logits [B, V] fp32, updated cache).
 
@@ -757,7 +788,8 @@ def decode_step_impl(
     logits, cache = verify_step_impl(params, cfg, tokens[:, None], cache,
                                      block_tables, positions,
                                      attn_mode=attn_mode, attn_mesh=attn_mesh,
-                                     attn_axis=attn_axis)
+                                     attn_axis=attn_axis,
+                                     fused_kv_write=fused_kv_write)
     return logits[:, 0], cache
 
 
@@ -771,6 +803,7 @@ def verify_step_impl(
     attn_mode: Optional[str] = None,
     attn_mesh=None,           # static Mesh + axis for attn_mode="shard_dma"
     attn_axis: Optional[str] = None,
+    fused_kv_write: bool = False,
 ) -> tuple[jax.Array, KVCache]:
     """Speculative-verify step: S tokens per sequence in one pass.
 
@@ -782,8 +815,19 @@ def verify_step_impl(
     analog of this capability lives inside vLLM's spec-decode workers for
     the reference (never in-tree); here it is one more jitted step sharing
     the decode layer body.
+
+    A scaled int8 pool (cache.quantized) routes every write through the
+    quantizing requant writer and carries the scale arrays in the layer
+    scan. `fused_kv_write` (S=1 only — LLM_FUSED_KV_WRITE) skips the
+    separate write entirely: the fresh K/V rides into
+    paged_decode_attention, which lands it in-kernel (dma2/dma3) or
+    byte-identically in XLA (every other mode).
     """
     b, s = tokens.shape
+    if fused_kv_write and s != 1:
+        raise ValueError(
+            "fused_kv_write serves the single-token decode step only "
+            "(the engine refuses the speculation combination at build)")
     pos_grid = positions[:, None] + jnp.arange(s, dtype=jnp.int32)[None]  # [B, S]
     x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)
     sin, cos = rope_sin_cos(pos_grid, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
@@ -791,43 +835,63 @@ def verify_step_impl(
     # table lookup would clamp onto the row's last real block and corrupt
     # live context for this step's kept tokens) — route them to trash.
     capacity = block_tables.shape[1] * cache.block_size
+    quantized = cache.quantized
 
     xs_layers, held = _scan_split(params["layers"])
 
     def body(carry, xs):
-        x, kc, vc = carry
+        x, kc, vc, ksc, vsc = carry
         xs_lp, li = xs
         lp = _merge_lp(xs_lp, held, li)
         xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
         q, k, v = _qkv(xa, lp, cfg)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        for i in range(s):  # S is small and static; chained DUS stays in place
-            # Chained DUS into the full pool: in-place on TPU, where a scatter
-            # would copy the pool per layer (see write_decode_kv_full).
-            ok = (positions + i) < capacity
-            kc = kvc.write_decode_kv_full(kc, li, k[:, i], block_tables,
-                                          positions + i, valid=ok)
-            vc = kvc.write_decode_kv_full(vc, li, v[:, i], block_tables,
-                                          positions + i, valid=ok)
-        # Paged attention straight off the stacked pool: Pallas kernel on TPU
-        # (layer indirection in its DMA index_map), jnp gather oracle on CPU
-        # (ops/attention_backend.py picks at trace time).
-        attn = paged_decode_attention(q, kc, vc, block_tables, positions,
-                                      mode=attn_mode, layer=li,
-                                      mesh=attn_mesh, axis=attn_axis)
+        if fused_kv_write:
+            # Round-10 fusion: the separate chained-DUS write disappears;
+            # the attention call writes the token then attends through it.
+            attn, kc, vc, ksc, vsc = paged_decode_attention(
+                q, kc, vc, block_tables, positions,
+                mode=attn_mode, layer=li, mesh=attn_mesh, axis=attn_axis,
+                k_scale=ksc, v_scale=vsc, new_k=k[:, 0], new_v=v[:, 0])
+        else:
+            for i in range(s):  # S small + static; chained DUS stays in place
+                # Chained DUS into the full pool: in-place on TPU, where a
+                # scatter would copy the pool per layer (write_decode_kv_full).
+                ok = (positions + i) < capacity
+                if quantized:
+                    kc, ksc = kvc.write_decode_kv_full_quant(
+                        kc, ksc, li, k[:, i], block_tables, positions + i,
+                        valid=ok)
+                    vc, vsc = kvc.write_decode_kv_full_quant(
+                        vc, vsc, li, v[:, i], block_tables, positions + i,
+                        valid=ok)
+                else:
+                    kc = kvc.write_decode_kv_full(kc, li, k[:, i],
+                                                  block_tables, positions + i,
+                                                  valid=ok)
+                    vc = kvc.write_decode_kv_full(vc, li, v[:, i],
+                                                  block_tables, positions + i,
+                                                  valid=ok)
+            # Paged attention straight off the stacked pool: Pallas kernel on
+            # TPU (layer indirection in its DMA index_map), jnp gather oracle
+            # on CPU (ops/attention_backend.py picks at trace time).
+            attn = paged_decode_attention(q, kc, vc, block_tables, positions,
+                                          mode=attn_mode, layer=li,
+                                          mesh=attn_mesh, axis=attn_axis,
+                                          k_scale=ksc, v_scale=vsc)
         x = x + dense(attn.reshape(b, s, -1), lp["wo"])
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         y, _ = _mlp_block(xm, lp, cfg)  # serving paths drop the MoE aux term
         x = x + y
-        return (x, kc, vc), None
+        return (x, kc, vc, ksc, vsc), None
 
-    (x, kc, vc), _ = jax.lax.scan(
-        body, (x, cache.k, cache.v),
+    (x, kc, vc, ksc, vsc), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
         (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    return _unembed(x, params, cfg), KVCache(kc, vc)
+    return _unembed(x, params, cfg), KVCache(kc, vc, ksc, vsc)
 
 
 def hybrid_step_impl(
@@ -841,6 +905,7 @@ def hybrid_step_impl(
     chunk_start: jax.Array,   # scalar i32 — absolute position of chunk_tokens[0, 0]
     chunk_len: jax.Array,     # scalar i32 — real (unpadded) tokens in the chunk
     attn_mode: Optional[str] = None,  # static; None=auto | "ragged" | "gather"
+    fused_kv_write: bool = False,
 ) -> tuple[jax.Array, jax.Array, KVCache]:
     """HYBRID step: one fused ragged pass over B decode lanes + one prefill
     chunk. Returns (decode next-token logits [B, V] fp32, chunk last-token
@@ -859,12 +924,23 @@ def hybrid_step_impl(
     position + a + 1) hold uniformly for both row kinds. Numerics per row
     therefore match decode_step_impl / prefill_chunk_impl's gather site
     exactly; tests/test_hybrid_batch.py pins token parity.
+
+    A scaled int8 pool routes both write kinds through the quantizing
+    writers (requant token append for decode lanes, fresh per-page scales
+    for the chunk). `fused_kv_write` folds ALL the step's writes into the
+    ragged attention dispatch instead (ops/pallas/ragged_paged_attention
+    fused-write contract; bf16/fp8 pools only — the engine refuses the
+    int8 combination at build).
     """
     b = dec_tokens.shape[0]
     _, c = chunk_tokens.shape
     bs = cache.block_size
     if c % bs != 0:
         raise ValueError(f"chunk length {c} not a multiple of block_size {bs}")
+    if fused_kv_write and cache.quantized:
+        raise ValueError(
+            "fused_kv_write x int8 KV is not wired for the hybrid step — "
+            "the engine refuses this combination at build")
     tokens_flat = jnp.concatenate([dec_tokens, chunk_tokens[0]])      # [T]
     chunk_pos = chunk_start + jnp.arange(c, dtype=jnp.int32)
     pos_flat = jnp.concatenate([positions, chunk_pos])[None]          # [1, T]
@@ -877,49 +953,76 @@ def hybrid_step_impl(
     hd = cfg.head_dim_
     capacity = block_tables.shape[1] * bs
     q_lens = (1,) * b + (c,)
+    quantized = cache.quantized
 
     xs_layers, held = _scan_split(params["layers"])
 
     def body(carry, xs):
-        x, kc, vc = carry
+        x, kc, vc, ksc, vsc = carry
         xs_lp, li = xs
         lp = _merge_lp(xs_lp, held, li)
         xa = rms_norm(x, lp["ln_attn"], cfg.rms_norm_eps)
         q, k, v = _qkv(xa, lp, cfg)
         q = apply_rope(q, sin, cos)
         k = apply_rope(k, sin, cos)
-        # Decode lanes: one chained-DUS write each (in place on TPU).
+        if fused_kv_write:
+            # Round-10 fusion: every row's writes (decode token rows +
+            # whole chunk pages) land inside the ragged dispatch itself.
+            attn, kc, vc = hybrid_ragged_attention(
+                q[0], kc, vc, block_tables, row_pos, q_lens,
+                mode=attn_mode, layer=li, new_k=k[0], new_v=v[0])
+            x = x + dense(attn.reshape(1, t, -1), lp["wo"])
+            xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
+            y, _ = _mlp_block(xm, lp, cfg)
+            return (x + y, kc, vc, ksc, vsc), None
+        # Decode lanes: one chained-DUS write each (in place on TPU;
+        # quantizing requant append on the int8 pool).
         ok = positions < capacity
-        kc = kvc.write_decode_kv_full(kc, li, k[0, :b], block_tables[:b],
-                                      positions, valid=ok)
-        vc = kvc.write_decode_kv_full(vc, li, v[0, :b], block_tables[:b],
-                                      positions, valid=ok)
+        if quantized:
+            kc, ksc = kvc.write_decode_kv_full_quant(
+                kc, ksc, li, k[0, :b], block_tables[:b], positions, valid=ok)
+            vc, vsc = kvc.write_decode_kv_full_quant(
+                vc, vsc, li, v[0, :b], block_tables[:b], positions, valid=ok)
+        else:
+            kc = kvc.write_decode_kv_full(kc, li, k[0, :b], block_tables[:b],
+                                          positions, valid=ok)
+            vc = kvc.write_decode_kv_full(vc, li, v[0, :b], block_tables[:b],
+                                          positions, valid=ok)
         # Chunk: whole-page DUS writes (C/bs per layer, not C) at the
         # table-column offset — garbage tail slots beyond chunk_len land
         # in slots nothing ever reads (same contract as write_prompt_pages
-        # on the serial chunk path).
+        # on the serial chunk path). Chunk blocks are private suffix
+        # blocks written once, so the int8 path takes fresh per-page
+        # scales (no requant).
         k_pages = k[0, b:].transpose(1, 0, 2)                 # [KH, C, hd]
         v_pages = v[0, b:].transpose(1, 0, 2)
         first_block = chunk_start // bs
-        zero = jnp.int32(0)
-        for p in range(c // bs):
-            blk = block_tables[b, first_block + p]
-            kup = k_pages[:, p * bs:(p + 1) * bs][None, :, None]  # [1,KH,1,bs,hd]
-            vup = v_pages[:, p * bs:(p + 1) * bs][None, :, None]
-            kc = jax.lax.dynamic_update_slice(
-                kc, kup.astype(kc.dtype), (li, zero, blk, zero, zero))
-            vc = jax.lax.dynamic_update_slice(
-                vc, vup.astype(vc.dtype), (li, zero, blk, zero, zero))
+        if quantized:
+            kc, ksc = kvc.write_chunk_pages_quant(
+                kc, ksc, li, k_pages, block_tables[b], first_block)
+            vc, vsc = kvc.write_chunk_pages_quant(
+                vc, vsc, li, v_pages, block_tables[b], first_block)
+        else:
+            zero = jnp.int32(0)
+            for p in range(c // bs):
+                blk = block_tables[b, first_block + p]
+                kup = k_pages[:, p * bs:(p + 1) * bs][None, :, None]  # [1,KH,1,bs,hd]
+                vup = v_pages[:, p * bs:(p + 1) * bs][None, :, None]
+                kc = jax.lax.dynamic_update_slice(
+                    kc, kup.astype(kc.dtype), (li, zero, blk, zero, zero))
+                vc = jax.lax.dynamic_update_slice(
+                    vc, vup.astype(vc.dtype), (li, zero, blk, zero, zero))
         attn = hybrid_ragged_attention(q[0], kc, vc, block_tables, row_pos,
-                                       q_lens, mode=attn_mode, layer=li)
+                                       q_lens, mode=attn_mode, layer=li,
+                                       k_scale=ksc, v_scale=vsc)
         x = x + dense(attn.reshape(1, t, -1), lp["wo"])
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         y, _ = _mlp_block(xm, lp, cfg)  # serving paths drop the MoE aux term
         x = x + y
-        return (x, kc, vc), None
+        return (x, kc, vc, ksc, vsc), None
 
-    (x, kc, vc), _ = jax.lax.scan(
-        body, (x, cache.k, cache.v),
+    (x, kc, vc, ksc, vsc), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
         (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
@@ -928,7 +1031,7 @@ def hybrid_step_impl(
         x, (b + jnp.maximum(chunk_len - 1, 0))[None, None, None], axis=1)
     sel = jnp.concatenate([x[:, :b], last_chunk], axis=1)     # [1, B+1, D]
     logits = _unembed(sel, params, cfg)[0]                    # [B+1, V]
-    return logits[:b], logits[b:], KVCache(kc, vc)
+    return logits[:b], logits[b:], KVCache(kc, vc, ksc, vsc)
 
 
 # Jitted conveniences (tests, simple offline use). The serving engine builds

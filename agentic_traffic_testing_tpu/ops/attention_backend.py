@@ -81,6 +81,10 @@ def paged_decode_attention(
     layer=None,    # scalar i32, required when pages are stacked (5D)
     mesh=None,     # jax Mesh, required for mode="shard_dma"
     axis=None,     # mesh axis name the heads/pool are sharded on (e.g. "tp")
+    k_scale=None,  # [nb, KH] / [L, nb, KH] f32: scaled int8 pool (round 10)
+    v_scale=None,
+    new_k=None,    # [B, KH, hd]: fused decode KV write (round 10) — the
+    new_v=None,    # token at `positions` is written BEFORE attention
 ):
     """S-token paged attention over the block pool. Returns [B, S, H, hd].
 
@@ -92,6 +96,15 @@ def paged_decode_attention(
     folds the layer indirection into its DMA index_map (no per-layer slice is
     ever materialized); the gather path slices the layer first — that copy is
     cheap on CPU and keeps the KH-sharded gather well-partitioned under TP.
+
+    `k_scale`/`v_scale` mark the pool as scaled int8 (kv_cache_dtype=
+    "int8"): the dma2/dma3 kernels dequantize inside their chunk walk, the
+    gather/ragged paths dequantize after the gather; the legacy dma/v1
+    kernels refuse. `new_k`/`new_v` request a FUSED decode KV write (S=1
+    only): dma2/dma3 fold it into the kernel (pool + scales alias in/out),
+    every other mode performs the identical write functionally first — so
+    the engine-level contract is mode-independent. With a fused write the
+    call returns (out, k_pages, v_pages, k_scale, v_scale) instead of out.
 
     `mode` overrides the env/platform choice. A pallas_call has no SPMD
     partitioning rule, so under a tp>1 mesh plain GSPMD would replicate
@@ -107,26 +120,84 @@ def paged_decode_attention(
     if mode is None:
         mode = backend_choice()
     lay = layer if k_pages.ndim == 5 else None
+    quantized = k_scale is not None
+    fused = new_k is not None
+    if fused and s != 1:
+        raise ValueError("fused KV write serves single-query decode only")
     if mode == "shard_dma":
+        if quantized or fused:
+            # The shard_map wrapper has no scale-sharding or aliasing rule;
+            # the mesh runners declare supports_quantized_kv /
+            # supports_fused_kv_write False and the engine refuses at build
+            # — reaching here means a caller bypassed that contract.
+            raise ValueError(
+                "shard_dma serves neither the scaled int8 pool nor fused "
+                "KV writes")
         return _shard_dma_attention(q, k_pages, v_pages, block_tables,
                                     ctx_lens, lay, mesh, axis)
+    if quantized and mode in ("dma", "pallas", "interpret"):
+        raise ValueError(
+            f"mode {mode!r} does not serve the scaled int8 pool — use "
+            f"dma2, dma3, ragged, or gather")
+    if fused and mode not in ("dma2", "dma3"):
+        # Functional fusion: the byte-identical write runs first (same op
+        # sequence as the separate-dispatch path), then the mode attends.
+        # Keeps the engine knob honest on CPU (gather) and legacy modes.
+        capacity = block_tables.shape[1] * k_pages.shape[-2]
+        ok = positions < capacity
+        if quantized:
+            if k_pages.ndim == 5:
+                k_pages, k_scale = kvc.write_decode_kv_full_quant(
+                    k_pages, k_scale, lay, new_k, block_tables, positions,
+                    valid=ok)
+                v_pages, v_scale = kvc.write_decode_kv_full_quant(
+                    v_pages, v_scale, lay, new_v, block_tables, positions,
+                    valid=ok)
+            else:
+                k_pages, k_scale = _unstacked_quant_write(
+                    k_pages, k_scale, new_k, block_tables, positions, ok)
+                v_pages, v_scale = _unstacked_quant_write(
+                    v_pages, v_scale, new_v, block_tables, positions, ok)
+        else:
+            if k_pages.ndim == 5:
+                k_pages = kvc.write_decode_kv_full(
+                    k_pages, lay, new_k, block_tables, positions, valid=ok)
+                v_pages = kvc.write_decode_kv_full(
+                    v_pages, lay, new_v, block_tables, positions, valid=ok)
+            else:
+                k_pages = kvc.write_decode_kv_full(
+                    k_pages[None], jnp.int32(0), new_k, block_tables,
+                    positions, valid=ok)[0]
+                v_pages = kvc.write_decode_kv_full(
+                    v_pages[None], jnp.int32(0), new_v, block_tables,
+                    positions, valid=ok)[0]
+        out = paged_decode_attention(
+            q, k_pages, v_pages, block_tables, positions, mode=mode,
+            layer=layer, mesh=mesh, axis=axis,
+            k_scale=k_scale, v_scale=v_scale)
+        return out, k_pages, v_pages, k_scale, v_scale
+    kv_kw = {}
+    if quantized:
+        kv_kw = dict(k_scale=k_scale, v_scale=v_scale)
     if mode == "dma":
         out = paged_attention_decode_dma(
             q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
             ctx_lens, layer=lay,
         )
         return out[:, None] if s == 1 else out
-    if mode == "dma2":
-        out = paged_attention_decode_dma2(
-            q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
-            ctx_lens, layer=lay,
-        )
-        return out[:, None] if s == 1 else out
-    if mode == "dma3":
-        out = paged_attention_decode_dma3(
-            q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
-            ctx_lens, layer=lay,
-        )
+    if mode in ("dma2", "dma3"):
+        fn = (paged_attention_decode_dma2 if mode == "dma2"
+              else paged_attention_decode_dma3)
+        if fused:
+            kv_kw = dict(kv_kw, new_k=new_k, new_v=new_v)
+            result = fn(q[:, 0], k_pages, v_pages, block_tables, ctx_lens,
+                        layer=lay, **kv_kw)
+            out = result[0][:, None]
+            if quantized:
+                return (out, *result[1:])
+            return out, result[1], result[2], None, None
+        out = fn(q[:, 0] if s == 1 else q, k_pages, v_pages, block_tables,
+                 ctx_lens, layer=lay, **kv_kw)
         return out[:, None] if s == 1 else out
     if mode == "ragged":
         # Decode (or verify) batch as the uniform special case of a ragged
@@ -136,7 +207,7 @@ def paged_decode_attention(
         out = ragged_paged_attention(
             q.reshape(b * s, h, hd), k_pages, v_pages, block_tables,
             positions, (s,) * b, layer=lay,
-            interpret=jax.default_backend() != "tpu",
+            interpret=jax.default_backend() != "tpu", **kv_kw,
         )
         return out.reshape(b, s, h, hd)
     if mode in ("pallas", "interpret"):
@@ -148,13 +219,34 @@ def paged_decode_attention(
     if k_pages.ndim == 5:
         k_pages = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
         v_pages = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
+        if quantized:
+            k_scale = jax.lax.dynamic_index_in_dim(k_scale, layer, 0,
+                                                   keepdims=False)
+            v_scale = jax.lax.dynamic_index_in_dim(v_scale, layer, 0,
+                                                   keepdims=False)
     hd = q.shape[-1]  # pool lanes may be padded wider (kv_cache.phys_head_dim)
-    k_all = kvc.gather_kv(k_pages, block_tables)[..., :hd]
-    v_all = kvc.gather_kv(v_pages, block_tables)[..., :hd]
+    if quantized:
+        k_all = kvc.gather_kv_dequant(k_pages, k_scale,
+                                      block_tables)[..., :hd].astype(q.dtype)
+        v_all = kvc.gather_kv_dequant(v_pages, v_scale,
+                                      block_tables)[..., :hd].astype(q.dtype)
+    else:
+        k_all = kvc.gather_kv(k_pages, block_tables)[..., :hd]
+        v_all = kvc.gather_kv(v_pages, block_tables)[..., :hd]
     q_positions = positions[:, None] + jnp.arange(s, dtype=jnp.int32)[None]
     return causal_attention(
         q, k_all, v_all, q_positions=q_positions, kv_valid_len=positions + s
     )
+
+
+def _unstacked_quant_write(pages, scale, new, block_tables, positions,
+                           valid=None):
+    """write_decode_kv_full_quant for a single-layer (4D) pool + [nb, KH]
+    scales — the tests' direct-kernel shape."""
+    p, sc = kvc.write_decode_kv_full_quant(
+        pages[None], scale[None], jnp.int32(0), new, block_tables, positions,
+        valid=valid)
+    return p[0], sc[0]
 
 
 def hybrid_ragged_attention(
@@ -166,19 +258,38 @@ def hybrid_ragged_attention(
     q_lens: tuple[int, ...],   # static; sum == T
     mode: str | None = None,
     layer=None,
+    k_scale=None,  # [nb, KH] / [L, nb, KH] f32: scaled int8 pool
+    v_scale=None,
+    new_k=None,    # [T, KH, hd]: fused KV writes (all rows' tokens)
+    new_v=None,
 ):
     """Ragged-batch attention dispatch for the hybrid prefill+decode step.
 
     The Pallas ragged kernel on TPU, the jnp grouped-gather oracle
     elsewhere (the oracle outruns interpret mode on CPU, the same split
     every other backend mode makes). `mode` forces one path: "ragged"
-    (kernel; interpret engages automatically off-TPU) or "gather"."""
+    (kernel; interpret engages automatically off-TPU) or "gather".
+
+    `k_scale`/`v_scale` dequantize the scaled int8 pool on either path.
+    `new_k`/`new_v` fuse the hybrid step's KV writes (decode lanes' token
+    rows + the chunk row's whole pages) into this call: the kernel lands
+    them in-grid, the gather path performs the byte-identical writes
+    functionally first — either way the call returns (out, k_pages,
+    v_pages). Fused writes require block-aligned chunk rows (the hybrid
+    scheduler's invariant) and refuse the int8 pool (a q-block cannot own
+    a page's scale)."""
     if mode is None:
         mode = "ragged" if jax.default_backend() == "tpu" else "gather"
+    fused = new_k is not None
+    if fused and k_scale is not None:
+        raise ValueError(
+            "fused hybrid KV writes do not compose with the scaled int8 "
+            "pool — keep the separate quantizing writes")
     if mode == "ragged":
         return ragged_paged_attention(
             q, k_pages, v_pages, block_tables, positions, q_lens,
             layer=layer, interpret=jax.default_backend() != "tpu",
+            k_scale=k_scale, v_scale=v_scale, new_k=new_k, new_v=new_v,
         )
     if mode != "gather":
         # A typo'd hybrid_attn_mode must not silently serve the slow
@@ -186,8 +297,65 @@ def hybrid_ragged_attention(
         raise ValueError(
             f"hybrid attention mode {mode!r} invalid; choose 'ragged' or "
             f"'gather'")
+    if fused:
+        k_pages, v_pages = _functional_ragged_write(
+            k_pages, v_pages, block_tables, positions, q_lens, layer,
+            new_k, new_v)
+        out = ragged_paged_attention_ref(
+            q, k_pages, v_pages, block_tables, positions, q_lens,
+            layer=layer, k_scale=k_scale, v_scale=v_scale)
+        return out, k_pages, v_pages
     return ragged_paged_attention_ref(
-        q, k_pages, v_pages, block_tables, positions, q_lens, layer=layer)
+        q, k_pages, v_pages, block_tables, positions, q_lens, layer=layer,
+        k_scale=k_scale, v_scale=v_scale)
+
+
+def _functional_ragged_write(k_pages, v_pages, block_tables, positions,
+                             q_lens, layer, new_k, new_v):
+    """The gather-mode half of the fused ragged write: byte-identical to
+    the separate-dispatch hybrid writes (decode lanes via the chained-DUS
+    token writer, chunk rows via whole-page DUS at the block-aligned
+    table offset)."""
+    stacked = k_pages.ndim == 5
+    bs = k_pages.shape[-2]
+    lay = layer if stacked else jnp.int32(0)
+    if not stacked:
+        k_pages, v_pages = k_pages[None], v_pages[None]
+    capacity = block_tables.shape[1] * bs
+    start = 0
+    zero = jnp.int32(0)
+    for r, ln in enumerate(q_lens):
+        if ln == 1:
+            ok = (positions[r] < capacity)[None]
+            k_pages = kvc.write_decode_kv_full(
+                k_pages, lay, new_k[start:start + 1], block_tables[r:r + 1],
+                positions[r:r + 1], valid=ok)
+            v_pages = kvc.write_decode_kv_full(
+                v_pages, lay, new_v[start:start + 1], block_tables[r:r + 1],
+                positions[r:r + 1], valid=ok)
+        else:
+            if ln % bs:
+                raise ValueError(
+                    f"fused ragged writes need block-aligned chunk rows "
+                    f"(q_len {ln} % block_size {bs})")
+            first_block = positions[r] // bs
+            kp = new_k[start:start + ln].transpose(1, 0, 2)  # [KH, ln, hd]
+            vp = new_v[start:start + ln].transpose(1, 0, 2)
+            kh, _, hd = kp.shape
+            for p in range(ln // bs):
+                blk = block_tables[r, first_block + p]
+                kup = kp[:, p * bs:(p + 1) * bs][None, :, None]
+                vup = vp[:, p * bs:(p + 1) * bs][None, :, None]
+                k_pages = jax.lax.dynamic_update_slice(
+                    k_pages, kup.astype(k_pages.dtype),
+                    (lay, zero, blk, zero, zero))
+                v_pages = jax.lax.dynamic_update_slice(
+                    v_pages, vup.astype(v_pages.dtype),
+                    (lay, zero, blk, zero, zero))
+        start += ln
+    if not stacked:
+        k_pages, v_pages = k_pages[0], v_pages[0]
+    return k_pages, v_pages
 
 
 def _shard_dma_attention(q, k_pages, v_pages, block_tables, ctx_lens, layer,

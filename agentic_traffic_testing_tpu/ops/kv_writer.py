@@ -92,3 +92,62 @@ def write_prompt_pages(
     (pool_k, pool_v), _ = jax.lax.scan(
         body, (pool_k, pool_v), jnp.arange(t // bs, dtype=jnp.int32))
     return pool_k, pool_v
+
+
+def write_prompt_pages_quant(
+    pool_k: jax.Array,        # [L, KH, NB, bs, hdp] int8
+    pool_v: jax.Array,
+    k_scale: jax.Array,       # [L, NB, KH] f32
+    v_scale: jax.Array,
+    new_k: jax.Array,         # [L, B, KH, T, hdp] compute dtype (NOT int8)
+    new_v: jax.Array,
+    block_tables: jax.Array,  # [B, max_blocks]
+    first_block=0,            # scalar: table column of token 0 (chunk paths)
+) -> tuple[jax.Array, jax.Array, jax.Array, jax.Array]:
+    """Quantized prompt-page write: one fp32 scale per (layer, seq-page,
+    kv-head) from the page's absmax, int8 pages through the DUS writer
+    (the only one that takes a traced chunk offset), scales scattered in
+    one .at[].set per array (the scale pool is ~4096x smaller than the
+    page pool, so the scatter's copy-then-update lowering is noise).
+    Prompt pages are written exactly once, so no requant pass exists here
+    — only the decode append (kv_cache.write_decode_kv_full_quant) ever
+    re-scales a page.
+
+    Known precision nuance: a partial last page's absmax includes its
+    padding rows' K/V (slots past seq_len that nothing ever READS — but
+    the page scale is shared, so a pad row louder than every real row
+    inflates it and costs the real rows quantization resolution). Pad
+    magnitudes are comparable to real tokens' (same projections, token 0
+    embeddings), so the inflation is bounded; the accuracy-tier tests and
+    bench's quality gate own the budget. Masking rows >= seq_len before
+    the absmax is the refinement if a real checkpoint ever blows a tier."""
+    from agentic_traffic_testing_tpu.runtime.kv_cache import (
+        KV_QMAX,
+        quantize_with_scale,
+    )
+
+    L, b, kh, t, hdp = new_k.shape
+    bs = pool_k.shape[3]
+    nbp = t // bs
+
+    def qpages(new):
+        x = new.astype(jnp.float32).reshape(L, b, kh, nbp, bs, hdp)
+        scale = jnp.max(jnp.abs(x), axis=(-2, -1)) / KV_QMAX  # [L, B, KH, nbp]
+        q = quantize_with_scale(x, scale[..., None, None])
+        return q.reshape(L, b, kh, t, hdp), scale
+
+    qk, sk = qpages(new_k)
+    qv, sv = qpages(new_v)
+    pool_k, pool_v = write_prompt_pages(pool_k, pool_v, qk, qv, block_tables,
+                                        mode="dus", first_block=first_block)
+    cols = first_block + jnp.arange(nbp, dtype=jnp.int32)
+    idx = jnp.take_along_axis(
+        block_tables, jnp.broadcast_to(cols[None], (b, nbp)), axis=1)
+    flat = idx.reshape(-1)                                    # [B*nbp]
+    # [L, B, KH, nbp] -> [L, B*nbp, KH]; duplicate trash indices race among
+    # themselves only (same contract as the page writers).
+    sk2 = sk.transpose(0, 1, 3, 2).reshape(L, b * nbp, kh)
+    sv2 = sv.transpose(0, 1, 3, 2).reshape(L, b * nbp, kh)
+    k_scale = k_scale.at[:, flat, :].set(sk2, mode="drop")
+    v_scale = v_scale.at[:, flat, :].set(sv2, mode="drop")
+    return pool_k, pool_v, k_scale, v_scale

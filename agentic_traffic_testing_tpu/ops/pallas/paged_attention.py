@@ -51,6 +51,69 @@ _NEG_INF = -1e30
 # f32 scratch min tile is (8, 128): pad the softmax-stat lanes up to it.
 _STAT_LANES = 128
 _MIN_SUBLANES = 8
+# Scaled int8 KV (kv_cache_dtype="int8"): ONE source for the quantization
+# constants so the fused in-kernel write rounds identically to the XLA
+# write path (the byte-identity contract). runtime/kv_cache.py does not
+# import ops/, so this import is cycle-free.
+from agentic_traffic_testing_tpu.runtime.kv_cache import (  # noqa: E402
+    requant_page_int8 as _requant_page,
+)
+
+
+def _pad_scale_tiles(scale_l: jax.Array, block_tables: jax.Array,
+                     pages_per_chunk: int) -> jax.Array:
+    """Pre-gather one layer's per-page scales into per-row tiles.
+
+    scale_l [num_blocks, KH] f32, block_tables [B, W] -> [B, KH, Wp] with
+    Wp padded so EVERY chunk's [ci*cp, ci*cp + cp) scale slice is in
+    bounds (ceil(W/cp)*cp, then up to the 128-lane tile) — a clamped
+    dynamic_slice on the last chunk would silently apply the wrong pages'
+    scales. ~W*KH*4 bytes per row — negligible next to the pages
+    themselves, so the gather runs in XLA and the tile rides the kernels'
+    BlockSpec pipeline (the scale multiply then hides under the page
+    DMAs instead of costing extra descriptors)."""
+    s = scale_l[block_tables]                      # [B, W, KH]
+    s = s.transpose(0, 2, 1)                       # [B, KH, W]
+    w = s.shape[-1]
+    cp = pages_per_chunk
+    w_cover = -(-w // cp) * cp
+    wp = -(-w_cover // _STAT_LANES) * _STAT_LANES
+    if wp != w:
+        s = jnp.pad(s, ((0, 0), (0, 0), (0, wp - w)))
+    return s
+
+
+def _layer_scales(scale: jax.Array, layer, block_tables: jax.Array,
+                  pages_per_chunk: int):
+    """Slice the (possibly stacked) scale array to one layer's tiles."""
+    if scale.ndim == 3:
+        scale = jax.lax.dynamic_index_in_dim(
+            scale, jnp.asarray(layer, jnp.int32), 0, keepdims=False)
+    return _pad_scale_tiles(scale, block_tables, pages_per_chunk)
+
+
+def _pad_new_kv(new: jax.Array, hd_page: int, dtype) -> jax.Array:
+    """[B, KH, hd] fresh decode-token K or V -> [B, KH, 1, hdp] write tile
+    (zero pad lanes, exactly what the separate-dispatch writer leaves)."""
+    b, kh, hd = new.shape
+    new = new.astype(dtype)
+    if hd_page != hd:
+        new = jnp.pad(new, ((0, 0), (0, 0), (0, hd_page - hd)))
+    return new.reshape(b, kh, 1, hd_page)
+
+
+def _expand_chunk_scales(s_tile, ci, cp, bs, pi_w=None, s_new=None):
+    """[KH, Wp] per-page scales -> [KH, cp*bs] per-slot scales for chunk ci.
+
+    With a fused quantized write in flight, the target page's gathered
+    scale is stale — override page `pi_w` with the freshly computed
+    `s_new` ([KH])."""
+    kh = s_tile.shape[0]
+    chunk = jax.lax.dynamic_slice_in_dim(s_tile, ci * cp, cp, axis=1)
+    if s_new is not None:
+        pids = ci * cp + jax.lax.broadcasted_iota(jnp.int32, (kh, cp), 1)
+        chunk = jnp.where(pids == pi_w, s_new[:, None], chunk)
+    return jnp.repeat(chunk, bs, axis=1)
 
 
 def _pack_gqa_q(q: jax.Array, kh: int, hd_page: int):
@@ -346,6 +409,8 @@ def _dma2_decode_kernel(
     stacked: bool,
     q_per_seq: int = 1,
     queries_per_kv: int = 1,
+    quantized: bool = False,
+    fused_write: bool = False,
 ):
     """Decode kernel v3: one grid program per sequence; each page DMA moves
     ALL kv heads at once.
@@ -358,18 +423,49 @@ def _dma2_decode_kernel(
     Llama-1B shapes): 8x fewer DMAs, 8x fewer grid programs, and the
     flash-attention softmax runs batched over the head dim on the MXU.
 
+    Round 10 extensions (both trace-time static, off = byte-identical
+    programs):
+      * `quantized` — the pool is scaled int8: per-row scale tiles
+        ([1, KH, Wp] f32, pre-gathered in XLA) ride the BlockSpec pipeline
+        and the chunk walk dequantizes each page after the int8 load, so
+        the extra VPU multiply hides under the (halved) page DMAs.
+      * `fused_write` — the lane's fresh decode-token K/V arrives as a
+        [1, KH, 1, hdp] tile and the kernel writes it into the pool
+        (aliased in/out) BEFORE its chunk walk — the separate chained-DUS
+        write op per lane disappears. For int8 the write requants the
+        target page in VMEM (the page the walk re-reads anyway) and
+        overrides its stale gathered scale with s_new.
+
     Ref order: [layer_ref?], block_tables_ref [B, W] (SMEM), ctx_lens_ref
     [B, 1] (SMEM), q_ref [1, KH, rows, hd] (VMEM), k_hbm/v_hbm (ANY: full
-    pool), o_ref [1, KH, rows, hd], k_buf/v_buf [2, KH, CP*bs, hd] VMEM
-    scratch, sems DMA-semaphore array [2, 2]."""
-    if stacked:
-        layer_ref = refs[0]
-        (bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
-         k_buf, v_buf, sems) = refs[1:]
+    pool), [k/v scale tiles [1, KH, Wp] (VMEM)]Q, [k/v full scale arrays
+    (ANY, aliased)]Q+F, [new k/v tiles [1, KH, 1, hd] (VMEM)]F, o_ref
+    [1, KH, rows, hd], [aliased pool (+scale) out refs]F, k_buf/v_buf
+    [2, KH, CP*bs, hd] VMEM scratch, [s_buf [8, 128] f32]Q+F, sems
+    DMA-semaphore array [2, 2]."""
+    it = iter(refs)
+    layer_ref = next(it) if stacked else None
+    bt_ref, cl_ref, q_ref = next(it), next(it), next(it)
+    k_in, v_in = next(it), next(it)
+    ks_t = vs_t = nk_ref = nv_ref = s_buf = None
+    if quantized:
+        ks_t, vs_t = next(it), next(it)
+    if fused_write and quantized:
+        next(it), next(it)  # full scale arrays: aliased, use the out refs
+    if fused_write:
+        nk_ref, nv_ref = next(it), next(it)
+    o_ref = next(it)
+    if fused_write:
+        k_hbm, v_hbm = next(it), next(it)  # aliased out refs ARE the pool
+        ks_mem = vs_mem = None
+        if quantized:
+            ks_mem, vs_mem = next(it), next(it)
     else:
-        layer_ref = None
-        (bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
-         k_buf, v_buf, sems) = refs
+        k_hbm, v_hbm = k_in, v_in
+    k_buf, v_buf = next(it), next(it)
+    if quantized and fused_write:
+        s_buf = next(it)
+    sems = next(it)
     b = pl.program_id(0)
     cp = pages_per_chunk
     kh = k_buf.shape[1]
@@ -392,6 +488,68 @@ def _dma2_decode_kernel(
         return pltpu.make_async_copy(
             src, buf.at[slot, :, pl.ds(p * bs, bs), :], sems.at[slot, sem_col]
         )
+
+    # Fused decode-token write (round 10): land this lane's fresh K/V at
+    # position ctx-1 before anything is read. Over-capacity positions route
+    # to the trash block like the XLA writer's `valid` mask; every read of
+    # the written page below orders after the waited write.
+    pi_w = jnp.minimum((ctx - 1) // bs, w - 1)
+    s_new_k = s_new_v = None
+    if fused_write and not quantized:
+        blk_w = jnp.where(ctx - 1 < w * bs, bt_ref[b, pi_w], 0)
+        row_w = (ctx - 1) % bs
+
+        def row_copy(new_ref, pool_ref, sem_col):
+            if stacked:
+                dst = pool_ref.at[layer_ref[0], :, blk_w, pl.ds(row_w, 1), :]
+            else:
+                dst = pool_ref.at[:, blk_w, pl.ds(row_w, 1), :]
+            return pltpu.make_async_copy(new_ref.at[0], dst,
+                                         sems.at[0, sem_col])
+
+        row_copy(nk_ref, k_hbm, 0).start()
+        row_copy(nv_ref, v_hbm, 1).start()
+        row_copy(nk_ref, k_hbm, 0).wait()
+        row_copy(nv_ref, v_hbm, 1).wait()
+    elif fused_write:
+        blk_w = jnp.where(ctx - 1 < w * bs, bt_ref[b, pi_w], 0)
+        row_w = (ctx - 1) % bs
+
+        def requant_write(new_ref, pool_ref, s_tile, s_mem, buf, sem_col,
+                          srow):
+            """Read-modify-write the target page against the token's scale
+            (the chunk walk's slot-0 buffer doubles as scratch — chunk 0's
+            real DMA lands on top afterwards). Returns s_new [KH]."""
+            if stacked:
+                page_mem = pool_ref.at[layer_ref[0], :, blk_w]
+                scale_mem = s_mem.at[layer_ref[0], pl.ds(blk_w, 1), :]
+            else:
+                page_mem = pool_ref.at[:, blk_w]
+                scale_mem = s_mem.at[pl.ds(blk_w, 1), :]
+            cp_in = pltpu.make_async_copy(
+                page_mem, buf.at[0, :, pl.ds(0, bs), :], sems.at[0, sem_col])
+            cp_in.start()
+            cp_in.wait()
+            tok = new_ref[0, :, 0, :].astype(jnp.float32)        # [KH, hdp]
+            s_old = jax.lax.dynamic_slice_in_dim(
+                s_tile[0], pi_w, 1, axis=1)[:, 0]                # [KH]
+            page_q, s_new = _requant_page(buf[0, :, :bs, :], tok, s_old,
+                                          row_w)
+            buf[0, :, :bs, :] = page_q
+            cp_out = pltpu.make_async_copy(
+                buf.at[0, :, pl.ds(0, bs), :], page_mem, sems.at[0, sem_col])
+            cp_out.start()
+            cp_out.wait()
+            s_buf[pl.ds(srow, 1), pl.ds(0, kh)] = s_new[None]
+            sc = pltpu.make_async_copy(
+                s_buf.at[pl.ds(srow, 1), pl.ds(0, kh)], scale_mem,
+                sems.at[0, sem_col])
+            sc.start()
+            sc.wait()
+            return s_new
+
+        s_new_k = requant_write(nk_ref, k_hbm, ks_t, ks_mem, k_buf, 0, 0)
+        s_new_v = requant_write(nv_ref, v_hbm, vs_t, vs_mem, v_buf, 1, 1)
 
     def issue(ci, slot):
         for p in range(cp):
@@ -440,6 +598,11 @@ def _dma2_decode_kernel(
         wait(ci, slot)
         k = k_buf[slot].astype(jnp.float32)                  # [KH, cp*bs, hd]
         v = v_buf[slot].astype(jnp.float32)
+        if quantized:
+            k = k * _expand_chunk_scales(ks_t[0], ci, cp, bs,
+                                         pi_w, s_new_k)[:, :, None]
+            v = v * _expand_chunk_scales(vs_t[0], ci, cp, bs,
+                                         pi_w, s_new_v)[:, :, None]
         s = jax.lax.dot_general(                             # [KH, rows, cp*bs]
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
@@ -480,16 +643,29 @@ def paged_attention_decode_dma2(
     layer: jax.Array | None = None,
     scale: float | None = None,
     pages_per_chunk: int = 8,
+    k_scale: jax.Array | None = None,  # [nb, KH] or [L, nb, KH] f32 (int8)
+    v_scale: jax.Array | None = None,
+    new_k: jax.Array | None = None,    # [B, KH, hd] — fused decode write
+    new_v: jax.Array | None = None,
     interpret: bool = False,
-) -> jax.Array:
+):
     """Decode paged attention, all-heads-per-DMA variant (_dma2_decode_kernel).
 
     Same contract as paged_attention_decode_dma; grid is (B,) and each page
     DMA carries every kv head, so descriptor count drops from
-    B*KH*pages*2 to B*pages*2 per call."""
+    B*KH*pages*2 to B*pages*2 per call.
+
+    `k_scale`/`v_scale` mark the pool as scaled int8: the kernel
+    dequantizes inside its chunk walk. `new_k`/`new_v` fuse the decode
+    KV write into the kernel (the pool — and, for int8, the scale arrays
+    — alias in/out): returns (out, k_pages, v_pages[, k_scale, v_scale])
+    instead of just out. Fused writes serve the single-query decode shape
+    only."""
     stacked = k_pages.ndim == 5
     if stacked and layer is None:
         raise ValueError("stacked (5D) pages require a layer index")
+    quantized = k_scale is not None
+    fused = new_k is not None
     kh, bs, hd_page = k_pages.shape[-4], k_pages.shape[-2], k_pages.shape[-1]
     max_blocks = block_tables.shape[1]
     if scale is None:
@@ -498,50 +674,113 @@ def paged_attention_decode_dma2(
 
     q_r, meta = _pack_gqa_q(q, kh, hd_page)
     _, b, s_q, qpk, _, _ = meta
+    if fused and s_q > 1:
+        raise ValueError("fused KV write serves single-query decode only")
     rows = s_q * qpk
     hd = hd_page
     if stacked:
         def q_map(bi, lay, bt, cl):
             return (bi, 0, 0, 0)
+
+        def s_map(bi, lay, bt, cl):
+            return (bi, 0, 0)
+
+        def n_map(bi, lay, bt, cl):
+            return (bi, 0, 0, 0)
         prefetch_args = (jnp.asarray(layer, jnp.int32).reshape(1),)
     else:
         def q_map(bi, bt, cl):
             return (bi, 0, 0, 0)
+
+        def s_map(bi, bt, cl):
+            return (bi, 0, 0)
+
+        def n_map(bi, bt, cl):
+            return (bi, 0, 0, 0)
         prefetch_args = ()
 
+    num_prefetch = 2 + len(prefetch_args)
+    in_specs = [
+        pl.BlockSpec((1, kh, rows, hd), q_map),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    args = [q_r, k_pages, v_pages]
+    if quantized:
+        ks_t = _layer_scales(k_scale, layer if stacked else 0, block_tables,
+                             cp)
+        vs_t = _layer_scales(v_scale, layer if stacked else 0, block_tables,
+                             cp)
+        wp = ks_t.shape[-1]
+        in_specs += [pl.BlockSpec((1, kh, wp), s_map)] * 2
+        args += [ks_t, vs_t]
+    if fused and quantized:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        args += [k_scale, v_scale]
+    if fused:
+        in_specs += [pl.BlockSpec((1, kh, 1, hd), n_map)] * 2
+        args += [_pad_new_kv(new_k, hd, jnp.float32 if quantized
+                             else k_pages.dtype),
+                 _pad_new_kv(new_v, hd, jnp.float32 if quantized
+                             else v_pages.dtype)]
+
+    out_shape = [jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype)]
+    out_specs = [pl.BlockSpec((1, kh, rows, hd), q_map)]
+    aliases = {}
+    if fused:
+        # Operand numbering includes the scalar-prefetch args; q sits at
+        # num_prefetch, so operand i of `args` is num_prefetch + i.
+        out_shape += [jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                      jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype)]
+        out_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        aliases[num_prefetch + 1] = 1
+        aliases[num_prefetch + 2] = 2
+        if quantized:
+            out_shape += [jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                          jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype)]
+            out_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+            aliases[num_prefetch + 5] = 3
+            aliases[num_prefetch + 6] = 4
+
+    scratch = [
+        pltpu.VMEM((2, kh, cp * bs, hd), k_pages.dtype),
+        pltpu.VMEM((2, kh, cp * bs, hd), k_pages.dtype),
+    ]
+    if quantized and fused:
+        scratch.append(pltpu.VMEM((_MIN_SUBLANES, _STAT_LANES), jnp.float32))
+    scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2 + len(prefetch_args),
+        num_scalar_prefetch=num_prefetch,
         grid=(b,),
-        in_specs=[
-            pl.BlockSpec((1, kh, rows, hd), q_map),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
-        out_specs=pl.BlockSpec((1, kh, rows, hd), q_map),
-        scratch_shapes=[
-            pltpu.VMEM((2, kh, cp * bs, hd), k_pages.dtype),
-            pltpu.VMEM((2, kh, cp * bs, hd), k_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs if fused else out_specs[0],
+        scratch_shapes=scratch,
     )
 
-    out = pl.pallas_call(
+    result = pl.pallas_call(
         functools.partial(
             _dma2_decode_kernel, scale=scale, pages_per_chunk=cp,
             stacked=stacked, q_per_seq=s_q, queries_per_kv=qpk,
+            quantized=quantized, fused_write=fused,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
+        out_shape=out_shape if fused else out_shape[0],
+        input_output_aliases=aliases,
         compiler_params=CompilerParams(
             # Every program zero-fills its own tail V slots (no cross-
-            # program scratch dependency), so the batch grid parallelizes
+            # program scratch dependency) and fused writes touch only the
+            # program's own lane's block, so the batch grid parallelizes
             # across megacore on v4/v5p.
             dimension_semantics=("parallel",),
         ),
         interpret=interpret,
     )(*prefetch_args, block_tables.astype(jnp.int32),
-      ctx_lens.astype(jnp.int32)[:, None], q_r, k_pages, v_pages)
-    return _unpack_gqa_out(out, kh, meta)
+      ctx_lens.astype(jnp.int32)[:, None], *args)
+    if not fused:
+        return _unpack_gqa_out(result, kh, meta)
+    out = _unpack_gqa_out(result[0], kh, meta)
+    return (out, *result[1:])
 
 
 def _dma3_decode_kernel(
@@ -552,6 +791,8 @@ def _dma3_decode_kernel(
     stacked: bool,
     q_per_seq: int = 1,
     queries_per_kv: int = 1,
+    quantized: bool = False,
+    fused_write: bool = False,
 ):
     """Decode kernel v4 (round 7: lane-parallel): grid (B, KH, C) — one
     double-buffered chunk walk per (sequence, kv-head) lane, with the
@@ -576,19 +817,45 @@ def _dma3_decode_kernel(
     scratch at the last chunk step (all real chunks precede it in the
     lane's sequential walk).
 
+    Round 10: `quantized` dequantizes scaled int8 pages in the chunk walk
+    against the lane's [1, 1, Wp] scale tile; `fused_write` lands the
+    lane's head-slice of the fresh decode token (tile [1, 1, 1, hd]) into
+    the aliased pool at the ci == 0 prologue — for int8 with a per-head
+    page requant whose s_new persists across the lane's chunk steps in
+    `s_buf` (scratch survives the lane's sequential ci walk; each lane
+    rewrites it at its own prologue).
+
     Ref order: [layer_ref?], block_tables_ref [B, W] (SMEM), ctx_lens_ref
     [B, 1] (SMEM), q_ref [1, 1, rows, hd] (VMEM), k_hbm/v_hbm (ANY: full
-    pool), o_ref [1, 1, rows, hd], k_buf/v_buf [2, CP*bs, hd] VMEM
+    pool), [k/v scale tiles [1, 1, Wp]]Q, [full scale arrays (ANY,
+    aliased)]Q+F, [new k/v tiles [1, 1, 1, hd]]F, o_ref [1, 1, rows, hd],
+    [aliased pool (+scale) out refs]F, k_buf/v_buf [2, CP*bs, hd] VMEM
     scratch, m_buf/l_buf [R, 128] f32 scratch, acc_buf [R, hd] f32
-    scratch, sems DMA-semaphore array [2, 2]."""
-    if stacked:
-        layer_ref = refs[0]
-        (bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
-         k_buf, v_buf, m_buf, l_buf, acc_buf, sems) = refs[1:]
+    scratch, [s_buf [8, 128] f32]Q+F, sems DMA-semaphore array [2, 2]."""
+    it = iter(refs)
+    layer_ref = next(it) if stacked else None
+    bt_ref, cl_ref, q_ref = next(it), next(it), next(it)
+    k_in, v_in = next(it), next(it)
+    ks_t = vs_t = nk_ref = nv_ref = s_buf = None
+    if quantized:
+        ks_t, vs_t = next(it), next(it)
+    if fused_write and quantized:
+        next(it), next(it)  # full scale arrays: aliased, use the out refs
+    if fused_write:
+        nk_ref, nv_ref = next(it), next(it)
+    o_ref = next(it)
+    if fused_write:
+        k_hbm, v_hbm = next(it), next(it)
+        ks_mem = vs_mem = None
+        if quantized:
+            ks_mem, vs_mem = next(it), next(it)
     else:
-        layer_ref = None
-        (bt_ref, cl_ref, q_ref, k_hbm, v_hbm, o_ref,
-         k_buf, v_buf, m_buf, l_buf, acc_buf, sems) = refs
+        k_hbm, v_hbm = k_in, v_in
+    k_buf, v_buf = next(it), next(it)
+    m_buf, l_buf, acc_buf = next(it), next(it), next(it)
+    if quantized and fused_write:
+        s_buf = next(it)
+    sems = next(it)
     bi = pl.program_id(0)
     h = pl.program_id(1)
     ci = pl.program_id(2)
@@ -633,8 +900,68 @@ def _dma3_decode_kernel(
     # mask replaces NaN scores), then start the lane's pipeline. Per-lane
     # (not per-call) so megacore halves with separate scratch each
     # initialize their own buffers.
+    # Fused decode-token write (round 10): once per lane, at the lane's
+    # first chunk step, BEFORE any page DMA is issued — this lane is the
+    # only reader of its (sequence, head) pages, so the grid stays
+    # "parallel". Over-capacity positions route to trash like the XLA
+    # writer's `valid` mask.
+    pi_w = jnp.minimum((ctx - 1) // bs, w - 1)
+
     @pl.when(ci == 0)
     def _prologue():
+        if fused_write:
+            blk_w = jnp.where(ctx - 1 < w * bs, bt_ref[bi, pi_w], 0)
+            row_w = (ctx - 1) % bs
+            if stacked:
+                k_page_mem = k_hbm.at[layer_ref[0], h, blk_w]
+                v_page_mem = v_hbm.at[layer_ref[0], h, blk_w]
+            else:
+                k_page_mem = k_hbm.at[h, blk_w]
+                v_page_mem = v_hbm.at[h, blk_w]
+            if not quantized:
+                for new_ref, page_mem, sc in ((nk_ref, k_page_mem, 0),
+                                              (nv_ref, v_page_mem, 1)):
+                    cpy = pltpu.make_async_copy(
+                        new_ref.at[0, 0],
+                        page_mem.at[pl.ds(row_w, 1), :], sems.at[0, sc])
+                    cpy.start()
+                    cpy.wait()
+            else:
+                def requant_write(new_ref, page_mem, s_tile, s_mem, buf,
+                                  sem_col, srow):
+                    """Single-head page requant (see _dma2's fused write);
+                    s_new persists in s_buf for the lane's later chunk
+                    steps' scale override."""
+                    if stacked:
+                        scale_mem = s_mem.at[layer_ref[0], pl.ds(blk_w, 1),
+                                             pl.ds(h, 1)]
+                    else:
+                        scale_mem = s_mem.at[pl.ds(blk_w, 1), pl.ds(h, 1)]
+                    cp_in = pltpu.make_async_copy(
+                        page_mem, buf.at[1, pl.ds(0, bs), :],
+                        sems.at[0, sem_col])
+                    cp_in.start()
+                    cp_in.wait()
+                    tok = new_ref[0, 0, 0, :].astype(jnp.float32)    # [hd]
+                    s_old = jax.lax.dynamic_slice_in_dim(
+                        s_tile[0, 0], pi_w, 1)                       # [1]
+                    page_q, s_new = _requant_page(
+                        buf[1, :bs, :][None], tok[None], s_old, row_w)
+                    buf[1, pl.ds(0, bs), :] = page_q[0]
+                    cp_out = pltpu.make_async_copy(
+                        buf.at[1, pl.ds(0, bs), :], page_mem,
+                        sems.at[0, sem_col])
+                    cp_out.start()
+                    cp_out.wait()
+                    s_buf[pl.ds(srow, 1), pl.ds(0, 1)] = s_new[None]
+                    sc = pltpu.make_async_copy(
+                        s_buf.at[pl.ds(srow, 1), pl.ds(0, 1)], scale_mem,
+                        sems.at[0, sem_col])
+                    sc.start()
+                    sc.wait()
+
+                requant_write(nk_ref, k_page_mem, ks_t, ks_mem, k_buf, 0, 0)
+                requant_write(nv_ref, v_page_mem, vs_t, vs_mem, v_buf, 1, 1)
         last_c = jax.lax.div(n_pages + cp - 1, cp) - 1
         for p in range(cp):
             @pl.when(last_c * cp + p >= n_pages)
@@ -667,6 +994,13 @@ def _dma3_decode_kernel(
         q = q_ref[0, 0].astype(jnp.float32) * scale          # [rows, hd]
         k = k_buf[slot].astype(jnp.float32)                  # [cp*bs, hd]
         v = v_buf[slot].astype(jnp.float32)
+        if quantized:
+            s_new_k = s_buf[0:1, 0] if fused_write else None
+            s_new_v = s_buf[1:2, 0] if fused_write else None
+            k = k * _expand_chunk_scales(ks_t[0, 0][None], ci, cp, bs,
+                                         pi_w, s_new_k)[0][:, None]
+            v = v * _expand_chunk_scales(vs_t[0, 0][None], ci, cp, bs,
+                                         pi_w, s_new_v)[0][:, None]
         s = jax.lax.dot_general(                             # [rows, cp*bs]
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -716,8 +1050,12 @@ def paged_attention_decode_dma3(
     layer: jax.Array | None = None,
     scale: float | None = None,
     pages_per_chunk: int = 16,
+    k_scale: jax.Array | None = None,  # [nb, KH] or [L, nb, KH] f32 (int8)
+    v_scale: jax.Array | None = None,
+    new_k: jax.Array | None = None,    # [B, KH, hd] — fused decode write
+    new_v: jax.Array | None = None,
     interpret: bool = False,
-) -> jax.Array:
+):
     """Decode paged attention, lane-parallel variant (_dma3_decode_kernel).
     Same contract as paged_attention_decode_dma2; grid is
     (B, KH, ceil(max_blocks/pages_per_chunk)) with the sequence and
@@ -734,6 +1072,8 @@ def paged_attention_decode_dma3(
     stacked = k_pages.ndim == 5
     if stacked and layer is None:
         raise ValueError("stacked (5D) pages require a layer index")
+    quantized = k_scale is not None
+    fused = new_k is not None
     kh, bs, hd_page = k_pages.shape[-4], k_pages.shape[-2], k_pages.shape[-1]
     max_blocks = block_tables.shape[1]
     if scale is None:
@@ -743,55 +1083,115 @@ def paged_attention_decode_dma3(
 
     q_r, meta = _pack_gqa_q(q, kh, hd_page)
     _, b, s_q, qpk, _, _ = meta
+    if fused and s_q > 1:
+        raise ValueError("fused KV write serves single-query decode only")
     rows = s_q * qpk
     hd = hd_page
     r_pad = max(rows, _MIN_SUBLANES)
     if stacked:
         def q_map(bi, hi, ci, lay, bt, cl):
             return (bi, hi, 0, 0)
+
+        def s_map(bi, hi, ci, lay, bt, cl):
+            return (bi, hi, 0)
+
+        def n_map(bi, hi, ci, lay, bt, cl):
+            return (bi, hi, 0, 0)
         prefetch_args = (jnp.asarray(layer, jnp.int32).reshape(1),)
     else:
         def q_map(bi, hi, ci, bt, cl):
             return (bi, hi, 0, 0)
+
+        def s_map(bi, hi, ci, bt, cl):
+            return (bi, hi, 0)
+
+        def n_map(bi, hi, ci, bt, cl):
+            return (bi, hi, 0, 0)
         prefetch_args = ()
 
+    num_prefetch = 2 + len(prefetch_args)
+    in_specs = [
+        pl.BlockSpec((1, 1, rows, hd), q_map),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    args = [q_r, k_pages, v_pages]
+    if quantized:
+        ks_t = _layer_scales(k_scale, layer if stacked else 0, block_tables,
+                             cp)
+        vs_t = _layer_scales(v_scale, layer if stacked else 0, block_tables,
+                             cp)
+        wp = ks_t.shape[-1]
+        in_specs += [pl.BlockSpec((1, 1, wp), s_map)] * 2
+        args += [ks_t, vs_t]
+    if fused and quantized:
+        in_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        args += [k_scale, v_scale]
+    if fused:
+        in_specs += [pl.BlockSpec((1, 1, 1, hd), n_map)] * 2
+        args += [_pad_new_kv(new_k, hd, jnp.float32 if quantized
+                             else k_pages.dtype),
+                 _pad_new_kv(new_v, hd, jnp.float32 if quantized
+                             else v_pages.dtype)]
+
+    out_shape = [jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype)]
+    out_specs = [pl.BlockSpec((1, 1, rows, hd), q_map)]
+    aliases = {}
+    if fused:
+        out_shape += [jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                      jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype)]
+        out_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        aliases[num_prefetch + 1] = 1
+        aliases[num_prefetch + 2] = 2
+        if quantized:
+            out_shape += [jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+                          jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype)]
+            out_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+            aliases[num_prefetch + 5] = 3
+            aliases[num_prefetch + 6] = 4
+
+    scratch = [
+        pltpu.VMEM((2, cp * bs, hd), k_pages.dtype),
+        pltpu.VMEM((2, cp * bs, hd), k_pages.dtype),
+        pltpu.VMEM((r_pad, _STAT_LANES), jnp.float32),
+        pltpu.VMEM((r_pad, _STAT_LANES), jnp.float32),
+        pltpu.VMEM((r_pad, hd), jnp.float32),
+    ]
+    if quantized and fused:
+        scratch.append(pltpu.VMEM((_MIN_SUBLANES, _STAT_LANES), jnp.float32))
+    scratch.append(pltpu.SemaphoreType.DMA((2, 2)))
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2 + len(prefetch_args),
+        num_scalar_prefetch=num_prefetch,
         grid=(b, kh, c),
-        in_specs=[
-            pl.BlockSpec((1, 1, rows, hd), q_map),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
-        out_specs=pl.BlockSpec((1, 1, rows, hd), q_map),
-        scratch_shapes=[
-            pltpu.VMEM((2, cp * bs, hd), k_pages.dtype),
-            pltpu.VMEM((2, cp * bs, hd), k_pages.dtype),
-            pltpu.VMEM((r_pad, _STAT_LANES), jnp.float32),
-            pltpu.VMEM((r_pad, _STAT_LANES), jnp.float32),
-            pltpu.VMEM((r_pad, hd), jnp.float32),
-            pltpu.SemaphoreType.DMA((2, 2)),
-        ],
+        in_specs=in_specs,
+        out_specs=out_specs if fused else out_specs[0],
+        scratch_shapes=scratch,
     )
 
-    out = pl.pallas_call(
+    result = pl.pallas_call(
         functools.partial(
             _dma3_decode_kernel, scale=scale, pages_per_chunk=cp,
             n_chunk_steps=c, stacked=stacked, q_per_seq=s_q,
-            queries_per_kv=qpk,
+            queries_per_kv=qpk, quantized=quantized, fused_write=fused,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((b, kh, rows, hd), q.dtype),
+        out_shape=out_shape if fused else out_shape[0],
+        input_output_aliases=aliases,
         compiler_params=CompilerParams(
             # Lanes are independent (private scratch, per-lane prologue
-            # and DMA pipeline); only the chunk walk within a lane is
-            # order-dependent.
+            # and DMA pipeline — the fused write touches only the lane's
+            # own (sequence, head) page slice); only the chunk walk within
+            # a lane is order-dependent.
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
     )(*prefetch_args, block_tables.astype(jnp.int32),
-      ctx_lens.astype(jnp.int32)[:, None], q_r, k_pages, v_pages)
-    return _unpack_gqa_out(out, kh, meta)
+      ctx_lens.astype(jnp.int32)[:, None], *args)
+    if not fused:
+        return _unpack_gqa_out(result, kh, meta)
+    out = _unpack_gqa_out(result[0], kh, meta)
+    return (out, *result[1:])
 
 
 @functools.partial(

@@ -49,6 +49,10 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
+    _expand_chunk_scales,
+    _layer_scales,
+)
 from agentic_traffic_testing_tpu.ops.pallas.tpu_compat import CompilerParams
 
 _NEG_INF = -1e30
@@ -60,26 +64,48 @@ def _ragged_kernel(
     pages_per_chunk: int,
     stacked: bool,
     queries_per_kv: int,
+    q_tokens_per_block: int = 8,
+    quantized: bool = False,
+    fused_write: bool = False,
 ):
     """One program per q-token block of one ragged row.
+
+    Round 10: `quantized` dequantizes scaled int8 pages in the chunk walk
+    against per-row scale tiles; `fused_write` lands each program's OWN
+    tokens' fresh K/V into the aliased pool before its walk — the hybrid
+    step's per-layer chained-DUS writes (decode lanes + chunk pages)
+    disappear into the one ragged dispatch. A chunk row's later q-blocks
+    read pages written by its earlier q-blocks IN THIS CALL, so the fused
+    grid runs "arbitrary" (program order; the caller gives up megacore
+    splitting — scripts/dev/kv_quant_ab.py is the hardware arbiter).
 
     Ref order: [layer_ref?], row_ref [G] (SMEM: row of this block),
     qoff_ref [G] (first token's index within the row), nreal_ref [G]
     (real tokens in this block, <= QBLK), block_tables_ref [R, W] (SMEM),
     ctx_lens_ref [R, 1] (SMEM: positions + 1), q_ref [1, KH, rows, hd]
     (VMEM; rows = QBLK * qpk, row i = token (i // qpk), GQA member
-    (i % qpk)), k_hbm/v_hbm (ANY: full pool), o_ref [1, KH, rows, hd],
-    k_buf/v_buf [2, KH, CP*bs, hd] VMEM scratch, sems DMA-semaphore
-    array [2, 2].
+    (i % qpk)), k_hbm/v_hbm (ANY: full pool), [k/v scale tiles
+    [1, KH, Wp] f32]Q, [new k/v tiles [1, KH, QBLK, hd]]F, o_ref
+    [1, KH, rows, hd], [aliased pool out refs]F, k_buf/v_buf
+    [2, KH, CP*bs, hd] VMEM scratch, sems DMA-semaphore array [2, 2].
     """
-    if stacked:
-        layer_ref = refs[0]
-        (row_ref, qoff_ref, nreal_ref, bt_ref, cl_ref, q_ref,
-         k_hbm, v_hbm, o_ref, k_buf, v_buf, sems) = refs[1:]
+    it = iter(refs)
+    layer_ref = next(it) if stacked else None
+    row_ref, qoff_ref, nreal_ref = next(it), next(it), next(it)
+    bt_ref, cl_ref, q_ref = next(it), next(it), next(it)
+    k_in, v_in = next(it), next(it)
+    ks_t = vs_t = nk_ref = nv_ref = None
+    if quantized:
+        ks_t, vs_t = next(it), next(it)
+    if fused_write:
+        nk_ref, nv_ref = next(it), next(it)
+    o_ref = next(it)
+    if fused_write:
+        k_hbm, v_hbm = next(it), next(it)  # aliased out refs ARE the pool
     else:
-        layer_ref = None
-        (row_ref, qoff_ref, nreal_ref, bt_ref, cl_ref, q_ref,
-         k_hbm, v_hbm, o_ref, k_buf, v_buf, sems) = refs
+        k_hbm, v_hbm = k_in, v_in
+    k_buf, v_buf = next(it), next(it)
+    sems = next(it)
     g = pl.program_id(0)
     r = row_ref[g]
     qoff = qoff_ref[g]
@@ -121,11 +147,50 @@ def _ragged_kernel(
                 page_copy(ci, p, slot, k_hbm, k_buf, 0).wait()
                 page_copy(ci, p, slot, v_hbm, v_buf, 1).wait()
 
+    # Fused write (round 10): land this program's own tokens' K/V before
+    # any page DMA is issued. Decode rows (and 1-token tail blocks) write
+    # one page row; multi-token blocks write a full QBLK row window —
+    # legal because the hybrid contract block-aligns chunk starts and the
+    # wrapper enforces bs % QBLK == 0, so a q-block never straddles a
+    # page; garbage rows beyond nreal land in slots past chunk_len that
+    # nothing ever reads (the separate-dispatch writer's exact contract).
+    if fused_write:
+        qblk = q_tokens_per_block
+        pos0_w = ctx - 1 + qoff
+        pi_w = jnp.minimum(pos0_w // bs, w - 1)
+        blk_w = jnp.where(pos0_w < w * bs, bt_ref[r, pi_w], 0)
+        row_w0 = pos0_w % bs
+
+        def tok_copy(new_ref, kv_hbm, sem_col, n):
+            if stacked:
+                dst = kv_hbm.at[layer_ref[0], :, blk_w,
+                                pl.ds(row_w0, n), :]
+            else:
+                dst = kv_hbm.at[:, blk_w, pl.ds(row_w0, n), :]
+            return pltpu.make_async_copy(
+                new_ref.at[0, :, pl.ds(0, n), :], dst, sems.at[0, sem_col])
+
+        @pl.when(nreal == 1)
+        def _write_one():
+            tok_copy(nk_ref, k_hbm, 0, 1).start()
+            tok_copy(nv_ref, v_hbm, 1, 1).start()
+            tok_copy(nk_ref, k_hbm, 0, 1).wait()
+            tok_copy(nv_ref, v_hbm, 1, 1).wait()
+
+        @pl.when(nreal > 1)
+        def _write_block():
+            tok_copy(nk_ref, k_hbm, 0, qblk).start()
+            tok_copy(nv_ref, v_hbm, 1, qblk).start()
+            tok_copy(nk_ref, k_hbm, 0, qblk).wait()
+            tok_copy(nv_ref, v_hbm, 1, qblk).wait()
+
     # Same stale-V hazard and same per-program cure as the dma2 kernel:
     # tail-chunk page slots past n_pages are never DMA'd, and masked p_
     # (exactly 0.0) times NaN from uninitialized VMEM would poison
     # `p_ @ v` — zero the never-copied slots of both buffers' tail region
-    # before any DMA is issued. Per program, so the grid stays "parallel".
+    # before any DMA is issued. Per program, so the grid stays "parallel"
+    # (fused writes flip it to "arbitrary" for the row-internal
+    # write-then-read ordering, not for this zeroing).
     for p in range(cp):
         @pl.when((n_chunks - 1) * cp + p >= n_pages)
         def _zero_tail(p=p):
@@ -146,6 +211,9 @@ def _ragged_kernel(
         wait(ci, slot)
         k = k_buf[slot].astype(jnp.float32)                  # [KH, cp*bs, hd]
         v = v_buf[slot].astype(jnp.float32)
+        if quantized:
+            k = k * _expand_chunk_scales(ks_t[0], ci, cp, bs)[:, :, None]
+            v = v * _expand_chunk_scales(vs_t[0], ci, cp, bs)[:, :, None]
         s = jax.lax.dot_general(                             # [KH, rows, cp*bs]
             q, k, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32,
@@ -221,17 +289,37 @@ def ragged_paged_attention(
     scale: float | None = None,
     pages_per_chunk: int = 8,
     q_tokens_per_block: int = 8,
+    k_scale: jax.Array | None = None,  # [nb, KH] or [L, nb, KH] f32 (int8)
+    v_scale: jax.Array | None = None,
+    new_k: jax.Array | None = None,    # [T, KH, hd] — fused page writes
+    new_v: jax.Array | None = None,
     interpret: bool = False,
-) -> jax.Array:
+):
     """Ragged paged attention over a mixed decode/prefill-chunk batch.
 
     See the module docstring for the contract; `q_tokens_per_block` is the
     static q tile each grid program owns (decode rows round up to one
     block — 8 keeps the pad waste at 7 tokens/row while the GQA packing
-    still fills 8*qpk MXU rows)."""
+    still fills 8*qpk MXU rows).
+
+    `k_scale`/`v_scale` mark the pool as scaled int8 (dequantized in the
+    chunk walk). `new_k`/`new_v` fuse the hybrid step's KV writes — every
+    row's tokens, decode lanes and chunk pages alike — into this kernel
+    (pool aliased in/out; grid flips to "arbitrary" for the row-internal
+    write-then-read order): the contract then requires the POOL state
+    from BEFORE this step plus block-aligned chunk starts, and the call
+    returns (out, k_pages, v_pages). Fused writes do not compose with the
+    int8 pool (a q-block smaller than a page cannot own the page's
+    scale) — the hybrid int8 path keeps its separate quantizing writes."""
     stacked = k_pages.ndim == 5
     if stacked and layer is None:
         raise ValueError("stacked (5D) pages require a layer index")
+    quantized = k_scale is not None
+    fused = new_k is not None
+    if fused and quantized:
+        raise ValueError(
+            "fused ragged KV writes do not compose with the scaled int8 "
+            "pool — use the separate quantizing write path")
     kh, bs, hd_page = k_pages.shape[-4], k_pages.shape[-2], k_pages.shape[-1]
     t, h, hd = q.shape
     if t != sum(q_lens):
@@ -242,6 +330,10 @@ def ragged_paged_attention(
         scale = 1.0 / math.sqrt(hd)
     cp = min(pages_per_chunk, max_blocks)
     qblk = q_tokens_per_block
+    if fused and bs % qblk:
+        raise ValueError(
+            f"fused ragged KV writes need block_size % q_tokens_per_block "
+            f"== 0 (got {bs} % {qblk}) so no q-block straddles a page")
 
     blk_row, blk_qoff, blk_nreal, src, inv = _block_layout(q_lens, qblk)
     n_blocks = len(blk_row)
@@ -258,21 +350,69 @@ def ragged_paged_attention(
     if stacked:
         def q_map(g, lay, row, qoff, nreal, bt, cl):
             return (g, 0, 0, 0)
+
+        def s_map(g, lay, row, qoff, nreal, bt, cl):
+            return (row[g], 0, 0)
+
+        def n_map(g, lay, row, qoff, nreal, bt, cl):
+            return (g, 0, 0, 0)
         prefetch_args = (jnp.asarray(layer, jnp.int32).reshape(1),)
     else:
         def q_map(g, row, qoff, nreal, bt, cl):
             return (g, 0, 0, 0)
+
+        def s_map(g, row, qoff, nreal, bt, cl):
+            return (row[g], 0, 0)
+
+        def n_map(g, row, qoff, nreal, bt, cl):
+            return (g, 0, 0, 0)
         prefetch_args = ()
 
+    num_prefetch = 5 + len(prefetch_args)
+    in_specs = [
+        pl.BlockSpec((1, kh, rows, hd_page), q_map),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+        pl.BlockSpec(memory_space=pltpu.ANY),
+    ]
+    args = [q_pad, k_pages, v_pages]
+    if quantized:
+        ks_t = _layer_scales(k_scale, layer if stacked else 0, block_tables,
+                             cp)
+        vs_t = _layer_scales(v_scale, layer if stacked else 0, block_tables,
+                             cp)
+        wp = ks_t.shape[-1]
+        in_specs += [pl.BlockSpec((1, kh, wp), s_map)] * 2
+        args += [ks_t, vs_t]
+    if fused:
+        # Fresh K/V packed like q: per-block [1, KH, QBLK, hdp] tiles
+        # (padding tokens carry garbage that lands in unread slots).
+        def pack_new(new, pool_dtype):
+            x = new.astype(pool_dtype)[jnp.asarray(src)]     # [G*QBLK, KH, hd]
+            x = x.reshape(n_blocks, qblk, kh, hd).transpose(0, 2, 1, 3)
+            if hd_page != hd:
+                x = jnp.pad(x, ((0, 0), (0, 0), (0, 0), (0, hd_page - hd)))
+            return x
+
+        in_specs += [pl.BlockSpec((1, kh, qblk, hd_page), n_map)] * 2
+        args += [pack_new(new_k, k_pages.dtype),
+                 pack_new(new_v, v_pages.dtype)]
+
+    out_shape = [jax.ShapeDtypeStruct((n_blocks, kh, rows, hd_page), q.dtype)]
+    out_specs = [pl.BlockSpec((1, kh, rows, hd_page), q_map)]
+    aliases = {}
+    if fused:
+        out_shape += [jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+                      jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype)]
+        out_specs += [pl.BlockSpec(memory_space=pltpu.ANY)] * 2
+        # Operand numbering includes the scalar-prefetch args.
+        aliases[num_prefetch + 1] = 1
+        aliases[num_prefetch + 2] = 2
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=5 + len(prefetch_args),
+        num_scalar_prefetch=num_prefetch,
         grid=(n_blocks,),
-        in_specs=[
-            pl.BlockSpec((1, kh, rows, hd_page), q_map),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-            pl.BlockSpec(memory_space=pltpu.ANY),
-        ],
-        out_specs=pl.BlockSpec((1, kh, rows, hd_page), q_map),
+        in_specs=in_specs,
+        out_specs=out_specs if fused else out_specs[0],
         scratch_shapes=[
             pltpu.VMEM((2, kh, cp * bs, hd_page), k_pages.dtype),
             pltpu.VMEM((2, kh, cp * bs, hd_page), k_pages.dtype),
@@ -280,27 +420,36 @@ def ragged_paged_attention(
         ],
     )
 
-    out = pl.pallas_call(
+    result = pl.pallas_call(
         functools.partial(
             _ragged_kernel, scale=scale, pages_per_chunk=cp,
-            stacked=stacked, queries_per_kv=qpk,
+            stacked=stacked, queries_per_kv=qpk, q_tokens_per_block=qblk,
+            quantized=quantized, fused_write=fused,
         ),
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((n_blocks, kh, rows, hd_page), q.dtype),
+        out_shape=out_shape if fused else out_shape[0],
+        input_output_aliases=aliases,
         compiler_params=CompilerParams(
             # Per-program tail-slot zeroing (no cross-program scratch
-            # dependency): blocks parallelize across megacore.
-            dimension_semantics=("parallel",),
+            # dependency): blocks parallelize across megacore — except
+            # under fused writes, where a chunk row's later q-blocks read
+            # pages its earlier q-blocks wrote in this call, so program
+            # order must hold.
+            dimension_semantics=("arbitrary",) if fused else ("parallel",),
         ),
         interpret=interpret,
     )(*prefetch_args, jnp.asarray(blk_row), jnp.asarray(blk_qoff),
       jnp.asarray(blk_nreal), block_tables.astype(jnp.int32),
-      (positions.astype(jnp.int32) + 1)[:, None], q_pad, k_pages, v_pages)
+      (positions.astype(jnp.int32) + 1)[:, None], *args)
 
+    out = result[0] if fused else result
     # Unpack: [G, KH, rows, hdp] -> padded token stream -> real tokens.
     out = out.reshape(n_blocks, kh, qblk, qpk, hd_page)
     out = out.transpose(0, 2, 1, 3, 4).reshape(n_blocks * qblk, h, hd_page)
-    return out[jnp.asarray(inv), :, :hd]
+    out = out[jnp.asarray(inv), :, :hd]
+    if fused:
+        return out, result[1], result[2]
+    return out
 
 
 def ragged_paged_attention_ref(
@@ -313,12 +462,15 @@ def ragged_paged_attention_ref(
     *,
     layer: jax.Array | None = None,
     scale: float | None = None,
+    k_scale: jax.Array | None = None,
+    v_scale: jax.Array | None = None,
 ) -> jax.Array:
     """jnp oracle (and CPU serving path) for `ragged_paged_attention`.
 
     Rows group by q_len (the grouping is static), so a hybrid batch costs
     one gather+causal_attention per distinct length — typically two: the
-    uniform decode rows and the one chunk row."""
+    uniform decode rows and the one chunk row. `k_scale`/`v_scale`
+    dequantize the scaled int8 pool exactly like the kernel does."""
     from agentic_traffic_testing_tpu.ops.jnp_ops import causal_attention
     from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
 
@@ -327,6 +479,11 @@ def ragged_paged_attention_ref(
             raise ValueError("stacked (5D) pages require a layer index")
         k_pages = jax.lax.dynamic_index_in_dim(k_pages, layer, 0, keepdims=False)
         v_pages = jax.lax.dynamic_index_in_dim(v_pages, layer, 0, keepdims=False)
+        if k_scale is not None:
+            k_scale = jax.lax.dynamic_index_in_dim(k_scale, layer, 0,
+                                                   keepdims=False)
+            v_scale = jax.lax.dynamic_index_in_dim(v_scale, layer, 0,
+                                                   keepdims=False)
     hd = q.shape[-1]
     starts = np.concatenate([[0], np.cumsum(q_lens)]).astype(int)
     groups: dict[int, list[int]] = {}
@@ -337,8 +494,14 @@ def ragged_paged_attention_ref(
         idx = jnp.asarray(rows, jnp.int32)
         qg = jnp.stack([q[starts[r]:starts[r] + ln] for r in rows])
         pos0 = positions[idx]
-        k_all = kvc.gather_kv(k_pages, block_tables[idx])[..., :hd]
-        v_all = kvc.gather_kv(v_pages, block_tables[idx])[..., :hd]
+        if k_scale is not None:
+            k_all = kvc.gather_kv_dequant(
+                k_pages, k_scale, block_tables[idx])[..., :hd]
+            v_all = kvc.gather_kv_dequant(
+                v_pages, v_scale, block_tables[idx])[..., :hd]
+        else:
+            k_all = kvc.gather_kv(k_pages, block_tables[idx])[..., :hd]
+            v_all = kvc.gather_kv(v_pages, block_tables[idx])[..., :hd]
         qpos = pos0[:, None] + jnp.arange(ln, dtype=jnp.int32)[None]
         out = causal_attention(
             qg, k_all.astype(qg.dtype), v_all.astype(qg.dtype),

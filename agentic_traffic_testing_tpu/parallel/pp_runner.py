@@ -249,6 +249,8 @@ class PPRunner(ModelRunner):
     supports_hybrid = False            # no staged hybrid jit either
     supports_prefill_pipeline = False  # no staged pipelined-chunk jit
     supports_decode_overlap = False    # no donated-state staged decode jit
+    supports_quantized_kv = False      # no staged scale plumbing (int8 KV)
+    supports_fused_kv_write = False    # no aliasing rule in the staged jits
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

@@ -67,6 +67,9 @@ class SPPrefillRunner(ModelRunner):
     supports_hybrid = False
     supports_prefill_pipeline = False
     supports_decode_overlap = False
+    # Nor for the scaled int8 pool / fused KV writes (see TPRunner).
+    supports_quantized_kv = False
+    supports_fused_kv_write = False
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,
@@ -138,6 +141,8 @@ class SPTPRunner(TPRunner):
     supports_chunked_prefill = True
     supports_prefill_pipeline = False  # see SPPrefillRunner
     supports_decode_overlap = False    # see SPPrefillRunner
+    supports_quantized_kv = False      # see SPPrefillRunner
+    supports_fused_kv_write = False    # see SPPrefillRunner
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

@@ -65,6 +65,11 @@ class TPRunner(ModelRunner):
     # No donated-state sharded decode jit for the overlapped decode loop;
     # the engine refuses decode_overlap=1 at build.
     supports_decode_overlap = False
+    # No scale-sharding rule in the shard_dma wrapper (int8 KV) and no
+    # aliasing rule for in-kernel pool writes (fused KV write); the engine
+    # refuses both knobs at build.
+    supports_quantized_kv = False
+    supports_fused_kv_write = False
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

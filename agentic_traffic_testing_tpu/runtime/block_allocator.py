@@ -350,7 +350,8 @@ class PrefixCachingAllocator(BlockAllocator):
 
                     restores.append(RestoreBlock(
                         block=got[0], key=ks[i], tokens=toks[i],
-                        k=entry.k, v=entry.v))
+                        k=entry.k, v=entry.v,
+                        k_scale=entry.k_scale, v_scale=entry.v_scale))
                     seq.blocks.append(got[0])
                     cached += bs
                     continue

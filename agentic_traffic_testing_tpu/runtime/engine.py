@@ -226,14 +226,31 @@ class EngineConfig:
     # no tokens; >= num_experts guarantees no capacity drops (exact HF
     # numerics) at the cost of E-fold larger expert buffers (models/moe.py).
     moe_capacity_factor: Optional[float] = None
-    # KV-cache page dtype: None (follow `dtype`) or "fp8" (float8_e4m3 pages
+    # KV-cache page dtype: None (follow `dtype`), "fp8" (float8_e4m3 pages
     # — exactly double the KV capacity / concurrency and half the decode KV
     # stream, no scale plumbing; the vLLM analog is --kv-cache-dtype fp8,
-    # which the reference inherits through its vllm dependency). e4m3's
-    # per-element dynamic exponent costs ~2% RMS on K/V (~6% on individual
-    # pre-softmax scores, averaging out over slots) — the accuracy envelope
-    # tests/test_kv_fp8.py pins.
+    # which the reference inherits through its vllm dependency), or "int8"
+    # (round 10: scaled int8 pages + one fp32 scale per (layer, page,
+    # kv-head), quantized at write and dequantized inside the dma2/dma3/
+    # ragged kernels' chunk walk — same byte savings as fp8 without its
+    # cast error, at the cost of a per-page requant on decode appends).
+    # Accuracy envelopes: e4m3's per-element dynamic exponent costs ~2% RMS
+    # on K/V (~6% on individual pre-softmax scores, averaging out over
+    # slots) — tests/test_kv_fp8.py pins it; int8's per-(page x kv-head)
+    # symmetric scale is ~0.5% RMS on settled K/V (127 levels against the
+    # page absmax) plus at most one extra re-round per louder newcomer
+    # token — tests/test_kv_quant.py pins that tier. Single-chip runners
+    # only for int8 (supports_quantized_kv).
     kv_cache_dtype: Optional[str] = None
+    # Fused KV page writes (round 10, LLM_FUSED_KV_WRITE): 1 folds the
+    # decode token write into the dma2/dma3 attention kernels (aliased
+    # pool, requant in-kernel for int8) and the hybrid chunk's page
+    # scatter into the ragged kernel — eliminating the separate chained-
+    # DUS write ops per layer. 0 (default) keeps every write path
+    # bit-identical to pre-knob builds. Off-TPU modes fuse functionally
+    # (same bytes, one call site), so the knob is CPU-testable.
+    # Single-chip, non-speculative runners only; int8 x hybrid refuses.
+    fused_kv_write: int = 0
     # None = auto (C++ native/ core if it builds, Python otherwise);
     # True/False force one implementation.
     native_allocator: Optional[bool] = None
@@ -253,10 +270,35 @@ class EngineConfig:
             raise ValueError(
                 f"unknown quantization {self.quantization!r}; "
                 f"supported: int8, int4")
-        if self.kv_cache_dtype not in (None, "fp8", "fp8_e4m3"):
+        if self.kv_cache_dtype not in (None, "fp8", "fp8_e4m3", "int8"):
             raise ValueError(
                 f"unknown kv_cache_dtype {self.kv_cache_dtype!r}; "
-                f"supported: fp8")
+                f"supported: fp8, int8")
+        if self.fused_kv_write not in (0, 1):
+            raise ValueError(
+                f"fused_kv_write must be 0 or 1, got {self.fused_kv_write}")
+        if self.fused_kv_write and self.speculation:
+            # The verify step writes S tokens per lane; the fused kernels
+            # carry exactly one — refuse at build, not first step.
+            raise ValueError(
+                "fused_kv_write x speculation is not wired — disable one "
+                "of them")
+        if (self.fused_kv_write and self.hybrid_token_budget
+                and self.kv_cache_dtype == "int8"):
+            # A ragged q-block smaller than a page cannot own the page's
+            # int8 scale; the hybrid int8 path keeps its separate
+            # quantizing writes instead.
+            raise ValueError(
+                "fused_kv_write x hybrid_token_budget x kv_cache_dtype="
+                "'int8' is not wired — disable one of the three")
+        if (self.fused_kv_write and self.hybrid_token_budget
+                and self.block_size % 8):
+            # 8 = the ragged kernel's q_tokens_per_block: fused in-grid
+            # writes need block_size % qblk == 0 so no q-block straddles a
+            # page — refuse at build, not at the first hybrid trace.
+            raise ValueError(
+                f"fused_kv_write x hybrid_token_budget needs block_size % 8 "
+                f"== 0 (the ragged q-block tile), got {self.block_size}")
         if self.speculation not in (None, "ngram"):
             raise ValueError(
                 f"unknown speculation {self.speculation!r}; supported: ngram")
@@ -511,6 +553,7 @@ class LLMEngine:
                 self.model_cfg, params, decode_steps=decode_steps,
                 spec_tokens=cfg.effective_spec_tokens,
                 spec_ngram=cfg.spec_ngram,
+                fused_kv_write=bool(cfg.fused_kv_write),
             )
 
         if cfg.hybrid_token_budget and not getattr(
@@ -540,11 +583,57 @@ class LLMEngine:
                 f"overlapped decode loop — build the engine with "
                 f"decode_overlap=0 (unset LLM_DECODE_OVERLAP)")
 
+        kv_quantized = cfg.kv_cache_dtype == "int8"
+        if kv_quantized:
+            # A pinned legacy attention mode (ATT_TPU_ATTENTION=dma/pallas/
+            # interpret) cannot dequantize the scaled pool: refuse at
+            # construction, not on every dispatch's trace.
+            from agentic_traffic_testing_tpu.ops.attention_backend import (
+                backend_choice,
+            )
+
+            attn_mode = getattr(self.runner, "attn_mode", None) or backend_choice()
+            if attn_mode in ("dma", "pallas", "interpret"):
+                raise ValueError(
+                    f"attention mode {attn_mode!r} does not serve the scaled "
+                    f"int8 KV pool — set ATT_TPU_ATTENTION to dma2, dma3, "
+                    f"ragged, or gather (or unset LLM_KV_CACHE_DTYPE)")
+        if kv_quantized and not getattr(self.runner, "supports_quantized_kv",
+                                        False):
+            # The shard_dma wrapper has no scale-sharding rule and the
+            # staged/sharded gather paths no scale plumbing: fail at
+            # construction, not first step.
+            raise ValueError(
+                f"{type(self.runner).__name__} does not support the scaled "
+                f"int8 KV pool — build the engine with kv_cache_dtype=None "
+                f"or 'fp8' (unset LLM_KV_CACHE_DTYPE)")
+        if cfg.fused_kv_write and not getattr(
+                self.runner, "supports_fused_kv_write", False):
+            raise ValueError(
+                f"{type(self.runner).__name__} does not support fused KV "
+                f"page writes — build the engine with fused_kv_write=0 "
+                f"(unset LLM_FUSED_KV_WRITE)")
+        if cfg.fused_kv_write and getattr(self.runner, "spec_tokens", 0) > 0:
+            # Caller-supplied speculative runner: the cfg validator only
+            # sees cfg-level speculation.
+            raise ValueError(
+                "fused_kv_write x speculative runner is not wired — build "
+                "the engine with fused_kv_write=0")
+        if runner is not None and bool(cfg.fused_kv_write) != bool(
+                getattr(self.runner, "fused_kv_write", False)):
+            # The fused flag is baked into the runner's jitted programs; a
+            # mismatched supplied runner would silently serve the other
+            # write path behind the knob's name.
+            raise ValueError(
+                "fused_kv_write conflicts with the supplied runner's "
+                "programs — build the runner with the same flag")
+
         num_blocks = cfg.num_blocks or self._default_num_blocks()
         kv_dtype = (jnp.float8_e4m3fn if cfg.kv_cache_dtype in ("fp8", "fp8_e4m3")
-                    else dtype)
+                    else jnp.int8 if kv_quantized else dtype)
         self.cache = self.runner.prepare_cache(
-            make_kv_cache(self.model_cfg, num_blocks, cfg.block_size, kv_dtype)
+            make_kv_cache(self.model_cfg, num_blocks, cfg.block_size, kv_dtype,
+                          quantized=kv_quantized)
         )
         self.allocator = make_block_allocator(num_blocks, cfg.block_size,
                                               native=cfg.native_allocator,
@@ -559,7 +648,9 @@ class LLMEngine:
             )
 
             self._host_store = host_store_from_gb(cfg.host_cache_gb)
-        self._save_pending: list = []  # (key, tokens, k_dev, v_dev) queue
+        self._save_pending: list = []  # (key, tokens, k, v, ks, vs) queue
+        #                                (ks/vs = scale slices, None unless
+        #                                the pool is scaled int8)
         self.host_restore_bytes = 0    # cumulative host→device restore bytes
         if self._host_store is not None:
             if not cfg.prefix_caching:
@@ -693,10 +784,13 @@ class LLMEngine:
             # No introspection (CPU tests): small fixed pool.
             return 512
         bytes_per = 2 if self.cfg.dtype in ("bfloat16", "bf16") else 4
-        # fp8 pages store one byte per element — the profiling pass hands
-        # out roughly double the blocks (and the transient scan outputs are
-        # cast to the page dtype inside the layer scan, so they halve too).
+        # fp8/int8 pages store one byte per element — the profiling pass
+        # hands out roughly double the blocks. (int8's transient scan
+        # outputs stay in compute dtype until the per-page quantize, so its
+        # prefill transient is sized at bytes_per below.)
         kv_bytes = 1 if self.cfg.kv_cache_dtype else bytes_per
+        transient_bytes = (bytes_per if self.cfg.kv_cache_dtype == "int8"
+                           else kv_bytes)
         # Reserve room for prefill's per-layer K/V scan outputs (llama.py
         # prefill_impl defers pool writes; the transient peaks at one full
         # prefill bucket, B*T <= max_num_batched_tokens, lane-padded).
@@ -705,7 +799,8 @@ class LLMEngine:
         transient = (2 * self.model_cfg.num_layers
                      * self.cfg.max_num_batched_tokens
                      * self.model_cfg.num_kv_heads
-                     * phys_head_dim(self.model_cfg.head_dim_) * kv_bytes)
+                     * phys_head_dim(self.model_cfg.head_dim_)
+                     * transient_bytes)
         free = max(0, free - transient)
         n = profile_num_blocks(
             self.model_cfg, self.cfg.block_size, free,
@@ -713,6 +808,9 @@ class LLMEngine:
             tp_size=self.runner.tp_size,
             # PPRunner shards the pool's layer axis over its stages.
             pp_size=getattr(self.runner, "pp", 1),
+            # int8 pools carry a K+V fp32 scale per (layer, page, kv-head).
+            scale_bytes_per_head=(8 if self.cfg.kv_cache_dtype == "int8"
+                                  else 0),
         )
         # Never exceed what max_num_seqs * max_model_len can actually use.
         cap = self.cfg.max_num_seqs * self.table_width + 1
@@ -1299,12 +1397,19 @@ class LLMEngine:
             self._flush_saves()
         k = self.cache.k[:, :, blk]
         v = self.cache.v[:, :, blk]
-        for a in (k, v):
+        # Quantized pools spill raw int8 pages PLUS their per-head scales —
+        # no round trip through bf16, so a later restore is byte-identical
+        # and the host tier holds ~2x the blocks per GB.
+        ks = vs = None
+        if self.cache.quantized:
+            ks = self.cache.k_scale[:, blk]
+            vs = self.cache.v_scale[:, blk]
+        for a in (k, v) if ks is None else (k, v, ks, vs):
             try:
                 a.copy_to_host_async()
             except Exception:
                 pass
-        self._save_pending.append((key, tokens, k, v))
+        self._save_pending.append((key, tokens, k, v, ks, vs))
         if self.telemetry is not None:
             self.telemetry.record_instant(EVENT_HOST_SAVE, time.monotonic())
 
@@ -1317,12 +1422,16 @@ class LLMEngine:
             return
         pending, self._save_pending = self._save_pending, []
         leaves: list = []
-        for _, _, k, v in pending:
-            leaves.append(k)
-            leaves.append(v)
+        for _, _, k, v, ks, vs in pending:
+            leaves.extend((k, v) if ks is None else (k, v, ks, vs))
         fetched = iter(jax.device_get(leaves))  # statics: allow-host-sync(batched host-tier save drain; async copies started at evict time)
-        for key, tokens, _, _ in pending:
-            self._host_store.put(key, tokens, next(fetched), next(fetched))
+        for key, tokens, _, _, ks, _ in pending:
+            if ks is None:
+                self._host_store.put(key, tokens, next(fetched), next(fetched))
+            else:
+                self._host_store.put(key, tokens, next(fetched), next(fetched),
+                                     k_scale=next(fetched),
+                                     v_scale=next(fetched))
 
     def _apply_pending_restore(self, r: Request) -> bool:
         """Write a request's host-tier restore plan into its freshly
@@ -1346,6 +1455,9 @@ class LLMEngine:
             # write: a corrupt host block must degrade to recompute, not
             # scatter garbage-shaped pages (or raise) mid-step.
             shape = self.cache.k.shape[:2] + self.cache.k.shape[3:]
+            sshape = (None if not self.cache.quantized
+                      else (self.cache.k_scale.shape[0],
+                            self.cache.k_scale.shape[2]))
             for rb in restores:
                 if (rb.k.shape != shape or rb.v.shape != shape
                         or rb.k.dtype != self.cache.k.dtype
@@ -1354,6 +1466,17 @@ class LLMEngine:
                         f"host block {rb.key} pages {rb.k.shape}/"
                         f"{rb.k.dtype} do not match the pool page "
                         f"{shape}/{self.cache.k.dtype}")
+                if sshape is not None and (
+                        rb.k_scale is None or rb.v_scale is None
+                        or rb.k_scale.shape != sshape
+                        or rb.v_scale.shape != sshape):
+                    raise ValueError(
+                        f"host block {rb.key} carries no (or mis-shaped) "
+                        f"int8 scales for the quantized pool ({sshape})")
+                if sshape is None and rb.k_scale is not None:
+                    raise ValueError(
+                        f"host block {rb.key} carries int8 scales but the "
+                        f"pool is not quantized")
             blks = jnp.asarray([rb.block for rb in restores], jnp.int32)
             # .at[].set on TPU lowers as copy-pool-then-update (~2 ms/GB,
             # the reason per-step KV writes are DUS chains — kv_cache.py).
@@ -1363,10 +1486,23 @@ class LLMEngine:
             # rate. [N, L, KH, bs, hd] -> pool axes [L, KH, N, bs, hd]
             k_new = np.stack([rb.k for rb in restores]).transpose(1, 2, 0, 3, 4)
             v_new = np.stack([rb.v for rb in restores]).transpose(1, 2, 0, 3, 4)
-            self.cache = self.cache._replace(
+            cache = self.cache._replace(
                 k=self.cache.k.at[:, :, blks].set(k_new),
                 v=self.cache.v.at[:, :, blks].set(v_new),
             )
+            if sshape is not None:
+                # Scales restore unchanged alongside their pages ([N, L,
+                # KH] -> scale axes [L, N, KH]) — the byte-identity the
+                # quantized evict->restore test pins.
+                ks_new = np.stack([rb.k_scale for rb in restores]
+                                  ).transpose(1, 0, 2)
+                vs_new = np.stack([rb.v_scale for rb in restores]
+                                  ).transpose(1, 0, 2)
+                cache = cache._replace(
+                    k_scale=cache.k_scale.at[:, blks].set(ks_new),
+                    v_scale=cache.v_scale.at[:, blks].set(vs_new),
+                )
+            self.cache = cache
         except Exception as exc:
             self._restore_fallback(r, restores, exc)
             return False

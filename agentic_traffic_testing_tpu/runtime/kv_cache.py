@@ -28,7 +28,7 @@ sequence) lives host-side in `block_allocator.py`.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -36,6 +36,14 @@ import jax.numpy as jnp
 from agentic_traffic_testing_tpu.models.config import ModelConfig
 
 TRASH_BLOCK = 0
+
+# Scaled int8 KV quantization (kv_cache_dtype="int8"): symmetric, one fp32
+# scale per (layer, page, kv-head). 127 levels each side; the trash block's
+# scale accumulates garbage like its pages do (reads are always masked).
+KV_QMAX = 127.0
+# Guard divisor for empty scales: a scale of exactly 0 marks a never-written
+# (or all-zero) page, whose quantized values are forced to 0.
+_EPS = 1e-30
 
 # TPU lane width: the last dim of a page is padded up to this so pages are
 # tile-aligned. The tiled HBM layout pads head_dim < 128 to 128 lanes
@@ -51,10 +59,20 @@ def phys_head_dim(head_dim: int) -> int:
 
 
 class KVCache(NamedTuple):
-    """Stacked per-layer paged KV storage (a pytree; lives in HBM)."""
+    """Stacked per-layer paged KV storage (a pytree; lives in HBM).
+
+    `k_scale`/`v_scale` are None except under kv_cache_dtype="int8": then
+    the pages are int8 and each (layer, page, kv-head) carries one fp32
+    dequantization scale — [L, num_blocks, KH], pages-major so one page's
+    KH scales are contiguous (a DMA-able row for the decode kernels).
+    A None scale pair keeps the pytree structure (and therefore every
+    compiled program) of the pre-quantization cache bit-identical.
+    """
 
     k: jax.Array  # [L, KH, num_blocks, block_size, hd]
     v: jax.Array  # [L, KH, num_blocks, block_size, hd]
+    k_scale: Optional[jax.Array] = None  # [L, num_blocks, KH] f32 (int8 only)
+    v_scale: Optional[jax.Array] = None
 
     @property
     def num_blocks(self) -> int:
@@ -68,15 +86,70 @@ class KVCache(NamedTuple):
     def usable_tokens(self) -> int:
         return (self.num_blocks - 1) * self.block_size
 
+    @property
+    def quantized(self) -> bool:
+        """True for the scaled int8 pool (trace-time static: pytree shape)."""
+        return self.k_scale is not None
+
 
 def make_kv_cache(
-    cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16
+    cfg: ModelConfig, num_blocks: int, block_size: int, dtype=jnp.bfloat16,
+    quantized: bool = False,
 ) -> KVCache:
     """Pages store `phys_head_dim(head_dim)` lanes; the pad lanes stay zero
-    (writers only touch [..., :head_dim]) and consumers slice or mask them."""
+    (writers only touch [..., :head_dim]) and consumers slice or mask them.
+    `quantized` builds the scaled int8 pool: int8 pages plus zeroed
+    per-(page x kv-head) fp32 scales (scale 0 = never written)."""
     shape = (cfg.num_layers, cfg.num_kv_heads, num_blocks, block_size,
              phys_head_dim(cfg.head_dim_))
+    if quantized:
+        if dtype != jnp.int8:
+            raise ValueError(f"quantized pool stores int8 pages, got {dtype}")
+        sshape = (cfg.num_layers, num_blocks, cfg.num_kv_heads)
+        return KVCache(k=jnp.zeros(shape, jnp.int8),
+                       v=jnp.zeros(shape, jnp.int8),
+                       k_scale=jnp.zeros(sshape, jnp.float32),
+                       v_scale=jnp.zeros(sshape, jnp.float32))
     return KVCache(k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype))
+
+
+def quantize_with_scale(x: jax.Array, scale: jax.Array) -> jax.Array:
+    """Symmetric int8 quantization of `x` against a broadcastable `scale`.
+
+    The EXACT op sequence (where -> round -> clip -> cast, f32 throughout)
+    is shared by every quantizing writer — XLA paths and the fused in-kernel
+    write replicate it verbatim so the fused-vs-separate byte-identity pin
+    holds bit-for-bit."""
+    q = jnp.where(scale > 0, x / jnp.maximum(scale, _EPS), 0.0)
+    return jnp.clip(jnp.round(q), -KV_QMAX, KV_QMAX).astype(jnp.int8)
+
+
+def dequantize(q: jax.Array, scale: jax.Array) -> jax.Array:
+    """int8 -> f32 against a broadcastable scale (the oracle-side inverse)."""
+    return q.astype(jnp.float32) * scale
+
+
+def requant_page_int8(page_i8: jax.Array, tok_f32: jax.Array,
+                      s_old: jax.Array, row) -> tuple[jax.Array, jax.Array]:
+    """Append one token row to an int8 page, re-quantizing the page against
+    s_new = max(s_old, absmax(token)/127). Returns (new int8 page, s_new).
+
+    Shapes: page [KH, bs, hdp] int8, tok [KH, hdp] f32, s_old [KH] f32,
+    row scalar i32. The ONE requant op sequence — the XLA writer
+    (write_decode_kv_full_quant) and the fused in-kernel write
+    (ops/pallas/paged_attention.py) both call THIS function, so
+    fused-vs-separate byte identity holds by construction, not by
+    two-file discipline."""
+    bs = page_i8.shape[1]
+    s_new = jnp.maximum(s_old, jnp.max(jnp.abs(tok_f32), axis=-1) / KV_QMAX)
+    r = jnp.where(s_new > 0, s_old / jnp.maximum(s_new, _EPS), 0.0)
+    page_f = page_i8.astype(jnp.float32) * r[:, None, None]
+    q_tok = jnp.where(s_new[:, None] > 0,
+                      tok_f32 / jnp.maximum(s_new[:, None], _EPS), 0.0)
+    rowmask = jax.lax.broadcasted_iota(jnp.int32, (1, bs, 1), 1) == row
+    page_f = jnp.where(rowmask, q_tok[:, None, :], page_f)
+    page_q = jnp.clip(jnp.round(page_f), -KV_QMAX, KV_QMAX).astype(jnp.int8)
+    return page_q, s_new
 
 
 def write_prompt_kv(
@@ -160,6 +233,86 @@ def write_decode_kv_full(
     return cache
 
 
+def write_decode_kv_full_quant(
+    cache: jax.Array,         # [L, KH, num_blocks, bs, hdp] int8 pool
+    scale: jax.Array,         # [L, num_blocks, KH] f32 per-page scales
+    layer: jax.Array,         # scalar i32 — layer being written
+    new: jax.Array,           # [B, KH, hd] (compute dtype; hd <= hdp)
+    block_tables: jax.Array,  # [B, max_blocks]
+    positions: jax.Array,     # [B] absolute position being written
+    valid=None,               # [B] bool — False routes the write to trash
+) -> tuple[jax.Array, jax.Array]:
+    """Quantizing one-token write into the scaled int8 pool.
+
+    Per-page symmetric scales cannot absorb a louder-than-the-page token by
+    casting alone: appending token t to page p re-quantizes the WHOLE page
+    against s_new = max(s_old, absmax(t)/127) (a [KH, bs, hdp] read-modify-
+    write per lane per layer — bounded, and tiny next to the attention read
+    of the full context). s_old/s_new <= 1, so settled pages re-round at
+    most once per louder newcomer; the fp-tol parity tiers in
+    tests/test_kv_quant.py own the accumulated error budget. Trash-block
+    lanes race onto page 0 exactly like the unquantized writer — its scale
+    is garbage and its reads are always masked.
+
+    The requant itself is `requant_page_int8` — the SAME function the
+    fused in-kernel write (ops/pallas/paged_attention.py) calls, so fused
+    and separate writes are byte-identical by construction, not by
+    two-file discipline."""
+    _, kh, _, bs, hdp = cache.shape
+    b, _, hd = new.shape
+    zero = jnp.int32(0)
+    newf = new.astype(jnp.float32)
+    if hd < hdp:
+        newf = jnp.pad(newf, ((0, 0), (0, 0), (0, hdp - hd)))
+    for i in range(b):
+        blk = block_tables[i, positions[i] // bs]  # OOB clamps; trash below
+        if valid is not None:
+            blk = jnp.where(valid[i], blk, TRASH_BLOCK)
+        row = positions[i] % bs
+        s_old = jax.lax.dynamic_slice(
+            scale, (layer, blk, zero), (1, 1, kh))[0, 0]      # [KH]
+        page = jax.lax.dynamic_slice(
+            cache, (layer, zero, blk, zero, zero),
+            (1, kh, 1, bs, hdp))[0, :, 0]                     # [KH, bs, hdp]
+        page_q, s_new = requant_page_int8(page, newf[i], s_old, row)
+        cache = jax.lax.dynamic_update_slice(
+            cache, page_q[None, :, None], (layer, zero, blk, zero, zero))
+        scale = jax.lax.dynamic_update_slice(
+            scale, s_new[None, None, :], (layer, blk, zero))
+    return cache, scale
+
+
+def write_chunk_pages_quant(
+    cache: jax.Array,         # [L, KH, num_blocks, bs, hdp] int8 pool
+    scale: jax.Array,         # [L, num_blocks, KH] f32
+    layer: jax.Array,         # scalar i32
+    pages: jax.Array,         # [KH, C, hd] one row's chunk KV (compute dtype)
+    table_row: jax.Array,     # [max_blocks] the row's block table
+    first_block: jax.Array,   # scalar i32 — table column of pages[:, 0]
+) -> tuple[jax.Array, jax.Array]:
+    """Quantize + write one prefill chunk's whole pages (hybrid step path).
+
+    Chunk blocks are private suffix blocks written exactly once per layer,
+    so each page's scale is simply absmax/127 over the page — no requant.
+    Garbage rows beyond chunk_len quantize along (slots nothing reads)."""
+    _, kh, _, bs, hdp = cache.shape
+    _, c, hd = pages.shape
+    zero = jnp.int32(0)
+    x = pages.astype(jnp.float32)
+    if hd < hdp:
+        x = jnp.pad(x, ((0, 0), (0, 0), (0, hdp - hd)))
+    for p in range(c // bs):
+        blk = table_row[first_block + p]
+        pg = x[:, p * bs:(p + 1) * bs]                        # [KH, bs, hdp]
+        s = jnp.max(jnp.abs(pg), axis=(-2, -1)) / KV_QMAX     # [KH]
+        q = quantize_with_scale(pg, s[:, None, None])
+        cache = jax.lax.dynamic_update_slice(
+            cache, q[None, :, None], (layer, zero, blk, zero, zero))
+        scale = jax.lax.dynamic_update_slice(
+            scale, s[None, None, :], (layer, blk, zero))
+    return cache, scale
+
+
 def gather_kv(cache_l: jax.Array, block_tables: jax.Array) -> jax.Array:
     """Materialize each sequence's KV from one layer's pool (jnp reference path).
 
@@ -176,6 +329,20 @@ def gather_kv(cache_l: jax.Array, block_tables: jax.Array) -> jax.Array:
     return gathered.reshape(kh, b, max_blocks * bs, hd).transpose(1, 2, 0, 3)
 
 
+def gather_kv_dequant(cache_l: jax.Array, scale_l: jax.Array,
+                      block_tables: jax.Array) -> jax.Array:
+    """`gather_kv` for the scaled int8 pool: dequantized f32 sequences.
+
+    cache_l [KH, num_blocks, bs, hd] int8; scale_l [num_blocks, KH] f32.
+    Returns [B, max_blocks*bs, KH, hd] f32 — the jnp oracle (and CPU/chunk
+    gather path) every quantized decode kernel is tested against."""
+    bs = cache_l.shape[2]
+    g = gather_kv(cache_l, block_tables)          # [B, W*bs, KH, hd] int8
+    s = scale_l[block_tables]                     # [B, W, KH]
+    s = jnp.repeat(s, bs, axis=1)                 # [B, W*bs, KH]
+    return g.astype(jnp.float32) * s[..., None]
+
+
 def kv_cache_bytes(cfg: ModelConfig, num_blocks: int, block_size: int, dtype_bytes: int = 2) -> int:
     return (2 * cfg.num_layers * num_blocks * block_size * cfg.num_kv_heads
             * phys_head_dim(cfg.head_dim_) * dtype_bytes)
@@ -189,6 +356,7 @@ def profile_num_blocks(
     dtype_bytes: int = 2,
     tp_size: int = 1,
     pp_size: int = 1,
+    scale_bytes_per_head: int = 0,
 ) -> int:
     """Derive the block budget from free HBM, vLLM-profiling style.
 
@@ -203,7 +371,10 @@ def profile_num_blocks(
     """
     kh_local = max(1, cfg.num_kv_heads // tp_size)
     layers_local = max(1, cfg.num_layers // pp_size)
+    # scale_bytes_per_head: the int8 pool's per-(layer, page, kv-head) fp32
+    # scale pair (2 * 4 bytes) — tiny, but the budget should not lie.
     per_block = (2 * layers_local * block_size * kh_local
-                 * phys_head_dim(cfg.head_dim_) * dtype_bytes)
+                 * phys_head_dim(cfg.head_dim_) * dtype_bytes
+                 + layers_local * kh_local * scale_bytes_per_head)
     budget = int(hbm_bytes_free * memory_utilization)
     return max(0, budget // per_block)

@@ -47,12 +47,18 @@ import numpy as np
 
 @dataclasses.dataclass
 class HostBlock:
-    """One offloaded KV block: the page pair + its content identity."""
+    """One offloaded KV block: the page pair + its content identity.
+
+    Quantized (int8) pools additionally carry the block's per-(layer,
+    kv-head) fp32 scales — stored raw, so save/restore never round-trips
+    through bf16 and the tier holds ~2x the blocks per GB."""
 
     tokens: tuple           # the block's token ids (collision check)
     k: np.ndarray           # [L, KH, block_size, hd_phys], cache dtype
     v: np.ndarray           # same shape/dtype as k
     nbytes: int
+    k_scale: Optional[np.ndarray] = None  # [L, KH] f32 (int8 pools only)
+    v_scale: Optional[np.ndarray] = None
 
 
 @dataclasses.dataclass
@@ -66,6 +72,8 @@ class RestoreBlock:
     tokens: tuple
     k: np.ndarray
     v: np.ndarray
+    k_scale: Optional[np.ndarray] = None
+    v_scale: Optional[np.ndarray] = None
 
 
 class HostKVStore:
@@ -92,6 +100,10 @@ class HostKVStore:
         # plan(); an exception there used to fail the whole step).
         self._page_shape: Optional[tuple] = None
         self._page_dtypes: Optional[tuple] = None
+        # Scale geometry (int8 pools): (shape, dtype) of the per-block
+        # scale pair, or None for unquantized pools — attested like the
+        # page geometry by the first put().
+        self._scale_shape: Optional[tuple] = None
         # Cumulative counters (exported as llm_host_cache_* families).
         self.saved_blocks = 0     # successful put()s
         self.evicted_blocks = 0   # LRU evictions (capacity pressure)
@@ -118,7 +130,14 @@ class HostKVStore:
             return False
         if e.k.shape != e.v.shape or e.k.shape != self._page_shape:
             return False
-        return (e.k.dtype, e.v.dtype) == self._page_dtypes
+        if (e.k.dtype, e.v.dtype) != self._page_dtypes:
+            return False
+        if self._scale_shape is None:
+            return e.k_scale is None and e.v_scale is None
+        return (isinstance(e.k_scale, np.ndarray)
+                and isinstance(e.v_scale, np.ndarray)
+                and e.k_scale.shape == self._scale_shape
+                and e.v_scale.shape == self._scale_shape)
 
     # statics: thread(engine-loop, handler)
     def get(self, key: int, tokens: tuple) -> Optional[HostBlock]:
@@ -154,18 +173,39 @@ class HostKVStore:
             return True
 
     # statics: thread(engine-loop)
-    def put(self, key: int, tokens: tuple, k: np.ndarray, v: np.ndarray) -> bool:
+    def put(self, key: int, tokens: tuple, k: np.ndarray, v: np.ndarray,
+            k_scale: Optional[np.ndarray] = None,
+            v_scale: Optional[np.ndarray] = None) -> bool:
         """Insert (or refresh) one block; False if it can never fit (or
-        fails the geometry attestation a first put established)."""
+        fails the geometry attestation a first put established). Quantized
+        pools pass the block's fp32 scale pair — stored raw alongside the
+        int8 pages (no bf16 round trip; the scale bytes count toward the
+        budget)."""
+        if (k_scale is None) != (v_scale is None):
+            # A half scale pair is corruption, not a servable block — and
+            # it must never raise into the caller (PR-8 contract: the
+            # store degrades, exceptions never escape into admission).
+            with self._lock:
+                self.corrupt_dropped += 1
+            return False
         nbytes = int(k.nbytes) + int(v.nbytes)
+        if k_scale is not None:
+            nbytes += int(k_scale.nbytes) + int(v_scale.nbytes)
         if nbytes > self.capacity_bytes:
             return False
         with self._lock:
             if self._page_shape is None:
                 self._page_shape = k.shape
                 self._page_dtypes = (k.dtype, v.dtype)
+                self._scale_shape = (None if k_scale is None
+                                     else k_scale.shape)
             elif (k.shape != self._page_shape or v.shape != k.shape
-                  or (k.dtype, v.dtype) != self._page_dtypes):
+                  or (k.dtype, v.dtype) != self._page_dtypes
+                  or (k_scale is None) != (self._scale_shape is None)
+                  or (k_scale is not None
+                      and (k_scale.shape != self._scale_shape
+                           or v_scale is None
+                           or v_scale.shape != self._scale_shape))):
                 self.corrupt_dropped += 1
                 return False
             old = self._entries.pop(key, None)
@@ -175,7 +215,9 @@ class HostKVStore:
                 _, ev = self._entries.popitem(last=False)
                 self.used_bytes -= ev.nbytes
                 self.evicted_blocks += 1
-            self._entries[key] = HostBlock(tokens=tokens, k=k, v=v, nbytes=nbytes)
+            self._entries[key] = HostBlock(tokens=tokens, k=k, v=v,
+                                           nbytes=nbytes, k_scale=k_scale,
+                                           v_scale=v_scale)
             self.used_bytes += nbytes
             self.saved_blocks += 1
             return True

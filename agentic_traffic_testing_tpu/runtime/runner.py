@@ -132,7 +132,7 @@ def _prefill_pipeline_sample_impl(params, cfg: ModelConfig, tokens, cache,
 def _hybrid_sample_impl(params, cfg: ModelConfig, dec_tokens, chunk_tokens,
                         cache, block_tables, positions, chunk_start,
                         chunk_len, samp: SamplingArrays, steps,
-                        attn_mode=None):
+                        attn_mode=None, fused_kv_write=False):
     """One FUSED hybrid step (B decode lanes + one prefill chunk in a
     single ragged dispatch) + sampling for every row.
 
@@ -143,7 +143,8 @@ def _hybrid_sample_impl(params, cfg: ModelConfig, dec_tokens, chunk_tokens,
     b = dec_tokens.shape[0]
     dec_logits, chunk_logits, cache = hybrid_step_impl(
         params, cfg, dec_tokens, chunk_tokens, cache, block_tables,
-        positions, chunk_start, chunk_len, attn_mode=attn_mode)
+        positions, chunk_start, chunk_len, attn_mode=attn_mode,
+        fused_kv_write=fused_kv_write)
     keys = make_row_keys(samp.seeds, steps)
     out = sample(jnp.concatenate([dec_logits, chunk_logits]), keys,
                  samp.temperature, samp.top_k, samp.top_p)
@@ -155,7 +156,7 @@ def _hybrid_sample_impl(params, cfg: ModelConfig, dec_tokens, chunk_tokens,
 def _decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
                         state: DecodeState, samp: SamplingArrays,
                         num_steps: int = 1, attn_mode=None, attn_mesh=None,
-                        attn_axis=None):
+                        attn_axis=None, fused_kv_write=False):
     """`num_steps` fused decode steps in ONE dispatch (lax.scan on device).
 
     The sampled token feeds the next step without leaving the device, so the
@@ -171,7 +172,8 @@ def _decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
                                          block_tables, st.positions,
                                          attn_mode=attn_mode,
                                          attn_mesh=attn_mesh,
-                                         attn_axis=attn_axis)
+                                         attn_axis=attn_axis,
+                                         fused_kv_write=fused_kv_write)
         keys = make_row_keys(samp.seeds, st.steps)
         out = sample(logits, keys, samp.temperature, samp.top_k, samp.top_p)
         new_st = DecodeState(tokens=out, positions=st.positions + 1, steps=st.steps + 1)
@@ -240,12 +242,19 @@ class ModelRunner:
     """Single-device runner. Owns the jitted step programs (not the cache)."""
 
     def __init__(self, cfg: ModelConfig, params, decode_steps: int = 1,
-                 spec_tokens: int = 0, spec_ngram: int = 3) -> None:
+                 spec_tokens: int = 0, spec_ngram: int = 3,
+                 fused_kv_write: bool = False) -> None:
         self.cfg = cfg
         self.params = params
         self.decode_steps = max(1, int(decode_steps))
         self.spec_tokens = max(0, int(spec_tokens))
         self.spec_ngram = max(1, int(spec_ngram))
+        # LLM_FUSED_KV_WRITE: decode dispatches write the fresh token KV
+        # inside the paged-attention call (in-kernel on dma2/dma3,
+        # functionally elsewhere) and the hybrid dispatch folds its chunk
+        # page scatter into the ragged kernel. Baked into the jits below,
+        # so an engine must be built with a matching runner.
+        self.fused_kv_write = bool(fused_kv_write)
         self._prefill = jax.jit(
             partial(_prefill_sample_impl, cfg=cfg,
                     kv_writer_mode=self.kv_writer_mode,
@@ -264,7 +273,8 @@ class ModelRunner:
         )
         self._hybrid = jax.jit(
             partial(_hybrid_sample_impl, cfg=cfg,
-                    attn_mode=self.hybrid_attn_mode),
+                    attn_mode=self.hybrid_attn_mode,
+                    fused_kv_write=self.fused_kv_write),
             donate_argnames=("cache",),
         )
         self._prefill_pipeline = jax.jit(
@@ -287,7 +297,8 @@ class ModelRunner:
             self._decode = jax.jit(
                 partial(_decode_sample_impl, cfg=cfg, num_steps=self.decode_steps,
                         attn_mode=self.attn_mode, attn_mesh=self.attn_mesh,
-                        attn_axis=self.attn_axis),
+                        attn_axis=self.attn_axis,
+                        fused_kv_write=self.fused_kv_write),
                 donate_argnames=("cache",),
             )
             # Overlapped-decode variant (LLM_DECODE_OVERLAP): identical
@@ -301,7 +312,8 @@ class ModelRunner:
             self._decode_overlapped = jax.jit(
                 partial(_decode_sample_impl, cfg=cfg, num_steps=self.decode_steps,
                         attn_mode=self.attn_mode, attn_mesh=self.attn_mesh,
-                        attn_axis=self.attn_axis),
+                        attn_axis=self.attn_axis,
+                        fused_kv_write=self.fused_kv_write),
                 donate_argnames=("cache", "state"),
             )
 
@@ -360,6 +372,18 @@ class ModelRunner:
     #: engine refuses the knob at build (parallel/ runners set False),
     #: matching the hybrid/pipeline precedent.
     supports_decode_overlap: bool = True
+    #: whether this runner serves the scaled int8 KV pool
+    #: (kv_cache_dtype="int8", round 10). The mesh runners don't: the
+    #: shard_dma attention wrapper has no scale-sharding rule, and the
+    #: sharded gather path would replicate the scale arrays incoherently
+    #: with a head-sharded pool — the engine refuses at build (parallel/
+    #: runners set False). fp8 pages (scale-free casts) are unaffected.
+    supports_quantized_kv: bool = True
+    #: whether this runner serves the fused KV-write decode/hybrid
+    #: dispatches (LLM_FUSED_KV_WRITE, round 10): the mesh runners' sharded
+    #: wrappers have no aliasing rule for the in-kernel pool writes, so the
+    #: engine refuses the knob at build (parallel/ runners set False).
+    supports_fused_kv_write: bool = True
 
     def prepare_cache(self, cache: KVCache) -> KVCache:
         """Hook for placing a freshly allocated cache (TP runner shards it)."""

@@ -139,7 +139,17 @@ class ServerConfig:
     hybrid_token_budget: int = 0               # LLM_HYBRID_TOKEN_BUDGET
     # "fp8" stores KV pages as float8_e4m3 — double capacity/concurrency,
     # half the decode KV stream (vLLM --kv-cache-dtype fp8 analog).
+    # "int8" (round 10) stores scaled int8 pages + per-(page x kv-head)
+    # fp32 scales, dequantized inside the decode kernels' chunk walk —
+    # the same byte savings without fp8's cast error; single-chip runners
+    # only (the engine refuses tp/sp/pp at build).
     kv_cache_dtype: Optional[str] = None       # LLM_KV_CACHE_DTYPE
+    # Fused KV page writes (round 10): 1 folds the decode token write into
+    # the dma2/dma3 attention kernels and the hybrid chunk page scatter
+    # into the ragged kernel (aliased pools; functional fusion off-TPU).
+    # 0 (default) keeps every write path bit-identical. Single-chip,
+    # non-speculative runners only; int8 x hybrid refuses at build.
+    fused_kv_write: int = 0                    # LLM_FUSED_KV_WRITE
     # AWQ-style K-group size for int4 weight scales (0 = per-column).
     int4_k_group: int = 0                      # LLM_INT4_K_GROUP
     num_blocks: Optional[int] = None           # LLM_NUM_BLOCKS (None -> HBM profile)
@@ -275,6 +285,18 @@ class ServerConfig:
         c.hybrid_token_budget = int(
             os.environ.get("LLM_HYBRID_TOKEN_BUDGET") or c.hybrid_token_budget)
         c.kv_cache_dtype = os.environ.get("LLM_KV_CACHE_DTYPE") or None
+        c.fused_kv_write = int(
+            os.environ.get("LLM_FUSED_KV_WRITE") or c.fused_kv_write)
+        if c.fused_kv_write not in (0, 1):
+            raise ValueError(
+                f"LLM_FUSED_KV_WRITE must be 0 or 1, got {c.fused_kv_write} "
+                f"(unset it for the separate-dispatch KV writes)")
+        if c.fused_kv_write and (os.environ.get("LLM_SPECULATION") or None):
+            # Same refusal the engine makes at build — surfaced at env
+            # parse so a compose file learns before any model loads.
+            raise ValueError(
+                "LLM_FUSED_KV_WRITE x LLM_SPECULATION is not wired — "
+                "disable one of them")
         c.int4_k_group = int(os.environ.get("LLM_INT4_K_GROUP") or c.int4_k_group)
         nb = os.environ.get("LLM_NUM_BLOCKS")
         c.num_blocks = int(nb) if nb else None
@@ -357,6 +379,12 @@ class ServerConfig:
         p.add_argument("--hybrid-token-budget", type=int,
                        default=c.hybrid_token_budget,
                        help="fused chunk+decode dispatch budget (0 = off)")
+        p.add_argument("--kv-cache-dtype", default=c.kv_cache_dtype,
+                       help="KV page dtype: fp8 | int8 (scaled, round 10) "
+                            "| unset = follow --dtype")
+        p.add_argument("--fused-kv-write", type=int, default=c.fused_kv_write,
+                       help="1 = fold decode/hybrid KV writes into the "
+                            "attention kernels (0 = separate writes)")
         p.add_argument("--num-blocks", type=int, default=c.num_blocks)
         p.add_argument("--block-size", type=int, default=c.block_size)
         p.add_argument("--weights-path", default=c.weights_path)
@@ -375,6 +403,7 @@ class ServerConfig:
                   "slo_itl_ms", "max_queue", "deadline_ms",
                   "fault_spec", "fault_seed", "prefix_caching",
                   "host_cache_gb", "hybrid_token_budget",
+                  "kv_cache_dtype", "fused_kv_write",
                   "num_blocks", "block_size", "weights_path",
                   "speculation", "spec_tokens", "spec_ngram"):
             setattr(c, f, getattr(a, f))
@@ -401,6 +430,13 @@ class ServerConfig:
             # Re-check after CLI overrides (--speculation may arrive here).
             raise ValueError(
                 "--decode-overlap does not compose with --speculation — "
+                "disable one of them")
+        if c.fused_kv_write not in (0, 1):
+            raise ValueError(
+                f"--fused-kv-write must be 0 or 1, got {c.fused_kv_write}")
+        if c.fused_kv_write and c.speculation:
+            raise ValueError(
+                "--fused-kv-write does not compose with --speculation — "
                 "disable one of them")
         if c.step_trace < 0:
             raise ValueError(

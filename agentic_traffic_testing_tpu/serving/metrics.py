@@ -104,6 +104,14 @@ class LLMMetrics:
             f"{prefix}_config_decode_overlap",
             "Overlapped decode loop enabled (LLM_DECODE_OVERLAP; 0 = serial "
             "decode dispatch)", registry=r)
+        self.config_kv_cache_dtype = Gauge(
+            f"{prefix}_config_kv_cache_dtype",
+            "KV page dtype (LLM_KV_CACHE_DTYPE encoded: 0 = follow serving "
+            "dtype, 1 = fp8 e4m3, 2 = scaled int8)", registry=r)
+        self.config_fused_kv_write = Gauge(
+            f"{prefix}_config_fused_kv_write",
+            "Fused KV page writes enabled (LLM_FUSED_KV_WRITE; 0 = separate "
+            "write dispatch ops)", registry=r)
         # Additive (no reference analog): overlapped-decode reconciliation.
         # Stays 0 unless LLM_DECODE_OVERLAP=1 routes decode through the
         # predicted-composition fast path (runtime/engine.py
@@ -476,7 +484,9 @@ class LLMMetrics:
                           decode_overlap: int = 0,
                           step_trace: int = 0,
                           slo_ttft_ms: float = 0.0,
-                          slo_itl_ms: float = 0.0) -> None:
+                          slo_itl_ms: float = 0.0,
+                          kv_cache_dtype: int = 0,
+                          fused_kv_write: int = 0) -> None:
         # max_num_seqs/max_num_batched_tokens stay PER-REPLICA values (the
         # configured knob, a config snapshot — docs/monitoring.md); the
         # pool-wide seat count is num_replicas * max_num_seqs.
@@ -493,6 +503,8 @@ class LLMMetrics:
         self.config_step_trace.set(step_trace)
         self.config_slo_ttft_ms.set(slo_ttft_ms)
         self.config_slo_itl_ms.set(slo_itl_ms)
+        self.config_kv_cache_dtype.set(kv_cache_dtype)
+        self.config_fused_kv_write.set(fused_kv_write)
 
     def set_kv_gauges(self, *, num_blocks: int, block_size: int,
                       max_model_len: int, max_num_seqs: int) -> None:

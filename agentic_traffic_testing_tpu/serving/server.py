@@ -216,6 +216,9 @@ class LLMServer:
                 step_trace=cfg.step_trace,
                 slo_ttft_ms=cfg.slo_ttft_ms,
                 slo_itl_ms=cfg.slo_itl_ms,
+                kv_cache_dtype={"fp8": 1, "fp8_e4m3": 1, "int8": 2}.get(
+                    cfg.kv_cache_dtype or "", 0),
+                fused_kv_write=cfg.fused_kv_write,
             )
             if self.pool is not None:
                 # Pool aggregate under the EXACT pre-pool names: blocks and
@@ -272,6 +275,7 @@ class LLMServer:
             host_cache_gb=c.host_cache_gb,
             hybrid_token_budget=c.hybrid_token_budget,
             kv_cache_dtype=c.kv_cache_dtype,
+            fused_kv_write=c.fused_kv_write,
             int4_k_group=c.int4_k_group,
             moe_capacity_factor=c.moe_capacity_factor,
             speculation=c.speculation, spec_tokens=c.spec_tokens,

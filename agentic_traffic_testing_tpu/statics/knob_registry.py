@@ -138,8 +138,15 @@ KNOBS: tuple[Knob, ...] = (
          "Fused prefill-chunk + decode ragged dispatch budget (0 = "
          "serial schedule; single-chip runners only)."),
     Knob("LLM_KV_CACHE_DTYPE", "enum", "unset", "serving/config.py",
-         "fp8 stores KV pages as float8_e4m3 (double capacity, half the "
-         "decode KV stream)."),
+         "KV page dtype: fp8 (float8_e4m3 casts) or int8 (scaled int8 "
+         "pages + per-(page x kv-head) fp32 scales dequantized inside "
+         "the decode kernels) — either doubles capacity and halves the "
+         "decode KV stream; int8 is single-chip only."),
+    Knob("LLM_FUSED_KV_WRITE", "int", "0", "serving/config.py",
+         "1 folds the decode token KV write into the dma2/dma3 attention "
+         "kernels and the hybrid chunk page scatter into the ragged "
+         "kernel (round 10); 0 keeps the separate-dispatch writes "
+         "bit-identical. Single-chip, non-speculative runners only."),
     Knob("LLM_INT4_K_GROUP", "int", "0", "serving/config.py",
          "AWQ-style K-group size for int4 scales (0 = per-column)."),
     Knob("LLM_NUM_BLOCKS", "int", "auto", "serving/config.py",
@@ -251,7 +258,10 @@ KNOBS: tuple[Knob, ...] = (
     Knob("BENCH_QUANTIZATION", "enum", "unset", "bench.py",
          "Weight quantization for the bench engines (int8 | int4)."),
     Knob("BENCH_KV_CACHE_DTYPE", "enum", "unset", "bench.py",
-         "KV page dtype for the bench engines (fp8)."),
+         "KV page dtype for the bench engines (fp8 | int8)."),
+    Knob("BENCH_KV_QUANT", "bool", "1", "bench.py",
+         "0 disables the KV-quantization A/B probe (bf16 vs fp8 vs int8 "
+         "decode tok/s + output-quality gate)."),
     Knob("BENCH_HYBRID", "bool", "1", "bench.py",
          "0 disables the hybrid on/off A/B series."),
     Knob("BENCH_HYBRID_BUDGET", "int", "256 (tpu) / 48", "bench.py",

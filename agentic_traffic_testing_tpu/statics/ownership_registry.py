@@ -263,4 +263,7 @@ OWNED_ATTRS: tuple[OwnedAttr, ...] = (
               "page geometry attested by the first put()"),
     OwnedAttr("HostKVStore", "_page_dtypes", "", "_lock",
               "page dtype pair attested by the first put()"),
+    OwnedAttr("HostKVStore", "_scale_shape", "", "_lock",
+              "int8 scale geometry attested by the first put() (None for "
+              "unquantized pools)"),
 )

@@ -833,6 +833,136 @@ def main() -> None:
             offload_res = None
             print(f"bench: offload probe dropped ({e!r})", file=sys.stderr)
 
+    # KV-quantization probe (round 10): bf16-vs-fp8-vs-int8 KV pools on the
+    # SAME runner/weights — decode tok/s per dtype, analytic streamed KV
+    # bytes/step, and an output-quality gate: greedy token identity on
+    # short generations (first token must match the bf16 engine, and at
+    # least half the fixed workload's trajectory agrees — trajectories may
+    # legitimately diverge after a near-tie) plus a logit-RMS tier vs the
+    # bf16 oracle at the first decode step. A failed gate DROPS the probe
+    # loudly instead of reporting fast-but-wrong numbers.
+    # BENCH_KV_QUANT=0 disables.
+    kv_quant_on = os.environ.get("BENCH_KV_QUANT", "1") not in ("0", "false")
+    KV_QUANT_RMS_TIERS = {"fp8": 0.20, "int8": 0.10}
+
+    def kv_quant_probe():
+        import jax.numpy as jnp
+
+        from agentic_traffic_testing_tpu.models.llama import (
+            decode_step,
+            prefill,
+        )
+        from agentic_traffic_testing_tpu.runtime.kv_cache import (
+            TRASH_BLOCK, make_kv_cache,
+        )
+
+        lanes = min(8, batch)
+        kv_prompt = min(prompt_len, 96)
+        kv_decode = 24
+        wl = np.random.default_rng(31)
+        prompts = [wl.integers(10, vocab - 10, kv_prompt).tolist()
+                   for _ in range(lanes)]
+        mc = engine.model_cfg
+        bs_ = cfg.block_size
+
+        def run(kv):
+            eng = LLMEngine(EngineConfig(
+                model=model, dtype="bfloat16", max_num_seqs=lanes,
+                max_model_len=kv_prompt + kv_decode + 16,
+                num_blocks=lanes * (-(-(kv_prompt + kv_decode + 16) // bs_)
+                                    + 4) + 1,
+                decode_steps=decode_steps, kv_cache_dtype=kv,
+            ), model_cfg=mc, runner=engine.runner)
+            reqs = [eng.add_request(p, SamplingParams(
+                temperature=0.0, max_tokens=kv_decode, ignore_eos=True))
+                for p in prompts]
+            t0 = time.monotonic()
+            while eng.has_work() and not all(r.is_finished() for r in reqs):
+                eng.step()
+            dt = time.monotonic() - t0
+            toks = sum(len(r.output_ids) for r in reqs)
+            mean_ctx_p = kv_prompt + kv_decode / 2
+            bytes_step = int(lanes * mean_ctx_p * mc.num_layers * 2
+                             * mc.num_kv_heads * eng.cache.k.shape[-1]
+                             * eng.cache.k.dtype.itemsize)
+            if eng.cache.quantized:  # + the per-page fp32 scale stream
+                bytes_step += int(lanes * -(-mean_ctx_p // bs_)
+                                  * mc.num_layers * 2 * mc.num_kv_heads * 4)
+            return toks / dt, [r.output_ids for r in reqs], bytes_step
+
+        def first_step_logits(kv):
+            """Logits of the first decode step over a freshly prefilled
+            pool of the given dtype — the RMS oracle input (one prompt,
+            model-level, no engine in the way)."""
+            tt = -(-kv_prompt // bs_) * bs_
+            toks = np.zeros((1, tt), np.int32)
+            toks[0, :kv_prompt] = prompts[0]
+            nb = tt // bs_ + 3
+            bt = np.full((1, nb), TRASH_BLOCK, np.int32)
+            bt[0, : nb - 1] = np.arange(1, nb)
+            quant = kv == "int8"
+            dt_ = (jnp.float8_e4m3fn if kv in ("fp8", "fp8_e4m3")
+                   else jnp.int8 if quant else jnp.bfloat16)
+            cache_ = make_kv_cache(mc, nb, bs_, dt_, quantized=quant)
+            logits, cache_ = prefill(
+                engine.runner.params, mc, jnp.asarray(toks), cache_,
+                jnp.asarray(bt), jnp.asarray([kv_prompt], jnp.int32))
+            nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+            dl, _ = decode_step(
+                engine.runner.params, mc, nxt, cache_, jnp.asarray(bt),
+                jnp.asarray([kv_prompt], jnp.int32))
+            return np.asarray(dl[0], np.float32)
+
+        for kv in (None, "fp8", "int8"):
+            run(kv)  # warmup: compile each pool pytree's shapes once
+        res = {"kv_quant_lanes": lanes,
+               "kv_quant_prompt_tokens": kv_prompt,
+               "kv_quant_decode_tokens": kv_decode}
+        ref_logits = first_step_logits(None)
+        ref_norm = float(np.sqrt(np.mean(ref_logits ** 2))) + 1e-9
+        ref_outs = None
+        for kv, tag in ((None, "bf16"), ("fp8", "fp8"), ("int8", "int8")):
+            runs = [run(kv) for _ in range(reps)]
+            tps = statistics.median([r[0] for r in runs])
+            outs, bytes_step = runs[0][1], runs[0][2]
+            res[f"kv_quant_{tag}_decode_toks_s"] = round(tps, 2)
+            res[f"kv_quant_{tag}_kv_bytes_per_step"] = bytes_step
+            if kv is None:
+                ref_outs = outs
+                continue
+            # Output-quality gate (greedy identity + logit RMS tier).
+            flat_ref = [t for o in ref_outs for t in o]
+            flat = [t for o in outs for t in o]
+            if not all(o and r and o[0] == r[0]
+                       for o, r in zip(outs, ref_outs)):
+                raise RuntimeError(
+                    f"kv_quant gate: {tag} first decode token diverged "
+                    f"from bf16 KV")
+            agree = (sum(a == b for a, b in zip(flat, flat_ref))
+                     / max(1, len(flat_ref)))
+            if agree < 0.5:
+                raise RuntimeError(
+                    f"kv_quant gate: {tag} greedy agreement {agree:.2f} "
+                    f"< 0.5 vs bf16 KV")
+            rms = float(np.sqrt(np.mean(
+                (first_step_logits(kv) - ref_logits) ** 2))) / ref_norm
+            tier = KV_QUANT_RMS_TIERS[tag]
+            if rms > tier:
+                raise RuntimeError(
+                    f"kv_quant gate: {tag} first-step logit RMS {rms:.4f} "
+                    f"over the {tier} tier")
+            res[f"kv_quant_{tag}_token_identity"] = round(agree, 3)
+            res[f"kv_quant_{tag}_logit_rms"] = round(rms, 5)
+        return res
+
+    kv_quant_res = None
+    if kv_quant_on:
+        try:
+            kv_quant_res = kv_quant_probe()
+        except Exception as e:
+            kv_quant_res = None
+            print(f"bench: kv_quant probe dropped ({e!r})", file=sys.stderr)
+
     replica_res = None
     if replicas_on:
         try:
@@ -1193,6 +1323,7 @@ def main() -> None:
         **({} if hybrid_res is None else hybrid_res),
         **({} if replica_res is None else replica_res),
         **({} if offload_res is None else offload_res),
+        **({} if kv_quant_res is None else kv_quant_res),
         **({} if prefill_s is None else {
             # Compute-bound half of serving (round-3 flash prefill site).
             # est_mfu counts dense matmul FLOPs (2 * non-embedding params

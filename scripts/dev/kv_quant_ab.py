@@ -1,0 +1,167 @@
+#!/usr/bin/env python3
+"""A/B the round-10 KV-quantization stack: bf16 vs fp8 vs int8 pages.
+
+One row per KV dtype on the SAME weights and the SAME greedy workload:
+
+    decode_toks_s       engine decode throughput (wall, request wave)
+    kv_bytes_per_step   analytic streamed KV bytes per fused decode step
+                        (pages + the int8 per-page scale stream)
+    logit_rms           relative RMS of the first decode step's logits vs
+                        the bf16-KV oracle (model-level, one prompt)
+    first_token_match   first greedy token equals the bf16 engine's
+    token_identity      greedy agreement fraction over the whole workload
+    fused_outputs_match the LLM_FUSED_KV_WRITE=1 engine of the same dtype
+                        reproduces the separate-dispatch outputs exactly
+
+On CPU (the test smoke) the numbers are semantics checks; on hardware the
+rows size the streamed-byte reduction against the bs32 roofline_frac
+target (ROADMAP standing ask — run together with bench.py's decode_anatomy
+probe).
+
+Usage: python scripts/dev/kv_quant_ab.py [n_requests] [prompt_len] [decode_tokens]
+Env:   KV_QUANT_AB_MODEL (default llama-3.2-1b on TPU / tiny elsewhere)
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+from agentic_traffic_testing_tpu.platform_guard import force_cpu_if_requested
+
+force_cpu_if_requested()
+
+
+def main(argv: list[str] | None = None) -> list[dict]:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import (
+        decode_step,
+        init_params,
+        prefill,
+    )
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.kv_cache import (
+        TRASH_BLOCK,
+        make_kv_cache,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+    from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+    argv = sys.argv[1:] if argv is None else argv
+    n_requests = int(argv[0]) if len(argv) > 0 else 4
+    prompt_len = int(argv[1]) if len(argv) > 1 else 48
+    decode_tokens = int(argv[2]) if len(argv) > 2 else 12
+
+    platform = jax.devices()[0].platform
+    model = os.environ.get(
+        "KV_QUANT_AB_MODEL", "llama-3.2-1b" if platform == "tpu" else "tiny")
+    mcfg = resolve_config(model)
+    dtype = jnp.bfloat16 if platform == "tpu" else jnp.float32
+    dtype_name = "bfloat16" if platform == "tpu" else "float32"
+    params = init_params(mcfg, jax.random.key(0), dtype=dtype)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(10, mcfg.vocab_size - 10, prompt_len).tolist()
+               for _ in range(n_requests)]
+    block_size = 16
+    max_len = prompt_len + decode_tokens + 16
+    num_blocks = n_requests * (-(-max_len // block_size) + 4) + 1
+
+    def build(kv, fused):
+        runner = ModelRunner(mcfg, params, decode_steps=1,
+                             fused_kv_write=fused)
+        return LLMEngine(EngineConfig(
+            model=model, dtype=dtype_name, max_num_seqs=n_requests,
+            max_model_len=max_len, block_size=block_size,
+            num_blocks=num_blocks, kv_cache_dtype=kv,
+            fused_kv_write=int(fused),
+        ), model_cfg=mcfg, params=params, runner=runner)
+
+    def drive(eng):
+        reqs = [eng.add_request(p, SamplingParams(
+            temperature=0.0, max_tokens=decode_tokens, ignore_eos=True))
+            for p in prompts]
+        t0 = time.monotonic()
+        while eng.has_work() and not all(r.is_finished() for r in reqs):
+            eng.step()
+        dt = time.monotonic() - t0
+        return [r.output_ids for r in reqs], dt
+
+    def first_step_logits(kv):
+        tt = -(-prompt_len // block_size) * block_size
+        toks = np.zeros((1, tt), np.int32)
+        toks[0, :prompt_len] = prompts[0]
+        nb = tt // block_size + 3
+        bt = np.full((1, nb), TRASH_BLOCK, np.int32)
+        bt[0, : nb - 1] = np.arange(1, nb)
+        quant = kv == "int8"
+        dt_ = (jnp.float8_e4m3fn if kv == "fp8"
+               else jnp.int8 if quant else dtype)
+        cache = make_kv_cache(mcfg, nb, block_size, dt_, quantized=quant)
+        logits, cache = prefill(params, mcfg, jnp.asarray(toks), cache,
+                                jnp.asarray(bt),
+                                jnp.asarray([prompt_len], jnp.int32))
+        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+        dl, _ = decode_step(params, mcfg, nxt, cache, jnp.asarray(bt),
+                            jnp.asarray([prompt_len], jnp.int32))
+        return np.asarray(dl[0], np.float32)
+
+    ref_logits = first_step_logits(None)
+    ref_norm = float(np.sqrt(np.mean(ref_logits ** 2))) + 1e-9
+    hdp = -(-mcfg.head_dim_ // 128) * 128
+    mean_ctx = prompt_len + decode_tokens / 2
+
+    rows: list[dict] = []
+    ref_outs = None
+    for kv, tag in ((None, "bf16"), ("fp8", "fp8"), ("int8", "int8")):
+        eng = build(kv, fused=False)
+        outs, dt = drive(eng)
+        fused_outs, _ = drive(build(kv, fused=True))
+        itemsize = eng.cache.k.dtype.itemsize
+        bytes_step = int(n_requests * mean_ctx * mcfg.num_layers * 2
+                         * mcfg.num_kv_heads * hdp * itemsize)
+        if eng.cache.quantized:
+            bytes_step += int(n_requests * -(-mean_ctx // block_size)
+                              * mcfg.num_layers * 2 * mcfg.num_kv_heads * 4)
+        if ref_outs is None:
+            ref_outs = outs
+        flat = [t for o in outs for t in o]
+        flat_ref = [t for o in ref_outs for t in o]
+        logits = ref_logits if kv is None else first_step_logits(kv)
+        row = {
+            "mode": tag,
+            "decode_toks_s": round(sum(len(o) for o in outs) / dt, 2),
+            "kv_bytes_per_step": bytes_step,
+            "logit_rms": round(float(np.sqrt(np.mean(
+                (logits - ref_logits) ** 2))) / ref_norm, 5),
+            "first_token_match": all(
+                o and r and o[0] == r[0] for o, r in zip(outs, ref_outs)),
+            "token_identity": round(
+                sum(a == b for a, b in zip(flat, flat_ref))
+                / max(1, len(flat_ref)), 3),
+            # Fused writes change WHERE bytes land, never WHICH bytes:
+            # token-identical by construction, pinned per dtype here.
+            "fused_outputs_match": fused_outs == outs,
+        }
+        rows.append(row)
+        print(json.dumps(row), flush=True)
+    return rows
+
+
+if __name__ == "__main__":
+    rows = main()
+    ok = (all(r["fused_outputs_match"] for r in rows)
+          and all(r["first_token_match"] for r in rows[1:])
+          and all(r["token_identity"] >= 0.5 for r in rows[1:]))
+    sys.exit(0 if ok else 1)

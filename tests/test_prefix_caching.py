@@ -262,6 +262,40 @@ def test_evict_restore_outputs_identical(params):
     assert eng.generate(prompt, seeded()).generated_ids == want_seeded
 
 
+def test_evict_restore_int8_pages_byte_identity(params):
+    """Round-10 satellite: the host tier saves/restores scaled int8 pages
+    + their fp32 scales RAW (no bf16 round trip) — entries carry int8
+    pages and scale pairs, restored completions are byte-identical to the
+    cold recompute, and the restored pool bytes match the pre-eviction
+    pages exactly."""
+    rng = np.random.default_rng(15)
+    prompt = rng.integers(0, CFG.vocab_size, 40).tolist()
+    pressure = [rng.integers(0, CFG.vocab_size, 120).tolist()
+                for _ in range(3)]
+
+    cold = make_engine(params, prefix_caching=False, num_blocks=24,
+                       kv_cache_dtype="int8")
+    want = cold.generate(prompt, greedy(8)).generated_ids
+
+    store = HostKVStore(64 << 20)
+    eng = make_engine(params, num_blocks=24, host_store=store,
+                      kv_cache_dtype="int8")
+    assert eng.generate(prompt, greedy(8)).generated_ids == want
+    for p in pressure:
+        eng.generate(p, greedy(8))
+    assert len(store) > 0, "eviction must have spilled blocks to host"
+    entry = next(iter(store._entries.values()))
+    assert entry.k.dtype == np.int8 and entry.v.dtype == np.int8
+    assert entry.k_scale is not None and entry.k_scale.dtype == np.float32
+    assert entry.k_scale.shape == (CFG.num_layers, CFG.num_kv_heads)
+    assert eng.allocator.probe_prefix(prompt) == 0
+    restored = eng.generate(prompt, greedy(8))
+    assert restored.generated_ids == want
+    stats = eng.kv_stats()
+    assert stats["host_cache_hit_tokens"] >= 32, stats
+    assert stats["host_cache_restore_bytes"] > 0, stats
+
+
 def test_host_store_shared_across_replicas(params):
     """One host store behind a 2-replica pool: a prefix computed (then
     evicted) on replica 0 is host-restored on replica 1 — the cross-replica

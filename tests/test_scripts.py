@@ -306,6 +306,33 @@ def test_decode_overlap_ab_smoke(monkeypatch):
         assert r["decode_toks_s"] > 0
 
 
+# ------------------------------------------------ KV-quantization A/B
+
+
+def test_kv_quant_ab_smoke(monkeypatch):
+    """scripts/dev/kv_quant_ab.py end-to-end on the tiny model: one JSON
+    row per KV dtype (bf16/fp8/int8), the quantized arms' first greedy
+    token matches the bf16 oracle with a sane logit RMS (int8's scaled
+    error under the fp8 tier bound), bytes/step actually shrink, and the
+    LLM_FUSED_KV_WRITE engines reproduce every arm's outputs exactly
+    (in-process for the warm jax/conftest CPU config, like router_ab)."""
+    monkeypatch.setenv("KV_QUANT_AB_MODEL", "tiny")
+    kv_ab = load_script("scripts/dev/kv_quant_ab.py", "kv_quant_ab")
+    rows = kv_ab.main(["2", "32", "6"])
+    assert [r["mode"] for r in rows] == ["bf16", "fp8", "int8"]
+    by_mode = {r["mode"]: r for r in rows}
+    assert by_mode["bf16"]["logit_rms"] == 0.0
+    for tag in ("fp8", "int8"):
+        r = by_mode[tag]
+        assert r["first_token_match"] is True
+        assert r["token_identity"] >= 0.5
+        assert 0 < r["logit_rms"] < 0.2
+        assert r["kv_bytes_per_step"] < by_mode["bf16"]["kv_bytes_per_step"]
+    for r in rows:
+        assert r["fused_outputs_match"] is True
+        assert r["decode_toks_s"] > 0
+
+
 # --------------------------------------------------------- chaos soak A/B
 
 
