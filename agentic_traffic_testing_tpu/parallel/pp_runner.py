@@ -251,6 +251,7 @@ class PPRunner(ModelRunner):
     supports_decode_overlap = False    # no donated-state staged decode jit
     supports_quantized_kv = False      # no staged scale plumbing (int8 KV)
     supports_fused_kv_write = False    # no aliasing rule in the staged jits
+    supports_migration = False         # no host slicing of the staged pool
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

@@ -70,6 +70,8 @@ class SPPrefillRunner(ModelRunner):
     # Nor for the scaled int8 pool / fused KV writes (see TPRunner).
     supports_quantized_kv = False
     supports_fused_kv_write = False
+    # Nor per-block host slicing for live migration (see TPRunner).
+    supports_migration = False
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,
@@ -143,6 +145,7 @@ class SPTPRunner(TPRunner):
     supports_decode_overlap = False    # see SPPrefillRunner
     supports_quantized_kv = False      # see SPPrefillRunner
     supports_fused_kv_write = False    # see SPPrefillRunner
+    supports_migration = False         # see SPPrefillRunner
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

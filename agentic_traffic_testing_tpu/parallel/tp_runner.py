@@ -70,6 +70,9 @@ class TPRunner(ModelRunner):
     # refuses both knobs at build.
     supports_quantized_kv = False
     supports_fused_kv_write = False
+    # No per-block host slicing / restore-write rule for the head-sharded
+    # pool: live migration (LLM_MIGRATION) refuses at engine build.
+    supports_migration = False
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

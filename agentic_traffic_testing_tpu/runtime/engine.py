@@ -200,6 +200,21 @@ class EngineConfig:
     # replica pool offsets it per replica).
     fault_spec: str = ""
     fault_seed: int = 0
+    # Live migration of in-flight streams (round 11 — the elastic-serving
+    # plane): 1 lets the engine checkpoint a running request's decode
+    # state (token history, sampling carry, position, RNG step) plus its
+    # full KV blocks (engine.checkpoint_request) and resume a checkpoint
+    # from another replica (engine.adopt_request), token-identical to the
+    # never-migrated stream. With it on, _fail_dispatch drains-and-
+    # migrates started streams instead of killing them (the round-9 kill
+    # path stays the degrade target — injected `migrate_error`, no
+    # survivor, or a failed checkpoint all fall back to it). 0 (default)
+    # keeps every path byte-identical to round 9: no checkpoint machinery
+    # is consulted anywhere. Host-side only — compiled programs are
+    # untouched either way. Single-chip runners only; refused with
+    # speculation (the device-resident n-gram history has no checkpoint
+    # rule).
+    migration: int = 0
     # Content-addressed reuse of full prompt blocks (vLLM automatic-prefix-
     # caching analog); cached requests prefill only their suffix.
     prefix_caching: bool = False
@@ -333,6 +348,14 @@ class EngineConfig:
             raise ValueError(
                 "decode_overlap x speculation is not wired — disable one "
                 "of them")
+        if self.migration not in (0, 1):
+            raise ValueError(
+                f"migration must be 0 or 1, got {self.migration}")
+        if self.migration and self.speculation:
+            # The device-resident n-gram history has no checkpoint rule;
+            # silently dropping it would break token identity on resume.
+            raise ValueError(
+                "migration x speculation is not wired — disable one of them")
         if self.step_trace < 0:
             raise ValueError(
                 f"step_trace must be >= 0, got {self.step_trace}")
@@ -607,6 +630,21 @@ class LLMEngine:
                 f"{type(self.runner).__name__} does not support the scaled "
                 f"int8 KV pool — build the engine with kv_cache_dtype=None "
                 f"or 'fp8' (unset LLM_KV_CACHE_DTYPE)")
+        if cfg.migration and not getattr(self.runner, "supports_migration",
+                                         False):
+            # The mesh runners' sharded/staged caches have no per-block
+            # host slicing or restore-write rule: fail at construction,
+            # not at the first checkpoint.
+            raise ValueError(
+                f"{type(self.runner).__name__} does not support live "
+                f"stream migration — build the engine with migration=0 "
+                f"(unset LLM_MIGRATION)")
+        if cfg.migration and getattr(self.runner, "spec_tokens", 0) > 0:
+            # Caller-supplied speculative runner: the cfg validator only
+            # sees cfg-level speculation.
+            raise ValueError(
+                "migration x speculative runner is not wired — build the "
+                "engine with migration=0")
         if cfg.fused_kv_write and not getattr(
                 self.runner, "supports_fused_kv_write", False):
             raise ValueError(
@@ -1171,17 +1209,34 @@ class LLMEngine:
         log.warning("dispatch failed; failing %d request(s): %s",
                     len(reqs), exc)
         self._drain_all()
-        now = time.monotonic()
         for r in reqs:
             if r.is_finished():
                 continue  # the drain finished it normally first
-            self.scheduler.abort(r)
-            r.state = RequestState.ABORTED
-            r.finish_reason = FinishReason.ERROR
-            r.finish_time = now
-            r.error = f"dispatch failed: {exc}"
-            self._new_tokens.setdefault(r.request_id, [])
+            if self.cfg.migration and r.sampling_step > 0:
+                # Drain-and-migrate (round 11): a STARTED stream's terminal
+                # used to be this ERROR — with migration on it checkpoints
+                # instead, and the pool re-queues it at the head of a
+                # survivor (adopting the MIGRATED terminal). Un-started
+                # requests keep the round-9 path below: the pool's
+                # retry-once already moves them with no tokens to replay.
+                # A failed checkpoint (injected migrate_error, capture
+                # fault) degrades to the kill path inside the helper.
+                self._checkpoint_or_fail(r, trigger="quarantine",
+                                         note=f" (dispatch failed: {exc})")
+                continue
+            self._fail_request(r, f"dispatch failed: {exc}")
         self._invalidate_decode_state()
+
+    def _fail_request(self, r: Request, msg: str) -> None:
+        """Round-9 kill path for ONE request: abort through the scheduler
+        (blocks released, queues consistent) and queue a structured ERROR
+        terminal for its stream."""
+        self.scheduler.abort(r)
+        r.state = RequestState.ABORTED
+        r.finish_reason = FinishReason.ERROR
+        r.finish_time = time.monotonic()
+        r.error = msg
+        self._new_tokens.setdefault(r.request_id, [])
 
     def _fail_unservable(self) -> None:
         for req in self.scheduler.failed:
@@ -1451,58 +1506,7 @@ class LLMEngine:
         try:
             if self._faults is not None:
                 self._faults.maybe_raise("restore_error")
-            # Validate against the live pool's page geometry BEFORE any
-            # write: a corrupt host block must degrade to recompute, not
-            # scatter garbage-shaped pages (or raise) mid-step.
-            shape = self.cache.k.shape[:2] + self.cache.k.shape[3:]
-            sshape = (None if not self.cache.quantized
-                      else (self.cache.k_scale.shape[0],
-                            self.cache.k_scale.shape[2]))
-            for rb in restores:
-                if (rb.k.shape != shape or rb.v.shape != shape
-                        or rb.k.dtype != self.cache.k.dtype
-                        or rb.v.dtype != self.cache.v.dtype):
-                    raise ValueError(
-                        f"host block {rb.key} pages {rb.k.shape}/"
-                        f"{rb.k.dtype} do not match the pool page "
-                        f"{shape}/{self.cache.k.dtype}")
-                if sshape is not None and (
-                        rb.k_scale is None or rb.v_scale is None
-                        or rb.k_scale.shape != sshape
-                        or rb.v_scale.shape != sshape):
-                    raise ValueError(
-                        f"host block {rb.key} carries no (or mis-shaped) "
-                        f"int8 scales for the quantized pool ({sshape})")
-                if sshape is None and rb.k_scale is not None:
-                    raise ValueError(
-                        f"host block {rb.key} carries int8 scales but the "
-                        f"pool is not quantized")
-            blks = jnp.asarray([rb.block for rb in restores], jnp.int32)
-            # .at[].set on TPU lowers as copy-pool-then-update (~2 ms/GB,
-            # the reason per-step KV writes are DUS chains — kv_cache.py).
-            # Here it runs ONCE per admission against a >= 100 ms prefill
-            # recompute, and a donated/jitted DUS chain would compile per
-            # restore length — the scatter is the right trade at this call
-            # rate. [N, L, KH, bs, hd] -> pool axes [L, KH, N, bs, hd]
-            k_new = np.stack([rb.k for rb in restores]).transpose(1, 2, 0, 3, 4)
-            v_new = np.stack([rb.v for rb in restores]).transpose(1, 2, 0, 3, 4)
-            cache = self.cache._replace(
-                k=self.cache.k.at[:, :, blks].set(k_new),
-                v=self.cache.v.at[:, :, blks].set(v_new),
-            )
-            if sshape is not None:
-                # Scales restore unchanged alongside their pages ([N, L,
-                # KH] -> scale axes [L, N, KH]) — the byte-identity the
-                # quantized evict->restore test pins.
-                ks_new = np.stack([rb.k_scale for rb in restores]
-                                  ).transpose(1, 0, 2)
-                vs_new = np.stack([rb.v_scale for rb in restores]
-                                  ).transpose(1, 0, 2)
-                cache = cache._replace(
-                    k_scale=cache.k_scale.at[:, blks].set(ks_new),
-                    v_scale=cache.v_scale.at[:, blks].set(vs_new),
-                )
-            self.cache = cache
+            self._write_restore_blocks(restores)
         except Exception as exc:
             self._restore_fallback(r, restores, exc)
             return False
@@ -1516,6 +1520,66 @@ class LLMEngine:
             self.telemetry.request_event(r.request_id, REQ_RESTORE, now,
                                          nbytes)
         return True
+
+    # statics: hot-region(host-tier-drain)
+    def _write_restore_blocks(self, restores: list) -> None:
+        """Validated host→device page write shared by the host-tier
+        restore path and migration adoption: every block's pages (and,
+        on a quantized pool, its scale pair) must match the live pool's
+        geometry, then land in ONE batched scatter. Raises on any
+        mismatch — callers own the degrade path (recompute)."""
+        # Validate against the live pool's page geometry BEFORE any
+        # write: a corrupt host block must degrade to recompute, not
+        # scatter garbage-shaped pages (or raise) mid-step.
+        shape = self.cache.k.shape[:2] + self.cache.k.shape[3:]
+        sshape = (None if not self.cache.quantized
+                  else (self.cache.k_scale.shape[0],
+                        self.cache.k_scale.shape[2]))
+        for rb in restores:
+            if (rb.k.shape != shape or rb.v.shape != shape
+                    or rb.k.dtype != self.cache.k.dtype
+                    or rb.v.dtype != self.cache.v.dtype):
+                raise ValueError(
+                    f"host block {rb.key} pages {rb.k.shape}/"
+                    f"{rb.k.dtype} do not match the pool page "
+                    f"{shape}/{self.cache.k.dtype}")
+            if sshape is not None and (
+                    rb.k_scale is None or rb.v_scale is None
+                    or rb.k_scale.shape != sshape
+                    or rb.v_scale.shape != sshape):
+                raise ValueError(
+                    f"host block {rb.key} carries no (or mis-shaped) "
+                    f"int8 scales for the quantized pool ({sshape})")
+            if sshape is None and rb.k_scale is not None:
+                raise ValueError(
+                    f"host block {rb.key} carries int8 scales but the "
+                    f"pool is not quantized")
+        blks = jnp.asarray([rb.block for rb in restores], jnp.int32)
+        # .at[].set on TPU lowers as copy-pool-then-update (~2 ms/GB,
+        # the reason per-step KV writes are DUS chains — kv_cache.py).
+        # Here it runs ONCE per admission against a >= 100 ms prefill
+        # recompute, and a donated/jitted DUS chain would compile per
+        # restore length — the scatter is the right trade at this call
+        # rate. [N, L, KH, bs, hd] -> pool axes [L, KH, N, bs, hd]
+        k_new = np.stack([rb.k for rb in restores]).transpose(1, 2, 0, 3, 4)
+        v_new = np.stack([rb.v for rb in restores]).transpose(1, 2, 0, 3, 4)
+        cache = self.cache._replace(
+            k=self.cache.k.at[:, :, blks].set(k_new),
+            v=self.cache.v.at[:, :, blks].set(v_new),
+        )
+        if sshape is not None:
+            # Scales restore unchanged alongside their pages ([N, L,
+            # KH] -> scale axes [L, N, KH]) — the byte-identity the
+            # quantized evict->restore test pins.
+            ks_new = np.stack([rb.k_scale for rb in restores]
+                              ).transpose(1, 0, 2)
+            vs_new = np.stack([rb.v_scale for rb in restores]
+                              ).transpose(1, 0, 2)
+            cache = cache._replace(
+                k_scale=cache.k_scale.at[:, blks].set(ks_new),
+                v_scale=cache.v_scale.at[:, blks].set(vs_new),
+            )
+        self.cache = cache
 
     def _restore_fallback(self, r: Request, restores: list,
                           exc: Exception) -> None:
@@ -1539,6 +1603,263 @@ class LLMEngine:
         r.state = RequestState.WAITING
         r.num_computed_tokens = 0
         self.scheduler.waiting.appendleft(r)
+
+    # -- live migration (round 11, runtime/scheduler.MigrationPlan) --------
+
+    # statics: thread(engine-loop)
+    def checkpoint_request(self, req: Request, trigger: str = "drain"):
+        """Checkpoint a live request for migration: drain its in-flight
+        tokens (they belong to the client and ride the MIGRATED terminal),
+        capture token history + sampling carry + full KV blocks, then
+        release the request from this engine exactly like an abort.
+
+        Returns the MigrationPlan (also attached to `req.migration` on the
+        terminal event), or None when the drain finished the request
+        normally — its ordinary terminal flushes instead. Raises on the
+        injected `migrate_error` fault (BEFORE any capture or teardown, so
+        the caller's degrade path sees an intact request) and on real
+        capture failures; callers route those to the round-9 kill path
+        (`_checkpoint_or_fail`). Works mid-chunked-prefill too: only the
+        computed full blocks travel and the target resumes the remaining
+        chunks — migration completes cleanly rather than refusing."""
+        from agentic_traffic_testing_tpu.runtime.scheduler import (
+            MigrationBlock,
+            MigrationPlan,
+        )
+
+        if req.is_finished():
+            return None
+        if self._faults is not None:
+            self._faults.maybe_raise("migrate_error")
+        if self._overlap_unharvested > 0 and req in self._decode_requests:
+            # Overlap mispredict: speculative dispatches in flight carry
+            # post-checkpoint tokens for this lane that the drain below
+            # keeps (they are real tokens) — but the pipeline itself is
+            # torn down, which is the mispredict accounting's unit.
+            self.num_overlap_mispredicts += 1
+            if self.telemetry is not None:
+                self.telemetry.record_instant(EVENT_MISPREDICT,
+                                              time.monotonic())
+        self._drain_all()
+        if req.is_finished():
+            return None  # the drain delivered its final token in time
+        token_ids = req.prompt_ids + req.output_ids
+        # KV coverage: a prefilling request has pages for its computed
+        # prompt tokens (block-aligned — only whole chunks completed); a
+        # decoding one for EVERY position but the last sampled token's
+        # (its page write rides the next dispatch, which never runs here).
+        # The decode-phase capture includes the partial tail block on
+        # purpose: the target then resumes directly on the DECODE path —
+        # the exact dispatch the source would have run next — which is
+        # what makes the resumed tokens byte-identical (a chunk-path tail
+        # recompute would produce bitwise-different KV/logits than the
+        # baseline's decode writes).
+        bs = self.cfg.block_size
+        decodable = not req.is_prefilling
+        kv_tokens = (max(0, req.total_len - 1) if decodable
+                     else req.num_computed_tokens)
+        kv_tokens = min(kv_tokens, len(token_ids) - 1)
+        n_blocks = -(-kv_tokens // bs) if decodable else kv_tokens // bs
+        mig_blocks: list = []
+        if req.blocks is not None and n_blocks > 0:
+            blks = list(req.blocks.blocks[:n_blocks])
+            leaves = [self.cache.k[:, :, blks], self.cache.v[:, :, blks]]
+            if self.cache.quantized:
+                leaves += [self.cache.k_scale[:, blks],
+                           self.cache.v_scale[:, blks]]
+            fetched = jax.device_get(leaves)
+            k_all, v_all = fetched[0], fetched[1]
+            ks_all = fetched[2] if self.cache.quantized else None
+            vs_all = fetched[3] if self.cache.quantized else None
+            for i in range(n_blocks):
+                mig_blocks.append(MigrationBlock(
+                    tokens=tuple(token_ids[i * bs:min((i + 1) * bs,
+                                                      kv_tokens)]),
+                    k=k_all[:, :, i], v=v_all[:, :, i],
+                    k_scale=None if ks_all is None else ks_all[:, i],
+                    v_scale=None if vs_all is None else vs_all[:, i],
+                ))
+        else:
+            kv_tokens = 0
+        plan = MigrationPlan(
+            request_id=req.request_id,
+            token_ids=token_ids,
+            sampling=req.sampling,
+            sampling_step=req.sampling_step,
+            num_orig_prompt_tokens=req.num_orig_prompt_tokens,
+            arrival_time=req.arrival_time,
+            depth_at_enqueue=req.depth_at_enqueue,
+            num_computed_tokens=req.num_computed_tokens,
+            blocks=mig_blocks,
+            kv_tokens=kv_tokens,
+            decodable=decodable,
+            block_size=bs,
+            deadline=req.deadline,
+            trigger=trigger,
+            created_t=time.monotonic(),
+            hops=req.migration_hops + 1,
+        )
+        # Teardown mirrors abort_request — pages are host-resident (the
+        # device_get above is synchronous), so releasing the blocks now is
+        # safe even though a later dispatch may overwrite them. Drained
+        # tokens already in _new_tokens ride the MIGRATED terminal.
+        req.state = RequestState.ABORTED
+        req.finish_reason = FinishReason.MIGRATED
+        req.finish_time = time.monotonic()
+        req.migration = plan
+        self.scheduler.abort(req)
+        # The MIGRATED terminal rides the normal event flush (which also
+        # drops the request from _requests, discards its deadline entry,
+        # and retires its telemetry timeline under reason="migrated").
+        self._new_tokens.setdefault(req.request_id, [])
+        self._invalidate_decode_state()
+        return plan
+
+    # statics: thread(engine-loop)
+    def _checkpoint_or_fail(self, r: Request, trigger: str,
+                            note: str = "") -> bool:
+        """Checkpoint `r`; any failure (injected `migrate_error` included)
+        degrades to the round-9 kill path — a structured ERROR terminal —
+        so a stream never hangs on a failed migration. True when the
+        request reached a MIGRATED terminal (or finished normally during
+        the drain)."""
+        try:
+            self.checkpoint_request(r, trigger=trigger)
+            return True
+        except Exception as exc:
+            log.warning("checkpoint failed for %s; degrading to the "
+                        "round-9 kill path: %s", r.request_id, exc)
+            if not r.is_finished():
+                self._fail_request(r, f"migration failed: {exc}{note}")
+            return False
+
+    # statics: thread(engine-loop)
+    def drain_for_migration(self, trigger: str, count: Optional[int] = None,
+                            started_only: bool = False) -> list[StepOutput]:
+        """Checkpoint live requests for migration, newest-arrival first
+        (the SLO-rebalance trigger moves the NEWEST streams — the oldest
+        are closest to finishing and have the most KV to move), and flush
+        the resulting events. `count` bounds how many migrate (None =
+        drain everything live, the scale-down/retire shape);
+        `started_only` restricts to decoding streams that already emitted
+        (the rebalance shape — queued work is the router's problem)."""
+        live = [r for r in self._requests.values() if not r.is_finished()]
+        if started_only:
+            live = [r for r in live if r.sampling_step > 0
+                    and not r.is_prefilling]
+        live.sort(key=lambda r: r.arrival_time, reverse=True)
+        if count is not None:
+            live = live[:count]
+        for r in live:
+            self._checkpoint_or_fail(r, trigger)
+        return self._flush_events()
+
+    # statics: thread(engine-loop)
+    def adopt_request(self, plan) -> Request:
+        """Resume a checkpointed stream on THIS engine (the drain path's
+        other half). Reconstructs the request with its generated tokens
+        folded into the prompt (the preemption shape) and its sampling
+        carry intact, then tries to transplant the checkpointed KV blocks
+        into freshly allocated pages — the suffix prefills as one chunk.
+        Any transplant obstacle (no seat, no KV room, geometry mismatch,
+        no pages in the plan) degrades to the head of the waiting queue
+        for a full recompute: token-identical either way, because the
+        sampler keys on (seed, sampling_step)."""
+        req = Request(
+            request_id=plan.request_id,
+            prompt_ids=list(plan.token_ids),
+            sampling=plan.sampling,
+            arrival_time=plan.arrival_time,
+        )
+        req.num_orig_prompt_tokens = plan.num_orig_prompt_tokens
+        req.sampling_step = plan.sampling_step
+        req.depth_at_enqueue = plan.depth_at_enqueue
+        req.migration_hops = plan.hops
+        if plan.deadline is not None:
+            req.deadline = plan.deadline
+            self._deadline_ids.add(req.request_id)
+        self._requests[req.request_id] = req
+        if self.telemetry is not None:
+            self.telemetry.request_queued(req.request_id, req.arrival_time)
+        if not self._try_transplant(req, plan):
+            req.num_computed_tokens = 0
+            self.scheduler.requeue_front(req)
+        return req
+
+    def _try_transplant(self, req: Request, plan) -> bool:
+        """Write a migration plan's KV blocks into fresh device pages and
+        seat the request: directly decodable for a decode-phase plan (the
+        next dispatch IS the decode step the source would have run),
+        mid-chunked-prefill otherwise. False (nothing mutated beyond a
+        clean release) sends the caller to the recompute path."""
+        from agentic_traffic_testing_tpu.runtime.kv_offload import (
+            RestoreBlock,
+        )
+
+        bs = self.cfg.block_size
+        kv_tokens = min(plan.kv_tokens, len(req.prompt_ids) - 1)
+        if not plan.blocks or plan.block_size != bs or kv_tokens <= 0:
+            return False
+        if kv_tokens != plan.kv_tokens:
+            return False  # malformed plan: coverage past the history
+        if len(self.scheduler.running) >= self.cfg.max_num_seqs:
+            return False  # no seat; admission recomputes when one frees
+        n = len(plan.blocks)
+        # Allocate through the sequence API only: the native (C++)
+        # allocator's `.blocks` is an FFI-marshaled COPY, so growing a
+        # sequence by hand-extending that list would silently desync the
+        # table from the pages. ensure_capacity covers the restored
+        # blocks AND the decode tail in one all-or-nothing grab; the
+        # first n block ids are then the page-write targets.
+        seq = self.allocator.new_sequence()
+        need = (req.num_prompt_tokens + 1
+                + self.scheduler.cfg.decode_lookahead)
+        if not seq.ensure_capacity(need):
+            # KV pressure: recompute beats evicting live sharers.
+            seq.release()
+            return False
+        got = list(seq.blocks[:n])
+        chain = getattr(self.allocator, "chain_keys", None)
+        keys = (chain(req.prompt_ids)[0] if chain is not None
+                else [0] * n)
+        restores = [
+            RestoreBlock(block=got[i],
+                         key=keys[i] if i < len(keys) else 0,
+                         tokens=b.tokens, k=b.k, v=b.v,
+                         k_scale=b.k_scale, v_scale=b.v_scale)
+            for i, b in enumerate(plan.blocks)
+        ]
+        try:
+            self._write_restore_blocks(restores)
+        except Exception as exc:
+            log.warning("migration transplant failed for %s; recomputing: "
+                        "%s", req.request_id, exc)
+            seq.release()
+            return False
+        register = getattr(self.allocator, "register_restored", None)
+        if register is not None and chain is not None:
+            # Prefix-caching pools index the transplanted blocks: the
+            # migrated stream's history becomes shareable device KV,
+            # exactly like a host-tier restore. FULL blocks only — a
+            # decode-phase plan's partial tail block covers fewer tokens
+            # than its key's content hash claims.
+            register([rb for i, rb in enumerate(restores)
+                      if (i + 1) * bs <= kv_tokens and i < len(keys)])
+        req.blocks = seq
+        # A decode-phase plan resumes decodable: every prompt position's
+        # KV is present except the last sampled token's, which the next
+        # decode dispatch writes (exactly as the source's would have).
+        req.num_computed_tokens = (req.num_prompt_tokens if plan.decodable
+                                   else n * bs)
+        self.scheduler.adopt_running(req)
+        if self.telemetry is not None:
+            now = time.monotonic()
+            nbytes = sum(int(rb.k.nbytes) + int(rb.v.nbytes)
+                         for rb in restores)
+            self.telemetry.record_instant(EVENT_HOST_RESTORE, now, n)
+            self.telemetry.request_event(req.request_id, REQ_RESTORE, now,
+                                         nbytes)
+        return True
 
     # statics: hot-region(chunk-dispatch)
     def _run_chunk(self, plan: ChunkPrefill) -> None:

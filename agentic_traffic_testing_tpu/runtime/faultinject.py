@@ -22,6 +22,13 @@ at the three call sites the robustness plane hardens:
                              every dispatch (replica_pool wiring) — the
                              stuck/degraded-replica shape health routing
                              and the watchdog must absorb
+    migrate_error:p=0.2      a live-migration checkpoint
+                             (engine.checkpoint_request) fails with
+                             probability p BEFORE any state capture or
+                             teardown, exercising the degrade path: the
+                             stream falls back to the round-9 kill path
+                             (structured ERROR terminal) instead of
+                             migrating
 
 Grammar: `point[:k=v[,k=v...]][;point...]` — semicolon-separated points,
 comma-separated key=value params, numbers parsed as float (int when
@@ -48,7 +55,8 @@ import zlib
 from typing import Optional
 
 #: the complete set of compile-time-valid fault point names.
-FAULT_POINTS = ("dispatch_error", "restore_error", "slow_replica")
+FAULT_POINTS = ("dispatch_error", "restore_error", "slow_replica",
+                "migrate_error")
 
 
 class InjectedFault(RuntimeError):
@@ -91,7 +99,7 @@ def parse_fault_spec(spec: str) -> dict[str, dict]:
             except ValueError:
                 raise ValueError(
                     f"non-numeric fault param {kv!r} for {name!r}") from None
-        if name in ("dispatch_error", "restore_error"):
+        if name in ("dispatch_error", "restore_error", "migrate_error"):
             p = params.setdefault("p", 1.0)
             if not 0.0 <= p <= 1.0:
                 raise ValueError(

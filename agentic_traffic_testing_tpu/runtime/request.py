@@ -53,6 +53,12 @@ class FinishReason(enum.Enum):
     ERROR = "error"        # unservable, or a dispatch failed under it
     DEADLINE = "deadline"  # request deadline expired (queued or running)
     SHED = "shed"          # rejected at admission (bounded queue)
+    # Internal terminal: the request was checkpointed for live migration
+    # (engine.checkpoint_request) and its MigrationPlan rides
+    # `request.migration`. The replica pool adopts it on a survivor and
+    # NEVER surfaces this reason to a client — a plan nobody adopts is
+    # converted to ERROR.
+    MIGRATED = "migrated"
 
 
 @dataclasses.dataclass(eq=False)  # identity semantics: a request is not its field values
@@ -92,6 +98,15 @@ class Request:
     # (None = no deadline). Stamped by the engine at add_request from
     # sampling.deadline_ms / the LLM_DEADLINE_MS default.
     deadline: Optional[float] = None
+    # Live-migration checkpoint (runtime/scheduler.MigrationPlan), attached
+    # by engine.checkpoint_request to the MIGRATED terminal event so the
+    # replica pool can resume the stream on a survivor. None everywhere
+    # else; never serialized to a client.
+    migration: Optional[object] = None
+    # Checkpoints this stream has already been through (set by
+    # engine.adopt_request from the plan; feeds the next plan's hop
+    # count so the pool's migration bound survives re-checkpoints).
+    migration_hops: int = 0
     # Waiting-queue depth of the OWNING replica at enqueue (stamped by
     # scheduler.add_request). The serving layer's per-slot wait EWMA
     # divides the measured queue wait by this — it must be the depth the

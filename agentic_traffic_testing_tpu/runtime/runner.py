@@ -384,6 +384,13 @@ class ModelRunner:
     #: wrappers have no aliasing rule for the in-kernel pool writes, so the
     #: engine refuses the knob at build (parallel/ runners set False).
     supports_fused_kv_write: bool = True
+    #: whether this runner serves live stream migration (LLM_MIGRATION,
+    #: round 11): checkpoint slices KV pages straight off the single-chip
+    #: pool (engine.checkpoint_request) and adopt writes them into a
+    #: fresh single-chip pool — the mesh runners' sharded/staged caches
+    #: have no per-block host slicing or restore-write rule, so the
+    #: engine refuses the knob at build (parallel/ runners set False).
+    supports_migration: bool = True
 
     def prepare_cache(self, cache: KVCache) -> KVCache:
         """Hook for placing a freshly allocated cache (TP runner shards it)."""

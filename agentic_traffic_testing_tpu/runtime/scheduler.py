@@ -111,6 +111,73 @@ StepPlan = Union[PrefillBatch, DecodeBatch, ChunkPrefill, HybridBatch, None]
 
 
 @dataclass
+class MigrationBlock:
+    """One KV block of a checkpointed stream: raw host pages (the pool's
+    dtype — int8 pools carry the fp32 scale pair raw, exactly like
+    kv_offload.HostBlock, so migration never round-trips through bf16 and
+    int8 halves the migration bytes) plus the covered token ids. The LAST
+    block of a decode-phase checkpoint may be partial (its trailing slots
+    hold stale bytes nothing ever reads — attention masks by position);
+    partial blocks are never prefix-indexed on adopt."""
+
+    tokens: tuple           # token ids covered by this block's valid slots
+    k: "object"             # np.ndarray [L, KH, block_size, hd_phys]
+    v: "object"
+    k_scale: Optional["object"] = None   # [L, KH] f32 (int8 pools only)
+    v_scale: Optional["object"] = None
+
+
+@dataclass
+class MigrationPlan:
+    """A checkpointed in-flight stream, ready to resume on another replica.
+
+    Built by `engine.checkpoint_request` (token history + sampling carry +
+    KV pages), consumed by `engine.adopt_request`. Token identity is the
+    contract: `token_ids` folds generated tokens into the prompt exactly
+    like preemption does, and `sampling_step` carries the per-request RNG
+    position ((seed, sampling_step) keys the sampler). A decode-phase plan
+    (`decodable`) carries KV for every position but the last sampled
+    token's, so the target's FIRST dispatch is the exact decode step the
+    source would have run next — byte-for-byte identical tokens, pinned by
+    tests/test_migration.py. A mid-prefill plan carries the computed full
+    blocks and the target resumes the remaining chunks on the same ladder
+    rungs. With the pages dropped (capacity pressure on the target,
+    geometry mismatch), the whole history recomputes from the folded
+    prompt — the deterministic preemption path the scheduler has always
+    trusted, though recomputed KV is not bitwise-pinned against the
+    uninterrupted stream's."""
+
+    request_id: str
+    token_ids: list          # original prompt + every generated token so far
+    sampling: "object"       # SamplingParams (carries seed/top_k/... + SLO class)
+    sampling_step: int       # RNG carry: tokens sampled so far
+    num_orig_prompt_tokens: int   # user-visible prompt boundary
+    arrival_time: float      # preserved: deadlines/TTFT stay the request's own
+    num_computed_tokens: int      # prefill progress at checkpoint (chunked)
+    blocks: list = field(default_factory=list)   # list[MigrationBlock]
+    kv_tokens: int = 0       # positions the blocks' valid slots cover
+    # True = checkpointed mid-decode: kv_tokens == len(token_ids) - 1 and
+    # the adopter seats the request directly decodable (the next dispatch
+    # is the decode step the source would have run). False = mid-chunked-
+    # prefill: full blocks only, the chunk path resumes.
+    decodable: bool = False
+    block_size: int = 0      # geometry attestation for the adopter
+    deadline: Optional[float] = None  # absolute monotonic abort instant
+    # Preserved so the server's per-slot queue-wait EWMA keeps dividing
+    # the measured wait by the depth the request ACTUALLY waited behind
+    # (the PR-8 spurious-429 fix) — a migrated terminal must not report
+    # depth 0.
+    depth_at_enqueue: int = 0
+    trigger: str = "drain"   # quarantine | rebalance | scale_down | drain
+    source_replica: int = -1
+    created_t: float = 0.0   # checkpoint instant (migration-duration metric)
+    # Total checkpoints this stream has been through (survives
+    # re-checkpoints of an adopted stream): the pool's ping-pong bound
+    # (replica_pool.MAX_STREAM_MIGRATIONS) reads it.
+    hops: int = 1
+
+
+@dataclass
 class SchedulerConfig:
     max_num_seqs: int = 12           # compose default (reference: docker-compose.distributed.yml:40)
     max_num_batched_tokens: int = 8192
@@ -363,6 +430,27 @@ class Scheduler:
             real = min(real, padded)
         return ChunkPrefill(request=req, chunk_start=start, chunk_len=real,
                             padded_len=padded)
+
+    def requeue_front(self, req: Request) -> None:
+        """Re-queue already-admitted work at the head of the waiting queue,
+        bypassing the max_queue bound — the preemption contract (admitted
+        work is never shed) extended to migration adopts whose KV could
+        not transplant: the request recomputes from its folded history."""
+        req.state = RequestState.WAITING
+        self.waiting.appendleft(req)
+        self.composition_epoch += 1
+
+    def adopt_running(self, req: Request) -> None:
+        """Seat an adopted (migrated-in) request directly in the running
+        set, mid-chunked-prefill: its restored blocks hold
+        `num_computed_tokens` of KV and the suffix prefills through the
+        normal chunk path. The caller verified the seat and block
+        capacity; this is only the membership bookkeeping."""
+        req.state = RequestState.RUNNING
+        self.running.append(req)
+        self.composition_epoch += 1
+        if self.on_admit is not None:
+            self.on_admit(req)
 
     def abort(self, req: Request) -> None:
         self.composition_epoch += 1

@@ -119,12 +119,38 @@ class AsyncLLMEngine:
         """Stream token increments for one request."""
         rid = request_id or uuid.uuid4().hex[:16]
         stream = _Stream(asyncio.get_running_loop())
-        self._submit_q.put((rid, list(prompt_ids), sampling, stream))
+        self._submit_q.put(("gen", rid, list(prompt_ids), sampling, stream))
         while True:
             ev = await stream.aq.get()
             yield ev
             if ev.finished:
                 return
+
+    # statics: thread(handler)
+    async def adopt(self, plan) -> AsyncIterator[TokenEvent]:
+        """Resume a checkpointed stream (runtime/scheduler.MigrationPlan)
+        on this replica: the engine thread adopts it at its next
+        submission drain and the remaining token increments stream back
+        exactly like generate()'s. The replica pool calls this with the
+        plan it pulled off a MIGRATED terminal."""
+        stream = _Stream(asyncio.get_running_loop())
+        self._submit_q.put(("adopt", plan.request_id, plan, stream))
+        while True:
+            ev = await stream.aq.get()
+            yield ev
+            if ev.finished:
+                return
+
+    # statics: thread(handler)
+    def request_drain(self, count: Optional[int], trigger: str) -> None:
+        """Ask the engine thread to checkpoint live streams for migration
+        (None = everything live — the scale-down/retire shape; an int
+        bounds it to the N newest started decode streams — the rebalance
+        shape). The resulting MIGRATED terminals flow through the normal
+        stream path; the pool adopts them on survivors. Fire-and-forget:
+        the control message rides the submit queue, so it orders after
+        every admission already enqueued."""
+        self._submit_q.put(("drain", count, trigger, None))
 
     # -- engine thread ------------------------------------------------------
 
@@ -136,7 +162,34 @@ class AsyncLLMEngine:
             except queue.Empty:
                 return
             block = False  # only the first get may block
-            rid, prompt_ids, sampling, stream = item
+            kind = item[0]
+            if kind == "drain":
+                # Migration drain control (round 11): checkpoint live
+                # streams; their MIGRATED terminals (plus any sibling
+                # events the drain flushed) route like step() events —
+                # including the on_step token accounting, so tokens
+                # harvested by the drain still count toward throughput.
+                _, count, trigger, _unused = item
+                events = self.engine.drain_for_migration(
+                    trigger, count=count,
+                    started_only=trigger == "rebalance")
+                if self._on_step is not None and events:
+                    self._on_step(
+                        sum(1 for e in events if e.new_token_ids))
+                self._route_events(events)
+                continue
+            if kind == "adopt":
+                _, rid, plan, stream = item
+                self._streams[rid] = stream
+                try:
+                    self.engine.adopt_request(plan)
+                except Exception as exc:
+                    # adopt_request degrades internally; this is the
+                    # belt-and-braces terminal so a stream never hangs.
+                    self._refuse(rid, plan.token_ids, plan.sampling,
+                                 stream, exc)
+                continue
+            _, rid, prompt_ids, sampling, stream = item
             self._streams[rid] = stream
             try:
                 self.engine.add_request(prompt_ids, sampling, request_id=rid)
@@ -145,24 +198,31 @@ class AsyncLLMEngine:
                 # must terminate THIS stream, never the engine thread: the
                 # HTTP layer's own pre-checks race against other handlers,
                 # so the authoritative refusal lands here.
-                from agentic_traffic_testing_tpu.runtime.request import (
-                    FinishReason,
-                    Request,
-                    RequestState,
-                )
-                from agentic_traffic_testing_tpu.runtime.scheduler import (
-                    QueueFullError,
-                )
+                self._refuse(rid, prompt_ids, sampling, stream, exc)
 
-                req = Request(request_id=rid, prompt_ids=list(prompt_ids),
-                              sampling=sampling)
-                req.state = RequestState.ABORTED
-                req.finish_reason = (FinishReason.SHED
-                                     if isinstance(exc, QueueFullError)
-                                     else FinishReason.ERROR)
-                req.error = str(exc)
-                del self._streams[rid]
-                stream.push(TokenEvent([], True, req))
+    # statics: thread(engine-loop)
+    def _refuse(self, rid: str, prompt_ids: list, sampling, stream,
+                exc: Exception) -> None:
+        """Terminate one stream with a structured refusal terminal (SHED
+        for the bounded queue, ERROR otherwise)."""
+        from agentic_traffic_testing_tpu.runtime.request import (
+            FinishReason,
+            Request,
+            RequestState,
+        )
+        from agentic_traffic_testing_tpu.runtime.scheduler import (
+            QueueFullError,
+        )
+
+        req = Request(request_id=rid, prompt_ids=list(prompt_ids),
+                      sampling=sampling)
+        req.state = RequestState.ABORTED
+        req.finish_reason = (FinishReason.SHED
+                             if isinstance(exc, QueueFullError)
+                             else FinishReason.ERROR)
+        req.error = str(exc)
+        del self._streams[rid]
+        stream.push(TokenEvent([], True, req))
 
     # statics: thread(engine-loop)
     def _run(self) -> None:
@@ -199,29 +259,35 @@ class AsyncLLMEngine:
                     h.record_ok()
             if self._on_step is not None and events:
                 self._on_step(sum(1 for e in events if e.new_token_ids))
-            # Work-list, not a plain for: an abort's drain can FINISH sibling
-            # requests, and their events surface only in abort_request's
-            # return value — if the engine is empty afterwards no later
-            # step() would flush them, stranding the survivors' streams.
-            pending = list(events)
-            while pending:
-                e = pending.pop(0)
-                stream = self._streams.get(e.request.request_id)
-                if stream is None:
-                    continue
-                alive = stream.push(
-                    TokenEvent(list(e.new_token_ids), e.finished, e.request))
-                if e.finished:
-                    del self._streams[e.request.request_id]
-                elif not alive:
-                    # Client loop is gone: stop paying for this generation.
-                    del self._streams[e.request.request_id]
-                    extra = self.engine.abort_request(e.request)
-                    if self._on_step is not None and extra:
-                        # Keep token accounting complete: these sibling
-                        # events never pass through the step() path above.
-                        self._on_step(sum(1 for x in extra if x.new_token_ids))
-                    pending.extend(extra)
+            self._route_events(events)
+
+    # statics: thread(engine-loop)
+    def _route_events(self, events: list) -> None:
+        """Push engine events to their streams. Work-list, not a plain
+        for: an abort's drain can FINISH sibling requests, and their
+        events surface only in abort_request's return value — if the
+        engine is empty afterwards no later step() would ever flush them,
+        stranding the survivors' streams. Shared by the step loop and the
+        migration-drain control path."""
+        pending = list(events)
+        while pending:
+            e = pending.pop(0)
+            stream = self._streams.get(e.request.request_id)
+            if stream is None:
+                continue
+            alive = stream.push(
+                TokenEvent(list(e.new_token_ids), e.finished, e.request))
+            if e.finished:
+                del self._streams[e.request.request_id]
+            elif not alive:
+                # Client loop is gone: stop paying for this generation.
+                del self._streams[e.request.request_id]
+                extra = self.engine.abort_request(e.request)
+                if self._on_step is not None and extra:
+                    # Keep token accounting complete: these sibling
+                    # events never pass through the step() path above.
+                    self._on_step(sum(1 for x in extra if x.new_token_ids))
+                pending.extend(extra)
 
     def _fail_all(self) -> None:
         """Abort every live request in the engine and notify its stream.

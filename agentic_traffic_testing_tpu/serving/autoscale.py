@@ -1,0 +1,153 @@
+"""Telemetry-driven replica-pool autoscaling (round 11, ROADMAP item 4).
+
+The elastic half of the migration plane: `EnginePool.scale_to_async` can
+add a warmed replica or drain-and-migrate one away at runtime; this
+module decides WHEN, from the two signals the serving plane already
+exports — SLO attainment (`llm_slo_attainment_total`, the step-clock
+plane's per-request verdicts) and queue depth (the same lock-free
+load snapshots the routers read).
+
+Policy (deliberately boring — hysteresis beats cleverness here):
+
+  * scale UP one replica when the recent SLO-violation fraction crosses
+    `violation_frac_up` (default 0.5) with at least `min_verdicts`
+    verdicts observed since the last decision, OR when the pool-wide
+    waiting-queue depth exceeds `queue_depth_up` requests per replica —
+    overload is visible in the queue before it is visible in attainment.
+  * scale DOWN one replica when the pool has been idle (zero waiting,
+    zero running) for `idle_ticks_down` consecutive decision intervals
+    and no violation was seen in the last interval. Scale-down retires
+    the highest-index replica by drain-and-migrate, so any straggler
+    streams move instead of dying.
+  * never outside [min_replicas, max_replicas]; at most one step per
+    decision interval (a pool that needs +3 gets there in 3 intervals —
+    each new replica changes the signal the next decision reads).
+
+`decide()` is a pure function over an `AutoscaleSignals` snapshot so the
+policy is unit-testable without a pool or an event loop; the controller
+is the thin async shell the server runs when `LLM_POOL_AUTOSCALE=1`.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import logging
+from typing import Optional
+
+log = logging.getLogger("att_tpu.autoscale")
+
+#: decision cadence (seconds); long enough for a scale step's effect to
+#: show up in the next window's attainment/queue signals.
+DECISION_INTERVAL_S = 5.0
+
+
+@dataclasses.dataclass
+class AutoscaleSignals:
+    """One decision window's inputs."""
+
+    current: int              # live replica count
+    waiting: int              # pool-wide queued requests
+    running: int              # pool-wide running requests
+    met_delta: int            # SLO verdicts met since the last decision
+    violated_delta: int       # SLO verdicts violated since the last decision
+    idle_ticks: int           # consecutive windows with zero work
+
+
+@dataclasses.dataclass
+class AutoscalePolicy:
+    min_replicas: int = 1
+    max_replicas: int = 1
+    violation_frac_up: float = 0.5
+    min_verdicts: int = 4          # ignore attainment noise below this
+    queue_depth_up: int = 4        # waiting per replica that forces growth
+    idle_ticks_down: int = 3       # calm windows before shrinking
+
+
+def decide(sig: AutoscaleSignals, pol: AutoscalePolicy) -> int:
+    """Target replica count for this window (== current for no-op)."""
+    target = sig.current
+    verdicts = sig.met_delta + sig.violated_delta
+    violating = (verdicts >= pol.min_verdicts
+                 and sig.violated_delta / verdicts >= pol.violation_frac_up)
+    queue_pressure = sig.waiting >= pol.queue_depth_up * max(1, sig.current)
+    if violating or queue_pressure:
+        target = sig.current + 1
+    elif (sig.idle_ticks >= pol.idle_ticks_down
+          and sig.violated_delta == 0
+          and sig.waiting == 0 and sig.running == 0):
+        target = sig.current - 1
+    return max(pol.min_replicas, min(pol.max_replicas, target))
+
+
+class AutoscaleController:
+    """Async decision loop over a live EnginePool.
+
+    `read_slo_counts` returns the cumulative (met, violated) totals from
+    the metrics plane (the server wires it to the llm_slo_attainment
+    counter); the controller differences consecutive reads. Without the
+    step-trace plane the totals stay 0 and queue depth alone drives
+    scaling — attainment is the better signal, but overload must not be
+    invisible just because tracing is off.
+    """
+
+    def __init__(self, pool, policy: AutoscalePolicy,
+                 read_slo_counts=None,
+                 interval_s: float = DECISION_INTERVAL_S) -> None:
+        self.pool = pool
+        self.policy = policy
+        self.read_slo_counts = read_slo_counts or (lambda: (0, 0))
+        self.interval_s = interval_s
+        self.decisions = 0       # windows evaluated
+        self.scale_actions = 0   # windows that changed the size
+        self._last = (0, 0)
+        self._idle_ticks = 0
+
+    def snapshot(self) -> AutoscaleSignals:
+        waiting = running = 0
+        for e in self.pool.engines:
+            s = e.load_snapshot()
+            waiting += s["num_waiting"]
+            running += s["num_running"]
+        met, violated = self.read_slo_counts()
+        met_d = max(0, met - self._last[0])
+        vio_d = max(0, violated - self._last[1])
+        self._last = (met, violated)
+        if waiting == 0 and running == 0:
+            self._idle_ticks += 1
+        else:
+            self._idle_ticks = 0
+        return AutoscaleSignals(
+            current=len(self.pool.engines), waiting=waiting, running=running,
+            met_delta=met_d, violated_delta=vio_d,
+            idle_ticks=self._idle_ticks)
+
+    async def tick(self) -> Optional[int]:
+        """One decision + (maybe) one scale step. Returns the new size
+        when a scale happened, None otherwise."""
+        self.decisions += 1
+        sig = self.snapshot()
+        target = decide(sig, self.policy)
+        if target == sig.current:
+            return None
+        log.info("autoscale: %d -> %d (waiting=%d violated=%d/%d idle=%d)",
+                 sig.current, target, sig.waiting, sig.violated_delta,
+                 sig.met_delta + sig.violated_delta, sig.idle_ticks)
+        await self.pool.scale_to_async(target)
+        self.scale_actions += 1
+        self._idle_ticks = 0
+        return target
+
+    async def run(self) -> None:
+        """The server's background task (cancelled at shutdown)."""
+        try:
+            while True:
+                await asyncio.sleep(self.interval_s)
+                try:
+                    await self.tick()
+                except Exception:
+                    # A failed scale step must not kill the controller —
+                    # the next window re-evaluates from live state.
+                    log.exception("autoscale tick failed")
+        except asyncio.CancelledError:
+            pass

@@ -124,6 +124,24 @@ class ServerConfig:
     fault_spec: str = ""                       # LLM_FAULT_SPEC
     # Seed for the per-point fault RNG streams (replica i uses seed + i).
     fault_seed: int = 0                        # LLM_FAULT_SEED
+    # Live migration of in-flight streams (round 11 — the elastic-serving
+    # plane): 1 lets a replica checkpoint a started stream's decode state
+    # + KV pages and the pool resume it on a survivor, token-identical —
+    # drain-and-migrate replaces the round-9 kill path on dispatch
+    # failures, SLO rebalance moves streams off hot replicas, and
+    # scale-down drains retire replicas without killing work. Requires
+    # LLM_NUM_REPLICAS > 1 (a single engine has no survivor to adopt on).
+    # 0 (default) keeps every serving path byte-identical to round 9.
+    migration: int = 0                         # LLM_MIGRATION
+    # Telemetry-driven pool autoscaling (serving/autoscale.py): 1 starts a
+    # controller that watches SLO attainment + queue depth and calls
+    # EnginePool.scale_to_async between pool_min_replicas and
+    # pool_max_replicas. Requires migration=1 (scale-down drains migrate
+    # started streams). 0 (default) = fixed pool, exactly as before.
+    pool_autoscale: int = 0                    # LLM_POOL_AUTOSCALE
+    pool_min_replicas: int = 1                 # LLM_POOL_MIN_REPLICAS
+    # 0 = the boot LLM_NUM_REPLICAS value is also the ceiling.
+    pool_max_replicas: int = 0                 # LLM_POOL_MAX_REPLICAS
     prefix_caching: bool = False               # LLM_PREFIX_CACHING
     # Host-RAM second tier for the prefix cache (runtime/kv_offload.py):
     # GB of host memory for evicted prefix blocks; restored device-side on
@@ -171,6 +189,45 @@ class ServerConfig:
     speculation: Optional[str] = None          # LLM_SPECULATION ("ngram" | unset)
     spec_tokens: int = 3                       # LLM_SPEC_TOKENS (drafts/step)
     spec_ngram: int = 3                        # LLM_SPEC_NGRAM (match length)
+
+    def _validate_elastic(self) -> None:
+        """Round-11 elastic-serving knob coherence — shared by the env
+        and CLI paths (the CLI can repair or break an env-only combo)."""
+        if self.migration not in (0, 1):
+            raise ValueError(
+                f"LLM_MIGRATION must be 0 or 1, got {self.migration} "
+                f"(unset it for the round-9 kill-path behavior)")
+        if self.migration and self.num_replicas < 2:
+            raise ValueError(
+                "LLM_MIGRATION=1 requires LLM_NUM_REPLICAS >= 2 — a "
+                "single engine has no survivor replica to adopt "
+                "checkpointed streams")
+        if self.pool_autoscale not in (0, 1):
+            raise ValueError(
+                f"LLM_POOL_AUTOSCALE must be 0 or 1, got "
+                f"{self.pool_autoscale} (unset it for a fixed pool)")
+        if self.pool_autoscale and not self.migration:
+            raise ValueError(
+                "LLM_POOL_AUTOSCALE=1 requires LLM_MIGRATION=1 — "
+                "scale-down retires replicas by drain-and-migrate, which "
+                "needs the migration plane")
+        if self.pool_min_replicas < 1:
+            raise ValueError(
+                f"LLM_POOL_MIN_REPLICAS must be >= 1, got "
+                f"{self.pool_min_replicas}")
+        if self.pool_max_replicas < 0:
+            raise ValueError(
+                f"LLM_POOL_MAX_REPLICAS must be >= 0 (0 = the boot "
+                f"replica count), got {self.pool_max_replicas}")
+        max_n = self.pool_max_replicas or self.num_replicas
+        if self.pool_autoscale and not (
+                self.pool_min_replicas <= self.num_replicas
+                and self.num_replicas <= max_n):
+            raise ValueError(
+                f"autoscale bounds must satisfy LLM_POOL_MIN_REPLICAS "
+                f"({self.pool_min_replicas}) <= LLM_NUM_REPLICAS "
+                f"({self.num_replicas}) <= LLM_POOL_MAX_REPLICAS "
+                f"({max_n})")
 
     @classmethod
     def from_env(cls) -> "ServerConfig":
@@ -271,6 +328,14 @@ class ServerConfig:
 
             parse_fault_spec(c.fault_spec)
         c.fault_seed = int(os.environ.get("LLM_FAULT_SEED") or c.fault_seed)
+        c.migration = int(os.environ.get("LLM_MIGRATION") or c.migration)
+        c.pool_autoscale = int(
+            os.environ.get("LLM_POOL_AUTOSCALE") or c.pool_autoscale)
+        c.pool_min_replicas = int(
+            os.environ.get("LLM_POOL_MIN_REPLICAS") or c.pool_min_replicas)
+        c.pool_max_replicas = int(
+            os.environ.get("LLM_POOL_MAX_REPLICAS") or c.pool_max_replicas)
+        c._validate_elastic()
         c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
         c.host_cache_gb = float(
             os.environ.get("LLM_HOST_CACHE_GB") or c.host_cache_gb)
@@ -371,6 +436,20 @@ class ServerConfig:
                        help="deterministic fault injection spec (chaos "
                             "testing only), e.g. 'dispatch_error:p=0.05'")
         p.add_argument("--fault-seed", type=int, default=c.fault_seed)
+        p.add_argument("--migration", type=int, default=c.migration,
+                       help="1 = live migration of in-flight streams "
+                            "(drain-and-migrate, SLO rebalance, elastic "
+                            "scale-down; needs --num-replicas >= 2)")
+        p.add_argument("--pool-autoscale", type=int,
+                       default=c.pool_autoscale,
+                       help="1 = telemetry-driven replica autoscaling "
+                            "(needs --migration 1)")
+        p.add_argument("--pool-min-replicas", type=int,
+                       default=c.pool_min_replicas)
+        p.add_argument("--pool-max-replicas", type=int,
+                       default=c.pool_max_replicas,
+                       help="autoscale ceiling (0 = the boot "
+                            "--num-replicas value)")
         p.add_argument("--enable-prefix-caching", dest="prefix_caching",
                        action="store_true", default=c.prefix_caching)
         p.add_argument("--host-cache-gb", type=float, default=c.host_cache_gb,
@@ -401,12 +480,15 @@ class ServerConfig:
                   "prefill_batch_max_len", "prefill_pipeline_chunks",
                   "decode_overlap", "step_trace", "slo_ttft_ms",
                   "slo_itl_ms", "max_queue", "deadline_ms",
-                  "fault_spec", "fault_seed", "prefix_caching",
+                  "fault_spec", "fault_seed", "migration",
+                  "pool_autoscale", "pool_min_replicas",
+                  "pool_max_replicas", "prefix_caching",
                   "host_cache_gb", "hybrid_token_budget",
                   "kv_cache_dtype", "fused_kv_write",
                   "num_blocks", "block_size", "weights_path",
                   "speculation", "spec_tokens", "spec_ngram"):
             setattr(c, f, getattr(a, f))
+        c._validate_elastic()  # re-check after CLI overrides
         if c.host_cache_gb and not c.prefix_caching:
             # The env path validated at parse; re-check after CLI overrides
             # (--host-cache-gb without --enable-prefix-caching).

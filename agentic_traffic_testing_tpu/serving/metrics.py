@@ -310,9 +310,11 @@ class LLMMetrics:
             "per-request deadline_ms body field; cumulative)", registry=r)
         self.request_retries = Gauge(
             f"{prefix}_request_retries_total",
-            "Un-started requests retried once on an alternate replica "
-            "after a dispatch failure (cumulative; 0 without a pool)",
-            registry=r)
+            "Un-started requests retried once on an alternate replica, by "
+            "the reason that triggered the retry (error = dispatch-failure "
+            "terminal, shed = engine-side queue bound; cumulative, 0 "
+            "without a pool; sum over reasons = total retries)",
+            ["reason"], registry=r)
         self.host_restore_fallback = Gauge(
             f"{prefix}_host_restore_fallback_total",
             "Host-tier KV restores that failed (corrupt/missing pages) and "
@@ -328,12 +330,40 @@ class LLMMetrics:
         # num_replicas=1) wins over the always-registered default the
         # other round-9 families follow: health is a property OF replicas.
         self.replica_health = None
+        # Elastic-serving plane (round 11): pool size, scale events, and
+        # live-migration accounting. Pool-scoped by nature (migration
+        # needs a survivor replica; scaling needs a pool), so they follow
+        # the replica-series rule: no family exists at num_replicas=1.
+        self.pool_size = None
+        self.pool_scale_events = None
+        self.migrations = None
+        self.migration_duration = None
         if num_replicas > 1:
             self.replica_health = Gauge(
                 f"{prefix}_replica_health",
                 "Replica health state machine: 1 = healthy, 0.5 = degraded, "
                 "0 = quarantined (router skips quarantined replicas)",
                 ["replica"], registry=r)
+            self.pool_size = Gauge(
+                f"{prefix}_pool_size",
+                "Live replica count (EnginePool.scale_to moves it at "
+                "runtime; boot value = LLM_NUM_REPLICAS)", registry=r)
+            self.pool_scale_events = Gauge(
+                f"{prefix}_pool_scale_events_total",
+                "scale_to calls that changed the pool size (cumulative)",
+                registry=r)
+            self.migrations = Gauge(
+                f"{prefix}_migrations_total",
+                "Live stream migrations by trigger (quarantine = drain-and-"
+                "migrate on a dispatch failure, rebalance = SLO queue-wait "
+                "rebalance, scale_down = replica retirement, drain = "
+                "explicit drain) and status (adopted = resumed on a "
+                "survivor, failed = degraded to the round-9 ERROR "
+                "terminal); cumulative", ["trigger", "status"], registry=r)
+            self.migration_duration = Histogram(
+                f"{prefix}_migration_duration_seconds",
+                "Checkpoint -> adoption handoff wall time per migrated "
+                "stream", buckets=STEP_BUCKETS, registry=r)
         # Pre-touch every label combination so a scrape shows zeroed
         # series (deterministic payload) instead of families appearing
         # only after first traffic.
@@ -347,9 +377,22 @@ class LLMMetrics:
         for reason in ("queue_full", "slo_unattainable",
                        "deadline_unattainable"):
             self.requests_shed.labels(reason=reason)
+        for reason in ("error", "shed"):
+            self.request_retries.labels(reason=reason)
         if self.replica_health is not None:
             for i in range(num_replicas):
                 self.replica_health.labels(replica=str(i))
+        # High-water mark of replica label indices ever rendered; scrape
+        # trims series past the LIVE count (dynamic pool size, round 11).
+        self._replica_label_count = num_replicas
+        if self.migrations is not None:
+            from agentic_traffic_testing_tpu.serving.replica_pool import (
+                MIGRATION_TRIGGERS,
+            )
+
+            for trigger in MIGRATION_TRIGGERS:
+                for status in ("adopted", "failed"):
+                    self.migrations.labels(trigger=trigger, status=status)
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -402,11 +445,29 @@ class LLMMetrics:
         if seen:
             self.batch_occupancy.set(occupancy)
 
+    def _trim_replica_series(self, live_count: int) -> None:
+        """Drop labeled series for replicas the pool retired (round 11:
+        the pool size is dynamic) — without this, a retired replica's
+        last health/load values render forever and the min()-based
+        quarantine alert fires for a replica that no longer exists."""
+        for i in range(live_count, self._replica_label_count):
+            label = str(i)
+            for g in (self.replica_routed, self.replica_waiting,
+                      self.replica_running, self.replica_used_blocks,
+                      self.replica_prefix_hits, self.replica_health):
+                if g is not None:
+                    try:
+                        g.remove(label)
+                    except KeyError:
+                        pass
+        self._replica_label_count = live_count
+
     def set_replica_stats(self, replica_stats: list) -> None:
         """Refresh the per-replica labeled series from EnginePool
         .replica_stats() (called on scrape; no-op without a pool)."""
         if self.replica_routed is None:
             return
+        self._trim_replica_series(len(replica_stats))
         for i, stats in enumerate(replica_stats):
             label = str(i)
             self.replica_routed.labels(replica=label).set(
@@ -437,15 +498,35 @@ class LLMMetrics:
         """One admission rejection (server-side, at shed time)."""
         self.requests_shed.labels(reason=reason).inc()
 
-    def set_robustness_stats(self, *, deadline_expired: int, retries: int,
+    def set_robustness_stats(self, *, deadline_expired: int,
+                             retry_reasons: dict,
                              restore_fallbacks: int,
                              dispatch_failures: int) -> None:
         """Refresh the round-9 cumulative counters from engine/pool state
-        (called on scrape; all zero while the policies never fire)."""
+        (called on scrape; all zero while the policies never fire).
+        `retry_reasons` maps the triggering reason (error | shed) to its
+        cumulative retry count (EnginePool.retry_reasons)."""
         self.deadline_exceeded.set(deadline_expired)
-        self.request_retries.set(retries)
+        for reason in ("error", "shed"):
+            self.request_retries.labels(reason=reason).set(
+                retry_reasons.get(reason, 0))
         self.host_restore_fallback.set(restore_fallbacks)
         self.dispatch_failures.set(dispatch_failures)
+
+    def set_pool_stats(self, *, size: int, scale_events: int,
+                       migrations: dict, durations: list) -> None:
+        """Refresh the elastic-serving families from EnginePool state
+        (called on scrape; no-op without a pool). `migrations` maps
+        (trigger, status) to cumulative counts; `durations` is the
+        drained checkpoint->adoption sample batch."""
+        if self.pool_size is None:
+            return
+        self.pool_size.set(size)
+        self.pool_scale_events.set(scale_events)
+        for (trigger, status), count in migrations.items():
+            self.migrations.labels(trigger=trigger, status=status).set(count)
+        for d in durations:
+            self.migration_duration.observe(d)
 
     def set_replica_health(self, states: list) -> None:
         """Refresh llm_replica_health from EnginePool health states

@@ -30,9 +30,12 @@ Two driving modes, mirroring LLMEngine/AsyncLLMEngine:
 
 from __future__ import annotations
 
+import asyncio
+import contextlib
 import logging
 import threading
 import time
+from collections import deque
 from typing import AsyncIterator, Callable, List, Optional
 
 from agentic_traffic_testing_tpu.runtime.engine import LLMEngine, StepOutput
@@ -52,6 +55,16 @@ log = logging.getLogger("att_tpu.replica_pool")
 HEALTHY = "healthy"
 DEGRADED = "degraded"
 QUARANTINED = "quarantined"
+
+#: migration-trigger label values (llm_migrations_total{trigger}).
+MIGRATION_TRIGGERS = ("quarantine", "rebalance", "scale_down", "drain")
+
+#: a stream that keeps landing on failing replicas re-checkpoints each
+#: time; past this many hops the pool stops migrating and surfaces a
+#: structured ERROR instead (an unbounded ping-pong under a pool-wide
+#: fault would never terminate — and every-replica-broken is not a state
+#: migration can serve through).
+MAX_STREAM_MIGRATIONS = 8
 
 
 class ReplicaHealth:
@@ -264,8 +277,28 @@ class EnginePool:
         self.health = [ReplicaHealth(**(health_params or {}))
                        for _ in self.engines]
         self.request_retries = 0   # retry-once failovers (llm_request_retries_total)
+        # Retry counts by triggering reason (error | shed) — the labeled
+        # llm_request_retries_total series; request_retries stays the sum.
+        self.retry_reasons: dict = {}
+        self._on_step = on_step
+        self._health_params = health_params
         self._async = [AsyncLLMEngine(e, on_step=on_step, health=h)
                        for e, h in zip(self.engines, self.health)]
+        # Elastic-serving state (round 11): the engine factory (set by
+        # build(); a pool constructed from bare engines cannot scale UP),
+        # replicas mid-retirement (excluded from routing while their
+        # streams drain-and-migrate), and the migration/scale accounting
+        # the metrics layer reads on scrape.
+        self._factory: Optional[Callable[[int], LLMEngine]] = None
+        self._started = False
+        self._retiring: set = set()
+        self.scale_events = 0          # scale_to calls that changed the size
+        self.migrations: dict = {}     # (trigger, status) -> cumulative count
+        # checkpoint -> adoption-handoff wall seconds; scrape drains into
+        # the llm_migration_duration_seconds histogram (lock-free deque
+        # contract, the StepClock sample-queue shape).
+        self.migration_durations: deque = deque(maxlen=1024)
+        self._inj = None
         if fault_spec:
             # slow_replica fault point (runtime/faultinject.py): the
             # replica-call-site injection — a per-step sleep on one
@@ -275,9 +308,9 @@ class EnginePool:
                 FaultInjector,
             )
 
-            inj = FaultInjector.from_spec(fault_spec, fault_seed)
+            self._inj = FaultInjector.from_spec(fault_spec, fault_seed)
             for i, a in enumerate(self._async):
-                a.step_delay_s = inj.delay_s(i)
+                a.step_delay_s = self._inj.delay_s(i)
 
     @classmethod
     def build(cls, engine_factory: Callable[[int], LLMEngine],
@@ -308,9 +341,11 @@ class EnginePool:
                 engine.cache = jax.device_put(engine.cache, dev)
                 log.info("replica %d pinned to %s", i, dev)
             engines.append(engine)
-        return cls(engines, policy=policy, on_step=on_step, devices=devices,
+        pool = cls(engines, policy=policy, on_step=on_step, devices=devices,
                    fault_spec=fault_spec, fault_seed=fault_seed,
                    health_params=health_params)
+        pool._factory = engine_factory   # scale_to can add replicas
+        return pool
 
     def __len__(self) -> int:
         return len(self.engines)
@@ -321,14 +356,17 @@ class EnginePool:
     def eligible_replicas(self) -> list[int]:
         """Replica indices the router may place new work on: everything
         not quarantined (the stuck watchdog fires lazily here — a wedged
-        engine thread cannot report on itself). Fails OPEN to all
+        engine thread cannot report on itself) and not mid-retirement
+        (scale_to down marks a replica retiring BEFORE draining it, so no
+        new work lands behind the drain). Fails OPEN to all non-retiring
         replicas when everyone is quarantined: degraded service beats
         refusing the entire pool."""
         now = time.monotonic()
         for h in self.health:
             h.check_stuck(now)
-        ok = [i for i, h in enumerate(self.health) if h.eligible(now)]
-        return ok or list(range(len(self.engines)))
+        live = [i for i in range(len(self.engines)) if i not in self._retiring]
+        ok = [i for i in live if self.health[i].eligible(now)]
+        return ok or live or list(range(len(self.engines)))
 
     # statics: thread(health-probe)
     def health_probe(self) -> int:
@@ -377,11 +415,21 @@ class EnginePool:
 
         Single-threaded convenience for bench/tests — replicas interleave
         on one host thread here, while the async path gives each its own.
-        """
+        MIGRATED terminals (round 11: a drain-and-migrate fired inside a
+        replica's _fail_dispatch) are adopted onto a survivor inline, so
+        sync callers see the same elasticity the async pool serves — the
+        adopted stream's remaining tokens arrive under the SAME request_id
+        in later steps' events."""
         events: list[StepOutput] = []
-        for e in self.engines:
-            if e.has_work():
-                events.extend(e.step())
+        for i, e in enumerate(self.engines):
+            if not e.has_work():
+                continue
+            evs = e.step()
+            for ev in evs:
+                if (ev.finished
+                        and ev.request.finish_reason is FinishReason.MIGRATED):
+                    self._adopt_sync(ev.request, source=i)
+            events.extend(evs)
         return events
 
     def has_work(self) -> bool:
@@ -402,11 +450,13 @@ class EnginePool:
 
     # statics: thread(handler)
     def start(self) -> None:
+        self._started = True
         for a in self._async:
             a.start()
 
     # statics: thread(handler)
     def shutdown(self) -> None:
+        self._started = False
         for a in self._async:
             a.shutdown()
 
@@ -426,40 +476,338 @@ class EnginePool:
         alternate replica — un-started work is side-effect-free to move,
         and the wait-queue bound is PER-replica, so a shed on one full
         replica says nothing about a less-loaded survivor (under global
-        overload the retry sheds again and the 503 surfaces). A stream
-        that already emitted tokens never retries (replaying tokens
-        silently would corrupt the client's text); its terminal error
-        passes through and the client decides. Deadline terminals never
-        retry (the wall clock moves with the request)."""
+        overload the retry sheds again and the 503 surfaces). The
+        terminal the client sees is always from the attempt that actually
+        RAN LAST — a retry that sheds surfaces the shed, not the original
+        error. Deadline terminals never retry (the wall clock moves with
+        the request).
+
+        Live migration (round 11): a MIGRATED terminal (the owning
+        replica checkpointed the stream — drain-and-migrate on a dispatch
+        failure, an SLO rebalance, or a scale-down drain) never reaches
+        the client. Its drained tokens are delivered as a normal
+        increment, the plan is adopted on the least-loaded eligible
+        survivor, and the stream continues from the target — started
+        streams now MOVE where round 9 could only kill them. No survivor
+        (or a stream past MAX_STREAM_MIGRATIONS hops) degrades to the
+        round-9 structured ERROR terminal."""
         idx = self.route(prompt_ids, request_id)
         tried = [idx]
+        emitted = False
+        source = self._async[idx].generate(prompt_ids, sampling, request_id)
         while True:
-            emitted = False
-            retry_ev: Optional[TokenEvent] = None
-            async for ev in self._async[idx].generate(prompt_ids, sampling,
-                                                      request_id):
+            terminal: Optional[TokenEvent] = None
+            async for ev in source:
                 if ev.new_token_ids:
+                    # BEFORE the terminal check: drained tokens can ride
+                    # a terminal event, and a stream that delivered any
+                    # token is STARTED — it must never retry (the
+                    # terminal below carries those tokens to the client).
                     emitted = True
-                if (ev.finished and not emitted and len(tried) == 1
-                        and ev.request.finish_reason in (FinishReason.ERROR,
-                                                         FinishReason.SHED)
-                        and len(self.engines) > 1):
-                    retry_ev = ev
+                if ev.finished:
+                    terminal = ev
                     break
                 yield ev
-                if ev.finished:
-                    return
-            if retry_ev is None:
+            if terminal is None:
                 return  # defensive: stream ended without a terminal event
-            alt = self._alternate(tried)
-            if alt is None:
-                yield retry_ev  # no survivor to retry on: surface the error
-                return
-            self.request_retries += 1
-            log.warning("request %s failed un-started on replica %d; "
-                        "retrying once on replica %d", request_id, idx, alt)
-            idx = alt
-            tried.append(alt)
+            fr = terminal.request.finish_reason
+            if fr is FinishReason.MIGRATED:
+                if terminal.new_token_ids:
+                    # Tokens drained at checkpoint belong to the client;
+                    # deliver them before resuming elsewhere.
+                    emitted = True
+                    yield TokenEvent(list(terminal.new_token_ids), False,
+                                     terminal.request)
+                target = self._adoption_target(terminal.request, idx)
+                if target is None:
+                    # Degraded in place to the round-9 structured ERROR.
+                    yield TokenEvent([], True, terminal.request)
+                    return
+                idx = target
+                source = self._async[idx].adopt(terminal.request.migration)
+                continue
+            if (not emitted and len(tried) == 1
+                    and fr in (FinishReason.ERROR, FinishReason.SHED)
+                    and len(self.engines) > 1):
+                alt = self._alternate(tried)
+                if alt is not None:
+                    self.request_retries += 1
+                    reason = ("shed" if fr is FinishReason.SHED else "error")
+                    self.retry_reasons[reason] = (
+                        self.retry_reasons.get(reason, 0) + 1)
+                    log.warning("request %s failed un-started on replica "
+                                "%d (%s); retrying once on replica %d",
+                                request_id, idx, reason, alt)
+                    idx = alt
+                    tried.append(alt)
+                    source = self._async[idx].generate(prompt_ids, sampling,
+                                                       request_id)
+                    continue
+            yield terminal
+            return
+
+    # -- live migration + elastic pool (round 11) --------------------------
+
+    @property
+    def migration_enabled(self) -> bool:
+        """Engines were built with cfg.migration=1 (replicas share cfg)."""
+        return bool(self.engines and self.engines[0].cfg.migration)
+
+    # statics: thread(handler)
+    def _record_migration(self, trigger: str, status: str,
+                          duration_s: Optional[float] = None) -> None:
+        """Migration accounting (llm_migrations_total{trigger,status} +
+        the duration histogram's sample queue). Single-writer on the
+        event loop; sync bench/test drives are single-threaded."""
+        key = (trigger, status)
+        self.migrations[key] = self.migrations.get(key, 0) + 1
+        if duration_s is not None:
+            self.migration_durations.append(duration_s)
+
+    @staticmethod
+    def _drain(dq: deque) -> list:
+        out = []
+        while True:
+            try:
+                out.append(dq.popleft())
+            except IndexError:
+                return out
+
+    # statics: thread(scrape)
+    def drain_migration_durations(self) -> list[float]:
+        """Pop the queued migration-duration samples (scrape-side drain,
+        lock-free deque contract like StepClock's sample queues)."""
+        return self._drain(self.migration_durations)
+
+    # statics: thread(handler)
+    def _adoption_target(self, req: Request, source: int) -> Optional[int]:
+        """The adopt-or-degrade policy shared by the async generate loop
+        and sync-mode adoption: pick the least-loaded eligible survivor
+        for a MIGRATED request's plan and record the migration
+        ("adopted" = handed to a survivor for resumption; the adopt
+        itself degrades internally to recompute — or, belt-and-braces,
+        a structured ERROR — never silently). None = no survivor or the
+        stream is past its hop bound — the terminal has been degraded
+        IN PLACE to the round-9 structured ERROR (and the failure
+        recorded), so no caller ever sees a MIGRATED terminal it cannot
+        resume."""
+        plan = req.migration
+        target = None
+        if plan is not None and plan.hops <= MAX_STREAM_MIGRATIONS:
+            target = self._alternate([source])
+        if target is None:
+            trig = plan.trigger if plan is not None else "drain"
+            self._record_migration(trig, "failed")
+            req.finish_reason = FinishReason.ERROR
+            req.error = (req.error
+                         or "migration failed: no eligible survivor replica")
+            return None
+        plan.source_replica = source
+        self._record_migration(plan.trigger, "adopted",
+                               time.monotonic() - plan.created_t)
+        log.info("request %s migrating (%s) from replica %d to %d at %d "
+                 "tokens", plan.request_id, plan.trigger, source, target,
+                 plan.sampling_step)
+        return target
+
+    # statics: thread(handler)
+    def _adopt_sync(self, req: Request, source: int) -> bool:
+        """Sync-mode adoption (bench/tests, scale_to): resume a MIGRATED
+        request on the survivor the shared policy picks, so sync callers
+        see a terminated-or-resumed stream, never a vanished one."""
+        target = self._adoption_target(req, source)
+        if target is None:
+            return False
+        self.engines[target].adopt_request(req.migration)
+        return True
+
+    # statics: thread(health-probe)
+    def maybe_rebalance(self, wait_per_slot: Optional[float],
+                        slo_ttft_ms: float) -> int:
+        """SLO rebalance trigger (round 11): when one replica's projected
+        queue wait (its per-slot wait EWMA x queue depth) blows the TTFT
+        SLO class while another replica sits idle, ask the hot replica to
+        checkpoint its NEWEST started stream — the pool adopts it on the
+        idle survivor through the normal MIGRATED flow. One stream per
+        tick: gradual rebalance beats a thundering drain. Returns how
+        many drains were requested (0 or 1). Called from the server's
+        health-probe loop; requires migration + an SLO class."""
+        if (not self.migration_enabled or wait_per_slot is None
+                or slo_ttft_ms <= 0 or len(self.engines) < 2):
+            return 0
+        eligible = set(self.eligible_replicas())
+        hot = idle = None
+        hot_wait = 0.0
+        idle_depth = None
+        for i, e in enumerate(self.engines):
+            s = e.load_snapshot()
+            depth = s["num_waiting"] + s["num_running"]
+            proj_ms = wait_per_slot * s["num_waiting"] * 1000.0
+            # An idle target needs an empty queue AND a free seat: a
+            # full-seat replica would refuse the transplant and the
+            # stream would degrade to a whole-history recompute — worse
+            # than leaving it decoding where it is.
+            if (i in eligible and s["num_waiting"] == 0
+                    and s["num_running"] < s["max_num_seqs"]
+                    and (idle_depth is None or depth < idle_depth)):
+                idle, idle_depth = i, depth
+            if proj_ms > slo_ttft_ms and proj_ms > hot_wait:
+                hot, hot_wait = i, proj_ms
+        if hot is None or idle is None or hot == idle:
+            return 0
+        self._async[hot].request_drain(1, "rebalance")
+        return 1
+
+    # statics: thread(handler)
+    def scale_to(self, n: int) -> list[StepOutput]:
+        """Resize the pool at runtime — SYNC driving mode (bench/tests;
+        the serving layer uses scale_to_async). Removal retires replicas
+        from the END: mark retiring (no new routes), drain-and-migrate
+        every live stream onto survivors, then drop the replica — so the
+        surviving indices are unchanged and rendezvous routing (which
+        scores by ORIGINAL index) keeps every remaining replica's keys;
+        a later scale-up re-creates index i and reclaims exactly the keys
+        index i owned before. Returns the drain events (MIGRATED
+        terminals included, already adopted or degraded)."""
+        if n < 1:
+            raise ValueError(f"pool size must be >= 1, got {n}")
+        if self._started:
+            # A started pool's engine threads own their engines — a drain
+            # from this thread would race them, and the drained terminals
+            # would never reach the async streams (double-adoption on the
+            # pool.generate side). The async variant drains through the
+            # engine threads themselves.
+            raise RuntimeError(
+                "scale_to is the sync-driving API; a started pool must "
+                "use scale_to_async")
+        n0 = len(self.engines)
+        events: list[StepOutput] = []
+        while len(self.engines) > n:
+            idx = len(self.engines) - 1
+            self._retiring.add(idx)
+            try:
+                evs = self.engines[idx].drain_for_migration("scale_down")
+                for ev in evs:
+                    if (ev.finished and ev.request.finish_reason
+                            is FinishReason.MIGRATED):
+                        self._adopt_sync(ev.request, source=idx)
+                events.extend(evs)
+            finally:
+                self._retiring.discard(idx)
+            self._pop_replica(idx)
+        while len(self.engines) < n:
+            self._append_replica()
+        self.router = make_router(self.policy, self.engines)
+        if len(self.engines) != n0:
+            self.scale_events += 1
+        log.info("pool scaled to %d replica(s)", len(self.engines))
+        return events
+
+    # statics: thread(handler)
+    async def scale_to_async(self, n: int,
+                             drain_timeout_s: float = 10.0) -> None:
+        """scale_to for the live serving path: engine builds run in an
+        executor (a cold build must not stall the event loop) and
+        scale-down drains are awaited — the retiring replica's engine
+        thread checkpoints its streams, the pool's generate() coroutines
+        adopt them on survivors, and only then is the replica retired. A
+        drain that exceeds `drain_timeout_s` falls back to shutdown (the
+        async engine's fail-all terminals keep every stream terminated)."""
+        if n < 1:
+            raise ValueError(f"pool size must be >= 1, got {n}")
+        n0 = len(self.engines)
+        loop = asyncio.get_running_loop()
+        while len(self.engines) < n:
+            # Build off the loop (a cold engine build must not stall live
+            # handlers), attach ON the loop with no await in between —
+            # routing never observes the replica lists mid-grow.
+            built = await loop.run_in_executor(
+                None, self._build_replica, len(self.engines))
+            self._attach_replica(*built)
+            self.router = make_router(self.policy, self.engines)
+        while len(self.engines) > n:
+            idx = len(self.engines) - 1
+            self._retiring.add(idx)
+            try:
+                deadline = time.monotonic() + drain_timeout_s
+                while time.monotonic() < deadline:
+                    a = self._async[idx]
+                    if (not self.engines[idx].has_work()
+                            and not a._streams and a._submit_q.empty()):
+                        break
+                    # Re-request each tick: admissions already queued when
+                    # retirement began drain too.
+                    a.request_drain(None, "scale_down")
+                    await asyncio.sleep(0.05)
+                # shutdown() joins the engine thread (up to 5 s if it is
+                # mid-step — possibly the reason it is being retired):
+                # off the loop, so live streams keep flowing meanwhile.
+                await loop.run_in_executor(None, self._async[idx].shutdown)
+            finally:
+                self._retiring.discard(idx)
+            self._pop_replica(idx)
+        self.router = make_router(self.policy, self.engines)
+        if len(self.engines) != n0:
+            self.scale_events += 1
+        log.info("pool scaled to %d replica(s)", len(self.engines))
+
+    def _build_replica(self, i: int):
+        """Build one replica's engine for ORIGINAL index `i` (the
+        rendezvous slot it reclaims) — the EXPENSIVE half (model init,
+        program compiles), safe to run off the event loop because it
+        touches no pool state. Returns (engine, device)."""
+        if self._factory is None:
+            raise RuntimeError(
+                "this pool was constructed from bare engines — only pools "
+                "built via EnginePool.build(engine_factory, ...) can scale "
+                "up")
+        import jax
+
+        dev = replica_devices(i + 1)[i]
+        ctx = (jax.default_device(dev) if dev is not None
+               else contextlib.nullcontext())
+        with ctx:
+            engine = self._factory(i)
+        if dev is not None:
+            engine.runner.params = jax.device_put(engine.runner.params, dev)
+            engine.cache = jax.device_put(engine.cache, dev)
+            log.info("replica %d pinned to %s", i, dev)
+        return engine, dev
+
+    # statics: thread(handler)
+    def _attach_replica(self, engine: LLMEngine, dev) -> None:
+        """Attach a built replica to the pool's routing lists — the
+        CHEAP half, run on the event loop (sync drives: the one driver
+        thread) with no awaits, so handlers never observe the lists
+        mid-grow (the ownership registry declares them handler-owned).
+        Started pools start the engine thread immediately; the caller
+        rebuilds the router."""
+        i = len(self.engines)
+        h = ReplicaHealth(**(self._health_params or {}))
+        a = AsyncLLMEngine(engine, on_step=self._on_step, health=h)
+        if self._inj is not None:
+            a.step_delay_s = self._inj.delay_s(i)
+        # routed_requests grows FIRST: eligible_replicas/route key off
+        # len(engines), so the counter slot must exist before the index.
+        self.routed_requests.append(0)
+        self.engines.append(engine)
+        self.health.append(h)
+        self._async.append(a)
+        self.devices.append(dev)
+        if self._started:
+            a.start()
+
+    # statics: thread(handler)
+    def _append_replica(self) -> None:
+        self._attach_replica(*self._build_replica(len(self.engines)))
+
+    # statics: thread(handler)
+    def _pop_replica(self, idx: int) -> None:
+        self.engines.pop(idx)
+        self.health.pop(idx)
+        self._async.pop(idx)
+        self.devices.pop(idx)
+        self.routed_requests.pop(idx)
 
     # -- aggregation (metrics layer) ---------------------------------------
 

@@ -93,6 +93,11 @@ class LLMServer:
 
     def __init__(self, cfg: ServerConfig, engine: Optional[LLMEngine] = None) -> None:
         self.cfg = cfg
+        # Re-checked here (not only at env/CLI parse) so a directly
+        # constructed config cannot build a single-engine server with
+        # migration on — a MIGRATED terminal with no pool to adopt it
+        # would surface an internal finish reason to clients.
+        cfg._validate_elastic()
         self.tokenizer = load_tokenizer(cfg.weights_path or cfg.model)
         self.model_loaded = False  # set by _load_params on checkpoint load
         self.metrics = (
@@ -195,6 +200,7 @@ class LLMServer:
         self._ctx_window: deque[int] = deque(maxlen=256)
         self._probe_task: Optional[asyncio.Task] = None
         self._health_task: Optional[asyncio.Task] = None
+        self._autoscale_task: Optional[asyncio.Task] = None
         # EWMA of measured queue wait per queue slot (seconds), fed by
         # finished requests: the SLO-aware shedding projection
         # (`_admission_check`) multiplies it by the live queue depth —
@@ -266,6 +272,7 @@ class LLMServer:
             slo_itl_ms=c.slo_itl_ms,
             max_queue=c.max_queue,
             deadline_ms=c.deadline_ms,
+            migration=c.migration,
             fault_spec=c.fault_spec,
             # Replicas must not fault in lockstep: each gets its own
             # deterministic stream (the pool's slow_replica wiring keys
@@ -645,11 +652,16 @@ class LLMServer:
             mispredicts=getattr(source, "num_overlap_mispredicts", 0))
         self.metrics.set_robustness_stats(
             deadline_expired=getattr(source, "num_deadline_expired", 0),
-            retries=getattr(source, "request_retries", 0),
+            retry_reasons=getattr(source, "retry_reasons", {}),
             restore_fallbacks=getattr(source, "num_restore_fallbacks", 0),
             dispatch_failures=getattr(source, "num_dispatch_failures", 0))
         self.metrics.observe_step_clock(self._recorders())
         if self.pool is not None:
+            self.metrics.set_pool_stats(
+                size=len(self.pool),
+                scale_events=self.pool.scale_events,
+                migrations=self.pool.migrations,
+                durations=self.pool.drain_migration_durations())
             # One health/watchdog pass per scrape: replica_stats() already
             # folds replica_health_states() in, and a second pass could
             # disagree with the first within a single payload.
@@ -1214,27 +1226,72 @@ class LLMServer:
                     # lapses (serving/replica_pool.ReplicaHealth).
                     self._health_task = asyncio.ensure_future(
                         self._health_probe_loop())
+                if self.pool is not None and self.cfg.pool_autoscale:
+                    # Telemetry-driven elastic pool (round 11): the
+                    # controller watches SLO attainment + queue depth and
+                    # resizes the pool between the configured bounds;
+                    # scale-down drains migrate started streams.
+                    from agentic_traffic_testing_tpu.serving.autoscale import (
+                        AutoscaleController,
+                        AutoscalePolicy,
+                    )
+
+                    pol = AutoscalePolicy(
+                        min_replicas=self.cfg.pool_min_replicas,
+                        max_replicas=(self.cfg.pool_max_replicas
+                                      or self.cfg.num_replicas))
+                    self._autoscale_task = asyncio.ensure_future(
+                        AutoscaleController(
+                            self.pool, pol,
+                            read_slo_counts=self._slo_counts).run())
 
             async def _stop(app):
                 if self._probe_task:
                     self._probe_task.cancel()
                 if self._health_task:
                     self._health_task.cancel()
+                if self._autoscale_task:
+                    self._autoscale_task.cancel()
                 self.async_engine.shutdown()
 
             app.on_startup.append(_start)
             app.on_cleanup.append(_stop)
         return app
 
+    def _slo_counts(self) -> tuple[int, int]:
+        """Cumulative (met, violated) TTFT-SLO verdicts from the metrics
+        plane — the autoscale controller differences consecutive reads.
+        (0, 0) without metrics or before any verdict."""
+        if self.metrics is None:
+            return (0, 0)
+        try:
+            met = self.metrics.slo_attainment.labels(
+                slo="ttft", status="met")._value.get()
+            violated = self.metrics.slo_attainment.labels(
+                slo="ttft", status="violated")._value.get()
+            return (int(met), int(violated))
+        except Exception:
+            return (0, 0)
+
     # statics: thread(health-probe)
     async def _health_probe_loop(self) -> None:
-        """Periodic quarantined-replica re-admission (pool only)."""
+        """Periodic quarantined-replica re-admission (pool only), plus the
+        round-11 SLO rebalance trigger: a replica whose projected queue
+        wait (per-slot EWMA x depth) blows the TTFT SLO class while
+        another replica idles checkpoints its newest started stream onto
+        the idle one."""
         try:
             while True:
                 await asyncio.sleep(HEALTH_PROBE_INTERVAL_S)
                 n = self.pool.health_probe()
                 if n:
                     log.info("health probe re-admitted %d replica(s)", n)
+                if self.cfg.migration and self.cfg.slo_ttft_ms:
+                    n = self.pool.maybe_rebalance(self._wait_per_slot,
+                                                  self.cfg.slo_ttft_ms)
+                    if n:
+                        log.info("SLO rebalance requested %d stream "
+                                 "migration(s)", n)
         except asyncio.CancelledError:
             pass
 

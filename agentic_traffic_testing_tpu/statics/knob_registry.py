@@ -124,6 +124,21 @@ KNOBS: tuple[Knob, ...] = (
     Knob("LLM_FAULT_SEED", "int", "0", "serving/config.py",
          "Seed for the per-point fault-injection RNG streams (replica i "
          "offsets by +i)."),
+    Knob("LLM_MIGRATION", "int", "0", "serving/config.py",
+         "1 = live migration of in-flight streams (round 11): checkpoint "
+         "decode state + KV pages and resume on a survivor replica, "
+         "token-identical — drain-and-migrate on dispatch failures, SLO "
+         "rebalance, elastic scale-down. Needs LLM_NUM_REPLICAS >= 2; "
+         "0 keeps the round-9 kill-path behavior byte-identical."),
+    Knob("LLM_POOL_AUTOSCALE", "int", "0", "serving/config.py",
+         "1 = telemetry-driven replica autoscaling (serving/autoscale.py "
+         "watching SLO attainment + queue depth, scaling between the "
+         "MIN/MAX bounds); needs LLM_MIGRATION=1. 0 = fixed pool."),
+    Knob("LLM_POOL_MIN_REPLICAS", "int", "1", "serving/config.py",
+         "Autoscale floor on the live replica count."),
+    Knob("LLM_POOL_MAX_REPLICAS", "int", "0", "serving/config.py",
+         "Autoscale ceiling on the live replica count (0 = the boot "
+         "LLM_NUM_REPLICAS value)."),
     Knob("LLM_CONCURRENCY_CHECK", "bool", "0", "runtime/concurrency.py",
          "1 installs runtime thread-ownership assertions compiled from "
          "statics/ownership_registry.py (docs/threading.md); 0 = no "

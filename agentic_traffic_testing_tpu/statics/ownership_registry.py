@@ -81,6 +81,7 @@ REGISTERED_CLASSES = {
     "ReplicaHealth":
         "agentic_traffic_testing_tpu.serving.replica_pool:ReplicaHealth",
     "LLMServer": "agentic_traffic_testing_tpu.serving.server:LLMServer",
+    "LLMMetrics": "agentic_traffic_testing_tpu.serving.metrics:LLMMetrics",
     "StepClock": "agentic_traffic_testing_tpu.runtime.telemetry:StepClock",
     "HostKVStore":
         "agentic_traffic_testing_tpu.runtime.kv_offload:HostKVStore",
@@ -183,6 +184,34 @@ OWNED_ATTRS: tuple[OwnedAttr, ...] = (
               "event loop (sync bench drives are single-threaded)"),
     OwnedAttr("EnginePool", "request_retries", HANDLER,
               "", "retry-once failovers (scrape reads)"),
+    OwnedAttr("EnginePool", "retry_reasons", HANDLER,
+              "", "retry counts by triggering reason (scrape reads)"),
+    # Elastic pool (round 11): the replica lists are resized ONLY by
+    # scale_to/scale_to_async on the event loop (sync bench drives are
+    # single-threaded); every other context reads them via snapshots.
+    OwnedAttr("EnginePool", "engines", HANDLER,
+              "", "replica engine list (scale_to appends/pops at the end)"),
+    OwnedAttr("EnginePool", "health", HANDLER,
+              "", "per-replica health machines (scale_to resizes)"),
+    OwnedAttr("EnginePool", "_async", HANDLER,
+              "", "per-replica AsyncLLMEngine wrappers (scale_to resizes)"),
+    OwnedAttr("EnginePool", "devices", HANDLER,
+              "", "per-replica device pins (scale_to resizes)"),
+    OwnedAttr("EnginePool", "router", HANDLER,
+              "", "routing policy instance, rebuilt after every resize"),
+    OwnedAttr("EnginePool", "_retiring", HANDLER,
+              "", "replica indices mid-retirement (excluded from routing "
+              "while their streams drain-and-migrate)"),
+    OwnedAttr("EnginePool", "_started", HANDLER,
+              "", "start()/shutdown() latch (new replicas start their "
+              "engine thread iff the pool is live)"),
+    OwnedAttr("EnginePool", "scale_events", HANDLER,
+              "", "scale_to calls that changed the size (scrape reads)"),
+    OwnedAttr("EnginePool", "migrations", HANDLER,
+              "", "(trigger, status) -> migration counts (scrape reads)"),
+    OwnedAttr("EnginePool", "migration_durations", HANDLER,
+              "", "checkpoint->adoption duration sample queue (scrape "
+              "drains; lock-free deque contract)"),
     # -- ReplicaHealth (serving/replica_pool.py) -------------------------
     # Written from three contexts by design (engine-thread step outcomes,
     # routing-path watchdog, background probe): every transition holds
@@ -212,11 +241,17 @@ OWNED_ATTRS: tuple[OwnedAttr, ...] = (
               "", "concurrency-probe task handle (startup/cleanup)"),
     OwnedAttr("LLMServer", "_health_task", HANDLER,
               "", "health-probe task handle (startup/cleanup)"),
+    OwnedAttr("LLMServer", "_autoscale_task", HANDLER,
+              "", "pool-autoscale controller task handle (startup/cleanup)"),
     OwnedAttr("LLMServer", "model_loaded", INIT,
               "", "checkpoint-vs-random flag set during engine build"),
     OwnedAttr("LLMServer", "_ctx_window", HANDLER,
               "", "finished-request context lengths feeding the "
               "concurrency probe (bounded deque; probe task reads)"),
+    # -- LLMMetrics (serving/metrics.py) ---------------------------------
+    OwnedAttr("LLMMetrics", "_replica_label_count", SCRAPE,
+              "", "high-water mark of replica label indices rendered; "
+              "scrape trims retired replicas' series past the live count"),
     # -- StepClock (runtime/telemetry.py) --------------------------------
     OwnedAttr("StepClock", "_seq", "", "_lock",
               "step-record sequence number"),

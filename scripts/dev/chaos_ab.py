@@ -174,6 +174,168 @@ def run_restore_section(*, runner, model_cfg, model: str,
     }
 
 
+def _pool_workload(model_cfg, n_requests: int, prompt_len: int,
+                   max_tokens: int):
+    """Deterministic churn workload shared by the migration/scale arms:
+    mixed greedy + seeded sampling, mixed stop lengths."""
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+    wl = np.random.default_rng(41)
+    vocab = model_cfg.vocab_size
+    prompts = [wl.integers(10, vocab - 10, prompt_len).tolist()
+               for _ in range(n_requests)]
+
+    def sampling(i: int) -> SamplingParams:
+        if i % 2 == 0:
+            return SamplingParams(temperature=0.0,
+                                  max_tokens=max_tokens - (i % 3),
+                                  ignore_eos=True)
+        return SamplingParams(temperature=0.8, top_k=20, seed=5 + i,
+                              max_tokens=max_tokens // 2 + (i % 4),
+                              ignore_eos=True)
+
+    return prompts, sampling
+
+
+def _drive_pool(pool, prompts, sampling, step_cap: int,
+                scale_script=None) -> dict:
+    """Sync-drive a pool to completion, tracking each request's FINAL
+    terminal (a migrated stream's later events carry a NEW Request object
+    under the same request_id). `scale_script` maps a step index to a
+    pool size (the scale-churn arm's oscillation)."""
+    from agentic_traffic_testing_tpu.runtime.request import FinishReason
+
+    reqs = [pool.add_request(p, sampling(i), request_id=f"m{i}")
+            for i, p in enumerate(prompts)]
+    finals = {r.request_id: r for r in reqs}
+    steps = 0
+    while pool.has_work() and steps < step_cap:
+        if scale_script and steps in scale_script:
+            for ev in pool.scale_to(scale_script[steps]):
+                cur = finals.get(ev.request.request_id)
+                if cur is None or ev.request.sampling_step >= cur.sampling_step:
+                    finals[ev.request.request_id] = ev.request
+        for ev in pool.step():
+            cur = finals.get(ev.request.request_id)
+            if cur is None or ev.request.sampling_step >= cur.sampling_step:
+                finals[ev.request.request_id] = ev.request
+        steps += 1
+    done = {rid: r for rid, r in finals.items()
+            if r.finish_reason in (FinishReason.STOP, FinishReason.LENGTH)}
+    return {
+        "steps": steps,
+        "all_terminated": all(r.is_finished() for r in finals.values()),
+        "completed": len(done),
+        "errored": sum(1 for r in finals.values()
+                       if r.finish_reason is FinishReason.ERROR),
+        "outputs": {rid: r.generated_ids for rid, r in done.items()},
+    }
+
+
+def run_migration_soak(*, runner, model_cfg, model: str, dtype: str,
+                       n_requests: int, prompt_len: int,
+                       max_tokens: int) -> dict:
+    """Round-11 migration soak: the same churn workload runs clean on a
+    2-replica pool, then with dispatch faults injected on replica 0 and
+    LLM_MIGRATION on — started streams checkpoint mid-decode and resume
+    on the survivor. Gates: every stream terminates, at least one stream
+    migrated, and every COMPLETED stream's tokens are byte-identical to
+    the clean run's (the ISSUE-11 acceptance criterion)."""
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.serving.replica_pool import EnginePool
+
+    block_size = 16
+    max_len = max(256, prompt_len + max_tokens + 64)
+
+    def eng(spec: str) -> LLMEngine:
+        return LLMEngine(EngineConfig(
+            model=model, dtype=dtype, max_num_seqs=4, max_model_len=max_len,
+            block_size=block_size,
+            num_blocks=max(256, 8 * (-(-max_len // block_size) + 4)),
+            migration=1, fault_spec=spec, fault_seed=17,
+        ), model_cfg=model_cfg, runner=runner)
+
+    prompts, sampling = _pool_workload(model_cfg, n_requests, prompt_len,
+                                       max_tokens)
+    clean_pool = EnginePool([eng(""), eng("")], policy="round_robin")
+    clean = _drive_pool(clean_pool, prompts, sampling,
+                        step_cap=400 * n_requests)
+    chaos_pool = EnginePool([eng("dispatch_error:p=0.15"), eng("")],
+                            policy="round_robin")
+    chaos = _drive_pool(chaos_pool, prompts, sampling,
+                        step_cap=400 * n_requests)
+    migrated = sum(v for (t, s), v in chaos_pool.migrations.items()
+                   if s == "adopted")
+    identical = all(chaos["outputs"][rid] == clean["outputs"].get(rid)
+                    for rid in chaos["outputs"])
+    return {
+        "mode": "migration_soak",
+        "requests": n_requests,
+        "clean_completed": clean["completed"],
+        "chaos_completed": chaos["completed"],
+        "chaos_errored": chaos["errored"],
+        "migrations_adopted": migrated,
+        "migrations": {f"{t}:{s}": v
+                       for (t, s), v in chaos_pool.migrations.items()},
+        "all_terminated": clean["all_terminated"] and chaos["all_terminated"],
+        "migrated_identical": identical,
+    }
+
+
+def run_scale_churn(*, runner, model_cfg, model: str, dtype: str,
+                    n_requests: int, prompt_len: int,
+                    max_tokens: int) -> dict:
+    """Round-11 scale-churn soak: the clean workload runs on a fixed
+    2-replica pool, then again under scale_to oscillation (2 → 3 → 1 → 2
+    mid-traffic; scale-downs drain-and-migrate live streams). Gates:
+    every stream terminates, completions are byte-identical to the fixed
+    run, and the pool lands on the scripted final size."""
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.serving.replica_pool import EnginePool
+
+    block_size = 16
+    max_len = max(256, prompt_len + max_tokens + 64)
+
+    def factory(i: int) -> LLMEngine:
+        return LLMEngine(EngineConfig(
+            model=model, dtype=dtype, max_num_seqs=4, max_model_len=max_len,
+            block_size=block_size,
+            num_blocks=max(256, 8 * (-(-max_len // block_size) + 4)),
+            migration=1,
+        ), model_cfg=model_cfg, runner=runner)
+
+    prompts, sampling = _pool_workload(model_cfg, n_requests, prompt_len,
+                                       max_tokens)
+    clean = _drive_pool(EnginePool.build(factory, 2), prompts, sampling,
+                        step_cap=400 * n_requests)
+    pool = EnginePool.build(factory, 2)
+    churn = _drive_pool(pool, prompts, sampling,
+                        step_cap=400 * n_requests,
+                        scale_script={2: 3, 5: 1, 9: 2})
+    identical = all(churn["outputs"][rid] == clean["outputs"].get(rid)
+                    for rid in churn["outputs"])
+    return {
+        "mode": "scale_churn",
+        "requests": n_requests,
+        "clean_completed": clean["completed"],
+        "churn_completed": churn["completed"],
+        "scale_events": pool.scale_events,
+        "final_size": len(pool),
+        "migrations": {f"{t}:{s}": v
+                       for (t, s), v in pool.migrations.items()},
+        "all_terminated": clean["all_terminated"] and churn["all_terminated"],
+        "churn_identical": identical,
+    }
+
+
 def main(argv=None) -> list[dict]:
     argv = [int(a) for a in (argv if argv is not None else sys.argv[1:])]
     n_requests = argv[0] if len(argv) > 0 else 8
@@ -222,6 +384,13 @@ def main(argv=None) -> list[dict]:
                                   model=model, dtype=dtype)
     print(json.dumps(restore), flush=True)
     results.append(restore)
+    soak_common = dict(runner=runner, model_cfg=model_cfg, model=model,
+                       dtype=dtype, n_requests=n_requests,
+                       prompt_len=prompt_len, max_tokens=max_tokens)
+    for section in (run_migration_soak, run_scale_churn):
+        r = section(**soak_common)
+        print(json.dumps(r), flush=True)
+        results.append(r)
     return results
 
 

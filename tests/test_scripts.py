@@ -341,12 +341,15 @@ def test_chaos_ab_smoke(monkeypatch):
     completes everything, the chaos arm injects at least one dispatch
     fault yet every request terminates and the surviving completions are
     token-identical to the clean arm; the restore section degrades a
-    fault-injected host-tier restore to a byte-identical recompute
+    fault-injected host-tier restore to a byte-identical recompute; the
+    round-11 migration-soak arm checkpoints quarantine-interrupted
+    streams onto the survivor token-identically; the scale-churn arm
+    oscillates the pool size under load with identical completions
     (in-process for the warm jax/conftest CPU config, like router_ab)."""
     monkeypatch.setenv("CHAOS_AB_MODEL", "tiny")
     monkeypatch.setenv("CHAOS_AB_SEATS", "4")
     chaos_ab = load_script("scripts/dev/chaos_ab.py", "chaos_ab")
-    clean, chaos, restore = chaos_ab.main(["8", "24", "10"])
+    clean, chaos, restore, soak, churn = chaos_ab.main(["8", "24", "10"])
     assert (clean["mode"], chaos["mode"]) == ("clean", "chaos")
     assert clean["completed"] == 8 and clean["dispatch_failures"] == 0
     assert chaos["dispatch_failures"] >= 1
@@ -357,6 +360,14 @@ def test_chaos_ab_smoke(monkeypatch):
     assert restore["fallbacks"] >= 1
     assert restore["clean_restores_fell_back"] == 0
     assert restore["outputs_match"] is True
+    assert soak["mode"] == "migration_soak"
+    assert soak["all_terminated"] and soak["migrations_adopted"] >= 1
+    assert soak["migrated_identical"] is True
+    assert soak["clean_completed"] == 8
+    assert churn["mode"] == "scale_churn"
+    assert churn["all_terminated"] and churn["churn_identical"] is True
+    assert churn["scale_events"] == 3 and churn["final_size"] == 2
+    assert churn["migrations"].get("scale_down:adopted", 0) >= 1
 
 
 # ------------------------------------------------ step-clock timeline dump
