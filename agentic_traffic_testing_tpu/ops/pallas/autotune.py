@@ -56,9 +56,14 @@ import jax.numpy as jnp
 _BENCH_ITERS = 3
 
 # Conservative VMEM budget for one grid step's working set (q tile + double-
-# buffered k/v tiles + f32 softmax scratch). v5e cores carry ~16 MB; leave
-# headroom for the pipeline's prefetch margin.
-_VMEM_BUDGET_BYTES = 12 * 2**20
+# buffered k/v tiles + f32 softmax scratch): the statics-owned
+# per-generation budget table's headroom constant, so the candidate
+# lattice and the kernelcontract checker's ledger cannot drift apart
+# (value unchanged from the pre-registry 12 MiB — programs are
+# byte-identical).
+from agentic_traffic_testing_tpu.statics.kernel_registry import (  # noqa: E402
+    PIPELINE_VMEM_BUDGET_BYTES as _VMEM_BUDGET_BYTES,
+)
 
 
 # -- heuristic (the pre-tuner behavior, and every fallback) -----------------

@@ -44,6 +44,9 @@ ATT_FLASH_TUNE table when one is loaded, today's heuristic (largest-pow2
 QB, KB=1024) otherwise; explicit q_block/kv_block arguments pin a config
 for the tuner's sweep and the per-candidate parity tests. Tiling is the
 ONLY thing block sizes change — numerics are identical across configs.
+The autotuner's VMEM ceiling and this kernel's launch contract share one
+source: statics/kernel_registry.py (the `kernelcontract` checker,
+docs/kernels.md).
 """
 
 from __future__ import annotations

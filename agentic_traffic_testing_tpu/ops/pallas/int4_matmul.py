@@ -45,8 +45,12 @@ MAX_KERNEL_ROWS = 2048
 #: with models/quant._int4_n_block: the n_block chooser prefers the largest
 #: hb that keeps K monolithic under this budget (K chunking measured ~30-50%
 #: slower on chip than a monolithic K at a narrower hb — r5 n_block sweep in
-#: docs/BENCHMARKS.md).
-VMEM_I32_BUDGET = 8_000_000
+#: docs/BENCHMARKS.md). Owned by the statics kernel registry so the
+#: kernelcontract VMEM ledger and this chunker share one source (value
+#: unchanged — programs are byte-identical).
+from agentic_traffic_testing_tpu.statics.kernel_registry import (  # noqa: E402
+    INT4_UNPACK_I32_BUDGET_BYTES as VMEM_I32_BUDGET,
+)
 
 
 def _kernel(layer_ref, x_ref, w_ref, s_ref, lo_out, hi_out, acc_e, acc_o, *,
@@ -188,12 +192,13 @@ def int4_matmul(x, packed, scale, layer=None, *, n_block: int = 512,
         # Gk-axis block index: chunk kk starts at row kk*k_blk = group
         # (kk*k_blk)//kg; with gpb>1 blocks tile the axis, so divide again.
         s_spec = pl.BlockSpec(
-            (1, gpb, 2, hb),
+            (1, gpb, 2, hb),  # statics: allow-kernel-tile(the 2-row scale pair is the operand's full low/high-half axis; Mosaic pads the sub-sublane f32 tile once and it never feeds the MXU)
             lambda r, j, kk, s, _gpb=gpb, _kg=kg, _kb=k_blk:
                 (s[0], (kk * _kb) // (_kg * _gpb), 0, j))
     else:
         gpb = 0
-        s_spec = pl.BlockSpec((1, 2, hb), lambda r, j, kk, s: (s[0], 0, j))
+        s_spec = pl.BlockSpec((1, 2, hb),  # statics: allow-kernel-tile(the 2-row scale pair is the operand's full low/high-half axis; Mosaic pads the sub-sublane f32 tile once and it never feeds the MXU)
+                              lambda r, j, kk, s: (s[0], 0, j))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=grid,

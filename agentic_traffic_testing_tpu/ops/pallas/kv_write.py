@@ -23,6 +23,10 @@ Layout notes:
     pool's page lanes — so every DMA is a tile-aligned [KH, bs, hdp] window
     (Mosaic cannot DMA sub-lane-width slices).
   * T % block_size == 0 (the scheduler's prefill buckets are block-aligned).
+
+The launch contract (aliased in-place pool update, body arity, grid
+semantics) is declared in statics/kernel_registry.py and enforced by the
+`kernelcontract` checker (docs/kernels.md).
 """
 
 from __future__ import annotations

@@ -33,6 +33,13 @@ Two TPU-specific tricks:
 Inactive batch lanes (schedulers keep dead lanes with ctx_len=1 pointing at
 the trash block) produce finite garbage that callers discard — same contract
 as the gather path.
+
+Launch contracts (grid/semantics, per-dtype tile legality, body arity,
+fused-write aliasing, per-step VMEM ledger) for every pallas_call in this
+module are declared in statics/kernel_registry.py and machine-checked by
+the `kernelcontract` statics checker — edit a spec list, a scratch shape,
+or a ref unpack and `scripts/dev/statics_all.py` is the first gate that
+fails (docs/kernels.md carries the rendered table).
 """
 
 from __future__ import annotations

@@ -35,7 +35,10 @@ the grid stays "parallel" across megacore).
 
 The jnp oracle for these numerics is `ragged_paged_attention_ref` below
 (gather + causal_attention per q_len group); interpret-mode parity is
-pinned in tests/test_ragged_paged_attention.py.
+pinned in tests/test_ragged_paged_attention.py. The launch contract —
+including the fused variant's "arbitrary" grid flip and its aliasing —
+is declared in statics/kernel_registry.py and enforced by the
+`kernelcontract` checker (docs/kernels.md).
 """
 
 from __future__ import annotations

@@ -1,21 +1,33 @@
 """The statics plane: AST-based invariant checkers for the serving stack.
 
-Six checkers, one runner (`scripts/dev/statics_all.py`), one pragma
+Seven checkers, one runner (`scripts/dev/statics_all.py`), one pragma
 syntax (`# statics: allow-<rule>(<reason>)`) — see docs/statics.md:
 
-  knobs         env-knob registry parity (code <-> registry <-> docs)
-  capabilities  supports_* matrix parity + build-time refusal guards
-  host-sync     no host synchronization inside marked hot regions
-  donation      no reads of donated buffers after a runner dispatch
-  concurrency   thread-ownership map + lock discipline for the serving
-                plane (statics/ownership_registry.py, docs/threading.md;
-                the runtime half is LLM_CONCURRENCY_CHECK=1)
-  metric-docs   Prometheus family <-> docs/monitoring.md parity
-                (scripts/dev/check_metric_docs.py behind a thin shim)
+  knobs          env-knob registry parity (code <-> registry <-> docs)
+  capabilities   supports_* matrix parity + build-time refusal guards
+  host-sync      no host synchronization inside marked hot regions
+  donation       no reads of donated buffers after a runner dispatch
+  concurrency    thread-ownership map + lock discipline for the serving
+                 plane (statics/ownership_registry.py, docs/threading.md;
+                 the runtime half is LLM_CONCURRENCY_CHECK=1)
+  metric-docs    Prometheus family <-> docs/monitoring.md parity
+                 (scripts/dev/check_metric_docs.py behind a thin shim)
+  kernelcontract Pallas launch contracts for ops/pallas/ — tiling
+                 legality per dtype, body arity vs spec lists, in/out
+                 aliasing (cross-checked against the donation map),
+                 grid-semantics justification, per-step VMEM budget
+                 ledger (statics/kernel_registry.py, docs/kernels.md)
+
+Checker modules import LAZILY (inside run_all/write_docs): the kernels
+under ops/pallas/ import statics.kernel_registry for the budget
+constants, and that import must execute only this light __init__ — a
+statics-only regression in a checker module must never break the kernel
+trace path at serving startup.
 """
 
 from __future__ import annotations
 
+import importlib
 import importlib.util
 import io
 import os
@@ -24,13 +36,6 @@ import time
 from contextlib import redirect_stdout
 from typing import Iterable, Optional
 
-from agentic_traffic_testing_tpu.statics import (  # noqa: F401
-    capabilities,
-    concurrency,
-    donation,
-    host_sync,
-    knobs,
-)
 from agentic_traffic_testing_tpu.statics.common import Finding, repo_root
 
 
@@ -52,13 +57,25 @@ def check_metric_docs(root: Optional[str] = None) -> list[Finding]:
                     "metric <-> docs parity failed:\n" + buf.getvalue())]
 
 
+def _checker(module: str):
+    """A lazily-importing check() runner for a statics submodule."""
+
+    def run(root):
+        mod = importlib.import_module(
+            f"agentic_traffic_testing_tpu.statics.{module}")
+        return mod.check(root)
+
+    return run
+
+
 CHECKERS = (
-    ("knobs", lambda root: knobs.check(root)),
-    ("capabilities", lambda root: capabilities.check(root)),
-    ("host-sync", lambda root: host_sync.check(root)),
-    ("donation", lambda root: donation.check(root)),
-    ("concurrency", lambda root: concurrency.check(root)),
+    ("knobs", _checker("knobs")),
+    ("capabilities", _checker("capabilities")),
+    ("host-sync", _checker("host_sync")),
+    ("donation", _checker("donation")),
+    ("concurrency", _checker("concurrency")),
     ("metric-docs", lambda root: check_metric_docs(root)),
+    ("kernelcontract", _checker("kernelcontract")),
 )
 
 
@@ -110,11 +127,19 @@ def run_all(root: Optional[str] = None,
 def write_docs(root: Optional[str] = None) -> list[str]:
     """Regenerate the generated doc surfaces; returns the paths written."""
     root = root or repo_root()
+    from agentic_traffic_testing_tpu.statics import (
+        capabilities,
+        concurrency,
+        kernelcontract,
+        knobs,
+    )
+
     written = []
     for relpath, content in (
         (knobs.DOC_RELPATH, knobs.render_doc()),
         (capabilities.DOC_RELPATH, capabilities.render(root)),
         (concurrency.DOC_RELPATH, concurrency.render(root)),
+        (kernelcontract.DOC_RELPATH, kernelcontract.render(root)),
     ):
         path = os.path.join(root, relpath)
         with open(path, "w", encoding="utf-8") as f:

@@ -1,0 +1,352 @@
+"""Kernel-contract registry: every `pl.pallas_call` site under ops/pallas/.
+
+This is the statics-owned source of truth the seventh checker
+(statics/kernelcontract.py) validates the ACTUAL call sites against.
+Each entry declares a kernel's launch contract — wrapper + body function,
+grid intent, the trace-time flag configurations it is instantiated at,
+representative serving-shape bindings for the symbolic dims, operand
+dtypes, the aliased fused-write buffers, and the justification for every
+`"parallel"` grid-axis declaration that coexists with cross-step ref
+state. The checker AST-parses ops/pallas/ and fails on tiling
+illegality, kernel-body arity drift, aliasing-contract violations,
+unjustified parallel semantics, and VMEM budget blowouts; docs/kernels.md
+is generated from this registry plus the extracted facts.
+
+The registry also owns the VMEM budget constants the kernels themselves
+size against (previously two ad-hoc per-module constants):
+
+  * `PIPELINE_VMEM_BUDGET_BYTES` — the flash autotuner's per-grid-step
+    working-set ceiling (ops/pallas/autotune.py imports it).
+  * `INT4_UNPACK_I32_BUDGET_BYTES` — the int4 kernel's scoped-VMEM cap
+    for its i32 nibble-unpack intermediates (ops/pallas/int4_matmul.py
+    imports it).
+
+Values are unchanged from the pre-registry constants, so every compiled
+program stays byte-identical. This module is pure python (stdlib only),
+and the statics package __init__ imports its checker modules lazily, so
+an ops/ import of this registry executes nothing beyond the light
+package __init__ — no checker code ever enters the kernel trace path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Mapping
+
+# --------------------------------------------------------------- budgets
+
+#: Usable VMEM per TensorCore by device generation (bytes). Mosaic's
+#: scoped allocations + the BlockSpec pipeline's live blocks must fit
+#: here; the checker's ledger (blocks x double-buffer + scratch + any
+#: declared extra scoped bytes) is validated against every generation a
+#: kernel entry lists. All currently-targeted parts carry 16 MiB/core.
+VMEM_BYTES_PER_CORE: Mapping[str, int] = {
+    "v4": 16 * 2**20,
+    "v5e": 16 * 2**20,
+    "v5p": 16 * 2**20,
+}
+
+#: Conservative per-grid-step working-set budget for pipelined attention
+#: tiles (q tile + double-buffered k/v tiles + f32 softmax scratch):
+#: the 16 MiB/core floor above minus headroom for the pipeline's
+#: prefetch margin. Was `autotune._VMEM_BUDGET_BYTES`; the flash
+#: candidate lattice imports it from here so the tuner and the statics
+#: ledger cannot drift apart.
+PIPELINE_VMEM_BUDGET_BYTES = 12 * 2**20
+
+#: Scoped-VMEM ceiling for the int4 kernel's [k_blk, hb] i32
+#: nibble-unpack intermediates. Was `int4_matmul.VMEM_I32_BUDGET`
+#: (value unchanged — programs stay byte-identical); the kernel's K
+#: chunker and models/quant's n_block chooser both import it via
+#: int4_matmul.
+INT4_UNPACK_I32_BUDGET_BYTES = 8_000_000
+
+#: Dtype-dependent minimum tile (sublane x lane) Mosaic lowers without
+#: padding: (8, 128) f32/i32, (16, 128) bf16, (32, 128) int8/fp8. The
+#: tiling rule: a VMEM block/scratch shape's last dim must be a multiple
+#: of 128 and its second-to-last a multiple of the dtype's sublane
+#: minimum (a dim of exactly 1 lowers as a replicated row vector, and a
+#: dim spanning its operand's full axis is padded once at the edge —
+#: both legal; everything else is the 8-bit-tiling bug class the
+#: ROADMAP's Mosaic-lowering ask pins).
+LANES = 128
+MIN_SUBLANES: Mapping[str, int] = {
+    "f32": 8,
+    "i32": 8,
+    "bf16": 16,
+    "int8": 32,
+    "fp8": 32,
+}
+DTYPE_BYTES: Mapping[str, int] = {
+    "f32": 4,
+    "i32": 4,
+    "bf16": 2,
+    "int8": 1,
+    "fp8": 1,
+}
+
+# --------------------------------------------------------------- entries
+
+OPS_PALLAS_DIR = os.path.join("agentic_traffic_testing_tpu", "ops", "pallas")
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelVariant:
+    """One trace-time configuration of a kernel wrapper.
+
+    `flags` bind the wrapper locals that gate spec-list construction
+    (`stacked`, `quantized`, `fused`, ...); `bindings` give
+    representative serving-shape values for the symbolic dims the
+    wrapper cannot resolve statically (pool head count, block size,
+    padded lane widths). The checker symbolically executes the wrapper
+    under this environment, so every rule is evaluated per variant —
+    the int8 configurations see int8 tiles, the fused ones see the
+    aliased outputs."""
+
+    name: str
+    flags: Mapping[str, bool] = dataclasses.field(default_factory=dict)
+    bindings: Mapping[str, int] = dataclasses.field(default_factory=dict)
+    #: array/operand name -> dtype token (DTYPE_BYTES key); operands not
+    #: named here take the kernel entry's default_dtype.
+    dtypes: Mapping[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass(frozen=True)
+class Kernel:
+    name: str          # registry key (docs/kernels.md row group)
+    module: str        # path relative to the repo root
+    wrapper: str       # function containing the pl.pallas_call
+    body: str          # kernel body function name
+    grid: str          # human-readable grid description (docs)
+    intent: str        # one-line purpose (docs)
+    variants: tuple[KernelVariant, ...]
+    #: shape symbols that span their operand's FULL axis — a block dim
+    #: written as exactly this symbol is exempt from the sublane-minimum
+    #: rule (Mosaic pads a full small axis once; only sub-tiles of a
+    #: larger axis mis-lower).
+    full_axis: frozenset = frozenset()
+    default_dtype: str = "bf16"
+    #: operand names legal as input_output_aliases inputs (the fused
+    #:  in-place write surface); every aliased pair must resolve to one.
+    aliased: tuple[str, ...] = ()
+    #: runner donate_argnames the aliased buffers travel under — must
+    #: exist in donation.donation_map so the donation checker's
+    #: engine.py walk covers reads of the aliased pool.
+    donated_as: tuple[str, ...] = ()
+    #: why cross-grid-step ref state is safe under "parallel" axes
+    #: (required whenever the body stores-then-loads a ref and any grid
+    #: axis is declared "parallel"; the write-then-read shape that
+    #: forced ragged's fused grid to "arbitrary").
+    parallel_reason: str = ""
+    #: extra scoped VMEM per grid step not visible in the specs, as an
+    #: expression over the variant env (the int4 i32 unpack
+    #: intermediate).
+    extra_vmem: str = ""
+    generations: tuple[str, ...] = ("v4", "v5e", "v5p")
+
+
+def _pa(fname: str) -> str:
+    return os.path.join(OPS_PALLAS_DIR, fname)
+
+
+# Common representative serving shape (Llama-1B-class pool): 8 lanes,
+# 8 kv heads, GQA group 4, 128 physical head lanes, 16-slot pages, a
+# 64-wide block table, scale tiles padded to one 128-lane tile.
+_POOL = dict(b=8, kh=8, qpk=4, s_q=1, hd_page=128, bs=16, max_blocks=64,
+             wp=128)
+_INT8 = {"k_pages": "int8", "v_pages": "int8",
+         "ks_t": "f32", "vs_t": "f32", "k_scale": "f32", "v_scale": "f32"}
+def _fused_flags(stacked: bool, quantized: bool, fused: bool) -> dict:
+    """Wrapper locals AND the kernel-body kwarg spelling (`fused` at the
+    call site, `fused_write` inside the body) — the checker executes
+    both scopes under one environment."""
+    return dict(stacked=stacked, quantized=quantized, fused=fused,
+                fused_write=fused)
+
+
+_DMA23_VARIANTS = (
+    KernelVariant("bf16", flags=_fused_flags(True, False, False),
+                  bindings=_POOL),
+    # The 4D single-layer pool path (attention_backend dispatches both):
+    # its stacked=False spec/ref branches must stay arity-checked too.
+    KernelVariant("bf16-flat", flags=_fused_flags(False, False, False),
+                  bindings=_POOL),
+    KernelVariant("int8", flags=_fused_flags(True, True, False),
+                  bindings=_POOL, dtypes=_INT8),
+    KernelVariant("bf16+fused", flags=_fused_flags(True, False, True),
+                  bindings=_POOL),
+    KernelVariant("int8+fused", flags=_fused_flags(True, True, True),
+                  bindings=_POOL, dtypes=_INT8),
+)
+
+KERNELS: tuple[Kernel, ...] = (
+    Kernel(
+        name="paged_decode",
+        module=_pa("paged_attention.py"),
+        wrapper="paged_attention_decode",
+        body="_decode_kernel",
+        grid="(B, KH, max_blocks) — one BlockSpec-pipelined page per step",
+        intent="v1 decode: page streaming via index_map indirection",
+        variants=(
+            KernelVariant("bf16", flags=dict(stacked=True), bindings=_POOL),
+            KernelVariant("bf16-flat", flags=dict(stacked=False),
+                          bindings=_POOL),
+        ),
+        full_axis=frozenset({"rows", "hd"}),
+        parallel_reason=(
+            "softmax m/l/acc scratch carries only across the innermost "
+            "page axis, which is 'arbitrary'; every (b, kh) lane "
+            "re-initializes at j == 0 and finalizes at last_j, so lanes "
+            "share no state"),
+    ),
+    Kernel(
+        name="paged_decode_dma",
+        module=_pa("paged_attention.py"),
+        wrapper="paged_attention_decode_dma",
+        body="_dma_decode_kernel",
+        grid="(B, KH) — per-lane double-buffered chunk walk",
+        intent="v2 decode: explicit per-head page DMA, fori_loop softmax",
+        variants=(
+            KernelVariant("bf16", flags=dict(stacked=True), bindings=_POOL),
+            KernelVariant("bf16-flat", flags=dict(stacked=False),
+                          bindings=_POOL),
+        ),
+        full_axis=frozenset({"rows", "hd"}),
+        parallel_reason=(
+            "softmax state rides the fori_loop carry, not scratch; each "
+            "program's k/v double buffers are filled and drained entirely "
+            "within its own grid step"),
+    ),
+    Kernel(
+        name="paged_decode_dma2",
+        module=_pa("paged_attention.py"),
+        wrapper="paged_attention_decode_dma2",
+        body="_dma2_decode_kernel",
+        grid="(B,) — all kv heads per page DMA, fori_loop chunk walk",
+        intent="v3 decode: 8x fewer descriptors; int8 dequant + fused "
+               "decode-token write variants",
+        variants=_DMA23_VARIANTS,
+        full_axis=frozenset({"rows", "hd"}),
+        aliased=("k_pages", "v_pages", "k_scale", "v_scale"),
+        donated_as=("cache",),
+        parallel_reason=(
+            "each lane zero-fills its own tail V slots and fused-writes "
+            "only its own lane's target page before its private chunk "
+            "walk re-reads it; no program reads pages another program "
+            "wrote in this call"),
+    ),
+    Kernel(
+        name="paged_decode_dma3",
+        module=_pa("paged_attention.py"),
+        wrapper="paged_attention_decode_dma3",
+        body="_dma3_decode_kernel",
+        grid="(B, KH, C) — lane-parallel chunk walk, chunks 'arbitrary'",
+        intent="v4 decode: megacore lane splitting; int8 dequant + fused "
+               "per-head write variants",
+        variants=tuple(
+            dataclasses.replace(v, bindings=dict(v.bindings,
+                                                 pages_per_chunk=16))
+            for v in _DMA23_VARIANTS),
+        full_axis=frozenset({"rows", "hd"}),
+        aliased=("k_pages", "v_pages", "k_scale", "v_scale"),
+        donated_as=("cache",),
+        parallel_reason=(
+            "m/l/acc/s_buf scratch carries only across the innermost "
+            "chunk axis, which is 'arbitrary'; every (b, kh) lane "
+            "re-initializes its stats (and lands its own fused write) in "
+            "its ci == 0 prologue and touches only its own (sequence, "
+            "head) page slice"),
+    ),
+    Kernel(
+        name="ragged_paged_attention",
+        module=_pa("ragged_paged_attention.py"),
+        wrapper="ragged_paged_attention",
+        body="_ragged_kernel",
+        grid="(G,) — one program per ragged q-token block",
+        intent="hybrid prefill+decode batches against the paged pool; "
+               "fused variant flips the grid to 'arbitrary'",
+        variants=(
+            KernelVariant("bf16", flags=_fused_flags(True, False, False),
+                          bindings=dict(_POOL, t=64, h=32, n_blocks=16)),
+            KernelVariant("bf16-flat", flags=_fused_flags(False, False,
+                                                          False),
+                          bindings=dict(_POOL, t=64, h=32, n_blocks=16)),
+            KernelVariant("int8", flags=_fused_flags(True, True, False),
+                          bindings=dict(_POOL, t=64, h=32, n_blocks=16),
+                          dtypes=_INT8),
+            KernelVariant("bf16+fused", flags=_fused_flags(True, False,
+                                                           True),
+                          bindings=dict(_POOL, t=64, h=32, n_blocks=16)),
+        ),
+        full_axis=frozenset({"rows", "qblk", "hd_page"}),
+        aliased=("k_pages", "v_pages"),
+        donated_as=("cache",),
+        parallel_reason=(
+            "non-fused blocks only read pool pages and zero their own "
+            "tail V slots; a chunk row's later q-blocks read pages its "
+            "earlier q-blocks wrote ONLY under fused writes, where the "
+            "grid is declared 'arbitrary'"),
+    ),
+    Kernel(
+        name="chunk_flash",
+        module=_pa("chunk_flash.py"),
+        wrapper="_flash_grid_call",
+        body="_kernel",
+        grid="(B, KH, Tq/QB, Tkv/KB) — kv axis 'arbitrary'",
+        intent="first-party flash attention (solo/batched + chunked "
+               "prefill sites, one body)",
+        variants=(
+            KernelVariant("causal",
+                          bindings=dict(b=1, kh=8, r=8192, hd=128, tkv=2048,
+                                        prior_len=0, q_block=512,
+                                        kv_block=1024, queries_per_kv=4)),
+            KernelVariant("chunk",
+                          bindings=dict(b=1, kh=8, r=512, hd=128, tkv=2048,
+                                        prior_len=1024, q_block=128,
+                                        kv_block=1024, queries_per_kv=4)),
+        ),
+        full_axis=frozenset({"hd"}),
+        parallel_reason=(
+            "softmax m/l/acc scratch carries only across the innermost kv "
+            "axis, which is 'arbitrary'; every (b, kh, qb) tile "
+            "re-initializes at kb == 0 and finalizes at last_kb"),
+    ),
+    Kernel(
+        name="kv_write",
+        module=_pa("kv_write.py"),
+        wrapper="write_prompt_kv_pallas",
+        body="_write_kernel",
+        grid="(L, B) — one program per (layer, sequence), page DMAs only",
+        intent="bulk prompt-KV page writer (aliased in-place pool update)",
+        variants=(
+            KernelVariant("bf16",
+                          bindings=dict(L=16, b=8, kh=8, t=128, hdp=128,
+                                        bs=16)),
+        ),
+        aliased=("pool_k", "pool_v"),
+        donated_as=("cache",),
+    ),
+    Kernel(
+        name="int4_matmul",
+        module=_pa("int4_matmul.py"),
+        wrapper="int4_matmul",
+        body="_kernel",
+        grid="(rows/RB, N/2/hb, K/k_blk) — K chunks 'arbitrary'",
+        intent="weight-only int4 matmul: packed nibbles unpacked in VMEM",
+        variants=(
+            KernelVariant("flat", flags=dict(stacked=True, grouped=False),
+                          bindings=dict(L=16, K=8192, half=7168, b=256),
+                          dtypes={"packed": "int8", "scale": "f32"}),
+            KernelVariant("grouped", flags=dict(stacked=True, grouped=True),
+                          bindings=dict(L=16, K=8192, half=7168, b=256,
+                                        gk=64),
+                          dtypes={"packed": "int8", "scale": "f32"}),
+        ),
+        parallel_reason=(
+            "acc_e/acc_o scratch carries only across the innermost K-chunk "
+            "axis, which is 'arbitrary'; every (row, n) tile zeroes its "
+            "accumulators at kk == 0 and emits at the last chunk"),
+        extra_vmem="k_blk * hb * 4",
+    ),
+)

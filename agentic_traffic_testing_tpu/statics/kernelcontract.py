@@ -1,0 +1,1304 @@
+"""Checker 7 — Pallas kernel launch contracts.
+
+The riskiest surface in the tree is the ~2.5k lines of TPU kernels under
+ops/pallas/: interpret-mode tests pin their numerics, but the LAUNCH
+contract — tile legality per dtype, kernel-body arity vs the spec lists,
+in/out aliasing, grid-axis semantics, per-step VMEM footprint — was
+reviewer memory (the PR-1 dma3 crash was a missing SMEM scratch entry;
+the PR-10 scale-tile bug was a padding-contract violation). This checker
+AST-parses every `pl.pallas_call` site against the declarations in
+statics/kernel_registry.py and fails on:
+
+  kernel-tile       a BlockSpec block or pltpu.VMEM scratch shape whose
+                    trailing dims violate the dtype-dependent
+                    sublane x lane minimum ((8,128) f32, (16,128) bf16,
+                    (32,128) int8/fp8); dims of exactly 1 (replicated
+                    row vectors) and dims spanning their operand's full
+                    axis (registry `full_axis`) are exempt
+  kernel-arity      kernel-body ref count != num_scalar_prefetch +
+                    in_specs + out_specs + scratch_shapes (the dma3
+                    `rc_ref` crash class, at lint time)
+  kernel-alias      input_output_aliases pairs whose input operand and
+                    output ShapeDtypeStruct are built from different
+                    arrays (shape/dtype contract broken), aliased
+                    buffers the registry does not declare, or aliased
+                    pools not covered by any runner donate_argnames
+                    (the donation checker's engine.py walk must see
+                    post-dispatch reads of an aliased pool)
+  kernel-grid       dimension_semantics length != grid rank, or a body
+                    that stores-then-loads a ref while any grid axis is
+                    declared "parallel" without a registry
+                    `parallel_reason` (the write-then-read shape that
+                    forced ragged's fused grid to "arbitrary")
+  kernel-vmem       the per-grid-step working set (pipelined blocks x
+                    double-buffer + scratch + declared extra scoped
+                    bytes) exceeds the generation budget table
+  kernel-unregistered / kernel-registry-dead
+                    call-site <-> registry parity
+  kernel-docs-stale docs/kernels.md does not match the registry render
+
+Because the wrappers assemble their spec lists at trace time (`if
+quantized: in_specs += ...`), the checker symbolically executes each
+wrapper body under every registry variant's flag/shape environment — a
+small abstract interpreter over the idioms these six modules use (list
+builds, flag branches, range loops, BlockSpec/VMEM/GridSpec
+construction) — so the int8 configurations are checked with int8 tiles
+and the fused ones with their aliased outputs. Anything it cannot
+resolve degrades to an explicit `kernel-extract` finding, never to a
+silent pass of a registered site.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import os
+from types import SimpleNamespace
+from typing import Iterable, Optional
+
+from agentic_traffic_testing_tpu.statics import donation
+from agentic_traffic_testing_tpu.statics.common import (
+    Finding,
+    SourceFile,
+    bare_pragma_findings,
+    doc_drift_finding,
+    dotted,
+    iter_python_files,
+    repo_root,
+)
+from agentic_traffic_testing_tpu.statics.kernel_registry import (
+    DTYPE_BYTES,
+    KERNELS,
+    LANES,
+    MIN_SUBLANES,
+    OPS_PALLAS_DIR,
+    VMEM_BYTES_PER_CORE,
+    Kernel,
+    KernelVariant,
+)
+
+DOC_RELPATH = os.path.join("docs", "kernels.md")
+
+_DTYPE_TOKENS = {
+    "jnp.float32": "f32", "jnp.int32": "i32", "jnp.bfloat16": "bf16",
+    "jnp.int8": "int8", "jnp.float8_e4m3fn": "fp8",
+}
+
+
+class Opaque:
+    """An unresolvable value; `name` is the source binding when known."""
+
+    __slots__ = ("name",)
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        self.name = name
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        return f"Opaque({self.name})"
+
+
+class ShapeOf:
+    """`X.shape` of an array operand — only its root name is known."""
+
+    __slots__ = ("root",)
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+
+
+class DtypeOf:
+    """`X.dtype` of an array operand — resolved via the variant dtypes."""
+
+    __slots__ = ("root",)
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+
+
+#: A shape argument that EXISTED but did not evaluate — distinct from a
+#: memory-space-only BlockSpec (dims None), so unresolvable shapes fail
+#: loudly (kernel-extract) instead of silently skipping tile/vmem rules.
+UNRESOLVED = object()
+
+
+class Block:
+    """A pl.BlockSpec: evaluated dims [(value, source_text)], None for
+    memory-space-only specs, or UNRESOLVED."""
+
+    __slots__ = ("dims", "memory_space", "lineno", "end_lineno")
+
+    def __init__(self, dims, memory_space, lineno, end_lineno) -> None:
+        self.dims = dims
+        self.memory_space = memory_space
+        self.lineno = lineno
+        self.end_lineno = end_lineno
+
+
+class Vmem:
+    """A pltpu.VMEM scratch shape; dtype is a token or DtypeOf."""
+
+    __slots__ = ("dims", "dtype", "lineno", "end_lineno")
+
+    def __init__(self, dims, dtype, lineno, end_lineno) -> None:
+        self.dims = dims
+        self.dtype = dtype
+        self.lineno = lineno
+        self.end_lineno = end_lineno
+
+
+class Sem:
+    """A pltpu.SemaphoreType scratch entry (no VMEM tile rules)."""
+
+    __slots__ = ()
+
+
+class SDS:
+    """A jax.ShapeDtypeStruct: the array names its shape/dtype came from
+    (or, for a literal jnp dtype, the resolved dtype token)."""
+
+    __slots__ = ("shape_root", "dtype_root", "dtype_token")
+
+    def __init__(self, shape_root, dtype_root, dtype_token=None) -> None:
+        self.shape_root = shape_root
+        self.dtype_root = dtype_root
+        self.dtype_token = dtype_token
+
+
+class GridSpecObj:
+    __slots__ = ("num_prefetch", "grid", "in_specs", "out_specs", "scratch")
+
+    def __init__(self, num_prefetch, grid, in_specs, out_specs,
+                 scratch) -> None:
+        self.num_prefetch = num_prefetch
+        self.grid = grid
+        self.in_specs = in_specs
+        self.out_specs = out_specs
+        self.scratch = scratch
+
+
+class Partial:
+    __slots__ = ("fn_name",)
+
+    def __init__(self, fn_name) -> None:
+        self.fn_name = fn_name
+
+
+def _is_opaque(v) -> bool:
+    return isinstance(v, Opaque)
+
+
+def _src(node: ast.AST) -> str:
+    try:
+        return ast.unparse(node)
+    except Exception:  # pragma: no cover - unparse is total on stdlib ASTs
+        return "?"
+
+
+def _dims_of(node: ast.AST, env) -> Optional[list]:
+    """Evaluate a shape expression into [(int|None, source_text)]."""
+    val = _eval(node, env)
+    if isinstance(val, tuple):
+        out = []
+        elts = node.elts if isinstance(node, (ast.Tuple, ast.List)) else None
+        for i, v in enumerate(val):
+            text = _src(elts[i]) if elts and i < len(elts) else ""
+            out.append((v if isinstance(v, int) else None, text))
+        return out
+    if isinstance(val, int):
+        return [(val, _src(node))]
+    return None
+
+
+# ------------------------------------------------------------ expressions
+
+
+def _eval(node: ast.AST, env: dict):
+    """Abstract evaluation over the wrappers' expression idioms. Unknown
+    values are Opaque; env maps names to ints/bools/containers/objects."""
+    if isinstance(node, ast.Constant):
+        return node.value
+    if isinstance(node, ast.Name):
+        if node.id in env:
+            return env[node.id]
+        return Opaque(node.id)
+    if isinstance(node, ast.Attribute):
+        d = dotted(node)
+        if d in _DTYPE_TOKENS:
+            return _DTYPE_TOKENS[d]
+        if d is not None and d.endswith(".ANY"):
+            return "ANY"
+        if node.attr == "dtype":
+            base = dotted(node.value)
+            if base is not None:
+                return DtypeOf(base.split(".")[0])
+        if node.attr == "shape":
+            base = dotted(node.value)
+            if base is not None:
+                return ShapeOf(base.split(".")[0])
+        return Opaque(None)
+    if isinstance(node, ast.Tuple) or isinstance(node, ast.List):
+        vals = []
+        for e in node.elts:
+            if isinstance(e, ast.Starred):
+                inner = _eval(e.value, env)
+                vals.extend(inner if isinstance(inner, (tuple, list))
+                            else [Opaque(None)])
+            else:
+                vals.append(_eval(e, env))
+        return tuple(vals) if isinstance(node, ast.Tuple) else list(vals)
+    if isinstance(node, ast.Dict):
+        out = {}
+        for k, v in zip(node.keys, node.values):
+            kk = _eval(k, env) if k is not None else Opaque(None)
+            out[kk if not _is_opaque(kk) else object()] = _eval(v, env)
+        return out
+    if isinstance(node, ast.UnaryOp):
+        v = _eval(node.operand, env)
+        if isinstance(node.op, ast.USub) and isinstance(v, (int, float)):
+            return -v
+        if isinstance(node.op, ast.Not) and isinstance(v, (bool, int)):
+            return not v
+        return Opaque(None)
+    if isinstance(node, ast.BinOp):
+        left, right = _eval(node.left, env), _eval(node.right, env)
+        try:
+            if isinstance(node.op, ast.Add):
+                return left + right
+            if isinstance(node.op, ast.Sub):
+                return left - right
+            if isinstance(node.op, ast.Mult):
+                return left * right
+            if isinstance(node.op, ast.FloorDiv):
+                return left // right
+            if isinstance(node.op, ast.Div):
+                return left / right
+            if isinstance(node.op, ast.Mod):
+                return left % right
+            if isinstance(node.op, ast.Pow):
+                return left ** right
+        except TypeError:
+            return Opaque(None)
+        return Opaque(None)
+    if isinstance(node, ast.BoolOp):
+        vals = [_eval(v, env) for v in node.values]
+        if isinstance(node.op, ast.And):
+            out = True
+            for v in vals:
+                if v is False or v == 0:
+                    return v
+                if _is_opaque(v):
+                    out = Opaque(None)
+                elif not _is_opaque(out):
+                    out = v
+            return out
+        out = False
+        for v in vals:
+            if not _is_opaque(v) and v:
+                return v
+            if _is_opaque(v):
+                out = Opaque(None)
+            elif _is_opaque(out) is False:
+                out = v
+        return out
+    if isinstance(node, ast.Compare) and len(node.ops) == 1:
+        left = _eval(node.left, env)
+        right = _eval(node.comparators[0], env)
+        if _is_opaque(left) or _is_opaque(right):
+            return Opaque(None)
+        op = node.ops[0]
+        try:
+            if isinstance(op, ast.Eq):
+                return left == right
+            if isinstance(op, ast.NotEq):
+                return left != right
+            if isinstance(op, ast.Lt):
+                return left < right
+            if isinstance(op, ast.LtE):
+                return left <= right
+            if isinstance(op, ast.Gt):
+                return left > right
+            if isinstance(op, ast.GtE):
+                return left >= right
+            if isinstance(op, ast.Is):
+                return left is right or left == right
+            if isinstance(op, ast.IsNot):
+                return not (left is right or left == right)
+        except TypeError:
+            return Opaque(None)
+        return Opaque(None)
+    if isinstance(node, ast.IfExp):
+        test = _eval(node.test, env)
+        if _is_opaque(test):
+            return Opaque(None)
+        return _eval(node.body if test else node.orelse, env)
+    if isinstance(node, ast.Subscript):
+        base = _eval(node.value, env)
+        if isinstance(node.slice, ast.Slice):
+            return Opaque(None)
+        idx = _eval(node.slice, env)
+        if isinstance(base, (tuple, list)) and isinstance(idx, int):
+            try:
+                return base[idx]
+            except IndexError:
+                return Opaque(None)
+        if isinstance(base, dict) and not _is_opaque(idx):
+            return base.get(idx, Opaque(None))
+        return Opaque(None)
+    if isinstance(node, ast.Call):
+        return _eval_call(node, env)
+    if isinstance(node, ast.Lambda):
+        return Opaque(None)
+    if isinstance(node, ast.Starred):
+        return _eval(node.value, env)
+    return Opaque(None)
+
+
+def _eval_call(node: ast.Call, env: dict):
+    d = dotted(node.func) or ""
+    tail = d.split(".")[-1]
+    kwargs = {kw.arg: kw.value for kw in node.keywords if kw.arg}
+    if tail == "BlockSpec":
+        dims = None
+        if node.args:
+            dims = _dims_of(node.args[0], env)
+            if dims is None:
+                dims = UNRESOLVED
+        space = "VMEM"
+        if "memory_space" in kwargs:
+            sp = _eval(kwargs["memory_space"], env)
+            space = sp if isinstance(sp, str) else "?"
+        return Block(dims, space, node.lineno,
+                     getattr(node, "end_lineno", node.lineno))
+    if tail == "VMEM":
+        # VMEM always takes a shape: a missing/unevaluated one is
+        # unresolvable, never a legitimate shapeless spec.
+        dims = (_dims_of(node.args[0], env) if node.args else None)
+        if dims is None:
+            dims = UNRESOLVED
+        dt = _eval(node.args[1], env) if len(node.args) > 1 else None
+        return Vmem(dims, dt, node.lineno,
+                    getattr(node, "end_lineno", node.lineno))
+    if d.endswith("SemaphoreType.DMA") or tail == "DMA":
+        return Sem()
+    if tail == "PrefetchScalarGridSpec":
+        def kw(name):
+            return _eval(kwargs[name], env) if name in kwargs else Opaque(None)
+        return GridSpecObj(kw("num_scalar_prefetch"), kw("grid"),
+                           kw("in_specs"), kw("out_specs"),
+                           kw("scratch_shapes"))
+    if tail == "ShapeDtypeStruct" and node.args:
+        shape_v = _eval(node.args[0], env)
+        shape_root = shape_v.root if isinstance(shape_v, ShapeOf) else None
+        dtype_root = dtype_token = None
+        if len(node.args) > 1:
+            dt = _eval(node.args[1], env)
+            if isinstance(dt, DtypeOf):
+                dtype_root = dt.root
+            elif isinstance(dt, str) and dt in DTYPE_BYTES:
+                dtype_token = dt
+        return SDS(shape_root, dtype_root, dtype_token)
+    if tail == "partial" and node.args:
+        fn = dotted(node.args[0])
+        return Partial(fn.split(".")[-1] if fn else None)
+    if tail == "CompilerParams":
+        return {k: _eval(v, env) for k, v in kwargs.items()}
+    if tail in ("min", "max", "abs", "int"):
+        vals = [_eval(a, env) for a in node.args]
+        if all(isinstance(v, (int, float)) for v in vals) and vals:
+            return {"min": min, "max": max, "abs": lambda *a: abs(a[0]),
+                    "int": lambda *a: int(a[0])}[tail](*vals)
+        return Opaque(None)
+    if tail == "len":
+        v = _eval(node.args[0], env) if node.args else Opaque(None)
+        if isinstance(v, (tuple, list, dict)):
+            return len(v)
+        return Opaque(None)
+    if d == "math.gcd":
+        vals = [_eval(a, env) for a in node.args]
+        if all(isinstance(v, int) for v in vals):
+            import math
+            return math.gcd(*vals)
+        return Opaque(None)
+    if tail == "range":
+        vals = [_eval(a, env) for a in node.args]
+        if all(isinstance(v, int) for v in vals) and 1 <= len(vals) <= 3:
+            return ("range", tuple(vals))
+        return Opaque(None)
+    return Opaque(None)
+
+
+# ------------------------------------------------------------- statements
+
+
+_MAX_LOOP = 10_000
+
+
+def _exec_block(body: list, env: dict) -> None:
+    for stmt in body:
+        _exec(stmt, env)
+
+
+def _assign_name(name: str, value, env: dict) -> None:
+    # Registry bindings survive unresolvable reassignment: an opaque RHS
+    # never clobbers a representative value, it only fills a gap.
+    if _is_opaque(value):
+        if name not in env:
+            env[name] = Opaque(name)
+        return
+    env[name] = value
+
+
+def _exec(stmt: ast.stmt, env: dict) -> None:
+    if isinstance(stmt, ast.Assign):
+        value = _eval(stmt.value, env)
+        for t in stmt.targets:
+            if isinstance(t, ast.Name):
+                _assign_name(t.id, value, env)
+            elif isinstance(t, ast.Tuple):
+                if isinstance(value, (tuple, list)) and len(value) == len(
+                        t.elts):
+                    for sub, v in zip(t.elts, value):
+                        if isinstance(sub, ast.Name):
+                            _assign_name(sub.id, v, env)
+            elif isinstance(t, ast.Subscript):
+                base = _eval(t.value, env)
+                key = _eval(t.slice, env)
+                if isinstance(base, dict) and not _is_opaque(key):
+                    base[key] = value
+        return
+    if isinstance(stmt, ast.AugAssign) and isinstance(stmt.target, ast.Name):
+        cur = env.get(stmt.target.id)
+        add = _eval(stmt.value, env)
+        if isinstance(stmt.op, ast.Add) and cur is not None and not (
+                _is_opaque(cur) or _is_opaque(add)):
+            try:
+                env[stmt.target.id] = cur + add
+            except TypeError:
+                pass
+        return
+    if isinstance(stmt, ast.Expr):
+        call = stmt.value
+        if (isinstance(call, ast.Call)
+                and isinstance(call.func, ast.Attribute)
+                and call.func.attr == "append"
+                and isinstance(call.func.value, ast.Name)):
+            lst = env.get(call.func.value.id)
+            if isinstance(lst, list) and call.args:
+                lst.append(_eval(call.args[0], env))
+        return
+    if isinstance(stmt, ast.If):
+        test = _eval(stmt.test, env)
+        if _is_opaque(test):
+            return  # unknown predicate: touch neither branch
+        _exec_block(stmt.body if test else stmt.orelse, env)
+        return
+    if isinstance(stmt, ast.For) and isinstance(stmt.target, ast.Name):
+        it = _eval(stmt.iter, env)
+        if isinstance(it, tuple) and len(it) == 2 and it[0] == "range":
+            seq = range(*it[1])
+            if len(seq) <= _MAX_LOOP:
+                for v in seq:
+                    env[stmt.target.id] = v
+                    _exec_block(stmt.body, env)
+        return
+    # FunctionDef/Return/Raise/Pass/With/Try/docstring: no spec effect.
+
+
+# --------------------------------------------------------- fact extraction
+
+
+class ExtractError(Exception):
+    pass
+
+
+def _module_env(src: SourceFile) -> dict:
+    """Module-level int constants (plus names imported from the kernel
+    registry, resolved against the real module)."""
+    env: dict = {}
+    for stmt in src.tree.body:
+        if (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)
+                and isinstance(stmt.value, ast.Constant)
+                and isinstance(stmt.value.value, (int, float))):
+            env[stmt.targets[0].id] = stmt.value.value
+        elif isinstance(stmt, ast.ImportFrom) and stmt.module and (
+                stmt.module.endswith("kernel_registry")):
+            reg = importlib.import_module(
+                "agentic_traffic_testing_tpu.statics.kernel_registry")
+            for alias in stmt.names:
+                val = getattr(reg, alias.name, None)
+                if isinstance(val, (int, float)):
+                    env[alias.asname or alias.name] = val
+    return env
+
+
+def _find_fn(src: SourceFile, name: str) -> Optional[ast.FunctionDef]:
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.FunctionDef) and node.name == name:
+            return node
+    return None
+
+
+def _find_pallas_call(fn: ast.FunctionDef) -> ast.Call:
+    calls = [node for node in ast.walk(fn)
+             if isinstance(node, ast.Call) and dotted(node.func) in (
+                 "pl.pallas_call", "pallas_call")]
+    if not calls:
+        raise ExtractError(f"no pl.pallas_call inside {fn.name}")
+    if len(calls) > 1:
+        # A silent first-match would leave the other site entirely
+        # unchecked while parity stays green — refuse instead.
+        raise ExtractError(
+            f"{len(calls)} pl.pallas_call sites inside {fn.name} — a "
+            f"registered wrapper must contain exactly one (split the "
+            f"wrapper and register each site)")
+    return calls[0]
+
+
+def _operand_call(fn: ast.FunctionDef, pc: ast.Call) -> Optional[ast.Call]:
+    """The Call that applies the pallas_call result to its operands:
+    either immediate (`pl.pallas_call(...)(ops...)`) or through a local
+    binding (`kernel = pl.pallas_call(...); kernel(ops...)`)."""
+    bound: Optional[str] = None
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call) and node.func is pc:
+            return node
+        if (isinstance(node, ast.Assign) and node.value is pc
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            bound = node.targets[0].id
+    if bound is not None:
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Name) and node.func.id == bound:
+                return node
+    return None
+
+
+def _operand_names(call: ast.Call, env: dict) -> list:
+    names: list = []
+    for a in call.args:
+        if isinstance(a, ast.Starred):
+            v = _eval(a.value, env)
+            if isinstance(v, (tuple, list)):
+                names.extend(e.name if _is_opaque(e) else None for e in v)
+            else:
+                names.append(None)
+        elif isinstance(a, ast.Name):
+            names.append(a.id)
+        else:
+            v = _eval(a, env)
+            names.append(v.name if _is_opaque(v) else None)
+    return names
+
+
+class Facts(SimpleNamespace):
+    pass
+
+
+def _listify(v) -> list:
+    if isinstance(v, list):
+        return v
+    if isinstance(v, tuple):
+        return list(v)
+    if v is None or _is_opaque(v):
+        return []
+    return [v]
+
+
+def extract(src: SourceFile, entry: Kernel, variant: KernelVariant) -> Facts:
+    """Symbolically execute `entry.wrapper` under the variant env and
+    read the launch facts off its pl.pallas_call."""
+    fn = _find_fn(src, entry.wrapper)
+    if fn is None:
+        raise ExtractError(f"wrapper {entry.wrapper} not found")
+    env = _module_env(src)
+    args = fn.args
+
+    def seed(a, default):
+        # Only numeric defaults seed the env: a `param=None` default must
+        # stay symbolic, or `quantized = k_scale is not None` would
+        # evaluate to a hard False and clobber the variant's flag.
+        if (isinstance(default, ast.Constant)
+                and isinstance(default.value, (int, float))
+                and not isinstance(default.value, bool)):
+            env.setdefault(a.arg, default.value)
+
+    for a, default in zip(args.args[len(args.args) - len(args.defaults):],
+                          args.defaults):
+        seed(a, default)
+    for a, default in zip(args.kwonlyargs, args.kw_defaults):
+        if default is not None:
+            seed(a, default)
+    env.update(variant.bindings)
+    env.update(variant.flags)
+    _exec_block(fn.body, env)
+
+    pc = _find_pallas_call(fn)
+    kwargs = {kw.arg: kw.value for kw in pc.keywords if kw.arg}
+    gs = _eval(kwargs["grid_spec"], env) if "grid_spec" in kwargs else None
+    if not isinstance(gs, GridSpecObj):
+        raise ExtractError("grid_spec did not resolve to a "
+                           "PrefetchScalarGridSpec")
+    semantics = None
+    if "compiler_params" in kwargs:
+        cp = _eval(kwargs["compiler_params"], env)
+        if isinstance(cp, dict):
+            sem = cp.get("dimension_semantics")
+            if isinstance(sem, tuple) and all(
+                    isinstance(s, str) for s in sem):
+                semantics = sem
+    aliases: dict = {}
+    aliases_unresolved = False
+    if "input_output_aliases" in kwargs:
+        al = _eval(kwargs["input_output_aliases"], env)
+        if isinstance(al, dict) and all(
+                isinstance(k, int) and isinstance(v, int)
+                for k, v in al.items()):
+            aliases = dict(al)
+        else:
+            aliases_unresolved = True
+    out_shape = _listify(_eval(kwargs["out_shape"], env)
+                         if "out_shape" in kwargs else None)
+    body_ref = pc.args[0] if pc.args else None
+    body_val = _eval(body_ref, env) if body_ref is not None else None
+    body_name = (body_val.fn_name if isinstance(body_val, Partial)
+                 else (dotted(body_ref) if body_ref is not None else None))
+    opcall = _operand_call(fn, pc)
+    operands = _operand_names(opcall, env) if opcall is not None else []
+    num_prefetch = (gs.num_prefetch
+                    if isinstance(gs.num_prefetch, int) else None)
+    grid = gs.grid if isinstance(gs.grid, tuple) else None
+    return Facts(
+        grid=grid,
+        semantics=semantics,
+        num_prefetch=num_prefetch,
+        in_specs=_listify(gs.in_specs),
+        out_specs=_listify(gs.out_specs),
+        scratch=_listify(gs.scratch),
+        aliases=aliases,
+        aliases_unresolved=aliases_unresolved,
+        out_shape=out_shape,
+        operands=operands,
+        body_name=body_name,
+        call_lineno=pc.lineno,
+        src_path=src.path,
+        env=env,
+    )
+
+
+# ----------------------------------------------------------- body analysis
+
+
+def _body_ref_count(body: ast.FunctionDef, flags: dict) -> Optional[int]:
+    """How many refs the kernel body consumes under `flags`.
+
+    Explicit positional params count directly; `*refs` bodies are walked
+    for their `next(it)` prologue (flag-gated branches resolved) or a
+    whole-tuple unpack from `refs`/`refs[1:]`."""
+    explicit = len(body.args.posonlyargs) + len(body.args.args)
+    if body.args.vararg is None:
+        return explicit
+
+    count = 0
+    resolved: Optional[int] = None
+
+    def exprs_in(stmt: ast.stmt) -> list:
+        if isinstance(stmt, ast.Assign):
+            return [stmt.value]
+        if isinstance(stmt, ast.Expr):
+            return [stmt.value]
+        return []
+
+    def count_next(node: ast.AST, env: dict) -> int:
+        n = 0
+        if isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name) and node.func.id == "next":
+            return 1
+        if isinstance(node, ast.IfExp):
+            test = _eval(node.test, env)
+            if _is_opaque(test):
+                return 0
+            return count_next(node.body if test else node.orelse, env)
+        for child in ast.iter_child_nodes(node):
+            n += count_next(child, env)
+        return n
+
+    def walk(stmts: list, env: dict) -> None:
+        nonlocal count, resolved
+        for stmt in stmts:
+            if isinstance(stmt, ast.If):
+                test = _eval(stmt.test, env)
+                if not _is_opaque(test):
+                    walk(stmt.body if test else stmt.orelse, env)
+                continue
+            if isinstance(stmt, ast.Assign) and isinstance(
+                    stmt.targets[0], ast.Tuple):
+                v = stmt.value
+                if isinstance(v, ast.Name) and v.id == "refs":
+                    resolved = len(stmt.targets[0].elts)
+                    return
+                if (isinstance(v, ast.Subscript)
+                        and isinstance(v.value, ast.Name)
+                        and v.value.id == "refs"
+                        and isinstance(v.slice, ast.Slice)
+                        and isinstance(v.slice.lower, ast.Constant)):
+                    resolved = (len(stmt.targets[0].elts)
+                                + v.slice.lower.value)
+                    return
+            for e in exprs_in(stmt):
+                count += count_next(e, env)
+
+    walk(body.body, dict(flags))
+    # Explicit params before *refs consume refs too (def _k(a_ref, *refs)).
+    if resolved is not None:
+        return resolved + explicit
+    return (count + explicit) if count else None
+
+
+def _state_roots(body: ast.FunctionDef) -> set:
+    """Ref roots the body both subscript-stores and subscript-loads —
+    cross-grid-step state when scratch/aliased refs are involved."""
+    stores: set = set()
+    loads: set = set()
+    for node in ast.walk(body):
+        if isinstance(node, ast.Subscript):
+            root = dotted(node.value)
+            if root is None:
+                continue
+            root = root.split(".")[0]
+            if isinstance(node.ctx, ast.Store):
+                stores.add(root)
+            elif isinstance(node.ctx, ast.Load):
+                loads.add(root)
+        elif isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Subscript):
+            root = dotted(node.target.value)
+            if root is not None:
+                r = root.split(".")[0]
+                stores.add(r)
+                loads.add(r)
+    return {r for r in stores & loads if r not in ("refs",)}
+
+
+# ------------------------------------------------------------------ rules
+
+
+def _anchor(lineno: int, end_lineno: Optional[int] = None):
+    return SimpleNamespace(lineno=lineno, end_lineno=end_lineno or lineno)
+
+
+def _spec_dtype(entry: Kernel, variant: KernelVariant, name) -> str:
+    if name is not None and name in variant.dtypes:
+        return variant.dtypes[name]
+    return entry.default_dtype
+
+
+def _scratch_dtype(entry: Kernel, variant: KernelVariant, token) -> str:
+    if isinstance(token, str) and token in DTYPE_BYTES:
+        return token
+    if isinstance(token, DtypeOf):
+        return variant.dtypes.get(token.root, entry.default_dtype)
+    return entry.default_dtype
+
+
+def _iter_tiles(entry: Kernel, variant: KernelVariant, facts: Facts):
+    """(dims, dtype, lineno, what) for every VMEM tile of the variant."""
+    np_ = facts.num_prefetch or 0
+    for i, spec in enumerate(facts.in_specs):
+        if isinstance(spec, Block) and spec.memory_space != "ANY" and (
+                isinstance(spec.dims, list)):
+            name = (facts.operands[np_ + i]
+                    if np_ + i < len(facts.operands) else None)
+            yield spec.dims, _spec_dtype(entry, variant, name), \
+                (spec.lineno, spec.end_lineno), f"in_specs[{i}]"
+    for j, spec in enumerate(facts.out_specs):
+        if isinstance(spec, Block) and spec.memory_space != "ANY" and (
+                isinstance(spec.dims, list)):
+            sds = (facts.out_shape[j] if j < len(facts.out_shape)
+                   and isinstance(facts.out_shape[j], SDS) else None)
+            dt = (sds.dtype_token if sds is not None and sds.dtype_token
+                  else _spec_dtype(entry, variant,
+                                   sds.dtype_root if sds else None))
+            yield spec.dims, dt, \
+                (spec.lineno, spec.end_lineno), f"out_specs[{j}]"
+    for k, s in enumerate(facts.scratch):
+        if isinstance(s, Vmem) and isinstance(s.dims, list):
+            yield s.dims, _scratch_dtype(entry, variant, s.dtype), \
+                (s.lineno, s.end_lineno), f"scratch_shapes[{k}]"
+
+
+def _check_resolution(entry: Kernel, variant: KernelVariant,
+                      facts: Facts) -> list:
+    """Unresolvable facts fail loudly (kernel-extract), never silently
+    exempt a spec from the tile/vmem rules or a site from the alias
+    contract."""
+    findings = []
+
+    def bad(lineno, what):
+        findings.append(Finding(
+            "kernel-extract", facts.src_path, lineno,
+            f"{entry.name}[{variant.name}]: {what} did not resolve under "
+            f"the variant bindings — extend the bindings (or simplify the "
+            f"expression) so the checker can see the shape"))
+
+    for i, spec in enumerate(facts.in_specs):
+        if isinstance(spec, Block) and spec.dims is UNRESOLVED:
+            bad(spec.lineno, f"in_specs[{i}]'s block shape")
+    for j, spec in enumerate(facts.out_specs):
+        if isinstance(spec, Block) and spec.dims is UNRESOLVED:
+            bad(spec.lineno, f"out_specs[{j}]'s block shape")
+    for k, s in enumerate(facts.scratch):
+        if isinstance(s, Vmem) and s.dims is UNRESOLVED:
+            bad(s.lineno, f"scratch_shapes[{k}]'s VMEM shape")
+    if facts.grid is None:
+        bad(facts.call_lineno,
+            "the grid (so the semantics-vs-grid rank check cannot run)")
+    if facts.aliases_unresolved:
+        findings.append(Finding(
+            "kernel-extract", facts.src_path, facts.call_lineno,
+            f"{entry.name}[{variant.name}]: input_output_aliases did not "
+            f"resolve to an int->int dict — the alias contract cannot be "
+            f"checked; build the map from literals/flag-gated subscript "
+            f"assignments the checker can evaluate"))
+    return findings
+
+
+def _check_tiles(entry: Kernel, variant: KernelVariant, facts: Facts,
+                 src: SourceFile) -> list:
+    findings = []
+    for dims, dtype, (lineno, end), what in _iter_tiles(entry, variant,
+                                                        facts):
+        if len(dims) < 2:
+            continue
+        sub = MIN_SUBLANES.get(dtype, 8)
+        (lval, lsym), (sval, ssym) = dims[-1], dims[-2]
+        bad = []
+        if (lval is not None and lval != 1 and lval % LANES
+                and lsym not in entry.full_axis):
+            bad.append(f"lane dim {lsym or lval}={lval} is not a multiple "
+                       f"of {LANES}")
+        if (sval is not None and sval != 1 and sval % sub
+                and ssym not in entry.full_axis):
+            bad.append(f"sublane dim {ssym or sval}={sval} is not a "
+                       f"multiple of the {dtype} minimum {sub}")
+        if bad and not src.allowed("kernel-tile", _anchor(lineno, end)):
+            findings.append(Finding(
+                "kernel-tile", src.path, lineno,
+                f"{entry.name}[{variant.name}] {what}: {'; '.join(bad)} — "
+                f"the {dtype} minimum tile is ({sub}, {LANES}); pad the "
+                f"trailing dims, mark the symbol full-axis in "
+                f"kernel_registry, or pragma with the reason the sub-tile "
+                f"is intentional"))
+    return findings
+
+
+def _check_arity(entry: Kernel, variant: KernelVariant, facts: Facts,
+                 src: SourceFile) -> list:
+    body = _find_fn(src, entry.body)
+    if body is None:
+        return [Finding("kernel-extract", src.path, 1,
+                        f"{entry.name}: body {entry.body} not found")]
+    have = _body_ref_count(body, dict(variant.flags, **variant.bindings))
+    if have is None:
+        return [Finding(
+            "kernel-extract", src.path, body.lineno,
+            f"{entry.name}[{variant.name}]: cannot determine the ref "
+            f"count of {entry.body} (unrecognized unpack idiom)")]
+    if facts.num_prefetch is None:
+        return [Finding(
+            "kernel-extract", src.path, facts.call_lineno,
+            f"{entry.name}[{variant.name}]: num_scalar_prefetch did not "
+            f"resolve to an int")]
+    want = (facts.num_prefetch + len(facts.in_specs) + len(facts.out_specs)
+            + len(facts.scratch))
+    if have != want and not src.allowed("kernel-arity",
+                                        _anchor(facts.call_lineno)):
+        return [Finding(
+            "kernel-arity", src.path, facts.call_lineno,
+            f"{entry.name}[{variant.name}]: kernel body {entry.body} "
+            f"consumes {have} refs but the specs provide {want} "
+            f"(num_scalar_prefetch {facts.num_prefetch} + "
+            f"{len(facts.in_specs)} in + {len(facts.out_specs)} out + "
+            f"{len(facts.scratch)} scratch) — the dma3 rc_ref crash "
+            f"class: a ref list and its spec lists drifted apart")]
+    return []
+
+
+def _check_aliases(entry: Kernel, variant: KernelVariant, facts: Facts,
+                   src: SourceFile) -> list:
+    findings = []
+    ln = facts.call_lineno
+
+    def emit(msg):
+        if not src.allowed("kernel-alias", _anchor(ln)):
+            findings.append(Finding("kernel-alias", src.path, ln,
+                                    f"{entry.name}[{variant.name}]: {msg}"))
+
+    for in_idx, out_idx in sorted(facts.aliases.items()):
+        if facts.num_prefetch is not None and in_idx < facts.num_prefetch:
+            emit(f"input_output_aliases maps scalar-prefetch operand "
+                 f"{in_idx} — prefetch args cannot alias outputs")
+            continue
+        opname = (facts.operands[in_idx]
+                  if in_idx < len(facts.operands) else None)
+        if opname is None:
+            emit(f"aliased input operand {in_idx} does not resolve to a "
+                 f"named array — the shape/dtype contract cannot be "
+                 f"checked")
+            continue
+        if out_idx >= len(facts.out_shape) or not isinstance(
+                facts.out_shape[out_idx], SDS):
+            emit(f"aliased output {out_idx} has no ShapeDtypeStruct entry")
+            continue
+        sds = facts.out_shape[out_idx]
+        for half, root in (("shaped", sds.shape_root),
+                           ("dtyped", sds.dtype_root)):
+            if root != opname:
+                emit(f"alias {in_idx}->{out_idx} pairs input `{opname}` "
+                     f"with an output {half} from "
+                     f"`{root or '<not an array reference>'}` — aliased "
+                     f"pairs must agree in shape and dtype (build the "
+                     f"ShapeDtypeStruct from the same array's .shape and "
+                     f".dtype)")
+        if opname not in entry.aliased:
+            emit(f"aliased buffer `{opname}` is not declared in the "
+                 f"kernel registry's `aliased` tuple — every fused-write "
+                 f"surface must be registered so the donation cross-check "
+                 f"covers it")
+    return findings
+
+
+def _check_grid(entry: Kernel, variant: KernelVariant, facts: Facts,
+                src: SourceFile) -> list:
+    findings = []
+    ln = facts.call_lineno
+    if facts.semantics is None:
+        if not src.allowed("kernel-grid", _anchor(ln)):
+            findings.append(Finding(
+                "kernel-grid", src.path, ln,
+                f"{entry.name}[{variant.name}]: dimension_semantics did "
+                f"not resolve — every pallas_call must declare its grid "
+                f"semantics statically"))
+        return findings
+    if facts.grid is not None and len(facts.semantics) != len(facts.grid):
+        if not src.allowed("kernel-grid", _anchor(ln)):
+            findings.append(Finding(
+                "kernel-grid", src.path, ln,
+                f"{entry.name}[{variant.name}]: {len(facts.semantics)} "
+                f"dimension_semantics entries for a rank-"
+                f"{len(facts.grid)} grid"))
+    if "parallel" in facts.semantics:
+        body = _find_fn(src, entry.body)
+        state = _state_roots(body) if body is not None else set()
+        if state and not entry.parallel_reason:
+            if not src.allowed("kernel-grid", _anchor(ln)):
+                findings.append(Finding(
+                    "kernel-grid", src.path, ln,
+                    f"{entry.name}[{variant.name}]: grid axes are "
+                    f"declared \"parallel\" but {entry.body} "
+                    f"stores-then-loads ref(s) {sorted(state)} across "
+                    f"grid steps — the write-then-read shape that forced "
+                    f"ragged's fused grid to \"arbitrary\". Either flip "
+                    f"the semantics or add a `parallel_reason` to the "
+                    f"registry entry explaining why no program reads "
+                    f"state another program wrote"))
+    return findings
+
+
+def step_vmem_bytes(entry: Kernel, variant: KernelVariant,
+                    facts: Facts) -> Optional[int]:
+    """The ledger: per-grid-step VMEM working set (pipelined blocks are
+    double-buffered by Mosaic; scratch persists single-buffered)."""
+    total = 0
+    resolved_any = False
+    for dims, dtype, _, what in _iter_tiles(entry, variant, facts):
+        vals = [v for v, _ in dims]
+        if any(v is None for v in vals):
+            return None
+        n = 1
+        for v in vals:
+            n *= v
+        factor = 1 if what.startswith("scratch") else 2
+        total += n * DTYPE_BYTES.get(dtype, 2) * factor
+        resolved_any = True
+    if entry.extra_vmem:
+        try:
+            expr = ast.parse(entry.extra_vmem, mode="eval").body
+        except SyntaxError:
+            return None
+        extra = _eval(expr, facts.env)
+        if not isinstance(extra, (int, float)):
+            return None
+        total += int(extra)
+        resolved_any = True
+    return total if resolved_any else 0
+
+
+def _check_budget(entry: Kernel, variant: KernelVariant, facts: Facts,
+                  src: SourceFile) -> list:
+    total = step_vmem_bytes(entry, variant, facts)
+    if total is None:
+        return [Finding(
+            "kernel-extract", src.path, facts.call_lineno,
+            f"{entry.name}[{variant.name}]: a VMEM tile dim did not "
+            f"resolve under the variant bindings — the budget ledger "
+            f"cannot be computed; extend the bindings")]
+    over = [g for g in entry.generations
+            if total > VMEM_BYTES_PER_CORE.get(g, 0)]
+    if over and not src.allowed("kernel-vmem", _anchor(facts.call_lineno)):
+        return [Finding(
+            "kernel-vmem", src.path, facts.call_lineno,
+            f"{entry.name}[{variant.name}]: per-grid-step working set "
+            f"{total} bytes exceeds the VMEM budget on {over} "
+            f"({', '.join(f'{g}={VMEM_BYTES_PER_CORE[g]}' for g in over)}) "
+            f"— shrink the tiles or chunk the walk")]
+    return []
+
+
+# ------------------------------------------------------------------ check
+
+
+def _scan_sites(srcs: Iterable[SourceFile]) -> dict:
+    """(module relpath, wrapper fn name) -> def lineno, for every
+    function containing a pl.pallas_call."""
+    sites: dict = {}
+    for src in srcs:
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.FunctionDef):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and dotted(sub.func) in (
+                        "pl.pallas_call", "pallas_call"):
+                    sites.setdefault((src.path, node.name), node.lineno)
+                    break
+    return sites
+
+
+def _donated_names(root: str, runner_path: Optional[str]) -> set:
+    path = runner_path or os.path.join(root, donation.RUNNER_RELPATH)
+    try:
+        runner_src = SourceFile(path, root)
+    except (OSError, SyntaxError):
+        return set()
+    jit_donates: set = set()
+    for methods in donation.donation_map(runner_src).values():
+        jit_donates |= methods
+    # donation_map intersects with method params; also take the raw
+    # donate_argnames so pool containers donated under a different
+    # parameter spelling still count.
+    for node in ast.walk(runner_src.tree):
+        if isinstance(node, ast.Call) and dotted(node.func) in ("jax.jit",
+                                                                "jit"):
+            for kw in node.keywords:
+                if kw.arg in ("donate_argnames", "donate_argnums") and (
+                        isinstance(kw.value, (ast.Tuple, ast.List))):
+                    for elt in kw.value.elts:
+                        if isinstance(elt, ast.Constant) and isinstance(
+                                elt.value, str):
+                            jit_donates.add(elt.value)
+    return jit_donates
+
+
+def check(root: Optional[str] = None,
+          registry: tuple[Kernel, ...] = KERNELS,
+          paths: Optional[Iterable[str]] = None,
+          runner_path: Optional[str] = None,
+          doc_path: Optional[str] = None,
+          check_doc: bool = True) -> list[Finding]:
+    root = root or repo_root()
+    if paths is None:
+        paths = [os.path.join(root, OPS_PALLAS_DIR)]
+    files = [SourceFile(p, root) for p in iter_python_files(paths)]
+    by_path = {src.path: src for src in files}
+    findings: list[Finding] = []
+    for src in files:
+        findings.extend(bare_pragma_findings(src))
+
+    # Call-site <-> registry parity.
+    sites = _scan_sites(files)
+    registered = {(e.module.replace(os.sep, "/"), e.wrapper)
+                  for e in registry}
+    for (path, fname), lineno in sorted(sites.items()):
+        key = (path.replace(os.sep, "/"), fname)
+        if key not in registered:
+            src = by_path[path]
+            if not src.allowed("kernel-unregistered", _anchor(lineno)):
+                findings.append(Finding(
+                    "kernel-unregistered", path, lineno,
+                    f"pl.pallas_call site `{fname}` has no entry in "
+                    f"statics/kernel_registry.py — declare its grid, "
+                    f"variants, dtypes and (if fused) aliasing before "
+                    f"landing a new kernel"))
+    site_keys = {(p.replace(os.sep, "/"), f) for (p, f) in sites}
+    reg_relpath = os.path.join("agentic_traffic_testing_tpu", "statics",
+                               "kernel_registry.py")
+    dead: set = set()
+    for e in registry:
+        if (e.module.replace(os.sep, "/"), e.wrapper) not in site_keys:
+            dead.add(e.name)
+            findings.append(Finding(
+                "kernel-registry-dead", reg_relpath, 1,
+                f"registry entry `{e.name}` points at "
+                f"{e.module}:{e.wrapper} but no pl.pallas_call site "
+                f"exists there — delete the entry or fix the pointer"))
+
+    donated = _donated_names(root, runner_path)
+    facts_map: dict = {}
+
+    for entry in registry:
+        if entry.name in dead:
+            continue  # registry-dead already reported
+        src = by_path.get(entry.module) or by_path.get(
+            entry.module.replace("/", os.sep))
+        if src is None:
+            continue
+        if entry.aliased:
+            missing = [d for d in entry.donated_as if d not in donated]
+            if not entry.donated_as or missing:
+                findings.append(Finding(
+                    "kernel-alias", reg_relpath, 1,
+                    f"`{entry.name}` declares aliased fused-write buffers "
+                    f"{list(entry.aliased)} but its donated_as "
+                    f"{list(entry.donated_as)} is not covered by the "
+                    f"runner's donate_argnames {sorted(donated)} — the "
+                    f"donation checker cannot see post-dispatch reads of "
+                    f"an aliased pool that is never donated"))
+        any_aliases = False
+        for variant in entry.variants:
+            try:
+                facts = extract(src, entry, variant)
+            except ExtractError as exc:
+                findings.append(Finding(
+                    "kernel-extract", src.path, 1,
+                    f"{entry.name}[{variant.name}]: {exc}"))
+                continue
+            facts_map[(entry.name, variant.name)] = facts
+            any_aliases = any_aliases or bool(facts.aliases)
+            findings.extend(_check_resolution(entry, variant, facts))
+            findings.extend(_check_tiles(entry, variant, facts, src))
+            findings.extend(_check_arity(entry, variant, facts, src))
+            findings.extend(_check_aliases(entry, variant, facts, src))
+            findings.extend(_check_grid(entry, variant, facts, src))
+            findings.extend(_check_budget(entry, variant, facts, src))
+        if entry.aliased and not any_aliases:
+            # The dead-row direction of the alias contract: a declaration
+            # with no variant actually emitting input_output_aliases means
+            # the fused in-place write silently stopped existing (or the
+            # registry row is stale) while docs still claim it.
+            findings.append(Finding(
+                "kernel-alias", reg_relpath, 1,
+                f"`{entry.name}` declares aliased buffers "
+                f"{list(entry.aliased)} but no variant's call site emits "
+                f"input_output_aliases — delete the declaration or "
+                f"restore the fused in-place write"))
+
+    if check_doc:
+        doc_abs = doc_path or os.path.join(root, DOC_RELPATH)
+        drift = doc_drift_finding("kernel-docs-stale", doc_abs, DOC_RELPATH,
+                                  render(root, registry,
+                                         _facts=facts_map),
+                                  "the kernel registry")
+        if drift is not None:
+            findings.append(drift)
+    return findings
+
+
+# ------------------------------------------------------------------- docs
+
+
+def _fmt_bytes(n: Optional[int]) -> str:
+    if n is None:
+        return "?"
+    if n >= 2**20:
+        return f"{n / 2**20:.2f} MiB"
+    if n >= 1024:
+        return f"{n / 1024:.1f} KiB"
+    return f"{n} B"
+
+
+def _fmt_tiles(entry: Kernel, variant: KernelVariant, facts: Facts) -> str:
+    parts = []
+    for dims, dtype, _, what in _iter_tiles(entry, variant, facts):
+        shape = "x".join(str(v) if v is not None else "?" for v, _ in dims)
+        kind = what.split("[")[0].replace("_specs", "").replace(
+            "_shapes", "")
+        parts.append(f"{kind}({shape}) {dtype}")
+    return ", ".join(parts) if parts else "—"
+
+
+def render(root: Optional[str] = None,
+           registry: tuple[Kernel, ...] = KERNELS,
+           _facts: Optional[dict] = None) -> str:
+    """The generated docs/kernels.md content (regenerate via
+    `python scripts/dev/statics_all.py --write-docs`).
+
+    `_facts` lets check() hand over its already-extracted
+    (kernel, variant) facts so the doc-drift compare reuses the exact
+    facts the rules ran on instead of re-running the symbolic
+    execution."""
+    root = root or repo_root()
+    lines = [
+        "# Pallas kernel contracts",
+        "",
+        "<!-- GENERATED FILE — do not edit by hand. -->",
+        "<!-- Source of truth: agentic_traffic_testing_tpu/statics/"
+        "kernel_registry.py -->",
+        "<!-- + the extracted pl.pallas_call facts; regenerate with -->",
+        "<!-- `python scripts/dev/statics_all.py --write-docs`. -->",
+        "",
+        "Every `pl.pallas_call` site under `ops/pallas/`, as declared in",
+        "the kernel registry and validated by the `kernelcontract`",
+        "checker (tiling legality, body arity, aliasing, grid semantics,",
+        "VMEM budget — see docs/statics.md). VMEM/step is the checker's",
+        "per-grid-step working-set ledger at the variant's representative",
+        "serving shape: pipelined blocks double-buffered, scratch",
+        "single-buffered, plus any declared scoped extra.",
+        "",
+    ]
+    for entry in registry:
+        src_path = os.path.join(root, entry.module)
+        try:
+            src = SourceFile(src_path, root)
+        except (OSError, SyntaxError):
+            src = None
+        lines.append(f"## `{entry.name}` — "
+                     f"`{entry.module.replace(os.sep, '/')}`")
+        lines.append("")
+        lines.append(f"{entry.intent}. Grid: {entry.grid}. "
+                     f"Body: `{entry.body}`.")
+        if entry.aliased:
+            lines.append(f"Aliased in/out: "
+                         f"{', '.join(f'`{a}`' for a in entry.aliased)} "
+                         f"(donated as "
+                         f"{', '.join(f'`{d}`' for d in entry.donated_as)}"
+                         f").")
+        if entry.parallel_reason:
+            lines.append(f"Parallel-axis justification: "
+                         f"{entry.parallel_reason}.")
+        lines.append("")
+        lines.append("| Variant | Grid | Semantics | Tiles (per step) | "
+                     "VMEM/step |")
+        lines.append("|---|---|---|---|---|")
+        for variant in entry.variants:
+            grid = sem = tiles = vmem = "?"
+            if src is not None:
+                facts = (_facts or {}).get((entry.name, variant.name))
+                if facts is None:
+                    try:
+                        facts = extract(src, entry, variant)
+                    except ExtractError:
+                        facts = None
+                if facts is not None:
+                    if facts.grid is not None:
+                        grid = "(" + ", ".join(str(g) for g in facts.grid) \
+                            + ")"
+                    if facts.semantics is not None:
+                        sem = ", ".join(facts.semantics)
+                    tiles = _fmt_tiles(entry, variant, facts)
+                    vmem = _fmt_bytes(step_vmem_bytes(entry, variant,
+                                                      facts))
+            lines.append(f"| `{variant.name}` | {grid} | {sem} | {tiles} | "
+                         f"{vmem} |")
+        lines.append("")
+    return "\n".join(lines)

@@ -1,7 +1,7 @@
 #!/usr/bin/env python3
 """Run the statics plane: every AST invariant checker, one JSON report.
 
-The six checkers (agentic_traffic_testing_tpu/statics/):
+The seven checkers (agentic_traffic_testing_tpu/statics/):
 
   knobs         every LLM_*/ATT_*/BENCH_* env read is registered in
                 statics/knob_registry.py, no registry entry is dead, and
@@ -20,6 +20,14 @@ The six checkers (agentic_traffic_testing_tpu/statics/):
                 docs/threading.md parity)
   metric-docs   Prometheus families <-> docs/monitoring.md parity
                 (scripts/dev/check_metric_docs.py behind a thin shim)
+  kernelcontract
+                every pl.pallas_call under ops/pallas/ honors its
+                declared launch contract (statics/kernel_registry.py):
+                dtype-legal tile shapes, kernel-body arity matching the
+                spec lists, aliasing pairs that agree and are donated,
+                justified "parallel" grid semantics, and a per-grid-step
+                VMEM working set inside the per-generation budget table;
+                docs/kernels.md matches the registry render
 
 Usage:
   python scripts/dev/statics_all.py              # check; JSON report
@@ -52,15 +60,16 @@ sys.path.insert(0, REPO)
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--write-docs", action="store_true",
-                   help="regenerate docs/knobs.md, docs/capabilities.md "
-                        "+ docs/threading.md from their source-of-truth "
-                        "surfaces before checking")
+                   help="regenerate docs/knobs.md, docs/capabilities.md, "
+                        "docs/threading.md + docs/kernels.md from their "
+                        "source-of-truth surfaces before checking")
     p.add_argument("--quiet", action="store_true",
                    help="suppress the JSON report; exit code only")
     p.add_argument("--only", action="append", metavar="CHECKER",
                    help="run only this checker (repeatable); names are "
                         "the report keys (knobs, capabilities, "
-                        "host-sync, donation, concurrency, metric-docs)")
+                        "host-sync, donation, concurrency, metric-docs, "
+                        "kernelcontract)")
     a = p.parse_args(argv)
 
     from agentic_traffic_testing_tpu.statics import run_all, write_docs

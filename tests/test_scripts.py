@@ -414,10 +414,12 @@ def test_statics_all_smoke(capsys):
     unsuppressed findings — tier-1 therefore fails on any new
     unregistered env knob, supports_* flag without a refusal guard,
     un-pragma'd host sync in a hot region, post-donation buffer read,
-    unowned cross-thread attribute write, lock-discipline violation, or
-    knob/capability/threading doc drift (the per-checker behavior is
-    pinned in tests/test_statics.py and tests/test_statics_concurrency.py
-    against fixture trees)."""
+    unowned cross-thread attribute write, lock-discipline violation,
+    Pallas launch-contract violation (illegal tile, arity drift,
+    aliasing mismatch, unjustified parallel grid, VMEM blowout), or
+    knob/capability/threading/kernel doc drift (the per-checker behavior
+    is pinned in tests/test_statics.py, tests/test_statics_concurrency.py
+    and tests/test_statics_kernels.py against fixture trees)."""
     statics_all = load_script("scripts/dev/statics_all.py", "statics_all")
     rc = statics_all.main([])
     out = capsys.readouterr().out
@@ -428,7 +430,7 @@ def test_statics_all_smoke(capsys):
     assert report["ok"] is True
     assert set(report["checkers"]) == {
         "knobs", "capabilities", "host-sync", "donation", "concurrency",
-        "metric-docs"}
+        "metric-docs", "kernelcontract"}
     # Per-checker wall time rides the report so CI can spot a checker
     # whose scan cost regressed.
     for entry in report["checkers"].values():
@@ -447,6 +449,21 @@ def test_statics_all_only_flag(capsys):
     report = json_mod.loads(out)
     assert set(report["checkers"]) == {"concurrency"}
     assert statics_all.main(["--only", "nonesuch", "--quiet"]) == 2
+
+
+def test_statics_all_only_kernelcontract(capsys):
+    """The seventh checker is individually addressable and reports its
+    wall time like the rest."""
+    statics_all = load_script("scripts/dev/statics_all.py", "statics_all")
+    rc = statics_all.main(["--only", "kernelcontract"])
+    out = capsys.readouterr().out
+    assert rc == 0, out
+    import json as json_mod
+
+    report = json_mod.loads(out)
+    assert set(report["checkers"]) == {"kernelcontract"}
+    assert isinstance(
+        report["checkers"]["kernelcontract"]["wall_time_s"], float)
 
 
 # --------------------------------------------------------- platform guard

@@ -618,7 +618,7 @@ def test_run_all_green_on_tree():
         if c["findings"]}
     assert set(report["checkers"]) == {
         "knobs", "capabilities", "host-sync", "donation", "concurrency",
-        "metric-docs"}
+        "metric-docs", "kernelcontract"}
 
 
 def test_run_all_dedups_repeats_not_distinct_findings(monkeypatch):
@@ -640,13 +640,15 @@ def test_run_all_dedups_repeats_not_distinct_findings(monkeypatch):
 def test_generated_docs_round_trip(tmp_path):
     """write_docs output == committed docs (the regenerate-and-diff gate,
     exercised through the real --write-docs file-writing path)."""
-    # Mirror the runner + serving-plane sources into a tmp root so
-    # write_docs() runs its actual path joins and file writes without
+    # Mirror the runner + serving-plane + kernel sources into a tmp root
+    # so write_docs() runs its actual path joins and file writes without
     # touching the repo.
-    from agentic_traffic_testing_tpu.statics import concurrency
+    from agentic_traffic_testing_tpu.statics import concurrency, kernelcontract
+    from agentic_traffic_testing_tpu.statics.kernel_registry import KERNELS
 
     for rel in ((capabilities.RUNNER_RELPATH,) + capabilities.MESH_RELPATHS
-                + concurrency.SCAN_RELPATHS):
+                + concurrency.SCAN_RELPATHS
+                + tuple({k.module for k in KERNELS})):
         dst = tmp_path / rel
         dst.parent.mkdir(parents=True, exist_ok=True)
         dst.write_text(open(os.path.join(REPO, rel)).read())
@@ -654,7 +656,7 @@ def test_generated_docs_round_trip(tmp_path):
     written = write_docs(str(tmp_path))
     assert sorted(written) == sorted(
         [knobs.DOC_RELPATH, capabilities.DOC_RELPATH,
-         concurrency.DOC_RELPATH])
+         concurrency.DOC_RELPATH, kernelcontract.DOC_RELPATH])
     for rel in written:
         committed = open(os.path.join(REPO, rel)).read()
         assert (tmp_path / rel).read_text() == committed

@@ -1,0 +1,539 @@
+"""The kernel-contract checker (statics/kernelcontract.py).
+
+Seeded-violation fixtures per rule — an illegal int8 (16, 128) tile, a
+dropped scratch param (the dma3 `rc_ref` crash class), a
+shape-mismatched alias, a parallel-axis write-then-read, a VMEM budget
+blowout — plus pragma-suppression and clean-tree negatives, registry
+parity both ways, the budget-constant unification, and the
+generate-vs-committed docs/kernels.md round trip.
+
+Pure AST work on tmp fixture trees: no jax arrays, no kernels traced —
+milliseconds in the default tier (the two constant-unification tests
+import ops modules, which pull jax but trace nothing).
+"""
+
+import os
+import textwrap
+
+import pytest
+
+from agentic_traffic_testing_tpu.statics import kernelcontract
+from agentic_traffic_testing_tpu.statics.common import Finding, repo_root
+from agentic_traffic_testing_tpu.statics.kernel_registry import (
+    INT4_UNPACK_I32_BUDGET_BYTES,
+    KERNELS,
+    PIPELINE_VMEM_BUDGET_BYTES,
+    VMEM_BYTES_PER_CORE,
+    Kernel,
+    KernelVariant,
+)
+
+REPO = repo_root()
+
+
+def write(tmp_path, relpath: str, body: str) -> str:
+    p = tmp_path / relpath
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(body))
+    return str(p)
+
+
+def rules(findings: list[Finding]) -> list[str]:
+    return sorted(f.rule for f in findings)
+
+
+RUNNER = """\
+    class Runner:
+        def __init__(self):
+            self._decode = jax.jit(_impl, donate_argnames=("cache",))
+
+        def decode(self, cache):
+            return self._decode(cache)
+"""
+
+# The baseline fixture: arity 0+1+1+1 == the 3 kernel params, legal f32
+# (32, 128) tiles, "arbitrary" grid — every test below perturbs exactly
+# one contract surface.
+CLEAN = """\
+    def _fix_kernel(x_ref, o_ref, acc_ref):
+        acc_ref[...] = x_ref[...]
+        o_ref[...] = acc_ref[...]
+
+    def fix_wrapper(x):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(8,),
+            in_specs=[pl.BlockSpec((32, 128), lambda i: (i, 0))],
+            out_specs=pl.BlockSpec((32, 128), lambda i: (i, 0)),
+            scratch_shapes=[pltpu.VMEM((32, 128), jnp.float32)],
+        )
+        return pl.pallas_call(
+            _fix_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            compiler_params=CompilerParams(
+                dimension_semantics=("arbitrary",)),
+        )(x)
+"""
+
+
+def entry(**kw) -> Kernel:
+    base = dict(
+        name="fix", module="m.py", wrapper="fix_wrapper",
+        body="_fix_kernel", grid="(8,)", intent="fixture",
+        variants=(KernelVariant("base"),),
+    )
+    base.update(kw)
+    return Kernel(**base)
+
+
+def check_fixture(tmp_path, source: str, kernel: Kernel) -> list[Finding]:
+    mpath = write(tmp_path, "m.py", source)
+    rpath = write(tmp_path, "runner.py", RUNNER)
+    return kernelcontract.check(
+        root=str(tmp_path), registry=(kernel,), paths=[mpath],
+        runner_path=rpath, check_doc=False)
+
+
+# ------------------------------------------------------------- clean tree
+
+
+def test_fixture_clean(tmp_path):
+    assert check_fixture(tmp_path, CLEAN, entry()) == []
+
+
+def test_repo_tree_clean():
+    """Every real ops/pallas/ call site honors its declared contract
+    (fixed or reason-pragma'd — zero bare allows) and docs/kernels.md is
+    current: the acceptance bar for every future kernel edit."""
+    assert kernelcontract.check(REPO) == []
+
+
+# ----------------------------------------------------------------- tiling
+
+
+def test_illegal_int8_tile_fires(tmp_path):
+    """The acceptance seed: a (16, 128) tile on an int8 operand is below
+    the (32, 128) int8 minimum — the 8-bit tiling-legality bug class."""
+    src = CLEAN.replace("(32, 128), lambda i: (i, 0))],",
+                        "(16, 128), lambda i: (i, 0))],")
+    kern = entry(variants=(KernelVariant("int8", dtypes={"x": "int8"}),))
+    fs = check_fixture(tmp_path, src, kern)
+    assert rules(fs) == ["kernel-tile"]
+    assert "int8 minimum 32" in fs[0].message
+
+
+def test_bf16_sublane_minimum(tmp_path):
+    """(8, 128) is legal f32 but sub-minimum bf16 (16, 128)."""
+    src = CLEAN.replace("(32, 128)", "(8, 128)").replace(
+        "jnp.float32", "x.dtype")
+    assert check_fixture(
+        tmp_path, src,
+        entry(variants=(KernelVariant("f32", dtypes={"x": "f32"}),))) == []
+    fs = check_fixture(
+        tmp_path, src,
+        entry(variants=(KernelVariant("bf16", dtypes={"x": "bf16"}),)))
+    assert "kernel-tile" in rules(fs)
+
+
+def test_unaligned_lane_dim_fires(tmp_path):
+    src = CLEAN.replace("(32, 128), lambda i: (i, 0))],",
+                        "(32, 100), lambda i: (i, 0))],")
+    fs = check_fixture(tmp_path, src, entry())
+    assert rules(fs) == ["kernel-tile"]
+    assert "multiple of 128" in fs[0].message
+
+
+def test_full_axis_symbol_exempt(tmp_path):
+    """A sub-sublane dim spelled as a registry full-axis symbol is legal
+    (the block spans the operand's whole axis; Mosaic pads once)."""
+    src = CLEAN.replace(
+        "def fix_wrapper(x):", "def fix_wrapper(x):\n        rows = 4")
+    src = src.replace("in_specs=[pl.BlockSpec((32, 128), lambda i: (i, 0))]",
+                      "in_specs=[pl.BlockSpec((rows, 128), lambda i: (i, 0))]")
+    fs = check_fixture(tmp_path, src, entry(full_axis=frozenset({"rows"})))
+    assert fs == []
+    assert "kernel-tile" in rules(check_fixture(tmp_path, src, entry()))
+
+
+def test_tile_pragma_suppresses(tmp_path):
+    src = CLEAN.replace(
+        "in_specs=[pl.BlockSpec((32, 128), lambda i: (i, 0))],",
+        "in_specs=[pl.BlockSpec((16, 128), lambda i: (i, 0))],"
+        "  # statics: allow-kernel-tile(deliberate sub-tile fixture)")
+    kern = entry(variants=(KernelVariant("int8", dtypes={"x": "int8"}),))
+    assert check_fixture(tmp_path, src, kern) == []
+
+
+def test_out_spec_literal_dtype_checked(tmp_path):
+    """An out_shape dtyped by a LITERAL jnp dtype is tile-checked under
+    that dtype, not the kernel's default — an illegal int8 out tile
+    fires even when the entry's default_dtype would make it legal."""
+    src = CLEAN.replace("jax.ShapeDtypeStruct(x.shape, x.dtype)",
+                        "jax.ShapeDtypeStruct((64, 128), jnp.int8)")
+    src = src.replace("out_specs=pl.BlockSpec((32, 128), lambda i: (i, 0)),",
+                      "out_specs=pl.BlockSpec((16, 128), lambda i: (i, 0)),")
+    fs = check_fixture(tmp_path, src, entry())
+    assert "kernel-tile" in rules(fs)
+    assert any("int8 minimum 32" in f.message for f in fs)
+
+
+def test_lane_dim_of_one_is_exempt(tmp_path):
+    """A trailing dim of exactly 1 is a replicated vector in either
+    position — the documented exemption covers the lane dim too."""
+    src = CLEAN.replace("pltpu.VMEM((32, 128), jnp.float32)",
+                        "pltpu.VMEM((8, 1), jnp.float32)")
+    assert check_fixture(tmp_path, src, entry()) == []
+
+
+# ------------------------------------------------------------------ arity
+
+
+def test_dropped_scratch_param_fires(tmp_path):
+    """The acceptance seed (the PR-1 dma3 rc_ref crash, at lint time):
+    the spec lists stop providing a ref the body still consumes."""
+    src = CLEAN.replace(
+        "scratch_shapes=[pltpu.VMEM((32, 128), jnp.float32)],",
+        "scratch_shapes=[],")
+    fs = check_fixture(tmp_path, src, entry())
+    assert rules(fs) == ["kernel-arity"]
+    assert "consumes 3 refs but the specs provide 2" in fs[0].message
+
+
+def test_arity_counts_flag_gated_next_refs(tmp_path):
+    """*refs bodies are counted through their flag-gated next(it)
+    prologue, so a variant's ref count follows its configuration."""
+    src = """\
+        def _fix_kernel(*refs, quantized):
+            it = iter(refs)
+            x_ref, o_ref = next(it), next(it)
+            if quantized:
+                s_ref = next(it)
+            acc_ref = next(it)
+
+        def fix_wrapper(x, quantized):
+            in_specs = [pl.BlockSpec((32, 128), lambda i: (i, 0))]
+            if quantized:
+                in_specs += [pl.BlockSpec((32, 128), lambda i: (i, 0))]
+            grid_spec = pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=0,
+                grid=(8,),
+                in_specs=in_specs,
+                out_specs=pl.BlockSpec((32, 128), lambda i: (i, 0)),
+                scratch_shapes=[pltpu.VMEM((32, 128), jnp.float32)],
+            )
+            return pl.pallas_call(
+                _fix_kernel,
+                grid_spec=grid_spec,
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+                compiler_params=CompilerParams(
+                    dimension_semantics=("arbitrary",)),
+            )(x)
+    """
+    kern = entry(variants=(
+        KernelVariant("base", flags={"quantized": False}),
+        KernelVariant("quant", flags={"quantized": True}),
+    ))
+    assert check_fixture(tmp_path, src, kern) == []
+    # Dropping the flag-gated spec breaks ONLY the quantized variant.
+    broken = src.replace("            if quantized:\n"
+                         "                in_specs += "
+                         "[pl.BlockSpec((32, 128), lambda i: (i, 0))]\n",
+                         "")
+    fs = check_fixture(tmp_path, broken, kern)
+    assert rules(fs) == ["kernel-arity"]
+    assert "[quant]" in fs[0].message
+
+
+# --------------------------------------------------------------- aliasing
+
+
+ALIAS = """\
+    def _fix_kernel(x_ref, y_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    def fix_wrapper(x, y):
+        grid_spec = pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(8,),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.ANY),
+                      pl.BlockSpec(memory_space=pltpu.ANY)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+            scratch_shapes=[],
+        )
+        return pl.pallas_call(
+            _fix_kernel,
+            grid_spec=grid_spec,
+            out_shape=jax.ShapeDtypeStruct({out_of}.shape, {out_of}.dtype),
+            input_output_aliases={{0: 0}},
+            compiler_params=CompilerParams(
+                dimension_semantics=("arbitrary",)),
+        )(x, y)
+"""
+
+
+def test_alias_agreeing_pair_clean(tmp_path):
+    src = ALIAS.format(out_of="x")
+    kern = entry(aliased=("x",), donated_as=("cache",))
+    assert check_fixture(tmp_path, src, kern) == []
+
+
+def test_shape_mismatched_alias_fires(tmp_path):
+    """The acceptance seed: aliasing input x onto an output whose
+    ShapeDtypeStruct is built from a DIFFERENT array."""
+    src = ALIAS.format(out_of="y")
+    kern = entry(aliased=("x",), donated_as=("cache",))
+    fs = check_fixture(tmp_path, src, kern)
+    assert rules(fs) == ["kernel-alias", "kernel-alias"]  # shape + dtype
+    assert "output shaped from `y`" in fs[0].message
+
+
+def test_dtype_mismatched_alias_fires(tmp_path):
+    """Both halves of the alias contract are enforced: an output shaped
+    from the aliased array but dtyped from a literal (or another array)
+    fails — the dtype half cannot be verified as agreeing."""
+    src = ALIAS.format(out_of="x").replace("x.dtype", "jnp.bfloat16")
+    kern = entry(aliased=("x",), donated_as=("cache",))
+    fs = check_fixture(tmp_path, src, kern)
+    assert rules(fs) == ["kernel-alias"]
+    assert "dtyped from" in fs[0].message
+
+
+def test_two_pallas_calls_in_one_wrapper_refused(tmp_path):
+    """A second pl.pallas_call in a registered wrapper is a loud
+    kernel-extract finding, never a silently-unchecked site."""
+    body = CLEAN.replace(
+        "        )(x)\n",
+        "        )(x)\n"
+        "        return pl.pallas_call(\n"
+        "            _fix_kernel,\n"
+        "            grid_spec=grid_spec,\n"
+        "            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),\n"
+        "            compiler_params=CompilerParams(\n"
+        "                dimension_semantics=(\"arbitrary\",)),\n"
+        "        )(x)\n")
+    fs = check_fixture(tmp_path, body, entry())
+    assert "kernel-extract" in rules(fs)
+    assert "exactly one" in " ".join(f.message for f in fs)
+
+
+def test_undeclared_aliased_buffer_fires(tmp_path):
+    src = ALIAS.format(out_of="x")
+    kern = entry(aliased=("z",), donated_as=("cache",))
+    fs = check_fixture(tmp_path, src, kern)
+    assert rules(fs) == ["kernel-alias"]
+    assert "not declared in the kernel registry" in fs[0].message
+
+
+def test_dead_aliased_declaration_fires(tmp_path):
+    """The dead-row direction: a registry `aliased` declaration with no
+    variant emitting input_output_aliases means the fused in-place write
+    silently stopped existing."""
+    fs = check_fixture(tmp_path, CLEAN,
+                       entry(aliased=("x",), donated_as=("cache",)))
+    assert rules(fs) == ["kernel-alias"]
+    assert "no variant's call site emits" in fs[0].message
+
+
+def test_undonated_aliased_pool_fires(tmp_path):
+    """The donation cross-check: an aliased fused-write pool must travel
+    under a runner donate_argnames name, or the donation checker's
+    engine walk cannot see post-dispatch reads of it."""
+    src = ALIAS.format(out_of="x")
+    kern = entry(aliased=("x",), donated_as=("not_donated_anywhere",))
+    fs = check_fixture(tmp_path, src, kern)
+    assert rules(fs) == ["kernel-alias"]
+    assert "donate_argnames" in fs[0].message
+
+
+# ---------------------------------------------------------- grid semantics
+
+
+def test_parallel_write_then_read_fires(tmp_path):
+    """The acceptance seed: a body that stores-then-loads a ref across
+    grid steps under a "parallel" axis with no registry justification —
+    the exact shape that forced ragged's fused grid to "arbitrary"."""
+    src = CLEAN.replace('("arbitrary",)', '("parallel",)')
+    fs = check_fixture(tmp_path, src, entry())
+    assert rules(fs) == ["kernel-grid"]
+    assert "acc_ref" in fs[0].message and "parallel" in fs[0].message
+
+
+def test_parallel_with_registry_reason_clean(tmp_path):
+    src = CLEAN.replace('("arbitrary",)', '("parallel",)')
+    kern = entry(parallel_reason="each program re-initializes its scratch")
+    assert check_fixture(tmp_path, src, kern) == []
+
+
+def test_parallel_pure_map_needs_no_reason(tmp_path):
+    """No cross-step ref state -> "parallel" is trivially safe."""
+    src = CLEAN.replace('("arbitrary",)', '("parallel",)')
+    src = src.replace("        acc_ref[...] = x_ref[...]\n"
+                      "        o_ref[...] = acc_ref[...]\n",
+                      "        o_ref[...] = x_ref[...]\n")
+    assert check_fixture(tmp_path, src, entry()) == []
+
+
+def test_semantics_grid_rank_mismatch_fires(tmp_path):
+    src = CLEAN.replace('("arbitrary",)', '("arbitrary", "arbitrary")')
+    fs = check_fixture(tmp_path, src, entry())
+    assert rules(fs) == ["kernel-grid"]
+    assert "rank-1 grid" in fs[0].message
+
+
+# ------------------------------------------------------------- VMEM budget
+
+
+def test_budget_blowout_fires(tmp_path):
+    """A 32 MiB f32 scratch blows every generation's 16 MiB budget."""
+    src = CLEAN.replace("pltpu.VMEM((32, 128), jnp.float32)",
+                        "pltpu.VMEM((8192, 1024), jnp.float32)")
+    fs = check_fixture(tmp_path, src, entry())
+    assert rules(fs) == ["kernel-vmem"]
+    assert "exceeds the VMEM budget" in fs[0].message
+
+
+def test_budget_counts_double_buffered_blocks(tmp_path):
+    """Pipelined blocks cost 2x (Mosaic double-buffers them): two 6 MiB
+    bf16 blocks would fit single-buffered (12 MiB) but the ledger's
+    double-buffer factor takes them to 24 MiB > 16 MiB."""
+    src = CLEAN.replace("(32, 128), lambda i: (i, 0))],",
+                        "(24576, 128), lambda i: (i, 0))],")
+    src = src.replace("out_specs=pl.BlockSpec((32, 128), lambda i: (i, 0)),",
+                      "out_specs=pl.BlockSpec((24576, 128), lambda i: (i, 0)),")
+    fs = check_fixture(tmp_path, src, entry())
+    assert rules(fs) == ["kernel-vmem"]
+
+
+def test_budget_extra_vmem_expression(tmp_path):
+    """The declared scoped extra (the int4 i32 unpack intermediates)
+    rides the ledger, evaluated in the variant env."""
+    kern = entry(extra_vmem="17 * 2**20")
+    fs = check_fixture(tmp_path, CLEAN, kern)
+    assert rules(fs) == ["kernel-vmem"]
+
+
+# --------------------------------------------------- loud extract failures
+
+
+def test_unresolvable_block_shape_fires(tmp_path):
+    """A shape the interpreter cannot evaluate is a kernel-extract
+    finding, never a silent exemption from the tile/vmem rules."""
+    src = CLEAN.replace(
+        "def fix_wrapper(x):",
+        "def fix_wrapper(x):\n        blk = choose_block(x)")
+    src = src.replace("pl.BlockSpec((32, 128), lambda i: (i, 0))],",
+                      "pl.BlockSpec(blk, lambda i: (i, 0))],")
+    fs = check_fixture(tmp_path, src, entry())
+    assert "kernel-extract" in rules(fs)
+    assert any("in_specs[0]" in f.message for f in fs)
+
+
+def test_unresolvable_vmem_shape_fires(tmp_path):
+    src = CLEAN.replace(
+        "def fix_wrapper(x):",
+        "def fix_wrapper(x):\n        blk = choose_block(x)")
+    src = src.replace("pltpu.VMEM((32, 128), jnp.float32)",
+                      "pltpu.VMEM(blk, jnp.float32)")
+    fs = check_fixture(tmp_path, src, entry())
+    assert "kernel-extract" in rules(fs)
+    assert any("scratch_shapes[0]" in f.message for f in fs)
+
+
+def test_unresolvable_aliases_fires(tmp_path):
+    """An alias map the interpreter cannot evaluate disables the whole
+    alias contract — that must be a finding, not a silent pass."""
+    src = ALIAS.format(out_of="x").replace(
+        "input_output_aliases={0: 0},",
+        "input_output_aliases=_alias_map(x),")
+    kern = entry(aliased=("x",), donated_as=("cache",))
+    fs = check_fixture(tmp_path, src, kern)
+    assert "kernel-extract" in rules(fs)
+    assert any("input_output_aliases" in f.message for f in fs)
+
+
+# ------------------------------------------------------------------ parity
+
+
+def test_unregistered_site_fires(tmp_path):
+    fs = check_fixture(tmp_path, CLEAN,
+                       entry(wrapper="other_wrapper_name"))
+    assert rules(fs) == ["kernel-registry-dead", "kernel-unregistered"]
+
+
+def test_registry_dead_entry_fires(tmp_path):
+    fs = check_fixture(tmp_path, CLEAN, entry(module="nonesuch.py"))
+    assert "kernel-registry-dead" in rules(fs)
+
+
+# ----------------------------------------------- budget-constant unification
+
+
+def test_autotune_budget_is_registry_owned():
+    from agentic_traffic_testing_tpu.ops.pallas import autotune
+
+    assert autotune._VMEM_BUDGET_BYTES == PIPELINE_VMEM_BUDGET_BYTES
+    assert PIPELINE_VMEM_BUDGET_BYTES == 12 * 2**20  # value unchanged
+    assert PIPELINE_VMEM_BUDGET_BYTES < min(VMEM_BYTES_PER_CORE.values())
+
+
+def test_int4_budget_is_registry_owned():
+    from agentic_traffic_testing_tpu.ops.pallas import int4_matmul
+
+    assert int4_matmul.VMEM_I32_BUDGET == INT4_UNPACK_I32_BUDGET_BYTES
+    assert INT4_UNPACK_I32_BUDGET_BYTES == 8_000_000  # value unchanged
+
+
+# ------------------------------------------------------------------- docs
+
+
+def test_kernels_doc_round_trip():
+    """docs/kernels.md regenerates byte-identical to the committed copy."""
+    with open(os.path.join(REPO, "docs", "kernels.md"),
+              encoding="utf-8") as f:
+        committed = f.read()
+    assert committed == kernelcontract.render(REPO)
+
+
+def test_kernels_doc_drift_fires(tmp_path):
+    doc = tmp_path / "kernels.md"
+    doc.write_text(kernelcontract.render(REPO) + "\nEDITED\n")
+    fs = [f for f in kernelcontract.check(REPO, doc_path=str(doc))
+          if f.rule == "kernel-docs-stale"]
+    assert len(fs) == 1 and "--write-docs" in fs[0].message
+    doc.write_text(kernelcontract.render(REPO))
+    assert kernelcontract.check(REPO, doc_path=str(doc)) == []
+
+
+def test_doc_rows_cover_every_registry_variant():
+    doc = kernelcontract.render(REPO)
+    for kern in KERNELS:
+        assert f"## `{kern.name}`" in doc
+        for variant in kern.variants:
+            assert f"| `{variant.name}` |" in doc
+
+
+def test_registry_entries_have_grid_semantics_justifications():
+    """Every in-tree entry whose kernels declare "parallel" axes with
+    carried state documents WHY — the registry carries the justification
+    the checker enforces."""
+    for kern in KERNELS:
+        if kern.name in ("kv_write",):  # all-"arbitrary" grids
+            continue
+        assert kern.parallel_reason, kern.name
+
+
+@pytest.mark.parametrize("kern", KERNELS, ids=lambda k: k.name)
+def test_every_registry_variant_extracts(kern):
+    """The abstract interpreter resolves every declared variant of every
+    real call site (no silent kernel-extract degradation)."""
+    from agentic_traffic_testing_tpu.statics.common import SourceFile
+
+    src = SourceFile(os.path.join(REPO, kern.module), REPO)
+    for variant in kern.variants:
+        facts = kernelcontract.extract(src, kern, variant)
+        assert facts.grid is not None
+        assert facts.semantics is not None
+        assert facts.num_prefetch is not None
+        total = kernelcontract.step_vmem_bytes(kern, variant, facts)
+        assert total is not None
