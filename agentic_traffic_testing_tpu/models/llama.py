@@ -804,17 +804,21 @@ def verify_step_impl(
     attn_mesh=None,           # static Mesh + axis for attn_mode="shard_dma"
     attn_axis: Optional[str] = None,
     fused_kv_write: bool = False,
-) -> tuple[jax.Array, KVCache]:
+    return_kv: bool = False,
+):  # -> (logits, cache) | (logits, cache, k_seq, v_seq) with return_kv
     """Speculative-verify step: S tokens per sequence in one pass.
 
     Returns (logits [B, S, V] fp32 — position i scores the token FOLLOWING
     tokens[:, i] — and the updated cache). The draft-token KV is written at
-    positions+i before attention; rejected drafts leave garbage KV beyond the
-    accepted prefix, which the next decode/verify step overwrites in place
-    (its write range starts exactly at the first rejected slot). The CUDA
-    analog of this capability lives inside vLLM's spec-decode workers for
-    the reference (never in-tree); here it is one more jitted step sharing
-    the decode layer body.
+    positions+i before attention (the paged kernels read the pool); the
+    speculative round's accepted-prefix commit then restores rejected
+    slots to their pre-round bytes (ops/speculative.rollback_commit),
+    which needs every layer's per-position K/V — `return_kv=True` (static)
+    additionally returns the post-rope compute-dtype (k, v) streams as
+    [L, B, S, KH, hd] scan outputs. The CUDA analog of this capability
+    lives inside vLLM's spec-decode workers for the reference (never
+    in-tree); here it is one more jitted step sharing the decode layer
+    body.
 
     A scaled int8 pool (cache.quantized) routes every write through the
     quantizing requant writer and carries the scale arrays in the layer
@@ -826,8 +830,10 @@ def verify_step_impl(
     b, s = tokens.shape
     if fused_kv_write and s != 1:
         raise ValueError(
-            "fused_kv_write serves the single-token decode step only "
-            "(the engine refuses the speculation combination at build)")
+            "fused_kv_write serves the single-token decode step only — "
+            "the multi-token speculative verify keeps its chained write "
+            "sequence (runner._spec_verify_sample_impl never passes the "
+            "flag; this trace-time check is the one guard)")
     pos_grid = positions[:, None] + jnp.arange(s, dtype=jnp.int32)[None]  # [B, S]
     x = embed_lookup(params["tok_embed"], tokens, dtype=params["final_norm"].dtype)
     sin, cos = rope_sin_cos(pos_grid, cfg.head_dim_, cfg.rope_theta, cfg.rope_scaling)
@@ -884,14 +890,18 @@ def verify_step_impl(
         xm = rms_norm(x, lp["ln_mlp"], cfg.rms_norm_eps)
         y, _ = _mlp_block(xm, lp, cfg)  # serving paths drop the MoE aux term
         x = x + y
-        return (x, kc, vc, ksc, vsc), None
+        return (x, kc, vc, ksc, vsc), ((k, v) if return_kv else None)
 
-    (x, kc, vc, ksc, vsc), _ = jax.lax.scan(
+    (x, kc, vc, ksc, vsc), kv_seq = jax.lax.scan(
         body, (x, cache.k, cache.v, cache.k_scale, cache.v_scale),
         (xs_layers, jnp.arange(cfg.num_layers, dtype=jnp.int32)),
     )
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
-    return _unembed(x, params, cfg), KVCache(kc, vc, ksc, vsc)
+    logits = _unembed(x, params, cfg)
+    new_cache = KVCache(kc, vc, ksc, vsc)
+    if return_kv:
+        return logits, new_cache, kv_seq[0], kv_seq[1]
+    return logits, new_cache
 
 
 def hybrid_step_impl(
@@ -1049,6 +1059,7 @@ decode_step = jax.jit(
 )
 verify_step = jax.jit(
     verify_step_impl,
-    static_argnames=("cfg", "attn_mode", "attn_mesh", "attn_axis"),
+    static_argnames=("cfg", "attn_mode", "attn_mesh", "attn_axis",
+                     "fused_kv_write", "return_kv"),
     donate_argnums=(3,),
 )

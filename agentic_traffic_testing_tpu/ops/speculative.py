@@ -1,4 +1,4 @@
-"""N-gram (prompt-lookup) speculative decoding: device-side helpers.
+"""N-gram (prompt-lookup) speculative decoding: the composable round-14 split.
 
 Agentic traffic is highly self-repetitive — workers quote the task, the
 orchestrator quotes the workers, JSON keys and role contracts recur verbatim
@@ -8,20 +8,74 @@ any draft model: propose the γ tokens that followed the most recent earlier
 occurrence of the current trailing n-gram, then verify all γ+1 positions in
 one model step (models/llama.py `verify_step_impl`).
 
-Everything here runs INSIDE the fused decode scan on device
-(runtime/runner.py): the token history rides in the scan carry, so
-speculation adds zero host round trips — the decisive constraint on this
-hardware, where a dispatch costs ~3 ms through the tunnel.
+Round 14 rebuilt the split so speculation composes with the rest of the
+serving machinery instead of refusing it:
+
+  * **Proposal is host-side** (`propose_ngram_host` / `propose_stream`,
+    plain numpy): the engine proposes, per dispatch, a predicted
+    CONTINUATION STREAM per lane from the token history it already holds
+    (`Request.prompt_ids + output_ids`) and ships it as one small [B, E]
+    operand. Per round the device then ALIGNS into that stream by value
+    (`align_drafts`: find the lane's current last token in the stream,
+    its successors are the round's γ drafts) — so a partially-accepted
+    round re-aligns at its correction token, and a stream proposed from
+    history that is STALE by the in-flight tokens (the overlapped loop,
+    dispatch pipelining) re-aligns at wherever the device actually is,
+    instead of comparing drafts against the wrong positions. No
+    device-resident history buffer exists anymore, which is exactly what
+    un-refuses hybrid batching (the fused chunk+decode step advances
+    lanes without any spec state to maintain), the overlapped loop (the
+    decode carry is a plain `DecodeState`, donor-able like
+    non-speculative decode), migration (the checkpoint rule is the
+    plain-decode one), and the pipelined prefill (no synchronous
+    first-token readback to seed history). A wrong or stale stream is
+    still just a guess — acceptance is sample-and-compare — it only
+    accepts less often.
+  * **Verify/accept/advance stay on device** (`accept_counts` inside the
+    runner's fused scan): per round the dispatch verifies [last-accepted,
+    draft 1..γ] in one multi-token model pass, samples every position with
+    its serial (seed, step) PRNG key, keeps the longest draft-consistent
+    prefix, and chains (tokens, positions, steps) into the next round
+    without host involvement — so K rounds still ride ONE dispatch.
+  * **Rejected KV appends roll back** (`touched_pages` / `snapshot_pages` /
+    `rollback_commit`): the verify pass writes all γ+1 positions' KV before
+    attention (the paged kernels read the pool), so a rejected draft leaves
+    bytes the serial loop never wrote — and on the scaled int8 pool a loud
+    rejected draft would REQUANT its page, re-rounding settled context. Each
+    round therefore snapshots the ≤2 pages per lane its writes can touch
+    (raw page bytes + the fp32 scale pair, the same raw capture shape the
+    migration checkpoint uses), restores them after acceptance, and replays
+    ONLY the accepted inputs' writes through the same chained writers serial
+    decode uses. Rejected drafts therefore leave NOTHING behind: two
+    dispatches differing only in their rejected draft content commit
+    byte-identical pools (reject-independence — pinned by tests on bf16 and
+    int8 pools, scales included), which is what keeps prefix-cache indexing,
+    host-tier spills, and migration checkpoints clean under speculation.
+    (Relative to the serial loop the accepted writes carry the verify
+    pass's own K/V activations — these track the serial samples exactly
+    but can differ from serial's activation BYTES in low-order bits, the
+    same [B, S]-vs-[B, 1] step-shape numerics documented below.)
 
 Acceptance is sample-and-compare, which is exactly unbiased: position i's
 emitted token is ALWAYS the target-distribution sample at that position; the
 draft only decides whether positions after i can be kept (their context was
-right) or must be discarded (their context was wrong). Output is therefore
-bit-identical with speculation on or off whenever the step math itself is
-(fp32 CPU tests pin this). Under bf16 on TPU the [B, S]-shaped verify step
-can round differently from the [B, 1] decode step (different XLA fusions),
-so near-tied argmaxes may occasionally diverge — the standard numerics
-caveat of every speculative-decoding implementation, not a bias.
+right) or must be discarded (their context was wrong). The numerics
+caveats — all the standard class for every speculative-decoding
+implementation, none a bias: (a) the [B, S]-shaped verify step can round
+differently from the [B, 1] decode step (different reduction/fusion
+orders — bf16 on TPU AND, in low-order bits, fp32 on CPU), both in the
+round's own logits and in the activation BYTES the accepted-prefix
+commit writes, so the committed KV drifts from the serial loop's bytes
+by ~ulp per accepted token and a near-tied greedy argmax can eventually
+flip — on short horizons (the tests' fixtures, the bench probe's
+tool-call-sized completions) fp32 output is identical in practice, but
+identity is NOT guaranteed at arbitrary length even in fp32; (b) on the
+scaled int8 pool, a rejected draft louder than its page's absmax
+transiently re-rounds that page DURING the round's own attention (the
+rollback restores the bytes afterwards, but the round's logits saw the
+re-rounded view), so a near-tie within that round can diverge. Every
+emitted token remains a true target sample for its (seed, step) key
+against the context the speculative engine itself committed.
 
 The reference gets the equivalent capability (spec-decode workers) from
 inside the vLLM dependency (reference: llm/serve_llm.py:22-34); here it is
@@ -30,46 +84,151 @@ first-party and TPU-shaped.
 
 from __future__ import annotations
 
+from typing import Sequence
+
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
+from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
 
 
-def propose_ngram(
-    history: jax.Array,    # [B, L] i32 token history (prompt + accepted output)
-    positions: jax.Array,  # [B] index of the last valid token in each row
-    num_drafts: int,       # γ — draft tokens to propose (static)
-    ngram: int,            # n — trailing n-gram length to match (static)
-) -> jax.Array:
-    """Propose `num_drafts` continuation tokens per sequence. Returns [B, γ].
+# ---------------------------------------------------------------------------
+# Host-side proposal (plain numpy — runs inside the engine's dispatch path,
+# no device work, no host<->device sync)
+# ---------------------------------------------------------------------------
 
-    Finds the LATEST index j < positions where history[j-n+1 .. j] equals the
-    trailing n-gram history[p-n+1 .. p], and proposes history[j+1 .. j+γ]
-    (clamped into known history). No match → the last token repeated, which
-    costs nothing extra: verification still emits ≥ 1 real token per step and
-    the extra positions ride the memory-bound model step for free.
 
-    Vectorized as n shifted equality maps over the whole row — O(B·L·n)
-    vector ops, trivial against a model step.
+def propose_ngram_host(ids: Sequence[int], num_tokens: int, ngram: int,
+                       window: int = 0) -> list[int]:
+    """Propose `num_tokens` continuation tokens for ONE sequence from its
+    host-side token history.
+
+    Finds the LATEST index j < len(ids)-1 whose trailing `ngram` tokens
+    ids[j-n+1 .. j] equal the history's trailing n-gram, and proposes
+    ids[j+1 ...] clamped into known history; no match (or a history too
+    short to hold a prior occurrence) proposes the last token repeated,
+    which costs nothing extra: verification still emits >= 1 real token
+    per round and the extra positions ride the memory-bound model step
+    for free. `window` > 0 bounds the match scan to the trailing `window`
+    tokens (LLM_SPEC_LOOKUP_WINDOW — long multi-turn histories cap the
+    per-dispatch host scan; 0 scans the whole history).
+
+    Vectorized as n shifted equality maps over the scanned row — O(W·n)
+    numpy ops per lane per dispatch, trivial against a model step.
     """
-    b, l = history.shape
-    idx = jnp.arange(l, dtype=jnp.int32)
-    match = jnp.ones((b, l), bool)
-    for t in range(ngram):  # static, small
-        suffix_tok = jnp.take_along_axis(
-            history, jnp.maximum(positions - t, 0)[:, None], axis=1)  # [B, 1]
-        eq = history == suffix_tok
-        if t:
-            # candidate end-index j draws this factor from history[j - t]
-            eq = jnp.pad(eq, ((0, 0), (t, 0)))[:, :l]
-        match = match & eq
-    valid = (idx[None] >= ngram - 1) & (idx[None] < positions[:, None])
-    valid = valid & (positions[:, None] >= ngram)  # row long enough at all
-    cand = jnp.where(match & valid, idx[None], -1)
-    best = jnp.max(cand, axis=1)                        # [B]; -1 when no match
-    start = jnp.where(best >= 0, best + 1, positions)
-    offs = start[:, None] + jnp.arange(num_drafts, dtype=jnp.int32)[None]
-    offs = jnp.minimum(offs, positions[:, None])        # only propose known tokens
-    return jnp.take_along_axis(history, offs, axis=1)
+    if num_tokens <= 0:
+        return []
+    if window and window > 0 and len(ids) > window + ngram:
+        # Slice BEFORE the array conversion: the knob's whole point is an
+        # O(window) per-dispatch host term, so the un-scanned history
+        # prefix must never be touched (a windowed scan over the tail
+        # slice matches a bounded scan over the full history exactly —
+        # candidate grams ending inside the window see the same tokens).
+        ids = ids[-(window + ngram):]
+    h = len(ids)
+    if h == 0:
+        return [0] * num_tokens
+    last = int(ids[-1])
+    if h <= ngram:
+        return [last] * num_tokens
+    a = np.asarray(ids, dtype=np.int64)
+    lo = ngram - 1
+    if window and window > 0:
+        # The candidate gram must END inside the window's span; the
+        # trailing gram itself always participates (it sits at the end).
+        lo = max(lo, h - 1 - int(window))
+    cand = np.arange(lo, h - 1)
+    if cand.size == 0:
+        return [last] * num_tokens
+    ok = np.ones(cand.shape, bool)
+    for t in range(ngram):
+        ok &= a[cand - t] == a[h - 1 - t]
+    hits = cand[ok]
+    if hits.size == 0:
+        return [last] * num_tokens
+    start = int(hits[-1]) + 1  # latest occurrence wins (most recent context)
+    idx = np.minimum(start + np.arange(num_tokens), h - 1)
+    return a[idx].astype(np.int32).tolist()
+
+
+def history_tail(prompt_ids: Sequence[int], output_ids: Sequence[int],
+                 ngram: int, window: int = 0) -> list[int]:
+    """A lane's proposal history, bounded to the windowed scan's reach.
+
+    With a lookup window the proposal only ever reads the trailing
+    window + ngram tokens, so the engine's per-dispatch host term must
+    not build (or copy) the full prompt + output concatenation — at 32
+    lanes × multi-thousand-token agentic histories that list work alone
+    would rival the dispatch budget the window knob exists to protect.
+    window = 0 returns the full concatenation (the unbounded scan needs
+    it)."""
+    if not window or window <= 0:
+        return list(prompt_ids) + list(output_ids)
+    need = window + ngram
+    if len(output_ids) >= need:
+        return list(output_ids[-need:])
+    take = need - len(output_ids)
+    return list(prompt_ids[-take:]) + list(output_ids)
+
+
+def propose_stream(histories: Sequence[Sequence[int]], padded_batch: int,
+                   length: int, ngram: int, window: int = 0) -> np.ndarray:
+    """Predicted-continuation streams for one fused dispatch:
+    [padded_batch, length] int32.
+
+    One n-gram lookup per lane predicts the emission stream the dispatch
+    hopes to walk: stream[0] is the lane's last HOST-KNOWN token and
+    stream[1:] the lookup's continuation after the latest prior
+    occurrence of the trailing n-gram. The device never consumes the
+    stream positionally — each verify round aligns into it by VALUE
+    (`align_drafts`), so the stream survives both partial acceptance
+    (the correction token re-anchors, if it appears in the stream) and
+    host-side staleness under the overlapped loop / dispatch pipelining
+    (the device's actual last token anchors wherever it really is). The
+    engine sizes `length` to cover every round of every dispatch that
+    can be in flight. Padding lanes (histories shorter than
+    padded_batch) stream zeros; their rows are garbage the harvest never
+    reads.
+    """
+    out = np.zeros((padded_batch, length), np.int32)
+    for i, ids in enumerate(histories):
+        if not len(ids):
+            continue
+        out[i, 0] = int(ids[-1])
+        out[i, 1:] = propose_ngram_host(ids, length - 1, ngram, window)
+    return out
+
+
+def align_drafts(stream: jax.Array, tokens: jax.Array,
+                 spec_tokens: int) -> jax.Array:
+    """Device-side draft selection for one verify round: [B, γ].
+
+    Finds each lane's current last token (`tokens`, the verify carry) in
+    its host-proposed stream and drafts the following γ entries — the
+    first occurrence wins (it maximizes remaining runway; for the
+    periodic continuations prompt-lookup thrives on, every occurrence
+    agrees). Successors past the stream end clamp onto its final entry,
+    and a lane whose token appears nowhere (the model left the predicted
+    trajectory) drafts its own token repeated — the original proposal's
+    no-match fallback, costing nothing: verification still emits >= 1
+    real token and the extra positions ride the model step for free.
+    """
+    e = stream.shape[1]
+    idx = jnp.arange(e, dtype=jnp.int32)
+    eq = stream == tokens[:, None]
+    hit = jnp.min(jnp.where(eq, idx[None], e), axis=1)          # [B]; e = miss
+    offs = jnp.clip(hit[:, None] + 1 + jnp.arange(spec_tokens,
+                                                  dtype=jnp.int32)[None],
+                    0, e - 1)
+    drafts = jnp.take_along_axis(stream, offs, axis=1)
+    return jnp.where((hit < e)[:, None], drafts, tokens[:, None])
+
+
+# ---------------------------------------------------------------------------
+# Device-side acceptance (inside the runner's fused verify scan)
+# ---------------------------------------------------------------------------
 
 
 def accept_counts(sampled: jax.Array, drafts: jax.Array) -> jax.Array:
@@ -87,18 +246,138 @@ def accept_counts(sampled: jax.Array, drafts: jax.Array) -> jax.Array:
     return 1 + jnp.sum(acc, axis=1)
 
 
-def update_history(
-    history: jax.Array,     # [B, L]
-    new_tokens: jax.Array,  # [B, S] this step's sampled tokens (incl. discarded)
-    positions: jax.Array,   # [B] index of the last PREVIOUSLY accepted token
-) -> jax.Array:
-    """Write the step's samples at history[positions+1 ...]. Discarded-tail
-    slots hold garbage, but they sit at indices > the new last-token index, so
-    proposal never reads them before the next step overwrites them. Near the
-    buffer end the DUS start clamps to L - S (shifting writes onto valid
-    history): that can only degrade proposal quality for a request that is
-    about to hit max_model_len anyway — emitted tokens are never affected.
-    """
-    return jax.vmap(
-        lambda h, t, p: jax.lax.dynamic_update_slice(h, t, (p + 1,))
-    )(history, new_tokens, positions)
+# ---------------------------------------------------------------------------
+# Device-side KV rollback: accepted-prefix commit for the round's appends
+# ---------------------------------------------------------------------------
+
+
+def num_touched_pages(s: int, block_size: int) -> int:
+    """Worst-case pages a lane's S consecutive slot writes can span."""
+    return (block_size - 1 + s - 1) // block_size + 1
+
+
+def touched_pages(block_tables: jax.Array, positions: jax.Array, s: int,
+                  block_size: int) -> jax.Array:
+    """Page ids ([B, P]) the round's writes at positions p..p+S-1 can touch.
+
+    Columns clip to the table width: near the table end the extra columns
+    resolve to the lane's last real page (whose writes the verify step
+    masks to the trash block anyway — restoring an untouched page from its
+    own snapshot is a no-op), and fully-padded lanes resolve to
+    TRASH_BLOCK, whose bytes are garbage by contract."""
+    w = block_tables.shape[1]
+    cols = jnp.clip(
+        positions[:, None] // block_size
+        + jnp.arange(num_touched_pages(s, block_size), dtype=jnp.int32)[None],
+        0, w - 1)
+    return jnp.take_along_axis(block_tables, cols, axis=1)
+
+
+def snapshot_pages(cache: KVCache, blks: jax.Array):
+    """Raw capture of the touched pages BEFORE the round's writes: page
+    bytes in the pool dtype plus, on the scaled int8 pool, the fp32 scale
+    pair — the same raw-page shape the migration checkpoint captures
+    (runtime/scheduler.MigrationBlock), taken on device instead of host.
+    blks [B, P] → (k [L, KH, B, P, bs, hdp], v, k_scale [L, B, P, KH] | None,
+    v_scale | None)."""
+    if cache.quantized:
+        return (cache.k[:, :, blks], cache.v[:, :, blks],
+                cache.k_scale[:, blks], cache.v_scale[:, blks])
+    return cache.k[:, :, blks], cache.v[:, :, blks], None, None
+
+
+def rollback_commit(
+    cache: KVCache,
+    snap,                      # snapshot_pages() result (round-start bytes)
+    blks: jax.Array,           # [B, P] touched page ids
+    k_seq: jax.Array,          # [L, B, S, KH, hd] post-rope K (compute dtype)
+    v_seq: jax.Array,          # [L, B, S, KH, hd]
+    block_tables: jax.Array,   # [B, W]
+    positions: jax.Array,      # [B] position of the round's input 0
+    counts: jax.Array,         # [B] accepted-input count m in [1, S]
+    capacity: int,             # W * block_size (static)
+) -> KVCache:
+    """Accepted-prefix commit: restore the touched pages to their
+    round-start bytes (and scales), then replay inputs 0..m-1's writes
+    through the SAME chained writers serial decode uses
+    (kv_cache.write_decode_kv_full / _quant), with rejected and
+    over-capacity slots masked to the trash block.
+
+    Two properties fall out by construction:
+      * rejected drafts leave NOTHING behind — the committed pool is
+        byte-identical (pages AND int8 scales) to a dispatch that never
+        proposed them (reject-independence, pinned by tests): no garbage
+        slots for a migration checkpoint or host-tier spill to capture,
+        no inflated int8 page scale re-rounding settled context for
+        later rounds; and
+      * the commit IS the serial write chain — the same writer functions,
+        the same order, the same per-token requant sequence on int8 —
+        applied to the restored (pre-round) page state, carrying the
+        verify pass's K/V activations for the accepted inputs.
+
+    Rejected replay slots mask to the trash block (the same `valid`
+    routing the verify writes use), so the trash page's garbage bytes ARE
+    perturbed — garbage by contract, never read unmasked. Cost is
+    bounded: P = ceil((bs+S-2)/bs)+1 <= 2 page restores plus S masked
+    token writes per lane per layer per round — DUS chains that alias in
+    place on TPU, small next to the verify pass's attention read of the
+    full context."""
+    k_snap, v_snap, ks_snap, vs_snap = snap
+    n_layers = cache.k.shape[0]
+    s = k_seq.shape[2]
+    b, p = blks.shape
+    quantized = cache.quantized
+    zero = jnp.int32(0)
+
+    def body(carry, xs):
+        if quantized:
+            kc, vc, ksc, vsc = carry
+            k_l, v_l, ks_l, vs_l, kq_l, vq_l, li = xs
+        else:
+            kc, vc = carry
+            ksc = vsc = None
+            k_l, v_l, kq_l, vq_l, li = xs
+        # Restore: whole-page DUS per (lane, page) — duplicate page ids
+        # (trash, clipped tail columns) restore deterministically in
+        # program order, and every restored value is the page's own
+        # round-start snapshot, so duplicates are idempotent.
+        for i in range(b):
+            for j in range(p):
+                blk = blks[i, j]
+                kc = jax.lax.dynamic_update_slice(
+                    kc, k_l[:, i, j][None, :, None],
+                    (li, zero, blk, zero, zero))
+                vc = jax.lax.dynamic_update_slice(
+                    vc, v_l[:, i, j][None, :, None],
+                    (li, zero, blk, zero, zero))
+                if quantized:
+                    ksc = jax.lax.dynamic_update_slice(
+                        ksc, ks_l[i, j][None, None, :], (li, blk, zero))
+                    vsc = jax.lax.dynamic_update_slice(
+                        vsc, vs_l[i, j][None, None, :], (li, blk, zero))
+        # Replay: the serial write chain for the accepted prefix only.
+        for i in range(s):
+            ok = ((positions + i) < capacity) & (i < counts)
+            if quantized:
+                kc, ksc = kvc.write_decode_kv_full_quant(
+                    kc, ksc, li, kq_l[:, i], block_tables, positions + i,
+                    valid=ok)
+                vc, vsc = kvc.write_decode_kv_full_quant(
+                    vc, vsc, li, vq_l[:, i], block_tables, positions + i,
+                    valid=ok)
+            else:
+                kc = kvc.write_decode_kv_full(
+                    kc, li, kq_l[:, i], block_tables, positions + i, valid=ok)
+                vc = kvc.write_decode_kv_full(
+                    vc, li, vq_l[:, i], block_tables, positions + i, valid=ok)
+        return ((kc, vc, ksc, vsc) if quantized else (kc, vc)), None
+
+    layer_idx = jnp.arange(n_layers, dtype=jnp.int32)
+    if quantized:
+        (kc, vc, ksc, vsc), _ = jax.lax.scan(
+            body, (cache.k, cache.v, cache.k_scale, cache.v_scale),
+            (k_snap, v_snap, ks_snap, vs_snap, k_seq, v_seq, layer_idx))
+        return KVCache(kc, vc, ksc, vsc)
+    (kc, vc), _ = jax.lax.scan(
+        body, (cache.k, cache.v), (k_snap, v_snap, k_seq, v_seq, layer_idx))
+    return KVCache(kc, vc)

@@ -252,6 +252,9 @@ class PPRunner(ModelRunner):
     supports_quantized_kv = False      # no staged scale plumbing (int8 KV)
     supports_fused_kv_write = False    # no aliasing rule in the staged jits
     supports_migration = False         # no host slicing of the staged pool
+    supports_speculation = False       # no staged multi-token verify jit
+    #                                    (constructor refuses spec_tokens;
+    #                                    engine guards supplied runners)
 
     def __init__(self, cfg: ModelConfig, params, mesh: Mesh,
                  decode_steps: int = 1, spec_tokens: int = 0,

@@ -54,7 +54,6 @@ from agentic_traffic_testing_tpu.runtime.runner import (
     DecodeState,
     ModelRunner,
     SamplingArrays,
-    SpecDecodeState,
 )
 from agentic_traffic_testing_tpu.runtime.scheduler import (
     ChunkPrefill,
@@ -76,6 +75,7 @@ from agentic_traffic_testing_tpu.runtime.telemetry import (
     PHASE_OVERLAPPED_DECODE,
     PHASE_PIPELINED_PREFILL,
     PHASE_PREFILL,
+    PHASE_SPECULATIVE_DECODE,
     REQ_ADMITTED,
     REQ_PREFILL_CHUNK,
     REQ_RESTORE,
@@ -131,8 +131,9 @@ class EngineConfig:
     # on, outputs are token-identical and KV pages byte-identical
     # (tests/test_prefill_pipeline.py pins both). Chunks reuse the chunked
     # -prefill model impl, so one compiled program serves every chunk of a
-    # bucket. Single-chip runners only; refused with speculation (the
-    # spec prefill needs its synchronous first-token readback).
+    # bucket. Single-chip runners only. (Composes with speculation since
+    # round 14: the spec prefill handoff is the same async DecodeState
+    # handoff as plain decode — no first-token readback to pipeline past.)
     prefill_pipeline_chunks: int = 0
     # Hybrid prefill+decode batching (Sarathi-style chunked piggyback over
     # the ragged Pallas kernel): when > 0, a pending prefill chunk and the
@@ -157,8 +158,12 @@ class EngineConfig:
     # dispatch's post-stop outputs are discarded at harvest and the step
     # re-runs on the corrected batch via the normal drain + re-plan, so
     # token streams are identical to the serial loop. 0 (default) keeps
-    # every path bit-identical to today. Single-chip, non-speculative
-    # runners only (tp/sp/pp and speculation refuse at build).
+    # every path bit-identical to today. Single-chip runners only
+    # (tp/sp/pp refuse at build). Composes with speculation since
+    # round 14: the speculative verify dispatch IS the predicted
+    # next-step dispatch (its carry is a donated DecodeState), and a
+    # rejected draft is just another mispredict reconciled through the
+    # same drain + re-plan.
     decode_overlap: int = 0
     # Step-clock telemetry plane (round 8 — runtime/telemetry.py): 0
     # (default) keeps the hot loop byte-identical and allocation-free —
@@ -211,9 +216,10 @@ class EngineConfig:
     # survivor, or a failed checkpoint all fall back to it). 0 (default)
     # keeps every path byte-identical to round 9: no checkpoint machinery
     # is consulted anywhere. Host-side only — compiled programs are
-    # untouched either way. Single-chip runners only; refused with
-    # speculation (the device-resident n-gram history has no checkpoint
-    # rule).
+    # untouched either way. Single-chip runners only. (Composes with
+    # speculation since round 14: the token history is host-side and the
+    # rejection rollback leaves no draft bytes behind, so the plain-decode
+    # checkpoint rule covers the speculative stream unchanged.)
     migration: int = 0
     # Content-addressed reuse of full prompt blocks (vLLM automatic-prefix-
     # caching analog); cached requests prefill only their suffix.
@@ -264,18 +270,32 @@ class EngineConfig:
     # DUS write ops per layer. 0 (default) keeps every write path
     # bit-identical to pre-knob builds. Off-TPU modes fuse functionally
     # (same bytes, one call site), so the knob is CPU-testable.
-    # Single-chip, non-speculative runners only; int8 x hybrid refuses.
+    # Single-chip runners only; int8 x hybrid refuses. Composes with
+    # speculation (round 14): single-token dispatches stay fused while
+    # the multi-token verify keeps its chained write sequence (the
+    # in-kernel fused write carries exactly one token).
     fused_kv_write: int = 0
     # None = auto (C++ native/ core if it builds, Python otherwise);
     # True/False force one implementation.
     native_allocator: Optional[bool] = None
     # Speculative decoding: None (off) or "ngram" (draft-model-free
-    # prompt-lookup speculation — ops/speculative.py). Each fused decode
-    # iteration then verifies spec_tokens drafts + 1 in one model step;
-    # greedy output is bit-identical to non-speculative decode.
+    # prompt-lookup speculation — ops/speculative.py). Drafts are proposed
+    # HOST-side from the request's own token history (round 14) and each
+    # fused decode round verifies spec_tokens drafts + 1 in one multi-token
+    # model step, with rejected KV appends rolled back to the serial
+    # loop's bytes; greedy output is bit-identical to non-speculative
+    # decode (fp32 CPU pins). Composes with hybrid batching, the
+    # overlapped loop, int8 KV, fused writes, the pipelined prefill, and
+    # migration; pp runners refuse (supports_speculation).
     speculation: Optional[str] = None
     spec_tokens: int = 3   # γ — drafts verified per step
     spec_ngram: int = 3    # trailing n-gram length matched against history
+    # Bound the host-side prompt-lookup scan to the trailing this-many
+    # tokens of each lane's history (LLM_SPEC_LOOKUP_WINDOW). 0 (default)
+    # scans the whole history — the original proposal semantics; long
+    # multi-turn agentic histories set a window to cap the per-dispatch
+    # host scan at O(window) per lane.
+    spec_lookup_window: int = 0
 
     def __post_init__(self) -> None:
         # Fail fast: a typo'd scheme must not silently serve full-precision
@@ -292,12 +312,6 @@ class EngineConfig:
         if self.fused_kv_write not in (0, 1):
             raise ValueError(
                 f"fused_kv_write must be 0 or 1, got {self.fused_kv_write}")
-        if self.fused_kv_write and self.speculation:
-            # The verify step writes S tokens per lane; the fused kernels
-            # carry exactly one — refuse at build, not first step.
-            raise ValueError(
-                "fused_kv_write x speculation is not wired — disable one "
-                "of them")
         if (self.fused_kv_write and self.hybrid_token_budget
                 and self.kv_cache_dtype == "int8"):
             # A ragged q-block smaller than a page cannot own the page's
@@ -317,13 +331,6 @@ class EngineConfig:
         if self.speculation not in (None, "ngram"):
             raise ValueError(
                 f"unknown speculation {self.speculation!r}; supported: ngram")
-        if self.hybrid_token_budget and self.speculation:
-            # The fused hybrid step advances decode lanes without the
-            # device-resident n-gram history; silently dropping drafts
-            # would misreport every acceptance gauge.
-            raise ValueError(
-                "hybrid_token_budget x speculation is not wired — disable "
-                "one of them")
         if self.hybrid_token_budget < 0:
             raise ValueError(
                 f"hybrid_token_budget must be >= 0, got {self.hybrid_token_budget}")
@@ -331,31 +338,12 @@ class EngineConfig:
             raise ValueError(
                 f"prefill_pipeline_chunks must be >= 0, "
                 f"got {self.prefill_pipeline_chunks}")
-        if self.prefill_pipeline_chunks > 1 and self.speculation:
-            # The speculative prefill reads its first token synchronously
-            # to seed the device-resident n-gram history; a pipelined
-            # prefill's whole point is NOT synchronizing until the tail.
-            raise ValueError(
-                "prefill_pipeline_chunks x speculation is not wired — "
-                "disable one of them")
         if self.decode_overlap not in (0, 1):
             raise ValueError(
                 f"decode_overlap must be 0 or 1, got {self.decode_overlap}")
-        if self.decode_overlap and self.speculation:
-            # The overlap fast path skips the per-dispatch host sync the
-            # speculative history re-upload depends on, and the spec jit
-            # has no donated-state variant; refuse at build, not first step.
-            raise ValueError(
-                "decode_overlap x speculation is not wired — disable one "
-                "of them")
         if self.migration not in (0, 1):
             raise ValueError(
                 f"migration must be 0 or 1, got {self.migration}")
-        if self.migration and self.speculation:
-            # The device-resident n-gram history has no checkpoint rule;
-            # silently dropping it would break token identity on resume.
-            raise ValueError(
-                "migration x speculation is not wired — disable one of them")
         if self.step_trace < 0:
             raise ValueError(
                 f"step_trace must be >= 0, got {self.step_trace}")
@@ -388,6 +376,10 @@ class EngineConfig:
                 "extends the content-addressed prefix cache)")
         if self.speculation and self.spec_tokens < 1:
             raise ValueError("spec_tokens must be >= 1 when speculation is on")
+        if self.spec_lookup_window < 0:
+            raise ValueError(
+                f"spec_lookup_window must be >= 0 (0 = scan the whole "
+                f"history), got {self.spec_lookup_window}")
         if self.moe_capacity_factor is not None and self.moe_capacity_factor <= 0:
             # 0 would clamp every expert to one slot -> near-total token
             # dropping served behind healthy 200s.
@@ -421,6 +413,9 @@ class EngineConfig:
     def scheduler_config(self, decode_steps: int = 1) -> SchedulerConfig:
         # Lookahead must cover every KV write a lagged in-flight dispatch can
         # make: (pipeline_depth unharvested + 1 dispatching) × decode_steps.
+        # Speculative engines pass decode_steps * (spec_tokens + 1) here
+        # (the engine constructor's one call site): each fused round can
+        # emit — and write KV for — up to γ+1 positions per lane.
         return SchedulerConfig(
             max_num_seqs=self.max_num_seqs,
             max_num_batched_tokens=self.max_num_batched_tokens,
@@ -595,16 +590,24 @@ class LLMEngine:
                 f"{type(self.runner).__name__} does not support the "
                 f"pipelined-prefill path — build the engine with "
                 f"prefill_pipeline_chunks=0 (unset LLM_PREFILL_PIPELINE)")
-        if cfg.decode_overlap and (
-                not getattr(self.runner, "supports_decode_overlap", False)
-                or getattr(self.runner, "spec_tokens", 0) > 0):
-            # Mesh runners have no donated-state decode jit; a caller-
-            # supplied speculative runner reaches here even though the
-            # config validator already refuses the cfg-level combination.
+        if cfg.decode_overlap and not getattr(
+                self.runner, "supports_decode_overlap", False):
+            # Mesh runners have no donated-state decode jit. (Speculative
+            # runners compose since round 14: the spec verify carry is a
+            # plain DecodeState with its own donated-state jit.)
             raise ValueError(
                 f"{type(self.runner).__name__} does not support the "
                 f"overlapped decode loop — build the engine with "
                 f"decode_overlap=0 (unset LLM_DECODE_OVERLAP)")
+        if (cfg.effective_spec_tokens or getattr(self.runner, "spec_tokens", 0)
+                ) and not getattr(self.runner, "supports_speculation", False):
+            # The pp runner's staged jits have no multi-token verify
+            # stage (its constructor refuses spec_tokens too; this guard
+            # covers caller-supplied runners and cfg-level speculation).
+            raise ValueError(
+                f"{type(self.runner).__name__} does not support speculative "
+                f"decoding — build the engine with speculation=None "
+                f"(unset LLM_SPECULATION)")
 
         kv_quantized = cfg.kv_cache_dtype == "int8"
         if kv_quantized:
@@ -639,24 +642,31 @@ class LLMEngine:
                 f"{type(self.runner).__name__} does not support live "
                 f"stream migration — build the engine with migration=0 "
                 f"(unset LLM_MIGRATION)")
-        if cfg.migration and getattr(self.runner, "spec_tokens", 0) > 0:
-            # Caller-supplied speculative runner: the cfg validator only
-            # sees cfg-level speculation.
-            raise ValueError(
-                "migration x speculative runner is not wired — build the "
-                "engine with migration=0")
         if cfg.fused_kv_write and not getattr(
                 self.runner, "supports_fused_kv_write", False):
             raise ValueError(
                 f"{type(self.runner).__name__} does not support fused KV "
                 f"page writes — build the engine with fused_kv_write=0 "
                 f"(unset LLM_FUSED_KV_WRITE)")
-        if cfg.fused_kv_write and getattr(self.runner, "spec_tokens", 0) > 0:
-            # Caller-supplied speculative runner: the cfg validator only
-            # sees cfg-level speculation.
+        if runner is not None and bool(cfg.effective_spec_tokens) != bool(
+                getattr(self.runner, "spec_tokens", 0)):
+            # The speculative verify program is baked into the runner's
+            # jits; a mismatched supplied runner would silently serve the
+            # other decode path while llm_config_speculation reports the
+            # cfg's value (the same silent-misconfiguration class the
+            # fused_kv_write check below refuses).
             raise ValueError(
-                "fused_kv_write x speculative runner is not wired — build "
-                "the engine with fused_kv_write=0")
+                "speculation conflicts with the supplied runner's programs "
+                "— build the runner with matching spec_tokens")
+        if (runner is not None and cfg.effective_spec_tokens
+                and getattr(self.runner, "spec_ngram",
+                            cfg.spec_ngram) != cfg.spec_ngram):
+            # Proposal uses the runner's lookup length (it sits next to
+            # spec_tokens, the runner-owned half); a disagreeing cfg
+            # would silently misreport the knob — same rule as above.
+            raise ValueError(
+                "spec_ngram conflicts with the supplied runner's — build "
+                "the runner with the same lookup length")
         if runner is not None and bool(cfg.fused_kv_write) != bool(
                 getattr(self.runner, "fused_kv_write", False)):
             # The fused flag is baked into the runner's jitted programs; a
@@ -773,9 +783,13 @@ class LLMEngine:
             self._faults = FaultInjector.from_spec(cfg.fault_spec,
                                                    cfg.fault_seed)
         # Speculation acceptance accounting (live request lanes only):
-        # emitted/iters = mean tokens per verify step in [1, spec_tokens+1].
+        # emitted/iters = mean tokens per verify step in [1, spec_tokens+1];
+        # accepted/drafted = the draft acceptance rate (llm_spec_* gauges —
+        # iters doubles as the rounds counter, llm_spec_rounds_total).
         self.spec_iters = 0
         self.spec_emitted = 0
+        self.spec_drafted = 0    # draft tokens proposed (consumed rounds)
+        self.spec_accepted = 0   # draft tokens verification accepted
         # Step-clock telemetry (runtime/telemetry.py): None unless the
         # knob is on, so the hot loop stays byte-identical and every
         # hook below costs one `is not None` test with the plane off.
@@ -870,26 +884,22 @@ class LLMEngine:
         n = 0
         for b in pow2_buckets(1, self.cfg.max_num_seqs):
             tables = jnp.full((b, self.table_width), TRASH_BLOCK, jnp.int32)
-            tokens = jnp.zeros((b,), jnp.int32)
-            positions = jnp.zeros((b,), jnp.int32)
-            steps = jnp.zeros((b,), jnp.int32)
-            if spec > 0:
-                hist = jnp.zeros(
-                    (b, self.table_width * self.cfg.block_size), jnp.int32)
-                state = SpecDecodeState(tokens=tokens, positions=positions,
-                                        steps=steps, history=hist)
-            else:
-                state = DecodeState(tokens=tokens, positions=positions,
-                                    steps=steps)
+            state = DecodeState(tokens=jnp.zeros((b,), jnp.int32),
+                                positions=jnp.zeros((b,), jnp.int32),
+                                steps=jnp.zeros((b,), jnp.int32))
             samp = self._sampling_arrays([], b)
             # Warm the program the live loop will actually run: the
             # overlapped (donated-state) jit under decode_overlap, the
             # plain one otherwise — else the first fast-path dispatch
             # would cold-compile mid-traffic.
             decode = (self.runner.decode_overlapped
-                      if self.cfg.decode_overlap and spec == 0
-                      else self.runner.decode)
-            result = decode(self.cache, tables, state, samp)
+                      if self.cfg.decode_overlap else self.runner.decode)
+            if spec > 0:
+                drafts = jnp.zeros((b, self._spec_stream_len()), jnp.int32)
+                result = decode(self.cache, tables, state, samp,
+                                drafts=drafts)
+            else:
+                result = decode(self.cache, tables, state, samp)
             # decode donates the cache: keep the returned one (dummy writes
             # went to the trash block; real pages are untouched).
             self.cache = result[1]
@@ -1274,7 +1284,7 @@ class LLMEngine:
         don't split fall back to the single dispatch, which is always
         correct."""
         k = self.cfg.prefill_pipeline_chunks
-        if k < 2 or getattr(self.runner, "spec_tokens", 0) > 0:
+        if k < 2:
             return None
         bs = self.cfg.block_size
         for kk in range(min(k, t // bs), 1, -1):
@@ -1327,19 +1337,6 @@ class LLMEngine:
         for r in reqs:
             r.num_computed_tokens = r.num_prompt_tokens
             self._register_prefix(r)
-        if getattr(self.runner, "spec_tokens", 0) > 0:
-            # Speculative decode builds its host-side history from the first
-            # token, so the readback stays synchronous here.
-            toks = jax.device_get(out)  # statics: allow-host-sync(spec history needs the first token before the next dispatch)
-            now = time.monotonic()
-            for i, r in enumerate(reqs):
-                if r.first_token_time is None:
-                    r.first_token_time = now
-                if rec is not None:
-                    rec.request_tokens(r.request_id, now, 1)
-                self._append_token(r, int(toks[i]))
-            self._invalidate_decode_state()
-            return
         # Async prefill -> decode handoff: the prefill program already
         # returns a ready DecodeState (sampled token, positions, PRNG steps),
         # so decode dispatches can follow back-to-back without waiting for
@@ -1403,9 +1400,9 @@ class LLMEngine:
         for r in reqs:
             r.num_computed_tokens = r.num_prompt_tokens
             self._register_prefix(r)
-        # Tail: same async prefill -> decode handoff as _run_prefill (the
-        # speculation branch is unreachable — config refuses the combo and
-        # _pipeline_split checks the runner).
+        # Tail: same async prefill -> decode handoff as _run_prefill
+        # (speculative engines included — the spec decode state is the
+        # same plain DecodeState since round 14).
         first = carry[:, None]
         try:
             first.copy_to_host_async()
@@ -2037,26 +2034,15 @@ class LLMEngine:
             steps[i] = r.sampling_step
         self._fill_tables(reqs, tables)
         self._decode_requests = list(reqs)
-        if getattr(self.runner, "spec_tokens", 0) > 0:
-            # Token history for n-gram proposal rides in the decode state; one
-            # [B, table_tokens] host upload per composition change (~KBs).
-            hist_len = self.table_width * self.cfg.block_size
-            history = np.zeros((b, hist_len), np.int32)
-            for i, r in enumerate(reqs):
-                ids = r.prompt_ids + r.output_ids
-                history[i, : len(ids)] = ids
-            self._decode_state = SpecDecodeState(
-                tokens=jnp.asarray(tokens),
-                positions=jnp.asarray(positions),
-                steps=jnp.asarray(steps),
-                history=jnp.asarray(history),
-            )
-        else:
-            self._decode_state = DecodeState(
-                tokens=jnp.asarray(tokens),
-                positions=jnp.asarray(positions),
-                steps=jnp.asarray(steps),
-            )
+        # ONE state shape for plain and speculative decode (round 14): the
+        # n-gram history lives host-side (the requests' own token lists),
+        # so speculation adds no device-resident state to arm here —
+        # drafts ride each dispatch as a small [B, K, γ] operand instead.
+        self._decode_state = DecodeState(
+            tokens=jnp.asarray(tokens),
+            positions=jnp.asarray(positions),
+            steps=jnp.asarray(steps),
+        )
         self._decode_tables = jnp.asarray(tables)
         self._decode_samp = self._sampling_arrays(reqs, b)
         self._decode_block_counts = [r.blocks.num_blocks for r in reqs]
@@ -2218,34 +2204,85 @@ class LLMEngine:
         # members and released their blocks — so re-plan from current state.
         self._plan_and_dispatch()
 
+    def _spec_stream_len(self) -> int:
+        """Static per-engine length of the host-proposed continuation
+        stream: every round of every dispatch that can be in flight must
+        find runway — (pipeline_depth unharvested + 1 dispatching)
+        dispatches × decode_steps rounds × up to γ+1 emitted each, plus
+        the anchor slot (stream[0] = the last host-known token)."""
+        s = self.runner.spec_tokens + 1
+        return (self.cfg.pipeline_depth + 1) * self.runner.decode_steps * s + 1
+
+    # statics: hot-region(decode-loop)
+    def _propose_drafts(self) -> jax.Array:
+        """Host-side prompt-lookup proposal for one speculative dispatch:
+        a [B, E] predicted-continuation stream from the requests' own
+        token histories (plain numpy — no device work, no sync). Each
+        verify round aligns into the stream by VALUE on device, so under
+        the overlapped loop / pipelining a stream proposed from history
+        that lags by the in-flight tokens still anchors at wherever the
+        device actually is; a stale or wrong stream is just a weaker
+        guess (acceptance is sample-and-compare), never a correctness
+        hazard."""
+        from agentic_traffic_testing_tpu.ops.speculative import (
+            history_tail,
+            propose_stream,
+        )
+
+        # The runner's spec_ngram wins when set (it sits next to
+        # spec_tokens, the runner-owned half of the speculation config;
+        # every construction site passes cfg.spec_ngram into it, so the
+        # two agree unless a caller deliberately overrode the runner's).
+        ngram = getattr(self.runner, "spec_ngram", 0) or self.cfg.spec_ngram
+        window = self.cfg.spec_lookup_window
+        mat = propose_stream(
+            [history_tail(r.prompt_ids, r.output_ids, ngram, window)
+             for r in self._decode_requests],
+            int(self._decode_tables.shape[0]), self._spec_stream_len(),
+            ngram, window)
+        return jnp.asarray(mat)
+
     # statics: hot-region(decode-loop)
     def _do_decode_dispatch(self, predicted: bool = False) -> None:
         if self._faults is not None:  # before the donated-state call below
             self._faults.maybe_raise("dispatch_error")
         # Under decode_overlap every decode dispatch runs the donated-state
-        # jit (spec is refused at build), so ONE program serves both the
+        # jit (the speculative verify included — its carry is a plain
+        # DecodeState since round 14), so ONE program serves both the
         # armed first dispatch and the fast-path ones — no duplicate
         # compiles per bucket. The old state leaves are consumed by the
         # donation; nothing else references them (the handoff's readback
         # entry is a separate [B, 1] buffer).
         decode = (self.runner.decode_overlapped if self.cfg.decode_overlap
                   else self.runner.decode)
+        spec = getattr(self.runner, "spec_tokens", 0)
         rec = self.telemetry
         t0 = time.monotonic() if rec is not None else 0.0
-        kind = PHASE_OVERLAPPED_DECODE if predicted else PHASE_DECODE
+        kind = (PHASE_SPECULATIVE_DECODE if spec > 0
+                else PHASE_OVERLAPPED_DECODE if predicted else PHASE_DECODE)
         span = rec.annotation(kind) if rec is not None else NULL_ANNOTATION
         with span:
-            result = decode(
-                self.cache, self._decode_tables, self._decode_state,
-                self._decode_samp
-            )
+            if spec > 0:
+                result = decode(
+                    self.cache, self._decode_tables, self._decode_state,
+                    self._decode_samp, drafts=self._propose_drafts()
+                )
+            else:
+                result = decode(
+                    self.cache, self._decode_tables, self._decode_state,
+                    self._decode_samp
+                )
         if rec is not None:
             b = len(self._decode_requests)
+            # Token count = positions the dispatch PROCESSES: K per lane
+            # for plain decode, K*(γ+1) verified positions for the
+            # speculative phase (emission is variable per round and only
+            # known at harvest — the acceptance gauges own that split).
             rec.record_dispatch(kind, t0, time.monotonic(), b,
-                                b * self.runner.decode_steps,
+                                b * self.runner.decode_steps * (1 + spec),
                                 predicted=predicted)
         counts = None
-        if getattr(self.runner, "spec_tokens", 0) > 0:
+        if spec > 0:
             self._decode_state, self.cache, out, counts = result
         else:
             self._decode_state, self.cache, out = result
@@ -2381,6 +2418,11 @@ class LLMEngine:
                     if r.is_finished():
                         break
                     self.spec_iters += 1
+                    # Per consumed round: γ = S-1 drafts proposed, m-1 of
+                    # them accepted by verification (the m-th emitted token
+                    # is the round's own correction/bonus sample).
+                    self.spec_drafted += toks.shape[2] - 1
+                    self.spec_accepted += int(counts[i, k]) - 1
                     for tok in toks[i, k, : counts[i, k]]:
                         self._append_token(r, int(tok))
                         self.spec_emitted += 1

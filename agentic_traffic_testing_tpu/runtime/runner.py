@@ -35,8 +35,10 @@ from agentic_traffic_testing_tpu.models.llama import (
 from agentic_traffic_testing_tpu.ops.sampling import make_row_keys, sample
 from agentic_traffic_testing_tpu.ops.speculative import (
     accept_counts,
-    propose_ngram,
-    update_history,
+    align_drafts,
+    rollback_commit,
+    snapshot_pages,
+    touched_pages,
 )
 from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
 
@@ -56,20 +58,6 @@ class DecodeState(NamedTuple):
     tokens: jax.Array     # [B] i32 — input token for the next step
     positions: jax.Array  # [B] i32 — position of `tokens`
     steps: jax.Array      # [B] i32 — per-request sampling step (PRNG stream)
-
-
-class SpecDecodeState(NamedTuple):
-    """DecodeState + the token history n-gram speculation proposes from.
-
-    `history[b, :positions[b]+1]` is the sequence so far (prompt + accepted
-    output); it advances on device with the accepted samples each step, so
-    proposal/verify/accept all stay inside the fused scan.
-    """
-
-    tokens: jax.Array     # [B] i32 — last accepted token
-    positions: jax.Array  # [B] i32 — its position
-    steps: jax.Array      # [B] i32 — per-request sampling step (PRNG stream)
-    history: jax.Array    # [B, L] i32 — token history buffer
 
 
 def _prefill_sample_impl(params, cfg: ModelConfig, tokens, cache, block_tables,
@@ -183,27 +171,45 @@ def _decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
     return state, cache, toks.T  # [B, num_steps]
 
 
-def _spec_decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
-                             state: SpecDecodeState, samp: SamplingArrays,
+def _spec_verify_sample_impl(params, cfg: ModelConfig, cache, block_tables,
+                             state: DecodeState, samp: SamplingArrays,
+                             drafts: jax.Array,
                              num_steps: int = 1, spec_tokens: int = 3,
-                             ngram: int = 3, attn_mode=None, attn_mesh=None,
+                             attn_mode=None, attn_mesh=None,
                              attn_axis=None):
-    """`num_steps` fused n-gram-speculative steps in ONE dispatch.
+    """`num_steps` fused speculative verify rounds in ONE dispatch.
 
-    Each scan iteration: propose γ=spec_tokens drafts from the device-resident
-    history (ops/speculative.py), verify all γ+1 positions in one model step
-    (verify_step_impl), sample every position with its own (seed, step) PRNG
-    key, keep the longest draft-consistent prefix. Emits per iteration the
-    full sample row [B, γ+1] plus the per-lane emitted count m ∈ [1, γ+1];
-    the host drops the discarded tail at harvest exactly like it drops
-    post-stop tokens. Returns (state, cache, tokens [B, K, γ+1], counts [B, K]).
+    `drafts` [B, E] is the HOST-proposed continuation stream
+    (ops/speculative.propose_stream — prompt-lookup over the engine's own
+    token history, so no device-resident history buffer exists and the
+    carry is a plain DecodeState, donor-able exactly like non-speculative
+    decode). Each scan round: align into the stream by value
+    (align_drafts — the lane's current last token anchors its γ drafts,
+    which is what lets K rounds chain on device and stale host streams
+    still hit under the overlapped loop), verify [last-accepted,
+    draft 1..γ] in one multi-token model pass (verify_step_impl — the
+    same ragged/multistep verify layout the paged kernels parity-pin,
+    int8 dequant included), sample every position with its own
+    (seed, step) PRNG key, keep the longest draft-consistent prefix,
+    then COMMIT only the accepted inputs' KV: the touched pages (raw
+    bytes + int8 scales) were snapshotted before the round's writes and
+    rejected appends roll back via the serial write chain replay
+    (ops/speculative.rollback_commit) — rejected drafts leave nothing
+    behind (reject-independence, pinned by tests). Emits per round the
+    full sample
+    row [B, γ+1] plus the per-lane emitted count m ∈ [1, γ+1]; the host
+    drops the discarded tail at harvest exactly like it drops post-stop
+    tokens. Returns (state, cache, tokens [B, K, γ+1], counts [B, K]).
 
-    Sampling-step keys advance by m per lane, so emitted token t of a request
-    uses the same key as non-speculative decode would — output is identical
-    with speculation on or off, up to step-shape numerics (bit-exact in fp32;
-    see ops/speculative.py on the bf16 caveat).
+    Sampling-step keys advance by m per lane, so emitted token t of a
+    request uses the same key as non-speculative decode would — output is
+    identical with speculation on or off, up to step-shape numerics
+    (bit-exact in fp32; see ops/speculative.py on the bf16 and int8
+    transient-scale caveats).
     """
     s = spec_tokens + 1
+    bs = cache.block_size
+    capacity = block_tables.shape[1] * bs
     # Flattened per-(lane, position) sampling params; row order matches
     # logits.reshape(B*S, V): row = lane*S + position.
     temp_f = jnp.repeat(samp.temperature, s)
@@ -214,23 +220,25 @@ def _spec_decode_sample_impl(params, cfg: ModelConfig, cache, block_tables,
 
     def body(carry, _):
         st, cache = carry
-        drafts = propose_ngram(st.history, st.positions, spec_tokens, ngram)
-        inputs = jnp.concatenate([st.tokens[:, None], drafts], axis=1)  # [B, S]
-        logits, cache = verify_step_impl(params, cfg, inputs, cache,
-                                         block_tables, st.positions,
-                                         attn_mode=attn_mode,
-                                         attn_mesh=attn_mesh,
-                                         attn_axis=attn_axis)
+        drafts_k = align_drafts(drafts, st.tokens, spec_tokens)   # [B, γ]
+        inputs = jnp.concatenate([st.tokens[:, None], drafts_k], axis=1)  # [B, S]
+        blks = touched_pages(block_tables, st.positions, s, bs)
+        snap = snapshot_pages(cache, blks)
+        logits, cache, k_seq, v_seq = verify_step_impl(
+            params, cfg, inputs, cache, block_tables, st.positions,
+            attn_mode=attn_mode, attn_mesh=attn_mesh, attn_axis=attn_axis,
+            return_kv=True)
         b = inputs.shape[0]
         steps_f = (st.steps[:, None] + offs[None]).reshape(-1)
         keys = make_row_keys(seeds_f, steps_f)
         toks = sample(logits.reshape(b * s, -1), keys,
                       temp_f, topk_f, topp_f).reshape(b, s)
-        m = accept_counts(toks, drafts)                                 # [B]
+        m = accept_counts(toks, drafts_k)                               # [B]
+        cache = rollback_commit(cache, snap, blks, k_seq, v_seq,
+                                block_tables, st.positions, m, capacity)
         last = jnp.take_along_axis(toks, (m - 1)[:, None], axis=1)[:, 0]
-        hist = update_history(st.history, toks, st.positions)
-        new_st = SpecDecodeState(tokens=last, positions=st.positions + m,
-                                 steps=st.steps + m, history=hist)
+        new_st = DecodeState(tokens=last, positions=st.positions + m,
+                             steps=st.steps + m)
         return (new_st, cache), (toks, m)
 
     (state, cache), (toks, counts) = jax.lax.scan(
@@ -248,6 +256,10 @@ class ModelRunner:
         self.params = params
         self.decode_steps = max(1, int(decode_steps))
         self.spec_tokens = max(0, int(spec_tokens))
+        # Consumed by the ENGINE's host-side proposal (round 14 — no jit
+        # reads it): engine._propose_drafts prefers this value over its
+        # cfg's, so a runner built with a different lookup length keeps
+        # meaning something.
         self.spec_ngram = max(1, int(spec_ngram))
         # LLM_FUSED_KV_WRITE: decode dispatches write the fresh token KV
         # inside the paged-attention call (in-kernel on dma2/dma3,
@@ -284,15 +296,18 @@ class ModelRunner:
             donate_argnames=("cache", "carry"),
         )
         if self.spec_tokens > 0:
-            self._decode = jax.jit(
-                partial(_spec_decode_sample_impl, cfg=cfg,
-                        num_steps=self.decode_steps,
-                        spec_tokens=self.spec_tokens, ngram=self.spec_ngram,
-                        attn_mode=self.attn_mode, attn_mesh=self.attn_mesh,
-                        attn_axis=self.attn_axis),
-                donate_argnames=("cache",),
-            )
-            self._decode_overlapped = None  # engine refuses overlap x spec
+            # The speculative verify dispatch: drafts arrive host-proposed
+            # per dispatch, the carry is a plain DecodeState — so the
+            # overlapped-loop variant below is the same donation shape as
+            # non-speculative decode (round 14; overlap x spec composes).
+            spec_impl = partial(
+                _spec_verify_sample_impl, cfg=cfg,
+                num_steps=self.decode_steps, spec_tokens=self.spec_tokens,
+                attn_mode=self.attn_mode, attn_mesh=self.attn_mesh,
+                attn_axis=self.attn_axis)
+            self._decode = jax.jit(spec_impl, donate_argnames=("cache",))
+            self._decode_overlapped = jax.jit(
+                spec_impl, donate_argnames=("cache", "state"))
         else:
             self._decode = jax.jit(
                 partial(_decode_sample_impl, cfg=cfg, num_steps=self.decode_steps,
@@ -391,6 +406,16 @@ class ModelRunner:
     #: have no per-block host slicing or restore-write rule, so the
     #: engine refuses the knob at build (parallel/ runners set False).
     supports_migration: bool = True
+    #: whether this runner serves n-gram speculative decoding
+    #: (LLM_SPECULATION, rebuilt round 14): drafts are host-proposed and
+    #: the verify carry is a plain DecodeState, so the single-chip runner
+    #: AND the tp/sp runners serve it (the verify pass rides the same
+    #: shard-mapped/gather attention as plain decode — pinned by
+    #: tests/test_parallel.py). PPRunner alone declares False: the staged
+    #: pipeline jits have no multi-token verify stage, and its
+    #: constructor refuses spec_tokens outright — the engine refuses a
+    #: supplied speculative runner at build via this flag.
+    supports_speculation: bool = True
 
     def prepare_cache(self, cache: KVCache) -> KVCache:
         """Hook for placing a freshly allocated cache (TP runner shards it)."""
@@ -443,24 +468,40 @@ class ModelRunner:
         )
 
     # statics: hot-region(dispatch-wrappers)
-    def decode(self, cache, block_tables, state, samp):
-        """One fused dispatch covering `decode_steps` model steps.
+    def decode(self, cache, block_tables, state, samp, drafts=None):
+        """One fused dispatch covering `decode_steps` model steps. `state`
+        is a DecodeState either way.
 
-        Non-speculative (spec_tokens == 0): state is a DecodeState; returns
-        (DecodeState, cache, tokens [B, decode_steps]).
-        Speculative: state is a SpecDecodeState; returns (SpecDecodeState,
-        cache, tokens [B, decode_steps, spec_tokens+1], counts
-        [B, decode_steps]) — the engine keeps counts[b, k] tokens of row k."""
+        Non-speculative (spec_tokens == 0): returns (DecodeState, cache,
+        tokens [B, decode_steps]); `drafts` must be None.
+        Speculative: `drafts` is the host-proposed [B, E] continuation
+        stream (each round aligns into it by value on device — see
+        ops/speculative.align_drafts); returns (DecodeState, cache, tokens
+        [B, decode_steps, spec_tokens+1], counts [B, decode_steps]) — the
+        engine keeps counts[b, k] tokens of row k. The verify pass writes
+        through the chained writers regardless of `fused_kv_write` (the
+        in-kernel fused write carries exactly one token; the multi-token
+        verify chain IS its write sequence), so the knob composes
+        functionally: every single-token dispatch stays fused."""
+        if self.spec_tokens > 0:
+            return self._decode(self.params, cache=cache,
+                                block_tables=block_tables, state=state,
+                                samp=samp, drafts=drafts)
         return self._decode(self.params, cache=cache, block_tables=block_tables,
                             state=state, samp=samp)
 
     # statics: hot-region(dispatch-wrappers)
-    def decode_overlapped(self, cache, block_tables, state, samp):
+    def decode_overlapped(self, cache, block_tables, state, samp, drafts=None):
         """decode() with the DecodeState carry donated (LLM_DECODE_OVERLAP
-        hot loop; non-speculative only). Callers must treat `state` as
-        consumed — the engine replaces its reference with the returned
-        state, and the in-flight token outputs are separate buffers, so
-        the donation is invisible outside the dispatch."""
+        hot loop). Callers must treat `state` as consumed — the engine
+        replaces its reference with the returned state, and the in-flight
+        token outputs are separate buffers, so the donation is invisible
+        outside the dispatch. The speculative variant takes the same
+        host-proposed `drafts` operand as decode()."""
+        if self.spec_tokens > 0:
+            return self._decode_overlapped(
+                self.params, cache=cache, block_tables=block_tables,
+                state=state, samp=samp, drafts=drafts)
         return self._decode_overlapped(
             self.params, cache=cache, block_tables=block_tables,
             state=state, samp=samp)

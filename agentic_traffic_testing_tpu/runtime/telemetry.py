@@ -61,6 +61,7 @@ PHASE_CHUNK = "chunk"
 PHASE_HYBRID = "hybrid"
 PHASE_DECODE = "decode"
 PHASE_OVERLAPPED_DECODE = "overlapped_decode"
+PHASE_SPECULATIVE_DECODE = "speculative_decode"
 PHASE_DRAIN = "drain"
 
 #: every phase a StepRecord can carry — the exporter pre-touches these
@@ -72,6 +73,7 @@ STEP_PHASES = (
     PHASE_HYBRID,
     PHASE_DECODE,
     PHASE_OVERLAPPED_DECODE,
+    PHASE_SPECULATIVE_DECODE,
     PHASE_DRAIN,
 )
 
@@ -224,7 +226,8 @@ class StepClock:
             self.steps.append(StepRecord(self._seq, kind, t0, t1 - t0, batch,
                                          tokens, predicted))
         self.step_samples.append((kind, t1 - t0))
-        if kind in (PHASE_DECODE, PHASE_OVERLAPPED_DECODE):
+        if kind in (PHASE_DECODE, PHASE_OVERLAPPED_DECODE,
+                    PHASE_SPECULATIVE_DECODE):
             self.last_decode_batch = batch
 
     # statics: thread(engine-loop)

@@ -80,16 +80,18 @@ class ServerConfig:
     # amortizing the per-dispatch tunnel overhead to one chunk's worth
     # (runtime/engine.py _run_prefill_pipelined). 0 (default) keeps the
     # single-dispatch prefill bit-identical; single-chip runners only
-    # (tp/sp/pp refuse at engine build), not wired with LLM_SPECULATION.
+    # (tp/sp/pp refuse at engine build). Composes with LLM_SPECULATION
+    # since round 14.
     prefill_pipeline_chunks: int = 0           # LLM_PREFILL_PIPELINE
     # Overlapped decode loop (round 7): dispatch fused-step N+1 against
     # the predicted composition while step N executes — skips the full
     # per-dispatch schedule pass, keeps block tables device-resident
     # (incremental scatter), donates the DecodeState carry. 0 (default)
     # keeps the serial decode loop bit-identical; 1 is token-identical
-    # under EOS/admission/abort churn (runtime/engine.py). Single-chip,
-    # non-speculative runners only: refused here and at engine build
-    # with LLM_SPECULATION or tp/sp/pp meshes, not at first step.
+    # under EOS/admission/abort churn (runtime/engine.py). Single-chip
+    # runners only (tp/sp/pp refuse at engine build). Composes with
+    # LLM_SPECULATION since round 14: the speculative verify dispatch IS
+    # the predicted next-step dispatch.
     decode_overlap: int = 0                    # LLM_DECODE_OVERLAP
     # Step-clock telemetry plane (round 8 — runtime/telemetry.py): 0
     # (default) keeps the engine hot loop byte-identical and allocation-
@@ -165,8 +167,10 @@ class ServerConfig:
     # Fused KV page writes (round 10): 1 folds the decode token write into
     # the dma2/dma3 attention kernels and the hybrid chunk page scatter
     # into the ragged kernel (aliased pools; functional fusion off-TPU).
-    # 0 (default) keeps every write path bit-identical. Single-chip,
-    # non-speculative runners only; int8 x hybrid refuses at build.
+    # 0 (default) keeps every write path bit-identical. Single-chip
+    # runners only; int8 x hybrid refuses at build. Composes with
+    # LLM_SPECULATION (round 14): single-token dispatches stay fused, the
+    # multi-token verify keeps its chained write sequence.
     fused_kv_write: int = 0                    # LLM_FUSED_KV_WRITE
     # AWQ-style K-group size for int4 weight scales (0 = per-column).
     int4_k_group: int = 0                      # LLM_INT4_K_GROUP
@@ -189,6 +193,10 @@ class ServerConfig:
     speculation: Optional[str] = None          # LLM_SPECULATION ("ngram" | unset)
     spec_tokens: int = 3                       # LLM_SPEC_TOKENS (drafts/step)
     spec_ngram: int = 3                        # LLM_SPEC_NGRAM (match length)
+    # Bound the host-side prompt-lookup scan to each lane's trailing
+    # this-many tokens (0 = whole history). Long multi-turn agentic
+    # histories cap the per-dispatch host scan with it.
+    spec_lookup_window: int = 0                # LLM_SPEC_LOOKUP_WINDOW
 
     def _validate_elastic(self) -> None:
         """Round-11 elastic-serving knob coherence — shared by the env
@@ -289,12 +297,6 @@ class ServerConfig:
             raise ValueError(
                 f"LLM_DECODE_OVERLAP must be 0 or 1, got {c.decode_overlap} "
                 f"(unset it for the serial decode loop)")
-        if c.decode_overlap and (os.environ.get("LLM_SPECULATION") or None):
-            # Same refusal the engine makes at build — surfaced at env
-            # parse so a compose file learns before any model loads.
-            raise ValueError(
-                "LLM_DECODE_OVERLAP x LLM_SPECULATION is not wired — "
-                "disable one of them")
         c.step_trace = int(os.environ.get("LLM_STEP_TRACE") or c.step_trace)
         if c.step_trace < 0:
             raise ValueError(
@@ -356,12 +358,6 @@ class ServerConfig:
             raise ValueError(
                 f"LLM_FUSED_KV_WRITE must be 0 or 1, got {c.fused_kv_write} "
                 f"(unset it for the separate-dispatch KV writes)")
-        if c.fused_kv_write and (os.environ.get("LLM_SPECULATION") or None):
-            # Same refusal the engine makes at build — surfaced at env
-            # parse so a compose file learns before any model loads.
-            raise ValueError(
-                "LLM_FUSED_KV_WRITE x LLM_SPECULATION is not wired — "
-                "disable one of them")
         c.int4_k_group = int(os.environ.get("LLM_INT4_K_GROUP") or c.int4_k_group)
         nb = os.environ.get("LLM_NUM_BLOCKS")
         c.num_blocks = int(nb) if nb else None
@@ -378,6 +374,12 @@ class ServerConfig:
         c.speculation = os.environ.get("LLM_SPECULATION") or None
         c.spec_tokens = int(os.environ.get("LLM_SPEC_TOKENS") or c.spec_tokens)
         c.spec_ngram = int(os.environ.get("LLM_SPEC_NGRAM") or c.spec_ngram)
+        c.spec_lookup_window = int(
+            os.environ.get("LLM_SPEC_LOOKUP_WINDOW") or c.spec_lookup_window)
+        if c.spec_lookup_window < 0:
+            raise ValueError(
+                f"LLM_SPEC_LOOKUP_WINDOW must be >= 0 (0 = scan the whole "
+                f"history), got {c.spec_lookup_window}")
         return c
 
     @classmethod
@@ -471,6 +473,10 @@ class ServerConfig:
                        help="'ngram' enables prompt-lookup speculative decoding")
         p.add_argument("--spec-tokens", type=int, default=c.spec_tokens)
         p.add_argument("--spec-ngram", type=int, default=c.spec_ngram)
+        p.add_argument("--spec-lookup-window", type=int,
+                       default=c.spec_lookup_window,
+                       help="bound the host-side prompt-lookup scan to the "
+                            "trailing this-many tokens (0 = whole history)")
         a = p.parse_args(argv)
         for f in ("model", "dtype", "max_num_seqs", "max_num_batched_tokens",
                   "memory_utilization", "max_tokens", "max_model_len",
@@ -486,7 +492,8 @@ class ServerConfig:
                   "host_cache_gb", "hybrid_token_budget",
                   "kv_cache_dtype", "fused_kv_write",
                   "num_blocks", "block_size", "weights_path",
-                  "speculation", "spec_tokens", "spec_ngram"):
+                  "speculation", "spec_tokens", "spec_ngram",
+                  "spec_lookup_window"):
             setattr(c, f, getattr(a, f))
         c._validate_elastic()  # re-check after CLI overrides
         if c.host_cache_gb and not c.prefix_caching:
@@ -508,18 +515,13 @@ class ServerConfig:
             )
 
             parse_fault_spec(c.fault_spec)  # re-check after CLI override
-        if c.decode_overlap and c.speculation:
-            # Re-check after CLI overrides (--speculation may arrive here).
-            raise ValueError(
-                "--decode-overlap does not compose with --speculation — "
-                "disable one of them")
         if c.fused_kv_write not in (0, 1):
             raise ValueError(
                 f"--fused-kv-write must be 0 or 1, got {c.fused_kv_write}")
-        if c.fused_kv_write and c.speculation:
+        if c.spec_lookup_window < 0:
             raise ValueError(
-                "--fused-kv-write does not compose with --speculation — "
-                "disable one of them")
+                f"--spec-lookup-window must be >= 0, got "
+                f"{c.spec_lookup_window}")
         if c.step_trace < 0:
             raise ValueError(
                 f"--step-trace must be >= 0, got {c.step_trace}")

@@ -230,7 +230,9 @@ class LLMMetrics:
                 "Configured host KV tier budget (LLM_HOST_CACHE_GB)",
                 registry=r)
         # Additive (no reference analog): speculative-decoding acceptance.
-        # emitted/iters = mean tokens kept per verify step, in [1, spec+1].
+        # emitted/iters = mean tokens kept per verify step, in [1, spec+1];
+        # accepted/draft = the draft acceptance rate the round-14 bench
+        # probe reports (spec_accept_rate).
         self.spec_emitted_tokens = Gauge(
             f"{prefix}_spec_emitted_tokens_total",
             "Tokens emitted by speculative verify steps (cumulative)",
@@ -239,6 +241,23 @@ class LLMMetrics:
             f"{prefix}_spec_verify_iters_total",
             "Speculative verify iterations run (cumulative, live lanes)",
             registry=r)
+        self.spec_draft_tokens = Gauge(
+            f"{prefix}_spec_draft_tokens_total",
+            "Draft tokens proposed to speculative verify rounds "
+            "(cumulative, consumed rounds)", registry=r)
+        self.spec_accepted_tokens = Gauge(
+            f"{prefix}_spec_accepted_tokens_total",
+            "Draft tokens accepted by speculative verification "
+            "(cumulative)", registry=r)
+        self.spec_rounds = Gauge(
+            f"{prefix}_spec_rounds_total",
+            "Speculative draft+verify rounds run (cumulative; alias of "
+            "the verify-iterations counter under the round-14 naming)",
+            registry=r)
+        self.config_speculation = Gauge(
+            f"{prefix}_config_speculation",
+            "Speculative decoding enabled (LLM_SPECULATION encoded: "
+            "0 = off, 1 = ngram prompt-lookup)", registry=r)
         # 1 = checkpoint weights loaded; 0 = randomly initialized (dev mode
         # or explicit LLM_ALLOW_RANDOM_WEIGHTS=1 fallback). Alert on 0 in any
         # deployment that sets LLM_WEIGHTS_PATH.
@@ -537,11 +556,17 @@ class LLMMetrics:
             self.replica_health.labels(replica=str(i)).set(
                 self._HEALTH_VALUES.get(state, 0.0))
 
-    def set_spec_stats(self, *, emitted: int, iters: int) -> None:
+    def set_spec_stats(self, *, emitted: int, iters: int,
+                       drafted: int = 0, accepted: int = 0) -> None:
         """Refresh speculation-acceptance gauges (called on scrape; zeros
         until a speculative engine has decoded something)."""
         self.spec_emitted_tokens.set(emitted)
         self.spec_verify_iters.set(iters)
+        self.spec_draft_tokens.set(drafted)
+        self.spec_accepted_tokens.set(accepted)
+        # One round = one verify iteration; the round-14 name keeps the
+        # pre-existing iters family intact for old dashboards.
+        self.spec_rounds.set(iters)
 
     # statics: thread(handler)
     def record_request(self, status: str, latency_s: float, queue_wait_s: float,
@@ -567,7 +592,8 @@ class LLMMetrics:
                           slo_ttft_ms: float = 0.0,
                           slo_itl_ms: float = 0.0,
                           kv_cache_dtype: int = 0,
-                          fused_kv_write: int = 0) -> None:
+                          fused_kv_write: int = 0,
+                          speculation: int = 0) -> None:
         # max_num_seqs/max_num_batched_tokens stay PER-REPLICA values (the
         # configured knob, a config snapshot — docs/monitoring.md); the
         # pool-wide seat count is num_replicas * max_num_seqs.
@@ -586,6 +612,7 @@ class LLMMetrics:
         self.config_slo_itl_ms.set(slo_itl_ms)
         self.config_kv_cache_dtype.set(kv_cache_dtype)
         self.config_fused_kv_write.set(fused_kv_write)
+        self.config_speculation.set(speculation)
 
     def set_kv_gauges(self, *, num_blocks: int, block_size: int,
                       max_model_len: int, max_num_seqs: int) -> None:

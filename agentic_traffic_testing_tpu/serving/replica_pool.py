@@ -820,6 +820,14 @@ class EnginePool:
         return sum(e.spec_iters for e in self.engines)
 
     @property
+    def spec_drafted(self) -> int:
+        return sum(e.spec_drafted for e in self.engines)
+
+    @property
+    def spec_accepted(self) -> int:
+        return sum(e.spec_accepted for e in self.engines)
+
+    @property
     def num_pipeline_dispatches(self) -> int:
         return sum(e.num_pipeline_dispatches for e in self.engines)
 

@@ -225,6 +225,7 @@ class LLMServer:
                 kv_cache_dtype={"fp8": 1, "fp8_e4m3": 1, "int8": 2}.get(
                     cfg.kv_cache_dtype or "", 0),
                 fused_kv_write=cfg.fused_kv_write,
+                speculation=1 if cfg.speculation else 0,
             )
             if self.pool is not None:
                 # Pool aggregate under the EXACT pre-pool names: blocks and
@@ -287,6 +288,7 @@ class LLMServer:
             moe_capacity_factor=c.moe_capacity_factor,
             speculation=c.speculation, spec_tokens=c.spec_tokens,
             spec_ngram=c.spec_ngram,
+            spec_lookup_window=c.spec_lookup_window,
         )
         runner = None
         params = None
@@ -645,7 +647,10 @@ class LLMServer:
         self.metrics.set_prefix_cache_stats(kv)
         self.metrics.set_host_cache_stats(kv)
         self.metrics.set_spec_stats(emitted=source.spec_emitted,
-                                    iters=source.spec_iters)
+                                    iters=source.spec_iters,
+                                    drafted=getattr(source, "spec_drafted", 0),
+                                    accepted=getattr(source, "spec_accepted",
+                                                     0))
         self.metrics.set_prefill_pipeline_stats(
             dispatches=getattr(source, "num_pipeline_dispatches", 0))
         self.metrics.set_decode_overlap_stats(

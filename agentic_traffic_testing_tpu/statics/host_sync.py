@@ -23,9 +23,11 @@ following are findings unless pragma'd with
 
 Uploads (`jnp.asarray`, `copy_to_host_async`) are NOT flagged: they
 enqueue without blocking. The intentional sync points (the batched
-harvest readback, the final-chunk TTFT stamp, the speculative-prefill
-history seed, the host-tier save drain) carry pragmas whose reasons
-document why each one is allowed to block.
+harvest readback, the final-chunk TTFT stamp, the host-tier save
+drain) carry pragmas whose reasons document why each one is allowed to
+block. (Round 14 dropped the speculative-prefill history-seed sync:
+speculation's history is host-side now, so the spec prefill rides the
+async handoff like everything else.)
 """
 
 from __future__ import annotations

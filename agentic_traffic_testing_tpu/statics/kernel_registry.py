@@ -165,6 +165,14 @@ def _fused_flags(stacked: bool, quantized: bool, fused: bool) -> dict:
                 fused_write=fused)
 
 
+#: The speculative-verify geometry (round 14): s_q > 1 query rows per lane
+#: — the multi-token dispatch the composable speculation path traces for
+#: every round. γ = 3 drafts (the LLM_SPEC_TOKENS default) makes S = 4.
+#: Fused-write variants stay single-query by contract (the wrapper raises
+#: on fused x s_q > 1; the speculative verify keeps its chained write
+#: sequence), so the verify rows cross with the plain and int8 flags only.
+_VERIFY = dict(_POOL, s_q=4)
+
 _DMA23_VARIANTS = (
     KernelVariant("bf16", flags=_fused_flags(True, False, False),
                   bindings=_POOL),
@@ -178,6 +186,10 @@ _DMA23_VARIANTS = (
                   bindings=_POOL),
     KernelVariant("int8+fused", flags=_fused_flags(True, True, True),
                   bindings=_POOL, dtypes=_INT8),
+    KernelVariant("verify", flags=_fused_flags(True, False, False),
+                  bindings=_VERIFY),
+    KernelVariant("verify-int8", flags=_fused_flags(True, True, False),
+                  bindings=_VERIFY, dtypes=_INT8),
 )
 
 KERNELS: tuple[Kernel, ...] = (
@@ -192,6 +204,8 @@ KERNELS: tuple[Kernel, ...] = (
             KernelVariant("bf16", flags=dict(stacked=True), bindings=_POOL),
             KernelVariant("bf16-flat", flags=dict(stacked=False),
                           bindings=_POOL),
+            KernelVariant("verify", flags=dict(stacked=True),
+                          bindings=_VERIFY),
         ),
         full_axis=frozenset({"rows", "hd"}),
         parallel_reason=(
@@ -211,6 +225,8 @@ KERNELS: tuple[Kernel, ...] = (
             KernelVariant("bf16", flags=dict(stacked=True), bindings=_POOL),
             KernelVariant("bf16-flat", flags=dict(stacked=False),
                           bindings=_POOL),
+            KernelVariant("verify", flags=dict(stacked=True),
+                          bindings=_VERIFY),
         ),
         full_axis=frozenset({"rows", "hd"}),
         parallel_reason=(

@@ -184,6 +184,9 @@ KNOBS: tuple[Knob, ...] = (
          "Drafts verified per speculative step."),
     Knob("LLM_SPEC_NGRAM", "int", "3", "serving/config.py",
          "Trailing n-gram length matched against history."),
+    Knob("LLM_SPEC_LOOKUP_WINDOW", "int", "0", "serving/config.py",
+         "Bound the host-side prompt-lookup scan to each lane's trailing "
+         "this-many tokens (0 = whole history)."),
     Knob("LLM_PROFILE_DIR", "path", "/tmp/att_tpu_profile",
          "serving/server.py",
          "jax.profiler trace directory for the /profile/start endpoint."),
@@ -277,6 +280,9 @@ KNOBS: tuple[Knob, ...] = (
     Knob("BENCH_KV_QUANT", "bool", "1", "bench.py",
          "0 disables the KV-quantization A/B probe (bf16 vs fp8 vs int8 "
          "decode tok/s + output-quality gate)."),
+    Knob("BENCH_SPEC_DECODE", "bool", "1", "bench.py",
+         "0 disables the speculative-decoding probe (agentic fan-out ITL "
+         "A/B + acceptance rate + token-identity gate)."),
     Knob("BENCH_HYBRID", "bool", "1", "bench.py",
          "0 disables the hybrid on/off A/B series."),
     Knob("BENCH_HYBRID_BUDGET", "int", "256 (tpu) / 48", "bench.py",

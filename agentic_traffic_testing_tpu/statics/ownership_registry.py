@@ -169,6 +169,10 @@ OWNED_ATTRS: tuple[OwnedAttr, ...] = (
               "", "speculative verify iterations (scrape reads)"),
     OwnedAttr("LLMEngine", "spec_emitted", ENGINE_LOOP,
               "", "speculative emitted tokens (scrape reads)"),
+    OwnedAttr("LLMEngine", "spec_drafted", ENGINE_LOOP,
+              "", "speculative draft tokens proposed (scrape reads)"),
+    OwnedAttr("LLMEngine", "spec_accepted", ENGINE_LOOP,
+              "", "speculative draft tokens accepted (scrape reads)"),
     OwnedAttr("LLMEngine", "telemetry", ENGINE_LOOP,
               "", "StepClock recorder; attached at build or by bench "
               "probes before stepping"),

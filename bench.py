@@ -963,6 +963,131 @@ def main() -> None:
             kv_quant_res = None
             print(f"bench: kv_quant probe dropped ({e!r})", file=sys.stderr)
 
+    # Speculative-decoding probe (round 14): the agentic fan-out workload —
+    # short tool-call-sized completions over highly self-repetitive,
+    # shared-prefix sibling prompts (PAPER.md L7/L8), the low-batch
+    # latency-bound regime prompt-lookup speculation exists for. Measures
+    # per-request ITL p50 with LLM_SPECULATION=ngram on vs off under a
+    # token-identity gate (exact in fp32 off-TPU at this probe's SHORT
+    # horizon — the step-shape byte drift ops/speculative.py documents
+    # needs length to flip a near-tie; first-token + >= 0.9 greedy
+    # agreement under TPU bf16), plus the draft acceptance
+    # rate from the engine's llm_spec_* counters. A failed gate DROPS the
+    # probe loudly instead of reporting fast-but-wrong numbers.
+    # BENCH_SPEC_DECODE=0 disables.
+    spec_decode_on = os.environ.get(
+        "BENCH_SPEC_DECODE", "1") not in ("0", "false")
+
+    def spec_decode_probe():
+        import jax.numpy as jnp
+
+        from agentic_traffic_testing_tpu.models.llama import init_params
+        from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+        lanes = min(5, fanout)
+        sp_decode = 20                          # short tool-call responses
+        sp_spec_tokens = 3
+        mc = engine.model_cfg
+        # fp32 params off-TPU so the identity gate is exact; on TPU the
+        # probe shares the primary runner's (possibly bf16) params — no
+        # second HBM-resident weight tree.
+        if platform == "tpu":
+            sp_params, sp_dtype = engine.runner.params, "bfloat16"
+        else:
+            sp_params = init_params(mc, jax.random.key(0), dtype=jnp.float32)
+            sp_dtype = "float32"
+        # ONE canonical agentic fan-out workload generator, shared with
+        # the A/B script so the probe and scripts/dev/spec_ab.py can
+        # never drift apart while measuring under the same name.
+        import importlib.util as _ilu
+
+        _spec_ab_path = os.path.join(os.path.dirname(os.path.abspath(
+            __file__)), "scripts", "dev", "spec_ab.py")
+        _sa_spec = _ilu.spec_from_file_location("_bench_spec_ab",
+                                                _spec_ab_path)
+        _sa = _ilu.module_from_spec(_sa_spec)
+        _sa_spec.loader.exec_module(_sa)
+        prompts = _sa.agentic_prompts(lanes, 8, vocab)
+        max_len = max(256, len(max(prompts, key=len)) + sp_decode + 64)
+        bs_ = cfg.block_size
+
+        def run(spec):
+            runner_ = ModelRunner(mc, sp_params, decode_steps=decode_steps or 2,
+                                  spec_tokens=sp_spec_tokens if spec else 0)
+            eng = LLMEngine(EngineConfig(
+                model=model, dtype=sp_dtype, max_num_seqs=lanes,
+                max_model_len=max_len,
+                num_blocks=max(256, lanes * (-(-max_len // bs_) + 4)),
+                decode_steps=decode_steps,
+                speculation="ngram" if spec else None,
+                spec_tokens=sp_spec_tokens,
+            ), model_cfg=mc, runner=runner_)
+
+            def wave():
+                reqs = [eng.add_request(p, SamplingParams(
+                    temperature=0.0, max_tokens=sp_decode, ignore_eos=True))
+                    for p in prompts]
+                while eng.has_work() and not all(
+                        r.is_finished() for r in reqs):
+                    eng.step()
+                itls = [(r.finish_time - r.first_token_time)
+                        / max(1, len(r.output_ids) - 1) for r in reqs]
+                return [r.output_ids for r in reqs], statistics.median(itls)
+
+            wave()  # warmup: compile outside timing
+            outs = itl = None
+            samples = []
+            for _ in range(reps):
+                outs, itl = wave()
+                samples.append(itl)
+            return outs, statistics.median(samples), eng
+
+        serial_outs, serial_itl, _ = run(False)
+        spec_outs, spec_itl, spec_eng = run(True)
+        # Token-identity gate (the correctness half of the ITL claim).
+        if platform == "tpu":
+            flat_ref = [t for o in serial_outs for t in o]
+            flat = [t for o in spec_outs for t in o]
+            if not all(o and r and o[0] == r[0]
+                       for o, r in zip(spec_outs, serial_outs)):
+                raise RuntimeError(
+                    "spec_decode gate: first token diverged from the "
+                    "serial loop")
+            agree = (sum(a == b for a, b in zip(flat, flat_ref))
+                     / max(1, len(flat_ref)))
+            if agree < 0.9:
+                raise RuntimeError(
+                    f"spec_decode gate: greedy agreement {agree:.2f} < 0.9 "
+                    f"vs the serial loop")
+            identity = round(agree, 3)
+        else:
+            if spec_outs != serial_outs:
+                raise RuntimeError(
+                    "spec_decode gate: speculative output diverged from "
+                    "the serial loop (fp32 — must be exact)")
+            identity = 1.0
+        accept = spec_eng.spec_accepted / max(1, spec_eng.spec_drafted)
+        return {
+            "spec_decode_lanes": lanes,
+            "spec_decode_tokens": sp_decode,
+            "spec_tokens": sp_spec_tokens,
+            "spec_itl_p50_s": round(spec_itl, 5),
+            "serial_itl_p50_s": round(serial_itl, 5),
+            "spec_accept_rate": round(accept, 4),
+            "spec_emitted_per_round": round(
+                spec_eng.spec_emitted / max(1, spec_eng.spec_iters), 3),
+            "spec_token_identity": identity,
+        }
+
+    spec_res = None
+    if spec_decode_on:
+        try:
+            spec_res = spec_decode_probe()
+        except Exception as e:
+            spec_res = None
+            print(f"bench: spec_decode probe dropped ({e!r})",
+                  file=sys.stderr)
+
     replica_res = None
     if replicas_on:
         try:
@@ -1324,6 +1449,7 @@ def main() -> None:
         **({} if replica_res is None else replica_res),
         **({} if offload_res is None else offload_res),
         **({} if kv_quant_res is None else kv_quant_res),
+        **({} if spec_res is None else spec_res),
         **({} if prefill_s is None else {
             # Compute-bound half of serving (round-3 flash prefill site).
             # est_mfu counts dense matmul FLOPs (2 * non-embedding params

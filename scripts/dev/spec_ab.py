@@ -1,0 +1,191 @@
+#!/usr/bin/env python3
+"""Speculative-decoding A/B: LLM_SPECULATION=ngram on/off, engine-isolated.
+
+The engine-level A/B for the round-14 composable-speculation claims,
+isolated from the HTTP layer: the agentic fan-out workload (short
+tool-call-sized completions over highly self-repetitive, shared-prefix
+sibling prompts — PAPER.md L7/L8, the regime prompt-lookup exists for)
+measured with the serial fused-decode loop (`serial`) vs the fused
+draft+verify dispatch (`spec`, LLM_SPECULATION=ngram — host-proposed
+continuation streams, value-aligned drafts, multi-token verify through
+the paged verify layout, rejected appends rolled back). One JSON line
+per arm:
+
+    {"mode": "serial"|"spec", "itl_p50_s": ..., "decode_toks_s": ...,
+     "accept_rate": ..., "emitted_per_round": ..., "outputs_match": true}
+
+The workload deliberately churns: more requests than seats (admission
+mid-decode), mixed greedy/seeded sampling, mixed max_tokens, and an EOS
+stop token picked from a deterministic probe pass so some lanes stop
+mid-dispatch — the same churn shapes the engine suite pins token
+identity under (tests/test_speculative.py). `outputs_match` asserts
+every arm's completions are token-identical (the correctness half of
+the claim); `accept_rate` > 0 on this workload is the win's existence
+proof (the repetitive siblings make prompt-lookup drafts land). Each
+arm builds its own ModelRunner over SHARED params (the spec verify
+program is a different jit), so compiles are paid once per arm.
+Numbers feed docs/BENCHMARKS.md once measured on hardware.
+
+Usage: python scripts/dev/spec_ab.py [n_requests] [prompt_reps] [max_tokens]
+Env: SPEC_AB_MODEL (default: tiny fp32 on cpu, llama-3.2-1b bf16 on tpu),
+     SPEC_AB_SEATS (default 4 on cpu, 8 on tpu),
+     SPEC_AB_TOKENS (γ drafts per round, default 3).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import statistics
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def agentic_prompts(n_requests: int, prompt_reps: int, vocab: int):
+    """Shared-prefix fan-out siblings over a verbatim-repetitive scenario
+    block — the reference's recruit→decide→execute→evaluate shape, where
+    every worker re-quotes the orchestrator's period-P instruction text."""
+    import numpy as np
+
+    wl = np.random.default_rng(41)
+    period = wl.integers(10, vocab - 10, 12).tolist()
+    shared = period * prompt_reps                # the quoted scenario block
+    return [shared + period[: 3 + (i % 5)] for i in range(n_requests)]
+
+
+def run_arm(spec: int, *, params, model_cfg, model: str, dtype: str,
+            seats: int, n_requests: int, prompt_reps: int, max_tokens: int,
+            spec_tokens: int, decode_steps: int, reps: int) -> dict:
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+    from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+    prompts = agentic_prompts(n_requests, prompt_reps, model_cfg.vocab_size)
+    block_size = 16
+    max_len = max(256, max(len(p) for p in prompts) + max_tokens + 64)
+    runner = ModelRunner(model_cfg, params, decode_steps=decode_steps,
+                         spec_tokens=spec_tokens if spec else 0)
+    eng = LLMEngine(EngineConfig(
+        model=model, dtype=dtype, max_num_seqs=seats, max_model_len=max_len,
+        block_size=block_size,
+        num_blocks=max(256, seats * (-(-max_len // block_size) + 4)),
+        speculation="ngram" if spec else None, spec_tokens=spec_tokens,
+        decode_steps=decode_steps,
+    ), model_cfg=model_cfg, runner=runner)
+
+    # Deterministic probe: one greedy completion picks the EOS token the
+    # churn wave will stop on — identical across arms by construction.
+    probe = eng.generate(prompts[0], SamplingParams(
+        temperature=0.0, max_tokens=max_tokens, ignore_eos=True))
+    stop_tok = probe.output_ids[len(probe.output_ids) // 2]
+
+    def sampling(i: int) -> SamplingParams:
+        # Mixed stop lengths + mixed greedy/seeded + a reachable stop
+        # token on the greedy lanes: stops land mid-round, admissions
+        # follow, and the accepted-prefix commit must survive both.
+        if i % 2 == 0:
+            return SamplingParams(temperature=0.0,
+                                  max_tokens=max_tokens - (i % 3),
+                                  stop_token_ids=[stop_tok])
+        return SamplingParams(temperature=0.8, top_k=20, seed=5 + i,
+                              max_tokens=max_tokens // 2 + (i % 4),
+                              ignore_eos=True)
+
+    def wave():
+        reqs = [eng.add_request(p, sampling(i))
+                for i, p in enumerate(prompts)]
+        t0 = time.monotonic()
+        while eng.has_work() and not all(r.is_finished() for r in reqs):
+            eng.step()
+        dt = time.monotonic() - t0
+        itls = [(r.finish_time - r.first_token_time)
+                / max(1, len(r.output_ids) - 1)
+                for r in reqs if len(r.output_ids) > 1]
+        return (reqs, sum(len(r.output_ids) for r in reqs) / dt,
+                statistics.median(itls))
+
+    wave()  # warmup: pay every compile outside timing
+    vals, itls = [], []
+    reqs = None
+    for _ in range(reps):
+        reqs, toks_s, itl = wave()
+        vals.append(toks_s)
+        itls.append(itl)
+    out = {
+        "mode": "spec" if spec else "serial",
+        "requests": n_requests,
+        "seats": seats,
+        "decode_toks_s": round(statistics.median(vals), 2),
+        "itl_p50_s": round(statistics.median(itls), 5),
+        "outputs": [r.output_ids for r in reqs],
+    }
+    if spec:
+        out["accept_rate"] = round(
+            eng.spec_accepted / max(1, eng.spec_drafted), 4)
+        out["emitted_per_round"] = round(
+            eng.spec_emitted / max(1, eng.spec_iters), 3)
+    return out
+
+
+def main(argv=None) -> list[dict]:
+    argv = [int(a) for a in (argv if argv is not None else sys.argv[1:])]
+    n_requests = argv[0] if len(argv) > 0 else 6
+    prompt_reps = argv[1] if len(argv) > 1 else 6
+    max_tokens = argv[2] if len(argv) > 2 else 14
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import init_params
+
+    platform = jax.devices()[0].platform
+    model = os.environ.get(
+        "SPEC_AB_MODEL", "llama-3.2-1b" if platform == "tpu" else "tiny")
+    # fp32 off-TPU so the identity gate is exact at this script's short
+    # completion horizon (ops/speculative.py documents the step-shape
+    # byte drift that can flip a near-tie at much longer lengths).
+    dtype = "bfloat16" if platform == "tpu" else "float32"
+    seats = int(os.environ.get(
+        "SPEC_AB_SEATS", "8" if platform == "tpu" else "4"))
+    spec_tokens = int(os.environ.get("SPEC_AB_TOKENS", "3"))
+    decode_steps = 2 if platform != "tpu" else 8
+    reps = 3 if platform == "tpu" else 1
+    model_cfg = resolve_config(model)
+    params = init_params(
+        model_cfg, jax.random.key(0),
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    print(f"devices: {jax.devices()}  requests={n_requests} seats={seats} "
+          f"model={model}", file=sys.stderr, flush=True)
+
+    common = dict(params=params, model_cfg=model_cfg, model=model,
+                  dtype=dtype, seats=seats, n_requests=n_requests,
+                  prompt_reps=prompt_reps, max_tokens=max_tokens,
+                  spec_tokens=spec_tokens, decode_steps=decode_steps,
+                  reps=reps)
+    results = [run_arm(sp, **common) for sp in (0, 1)]
+    # Correctness gate: both arms must produce identical completions
+    # (exact off-TPU in fp32; on TPU bf16 near-ties may flip — the
+    # documented step-shape caveat — so the gate loosens to agreement).
+    if platform == "tpu":
+        flat = [[t for o in r["outputs"] for t in o] for r in results]
+        agree = (sum(a == b for a, b in zip(*flat)) / max(1, len(flat[0])))
+        match = (results[0]["outputs"][0][:1] == results[1]["outputs"][0][:1]
+                 and agree >= 0.9)
+    else:
+        match = results[0]["outputs"] == results[1]["outputs"]
+    for r in results:
+        r["outputs_match"] = bool(match)
+        r.pop("outputs")
+        print(json.dumps(r), flush=True)
+    return results
+
+
+if __name__ == "__main__":
+    main()

@@ -17,8 +17,9 @@ pinned here, in the DEFAULT tier on CPU (acceptance criterion):
   * the dma3 widened (B, KH, C) lane-parallel grid matches dma2 and the
     jnp oracle in interpret mode for every head-count shape in the mode
     table.
-  * config guards: tp/sp/pp runners and speculation refuse the knob at
-    build, not at first step; the sampling-array memo evicts LRU instead
+  * config guards: tp/sp/pp runners refuse the knob at build, not at
+    first step (speculation composes since round 14); the sampling-array
+    memo evicts LRU instead
     of clearing wholesale.
 """
 
@@ -217,9 +218,11 @@ def test_overlap_uses_incremental_table_scatter(runner, monkeypatch):
 # --------------------------------------------------------- config guards
 
 
-def test_refused_with_speculation():
-    with pytest.raises(ValueError, match="speculation"):
-        EngineConfig(decode_overlap=1, speculation="ngram")
+def test_composes_with_speculation():
+    # Round 14: the speculative verify carry is a plain DecodeState with
+    # its own donated-state jit, so overlap x speculation BUILDS (token
+    # identity under churn is pinned in tests/test_speculative.py).
+    EngineConfig(decode_overlap=1, speculation="ngram")
 
 
 def test_refused_on_unsupporting_runner(runner):

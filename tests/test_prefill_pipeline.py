@@ -18,7 +18,7 @@ prefill_pipeline_impl). Invariants pinned here:
     softmax over a different kv width than the in-register site, which
     costs last-ulp differences (the same structural property the serial
     chunked-prefill suite pins token-identity across).
-  * config guards: speculation x pipeline refused; decode_steps auto-scale
+  * config guards: speculation x pipeline composes (round 14); decode_steps auto-scale
     (ROADMAP bs32 nibble) resolves as documented.
 """
 
@@ -224,9 +224,11 @@ def test_warmup_covers_pipeline_program(params, monkeypatch):
     assert calls["pipeline"] == n and calls["prefill"] == 0
 
 
-def test_pipeline_rejects_speculation():
-    with pytest.raises(ValueError, match="speculation"):
-        EngineConfig(prefill_pipeline_chunks=2, speculation="ngram")
+def test_pipeline_composes_with_speculation():
+    # Round 14: the spec prefill handoff is the same async DecodeState
+    # handoff as plain decode (no first-token readback to pipeline past),
+    # so the combination BUILDS (identity pinned in test_speculative.py).
+    EngineConfig(prefill_pipeline_chunks=2, speculation="ngram")
 
 
 def test_pipeline_rejects_negative():

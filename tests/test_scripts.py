@@ -306,6 +306,30 @@ def test_decode_overlap_ab_smoke(monkeypatch):
         assert r["decode_toks_s"] > 0
 
 
+# ------------------------------------------------ speculative-decoding A/B
+
+
+def test_spec_ab_smoke(monkeypatch):
+    """scripts/dev/spec_ab.py end-to-end on the tiny model (the ISSUE-14
+    acceptance smoke): one JSON row per arm, the spec arm actually
+    accepts drafts on the repetitive agentic workload (accept_rate > 0 —
+    prompt-lookup's existence proof) while emitting token-identical
+    completions under the script's churn (mixed stops, admissions,
+    greedy+seeded), fp32-exact on CPU."""
+    monkeypatch.setenv("SPEC_AB_MODEL", "tiny")
+    monkeypatch.setenv("SPEC_AB_SEATS", "4")
+    spec_ab = load_script("scripts/dev/spec_ab.py", "spec_ab")
+    results = spec_ab.main(["6", "6", "12"])
+    assert [r["mode"] for r in results] == ["serial", "spec"]
+    by_mode = {r["mode"]: r for r in results}
+    assert by_mode["spec"]["accept_rate"] > 0
+    assert by_mode["spec"]["emitted_per_round"] >= 1.0
+    for r in results:
+        assert r["outputs_match"] is True
+        assert r["decode_toks_s"] > 0
+        assert r["itl_p50_s"] > 0
+
+
 # ------------------------------------------------ KV-quantization A/B
 
 

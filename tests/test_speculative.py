@@ -1,12 +1,24 @@
-"""N-gram (prompt-lookup) speculative decoding.
+"""N-gram (prompt-lookup) speculative decoding — the round-14 composable split.
 
-Pins the two invariants that make speculation a pure performance knob:
-  * proposal/acceptance mechanics are correct (ops/speculative.py), and
+Pins the invariants that make speculation a pure performance knob:
+  * host-side proposal + device-side value-aligned acceptance mechanics
+    are correct (ops/speculative.py), and
   * the engine with speculation ON emits exactly the tokens the
-    non-speculative engine would — bit-identical for greedy AND for seeded
-    stochastic sampling (acceptance is sample-and-compare: every emitted
-    token is the target sample for its (seed, step) key, so the draft only
-    affects how many tokens each dispatch keeps).
+    non-speculative engine would on these bounded-horizon fixtures —
+    for greedy AND seeded sampling (acceptance is sample-and-compare:
+    every emitted token is the target sample for its (seed, step) key,
+    so the draft only affects how many tokens each dispatch keeps; at
+    much longer horizons the committed-KV byte drift ops/speculative.py
+    documents can flip a near-tie even in fp32) — for the plain engine
+    AND for every round-14 composition: hybrid batching, the overlapped
+    loop, the scaled int8 pool, fused KV writes, the pipelined prefill,
+    and live migration, each under churn (EOS mid-batch, admission
+    mid-decode, abort).
+  * rejected KV appends roll back: the committed pool after a speculative
+    dispatch is BYTE-identical to the serial loop's, on bf16 and int8
+    pools (the accepted-prefix commit — ops/speculative.rollback_commit).
+  * speculation=None keeps the non-speculative paths untouched: no
+    ops/speculative code runs anywhere (monkeypatch-never-invoked pin).
 Plus multi-query (verify) support in both Pallas kernels vs the jnp oracle,
 run in interpreter mode on CPU (SURVEY.md §4 kernel-test strategy).
 """
@@ -29,13 +41,19 @@ from agentic_traffic_testing_tpu.ops.pallas.paged_attention import (
 )
 from agentic_traffic_testing_tpu.ops.speculative import (
     accept_counts,
-    propose_ngram,
-    update_history,
+    align_drafts,
+    propose_ngram_host,
+    propose_stream,
 )
 from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
 from agentic_traffic_testing_tpu.runtime.kv_cache import TRASH_BLOCK, gather_kv
-from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+from agentic_traffic_testing_tpu.runtime.request import (
+    FinishReason,
+    SamplingParams,
+)
 from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+from token_utils import pick_midstream_stop
 
 CFG = PRESETS["tiny"]
 
@@ -46,52 +64,86 @@ def params():
 
 
 # ---------------------------------------------------------------------------
-# proposal / acceptance mechanics
+# host-side proposal mechanics (plain numpy)
 # ---------------------------------------------------------------------------
 
 
-def _hist(rows, l=32):
-    h = np.zeros((len(rows), l), np.int32)
-    pos = []
-    for i, row in enumerate(rows):
-        h[i, : len(row)] = row
-        pos.append(len(row) - 1)
-    return jnp.asarray(h), jnp.asarray(pos, jnp.int32)
-
-
-def test_propose_ngram_finds_latest_match():
+def test_propose_finds_latest_match():
     # trailing 2-gram (7, 8) occurred earlier, followed by 9, 4, 5
-    hist, pos = _hist([[1, 7, 8, 9, 4, 5, 6, 7, 8]])
-    drafts = propose_ngram(hist, pos, num_drafts=3, ngram=2)
-    assert drafts.tolist() == [[9, 4, 5]]
+    hist = [1, 7, 8, 9, 4, 5, 6, 7, 8]
+    assert propose_ngram_host(hist, 3, ngram=2) == [9, 4, 5]
 
 
-def test_propose_ngram_prefers_most_recent_occurrence():
+def test_propose_prefers_most_recent_occurrence():
     # (5, 1) appears twice; the later one is followed by 3 not 2
-    hist, pos = _hist([[5, 1, 2, 5, 1, 3, 9, 5, 1]])
-    drafts = propose_ngram(hist, pos, num_drafts=1, ngram=2)
-    assert drafts.tolist() == [[3]]
+    hist = [5, 1, 2, 5, 1, 3, 9, 5, 1]
+    assert propose_ngram_host(hist, 1, ngram=2) == [3]
 
 
-def test_propose_ngram_no_match_falls_back_to_last_token():
-    hist, pos = _hist([[1, 2, 3, 4, 5, 6]])
-    drafts = propose_ngram(hist, pos, num_drafts=3, ngram=3)
-    assert drafts.tolist() == [[6, 6, 6]]
+def test_propose_no_match_falls_back_to_last_token():
+    assert propose_ngram_host([1, 2, 3, 4, 5, 6], 3, ngram=3) == [6, 6, 6]
 
 
-def test_propose_ngram_clamps_drafts_to_known_history():
+def test_propose_clamps_to_known_history():
     # match ends one token before the suffix: only 1 real continuation known
-    hist, pos = _hist([[4, 9, 4, 9]])  # trailing (4,9) matches at j=1
-    drafts = propose_ngram(hist, pos, num_drafts=3, ngram=2)
+    hist = [4, 9, 4, 9]  # trailing (4,9) matches at j=1
     # continuation = hist[2:] = [4, 9] then clamped repeats of the last token
-    assert drafts.tolist() == [[4, 9, 9]]
+    assert propose_ngram_host(hist, 3, ngram=2) == [4, 9, 9]
 
 
-def test_propose_ngram_short_history_is_safe():
-    hist, pos = _hist([[3]])
-    drafts = propose_ngram(hist, pos, num_drafts=2, ngram=3)
-    assert drafts.shape == (1, 2)  # fallback path; values from known history
-    assert drafts.tolist() == [[3, 3]]
+def test_propose_short_history_is_safe():
+    assert propose_ngram_host([3], 2, ngram=3) == [3, 3]
+    assert propose_ngram_host([], 2, ngram=3) == [0, 0]
+
+
+def test_propose_window_bounds_the_scan():
+    # The early occurrence of (7, 8) sits outside a 4-token window: the
+    # bounded scan must miss it and fall back to last-token repeats.
+    hist = [1, 7, 8, 9, 4, 5, 6, 7, 8]
+    assert propose_ngram_host(hist, 2, ngram=2, window=4) == [8, 8]
+    assert propose_ngram_host(hist, 2, ngram=2, window=0) == [9, 4]
+    # A window large enough to see the match behaves like the full scan.
+    assert propose_ngram_host(hist, 2, ngram=2, window=7) == [9, 4]
+
+
+def test_history_tail_bounds_and_matches_full_concat():
+    """The engine's per-dispatch host term: with a window the tail slice
+    must be O(window) AND propose identically to the full concatenation
+    (the un-scanned prefix can never change a windowed match)."""
+    from agentic_traffic_testing_tpu.ops.speculative import history_tail
+
+    prompt, out = list(range(100, 400)), [7, 8, 9, 7, 8]
+    tail = history_tail(prompt, out, ngram=2, window=16)
+    assert len(tail) == 18  # window + ngram, not len(prompt) + len(out)
+    assert tail == (prompt + out)[-18:]
+    assert (propose_ngram_host(tail, 3, ngram=2, window=16)
+            == propose_ngram_host(prompt + out, 3, ngram=2, window=16))
+    # Window straddling the prompt/output boundary.
+    short_out = [7]
+    t2 = history_tail(prompt, short_out, ngram=2, window=4)
+    assert t2 == (prompt + short_out)[-6:]
+    # No window -> the full history (the unbounded scan needs it).
+    assert history_tail([1, 2], [3], ngram=3) == [1, 2, 3]
+
+
+def test_propose_stream_anchors_and_pads():
+    streams = propose_stream([[1, 7, 8, 9, 7, 8]], padded_batch=3,
+                             length=4, ngram=2)
+    assert streams.shape == (3, 4)
+    # stream[0] = last known token; continuation after the j=2 match = 9...
+    assert streams[0].tolist() == [8, 9, 7, 8]
+    assert streams[1].tolist() == [0, 0, 0, 0]  # padding lane
+
+
+def test_align_drafts_first_occurrence_and_fallbacks():
+    stream = jnp.asarray([[5, 6, 7, 5, 9, 9, 9, 9],
+                          [1, 2, 3, 4, 5, 6, 7, 8],
+                          [1, 2, 3, 4, 5, 6, 7, 8]], jnp.int32)
+    toks = jnp.asarray([5, 7, 99], jnp.int32)
+    got = align_drafts(stream, toks, 3)
+    assert got[0].tolist() == [6, 7, 5]      # first occurrence of 5 wins
+    assert got[1].tolist() == [8, 8, 8]      # clamped onto the stream end
+    assert got[2].tolist() == [99, 99, 99]   # miss -> repeat-last fallback
 
 
 def test_accept_counts():
@@ -104,19 +156,13 @@ def test_accept_counts():
     assert accept_counts(sampled, drafts).tolist() == [4, 2, 1]
 
 
-def test_update_history_writes_after_position():
-    hist, pos = _hist([[1, 2, 3]], l=8)
-    out = update_history(hist, jnp.asarray([[7, 8]], jnp.int32), pos)
-    assert out.tolist() == [[1, 2, 3, 7, 8, 0, 0, 0]]
-
-
 # ---------------------------------------------------------------------------
 # engine equivalence: speculation is a pure perf knob
 # ---------------------------------------------------------------------------
 
 
 def make_engine(params, *, speculation=None, spec_tokens=3, decode_steps=2,
-                **kw):
+                fused_kv_write=0, **kw):
     kw.setdefault("model", "tiny")
     kw.setdefault("dtype", "float32")
     kw.setdefault("max_model_len", 128)
@@ -124,9 +170,11 @@ def make_engine(params, *, speculation=None, spec_tokens=3, decode_steps=2,
     kw.setdefault("num_blocks", 96)
     kw.setdefault("max_num_seqs", 4)
     ecfg = EngineConfig(decode_steps=decode_steps, speculation=speculation,
-                        spec_tokens=spec_tokens, **kw)
+                        spec_tokens=spec_tokens,
+                        fused_kv_write=fused_kv_write, **kw)
     runner = ModelRunner(CFG, params, decode_steps=decode_steps,
-                         spec_tokens=(spec_tokens if speculation else 0))
+                         spec_tokens=(spec_tokens if speculation else 0),
+                         fused_kv_write=bool(fused_kv_write))
     return LLMEngine(ecfg, model_cfg=CFG, runner=runner)
 
 
@@ -170,9 +218,16 @@ def test_spec_batch_identical_and_counters(params):
 
     for w, g in zip(want, got):
         assert g.generated_ids == w.generated_ids
-    # Acceptance accounting advanced, and emitted >= iterations (>=1/step).
+    # Acceptance accounting advanced, emitted >= rounds (>= 1/round), and
+    # the draft ledger is coherent: γ drafts per consumed round; accepted
+    # counts at VERIFICATION level (m-1 per round), so it can only exceed
+    # emitted - rounds when a stop/length truncates a round's emission
+    # mid-row — never the reverse.
     assert spec.spec_iters > 0
     assert spec.spec_emitted >= spec.spec_iters
+    assert spec.spec_drafted == spec.spec_iters * spec.cfg.spec_tokens
+    assert (spec.spec_emitted - spec.spec_iters <= spec.spec_accepted
+            <= spec.spec_drafted)
 
 
 def test_spec_accepts_on_repetitive_text(params):
@@ -185,6 +240,7 @@ def test_spec_accepts_on_repetitive_text(params):
     # Greedy decode of a tiny random-init model on a periodic prompt settles
     # into a loop; prompt-lookup must exploit it.
     assert eng.spec_emitted / eng.spec_iters > 1.2
+    assert eng.spec_accepted > 0
 
 
 def test_spec_at_max_model_len_identical(params):
@@ -203,32 +259,375 @@ def test_spec_at_max_model_len_identical(params):
 
 
 def test_spec_stop_token_exact(params):
-    """EOS inside an accepted draft run must stop the request on the token."""
+    """EOS inside an accepted draft run must stop the request on the token.
+
+    The stop-token scan is the SHARED helper (tests/token_utils.py —
+    first-occurrence semantics): the multi-token accept path reuses it,
+    never forks it."""
     eng = make_engine(params, speculation="ngram")
     req = eng.generate(REPETITIVE,
                        SamplingParams(max_tokens=40, temperature=0.0,
                                       ignore_eos=True))
-    # Pick a stop token whose FIRST occurrence is mid-stream (a repetitive
-    # prompt makes early tokens recur, and the engine rightly stops at the
-    # first occurrence — the old fixed index 9 happened to pick a token
-    # that also appeared at index 0, asserting the wrong prefix).
-    candidates = [(i, t) for i, t in enumerate(req.generated_ids)
-                  if 2 <= i < len(req.generated_ids) - 1
-                  and t not in req.generated_ids[:i]]
-    if not candidates:
+    picked = pick_midstream_stop(req.generated_ids, REPETITIVE)
+    if picked is None:
         pytest.skip("stream has no mid-stream first-occurrence token "
                     "(fully cyclic from the start under this seed)")
-    # Prefer a token that also occurs in the prompt: the ngram drafter
-    # copies history continuations, so a prompt token CAN land inside an
-    # accepted draft run (the docstring's scenario) — a token new to the
-    # whole history can only ever be the step's target-sampled correction.
-    stop_at, tok = next(((i, t) for i, t in candidates if t in REPETITIVE),
-                        candidates[0])
+    stop_at, tok = picked
     eng2 = make_engine(params, speculation="ngram")
     req2 = eng2.generate(REPETITIVE,
                          SamplingParams(max_tokens=40, temperature=0.0,
                                         stop_token_ids=[tok]))
     assert req2.generated_ids == req.generated_ids[: stop_at + 1]
+
+
+# ---------------------------------------------------------------------------
+# round-14 compositions: identity vs the serial loop under churn
+# ---------------------------------------------------------------------------
+
+CHURN_PROMPTS = (REPETITIVE, PLAIN, [7] * 12, [21, 22, 23, 24] * 5)
+
+
+def _churn_workload(eng, stop_tok, late_prompt):
+    """EOS mid-batch (a reachable stop token on greedy lanes), admission
+    mid-decode (a late arrival past the initial wave), abort — the three
+    churn shapes every composed feature must reconcile identically."""
+    def sampling(i):
+        if i % 2 == 0:
+            return SamplingParams(temperature=0.0, max_tokens=14 - (i % 3),
+                                  stop_token_ids=[stop_tok])
+        return SamplingParams(temperature=0.8, top_k=20, seed=5 + i,
+                              max_tokens=8 + (i % 4), ignore_eos=True)
+
+    reqs = [eng.add_request(p, sampling(i))
+            for i, p in enumerate(CHURN_PROMPTS)]
+    for _ in range(4):
+        eng.step()
+    eng.abort_request(reqs[1])
+    late = eng.add_request(late_prompt, SamplingParams(
+        temperature=0.0, max_tokens=10, ignore_eos=True))
+    run_all(eng, [r for r in reqs if r is not reqs[1]] + [late])
+    return [r.generated_ids for r in reqs if r is not reqs[1]] + [
+        late.generated_ids]
+
+
+COMPOSITIONS = {
+    # Each newly-composed feature, individually enabled (the ISSUE-14
+    # acceptance list) — plus the pipelined prefill, whose refusal died
+    # with the synchronous spec-prefill readback.
+    "hybrid": dict(hybrid_token_budget=48, prefill_chunk_tokens=16,
+                   max_model_len=256, num_blocks=256),
+    "overlap": dict(decode_overlap=1),
+    "int8": dict(kv_cache_dtype="int8"),
+    "fused": dict(fused_kv_write=1),
+    "pipeline": dict(prefill_pipeline_chunks=2),
+}
+
+
+@pytest.mark.parametrize("feature", sorted(COMPOSITIONS))
+def test_spec_composition_identical_under_churn(params, feature):
+    kw = COMPOSITIONS[feature]
+    # The stop token comes from a deterministic greedy probe on the PLAIN
+    # serial engine, so both arms chase the same reachable EOS.
+    probe = make_engine(params, **kw).generate(
+        REPETITIVE, SamplingParams(temperature=0.0, max_tokens=14,
+                                   ignore_eos=True))
+    stop_tok = probe.output_ids[len(probe.output_ids) // 2]
+    late = REPETITIVE[:9]
+
+    want_eng = make_engine(params, **kw)
+    want = _churn_workload(want_eng, stop_tok, late)
+    got_eng = make_engine(params, speculation="ngram", **kw)
+    got = _churn_workload(got_eng, stop_tok, late)
+    assert got == want
+    assert got_eng.spec_iters > 0
+    if feature == "hybrid":
+        assert got_eng.scheduler.num_scheduled_hybrid > 0, \
+            "fusion never engaged — the composition was not exercised"
+    if feature == "overlap":
+        assert got_eng.num_overlap_dispatches > 0, \
+            "the predicted-composition fast path never engaged"
+        assert got_eng.num_overlap_mispredicts >= 1, \
+            "churn never landed with speculative dispatches in flight"
+
+
+def test_spec_migration_identity(params):
+    """Checkpoint a speculative stream mid-decode, adopt it on another
+    speculative engine, full sequence identical to the uninterrupted run
+    — the host-side history + rejection rollback are what make the
+    plain-decode checkpoint rule cover speculation unchanged."""
+    kw = dict(migration=1, block_size=16, max_model_len=256, num_blocks=128)
+    samp = lambda: SamplingParams(temperature=0.0, max_tokens=14,
+                                  ignore_eos=True)
+    prompt = [31, 32, 33, 34] * 6
+    base = make_engine(params, speculation="ngram", **kw).generate(
+        prompt, samp()).generated_ids
+    src = make_engine(params, speculation="ngram", **kw)
+    dst = make_engine(params, speculation="ngram", **kw)
+    req = src.add_request(prompt, samp())
+    for _ in range(2000):
+        src.step()
+        if req.sampling_step >= 5:
+            break
+    assert req.sampling_step >= 5
+    plan = src.checkpoint_request(req, trigger="drain")
+    assert plan is not None and plan.decodable
+    assert req.finish_reason is FinishReason.MIGRATED
+    adopted = dst.adopt_request(plan)
+    run_all(dst, [adopted])
+    assert adopted.generated_ids == base
+    # Cross-check against the serial loop too: migration did not launder
+    # a speculative divergence through the folded prompt.
+    serial = make_engine(params, **kw).generate(prompt, samp()).generated_ids
+    assert base == serial
+
+
+# ---------------------------------------------------------------------------
+# rejection rollback: committed KV is byte-identical to the serial loop's
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("pool", ["f32", "int8"],
+                         ids=["bf16-class", "int8"])
+def test_spec_rollback_kv_byte_identity(params, pool):
+    """Reject-independence: two speculative dispatches whose streams agree
+    on the accepted prefix but differ WILDLY in their rejected draft
+    content commit byte-identical pools (pages AND int8 scale pairs) —
+    the rejected appends (which land before attention and, on int8,
+    requant their page) left NOTHING behind. The trash block is excluded:
+    rejected replay slots mask to it (garbage by contract, never read
+    unmasked), exactly like every other masked write in the engine."""
+    from agentic_traffic_testing_tpu.runtime.kv_cache import make_kv_cache
+    from agentic_traffic_testing_tpu.runtime.runner import SamplingArrays
+
+    quantized = pool == "int8"
+    bs, nb, tt = 8, 12, 16
+    serial = ModelRunner(CFG, params, decode_steps=1)
+    spec = ModelRunner(CFG, params, decode_steps=2, spec_tokens=3)
+    prompt = np.zeros((1, tt), np.int32)
+    prompt[0, :13] = REPETITIVE
+    tables = np.full((1, 8), TRASH_BLOCK, np.int32)
+    tables[0, :6] = np.arange(1, 7)
+    tables = jnp.asarray(tables)
+    seq = jnp.asarray([13], jnp.int32)
+    samp = SamplingArrays(temperature=jnp.zeros((1,), jnp.float32),
+                          top_k=jnp.zeros((1,), jnp.int32),
+                          top_p=jnp.ones((1,), jnp.float32),
+                          seeds=jnp.zeros((1,), jnp.int32))
+
+    def fresh():
+        dtype = jnp.int8 if quantized else jnp.float32
+        cache = make_kv_cache(CFG, nb, bs, dtype, quantized=quantized)
+        state, cache, out = serial.prefill(
+            jnp.asarray(prompt), cache, tables, seq, samp,
+            jnp.zeros((1,), jnp.int32))
+        return state, cache
+
+    # Serial oracle: the greedy continuation (what verification accepts).
+    st, cache_a = fresh()
+    serial_toks = []
+    for _ in range(8):
+        st, cache_a, out = serial.decode(cache_a, tables, st, samp)
+        serial_toks.append(int(out[0, 0]))
+
+    def spec_dispatch(garbage_tok):
+        """One 2-round γ=3 dispatch whose stream walks the true
+        continuation for 3 tokens then proposes `garbage_tok` — partial
+        acceptance, so rejected appends land and must roll back."""
+        st2, cache_b = fresh()
+        stream = np.zeros((1, 12), np.int32)
+        stream[0, 0] = int(st2.tokens[0])
+        stream[0, 1:4] = serial_toks[:3]
+        stream[0, 4:] = garbage_tok
+        st2, cache_b, toks, counts = spec.decode(
+            cache_b, tables, st2, samp, drafts=jnp.asarray(stream))
+        counts = np.asarray(counts)
+        kept = [int(t) for row, m in zip(np.asarray(toks)[0], counts[0])
+                for t in row[:m]]
+        return cache_b, int(counts.sum()), kept
+
+    # Garbage values chosen to differ in embedding magnitude (the int8
+    # requant's scale bump depends on absmax — arm A and arm B perturb
+    # the touched pages differently before rolling back).
+    cache_x, emitted_x, kept_x = spec_dispatch(1)
+    cache_y, emitted_y, kept_y = spec_dispatch(CFG.vocab_size - 2)
+    assert emitted_x == emitted_y and kept_x == kept_y
+    assert 2 <= emitted_x < 8, "stream never partially accepted"
+    assert kept_x == serial_toks[:emitted_x]  # sample-and-compare identity
+
+    def real_blocks(arr):
+        # Drop the trash block (index TRASH_BLOCK): rejected replay slots
+        # mask onto it, and its bytes are garbage by contract.
+        a = np.asarray(arr)
+        return np.delete(a, TRASH_BLOCK, axis=2 if a.ndim >= 4 else 1)
+
+    np.testing.assert_array_equal(real_blocks(cache_x.k),
+                                  real_blocks(cache_y.k))
+    np.testing.assert_array_equal(real_blocks(cache_x.v),
+                                  real_blocks(cache_y.v))
+    if quantized:
+        np.testing.assert_array_equal(real_blocks(cache_x.k_scale),
+                                      real_blocks(cache_y.k_scale))
+        np.testing.assert_array_equal(real_blocks(cache_x.v_scale),
+                                      real_blocks(cache_y.v_scale))
+
+
+def test_rollback_commit_unit_restores_loud_rejection():
+    """The int8-specific hazard, pinned surgically (no model numerics in
+    the way): a LOUD rejected draft's chained write REQUANTS its page —
+    bumping the scale and re-rounding every settled byte — and
+    rollback_commit must restore page bytes AND the fp32 scale pair
+    exactly, then replay only the accepted write's serial requant."""
+    from agentic_traffic_testing_tpu.ops.speculative import (
+        rollback_commit,
+        snapshot_pages,
+        touched_pages,
+    )
+    from agentic_traffic_testing_tpu.runtime import kv_cache as kvc
+    from agentic_traffic_testing_tpu.runtime.kv_cache import KVCache
+
+    rng = np.random.default_rng(9)
+    n_layers, kh, nb, bs, hd = 2, 2, 4, 8, 8
+    s = 4
+    k0 = jnp.asarray(rng.integers(-100, 100, (n_layers, kh, nb, bs, hd)),
+                     jnp.int8)
+    v0 = jnp.asarray(rng.integers(-100, 100, (n_layers, kh, nb, bs, hd)),
+                     jnp.int8)
+    ks0 = jnp.asarray(rng.uniform(0.01, 0.05, (n_layers, nb, kh)),
+                      jnp.float32)
+    vs0 = jnp.asarray(rng.uniform(0.01, 0.05, (n_layers, nb, kh)),
+                      jnp.float32)
+    clean = KVCache(k0, v0, ks0, vs0)
+    tables = jnp.asarray([[1, 2]], jnp.int32)
+    positions = jnp.asarray([5], jnp.int32)   # writes at 5..8 span both pages
+    k_seq = rng.standard_normal((n_layers, 1, s, kh, hd)).astype(np.float32)
+    v_seq = rng.standard_normal((n_layers, 1, s, kh, hd)).astype(np.float32)
+    k_seq[:, :, 2] *= 100.0   # the loud REJECTED draft: guaranteed requant
+    k_seq, v_seq = jnp.asarray(k_seq), jnp.asarray(v_seq)
+
+    # The round's writes, exactly as verify_step_impl chains them.
+    kc, vc, ksc, vsc = clean.k, clean.v, clean.k_scale, clean.v_scale
+    for li in range(n_layers):
+        for i in range(s):
+            kc, ksc = kvc.write_decode_kv_full_quant(
+                kc, ksc, jnp.int32(li), k_seq[li, :, i], tables,
+                positions + i)
+            vc, vsc = kvc.write_decode_kv_full_quant(
+                vc, vsc, jnp.int32(li), v_seq[li, :, i], tables,
+                positions + i)
+    dirty = KVCache(kc, vc, ksc, vsc)
+    # The loud write really perturbed settled state (the hazard exists).
+    assert not np.array_equal(np.asarray(dirty.k_scale), np.asarray(ks0))
+
+    blks = touched_pages(tables, positions, s, bs)
+    snap = snapshot_pages(clean, blks)
+    committed = rollback_commit(dirty, snap, blks, k_seq, v_seq, tables,
+                                positions, jnp.asarray([1], jnp.int32),
+                                capacity=2 * bs)
+
+    # Expectation: the clean pool with ONLY the accepted write (i=0)
+    # applied through the same serial requant chain.
+    ke, vse_k, ve, vse_v = clean.k, clean.k_scale, clean.v, clean.v_scale
+    for li in range(n_layers):
+        ke, vse_k = kvc.write_decode_kv_full_quant(
+            ke, vse_k, jnp.int32(li), k_seq[li, :, 0], tables, positions)
+        ve, vse_v = kvc.write_decode_kv_full_quant(
+            ve, vse_v, jnp.int32(li), v_seq[li, :, 0], tables, positions)
+
+    def real(arr, axis):
+        # The trash block absorbs the rejected replays' masked writes —
+        # garbage by contract, excluded like every masked-write test.
+        return np.delete(np.asarray(arr), TRASH_BLOCK, axis=axis)
+
+    np.testing.assert_array_equal(real(committed.k, 2), real(ke, 2))
+    np.testing.assert_array_equal(real(committed.v, 2), real(ve, 2))
+    np.testing.assert_array_equal(real(committed.k_scale, 1),
+                                  real(vse_k, 1))
+    np.testing.assert_array_equal(real(committed.v_scale, 1),
+                                  real(vse_v, 1))
+
+
+def test_spec_int8_engine_identity(params):
+    """Engine-level int8 x speculation: greedy and seeded output matches
+    the non-speculative int8 engine exactly on these fixtures (the
+    committed pool is byte-identical by the rollback; the only residual
+    caveat is the documented in-round transient-scale visibility, which
+    these workloads do not excite)."""
+    for samp in (SamplingParams(temperature=0.0, max_tokens=16,
+                                ignore_eos=True),
+                 SamplingParams(temperature=0.7, seed=11, max_tokens=16,
+                                ignore_eos=True)):
+        import dataclasses
+
+        want = make_engine(params, kv_cache_dtype="int8").generate(
+            REPETITIVE, dataclasses.replace(samp)).generated_ids
+        got = make_engine(params, speculation="ngram",
+                          kv_cache_dtype="int8").generate(
+            REPETITIVE, dataclasses.replace(samp)).generated_ids
+        assert got == want
+
+
+# ---------------------------------------------------------------------------
+# speculation=None: the non-speculative paths are untouched
+# ---------------------------------------------------------------------------
+
+
+def test_spec_off_never_touches_spec_code(params, monkeypatch):
+    """The default keeps every compiled program byte-identical: with
+    speculation off, NO ops/speculative function runs anywhere — neither
+    through the runner's jit construction nor the engine's dispatch path
+    — and output matches a reference built before the patch."""
+    want = make_engine(params).generate(
+        REPETITIVE, SamplingParams(max_tokens=12, temperature=0.0,
+                                   ignore_eos=True)).generated_ids
+
+    import agentic_traffic_testing_tpu.ops.speculative as spec_mod
+    import agentic_traffic_testing_tpu.runtime.runner as runner_mod
+
+    def boom(*a, **kw):
+        raise AssertionError("speculative code ran with speculation=None")
+
+    for mod in (spec_mod, runner_mod):
+        for name in ("propose_stream", "align_drafts", "accept_counts",
+                     "touched_pages", "snapshot_pages", "rollback_commit",
+                     "propose_ngram_host"):
+            if hasattr(mod, name):
+                monkeypatch.setattr(mod, name, boom)
+    got = make_engine(params).generate(
+        REPETITIVE, SamplingParams(max_tokens=12, temperature=0.0,
+                                   ignore_eos=True)).generated_ids
+    assert got == want
+
+
+def test_engine_refuses_mismatched_spec_runner(params):
+    """cfg speculation with a non-speculative supplied runner (and the
+    reverse) must refuse at build — the spec verify program is baked into
+    the runner's jits, and silently serving the other path while
+    llm_config_speculation reports the cfg's value is exactly the
+    misconfiguration class the fused_kv_write mismatch check refuses."""
+    kw = dict(model="tiny", dtype="float32", max_model_len=128,
+              block_size=8, num_blocks=96)
+    plain = ModelRunner(CFG, params, decode_steps=1)
+    with pytest.raises(ValueError, match="spec"):
+        LLMEngine(EngineConfig(speculation="ngram", **kw),
+                  model_cfg=CFG, runner=plain)
+    spec = ModelRunner(CFG, params, decode_steps=1, spec_tokens=3)
+    with pytest.raises(ValueError, match="spec"):
+        LLMEngine(EngineConfig(**kw), model_cfg=CFG, runner=spec)
+
+
+def test_pp_runner_refuses_speculation(params):
+    """supports_speculation=False must refuse at engine build for a
+    caller-supplied non-speculative-capable runner (the pp constructor
+    refuses spec_tokens itself; the engine guard covers the cfg side)."""
+    class NoSpecRunner(ModelRunner):
+        supports_speculation = False
+
+    runner = NoSpecRunner(CFG, params, decode_steps=1)
+    with pytest.raises(ValueError, match="speculative"):
+        LLMEngine(EngineConfig(model="tiny", dtype="float32",
+                               max_model_len=128, block_size=8,
+                               num_blocks=96, speculation="ngram"),
+                  model_cfg=CFG, runner=runner)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,42 @@
+"""Shared token-stream helpers for the engine test suites.
+
+`pick_midstream_stop` is the stop-token scan that used to live inline in
+test_speculative.py::test_spec_stop_token_exact (rewritten in PR 6 after
+the fixed-index version picked a token that already occurred earlier and
+asserted the wrong prefix). The engine stops on a stop token's FIRST
+occurrence, so any test that injects a stop token into a known stream
+must pick one whose first occurrence is exactly where it expects the
+stream to end — every speculative accept-path test reuses THIS helper
+instead of forking the scan.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def pick_midstream_stop(
+    generated_ids: Sequence[int],
+    prompt_ids: Sequence[int] = (),
+    min_index: int = 2,
+) -> Optional[tuple[int, int]]:
+    """(stop_index, token) for a stop-token test over a known stream, or
+    None when the stream has no usable candidate.
+
+    Picks the first token at index >= `min_index` (and before the final
+    token) with NO earlier occurrence in the stream — the engine's
+    first-occurrence stop semantics then guarantee the truncated stream
+    is exactly generated_ids[: stop_index + 1]. Candidates that also
+    occur in `prompt_ids` are preferred: the n-gram drafter copies
+    history continuations, so a prompt token CAN land inside an accepted
+    draft run (the mid-run-stop scenario speculative tests exist for),
+    while a token new to the whole history can only ever be the round's
+    own target-sampled correction."""
+    candidates = [(i, t) for i, t in enumerate(generated_ids)
+                  if min_index <= i < len(generated_ids) - 1
+                  and t not in generated_ids[:i]]
+    if not candidates:
+        return None
+    prompt_set = set(prompt_ids)
+    return next(((i, t) for i, t in candidates if t in prompt_set),
+                candidates[0])
