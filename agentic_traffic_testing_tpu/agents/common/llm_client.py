@@ -29,6 +29,36 @@ from agentic_traffic_testing_tpu.utils.tracing import get_tracer, inject_context
 
 DEFAULT_LLM_URL = "http://localhost:8000/chat"
 
+# Live-run trace capture (round 15 loadgen plane): with
+# LOADGEN_RECORD_TRACE=<path>, every call_llm across this process records
+# into ONE TraceRecorder (agent id = role, task id = session, call type =
+# stage) flushed to <path> at interpreter exit — a captured AgentVerse
+# run replays through agentic_traffic_testing_tpu/loadgen exactly like a
+# synthesized one (docs/loadgen.md §recording).
+_trace_recorder = None
+
+
+def trace_recorder():
+    """The process-global recorder, or None when capture is off."""
+    global _trace_recorder
+    path = os.environ.get("LOADGEN_RECORD_TRACE")
+    if not path:
+        return None
+    if _trace_recorder is None:
+        import atexit
+
+        from agentic_traffic_testing_tpu.loadgen.trace import TraceRecorder
+
+        rec = TraceRecorder(name=os.path.basename(path) or "recorded")
+
+        def _flush(rec=rec, path=path):
+            if len(rec):
+                rec.to_trace().save(path)
+
+        atexit.register(_flush)
+        _trace_recorder = rec
+    return _trace_recorder
+
 
 def agent_b_urls() -> List[str]:
     """Parse AGENT_B_URLS (comma separated); default one local worker."""
@@ -106,6 +136,15 @@ class AgentHTTPClient:
             body["max_tokens"] = max_tokens
         if system_prompt is not None:
             body["system_prompt"] = system_prompt
+
+        recorder = trace_recorder()
+        if recorder is not None:
+            recorder.record_call(
+                request_id=request_id,
+                session_id=task_id or "task",
+                role=self.agent_id, stage=call_type,
+                prompt_chars=len(prompt),
+                max_tokens=max_tokens if max_tokens is not None else 512)
 
         tracer = get_tracer(self.agent_id)
         t0 = time.monotonic()

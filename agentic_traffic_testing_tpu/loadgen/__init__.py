@@ -1,0 +1,63 @@
+"""Agentic traffic plane (round 15 — ROADMAP item 5).
+
+Open-loop, trace-driven load generation for the serving stack: the
+reference testbed's AgentVerse workload (recruit → decide → execute →
+evaluate fan-out, MCP tool-call interleavings, shared-prefix system
+prompts) expressed as a conversation-DAG trace format, replayed against
+the engine/pool/HTTP surface at controlled arrival rates with
+no coordinated omission, measured into loadgen-side Prometheus families
+and a JSON run report (SLO attainment per class, per-role latency
+percentiles, capacity knee).
+
+Modules:
+  trace    — the DAG trace schema, the AgentVerse synthesizer seeded
+             from agents/templates/agentverse_workflow.json, and the
+             live-run recorder (same schema either way)
+  arrival  — arrival processes (poisson | deterministic | trace)
+  replay   — the open-loop asyncio replay engine + in-process/HTTP
+             targets
+  measure  — loadgen Prometheus exposition (own registry, own port)
+             and the run-report / capacity-knee math
+"""
+
+from agentic_traffic_testing_tpu.loadgen.arrival import arrival_offsets
+from agentic_traffic_testing_tpu.loadgen.measure import (
+    LoadgenMetrics,
+    MetricsExposition,
+    build_report,
+    capacity_knee,
+)
+from agentic_traffic_testing_tpu.loadgen.replay import (
+    InProcessTarget,
+    ReplayConfig,
+    RequestRecord,
+    replay_against_engine,
+    run_open_loop,
+)
+from agentic_traffic_testing_tpu.loadgen.trace import (
+    Trace,
+    TraceNode,
+    TraceRecorder,
+    build_replay_plan,
+    materialize_prompts,
+    synthesize_agentverse_trace,
+)
+
+__all__ = [
+    "Trace",
+    "TraceNode",
+    "TraceRecorder",
+    "synthesize_agentverse_trace",
+    "build_replay_plan",
+    "materialize_prompts",
+    "arrival_offsets",
+    "ReplayConfig",
+    "RequestRecord",
+    "InProcessTarget",
+    "run_open_loop",
+    "replay_against_engine",
+    "LoadgenMetrics",
+    "MetricsExposition",
+    "build_report",
+    "capacity_knee",
+]

@@ -1,0 +1,101 @@
+"""CLI: replay an agentic trace open-loop against a live backend.
+
+    python -m agentic_traffic_testing_tpu.loadgen \
+        --url http://localhost:8000/chat --rate 8 --arrival poisson \
+        --tasks 4 --report /tmp/loadgen_report.json
+
+Env mirrors the flags (LOADGEN_ARRIVAL / LOADGEN_RATE / LOADGEN_SEED /
+LOADGEN_TIME_SCALE / LOADGEN_TRACE / LOADGEN_METRICS_PORT); flags win.
+With LOADGEN_TRACE (or --trace) a recorded trace JSON replays instead of
+a synthesized one. LOADGEN_METRICS_PORT > 0 serves the loadgen's own
+Prometheus registry for the run's duration.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+import time
+from typing import Optional
+
+from agentic_traffic_testing_tpu.loadgen.measure import (
+    LoadgenMetrics,
+    MetricsExposition,
+    build_report,
+)
+from agentic_traffic_testing_tpu.loadgen.replay import (
+    HTTPTarget,
+    ReplayConfig,
+    run_open_loop,
+)
+from agentic_traffic_testing_tpu.loadgen.trace import (
+    Trace,
+    build_replay_plan,
+    materialize_texts,
+    synthesize_agentverse_trace,
+)
+
+
+def main(argv: Optional[list] = None) -> int:
+    env = ReplayConfig.from_env()
+    p = argparse.ArgumentParser(
+        description="open-loop agentic-trace load generator")
+    p.add_argument("--url", default="http://localhost:8000/chat")
+    p.add_argument("--arrival", default=env.arrival,
+                   choices=("poisson", "deterministic", "trace"))
+    p.add_argument("--rate", type=float, default=env.rate,
+                   help="offered rate λ (req/s)")
+    p.add_argument("--seed", type=int, default=env.seed)
+    p.add_argument("--time-scale", type=float, default=env.time_scale)
+    p.add_argument("--trace", default=env.trace_path,
+                   help="recorded trace JSON (default: synthesize)")
+    p.add_argument("--tasks", type=int, default=2,
+                   help="AgentVerse sessions to synthesize")
+    p.add_argument("--metrics-port", type=int, default=env.metrics_port,
+                   help="serve loadgen Prometheus families here (0 = off)")
+    p.add_argument("--report", default="",
+                   help="write the run report JSON here (default stdout)")
+    a = p.parse_args(argv)
+
+    trace = (Trace.load(a.trace) if a.trace
+             else synthesize_agentverse_trace(tasks=a.tasks, seed=a.seed))
+    plan = build_replay_plan(trace, arrival=a.arrival, rate=a.rate,
+                             seed=a.seed, time_scale=a.time_scale)
+    metrics = LoadgenMetrics.for_trace(trace)
+    exposition = (MetricsExposition(metrics, a.metrics_port)
+                  if a.metrics_port else None)
+    target = HTTPTarget(a.url, materialize_texts(trace, seed=a.seed))
+
+    async def _run():
+        t0 = time.monotonic()
+        try:
+            records = await run_open_loop(plan, trace, target,
+                                          metrics=metrics)
+        finally:
+            await target.close()
+        return records, time.monotonic() - t0
+
+    try:
+        records, duration = asyncio.run(_run())
+        report = build_report(records, trace=trace, duration_s=duration,
+                              arrival=a.arrival, rate=a.rate, seed=a.seed)
+        # Rate gauges land BEFORE the exposition closes, so a scraper
+        # polling the loadgen port sees the run's final numbers.
+        metrics.set_rates(offered=report["offered_rate"],
+                          achieved=report["achieved_rate"],
+                          goodput=report["goodput_rate"])
+    finally:
+        if exposition is not None:
+            exposition.close()
+    text = json.dumps(report, indent=1)
+    if a.report:
+        with open(a.report, "w") as f:
+            f.write(text)
+    print(text, flush=True)
+    return 0 if report["all_terminated"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,53 @@
+"""Arrival processes for the open-loop replay engine.
+
+Offsets are ABSOLUTE seconds from replay start. The open-loop contract
+(docs/loadgen.md): requests fire at these instants regardless of how many
+earlier requests have completed — the generator never waits on the
+system under test, so a stall shows up as latency, not as a silently
+reduced offered rate (the coordinated-omission failure mode the
+serving-comparison literature warns about).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+ARRIVAL_PROCESSES = ("poisson", "deterministic", "trace")
+
+
+def arrival_offsets(n: int, process: str, rate: float, *, seed: int = 0,
+                    trace_offsets: Optional[list] = None,
+                    time_scale: float = 1.0) -> list:
+    """Fire offsets for `n` requests.
+
+    poisson        — exponential interarrivals at λ=rate (req/s), the
+                     memoryless open-loop standard; deterministic under
+                     `seed`.
+    deterministic  — uniform 1/rate spacing (the paced sweep arm).
+    trace          — the recorded `trace_offsets`, scaled by
+                     `time_scale` (2.0 = replay at half speed, 0.5 =
+                     double speed); `rate` is ignored.
+    """
+    if process not in ARRIVAL_PROCESSES:
+        raise ValueError(
+            f"unknown arrival process {process!r} "
+            f"(expected one of {ARRIVAL_PROCESSES})")
+    if process == "trace":
+        if trace_offsets is None:
+            raise ValueError("trace arrivals need trace_offsets")
+        if time_scale <= 0:
+            raise ValueError(f"time_scale must be > 0, got {time_scale}")
+        t0 = min(trace_offsets) if trace_offsets else 0.0
+        return [(t - t0) * time_scale for t in trace_offsets]
+    if rate <= 0:
+        raise ValueError(
+            f"{process} arrivals need a positive rate (req/s), got {rate}")
+    if process == "deterministic":
+        return [i / rate for i in range(n)]
+    rng = random.Random(seed)
+    offsets, t = [], 0.0
+    for _ in range(n):
+        t += rng.expovariate(rate)
+        offsets.append(t)
+    return offsets

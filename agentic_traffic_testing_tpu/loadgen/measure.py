@@ -1,0 +1,261 @@
+"""Loadgen-side measurement surface: Prometheus families + run report.
+
+The loadgen is its own exporter: `loadgen_*` families live in a private
+CollectorRegistry served on a private port (`LOADGEN_METRICS_PORT`), so
+a λ sweep's offered/achieved view scrapes independently of the server's
+`llm_*` families — the two-sided measurement the serving-comparison
+methodology needs (offered rate is a loadgen fact, service rate a
+server fact).
+
+Exposition follows serving/metrics.py's always-registered rule: every
+family (and every label combination with a bounded label set) exists
+from construction, so the scrape contract is stable before the first
+request fires.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from prometheus_client import (
+    CONTENT_TYPE_LATEST,
+    CollectorRegistry,
+    Counter,
+    Gauge,
+    Histogram,
+    generate_latest,
+)
+
+from agentic_traffic_testing_tpu.serving.metrics import (
+    ITL_BUCKETS,
+    LATENCY_BUCKETS,
+    TTFT_BUCKETS,
+)
+
+#: open-loop dispatcher lag: how late a firing left the loadgen relative
+#: to its schedule (sustained growth = the GENERATOR is saturated and
+#: the offered rate is no longer honest — report.schedule_lag_* gates it).
+LAG_BUCKETS = [0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+               0.25, 0.5, 1.0, 2.5]
+
+#: terminal outcomes; a record still "pending" (target never stamped a
+#: terminal) or "hung" (cancelled at the drain timeout) counts against
+#: the report's all_terminated gate.
+STATUSES = ("ok", "shed", "deadline", "error")
+
+
+class LoadgenMetrics:
+    """The `loadgen_*` family set, one instance per replay run/sweep."""
+
+    content_type = CONTENT_TYPE_LATEST
+
+    def __init__(self, roles: tuple = (), slo_classes: tuple = ()) -> None:
+        r = self.registry = CollectorRegistry()
+        self.offered = Counter(
+            "loadgen_offered_requests", "Requests fired open-loop "
+            "(scheduled arrivals that left the generator)", registry=r)
+        self.requests = Counter(
+            "loadgen_requests", "Completed loadgen requests by role/stage "
+            "and terminal status", ["role", "stage", "status"], registry=r)
+        self.ttft = Histogram(
+            "loadgen_ttft_seconds", "Time to first token by role "
+            "(engine-stamped for the in-process target, client-observed "
+            "for HTTP)", ["role"], buckets=TTFT_BUCKETS, registry=r)
+        self.itl = Histogram(
+            "loadgen_itl_seconds", "Mean inter-token latency per request "
+            "by role", ["role"], buckets=ITL_BUCKETS, registry=r)
+        self.e2e = Histogram(
+            "loadgen_e2e_seconds", "Fire -> terminal wall time by role",
+            ["role"], buckets=LATENCY_BUCKETS, registry=r)
+        self.schedule_lag = Histogram(
+            "loadgen_schedule_lag_seconds", "Actual fire instant minus "
+            "scheduled instant (open-loop dispatcher health)",
+            buckets=LAG_BUCKETS, registry=r)
+        self.slo_attainment = Counter(
+            "loadgen_slo_attainment", "Per-request SLO verdicts by class "
+            "and axis (slo=ttft|itl, status=met|violated), mirroring the "
+            "server's llm_slo_attainment_total math",
+            ["slo_class", "slo", "status"], registry=r)
+        self.offered_rate = Gauge(
+            "loadgen_offered_rate", "Configured/actual offered arrival "
+            "rate λ (req/s) of the most recent run", registry=r)
+        self.achieved_rate = Gauge(
+            "loadgen_achieved_rate", "Completed-ok request throughput of "
+            "the most recent run (req/s; sheds/deadlines/errors excluded)",
+            registry=r)
+        self.goodput_rate = Gauge(
+            "loadgen_goodput_rate", "Completions that also met every SLO "
+            "axis they declared, per second (goodput)", registry=r)
+        # Pre-touch label combinations for the run's bounded sets so the
+        # scrape shows zeroed series before the first request.
+        for role in roles:
+            self.ttft.labels(role=role)
+            self.itl.labels(role=role)
+            self.e2e.labels(role=role)
+        for cls in slo_classes:
+            for slo in ("ttft", "itl"):
+                for status in ("met", "violated"):
+                    self.slo_attainment.labels(slo_class=cls, slo=slo,
+                                               status=status)
+
+    @classmethod
+    def for_trace(cls, trace) -> "LoadgenMetrics":
+        roles = tuple(sorted({n.role for n in trace.nodes}))
+        return cls(roles=roles, slo_classes=tuple(sorted(trace.slo_classes)))
+
+    def observe_fired(self, rec) -> None:
+        self.offered.inc()
+        self.schedule_lag.observe(max(0.0, rec.lag_s))
+
+    def observe_done(self, rec) -> None:
+        self.requests.labels(role=rec.role, stage=rec.stage,
+                             status=rec.status).inc()
+        if rec.ttft_s is not None:
+            self.ttft.labels(role=rec.role).observe(rec.ttft_s)
+        if rec.mean_itl_s is not None:
+            self.itl.labels(role=rec.role).observe(rec.mean_itl_s)
+        if rec.e2e_s is not None:
+            self.e2e.labels(role=rec.role).observe(rec.e2e_s)
+        for slo, met in (("ttft", rec.ttft_met), ("itl", rec.itl_met)):
+            if met is not None:
+                self.slo_attainment.labels(
+                    slo_class=rec.slo_class, slo=slo,
+                    status="met" if met else "violated").inc()
+
+    def set_rates(self, *, offered: float, achieved: float,
+                  goodput: float) -> None:
+        self.offered_rate.set(offered)
+        self.achieved_rate.set(achieved)
+        self.goodput_rate.set(goodput)
+
+    def render(self) -> bytes:
+        return generate_latest(self.registry)
+
+
+class MetricsExposition:
+    """Serve a registry on its own port (the loadgen's /metrics).
+
+    Thin lifecycle wrapper over prometheus_client.start_http_server —
+    its own daemon thread, so the loadgen never depends on the serving
+    stack's event loop (it measures it). `port=0` binds an ephemeral
+    port (tests); `.port` reports the bound value.
+    """
+
+    def __init__(self, metrics: LoadgenMetrics, port: int = 0,
+                 host: str = "0.0.0.0") -> None:
+        from prometheus_client import start_http_server
+
+        self._httpd, self._thread = start_http_server(
+            port, addr=host, registry=metrics.registry)
+        self.port = self._httpd.server_address[1]
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+# -- run report ----------------------------------------------------------
+
+
+def _percentile(values: list, q: float) -> Optional[float]:
+    if not values:
+        return None
+    v = sorted(values)
+    return v[min(len(v) - 1, int(q * len(v)))]
+
+
+def _round(x: Optional[float], nd: int = 5) -> Optional[float]:
+    return None if x is None else round(x, nd)
+
+
+def build_report(records: list, *, trace, duration_s: float,
+                 arrival: str, rate: float, seed: int = 0) -> dict:
+    """The run-report artifact (docs/loadgen.md §report).
+
+    Pure record math — everything here is recomputable from the
+    RequestRecord list, and the soak driver cross-checks the SLO/shed
+    numbers against the server's Prometheus counters.
+    """
+    n = len(records)
+    by_status = {s: sum(1 for r in records if r.status == s)
+                 for s in STATUSES}
+    terminated = sum(by_status.values())
+    ok = [r for r in records if r.status == "ok"]
+    span = max((r.scheduled_s for r in records), default=0.0)
+    goodput = sum(1 for r in ok
+                  if r.ttft_met is not False and r.itl_met is not False)
+
+    slo: dict = {}
+    for cls in sorted(trace.slo_classes):
+        rows = [r for r in records if r.slo_class == cls]
+        verdicts = {}
+        for axis, attr in (("ttft", "ttft_met"), ("itl", "itl_met")):
+            vs = [getattr(r, attr) for r in rows
+                  if getattr(r, attr) is not None]
+            verdicts[f"{axis}_met"] = sum(1 for v in vs if v)
+            verdicts[f"{axis}_total"] = len(vs)
+            verdicts[f"{axis}_attainment"] = (
+                round(sum(1 for v in vs if v) / len(vs), 4) if vs else None)
+        slo[cls] = {"requests": len(rows), **verdicts}
+
+    roles: dict = {}
+    for role in sorted({r.role for r in records}):
+        rows = [r for r in records if r.role == role]
+        ttfts = [r.ttft_s for r in rows if r.ttft_s is not None]
+        itls = [r.mean_itl_s for r in rows if r.mean_itl_s is not None]
+        e2es = [r.e2e_s for r in rows if r.e2e_s is not None]
+        roles[role] = {
+            "requests": len(rows),
+            "ok": sum(1 for r in rows if r.status == "ok"),
+            "ttft_p50_s": _round(_percentile(ttfts, 0.50)),
+            "ttft_p99_s": _round(_percentile(ttfts, 0.99)),
+            "itl_p50_s": _round(_percentile(itls, 0.50)),
+            "e2e_p50_s": _round(_percentile(e2es, 0.50)),
+            "e2e_p99_s": _round(_percentile(e2es, 0.99)),
+        }
+
+    ttft_all = [r.ttft_met for r in records if r.ttft_met is not None]
+    lags = [r.lag_s for r in records]
+    return {
+        "trace": trace.name,
+        "arrival": arrival,
+        "seed": seed,
+        "offered_rate": round(rate if arrival != "trace"
+                              else (n / span if span > 0 else float(n)), 4),
+        "requests": n,
+        "duration_s": round(duration_s, 4),
+        "completed": by_status["ok"],
+        "shed": by_status["shed"],
+        "deadline": by_status["deadline"],
+        "errors": by_status["error"],
+        "hung": n - terminated,
+        "all_terminated": terminated == n,
+        "achieved_rate": round(by_status["ok"] / duration_s, 4)
+        if duration_s > 0 else 0.0,
+        "goodput_rate": round(goodput / duration_s, 4)
+        if duration_s > 0 else 0.0,
+        "ttft_attainment": (round(sum(1 for v in ttft_all if v)
+                                  / len(ttft_all), 4) if ttft_all else None),
+        "schedule_lag_p50_s": _round(_percentile(lags, 0.50)),
+        "schedule_lag_p99_s": _round(_percentile(lags, 0.99)),
+        "slo": slo,
+        "roles": roles,
+    }
+
+
+def capacity_knee(sweep: list, *, target: float = 0.99) -> Optional[float]:
+    """Max sustainable λ: the highest offered rate in a [(rate, report)]
+    sweep such that it AND every lower swept rate attain >= target on
+    TTFT (the `agentic_load` probe's headline). Walking up from the
+    lowest rate and stopping at the first miss keeps a noisy or bimodal
+    sweep from reporting a rate "sustainable" while a lower one failed;
+    a rate with no verdicts counts as a miss. None when the lowest
+    swept rate already misses."""
+    best = None
+    for rate, report in sorted(sweep, key=lambda rr: rr[0]):
+        att = report.get("ttft_attainment")
+        if att is None or att < target:
+            break
+        best = rate
+    return best

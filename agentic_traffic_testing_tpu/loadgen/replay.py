@@ -1,0 +1,382 @@
+"""Open-loop asyncio replay engine.
+
+`run_open_loop` fires a replay plan's requests at their scheduled
+instants and NEVER waits on completions between firings — a stalled
+completion cannot delay a later arrival (the coordinated-omission pin in
+tests/test_loadgen.py). Each firing is an independent task driven
+through a target:
+
+  * `InProcessTarget` — AsyncLLMEngine / EnginePool `generate()` facade,
+    the CPU-testable path bench.py and scripts/dev/loadgen_soak.py use.
+    TTFT is taken from the ENGINE's own request stamps
+    (`Request.queue_wait_s` — the same instants the step-clock telemetry
+    plane turns into llm_slo_attainment verdicts), so a loadgen report
+    reconciles exactly with the server-side counters.
+  * `HTTPTarget` — SSE `/chat` client for a live deployment
+    (`python -m agentic_traffic_testing_tpu.loadgen`), stamping
+    client-observed TTFT and tagging SLO classes via the round-8
+    slo_ttft_ms / slo_itl_ms body overrides.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import time
+from typing import Optional
+
+from agentic_traffic_testing_tpu.loadgen.trace import (
+    Trace,
+    TraceNode,
+    build_replay_plan,
+    materialize_prompts,
+)
+
+
+@dataclasses.dataclass
+class ReplayConfig:
+    """Loadgen knobs (env surface: LOADGEN_*)."""
+
+    arrival: str = "poisson"       # LOADGEN_ARRIVAL
+    rate: float = 4.0              # LOADGEN_RATE (req/s; poisson/deterministic)
+    seed: int = 0                  # LOADGEN_SEED
+    time_scale: float = 1.0        # LOADGEN_TIME_SCALE (trace arrivals)
+    trace_path: str = ""           # LOADGEN_TRACE (recorded trace JSON)
+    metrics_port: int = 0          # LOADGEN_METRICS_PORT (0 = no exposition)
+
+    @classmethod
+    def from_env(cls) -> "ReplayConfig":
+        c = cls()
+        c.arrival = os.environ.get("LOADGEN_ARRIVAL") or c.arrival
+        c.rate = float(os.environ.get("LOADGEN_RATE") or c.rate)
+        c.seed = int(os.environ.get("LOADGEN_SEED") or c.seed)
+        c.time_scale = float(
+            os.environ.get("LOADGEN_TIME_SCALE") or c.time_scale)
+        c.trace_path = os.environ.get("LOADGEN_TRACE") or c.trace_path
+        c.metrics_port = int(
+            os.environ.get("LOADGEN_METRICS_PORT") or c.metrics_port)
+        if c.arrival != "trace" and c.rate <= 0:
+            # trace arrivals replay the recorded offsets; the rate knob
+            # is documented as ignored there, so it must not refuse.
+            raise ValueError(f"LOADGEN_RATE must be > 0, got {c.rate}")
+        if c.time_scale <= 0:
+            raise ValueError(
+                f"LOADGEN_TIME_SCALE must be > 0, got {c.time_scale}")
+        if c.metrics_port < 0:
+            raise ValueError(
+                f"LOADGEN_METRICS_PORT must be >= 0, got {c.metrics_port}")
+        return c
+
+
+def engine_geometry(trace: Trace, seats: int,
+                    block_size: int = 16) -> tuple:
+    """(max_model_len, num_blocks) sized for a trace's longest request
+    (prefix + suffix + completion, with headroom) — the ONE sizing
+    formula the soak driver and the bench probe both build their
+    engines from, so the two can never drift apart silently."""
+    longest = max(n.prompt_tokens + trace.prefixes.get(n.prefix_id or "", 0)
+                  + n.max_tokens for n in trace.nodes)
+    max_len = max(256, longest + 64)
+    num_blocks = max(512, 2 * seats * (-(-max_len // block_size) + 4))
+    return max_len, num_blocks
+
+
+@dataclasses.dataclass
+class RequestRecord:
+    """One fired request's measured outcome (loadgen side)."""
+
+    request_id: str
+    session_id: str
+    role: str
+    stage: str
+    slo_class: str
+    scheduled_s: float             # planned fire offset
+    fire_s: float                  # actual fire offset
+    lag_s: float                   # fire_s - scheduled_s (open-loop health)
+    # pending until the target stamps a terminal (ok | shed | deadline |
+    # error); "hung" = still pending when the drain timeout cancelled it.
+    # A non-terminal status is what fails the all_terminated gate.
+    status: str = "pending"
+    ttft_s: Optional[float] = None
+    e2e_s: Optional[float] = None
+    n_tokens: int = 0
+    mean_itl_s: Optional[float] = None
+    slo_ttft_ms: Optional[float] = None
+    slo_itl_ms: Optional[float] = None
+    error: Optional[str] = None
+
+    @property
+    def ttft_met(self) -> Optional[bool]:
+        """TTFT SLO verdict, mirroring runtime/telemetry.py exactly:
+        only completed (ok) and deadline-expired-with-a-first-token
+        requests attain a verdict; shed/error/non-terminal ones don't."""
+        if self.slo_ttft_ms is None or self.ttft_s is None:
+            return None
+        if self.status not in ("ok", "deadline"):
+            return None
+        return self.ttft_s <= self.slo_ttft_ms / 1e3
+
+    @property
+    def itl_met(self) -> Optional[bool]:
+        if (self.slo_itl_ms is None or self.mean_itl_s is None
+                or self.status not in ("ok", "deadline")):
+            return None
+        return self.mean_itl_s <= self.slo_itl_ms / 1e3
+
+
+class InProcessTarget:
+    """Drive an AsyncLLMEngine or EnginePool generate() facade."""
+
+    def __init__(self, async_engine, prompts: dict, *,
+                 stop_token_ids: tuple = (), ignore_eos: bool = True) -> None:
+        self.async_engine = async_engine
+        self.prompts = prompts
+        self.stop_token_ids = tuple(stop_token_ids)
+        self.ignore_eos = ignore_eos
+
+    async def fire(self, node: TraceNode, trace: Trace, rec: RequestRecord,
+                   seq: int) -> None:
+        from agentic_traffic_testing_tpu.runtime.request import (
+            FinishReason,
+            SamplingParams,
+        )
+
+        ttft_ms, itl_ms = trace.slo_for(node)
+        rec.slo_ttft_ms, rec.slo_itl_ms = ttft_ms, itl_ms
+        sampling = SamplingParams(
+            max_tokens=node.max_tokens, temperature=node.temperature,
+            stop_token_ids=self.stop_token_ids, ignore_eos=self.ignore_eos,
+            seed=seq, slo_ttft_ms=ttft_ms, slo_itl_ms=itl_ms)
+        t0 = time.monotonic()
+        first_t = last_t = None
+        n = 0
+        final = None
+        try:
+            async for ev in self.async_engine.generate(
+                    self.prompts[node.request_id], sampling,
+                    f"lg{seq}-{node.request_id}"):
+                now = time.monotonic()
+                if ev.new_token_ids:
+                    if first_t is None:
+                        first_t = now
+                    last_t = now
+                    n += len(ev.new_token_ids)
+                if ev.finished:
+                    final = ev.request
+                    break
+        except Exception as exc:  # target fault — record, never raise
+            rec.status, rec.error = "error", str(exc)
+            return
+        rec.n_tokens = n
+        rec.e2e_s = time.monotonic() - t0
+        # Engine-stamped TTFT (arrival -> first token on the engine
+        # thread): the instant llm_slo_attainment judges. Loadgen-side
+        # first-event time is the fallback for targets without stamps.
+        if final is not None and final.queue_wait_s is not None:
+            rec.ttft_s = final.queue_wait_s
+        elif first_t is not None:
+            rec.ttft_s = first_t - t0
+        if first_t is not None and last_t is not None and n > 1:
+            rec.mean_itl_s = (last_t - first_t) / (n - 1)
+        fr = final.finish_reason if final is not None else None
+        if fr in (FinishReason.STOP, FinishReason.LENGTH):
+            rec.status = "ok"
+        elif fr is FinishReason.SHED:
+            rec.status = "shed"
+        elif fr is FinishReason.DEADLINE:
+            rec.status = "deadline"
+        else:
+            rec.status = "error"
+            rec.error = getattr(final, "error", None) or "no terminal event"
+
+
+class HTTPTarget:
+    """Drive a live server's /chat SSE endpoint (client-observed TTFT)."""
+
+    def __init__(self, url: str, texts: dict, *, session=None) -> None:
+        self.url = url
+        self.texts = texts
+        self._session = session
+
+    async def session(self):
+        import aiohttp
+
+        if self._session is None or self._session.closed:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=600))
+        return self._session
+
+    async def close(self) -> None:
+        if self._session is not None and not self._session.closed:
+            await self._session.close()
+
+    async def fire(self, node: TraceNode, trace: Trace, rec: RequestRecord,
+                   seq: int) -> None:
+        import json as json_mod
+
+        ttft_ms, itl_ms = trace.slo_for(node)
+        rec.slo_ttft_ms, rec.slo_itl_ms = ttft_ms, itl_ms
+        body = {"prompt": self.texts[node.request_id],
+                "max_tokens": node.max_tokens, "stream": True,
+                "request_id": f"lg{seq}-{node.request_id}"}
+        if ttft_ms is not None:
+            body["slo_ttft_ms"] = ttft_ms
+        if itl_ms is not None:
+            body["slo_itl_ms"] = itl_ms
+        t0 = time.monotonic()
+        first_t = last_t = None
+        n = 0
+        try:
+            sess = await self.session()
+            async with sess.post(self.url, json=body) as resp:
+                if resp.status != 200:
+                    rec.status = ("shed" if resp.status in (429, 503)
+                                  else "deadline" if resp.status == 504
+                                  else "error")
+                    rec.error = f"http {resp.status}"
+                    rec.e2e_s = time.monotonic() - t0
+                    return
+                async for raw in resp.content:
+                    line = raw.decode("utf-8", "replace").strip()
+                    if not line.startswith("data: "):
+                        continue
+                    ev = json_mod.loads(line[len("data: "):])
+                    now = time.monotonic()
+                    toks = ev.get("token_ids") or []
+                    if toks or (ev.get("finished") and ev.get("text")):
+                        if first_t is None:
+                            first_t = now
+                        last_t = now
+                        n += len(toks)
+                    if ev.get("finished"):
+                        rec.status = ("error" if ev.get("error")
+                                      else "ok")
+                        rec.error = ev.get("error")
+                        if ev.get("reason") == "deadline":
+                            rec.status = "deadline"
+                        elif ev.get("reason") == "queue_full":
+                            rec.status = "shed"
+                        break
+        except Exception as exc:
+            rec.status, rec.error = "error", str(exc)
+            return
+        rec.n_tokens = n
+        rec.e2e_s = time.monotonic() - t0
+        if first_t is not None:
+            rec.ttft_s = first_t - t0
+            if last_t is not None and n > 1:
+                rec.mean_itl_s = (last_t - first_t) / (n - 1)
+
+
+async def run_open_loop(plan, trace: Trace, target, *, metrics=None,
+                        clock=None,
+                        drain_timeout_s: Optional[float] = None) -> list:
+    """Fire the plan open-loop; returns one RequestRecord per node.
+
+    Scheduling is against the event-loop clock: the dispatcher sleeps to
+    each request's fire instant and spawns its task WITHOUT awaiting any
+    earlier task — completions are gathered only after the last firing.
+    `metrics` (LoadgenMetrics) observes firings and completions live.
+
+    `drain_timeout_s` bounds the post-firing drain: a request still
+    pending when it expires is cancelled and recorded with status
+    "hung" — the non-terminal outcome the report's all_terminated gate
+    exists to catch (None = wait forever).
+    """
+    loop = asyncio.get_running_loop()
+    now = clock or loop.time
+    t0 = now()
+    tasks = []
+    records = []
+    for seq, sched in enumerate(plan):
+        delay = (t0 + sched.fire_at_s) - now()
+        if delay > 0:
+            await asyncio.sleep(delay)
+        fire_s = now() - t0
+        rec = RequestRecord(
+            request_id=sched.node.request_id,
+            session_id=sched.node.session_id, role=sched.node.role,
+            stage=sched.node.stage, slo_class=sched.node.slo_class,
+            scheduled_s=sched.fire_at_s, fire_s=fire_s,
+            lag_s=fire_s - sched.fire_at_s)
+        records.append(rec)
+        if metrics is not None:
+            metrics.observe_fired(rec)
+
+        async def _one(node=sched.node, rec=rec, seq=seq):
+            try:
+                await target.fire(node, trace, rec, seq)
+                if rec.status == "pending":
+                    # A conforming target always stamps a terminal; a
+                    # non-conforming one must not fake all_terminated.
+                    rec.status, rec.error = "error", "target stamped no terminal"
+            except Exception as exc:  # a raising target must not sink
+                rec.status = "error"  # the whole run's record set
+                rec.error = str(exc)
+            if metrics is not None:
+                metrics.observe_done(rec)
+
+        tasks.append(asyncio.ensure_future(_one()))
+    if tasks:
+        done, pending = await asyncio.wait(tasks, timeout=drain_timeout_s)
+        if pending:
+            # Genuinely wedged streams: cancel, mark non-terminal (the
+            # cancellation rips through _one before observe_done runs).
+            for task in pending:
+                task.cancel()
+            await asyncio.gather(*pending, return_exceptions=True)
+            for rec in records:
+                if rec.status == "pending":
+                    rec.status = "hung"
+                    rec.error = "no terminal event before drain timeout"
+                    if metrics is not None:
+                        metrics.observe_done(rec)
+    return records
+
+
+def replay_against_engine(engine, trace: Trace, *, arrival: str = "poisson",
+                          rate: float = 4.0, seed: int = 0,
+                          time_scale: float = 1.0, vocab_size: int,
+                          metrics=None, ignore_eos: bool = True,
+                          drain_timeout_s: Optional[float] = 600.0) -> tuple:
+    """Synchronous convenience: replay `trace` open-loop against an
+    in-process LLMEngine/EnginePool and return (records, report).
+
+    Owns the AsyncLLMEngine lifecycle for a bare engine (a pool is used
+    as its own facade) and runs a private event loop — callable from
+    bench.py probes, soak scripts and tests.
+    """
+    from agentic_traffic_testing_tpu.loadgen.measure import build_report
+    from agentic_traffic_testing_tpu.runtime.engine import LLMEngine
+    from agentic_traffic_testing_tpu.serving.async_engine import AsyncLLMEngine
+
+    # A bare LLMEngine gets a private facade (owned: shut down on exit);
+    # an AsyncLLMEngine/EnginePool is used as-is (start() is idempotent,
+    # shutdown stays with its owner).
+    owns = isinstance(engine, LLMEngine)
+    facade = AsyncLLMEngine(engine) if owns else engine
+    prompts = materialize_prompts(trace, vocab_size, seed=seed)
+    plan = build_replay_plan(trace, arrival=arrival, rate=rate, seed=seed,
+                             time_scale=time_scale)
+    target = InProcessTarget(facade, prompts, ignore_eos=ignore_eos)
+
+    async def _run():
+        t0 = time.monotonic()
+        records = await run_open_loop(plan, trace, target, metrics=metrics,
+                                      drain_timeout_s=drain_timeout_s)
+        return records, time.monotonic() - t0
+
+    facade.start()
+    try:
+        records, duration = asyncio.run(_run())
+    finally:
+        if owns:
+            facade.shutdown()
+    report = build_report(records, trace=trace, duration_s=duration,
+                          arrival=arrival, rate=rate, seed=seed)
+    if metrics is not None:
+        metrics.set_rates(offered=report["offered_rate"],
+                          achieved=report["achieved_rate"],
+                          goodput=report["goodput_rate"])
+    return records, report

@@ -1,0 +1,411 @@
+"""Conversation-DAG trace format + AgentVerse synthesizer + live recorder.
+
+One trace = one multi-agent workload: a list of request nodes, each tagged
+with its session (one orchestrator task run), role (recruiter / expert /
+solver / reviewer / evaluator / mcp_tool), pipeline stage (recruit /
+decide / tool_call / execute / evaluate), DAG parents, shared-prefix id,
+prompt/completion sizes, SLO class, and a trace-clock arrival offset.
+
+The same schema serves three producers:
+
+  * `synthesize_agentverse_trace` — deterministic synthesis seeded from
+    `agents/templates/agentverse_workflow.json` (the reference workflow
+    pack): per task, a recruit call fans out into parallel expert
+    discussion, MCP tool-call interleavings hang off the experts, a
+    solver/reviewer critique ladder runs `vertical_iterations` rounds,
+    and an evaluator closes the session — the recruit → decide →
+    execute → evaluate shape of PAPER.md's L7/L8 layer.
+  * `TraceRecorder` — captures a LIVE AgentVerse run into the identical
+    schema (wired opt-in into agents/common/llm_client.py behind
+    LOADGEN_RECORD_TRACE), so a recorded production workload replays
+    through the same engine as a synthetic one.
+  * hand-written JSON (the format is stable and versioned).
+
+Prompts are stored as SIZES + prefix ids, not token ids: a trace is
+model-agnostic, and `materialize_prompts` expands it deterministically
+against a vocab so every node sharing a prefix_id shares an exact token
+prefix (the shared-prefix fan-out the prefix cache and affinity router
+were built for). `materialize_texts` renders the same structure as text
+for the HTTP target.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import random
+import zlib
+from typing import Iterable, Optional
+
+SCHEMA_VERSION = 1
+
+#: canonical stages of the AgentVerse pipeline (PAPER.md L7).
+STAGES = ("recruit", "decide", "tool_call", "execute", "evaluate")
+
+DEFAULT_TEMPLATE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "agents", "templates", "agentverse_workflow.json")
+
+#: default SLO classes: interactive covers the latency-critical
+#: orchestration hops (a slow recruit stalls the whole DAG), batch covers
+#: the long evaluator synthesis. Budgets are deliberately generous — a
+#: λ sweep is about WHERE attainment collapses, not absolute numbers.
+DEFAULT_SLO_CLASSES = {
+    "interactive": {"ttft_ms": 2000.0, "itl_ms": 500.0},
+    "batch": {"ttft_ms": 15000.0, "itl_ms": 0.0},
+}
+
+
+@dataclasses.dataclass
+class TraceNode:
+    """One LLM request in the DAG."""
+
+    request_id: str
+    session_id: str
+    role: str
+    stage: str
+    arrival_offset_s: float          # trace clock, seconds from trace start
+    prefix_id: Optional[str] = None  # shared-prefix pool key (None = solo)
+    prompt_tokens: int = 64          # suffix tokens AFTER the shared prefix
+    max_tokens: int = 32
+    slo_class: str = "interactive"
+    parents: tuple = ()              # request_ids this node depends on
+    temperature: float = 0.0
+
+    @property
+    def total_prompt_tokens(self) -> int:
+        return self.prompt_tokens  # prefix length is added at materialize
+
+
+@dataclasses.dataclass
+class Trace:
+    """A replayable workload: nodes + shared-prefix pool + SLO classes."""
+
+    name: str
+    seed: Optional[int]
+    prefixes: dict                   # prefix_id -> prefix token length
+    slo_classes: dict                # class name -> {ttft_ms, itl_ms}
+    nodes: list
+
+    def __post_init__(self) -> None:
+        ids = [n.request_id for n in self.nodes]
+        if len(set(ids)) != len(ids):
+            raise ValueError("trace has duplicate request_ids")
+        for n in self.nodes:
+            if n.slo_class not in self.slo_classes:
+                raise ValueError(
+                    f"node {n.request_id} names unknown SLO class "
+                    f"{n.slo_class!r} (declared: {sorted(self.slo_classes)})")
+            if n.prefix_id is not None and n.prefix_id not in self.prefixes:
+                raise ValueError(
+                    f"node {n.request_id} names unknown prefix "
+                    f"{n.prefix_id!r}")
+
+    # -- serialization ---------------------------------------------------
+
+    def to_json(self) -> str:
+        return json.dumps({
+            "schema_version": SCHEMA_VERSION,
+            "name": self.name,
+            "seed": self.seed,
+            "prefixes": self.prefixes,
+            "slo_classes": self.slo_classes,
+            "nodes": [dataclasses.asdict(n) for n in self.nodes],
+        }, indent=1)
+
+    @classmethod
+    def from_json(cls, text: str) -> "Trace":
+        doc = json.loads(text)
+        if doc.get("schema_version") != SCHEMA_VERSION:
+            raise ValueError(
+                f"unsupported trace schema_version "
+                f"{doc.get('schema_version')!r} (this build reads "
+                f"{SCHEMA_VERSION})")
+        nodes = [TraceNode(**{**n, "parents": tuple(n.get("parents", ()))})
+                 for n in doc["nodes"]]
+        return cls(name=doc["name"], seed=doc.get("seed"),
+                   prefixes=dict(doc["prefixes"]),
+                   slo_classes=dict(doc["slo_classes"]), nodes=nodes)
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+    @classmethod
+    def load(cls, path: str) -> "Trace":
+        with open(path) as f:
+            return cls.from_json(f.read())
+
+    def slo_for(self, node: TraceNode) -> tuple:
+        """(slo_ttft_ms, slo_itl_ms) for a node; 0 entries become None
+        (no SLO on that axis — the telemetry plane's convention)."""
+        cls = self.slo_classes[node.slo_class]
+        ttft = float(cls.get("ttft_ms") or 0.0) or None
+        itl = float(cls.get("itl_ms") or 0.0) or None
+        return ttft, itl
+
+
+# -- deterministic synthesis --------------------------------------------
+
+
+def _rng(seed: int, *keys) -> random.Random:
+    tag = "/".join(str(k) for k in keys)
+    return random.Random(seed ^ zlib.crc32(tag.encode()))
+
+
+def synthesize_agentverse_trace(
+    *,
+    tasks: int = 2,
+    seed: int = 0,
+    template_path: str = DEFAULT_TEMPLATE,
+    session_interval_s: float = 2.0,
+    stage_gap_s: float = 0.25,
+    prompt_tokens: int = 48,
+    prefix_tokens: int = 64,
+    max_tokens: int = 16,
+    tool_call_prob: float = 0.5,
+    slo_classes: Optional[dict] = None,
+) -> Trace:
+    """Deterministic AgentVerse workload from the reference template pack.
+
+    Per task (session): recruit → `num_experts` parallel decide calls
+    (each possibly followed by an MCP tool call) → `vertical_iterations`
+    solver+reviewer critique rounds → one evaluator call. Every agent
+    node in a session shares that session's prefix (system prompt +
+    task), which itself extends the global system prefix — the nested
+    shared-prefix shape; tool calls share one flat tool-schema prefix.
+    """
+    with open(template_path) as f:
+        tpl = json.load(f)
+    defaults = tpl.get("workflow_defaults", {})
+    num_experts = int(defaults.get("num_experts", 3))
+    rounds = int(defaults.get("vertical_iterations", 2))
+    roles = [r["name"] for r in tpl.get("role_catalog", [])] or ["Expert"]
+    task_pack = tpl.get("example_tasks", []) or [{"task_id": "task"}]
+
+    slo_classes = dict(slo_classes or DEFAULT_SLO_CLASSES)
+    prefixes = {"system": prefix_tokens, "tool-schema": prefix_tokens // 2}
+    nodes: list[TraceNode] = []
+
+    for si in range(tasks):
+        task = task_pack[si % len(task_pack)]
+        sid = f"s{si}-{task['task_id']}"
+        spfx = f"session-{si}"
+        # Session prefix = the task statement riding on the system prompt
+        # (materialize nests it under the global system prefix).
+        prefixes[spfx] = prefix_tokens + prompt_tokens
+        r = _rng(seed, "session", si)
+        t = si * session_interval_s
+
+        def node(rid: str, role: str, stage: str, t: float, parents=(),
+                 prefix: str = spfx, ptok: int = prompt_tokens,
+                 mtok: int = max_tokens, slo: str = "interactive"):
+            nodes.append(TraceNode(
+                request_id=f"{sid}/{rid}", session_id=sid, role=role,
+                stage=stage, arrival_offset_s=round(t, 4), prefix_id=prefix,
+                prompt_tokens=ptok, max_tokens=mtok, slo_class=slo,
+                parents=tuple(f"{sid}/{p}" for p in parents)))
+            return rid
+
+        recruit = node("recruit", "recruiter", "recruit", t)
+        t += stage_gap_s
+        experts = []
+        for ei in range(num_experts):
+            role = roles[ei % len(roles)]
+            jitter = r.uniform(0.0, stage_gap_s / 2)
+            rid = node(f"decide{ei}", role, "decide", t + jitter,
+                       parents=[recruit])
+            experts.append(rid)
+            if r.random() < tool_call_prob:
+                # MCP tool-call interleaving: short schema-prefixed call
+                # issued while the expert discussion is still running.
+                node(f"tool{ei}", "mcp_tool", "tool_call",
+                     t + jitter + stage_gap_s / 2, parents=[rid],
+                     prefix="tool-schema", ptok=prompt_tokens // 2,
+                     mtok=max(4, max_tokens // 4))
+        t += stage_gap_s
+        prev = experts
+        for ri in range(rounds):
+            solver = node(f"solve{ri}", "solver", "execute", t, parents=prev)
+            t += stage_gap_s
+            reviewers = []
+            for vi in range(max(1, num_experts - 1)):
+                jitter = r.uniform(0.0, stage_gap_s / 2)
+                reviewers.append(node(
+                    f"review{ri}.{vi}", roles[(vi + 1) % len(roles)],
+                    "execute", t + jitter, parents=[solver]))
+            t += stage_gap_s
+            prev = reviewers
+        node("evaluate", "evaluator", "evaluate", t, parents=prev,
+             mtok=max_tokens * 2, slo="batch")
+
+    nodes.sort(key=lambda n: (n.arrival_offset_s, n.request_id))
+    return Trace(name=f"agentverse-{tasks}x{num_experts}", seed=seed,
+                 prefixes=prefixes, slo_classes=slo_classes, nodes=nodes)
+
+
+# -- materialization ----------------------------------------------------
+
+
+def _materialize(trace: Trace, base: int, gen) -> dict:
+    """Shared prefix-pool expansion: request_id -> element list.
+
+    `gen(n, *keys)` yields n deterministic elements for an rng keyed by
+    (base, keys). ONE body serves both the token and text renderings, so
+    the nested sharing structure — nodes with one prefix_id share that
+    exact element prefix, session prefixes extend the global "system"
+    prefix — cannot drift between the in-process and HTTP targets.
+    """
+    system = gen(trace.prefixes.get("system", 0), "prefix", "system")
+    pool = {}
+    for pid, length in trace.prefixes.items():
+        if pid == "system":
+            pool[pid] = list(system)
+        elif pid.startswith("session-") and length > len(system):
+            pool[pid] = system + gen(length - len(system), "prefix", pid)
+        else:
+            pool[pid] = gen(length, "prefix", pid)
+    out = {}
+    for n in trace.nodes:
+        prefix = pool.get(n.prefix_id, []) if n.prefix_id else []
+        out[n.request_id] = list(prefix) + gen(n.prompt_tokens, "node",
+                                               n.request_id)
+    return out
+
+
+def materialize_prompts(trace: Trace, vocab_size: int,
+                        seed: Optional[int] = None) -> dict:
+    """request_id -> prompt token ids, deterministic under (trace.seed |
+    seed). Nodes sharing a prefix_id share that exact token prefix;
+    session prefixes additionally extend the global "system" prefix, so
+    fan-out siblings AND cross-session requests overlap the way real
+    templated agent prompts do."""
+    base = seed if seed is not None else (trace.seed or 0)
+    lo, hi = 10, max(11, vocab_size - 10)
+
+    def toks(n: int, *keys) -> list:
+        r = _rng(base, *keys)
+        return [r.randrange(lo, hi) for _ in range(n)]
+
+    return _materialize(trace, base, toks)
+
+
+_WORDS = ("plan", "measure", "batch", "token", "cache", "agent", "route",
+          "probe", "queue", "shard", "trace", "layer")
+
+
+def materialize_texts(trace: Trace, seed: Optional[int] = None) -> dict:
+    """request_id -> prompt text for the HTTP target: the SAME sharing
+    structure as the token materialization (~1 word per token), via the
+    same _materialize body."""
+    base = seed if seed is not None else (trace.seed or 0)
+
+    def words(n: int, *keys) -> list:
+        r = _rng(base, *keys)
+        return [r.choice(_WORDS) for _ in range(n)]
+
+    return {rid: " ".join(elems)
+            for rid, elems in _materialize(trace, base, words).items()}
+
+
+# -- replay plan --------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ScheduledRequest:
+    """One planned firing: the node plus its wall-clock offset."""
+
+    fire_at_s: float
+    node: TraceNode
+
+
+def build_replay_plan(trace: Trace, *, arrival: str = "trace",
+                      rate: float = 0.0, seed: int = 0,
+                      time_scale: float = 1.0) -> list:
+    """Assign fire times to the trace's nodes under an arrival process.
+
+    Nodes are taken in trace order (arrival_offset_s, request_id) — the
+    synthesizer emits them DAG-topologically, so any monotonic re-timing
+    preserves parent-before-child ordering. `arrival="trace"` replays the
+    recorded offsets (scaled by time_scale); "poisson"/"deterministic"
+    re-time the same ordered stream at offered rate λ=`rate`
+    (requests/s). Deterministic under `seed`.
+    """
+    from agentic_traffic_testing_tpu.loadgen.arrival import arrival_offsets
+
+    nodes = sorted(trace.nodes,
+                   key=lambda n: (n.arrival_offset_s, n.request_id))
+    offsets = arrival_offsets(
+        len(nodes), arrival, rate, seed=seed,
+        trace_offsets=[n.arrival_offset_s for n in nodes],
+        time_scale=time_scale)
+    return [ScheduledRequest(fire_at_s=o, node=n)
+            for o, n in zip(offsets, nodes)]
+
+
+# -- live-run recorder --------------------------------------------------
+
+
+class TraceRecorder:
+    """Capture a live agent run into the trace schema.
+
+    Producers call `record_call` per LLM request (the llm_client hook
+    passes its call metadata); offsets are stamped from the first call.
+    `to_trace` freezes the capture. Prompt sizes are recorded as ~4
+    chars/token estimates when only text lengths are known — the replay
+    cares about magnitude and sharing structure, not exact tokenization.
+    """
+
+    def __init__(self, name: str = "recorded") -> None:
+        self.name = name
+        self._t0: Optional[float] = None
+        self._nodes: list[TraceNode] = []
+        self._last_by_session: dict = {}
+        self._id_counts: dict = {}
+
+    def record_call(self, *, request_id: str, session_id: str, role: str,
+                    stage: str = "execute", prompt_chars: int = 0,
+                    prompt_tokens: Optional[int] = None,
+                    max_tokens: int = 32, t: Optional[float] = None,
+                    prefix_id: Optional[str] = None) -> None:
+        import time
+
+        now = t if t is not None else time.monotonic()
+        if self._t0 is None:
+            self._t0 = now
+        parent = self._last_by_session.get(session_id)
+        # Caller-supplied ids can repeat (client retries reuse
+        # X-Request-ID); dedup at record time so to_trace() can never
+        # raise — an atexit flush that throws would lose the whole
+        # captured run for one duplicate.
+        seen = self._id_counts.get(request_id, 0)
+        self._id_counts[request_id] = seen + 1
+        if seen:
+            request_id = f"{request_id}#{seen + 1}"
+        self._nodes.append(TraceNode(
+            request_id=request_id, session_id=session_id, role=role,
+            stage=stage if stage in STAGES else "execute",
+            arrival_offset_s=round(now - self._t0, 4), prefix_id=prefix_id,
+            prompt_tokens=(prompt_tokens if prompt_tokens is not None
+                           else max(1, prompt_chars // 4)),
+            max_tokens=max_tokens,
+            parents=(parent,) if parent else ()))
+        self._last_by_session[session_id] = request_id
+
+    def __len__(self) -> int:
+        return len(self._nodes)
+
+    def to_trace(self, slo_classes: Optional[dict] = None) -> Trace:
+        return Trace(name=self.name, seed=None, prefixes={},
+                     slo_classes=dict(slo_classes or DEFAULT_SLO_CLASSES),
+                     nodes=list(self._nodes))
+
+
+def topological_order_ok(trace: Trace,
+                         plan: Iterable[ScheduledRequest]) -> bool:
+    """True when every node fires at-or-after all of its parents (the
+    invariant build_replay_plan preserves for any monotonic arrival)."""
+    fire = {s.node.request_id: s.fire_at_s for s in plan}
+    return all(fire[p] <= fire[n.request_id]
+               for n in trace.nodes for p in n.parents if p in fire)

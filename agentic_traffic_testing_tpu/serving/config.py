@@ -36,6 +36,14 @@ class ServerConfig:
     metrics_enabled: bool = True               # LLM_METRICS_ENABLED
     metrics_include_tokens: bool = True        # LLM_METRICS_INCLUDE_TOKENS
     metrics_prefix: str = "llm"                # LLM_METRICS_PREFIX
+    # vLLM dashboard parity (round 15): 1 additionally exposes the
+    # BASELINE-named vllm:* alias families on /metrics
+    # (vllm:time_to_first_token_seconds, vllm:num_requests_running,
+    # vllm:generation_tokens_total, ... — serving/metrics.py
+    # VLLM_ALIAS_SOURCES), re-emitting the llm_* values at render time
+    # so the reference's vLLM dashboards/scripts run unmodified. 0
+    # (default) keeps the scrape payload byte-identical.
+    vllm_compat_metrics: int = 0               # LLM_VLLM_COMPAT_METRICS
     apply_chat_template: bool = True           # LLM_APPLY_CHAT_TEMPLATE
     default_system_prompt: str = DEFAULT_SYSTEM_PROMPT  # LLM_DEFAULT_SYSTEM_PROMPT
     log_requests: bool = False                 # LOG_LLM_REQUESTS
@@ -255,6 +263,14 @@ class ServerConfig:
         c.metrics_enabled = _env_bool("LLM_METRICS_ENABLED")
         c.metrics_include_tokens = _env_bool("LLM_METRICS_INCLUDE_TOKENS")
         c.metrics_prefix = os.environ.get("LLM_METRICS_PREFIX", c.metrics_prefix)
+        c.vllm_compat_metrics = int(
+            os.environ.get("LLM_VLLM_COMPAT_METRICS")
+            or c.vllm_compat_metrics)
+        if c.vllm_compat_metrics not in (0, 1):
+            raise ValueError(
+                f"LLM_VLLM_COMPAT_METRICS must be 0 or 1, got "
+                f"{c.vllm_compat_metrics} (unset it for the plain llm_* "
+                f"scrape payload)")
         c.apply_chat_template = _env_bool("LLM_APPLY_CHAT_TEMPLATE")
         c.default_system_prompt = os.environ.get(
             "LLM_DEFAULT_SYSTEM_PROMPT", c.default_system_prompt)
@@ -477,6 +493,10 @@ class ServerConfig:
                        default=c.spec_lookup_window,
                        help="bound the host-side prompt-lookup scan to the "
                             "trailing this-many tokens (0 = whole history)")
+        p.add_argument("--vllm-compat-metrics", type=int,
+                       default=c.vllm_compat_metrics,
+                       help="1 = expose the vllm:* alias families on "
+                            "/metrics alongside llm_* (0 = llm_* only)")
         a = p.parse_args(argv)
         for f in ("model", "dtype", "max_num_seqs", "max_num_batched_tokens",
                   "memory_utilization", "max_tokens", "max_model_len",
@@ -493,7 +513,7 @@ class ServerConfig:
                   "kv_cache_dtype", "fused_kv_write",
                   "num_blocks", "block_size", "weights_path",
                   "speculation", "spec_tokens", "spec_ngram",
-                  "spec_lookup_window"):
+                  "spec_lookup_window", "vllm_compat_metrics"):
             setattr(c, f, getattr(a, f))
         c._validate_elastic()  # re-check after CLI overrides
         if c.host_cache_gb and not c.prefix_caching:
@@ -522,6 +542,10 @@ class ServerConfig:
             raise ValueError(
                 f"--spec-lookup-window must be >= 0, got "
                 f"{c.spec_lookup_window}")
+        if c.vllm_compat_metrics not in (0, 1):
+            raise ValueError(
+                f"--vllm-compat-metrics must be 0 or 1, got "
+                f"{c.vllm_compat_metrics}")
         if c.step_trace < 0:
             raise ValueError(
                 f"--step-trace must be >= 0, got {c.step_trace}")

@@ -42,7 +42,8 @@ class LLMMetrics:
     content_type = CONTENT_TYPE_LATEST
 
     def __init__(self, prefix: str = "llm", include_tokens: bool = True,
-                 num_replicas: int = 1, host_cache: bool = False) -> None:
+                 num_replicas: int = 1, host_cache: bool = False,
+                 vllm_compat: bool = False) -> None:
         self.include_tokens = include_tokens
         r = self.registry = CollectorRegistry()
         self.requests_total = Counter(
@@ -412,6 +413,32 @@ class LLMMetrics:
             for trigger in MIGRATION_TRIGGERS:
                 for status in ("adopted", "failed"):
                     self.migrations.labels(trigger=trigger, status=status)
+        # vLLM dashboard parity (round 15, LLM_VLLM_COMPAT_METRICS): an
+        # opt-in alias family re-emitting the llm_* values under the
+        # BASELINE-named vllm:* families at render time — ONE collection
+        # path, two name surfaces. Off (default): the collector does not
+        # exist and the scrape payload is byte-identical (pinned by
+        # tests/test_loadgen.py).
+        self.vllm_compat = vllm_compat
+        # Scheduler-level gauges the llm_* set has no family for,
+        # refreshed on scrape by the server (set_compat_stats); zeros
+        # until then so a cold scrape still shows every vllm:* family.
+        self._compat_stats = {"num_requests_running": 0.0,
+                              "num_requests_waiting": 0.0,
+                              "gpu_cache_usage_perc": 0.0}
+        if vllm_compat:
+            self.registry.register(_VLLMCompatCollector(self))
+
+    # statics: thread(scrape)
+    def set_compat_stats(self, *, num_running: int, num_waiting: int,
+                         cache_usage: float) -> None:
+        """Refresh the vllm:* scheduler gauges from engine/pool load
+        snapshots (called on scrape; no-op unless compat is on)."""
+        if not self.vllm_compat:
+            return
+        self._compat_stats = {"num_requests_running": float(num_running),
+                              "num_requests_waiting": float(num_waiting),
+                              "gpu_cache_usage_perc": float(cache_usage)}
 
     def render(self) -> bytes:
         return generate_latest(self.registry)
@@ -640,3 +667,89 @@ class LLMMetrics:
         self.measured_context_p95.set(round(ctx_p95, 1))
         self.probed_max_concurrency.set(
             round(min(total_tokens / ctx_p95, max_num_seqs), 2))
+
+
+#: vllm:* alias map (LLM_VLLM_COMPAT_METRICS=1): target family -> the
+#: LLMMetrics attribute whose samples it re-emits. The full table with
+#: semantics lives in docs/monitoring.md §vLLM compatibility aliases.
+VLLM_ALIAS_SOURCES = (
+    # (target family, source attr, doc)
+    ("vllm:time_to_first_token_seconds", "queue_wait",
+     "Alias of llm_queue_wait_seconds: arrival -> first token at the "
+     "HTTP layer (vLLM measures TTFT at the same frontend boundary)"),
+    ("vllm:time_per_output_token_seconds", "itl",
+     "Alias of llm_itl_seconds (engine inter-token gaps; empty unless "
+     "LLM_STEP_TRACE=1)"),
+    ("vllm:e2e_request_latency_seconds", "request_latency",
+     "Alias of llm_request_latency_seconds"),
+    ("vllm:prompt_tokens", "prompt_tokens",
+     "Alias of llm_prompt_tokens_total"),
+    ("vllm:generation_tokens", "completion_tokens",
+     "Alias of llm_completion_tokens_total"),
+)
+
+#: scheduler-level vllm:* gauges with no llm_* family to alias — fed from
+#: the engines' lock-free load snapshots on scrape (set_compat_stats).
+VLLM_COMPAT_GAUGES = (
+    ("vllm:num_requests_running", "num_requests_running",
+     "Requests currently scheduled into the continuous batch (summed "
+     "across replicas)"),
+    ("vllm:num_requests_waiting", "num_requests_waiting",
+     "Requests in the wait queues (summed across replicas)"),
+    ("vllm:gpu_cache_usage_perc", "gpu_cache_usage_perc",
+     "KV block pool utilization in [0, 1] (HBM blocks on TPU; name kept "
+     "for dashboard parity)"),
+)
+
+
+class _VLLMCompatCollector:
+    """Render-time alias collector: re-emits selected llm_* families
+    under the reference's vllm:* names (BASELINE north star — its
+    dashboards and scripts/experiment run unmodified). Holds direct
+    references to the source metric objects, so there is exactly ONE
+    collection path; per-instance `_created` timestamp samples are
+    dropped (meaningless for an alias)."""
+
+    def __init__(self, m: "LLMMetrics") -> None:
+        self._m = m
+
+    def collect(self):
+        from prometheus_client.core import (
+            CounterMetricFamily,
+            GaugeMetricFamily,
+            Metric,
+        )
+
+        m = self._m
+        out = []
+        for target, attr, doc in VLLM_ALIAS_SOURCES:
+            src = getattr(m, attr, None)
+            if src is None:
+                continue
+            for metric in src.collect():
+                alias = Metric(target, doc, metric.type)
+                for s in metric.samples:
+                    if s.name.endswith("_created"):
+                        continue
+                    alias.add_sample(
+                        s.name.replace(metric.name, target, 1),
+                        s.labels, s.value, s.timestamp, s.exemplar)
+                out.append(alias)
+        # Success counter: the status="success" slice of llm_requests_total.
+        ok = 0.0
+        for metric in m.requests_total.collect():
+            for s in metric.samples:
+                if (s.name.endswith("_total")
+                        and s.labels.get("status") == "success"):
+                    ok += s.value
+        succ = CounterMetricFamily(
+            "vllm:request_success",
+            "Successfully completed requests (llm_requests_total"
+            '{status="success"})')
+        succ.add_metric([], ok)
+        out.append(succ)
+        for target, key, doc in VLLM_COMPAT_GAUGES:
+            g = GaugeMetricFamily(target, doc)
+            g.add_metric([], m._compat_stats.get(key, 0.0))
+            out.append(g)
+        return out

@@ -103,7 +103,8 @@ class LLMServer:
         self.metrics = (
             LLMMetrics(cfg.metrics_prefix, cfg.metrics_include_tokens,
                        num_replicas=cfg.num_replicas,
-                       host_cache=cfg.host_cache_gb > 0)
+                       host_cache=cfg.host_cache_gb > 0,
+                       vllm_compat=bool(cfg.vllm_compat_metrics))
             if cfg.metrics_enabled else None
         )
         on_step = self.metrics.batch_size.observe if self.metrics else None
@@ -661,6 +662,19 @@ class LLMServer:
             restore_fallbacks=getattr(source, "num_restore_fallbacks", 0),
             dispatch_failures=getattr(source, "num_dispatch_failures", 0))
         self.metrics.observe_step_clock(self._recorders())
+        if self.metrics.vllm_compat:
+            # vllm:num_requests_running/waiting + cache usage from the
+            # lock-free load snapshots (the routers' read contract) —
+            # refreshed on scrape like every other derived gauge.
+            snaps = [e.load_snapshot() for e in self._engines()]
+            free = sum(s["free_blocks"] for s in snaps)
+            total = (self.pool.num_blocks if self.pool is not None
+                     else self.engine.cache.num_blocks - 1)
+            self.metrics.set_compat_stats(
+                num_running=sum(s["num_running"] for s in snaps),
+                num_waiting=sum(s["num_waiting"] for s in snaps),
+                cache_usage=(max(0.0, 1.0 - free / total) if total > 0
+                             else 0.0))
         if self.pool is not None:
             self.metrics.set_pool_stats(
                 size=len(self.pool),

@@ -1,4 +1,4 @@
-"""Declarative registry of every LLM_*/ATT_*/BENCH_* env knob.
+"""Declarative registry of every LLM_*/ATT_*/BENCH_*/LOADGEN_* env knob.
 
 This table is the single source of truth the statics plane checks code
 and docs against (statics/knobs.py): every knob read in
@@ -57,6 +57,12 @@ KNOBS: tuple[Knob, ...] = (
          "Include token histograms in /metrics."),
     Knob("LLM_METRICS_PREFIX", "str", "llm", "serving/config.py",
          "Metric family prefix (reference dashboards expect `llm`)."),
+    Knob("LLM_VLLM_COMPAT_METRICS", "int", "0", "serving/config.py",
+         "1 additionally exposes the BASELINE-named vllm:* alias "
+         "families on /metrics (render-time aliases of the llm_* "
+         "values — serving/metrics.py VLLM_ALIAS_SOURCES) so the "
+         "reference vLLM dashboards run unmodified; 0 keeps the scrape "
+         "payload byte-identical."),
     Knob("LLM_APPLY_CHAT_TEMPLATE", "bool", "1", "serving/config.py",
          "Wrap /chat prompts in the model's chat template."),
     Knob("LLM_DEFAULT_SYSTEM_PROMPT", "str", "built-in", "serving/config.py",
@@ -283,6 +289,10 @@ KNOBS: tuple[Knob, ...] = (
     Knob("BENCH_SPEC_DECODE", "bool", "1", "bench.py",
          "0 disables the speculative-decoding probe (agentic fan-out ITL "
          "A/B + acceptance rate + token-identity gate)."),
+    Knob("BENCH_AGENTIC_LOAD", "bool", "1", "bench.py",
+         "0 disables the open-loop agentic load probe (AgentVerse DAG "
+         "trace λ sweep; headline = max sustainable λ at >= 99% "
+         "TTFT-SLO attainment)."),
     Knob("BENCH_HYBRID", "bool", "1", "bench.py",
          "0 disables the hybrid on/off A/B series."),
     Knob("BENCH_HYBRID_BUDGET", "int", "256 (tpu) / 48", "bench.py",
@@ -320,4 +330,27 @@ KNOBS: tuple[Knob, ...] = (
     Knob("BENCH_INNER", "bool", "unset", "bench.py",
          "Internal: set by the launcher to mark the re-exec'd inner "
          "bench process."),
+    # ---------------------------------------------------------- LOADGEN_*
+    Knob("LOADGEN_ARRIVAL", "enum", "poisson", "loadgen/replay.py",
+         "Open-loop arrival process: poisson | deterministic | trace "
+         "(replay the recorded offsets)."),
+    Knob("LOADGEN_RATE", "float", "4", "loadgen/replay.py",
+         "Offered arrival rate λ in requests/s (poisson/deterministic "
+         "arrivals; ignored for trace arrivals)."),
+    Knob("LOADGEN_SEED", "int", "0", "loadgen/replay.py",
+         "Seed for arrival sampling + prompt materialization "
+         "(deterministic replay: same seed = same schedule and tokens)."),
+    Knob("LOADGEN_TIME_SCALE", "float", "1", "loadgen/replay.py",
+         "Trace-arrival replay speed: recorded offsets are multiplied "
+         "by this (0.5 = double speed)."),
+    Knob("LOADGEN_TRACE", "path", "unset", "loadgen/replay.py",
+         "Recorded/synthesized trace JSON to replay (unset = the CLI "
+         "synthesizes an AgentVerse trace)."),
+    Knob("LOADGEN_METRICS_PORT", "int", "0", "loadgen/replay.py",
+         "Serve the loadgen's own Prometheus registry (loadgen_* "
+         "families) on this port for the run's duration (0 = off)."),
+    Knob("LOADGEN_RECORD_TRACE", "path", "unset",
+         "agents/common/llm_client.py",
+         "Capture every live agent LLM call into a loadgen trace JSON "
+         "written here at process exit (replayable by the loadgen CLI)."),
 )

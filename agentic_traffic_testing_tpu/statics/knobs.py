@@ -1,7 +1,7 @@
 """Checker 1 — knob registry.
 
-Every `LLM_*` / `ATT_*` / `BENCH_*` environment knob read anywhere in the
-serving/bench/scripts surface must be declared in
+Every `LLM_*` / `ATT_*` / `BENCH_*` / `LOADGEN_*` environment knob read
+anywhere in the serving/bench/scripts surface must be declared in
 `statics/knob_registry.py`, and the declarative table is the single
 source docs/knobs.md is generated from. Three failure modes:
 
@@ -41,7 +41,7 @@ from agentic_traffic_testing_tpu.statics.knob_registry import (
     Knob,
 )
 
-KNOB_RE = re.compile(r"^(LLM|ATT|BENCH)_[A-Z0-9_]+$")
+KNOB_RE = re.compile(r"^(LLM|ATT|BENCH|LOADGEN)_[A-Z0-9_]+$")
 
 #: the default scan surface, relative to the repo root
 SCAN_PATHS = ("agentic_traffic_testing_tpu", "bench.py", "scripts")
@@ -94,21 +94,23 @@ def render_doc(knobs: tuple[Knob, ...] = KNOBS) -> str:
         "<!-- regenerate with `python scripts/dev/statics_all.py "
         "--write-docs`. -->",
         "",
-        "Every `LLM_*` / `ATT_*` / `BENCH_*` environment variable the",
-        "serving stack, `bench.py`, or `scripts/` reads. The statics plane",
-        "(`scripts/dev/statics_all.py`) fails tier-1 when a knob is read",
-        "but missing here, or listed here but never read.",
+        "Every `LLM_*` / `ATT_*` / `BENCH_*` / `LOADGEN_*` environment",
+        "variable the serving stack, `bench.py`, or `scripts/` reads. The",
+        "statics plane (`scripts/dev/statics_all.py`) fails tier-1 when a",
+        "knob is read but missing here, or listed here but never read.",
         "",
     ]
-    by_prefix = {"LLM": [], "ATT": [], "BENCH": []}
+    by_prefix = {"LLM": [], "ATT": [], "BENCH": [], "LOADGEN": []}
     for k in knobs:
         by_prefix[k.name.split("_", 1)[0]].append(k)
     titles = {
         "LLM": "## `LLM_*` — serving configuration",
         "ATT": "## `ATT_*` — kernel / accelerator plumbing",
         "BENCH": "## `BENCH_*` — bench.py probe shaping",
+        "LOADGEN": "## `LOADGEN_*` — open-loop load generation "
+                   "(agentic_traffic_testing_tpu/loadgen)",
     }
-    for prefix in ("LLM", "ATT", "BENCH"):
+    for prefix in ("LLM", "ATT", "BENCH", "LOADGEN"):
         lines.append(titles[prefix])
         lines.append("")
         lines.append("| Knob | Type | Default | Owner | Description |")

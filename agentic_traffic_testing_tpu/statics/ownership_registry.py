@@ -256,6 +256,11 @@ OWNED_ATTRS: tuple[OwnedAttr, ...] = (
     OwnedAttr("LLMMetrics", "_replica_label_count", SCRAPE,
               "", "high-water mark of replica label indices rendered; "
               "scrape trims retired replicas' series past the live count"),
+    OwnedAttr("LLMMetrics", "_compat_stats", SCRAPE,
+              "", "vllm:* scheduler gauges (num running/waiting, cache "
+              "usage) refreshed from the engines' lock-free load "
+              "snapshots on scrape; the compat collector reads the dict "
+              "reference it is rebound to (one atomic store)"),
     # -- StepClock (runtime/telemetry.py) --------------------------------
     OwnedAttr("StepClock", "_seq", "", "_lock",
               "step-record sequence number"),

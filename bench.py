@@ -1088,6 +1088,77 @@ def main() -> None:
             print(f"bench: spec_decode probe dropped ({e!r})",
                   file=sys.stderr)
 
+    # Agentic open-loop load probe (round 15 — the traffic plane): a
+    # synthesized AgentVerse DAG trace (recruit → decide → execute →
+    # evaluate, tool-call interleavings, shared-prefix siblings) replays
+    # open-loop at a λ sweep against a fresh engine with the step clock
+    # on; the headline is the capacity knee — max sustainable λ at
+    # >= 99% TTFT-SLO attainment (agentic_traffic_testing_tpu/loadgen,
+    # docs/loadgen.md). BENCH_AGENTIC_LOAD=0 disables.
+    agentic_load_on = os.environ.get(
+        "BENCH_AGENTIC_LOAD", "1") not in ("0", "false")
+
+    def agentic_load_probe():
+        from agentic_traffic_testing_tpu.loadgen.measure import capacity_knee
+        from agentic_traffic_testing_tpu.loadgen.replay import (
+            engine_geometry,
+            replay_against_engine,
+        )
+        from agentic_traffic_testing_tpu.loadgen.trace import (
+            synthesize_agentverse_trace,
+        )
+
+        mc = engine.model_cfg
+        on_tpu = platform == "tpu"
+        trace = synthesize_agentverse_trace(
+            tasks=2, seed=9, max_tokens=24 if on_tpu else 10)
+        rates = [16.0, 32.0] if on_tpu else [8.0, 16.0]
+        seats = min(8, batch)
+        max_len, lg_num_blocks = engine_geometry(trace, seats)
+
+        def run_rate(lam):
+            eng = LLMEngine(EngineConfig(
+                model=model, dtype="bfloat16" if on_tpu else "float32",
+                max_num_seqs=seats, max_model_len=max_len,
+                num_blocks=lg_num_blocks,
+                block_size=16, decode_steps=decode_steps, step_trace=1,
+            ), model_cfg=mc, runner=engine.runner)
+            _, report = replay_against_engine(
+                eng, trace, arrival="poisson", rate=lam, seed=13,
+                vocab_size=vocab)
+            if not report["all_terminated"]:
+                raise RuntimeError(
+                    "agentic_load gate: requests left unterminated at "
+                    f"rate {lam}")
+            return report
+
+        run_rate(rates[0])  # warmup: compile every trace shape untimed
+        sweep = []
+        keyed = {}
+        for lam in rates:
+            report = run_rate(lam)
+            sweep.append((lam, report))
+            key = f"agentic_load_r{lam:g}"
+            keyed[f"{key}_ttft_attainment"] = report["ttft_attainment"]
+            keyed[f"{key}_goodput_rate"] = report["goodput_rate"]
+            keyed[f"{key}_achieved_rate"] = report["achieved_rate"]
+        return {
+            "agentic_load_rates": rates,
+            "agentic_load_trace_nodes": len(trace.nodes),
+            "agentic_load_max_sustainable_lambda": capacity_knee(
+                sweep, target=0.99),
+            **keyed,
+        }
+
+    agentic_res = None
+    if agentic_load_on:
+        try:
+            agentic_res = agentic_load_probe()
+        except Exception as e:
+            agentic_res = None
+            print(f"bench: agentic_load probe dropped ({e!r})",
+                  file=sys.stderr)
+
     replica_res = None
     if replicas_on:
         try:
@@ -1450,6 +1521,7 @@ def main() -> None:
         **({} if offload_res is None else offload_res),
         **({} if kv_quant_res is None else kv_quant_res),
         **({} if spec_res is None else spec_res),
+        **({} if agentic_res is None else agentic_res),
         **({} if prefill_s is None else {
             # Compute-bound half of serving (round-3 flash prefill site).
             # est_mfu counts dense matmul FLOPs (2 * non-embedding params

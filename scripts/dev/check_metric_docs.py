@@ -1,19 +1,23 @@
 #!/usr/bin/env python3
-"""Metric-family ↔ docs parity check.
+"""Metric-family ↔ docs parity check — ALL exposition surfaces.
 
 The north star requires the Prometheus contract to stay identical to the
 reference's (docs/monitoring.md is normative: scrape_metrics.py treats the
 dashboard as a schema and the doc documents every family). Every PR that
 adds a family must document it, and every documented family must exist —
-this script asserts both directions so tier-1 catches drift:
+this script asserts both directions so tier-1 catches drift, across all
+three surfaces:
 
-  1. every `llm_*` family registered by serving/metrics.py (ALL conditional
-     sets on: replica pool + host cache) appears in docs/monitoring.md;
-  2. every `llm_*` token in docs/monitoring.md names a registered family
-     (histogram `_bucket`/`_sum`/`_count` suffixes and `llm_foo_*` wildcard
-     prefixes are understood).
+  1. the server's `llm_*` families (serving/metrics.py, ALL conditional
+     sets on: replica pool + host cache);
+  2. the loadgen's `loadgen_*` families (loadgen/measure.py — the second
+     exposition surface, served on its own port);
+  3. the opt-in `vllm:*` compat aliases (LLM_VLLM_COMPAT_METRICS=1),
+     documented in monitoring.md's alias table.
 
-Exit 0 on parity, 1 with a report otherwise. Wired into tests/test_scripts.py.
+Each surface is checked both ways: registered-but-undocumented and
+documented-but-unregistered both fail. Exit 0 on parity, 1 with a report
+otherwise. Wired into tests/test_scripts.py.
 """
 
 from __future__ import annotations
@@ -32,15 +36,11 @@ HIST_SUFFIXES = ("_bucket", "_sum", "_count")
 KNOWN_NON_FAMILIES = {"llm_backend"}
 
 
-def registered_families(prefix: str = "llm") -> set[str]:
-    """Family names as they appear in a scrape, with every conditional set
-    (replica series, host-cache series) enabled."""
-    from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
-
-    m = LLMMetrics(prefix, include_tokens=True, num_replicas=2,
-                   host_cache=True)
+def _scrape_names(registry) -> set[str]:
+    """Family names as they appear in a scrape (counters render their
+    `_total` sample name)."""
     fams = set()
-    for fam in m.registry.collect():
+    for fam in registry.collect():
         name = fam.name
         if fam.type == "counter":
             name += "_total"  # scrape-visible sample name
@@ -48,35 +48,56 @@ def registered_families(prefix: str = "llm") -> set[str]:
     return fams
 
 
+def registered_families(prefix: str = "llm") -> tuple[set, set]:
+    """(llm families, vllm compat alias families), with every conditional
+    set (replica series, host-cache series, compat aliases) enabled."""
+    from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
+
+    m = LLMMetrics(prefix, include_tokens=True, num_replicas=2,
+                   host_cache=True, vllm_compat=True)
+    fams = _scrape_names(m.registry)
+    vllm = {f for f in fams if f.startswith("vllm:")}
+    return fams - vllm, vllm
+
+
+def loadgen_families() -> set[str]:
+    """The loadgen exposition surface's families (its own registry — a
+    missing import here fails LOUDLY rather than silently skipping the
+    second surface)."""
+    from agentic_traffic_testing_tpu.loadgen.measure import LoadgenMetrics
+
+    return _scrape_names(
+        LoadgenMetrics(roles=("solver",), slo_classes=("interactive",))
+        .registry)
+
+
 def documented_tokens(text: str, prefix: str = "llm") -> tuple[set, set]:
     """(exact family tokens, wildcard prefixes) mentioned in the doc.
     A token ending in `_` came from a `llm_foo_*` or `llm_foo_{a,b}`
     shorthand and is treated as a prefix wildcard. Tokens preceded by a
     double quote are PromQL label VALUES (e.g. dst_service="llm_backend"),
-    not families."""
-    tokens = set(re.findall(rf'(?<!"){prefix}_[a-z0-9_]+', text))
+    not families. A leading word-boundary guard keeps `llm_*` tokens from
+    matching inside `vllm:*` alias names."""
+    tokens = set(re.findall(rf'(?<!["a-z0-9_:]){prefix}_[a-z0-9_]+', text))
     tokens -= KNOWN_NON_FAMILIES
     exact = {t for t in tokens if not t.endswith("_")}
     prefixes = {t for t in tokens if t.endswith("_")}
     return exact, prefixes
 
 
-def main(argv=None) -> int:
-    doc_path = os.path.join(REPO, "docs", "monitoring.md")
-    if argv:
-        doc_path = argv[0]
-    with open(doc_path) as f:
-        text = f.read()
-    reg = registered_families()
-    exact, prefixes = documented_tokens(text)
+def documented_vllm_tokens(text: str) -> set[str]:
+    return set(re.findall(r"vllm:[a-z0-9_]+", text))
 
+
+def check_surface(reg: set, exact: set, prefixes: set,
+                  surface: str) -> tuple[list, list]:
     missing_from_docs = []
     for fam in sorted(reg):
         if fam in exact:
             continue
         if any(fam.startswith(p) for p in prefixes):
             continue
-        missing_from_docs.append(fam)
+        missing_from_docs.append(f"[{surface}] {fam}")
 
     unknown_in_docs = []
     for tok in sorted(exact):
@@ -85,10 +106,32 @@ def main(argv=None) -> int:
         if any(tok.endswith(s) and tok[: -len(s)] in reg
                for s in HIST_SUFFIXES):
             continue
-        unknown_in_docs.append(tok)
+        unknown_in_docs.append(f"[{surface}] {tok}")
     for p in sorted(prefixes):
         if not any(f.startswith(p) for f in reg):
-            unknown_in_docs.append(p + "*")
+            unknown_in_docs.append(f"[{surface}] {p}*")
+    return missing_from_docs, unknown_in_docs
+
+
+def main(argv=None) -> int:
+    doc_path = os.path.join(REPO, "docs", "monitoring.md")
+    if argv:
+        doc_path = argv[0]
+    with open(doc_path) as f:
+        text = f.read()
+
+    llm_reg, vllm_reg = registered_families()
+    lg_reg = loadgen_families()
+
+    missing_from_docs: list[str] = []
+    unknown_in_docs: list[str] = []
+    for surface, reg, (exact, prefixes) in (
+            ("llm", llm_reg, documented_tokens(text, "llm")),
+            ("loadgen", lg_reg, documented_tokens(text, "loadgen")),
+            ("vllm", vllm_reg, (documented_vllm_tokens(text), set()))):
+        miss, unk = check_surface(reg, exact, prefixes, surface)
+        missing_from_docs.extend(miss)
+        unknown_in_docs.extend(unk)
 
     ok = not missing_from_docs and not unknown_in_docs
     if missing_from_docs:
@@ -96,12 +139,12 @@ def main(argv=None) -> int:
         for fam in missing_from_docs:
             print(f"  {fam}")
     if unknown_in_docs:
-        print("documented but NOT registered by serving/metrics.py:")
+        print("documented but NOT registered:")
         for tok in unknown_in_docs:
             print(f"  {tok}")
     if ok:
-        print(f"metric-docs parity OK: {len(reg)} families, "
-              f"{len(exact)} documented tokens")
+        print(f"metric-docs parity OK: {len(llm_reg)} llm + {len(lg_reg)} "
+              f"loadgen + {len(vllm_reg)} vllm families")
     return 0 if ok else 1
 
 

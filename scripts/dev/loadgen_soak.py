@@ -1,0 +1,221 @@
+#!/usr/bin/env python3
+"""Open-loop λ-sweep soak driver for the round-15 agentic traffic plane.
+
+The SAME synthesized AgentVerse DAG trace replays open-loop at each
+offered rate, twice per rate — `clean` (no faults, unbounded queue) and
+`chaos` (a seeded dispatch-fault spec + a bounded wait queue, the
+chaos_ab.py pattern) — against a fresh in-process engine with the
+step-clock telemetry plane on. One JSON line per run:
+
+    {"mode": "clean"|"chaos", "rate": λ, "completed": N, "shed": N, ...,
+     "all_terminated": true, "counters_reconcile": true}
+
+Gates (the ISSUE-15 acceptance criteria, machine-checked here and in
+tests/test_scripts.py::test_loadgen_soak_smoke):
+
+  * all_terminated       — every fired request reached a terminal state
+                           (ok, shed, deadline, or structured error).
+  * counters_reconcile   — the loadgen report's TTFT-SLO met/violated and
+                           shed counts EQUAL the engine's Prometheus
+                           counters (llm_slo_attainment_total drained from
+                           the step clock; num_shed, the value behind the
+                           SHED terminals llm_requests_shed_total counts).
+  * attainment_delta     — per rate, clean attainment >= chaos attainment
+                           (fault injection cannot improve SLO attainment).
+
+A final `sweep` line reports the clean arms' capacity knee (max λ at
+>= the attainment target) and serves the loadgen's own Prometheus
+registry once on an ephemeral port to prove the second exposition
+surface scrapes with every family present.
+
+Usage: python scripts/dev/loadgen_soak.py [tasks] [max_tokens]
+Env: SOAK_MODEL (default tiny/fp32 on cpu, llama-3.2-1b/bf16 on tpu),
+     SOAK_RATES (comma λ list, default "4,8"),
+     SOAK_FAULT_SPEC (default "dispatch_error:p=0.1"),
+     SOAK_ATTAINMENT_TARGET (default 0.5 on cpu — the tiny-engine knee).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+
+def run_one(*, chaos: bool, rate: float, trace, runner, model_cfg,
+            model: str, dtype: str, seats: int, fault_spec: str) -> dict:
+    from agentic_traffic_testing_tpu.loadgen.replay import (
+        engine_geometry,
+        replay_against_engine,
+    )
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
+
+    max_len, num_blocks = engine_geometry(trace, seats)
+    eng = LLMEngine(EngineConfig(
+        model=model, dtype=dtype, max_num_seqs=seats, max_model_len=max_len,
+        block_size=16, num_blocks=num_blocks,
+        step_trace=1,
+        fault_spec=fault_spec if chaos else "",
+        fault_seed=23,
+        # Chaos arm: bounded queue so open-loop overload SHEDS (the
+        # engine-side backstop terminal) instead of queueing unboundedly.
+        max_queue=2 * seats if chaos else 0,
+    ), model_cfg=model_cfg, runner=runner)
+    records, report = replay_against_engine(
+        eng, trace, arrival="poisson", rate=rate, seed=11,
+        vocab_size=model_cfg.vocab_size)
+
+    # Reconcile against the engine's Prometheus counters: drain the step
+    # clock into a real LLMMetrics registry and read the families back.
+    m = LLMMetrics()
+    m.observe_step_clock([eng.telemetry])
+    get = m.registry.get_sample_value
+    prom_met = get("llm_slo_attainment_total",
+                   {"slo": "ttft", "status": "met"}) or 0
+    prom_violated = get("llm_slo_attainment_total",
+                        {"slo": "ttft", "status": "violated"}) or 0
+    rep_met = sum(c["ttft_met"] for c in report["slo"].values())
+    rep_total = sum(c["ttft_total"] for c in report["slo"].values())
+    reconcile = (int(prom_met) == rep_met
+                 and int(prom_met + prom_violated) == rep_total
+                 and eng.num_shed == report["shed"])
+    return {
+        "mode": "chaos" if chaos else "clean",
+        "rate": rate,
+        "requests": report["requests"],
+        "completed": report["completed"],
+        "shed": report["shed"],
+        "deadline": report["deadline"],
+        "errors": report["errors"],
+        "dispatch_failures": eng.num_dispatch_failures,
+        "ttft_attainment": report["ttft_attainment"],
+        "achieved_rate": report["achieved_rate"],
+        "goodput_rate": report["goodput_rate"],
+        "schedule_lag_p99_s": report["schedule_lag_p99_s"],
+        "all_terminated": report["all_terminated"],
+        "engine_slo_met": int(prom_met),
+        "engine_slo_violated": int(prom_violated),
+        "engine_shed": eng.num_shed,
+        "counters_reconcile": reconcile,
+    }
+
+
+def scrape_loadgen_surface(trace) -> dict:
+    """Prove the loadgen's own exposition surface: serve the registry on
+    an ephemeral port, scrape it over HTTP, and check the
+    always-registered families are present BEFORE any request fired."""
+    from agentic_traffic_testing_tpu.loadgen.measure import (
+        LoadgenMetrics,
+        MetricsExposition,
+    )
+
+    metrics = LoadgenMetrics.for_trace(trace)
+    exposition = MetricsExposition(metrics, port=0, host="127.0.0.1")
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{exposition.port}/metrics",
+                timeout=10) as resp:
+            payload = resp.read().decode()
+    finally:
+        exposition.close()
+    families = ("loadgen_offered_requests_total", "loadgen_requests_total",
+                "loadgen_ttft_seconds", "loadgen_itl_seconds",
+                "loadgen_e2e_seconds", "loadgen_schedule_lag_seconds",
+                "loadgen_slo_attainment_total", "loadgen_offered_rate",
+                "loadgen_achieved_rate", "loadgen_goodput_rate")
+    return {"port_scraped": True,
+            "families_present": all(f in payload for f in families)}
+
+
+def main(argv=None) -> list:
+    argv = [int(a) for a in (argv if argv is not None else sys.argv[1:])]
+    tasks = argv[0] if len(argv) > 0 else 2
+    max_tokens = argv[1] if len(argv) > 1 else 8
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentic_traffic_testing_tpu.loadgen.measure import capacity_knee
+    from agentic_traffic_testing_tpu.loadgen.trace import (
+        synthesize_agentverse_trace,
+    )
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import init_params
+    from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+    platform = jax.devices()[0].platform
+    model = os.environ.get(
+        "SOAK_MODEL", "llama-3.2-1b" if platform == "tpu" else "tiny")
+    dtype = "bfloat16" if platform == "tpu" else "float32"
+    seats = 16 if platform == "tpu" else 4
+    rates = [float(r) for r in
+             os.environ.get("SOAK_RATES", "4,8").split(",") if r]
+    fault_spec = os.environ.get("SOAK_FAULT_SPEC", "dispatch_error:p=0.1")
+    target = float(os.environ.get(
+        "SOAK_ATTAINMENT_TARGET", "0.99" if platform == "tpu" else "0.5"))
+
+    model_cfg = resolve_config(model)
+    params = init_params(
+        model_cfg, jax.random.key(0),
+        dtype=jnp.bfloat16 if dtype == "bfloat16" else jnp.float32)
+    runner = ModelRunner(model_cfg, params,
+                         decode_steps=16 if platform == "tpu" else 1)
+    trace = synthesize_agentverse_trace(tasks=tasks, seed=5,
+                                        max_tokens=max_tokens)
+    print(f"devices: {jax.devices()}  trace={trace.name} "
+          f"nodes={len(trace.nodes)} rates={rates} spec={fault_spec!r}",
+          file=sys.stderr, flush=True)
+
+    common = dict(trace=trace, runner=runner, model_cfg=model_cfg,
+                  model=model, dtype=dtype, seats=seats,
+                  fault_spec=fault_spec)
+    # Discarded warmup pass: the shared runner compiles every
+    # prefill/decode shape the trace exercises OUTSIDE the measured
+    # arms, so the first measured run's TTFTs are not compile stalls.
+    run_one(chaos=False, rate=rates[0], **common)
+    print("warmup replay done", file=sys.stderr, flush=True)
+    results = []
+    sweep = []
+    for rate in rates:
+        clean = run_one(chaos=False, rate=rate, **common)
+        chaos = run_one(chaos=True, rate=rate, **common)
+        # Attainment-delta gate, goodput-guarded: fault injection must
+        # not produce MORE SLO-met completions per second than the
+        # clean arm (it destroys work). Raw attainment alone can move
+        # either way under chaos — errored requests attain no verdict,
+        # so killing work shortens the survivors' queues (survivor
+        # bias) — which is why a negative delta is tolerated exactly
+        # when the chaos arm actually errored work away.
+        delta = ((clean["ttft_attainment"] or 0.0)
+                 - (chaos["ttft_attainment"] or 0.0))
+        goodput_ok = (chaos["goodput_rate"]
+                      <= clean["goodput_rate"] * 1.1 + 0.5)
+        for r in (clean, chaos):
+            r["attainment_delta"] = round(delta, 4)
+            r["attainment_delta_ok"] = goodput_ok and (
+                delta >= -0.101 or chaos["errors"] > 0)
+            print(json.dumps(r), flush=True)
+            results.append(r)
+        sweep.append((rate, {"ttft_attainment": clean["ttft_attainment"]}))
+    summary = {
+        "mode": "sweep",
+        "rates": rates,
+        "attainment_target": target,
+        "max_sustainable_lambda": capacity_knee(sweep, target=target),
+        **scrape_loadgen_surface(trace),
+    }
+    print(json.dumps(summary), flush=True)
+    results.append(summary)
+    return results
+
+
+if __name__ == "__main__":
+    main()

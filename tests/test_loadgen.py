@@ -1,0 +1,671 @@
+"""The round-15 agentic traffic plane (agentic_traffic_testing_tpu/loadgen).
+
+Covers the ISSUE-15 acceptance surface on CPU:
+  * trace schema round-trip: synthesize → serialize → deserialize →
+    replay-plan identity;
+  * the open-loop contract: a stalled completion must NOT delay
+    subsequent arrivals (the coordinated-omission regression);
+  * SLO-report math against hand-computed fixtures;
+  * deterministic replay under a fixed seed;
+  * CPU e2e against an in-process engine: the report's attainment and
+    shed counts reconcile exactly with the engine's Prometheus
+    counters / terminal events;
+  * the vllm:* compat alias surface (default 0 = byte-identical scrape
+    payload, pinned) + the loadgen's own always-registered exposition.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import urllib.request
+
+import pytest
+
+from agentic_traffic_testing_tpu.loadgen.arrival import arrival_offsets
+from agentic_traffic_testing_tpu.loadgen.measure import (
+    LoadgenMetrics,
+    MetricsExposition,
+    build_report,
+    capacity_knee,
+)
+from agentic_traffic_testing_tpu.loadgen.replay import (
+    ReplayConfig,
+    RequestRecord,
+    replay_against_engine,
+    run_open_loop,
+)
+from agentic_traffic_testing_tpu.loadgen.trace import (
+    Trace,
+    TraceNode,
+    TraceRecorder,
+    build_replay_plan,
+    materialize_prompts,
+    materialize_texts,
+    synthesize_agentverse_trace,
+    topological_order_ok,
+)
+
+MODEL = "tiny"
+
+
+@pytest.fixture(scope="module")
+def runner():
+    """One shared ModelRunner (the test_faults idiom): every engine in
+    this module reuses its compiled programs."""
+    import jax
+    import jax.numpy as jnp
+
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import init_params
+    from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+    cfg = resolve_config(MODEL)
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    return cfg, ModelRunner(cfg, params, decode_steps=1)
+
+
+def _engine(runner, *, seats=4, max_len=512, **kw):
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+
+    model_cfg, r = runner
+    return LLMEngine(EngineConfig(
+        model=MODEL, dtype="float32", max_num_seqs=seats,
+        max_model_len=max_len, block_size=16, num_blocks=512, **kw),
+        model_cfg=model_cfg, runner=r)
+
+
+# ------------------------------------------------------------- schema
+
+
+def test_trace_roundtrip_replay_plan_identity():
+    """synthesize → serialize → deserialize: identical nodes AND an
+    identical replay plan for every arrival process."""
+    tr = synthesize_agentverse_trace(tasks=2, seed=7)
+    rt = Trace.from_json(tr.to_json())
+    assert rt.nodes == tr.nodes
+    assert rt.prefixes == tr.prefixes and rt.slo_classes == tr.slo_classes
+    for arrival, rate in (("trace", 0.0), ("poisson", 8.0),
+                          ("deterministic", 8.0)):
+        p1 = build_replay_plan(tr, arrival=arrival, rate=rate, seed=3)
+        p2 = build_replay_plan(rt, arrival=arrival, rate=rate, seed=3)
+        assert [(s.fire_at_s, s.node.request_id) for s in p1] == \
+               [(s.fire_at_s, s.node.request_id) for s in p2]
+
+
+def test_trace_save_load_roundtrip(tmp_path):
+    tr = synthesize_agentverse_trace(tasks=1, seed=1)
+    path = str(tmp_path / "t.json")
+    tr.save(path)
+    assert Trace.load(path).nodes == tr.nodes
+
+
+def test_trace_schema_version_rejected():
+    tr = synthesize_agentverse_trace(tasks=1, seed=0)
+    doc = json.loads(tr.to_json())
+    doc["schema_version"] = 99
+    with pytest.raises(ValueError, match="schema_version"):
+        Trace.from_json(json.dumps(doc))
+
+
+def test_trace_validation():
+    node = TraceNode(request_id="a", session_id="s", role="solver",
+                     stage="execute", arrival_offset_s=0.0)
+    with pytest.raises(ValueError, match="SLO class"):
+        Trace(name="x", seed=0, prefixes={}, slo_classes={}, nodes=[node])
+    with pytest.raises(ValueError, match="duplicate"):
+        Trace(name="x", seed=0, prefixes={},
+              slo_classes={"interactive": {"ttft_ms": 1}},
+              nodes=[node, TraceNode(
+                  request_id="a", session_id="s", role="solver",
+                  stage="execute", arrival_offset_s=0.1)])
+
+
+def test_synthesizer_dag_shape():
+    """The AgentVerse template drives the shape: recruit fans into
+    num_experts decide nodes, execute rounds ladder, evaluator closes;
+    tool calls hang off experts; any monotonic plan is topological."""
+    tr = synthesize_agentverse_trace(tasks=2, seed=5)
+    sessions = {n.session_id for n in tr.nodes}
+    assert len(sessions) == 2
+    for sid in sessions:
+        ns = [n for n in tr.nodes if n.session_id == sid]
+        stages = {n.stage for n in ns}
+        assert {"recruit", "decide", "execute", "evaluate"} <= stages
+        recruit = [n for n in ns if n.stage == "recruit"]
+        decide = [n for n in ns if n.stage == "decide"]
+        assert len(recruit) == 1 and len(decide) == 3  # template num_experts
+        assert all(n.parents == (recruit[0].request_id,) for n in decide)
+        (ev,) = [n for n in ns if n.stage == "evaluate"]
+        assert ev.slo_class == "batch"
+    for arrival, rate in (("poisson", 4.0), ("deterministic", 16.0),
+                          ("trace", 0.0)):
+        plan = build_replay_plan(tr, arrival=arrival, rate=rate, seed=2)
+        assert topological_order_ok(tr, plan)
+
+
+def test_materialize_shared_prefixes():
+    """Fan-out siblings share their session's exact token prefix, the
+    session prefix extends the global system prefix, and materialization
+    is deterministic under seed."""
+    tr = synthesize_agentverse_trace(tasks=2, seed=3)
+    p1 = materialize_prompts(tr, 512, seed=9)
+    p2 = materialize_prompts(tr, 512, seed=9)
+    assert p1 == p2
+    assert p1 != materialize_prompts(tr, 512, seed=10)
+    s0 = [n for n in tr.nodes
+          if n.session_id == tr.nodes[0].session_id and n.role != "mcp_tool"]
+    k = tr.prefixes[s0[0].prefix_id]
+    sysk = tr.prefixes["system"]
+    for n in s0[1:]:
+        assert p1[n.request_id][:k] == p1[s0[0].request_id][:k]
+    other = [n for n in tr.nodes
+             if n.session_id != tr.nodes[0].session_id
+             and n.role != "mcp_tool"][0]
+    assert p1[other.request_id][:sysk] == p1[s0[0].request_id][:sysk]
+    # the text materialization carries the SAME nested sharing: session
+    # prefixes extend the literal system-prefix string
+    texts = materialize_texts(tr, seed=9)
+    assert set(texts) == set(p1)
+    assert all(isinstance(t, str) and t for t in texts.values())
+    a_words = texts[s0[0].request_id].split()
+    for n in s0[1:]:
+        assert texts[n.request_id].split()[:k] == a_words[:k]
+    assert texts[other.request_id].split()[:sysk] == a_words[:sysk]
+
+
+# ------------------------------------------------------------ arrivals
+
+
+def test_arrival_processes():
+    det = arrival_offsets(4, "deterministic", 8.0)
+    assert det == [0.0, 0.125, 0.25, 0.375]
+    poi = arrival_offsets(100, "poisson", 10.0, seed=4)
+    assert poi == arrival_offsets(100, "poisson", 10.0, seed=4)
+    assert poi != arrival_offsets(100, "poisson", 10.0, seed=5)
+    assert all(b > a for a, b in zip(poi, poi[1:]))
+    # mean interarrival ~ 1/λ
+    assert 0.05 < poi[-1] / 100 < 0.2
+    tr = arrival_offsets(3, "trace", 0.0, trace_offsets=[1.0, 2.0, 4.0],
+                         time_scale=0.5)
+    assert tr == [0.0, 0.5, 1.5]
+    with pytest.raises(ValueError, match="unknown arrival"):
+        arrival_offsets(1, "weibull", 1.0)
+    with pytest.raises(ValueError, match="positive rate"):
+        arrival_offsets(1, "poisson", 0.0)
+    with pytest.raises(ValueError, match="trace_offsets"):
+        arrival_offsets(1, "trace", 1.0)
+
+
+def test_replay_config_from_env(monkeypatch):
+    monkeypatch.setenv("LOADGEN_ARRIVAL", "deterministic")
+    monkeypatch.setenv("LOADGEN_RATE", "12.5")
+    monkeypatch.setenv("LOADGEN_SEED", "7")
+    monkeypatch.setenv("LOADGEN_TIME_SCALE", "2.0")
+    monkeypatch.setenv("LOADGEN_TRACE", "/tmp/x.json")
+    monkeypatch.setenv("LOADGEN_METRICS_PORT", "9102")
+    c = ReplayConfig.from_env()
+    assert (c.arrival, c.rate, c.seed, c.time_scale, c.trace_path,
+            c.metrics_port) == ("deterministic", 12.5, 7, 2.0,
+                                "/tmp/x.json", 9102)
+    monkeypatch.setenv("LOADGEN_RATE", "-1")
+    with pytest.raises(ValueError, match="LOADGEN_RATE"):
+        ReplayConfig.from_env()
+
+
+# ----------------------------------------------- the open-loop contract
+
+
+class _StallTarget:
+    """First request hangs until released; the rest return instantly —
+    the coordinated-omission trap."""
+
+    def __init__(self):
+        self.release = asyncio.Event()
+        self.fired = []
+
+    async def fire(self, node, trace, rec, seq):
+        self.fired.append(node.request_id)
+        if seq == 0:
+            await self.release.wait()
+        rec.status = "ok"
+
+
+def test_open_loop_schedule_not_delayed_by_stall():
+    """A stalled completion must NOT delay subsequent arrivals: every
+    later request still fires within tolerance of its schedule while
+    request 0 is wedged for the whole run."""
+    tr = synthesize_agentverse_trace(tasks=1, seed=0)
+    plan = build_replay_plan(tr, arrival="deterministic", rate=100.0)
+    target = _StallTarget()
+
+    async def go():
+        task = asyncio.ensure_future(run_open_loop(plan, tr, target))
+        while len(target.fired) < len(plan):
+            await asyncio.sleep(0.002)
+        target.release.set()  # only NOW may request 0 complete
+        return await task
+
+    records = asyncio.run(go())
+    assert len(records) == len(plan)
+    assert all(r.status == "ok" for r in records)
+    # every arrival after the stalled one left on schedule
+    assert max(r.lag_s for r in records[1:]) < 0.25
+    # and the stalled request itself fired first, on schedule
+    assert records[0].lag_s < 0.25
+
+
+def test_open_loop_drain_timeout_marks_hung():
+    """The all_terminated gate is real: a request whose target NEVER
+    terminates is cancelled at the drain timeout and recorded as
+    non-terminal ("hung"), failing all_terminated — while conforming
+    requests keep their terminals."""
+    tr = synthesize_agentverse_trace(tasks=1, seed=0)
+    plan = build_replay_plan(tr, arrival="deterministic", rate=200.0)
+
+    class _Wedged:
+        async def fire(self, node, trace, rec, seq):
+            if seq == 0:
+                await asyncio.Event().wait()  # never terminates
+            rec.status = "ok"
+
+    records = asyncio.run(run_open_loop(
+        plan, tr, _Wedged(), drain_timeout_s=0.3))
+    assert records[0].status == "hung"
+    assert records[0].error and "drain timeout" in records[0].error
+    assert all(r.status == "ok" for r in records[1:])
+    rep = build_report(records, trace=tr, duration_s=1.0,
+                       arrival="deterministic", rate=200.0)
+    assert rep["all_terminated"] is False
+    assert rep["hung"] == 1
+    # non-terminal records attain no SLO verdict
+    assert records[0].ttft_met is None
+
+
+def test_open_loop_records_schedule_lag_metrics():
+    tr = synthesize_agentverse_trace(tasks=1, seed=0)
+    plan = build_replay_plan(tr, arrival="deterministic", rate=200.0)
+    m = LoadgenMetrics.for_trace(tr)
+
+    class _Instant:
+        async def fire(self, node, trace, rec, seq):
+            rec.status = "ok"
+            rec.ttft_s, rec.e2e_s, rec.n_tokens = 0.01, 0.02, 2
+            rec.slo_ttft_ms, _ = trace.slo_for(node)
+
+    asyncio.run(run_open_loop(plan, tr, _Instant(), metrics=m))
+    out = m.render().decode()
+    get = m.registry.get_sample_value
+    assert get("loadgen_offered_requests_total") == len(plan)
+    assert "loadgen_schedule_lag_seconds_bucket" in out
+    met = get("loadgen_slo_attainment_total",
+              {"slo_class": "interactive", "slo": "ttft", "status": "met"})
+    assert met and met > 0
+
+
+# ------------------------------------------------------- report math
+
+
+def _mk_trace_for_report():
+    return Trace(name="fixture", seed=0, prefixes={},
+                 slo_classes={"interactive": {"ttft_ms": 100.0,
+                                              "itl_ms": 50.0},
+                              "batch": {"ttft_ms": 1000.0, "itl_ms": 0}},
+                 nodes=[])
+
+
+def _rec(i, status, ttft=None, itl=None, cls="interactive", role="solver",
+         lag=0.001, e2e=0.5, ttft_slo=100.0, itl_slo=50.0):
+    return RequestRecord(
+        request_id=f"r{i}", session_id="s", role=role, stage="execute",
+        slo_class=cls, scheduled_s=0.1 * i, fire_s=0.1 * i + lag, lag_s=lag,
+        status=status, ttft_s=ttft, mean_itl_s=itl, e2e_s=e2e, n_tokens=4,
+        slo_ttft_ms=ttft_slo, slo_itl_ms=itl_slo)
+
+
+def test_report_math_hand_computed():
+    """SLO attainment, goodput and percentiles against a hand-built
+    record set (the telemetry-plane verdict rules: shed/error attain
+    nothing; a deadline'd request with a first token does)."""
+    records = [
+        _rec(0, "ok", ttft=0.05, itl=0.01),            # ttft met, itl met
+        _rec(1, "ok", ttft=0.20, itl=0.01),            # ttft VIOLATED
+        _rec(2, "shed"),                               # no verdict
+        _rec(3, "deadline", ttft=0.05),                # ttft met (deadline)
+        _rec(4, "error", ttft=0.01),                   # no verdict
+        _rec(5, "ok", ttft=0.50, cls="batch", role="evaluator",
+             ttft_slo=1000.0, itl_slo=None),           # batch met, no itl
+    ]
+    rep = build_report(records, trace=_mk_trace_for_report(),
+                       duration_s=2.0, arrival="poisson", rate=4.0)
+    assert (rep["requests"], rep["completed"], rep["shed"], rep["deadline"],
+            rep["errors"]) == (6, 3, 1, 1, 1)
+    assert rep["all_terminated"] is True
+    inter = rep["slo"]["interactive"]
+    assert (inter["ttft_met"], inter["ttft_total"]) == (2, 3)
+    assert inter["ttft_attainment"] == pytest.approx(2 / 3, abs=1e-4)
+    assert (inter["itl_met"], inter["itl_total"]) == (2, 2)
+    batch = rep["slo"]["batch"]
+    assert (batch["ttft_met"], batch["ttft_total"]) == (1, 1)
+    assert batch["itl_total"] == 0 and batch["itl_attainment"] is None
+    # overall: met verdicts 3 of 4
+    assert rep["ttft_attainment"] == pytest.approx(3 / 4, abs=1e-4)
+    # goodput: ok AND no violated axis -> records 0 and 5 (1 violated ttft)
+    assert rep["goodput_rate"] == pytest.approx(2 / 2.0, abs=1e-4)
+    assert rep["achieved_rate"] == pytest.approx(3 / 2.0, abs=1e-4)
+    assert rep["roles"]["solver"]["requests"] == 5
+    assert rep["roles"]["solver"]["ttft_p50_s"] == 0.05
+    assert rep["roles"]["evaluator"]["ttft_p50_s"] == 0.5
+
+
+def test_capacity_knee():
+    sweep = [(4.0, {"ttft_attainment": 1.0}),
+             (8.0, {"ttft_attainment": 0.995}),
+             (16.0, {"ttft_attainment": 0.7}),
+             (32.0, {"ttft_attainment": None})]
+    assert capacity_knee(sweep, target=0.99) == 8.0
+    assert capacity_knee(sweep, target=0.6) == 16.0
+    assert capacity_knee([(4.0, {"ttft_attainment": 0.1})]) is None
+    assert capacity_knee([]) is None
+    # non-monotone sweeps: a higher rate is NOT sustainable when a lower
+    # swept rate missed the target (noisy/bimodal attainment)
+    bimodal = [(8.0, {"ttft_attainment": 0.97}),
+               (16.0, {"ttft_attainment": 0.995})]
+    assert capacity_knee(bimodal, target=0.99) is None
+    # and the walk sorts by rate, whatever order the sweep ran in
+    assert capacity_knee(list(reversed(sweep)), target=0.99) == 8.0
+
+
+# --------------------------------------------- deterministic replay
+
+
+def test_deterministic_replay_same_seed(runner):
+    """Same seed = same schedule, same prompts, same completions; a
+    different seed produces a different poisson schedule."""
+    tr = synthesize_agentverse_trace(tasks=1, seed=2, max_tokens=4)
+    p1 = build_replay_plan(tr, arrival="poisson", rate=50.0, seed=6)
+    p2 = build_replay_plan(tr, arrival="poisson", rate=50.0, seed=6)
+    p3 = build_replay_plan(tr, arrival="poisson", rate=50.0, seed=7)
+    assert [s.fire_at_s for s in p1] == [s.fire_at_s for s in p2]
+    assert [s.fire_at_s for s in p1] != [s.fire_at_s for s in p3]
+
+    outs = []
+    for _ in range(2):
+        records, report = replay_against_engine(
+            _engine(runner), tr, arrival="poisson", rate=50.0, seed=6,
+            vocab_size=runner[0].vocab_size)
+        assert report["all_terminated"]
+        outs.append({r.request_id: (r.status, r.n_tokens) for r in records})
+    assert outs[0] == outs[1]
+
+
+# --------------------------------------------------- CPU e2e reconcile
+
+
+def test_e2e_report_reconciles_with_engine_counters(runner):
+    """The acceptance pin: the report's SLO-attainment counts equal the
+    engine's llm_slo_attainment_total (drained from the step clock into
+    a real LLMMetrics registry) and its shed count equals the engine's
+    shed counter — exactly."""
+    from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
+
+    tr = synthesize_agentverse_trace(tasks=2, seed=4, max_tokens=5)
+    eng = _engine(runner, seats=2, step_trace=1, max_queue=3)
+    records, report = replay_against_engine(
+        eng, tr, arrival="poisson", rate=60.0, seed=8,
+        vocab_size=runner[0].vocab_size)
+    assert report["all_terminated"]
+    # Overload at 60 req/s on 2 seats with a 3-deep queue must shed.
+    assert report["shed"] > 0
+    assert report["shed"] == eng.num_shed
+    assert report["completed"] + report["shed"] + report["errors"] \
+        + report["deadline"] == len(tr.nodes)
+
+    m = LLMMetrics()
+    m.observe_step_clock([eng.telemetry])
+    get = m.registry.get_sample_value
+    prom = {s: get("llm_slo_attainment_total",
+                   {"slo": "ttft", "status": s}) or 0
+            for s in ("met", "violated")}
+    rep_met = sum(c["ttft_met"] for c in report["slo"].values())
+    rep_total = sum(c["ttft_total"] for c in report["slo"].values())
+    assert int(prom["met"]) == rep_met
+    assert int(prom["met"] + prom["violated"]) == rep_total
+    assert rep_total > 0  # the pin is vacuous if nothing attained
+
+
+# ------------------------------------------------- loadgen exposition
+
+
+def test_loadgen_metrics_always_registered_and_served():
+    """The second exposition surface: every family present (zeroed) on a
+    scrape BEFORE the first request, served over HTTP on its own
+    (ephemeral) port."""
+    tr = synthesize_agentverse_trace(tasks=1, seed=0)
+    m = LoadgenMetrics.for_trace(tr)
+    exposition = MetricsExposition(m, port=0, host="127.0.0.1")
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{exposition.port}/metrics",
+                timeout=10) as resp:
+            payload = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+    finally:
+        exposition.close()
+    for fam in ("loadgen_offered_requests_total", "loadgen_requests_total",
+                "loadgen_ttft_seconds", "loadgen_itl_seconds",
+                "loadgen_e2e_seconds", "loadgen_schedule_lag_seconds",
+                "loadgen_slo_attainment_total", "loadgen_offered_rate",
+                "loadgen_achieved_rate", "loadgen_goodput_rate"):
+        assert fam in payload, fam
+    # pre-touched label combos render zeroed series per role/class
+    assert 'loadgen_slo_attainment_total{slo="ttft",slo_class="batch",' \
+           'status="met"} 0.0' in payload \
+           or 'slo_class="batch"' in payload
+
+
+# ------------------------------------------------------- vllm compat
+
+
+def _strip_volatile(payload: bytes) -> list:
+    return [ln for ln in payload.decode().splitlines()
+            if "_created" not in ln]
+
+
+def test_vllm_compat_default_off_byte_identical():
+    """Default 0: no vllm:* token anywhere, and the payload is
+    line-identical to a flagless LLMMetrics (modulo the per-instance
+    _created timestamps)."""
+    from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
+
+    off = LLMMetrics()
+    flagless = LLMMetrics(vllm_compat=False)
+    assert b"vllm:" not in off.render()
+    assert _strip_volatile(off.render()) == _strip_volatile(flagless.render())
+
+
+def test_vllm_compat_aliases_ride_llm_values():
+    """Compat on: the BASELINE-named families appear, carry the llm_*
+    values, and the llm_* payload itself is untouched."""
+    from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
+
+    on = LLMMetrics(vllm_compat=True)
+    on.record_request("success", 2.0, 0.3, 100, 40)
+    on.set_compat_stats(num_running=3, num_waiting=2, cache_usage=0.25)
+    off = LLMMetrics()
+    off.record_request("success", 2.0, 0.3, 100, 40)
+
+    payload = on.render()
+    get = on.registry.get_sample_value
+    assert get("vllm:prompt_tokens_total") == 100
+    assert get("vllm:generation_tokens_total") == 40
+    assert get("vllm:request_success_total") == 1
+    assert get("vllm:num_requests_running") == 3
+    assert get("vllm:num_requests_waiting") == 2
+    assert get("vllm:gpu_cache_usage_perc") == 0.25
+    assert get("vllm:time_to_first_token_seconds_sum") == \
+        get("llm_queue_wait_seconds_sum")
+    assert get("vllm:e2e_request_latency_seconds_count") == 1
+    assert b"vllm:time_per_output_token_seconds" in payload
+    # llm_* families byte-untouched by the aliases
+    on_llm = [ln for ln in _strip_volatile(payload)
+              if not ln.startswith("# HELP vllm:")
+              and not ln.startswith("# TYPE vllm:")
+              and not ln.startswith("vllm:")]
+    assert on_llm == _strip_volatile(off.render())
+
+
+def test_vllm_compat_server_scrape(runner):
+    """End to end through LLMServer.handle_metrics: compat on exposes
+    the vllm:* families with live scheduler gauges; compat off (same
+    engine) serves a vllm-free payload."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from agentic_traffic_testing_tpu.serving.config import ServerConfig
+    from agentic_traffic_testing_tpu.serving.server import LLMServer
+
+    async def scrape(compat):
+        cfg = ServerConfig(model=MODEL, dtype="float32", max_num_seqs=2,
+                           max_model_len=256, num_blocks=128, max_tokens=8,
+                           vllm_compat_metrics=compat)
+        srv = LLMServer(cfg, engine=_engine(runner, seats=2, max_len=256))
+        srv.async_engine.start()
+        try:
+            app = srv.make_app(manage_engine=False)
+            async with TestClient(TestServer(app)) as client:
+                resp = await client.get("/metrics")
+                assert resp.status == 200
+                return await resp.text()
+        finally:
+            srv.async_engine.shutdown()
+
+    on = asyncio.run(scrape(1))
+    off = asyncio.run(scrape(0))
+    assert "vllm:" not in off
+    for fam in ("vllm:time_to_first_token_seconds",
+                "vllm:num_requests_running", "vllm:num_requests_waiting",
+                "vllm:generation_tokens_total", "vllm:prompt_tokens_total",
+                "vllm:gpu_cache_usage_perc", "vllm:request_success_total"):
+        assert fam in on, fam
+    assert "llm_requests_total" in on and "llm_requests_total" in off
+
+
+def test_vllm_compat_env_validation(monkeypatch):
+    from agentic_traffic_testing_tpu.serving.config import ServerConfig
+
+    monkeypatch.setenv("LLM_VLLM_COMPAT_METRICS", "1")
+    assert ServerConfig.from_env().vllm_compat_metrics == 1
+    monkeypatch.setenv("LLM_VLLM_COMPAT_METRICS", "2")
+    with pytest.raises(ValueError, match="LLM_VLLM_COMPAT_METRICS"):
+        ServerConfig.from_env()
+
+
+# ----------------------------------------------------- HTTP target
+
+
+def test_http_target_replays_against_live_server(runner):
+    """The HTTP replay path end to end: the trace replays over SSE
+    against a live (in-process) server, client-observed TTFT recorded,
+    SLO body overrides delivered (visible as llm_slo_attainment series
+    once the step clock is on)."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from agentic_traffic_testing_tpu.loadgen.replay import HTTPTarget
+    from agentic_traffic_testing_tpu.serving.config import ServerConfig
+    from agentic_traffic_testing_tpu.serving.server import LLMServer
+
+    tr = synthesize_agentverse_trace(tasks=1, seed=6, max_tokens=4)
+    plan = build_replay_plan(tr, arrival="deterministic", rate=40.0)
+    texts = materialize_texts(tr, seed=6)
+
+    cfg = ServerConfig(model=MODEL, dtype="float32", max_num_seqs=4,
+                       max_model_len=512, num_blocks=256, max_tokens=8,
+                       step_trace=1)
+    srv = LLMServer(cfg, engine=_engine(runner, step_trace=1))
+    srv.async_engine.start()
+
+    async def go():
+        app = srv.make_app(manage_engine=False)
+        async with TestClient(TestServer(app)) as client:
+            target = HTTPTarget(str(client.make_url("/chat")), texts,
+                                session=client.session)
+            records = await run_open_loop(plan, tr, target)
+            resp = await client.get("/metrics")
+            return records, await resp.text()
+
+    try:
+        records, scrape = asyncio.run(go())
+    finally:
+        srv.async_engine.shutdown()
+    assert len(records) == len(tr.nodes)
+    assert all(r.status == "ok" for r in records), [
+        (r.request_id, r.status, r.error) for r in records]
+    assert all(r.ttft_s is not None and r.ttft_s > 0 for r in records)
+    assert all(r.n_tokens > 0 for r in records)
+    # the SLO body overrides reached the engine's telemetry plane
+    assert 'llm_slo_attainment_total{slo="ttft"' in scrape
+
+
+# --------------------------------------------------------- recorder
+
+
+def test_trace_recorder_roundtrip(tmp_path):
+    """Recorder → trace → replay plan: the captured schema replays like
+    a synthesized one, with per-session parent chaining."""
+    rec = TraceRecorder(name="live")
+    rec.record_call(request_id="a", session_id="t1", role="agent_a",
+                    stage="root", prompt_chars=400, max_tokens=32, t=100.0)
+    rec.record_call(request_id="b", session_id="t1", role="agent_b",
+                    stage="subtask", prompt_chars=80, max_tokens=16,
+                    t=100.5)
+    rec.record_call(request_id="c", session_id="t2", role="agent_a",
+                    stage="root", prompt_tokens=64, t=101.0)
+    tr = rec.to_trace()
+    assert len(tr.nodes) == 3
+    by_id = {n.request_id: n for n in tr.nodes}
+    assert by_id["a"].arrival_offset_s == 0.0
+    assert by_id["b"].arrival_offset_s == 0.5
+    assert by_id["b"].parents == ("a",)     # same session chains
+    assert by_id["c"].parents == ()         # new session starts fresh
+    assert by_id["a"].prompt_tokens == 100  # ~4 chars/token estimate
+    assert by_id["c"].prompt_tokens == 64   # explicit token count wins
+    assert by_id["b"].stage == "execute"    # unknown stage coerced
+    path = str(tmp_path / "rec.json")
+    tr.save(path)
+    plan = build_replay_plan(Trace.load(path), arrival="trace")
+    assert [s.node.request_id for s in plan] == ["a", "b", "c"]
+    assert [s.fire_at_s for s in plan] == [0.0, 0.5, 1.0]
+
+
+def test_trace_recorder_dedups_reused_request_ids():
+    """Caller-supplied ids can repeat (client retries reuse
+    X-Request-ID); the recorder dedups at record time so the atexit
+    flush can never throw away the whole capture on a duplicate."""
+    rec = TraceRecorder()
+    for t in (1.0, 2.0, 3.0):
+        rec.record_call(request_id="dup", session_id="t", role="agent_a",
+                        prompt_chars=8, t=t)
+    tr = rec.to_trace()  # must not raise
+    assert [n.request_id for n in tr.nodes] == ["dup", "dup#2", "dup#3"]
+    assert tr.nodes[2].parents == ("dup#2",)  # chaining uses deduped ids
+
+
+def test_llm_client_recorder_hook(tmp_path, monkeypatch):
+    """The opt-in llm_client wiring: off = no recorder object; on = one
+    process-global recorder keyed by the env path."""
+    from agentic_traffic_testing_tpu.agents.common import llm_client
+
+    monkeypatch.delenv("LOADGEN_RECORD_TRACE", raising=False)
+    monkeypatch.setattr(llm_client, "_trace_recorder", None)
+    assert llm_client.trace_recorder() is None
+    path = str(tmp_path / "live.json")
+    monkeypatch.setenv("LOADGEN_RECORD_TRACE", path)
+    rec = llm_client.trace_recorder()
+    assert rec is not None
+    assert llm_client.trace_recorder() is rec  # one global instance
+    rec.record_call(request_id="x", session_id="t", role="agent_a",
+                    prompt_chars=40)
+    assert len(rec) == 1

@@ -394,6 +394,42 @@ def test_chaos_ab_smoke(monkeypatch):
     assert churn["migrations"].get("scale_down:adopted", 0) >= 1
 
 
+# ------------------------------------------------ loadgen λ-sweep soak
+
+
+def test_loadgen_soak_smoke(monkeypatch):
+    """scripts/dev/loadgen_soak.py end-to-end on the tiny model (the
+    ISSUE-15 acceptance smoke): the synthesized AgentVerse DAG trace
+    replays open-loop at >= 2 arrival rates against an in-process
+    engine, clean and under dispatch chaos — every request terminates,
+    the report's SLO-attainment and shed counts reconcile EXACTLY with
+    the engine's Prometheus counters, fault injection never improves
+    attainment, and the loadgen's own exposition surface serves every
+    family on its own port (in-process for the warm jax/conftest CPU
+    config, like chaos_ab)."""
+    monkeypatch.setenv("SOAK_MODEL", "tiny")
+    monkeypatch.setenv("SOAK_RATES", "6,12")
+    soak = load_script("scripts/dev/loadgen_soak.py", "loadgen_soak")
+    results = soak.main(["1", "5"])
+    runs = [r for r in results if r.get("mode") in ("clean", "chaos")]
+    (sweep,) = [r for r in results if r.get("mode") == "sweep"]
+    assert [(r["mode"], r["rate"]) for r in runs] == [
+        ("clean", 6.0), ("chaos", 6.0), ("clean", 12.0), ("chaos", 12.0)]
+    for r in runs:
+        assert r["all_terminated"] is True
+        assert r["counters_reconcile"] is True
+        assert r["attainment_delta_ok"] is True
+        assert r["requests"] == 13  # 1 task under the template shape
+    for r in runs:
+        if r["mode"] == "chaos":
+            assert r["errors"] >= 1 and r["dispatch_failures"] >= 1
+        else:
+            assert r["completed"] == r["requests"]
+    assert sweep["rates"] == [6.0, 12.0]
+    assert sweep["port_scraped"] is True
+    assert sweep["families_present"] is True
+
+
 # ------------------------------------------------ step-clock timeline dump
 
 
