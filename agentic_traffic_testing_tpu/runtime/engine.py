@@ -221,6 +221,15 @@ class EngineConfig:
     # rejection rollback leaves no draft bytes behind, so the plain-decode
     # checkpoint rule covers the speculative stream unchanged.)
     migration: int = 0
+    # Disaggregated serving role (round 16 — serving/replica_pool.py pool
+    # roles): "" / "mixed" (default) serve both phases exactly as before;
+    # "prefill" checkpoints every stream right after its first sampled
+    # token (trigger="disagg", requires migration=1) so the pool resumes
+    # decode on a decode/mixed replica through the byte-identical
+    # migration plane; "decode" admits its wait queue by SLO class
+    # (tightest slo_ttft_ms first) instead of FCFS. Host-side only —
+    # compiled programs are untouched for every value.
+    disagg_role: str = ""
     # Content-addressed reuse of full prompt blocks (vLLM automatic-prefix-
     # caching analog); cached requests prefill only their suffix.
     prefix_caching: bool = False
@@ -344,6 +353,14 @@ class EngineConfig:
         if self.migration not in (0, 1):
             raise ValueError(
                 f"migration must be 0 or 1, got {self.migration}")
+        if self.disagg_role not in ("", "mixed", "prefill", "decode"):
+            raise ValueError(
+                f"disagg_role must be '', mixed, prefill or decode, got "
+                f"{self.disagg_role!r}")
+        if self.disagg_role == "prefill" and not self.migration:
+            raise ValueError(
+                "disagg_role='prefill' requires migration=1 — the "
+                "first-token handoff rides the checkpoint/adopt plane")
         if self.step_trace < 0:
             raise ValueError(
                 f"step_trace must be >= 0, got {self.step_trace}")
@@ -425,6 +442,7 @@ class EngineConfig:
             prefill_chunk_tokens=self.prefill_chunk_tokens or None,
             hybrid_token_budget=self.hybrid_token_budget,
             max_queue=self.max_queue,
+            slo_class_admission=(self.disagg_role == "decode"),
             **({"prefill_batch_max_len": self.prefill_batch_max_len}
                if self.prefill_batch_max_len is not None else {}),
         )
@@ -1118,6 +1136,8 @@ class LLMEngine:
             if admission_possible:
                 self._plan_and_dispatch()
                 self._harvest(max_inflight=self.cfg.pipeline_depth)
+                if self.cfg.disagg_role == "prefill":
+                    self._disagg_handoff()
                 return self._flush_events()
             # Released but still unadmittable (pool too small for the next
             # head): fall through to the drain path below.
@@ -1136,6 +1156,8 @@ class LLMEngine:
             self._dispatch_decode()
 
         self._harvest(max_inflight=self.cfg.pipeline_depth)
+        if self.cfg.disagg_role == "prefill":
+            self._disagg_handoff()
         return self._flush_events()
 
     def _admission_possible(self) -> bool:
@@ -1729,6 +1751,22 @@ class LLMEngine:
             if not r.is_finished():
                 self._fail_request(r, f"migration failed: {exc}{note}")
             return False
+
+    # statics: thread(engine-loop)
+    def _disagg_handoff(self) -> None:
+        """Prefill-role step hook (disagg_role='prefill'): every stream
+        whose first token has been sampled checkpoints with
+        trigger='disagg' so the pool resumes its decode on a decode/mixed
+        replica — TTFT is stamped on this replica, the decode tail
+        belongs to the adopter. A stream that finished during the
+        checkpoint drain (EOS mid-batch) flushes its ordinary terminal
+        instead, and a failed checkpoint degrades to the round-9 kill
+        path inside _checkpoint_or_fail — never a hang."""
+        live = [r for r in self._requests.values()
+                if not r.is_finished() and not r.is_prefilling
+                and r.sampling_step > 0]
+        for r in live:
+            self._checkpoint_or_fail(r, "disagg")
 
     # statics: thread(engine-loop)
     def drain_for_migration(self, trigger: str, count: Optional[int] = None,

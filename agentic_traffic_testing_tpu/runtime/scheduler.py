@@ -168,7 +168,7 @@ class MigrationPlan:
     # (the PR-8 spurious-429 fix) — a migrated terminal must not report
     # depth 0.
     depth_at_enqueue: int = 0
-    trigger: str = "drain"   # quarantine | rebalance | scale_down | drain
+    trigger: str = "drain"   # quarantine | rebalance | scale_down | drain | disagg
     source_replica: int = -1
     created_t: float = 0.0   # checkpoint instant (migration-duration metric)
     # Total checkpoints this stream has been through (survives
@@ -204,6 +204,13 @@ class SchedulerConfig:
     # as before the knob existed. Preemption re-queues bypass the bound
     # (appendleft in _preempt): shedding applies to NEW work only.
     max_queue: int = 0
+    # SLO-class admission (round 16 — decode-role replicas in a
+    # disaggregated pool): add_request inserts by SLO class — tightest
+    # slo_ttft_ms first, unclassed (None) requests last, FIFO within a
+    # class — instead of plain FCFS, so an adopted tight-SLO stream never
+    # queues behind a batch of best-effort work. False (default) keeps
+    # admission order byte-identical to plain append.
+    slo_class_admission: bool = False
     # Multi-request prefill batches only form for buckets up to this length.
     # Longer prompts prefill solo: a (batch, long-bucket) combination is a
     # fresh XLA compile (~tens of seconds) that a burst of concurrent
@@ -291,8 +298,27 @@ class Scheduler:
             )
         req.state = RequestState.WAITING
         req.depth_at_enqueue = len(self.waiting)
-        self.waiting.append(req)
+        if self.cfg.slo_class_admission:
+            self._insert_by_slo_class(req)
+        else:
+            self.waiting.append(req)
         self.composition_epoch += 1
+
+    @staticmethod
+    def _slo_class(req: Request) -> float:
+        slo = getattr(req.sampling, "slo_ttft_ms", None)
+        return slo if slo is not None else float("inf")
+
+    def _insert_by_slo_class(self, req: Request) -> None:
+        """Decode-role admission order: tightest TTFT-SLO class first,
+        FIFO within a class (stable — scan from the tail for the last
+        entry whose class is <= ours)."""
+        cls = self._slo_class(req)
+        for i in range(len(self.waiting), 0, -1):
+            if self._slo_class(self.waiting[i - 1]) <= cls:
+                self.waiting.insert(i, req)
+                return
+        self.waiting.appendleft(req)
 
     def composition_stable(self, epoch: int) -> bool:
         """True when no membership change has happened since `epoch` was

@@ -80,6 +80,24 @@ def decide(sig: AutoscaleSignals, pol: AutoscalePolicy) -> int:
     return max(pol.min_replicas, min(pol.max_replicas, target))
 
 
+def decide_role_targets(role_sigs: dict, pol: AutoscalePolicy) -> dict:
+    """Per-role scale targets for a disaggregated pool (round 16): apply
+    the SAME boring policy independently to each role's signal window —
+    a prefill backlog (long-prompt burst) grows the prefill tier without
+    touching decode capacity, and an idle decode tier shrinks while
+    prefill churns. `role_sigs` maps role -> AutoscaleSignals scoped to
+    that role's replicas; each role keeps at least one replica (a tier
+    scaled to zero would wedge its phase — the pool-level bounds still
+    cap the TOTAL, enforced by the caller). Pure, like decide()."""
+    targets: dict = {}
+    for role, sig in role_sigs.items():
+        role_pol = dataclasses.replace(
+            pol, min_replicas=max(1, min(pol.min_replicas, sig.current)),
+            max_replicas=max(1, pol.max_replicas))
+        targets[role] = decide(sig, role_pol)
+    return targets
+
+
 class AutoscaleController:
     """Async decision loop over a live EnginePool.
 
@@ -122,11 +140,47 @@ class AutoscaleController:
             met_delta=met_d, violated_delta=vio_d,
             idle_ticks=self._idle_ticks)
 
+    def role_snapshot(self) -> dict:
+        """Per-role AutoscaleSignals for a disaggregated pool — the
+        decide_role_targets input (empty dict when the pool has no roles,
+        so role logic never runs on a plain pool). SLO deltas stay pooled
+        (verdicts are not labeled per replica); queue/running split by
+        role, which is the signal that distinguishes a prefill backlog
+        from a decode one."""
+        roles = getattr(self.pool, "roles", None)
+        if not roles or not getattr(self.pool, "roles_active", False):
+            return {}
+        sigs: dict = {}
+        for role in sorted(set(roles)):
+            waiting = running = n = 0
+            for i, e in enumerate(self.pool.engines):
+                if roles[i] != role:
+                    continue
+                n += 1
+                s = e.load_snapshot()
+                waiting += s["num_waiting"]
+                running += s["num_running"]
+            sigs[role] = AutoscaleSignals(
+                current=n, waiting=waiting, running=running,
+                met_delta=0, violated_delta=0, idle_ticks=self._idle_ticks)
+        return sigs
+
     async def tick(self) -> Optional[int]:
         """One decision + (maybe) one scale step. Returns the new size
         when a scale happened, None otherwise."""
         self.decisions += 1
         sig = self.snapshot()
+        role_sigs = self.role_snapshot()
+        if role_sigs:
+            # Disaggregated pools (round 16): log the per-role pressure so
+            # the operator sees WHICH tier wants capacity. Execution still
+            # rides the pool-level step below (scale_to grows mixed
+            # replicas, which serve either phase).
+            targets = decide_role_targets(role_sigs, self.policy)
+            if any(t != role_sigs[r].current for r, t in targets.items()):
+                log.info("autoscale role pressure: %s -> %s",
+                         {r: s.current for r, s in role_sigs.items()},
+                         targets)
         target = decide(sig, self.policy)
         if target == sig.current:
             return None

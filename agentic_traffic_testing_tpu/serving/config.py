@@ -152,6 +152,16 @@ class ServerConfig:
     pool_min_replicas: int = 1                 # LLM_POOL_MIN_REPLICAS
     # 0 = the boot LLM_NUM_REPLICAS value is also the ceiling.
     pool_max_replicas: int = 0                 # LLM_POOL_MAX_REPLICAS
+    # Disaggregated prefill/decode serving (round 16): comma list of
+    # per-replica roles, e.g. "prefill,decode" — one of prefill | decode
+    # | mixed per boot replica. A prefill replica runs new requests to
+    # first-token then hands the stream's KV to a decode/mixed replica
+    # through the migration plane (trigger="disagg", byte-identical
+    # resume); decode replicas admit by SLO class. Requires
+    # LLM_MIGRATION=1 and at least one decode/mixed replica whenever a
+    # prefill replica exists. Empty (default) = every replica "mixed",
+    # keeping all existing paths and the /metrics payload byte-identical.
+    pool_roles: str = ""                       # LLM_POOL_ROLES
     prefix_caching: bool = False               # LLM_PREFIX_CACHING
     # Host-RAM second tier for the prefix cache (runtime/kv_offload.py):
     # GB of host memory for evicted prefix blocks; restored device-side on
@@ -206,6 +216,13 @@ class ServerConfig:
     # histories cap the per-dispatch host scan with it.
     spec_lookup_window: int = 0                # LLM_SPEC_LOOKUP_WINDOW
 
+    def parsed_pool_roles(self) -> Optional[tuple[str, ...]]:
+        """The per-replica role tuple from LLM_POOL_ROLES, or None when
+        the knob is unset (all-mixed pool, legacy paths untouched)."""
+        if not self.pool_roles:
+            return None
+        return tuple(r.strip() for r in self.pool_roles.split(","))
+
     def _validate_elastic(self) -> None:
         """Round-11 elastic-serving knob coherence — shared by the env
         and CLI paths (the CLI can repair or break an env-only combo)."""
@@ -244,6 +261,28 @@ class ServerConfig:
                 f"({self.pool_min_replicas}) <= LLM_NUM_REPLICAS "
                 f"({self.num_replicas}) <= LLM_POOL_MAX_REPLICAS "
                 f"({max_n})")
+        roles = self.parsed_pool_roles()
+        if roles is not None:
+            bad = [r for r in roles if r not in ("prefill", "decode", "mixed")]
+            if bad:
+                raise ValueError(
+                    f"LLM_POOL_ROLES entries must be prefill | decode | "
+                    f"mixed, got {bad} (unset it for an all-mixed pool)")
+            if len(roles) != self.num_replicas:
+                raise ValueError(
+                    f"LLM_POOL_ROLES names {len(roles)} role(s) but "
+                    f"LLM_NUM_REPLICAS is {self.num_replicas} — one role "
+                    f"per boot replica")
+            if not self.migration:
+                raise ValueError(
+                    "LLM_POOL_ROLES requires LLM_MIGRATION=1 — the "
+                    "prefill->decode KV handoff rides the migration plane")
+            if "prefill" in roles and not any(
+                    r in ("decode", "mixed") for r in roles):
+                raise ValueError(
+                    "LLM_POOL_ROLES has prefill replicas but no decode/"
+                    "mixed replica to adopt their streams — handoff would "
+                    "wedge every request")
 
     @classmethod
     def from_env(cls) -> "ServerConfig":
@@ -353,6 +392,7 @@ class ServerConfig:
             os.environ.get("LLM_POOL_MIN_REPLICAS") or c.pool_min_replicas)
         c.pool_max_replicas = int(
             os.environ.get("LLM_POOL_MAX_REPLICAS") or c.pool_max_replicas)
+        c.pool_roles = os.environ.get("LLM_POOL_ROLES") or c.pool_roles
         c._validate_elastic()
         c.prefix_caching = _env_bool("LLM_PREFIX_CACHING", "0")
         c.host_cache_gb = float(
@@ -468,6 +508,10 @@ class ServerConfig:
                        default=c.pool_max_replicas,
                        help="autoscale ceiling (0 = the boot "
                             "--num-replicas value)")
+        p.add_argument("--pool-roles", default=c.pool_roles,
+                       help="comma list of per-replica roles for "
+                            "disaggregated serving: prefill | decode | "
+                            "mixed (empty = all mixed; needs --migration 1)")
         p.add_argument("--enable-prefix-caching", dest="prefix_caching",
                        action="store_true", default=c.prefix_caching)
         p.add_argument("--host-cache-gb", type=float, default=c.host_cache_gb,
@@ -508,7 +552,7 @@ class ServerConfig:
                   "slo_itl_ms", "max_queue", "deadline_ms",
                   "fault_spec", "fault_seed", "migration",
                   "pool_autoscale", "pool_min_replicas",
-                  "pool_max_replicas", "prefix_caching",
+                  "pool_max_replicas", "pool_roles", "prefix_caching",
                   "host_cache_gb", "hybrid_token_budget",
                   "kv_cache_dtype", "fused_kv_write",
                   "num_blocks", "block_size", "weights_path",

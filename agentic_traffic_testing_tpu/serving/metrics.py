@@ -43,8 +43,10 @@ class LLMMetrics:
 
     def __init__(self, prefix: str = "llm", include_tokens: bool = True,
                  num_replicas: int = 1, host_cache: bool = False,
-                 vllm_compat: bool = False) -> None:
+                 vllm_compat: bool = False,
+                 pool_roles: Optional[tuple] = None) -> None:
         self.include_tokens = include_tokens
+        self.pool_roles = tuple(pool_roles) if pool_roles else None
         r = self.registry = CollectorRegistry()
         self.requests_total = Counter(
             f"{prefix}_requests_total", "Total LLM requests", ["status"], registry=r)
@@ -384,6 +386,25 @@ class LLMMetrics:
                 f"{prefix}_migration_duration_seconds",
                 "Checkpoint -> adoption handoff wall time per migrated "
                 "stream", buckets=STEP_BUCKETS, registry=r)
+        # Disaggregated serving families (round 16, LLM_POOL_ROLES):
+        # registered ONLY when the pool has roles — with the knob unset
+        # the /metrics payload stays byte-identical to the role-less pool
+        # (pinned by tests/test_disagg.py).
+        self.pool_role_replicas = None
+        self.role_overflow = None
+        if self.pool_roles is not None:
+            self.pool_role_replicas = Gauge(
+                f"{prefix}_pool_role_replicas",
+                "Live replica count per disaggregated-serving role "
+                "(LLM_POOL_ROLES: prefill replicas run prompts to first "
+                "token and hand off, decode replicas adopt the streams, "
+                "mixed serve both phases)", ["role"], registry=r)
+            self.role_overflow = Gauge(
+                f"{prefix}_role_overflow_total",
+                "Routing decisions that needed a role with zero eligible "
+                "replicas and overflowed loudly to the full eligible set "
+                "(cumulative, by the role that was missing)",
+                ["role"], registry=r)
         # Pre-touch every label combination so a scrape shows zeroed
         # series (deterministic payload) instead of families appearing
         # only after first traffic.
@@ -413,6 +434,20 @@ class LLMMetrics:
             for trigger in MIGRATION_TRIGGERS:
                 for status in ("adopted", "failed"):
                     self.migrations.labels(trigger=trigger, status=status)
+        if self.pool_roles is not None:
+            # Role-gated pre-touches: the disagg trigger joins the
+            # migration matrix, the role families render every role, and
+            # the no-eligible-replica shed escape hatch gets its zeroed
+            # series — none of which may appear with LLM_POOL_ROLES unset
+            # (the byte-identity contract above).
+            if self.migrations is not None:
+                for status in ("adopted", "failed"):
+                    self.migrations.labels(trigger="disagg", status=status)
+            for role in ("prefill", "decode", "mixed"):
+                self.pool_role_replicas.labels(role=role)
+            for role in ("prefill", "decode"):
+                self.role_overflow.labels(role=role)
+            self.requests_shed.labels(reason="no_eligible_replica")
         # vLLM dashboard parity (round 15, LLM_VLLM_COMPAT_METRICS): an
         # opt-in alias family re-emitting the llm_* values under the
         # BASELINE-named vllm:* families at render time — ONE collection
@@ -573,6 +608,17 @@ class LLMMetrics:
             self.migrations.labels(trigger=trigger, status=status).set(count)
         for d in durations:
             self.migration_duration.observe(d)
+
+    def set_role_stats(self, *, role_counts: dict,
+                       overflows: dict) -> None:
+        """Refresh the disaggregated-serving families from EnginePool
+        state (called on scrape; no-op unless the pool has roles)."""
+        if self.pool_role_replicas is None:
+            return
+        for role, count in role_counts.items():
+            self.pool_role_replicas.labels(role=role).set(count)
+        for role, count in overflows.items():
+            self.role_overflow.labels(role=role).set(count)
 
     def set_replica_health(self, states: list) -> None:
         """Refresh llm_replica_health from EnginePool health states

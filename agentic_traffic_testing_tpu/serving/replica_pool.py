@@ -59,6 +59,24 @@ QUARANTINED = "quarantined"
 #: migration-trigger label values (llm_migrations_total{trigger}).
 MIGRATION_TRIGGERS = ("quarantine", "rebalance", "scale_down", "drain")
 
+#: disaggregated-serving trigger (round 16): a prefill-role replica hands
+#: a first-tokened stream to a decode/mixed replica. Kept OUT of
+#: MIGRATION_TRIGGERS so the metrics pre-touch (and with it the /metrics
+#: payload) is byte-identical whenever LLM_POOL_ROLES is unset.
+DISAGG_TRIGGER = "disagg"
+
+#: replica roles for disaggregated serving (LLM_POOL_ROLES).
+POOL_ROLES = ("prefill", "decode", "mixed")
+
+
+def _engine_role(engine) -> str:
+    """A replica's serving role, read off its engine config ('' and
+    engines without a cfg — router-test stubs — are 'mixed')."""
+    cfg = getattr(engine, "cfg", None)
+    if cfg is None:
+        return "mixed"
+    return getattr(cfg, "disagg_role", "") or "mixed"
+
 #: a stream that keeps landing on failing replicas re-checkpoints each
 #: time; past this many hops the pool stops migrating and surfaces a
 #: structured ERROR instead (an unbounded ping-pong under a pool-wide
@@ -263,11 +281,30 @@ class EnginePool:
                  on_step: Optional[Callable[[int], None]] = None,
                  devices: Optional[list] = None,
                  fault_spec: str = "", fault_seed: int = 0,
-                 health_params: Optional[dict] = None) -> None:
+                 health_params: Optional[dict] = None,
+                 roles: Optional[List[str]] = None) -> None:
         self.engines = list(engines)
         self.policy = policy
         self.router = make_router(policy, self.engines)
         self.devices = devices or [None] * len(self.engines)
+        # Disaggregated-serving roles (round 16): one of POOL_ROLES per
+        # replica, derived from each engine's cfg.disagg_role unless
+        # passed explicitly (stub engines). All-mixed (the LLM_POOL_ROLES-
+        # unset shape) keeps every routing path byte-identical.
+        self.roles = (list(roles) if roles is not None
+                      else [_engine_role(e) for e in self.engines])
+        if len(self.roles) != len(self.engines):
+            raise ValueError(
+                f"{len(self.roles)} role(s) for {len(self.engines)} "
+                f"replica(s) — one role per replica")
+        bad = [r for r in self.roles if r not in POOL_ROLES]
+        if bad:
+            raise ValueError(f"unknown replica role(s) {bad}; "
+                             f"supported: {POOL_ROLES}")
+        # Role-overflow accounting (llm_role_overflow_total{role}): a
+        # routing decision that needed a role with zero eligible replicas
+        # and loudly fell back to the full eligible set.
+        self.role_overflows: dict = {}
         # Routing decisions per replica (exported as the per-replica
         # labeled series; plain int increments under the GIL).
         self.routed_requests = [0] * len(self.engines)
@@ -376,19 +413,54 @@ class EnginePool:
         now = time.monotonic()
         return sum(1 for h in self.health if h.probe(now))
 
+    @property
+    def roles_active(self) -> bool:
+        """Any non-mixed replica exists (LLM_POOL_ROLES set). False keeps
+        every routing path byte-identical to the pre-role pool."""
+        return any(r != "mixed" for r in self.roles)
+
+    # statics: thread(handler)
+    def _role_filter(self, cands: list[int],
+                     wanted: tuple[str, ...]) -> list[int]:
+        """Indices in `cands` whose role is in `wanted`. A role-restricted
+        pool with ZERO qualifying replicas overflows LOUDLY to the full
+        candidate set (counted in role_overflows, surfaced as
+        llm_role_overflow_total{role}) instead of wedging admission —
+        degraded phase separation beats refusing the pool."""
+        kept = [i for i in cands if self.roles[i] in wanted]
+        if kept or not cands:
+            return kept or cands
+        role = wanted[0]
+        self.role_overflows[role] = self.role_overflows.get(role, 0) + 1
+        log.warning("no eligible %s replica; overflowing to the full "
+                    "eligible set %s", role, cands)
+        return cands
+
     # statics: thread(handler)
     def route(self, prompt_ids: list[int],
-              request_id: Optional[str] = None) -> int:
+              request_id: Optional[str] = None,
+              sampling: Optional[SamplingParams] = None) -> int:
+        eligible = self.eligible_replicas()
+        if self.roles_active:
+            # New requests start with a prefill: decode-role replicas
+            # only take adopted streams, so route fresh work onto
+            # prefill/mixed replicas (loud overflow when none qualify).
+            eligible = self._role_filter(eligible, ("prefill", "mixed"))
         idx = self.router.select(prompt_ids, request_id,
-                                 eligible=self.eligible_replicas())
+                                 eligible=eligible, sampling=sampling)
         self.routed_requests[idx] += 1
         return idx
 
     # statics: thread(handler)
-    def _alternate(self, tried: list[int]) -> Optional[int]:
+    def _alternate(self, tried: list[int],
+                   prefer: Optional[tuple[str, ...]] = None) -> Optional[int]:
         """Least-loaded eligible replica outside `tried` (the retry-once
-        target), or None when no alternate exists."""
+        target), or None when no alternate exists. `prefer` restricts to
+        the named roles first (the disagg adoption shape: decode/mixed
+        replicas take the stream), overflowing loudly when none qualify."""
         cands = [i for i in self.eligible_replicas() if i not in tried]
+        if cands and prefer is not None and self.roles_active:
+            cands = self._role_filter(cands, prefer)
         if not cands:
             return None
         def _load(i: int) -> tuple:
@@ -405,7 +477,7 @@ class EnginePool:
     def add_request(self, prompt_ids: list[int],
                     sampling: Optional[SamplingParams] = None,
                     request_id: Optional[str] = None) -> Request:
-        idx = self.route(prompt_ids, request_id)
+        idx = self.route(prompt_ids, request_id, sampling=sampling)
         return self.engines[idx].add_request(prompt_ids, sampling,
                                              request_id=request_id)
 
@@ -491,7 +563,7 @@ class EnginePool:
         streams now MOVE where round 9 could only kill them. No survivor
         (or a stream past MAX_STREAM_MIGRATIONS hops) degrades to the
         round-9 structured ERROR terminal."""
-        idx = self.route(prompt_ids, request_id)
+        idx = self.route(prompt_ids, request_id, sampling=sampling)
         tried = [idx]
         emitted = False
         source = self._async[idx].generate(prompt_ids, sampling, request_id)
@@ -594,7 +666,13 @@ class EnginePool:
         plan = req.migration
         target = None
         if plan is not None and plan.hops <= MAX_STREAM_MIGRATIONS:
-            target = self._alternate([source])
+            # A disagg handoff prefers decode/mixed adopters — landing on
+            # another prefill replica would just re-checkpoint the stream
+            # next step (the hop bound still terminates that ping-pong if
+            # the overflow path ever takes it there).
+            prefer = (("decode", "mixed")
+                      if plan.trigger == DISAGG_TRIGGER else None)
+            target = self._alternate([source], prefer=prefer)
         if target is None:
             trig = plan.trigger if plan is not None else "drain"
             self._record_migration(trig, "failed")
@@ -791,6 +869,7 @@ class EnginePool:
         # len(engines), so the counter slot must exist before the index.
         self.routed_requests.append(0)
         self.engines.append(engine)
+        self.roles.append(_engine_role(engine))
         self.health.append(h)
         self._async.append(a)
         self.devices.append(dev)
@@ -804,10 +883,19 @@ class EnginePool:
     # statics: thread(handler)
     def _pop_replica(self, idx: int) -> None:
         self.engines.pop(idx)
+        self.roles.pop(idx)
         self.health.pop(idx)
         self._async.pop(idx)
         self.devices.pop(idx)
         self.routed_requests.pop(idx)
+
+    # statics: thread(scrape)
+    def role_counts(self) -> dict:
+        """Replica count per role (llm_pool_role_replicas{role})."""
+        counts = {r: 0 for r in POOL_ROLES}
+        for r in self.roles:
+            counts[r] += 1
+        return counts
 
     # -- aggregation (metrics layer) ---------------------------------------
 

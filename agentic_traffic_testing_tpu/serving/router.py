@@ -10,7 +10,7 @@ possible case: the orchestrator fans out workers that all quote the same
 ~512-token scenario prompt (PAPER.md workflow), so affinity routing turns
 N-1 of N sibling prefills into cache hits.
 
-Three policies, selected by `LLM_ROUTER_POLICY`:
+Four policies, selected by `LLM_ROUTER_POLICY`:
 
   round_robin     — strict rotation; the throughput-fair baseline.
   least_loaded    — lowest queue depth (waiting + running) wins; ties break
@@ -25,6 +25,11 @@ Three policies, selected by `LLM_ROUTER_POLICY`:
                     least-loaded unsaturated replica — bounded queue wait
                     beats a cache hit that would sit behind max_num_seqs
                     other requests.
+  phase_aware     — disaggregated pools (round 16): tight-SLO requests to
+                    the lowest projected queue wait (per-replica wait EWMA
+                    x depth), best-effort work rotates over unsaturated
+                    replicas; pairs with LLM_POOL_ROLES' prefill/mixed
+                    role filter.
 
 Every policy accepts an `eligible` replica-index subset (round 9): the
 EnginePool passes its health-filtered list so quarantined replicas are
@@ -43,7 +48,10 @@ from __future__ import annotations
 
 import hashlib
 import itertools
+import logging
 from typing import Optional, Sequence
+
+log = logging.getLogger("att_tpu.router")
 
 
 def prefix_route_key(prompt_ids: Sequence[int], block_size: int) -> bytes:
@@ -104,18 +112,25 @@ class Router:
     def _candidates(self, eligible) -> list[int]:
         """Replica indices a selection may consider. `eligible=None` (the
         default, and the poolless test path) means all; the pool passes
-        its health-filtered index list, which is never empty (it fails
-        open to all replicas when everyone is quarantined)."""
+        its health-filtered (and, under pool roles, role-filtered) index
+        list. An EMPTY eligible set overflows loudly to every replica
+        instead of raising (round 16): a role-restricted pool whose last
+        qualifying replica just quarantined must degrade to least-bad
+        placement, never wedge admission — the caller's shed policy is
+        the real overload valve."""
         if eligible is None:
             return list(range(len(self.engines)))
         cands = list(eligible)
         if not cands:
-            raise ValueError("select over an empty eligible set")
+            log.warning("select over an empty eligible set; overflowing "
+                        "to all %d replica(s)", len(self.engines))
+            return list(range(len(self.engines)))
         return cands
 
     def select(self, prompt_ids: Sequence[int],
                request_id: Optional[str] = None,
-               eligible: Optional[Sequence[int]] = None) -> int:
+               eligible: Optional[Sequence[int]] = None,
+               sampling=None) -> int:
         raise NotImplementedError
 
 
@@ -126,7 +141,8 @@ class RoundRobinRouter(Router):
         super().__init__(engines)
         self._counter = itertools.count()
 
-    def select(self, prompt_ids, request_id=None, eligible=None) -> int:
+    def select(self, prompt_ids, request_id=None, eligible=None,
+               sampling=None) -> int:
         # itertools.count.__next__ is a single C call — atomic under the
         # GIL, so concurrent handlers never double-assign a slot. With a
         # filtered eligible set the rotation walks the survivors (full
@@ -138,7 +154,8 @@ class RoundRobinRouter(Router):
 class LeastLoadedRouter(Router):
     name = "least_loaded"
 
-    def select(self, prompt_ids, request_id=None, eligible=None) -> int:
+    def select(self, prompt_ids, request_id=None, eligible=None,
+               sampling=None) -> int:
         return min(self._candidates(eligible), key=self._load)
 
 
@@ -155,7 +172,8 @@ class PrefixAffinityRouter(Router):
             return None
         return chain(prompt_ids)
 
-    def select(self, prompt_ids, request_id=None, eligible=None) -> int:
+    def select(self, prompt_ids, request_id=None, eligible=None,
+               sampling=None) -> int:
         cands = self._candidates(eligible)
         if len(cands) == 1:
             return cands[0]
@@ -184,9 +202,56 @@ class PrefixAffinityRouter(Router):
         return min(unsaturated, key=self._load)
 
 
+class PhaseAwareRouter(Router):
+    """Disaggregated-pool placement (round 16): route by SLO class and
+    per-replica queue-wait EWMA instead of global FCFS.
+
+    Tight-SLO requests (sampling.slo_ttft_ms set) go to the replica with
+    the lowest PROJECTED wait — its smoothed per-slot queue wait (fed via
+    `note_wait`, the server's EWMA shape) times its current queue depth,
+    load-tie-broken — so an interactive request never queues behind a
+    batch replica's backlog. Unclassed (best-effort) work rotates over
+    the unsaturated candidates, preserving the low-wait replicas' headroom
+    for the tight classes. With no wait observations yet the projection
+    degrades to plain least-loaded. The pool's role filter has already
+    restricted `eligible` to prefill/mixed replicas, so this policy is
+    the phase-aware half of disaggregated routing."""
+
+    name = "phase_aware"
+
+    def __init__(self, engines: Sequence) -> None:
+        super().__init__(engines)
+        self._wait_ewma: dict[int, float] = {}
+        self._counter = itertools.count()
+
+    def note_wait(self, i: int, wait_s: float, alpha: float = 0.2) -> None:
+        """Feed an observed per-slot queue wait for replica i (the server's
+        queue-wait EWMA, per replica)."""
+        prev = self._wait_ewma.get(i)
+        self._wait_ewma[i] = (wait_s if prev is None
+                              else (1 - alpha) * prev + alpha * wait_s)
+
+    def _projected_wait(self, i: int) -> tuple:
+        s = self.engines[i].load_snapshot()
+        per_slot = self._wait_ewma.get(i, 0.0)
+        return (per_slot * s["num_waiting"],
+                s["num_waiting"] + s["num_running"], i)
+
+    def select(self, prompt_ids, request_id=None, eligible=None,
+               sampling=None) -> int:
+        cands = self._candidates(eligible)
+        slo = getattr(sampling, "slo_ttft_ms", None)
+        if slo:
+            return min(cands, key=self._projected_wait)
+        unsaturated = [i for i in cands if not self._saturated(i)]
+        pool = unsaturated or cands
+        return pool[next(self._counter) % len(pool)]
+
+
 ROUTER_POLICIES = {
     r.name: r
-    for r in (RoundRobinRouter, LeastLoadedRouter, PrefixAffinityRouter)
+    for r in (RoundRobinRouter, LeastLoadedRouter, PrefixAffinityRouter,
+              PhaseAwareRouter)
 }
 
 

@@ -104,7 +104,8 @@ class LLMServer:
             LLMMetrics(cfg.metrics_prefix, cfg.metrics_include_tokens,
                        num_replicas=cfg.num_replicas,
                        host_cache=cfg.host_cache_gb > 0,
-                       vllm_compat=bool(cfg.vllm_compat_metrics))
+                       vllm_compat=bool(cfg.vllm_compat_metrics),
+                       pool_roles=cfg.parsed_pool_roles())
             if cfg.metrics_enabled else None
         )
         on_step = self.metrics.batch_size.observe if self.metrics else None
@@ -259,6 +260,7 @@ class LLMServer:
                 "LLM_HOST_CACHE_GB does not compose with tp/sp/pp meshes "
                 "yet — unset it or serve single-chip (optionally with "
                 "LLM_NUM_REPLICAS)")
+        pool_roles = c.parsed_pool_roles()
         ecfg = EngineConfig(
             model=c.model, dtype=c.dtype, max_num_seqs=c.max_num_seqs,
             max_num_batched_tokens=c.max_num_batched_tokens,
@@ -275,6 +277,12 @@ class LLMServer:
             max_queue=c.max_queue,
             deadline_ms=c.deadline_ms,
             migration=c.migration,
+            # Disaggregated serving (round 16): replica i takes the i-th
+            # LLM_POOL_ROLES entry; autoscale replicas grown past the boot
+            # list serve mixed (""), so elastic capacity is general.
+            disagg_role=(pool_roles[replica_idx]
+                         if pool_roles is not None
+                         and replica_idx < len(pool_roles) else ""),
             fault_spec=c.fault_spec,
             # Replicas must not fault in lockstep: each gets its own
             # deterministic stream (the pool's slow_replica wiring keys
@@ -596,8 +604,19 @@ class LLMServer:
                                   measures)
           * deadline_unattainable — 429: projected wait exceeds the
                                   request's whole deadline
+          * no_eligible_replica  — 503: a role-restricted pool (round 16,
+                                  LLM_POOL_ROLES) has NO prefill/mixed
+                                  replica at all, so no replica can run a
+                                  new request's prefill — the loud escape
+                                  hatch instead of wedging admission
         """
         c = self.cfg
+        if (self.pool is not None and self.pool.roles_active
+                and not any(r in ("prefill", "mixed")
+                            for r in self.pool.roles)):
+            return (503, "no_eligible_replica", 1,
+                    "no prefill/mixed replica can take new requests "
+                    "(LLM_POOL_ROLES names only decode replicas)")
         if c.max_queue > 0 and depth >= c.max_queue:
             proj = self._projected_wait_s(depth)
             retry = max(1, round(proj)) if proj else 1
@@ -687,6 +706,12 @@ class LLMServer:
             rs = self.pool.replica_stats()
             self.metrics.set_replica_stats(rs)
             self.metrics.set_replica_health([s["health"] for s in rs])
+            # Disaggregated-serving families (round 16): per-role replica
+            # counts + loud role-overflow totals. No-op (and no family)
+            # unless LLM_POOL_ROLES built the metrics with roles.
+            self.metrics.set_role_stats(
+                role_counts=self.pool.role_counts(),
+                overflows=self.pool.role_overflows)
         return web.Response(body=self.metrics.render(),
                             headers={"Content-Type": self.metrics.content_type})
 

@@ -84,7 +84,9 @@ KNOBS: tuple[Knob, ...] = (
          "Data-parallel replica count (serving/replica_pool.py); does not "
          "compose with tp/sp/pp."),
     Knob("LLM_ROUTER_POLICY", "enum", "round_robin", "serving/config.py",
-         "Replica router: round_robin | least_loaded | prefix_affinity."),
+         "Replica router: round_robin | least_loaded | prefix_affinity | "
+         "phase_aware (tight-SLO requests to the lowest projected "
+         "queue-wait via a per-replica EWMA; round 16)."),
     Knob("LLM_QUANTIZATION", "enum", "unset", "serving/config.py",
          "Weight-only quantization: int8 | int4 (models/quant.py)."),
     Knob("LLM_DECODE_STEPS", "int", "auto", "serving/config.py",
@@ -145,6 +147,14 @@ KNOBS: tuple[Knob, ...] = (
     Knob("LLM_POOL_MAX_REPLICAS", "int", "0", "serving/config.py",
          "Autoscale ceiling on the live replica count (0 = the boot "
          "LLM_NUM_REPLICAS value)."),
+    Knob("LLM_POOL_ROLES", "str", "unset", "serving/config.py",
+         "Disaggregated serving (round 16): comma list of per-replica "
+         "roles (prefill | decode | mixed), one per boot replica — "
+         "prefill replicas hand every stream's KV to a decode/mixed "
+         "replica after its first token (trigger=\"disagg\" on the "
+         "migration plane, token-identical). Needs LLM_MIGRATION=1 and "
+         "at least one decode/mixed replica per prefill replica set; "
+         "unset = every replica mixed, byte-identical serving paths."),
     Knob("LLM_CONCURRENCY_CHECK", "bool", "0", "runtime/concurrency.py",
          "1 installs runtime thread-ownership assertions compiled from "
          "statics/ownership_registry.py (docs/threading.md); 0 = no "
@@ -293,6 +303,12 @@ KNOBS: tuple[Knob, ...] = (
          "0 disables the open-loop agentic load probe (AgentVerse DAG "
          "trace λ sweep; headline = max sustainable λ at >= 99% "
          "TTFT-SLO attainment)."),
+    Knob("BENCH_DISAGG_AB", "bool", "1", "bench.py",
+         "0 disables the disaggregated prefill/decode A/B probe "
+         "(scripts/dev/disagg_ab.py: mixed pool vs 1-prefill+1-decode "
+         "with the KV handoff — capacity knees, decode ITL p99 under a "
+         "long concurrent prefill, exact handoff-counter "
+         "reconciliation)."),
     Knob("BENCH_HYBRID", "bool", "1", "bench.py",
          "0 disables the hybrid on/off A/B series."),
     Knob("BENCH_HYBRID_BUDGET", "int", "256 (tpu) / 48", "bench.py",

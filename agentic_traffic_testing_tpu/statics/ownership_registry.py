@@ -216,6 +216,15 @@ OWNED_ATTRS: tuple[OwnedAttr, ...] = (
     OwnedAttr("EnginePool", "migration_durations", HANDLER,
               "", "checkpoint->adoption duration sample queue (scrape "
               "drains; lock-free deque contract)"),
+    # Disaggregated roles (round 16): parallel to `engines`, resized by
+    # the same scale_to path; routing reads it for the eligibility
+    # filter, scrape reads the counts.
+    OwnedAttr("EnginePool", "roles", HANDLER,
+              "", "per-replica prefill/decode/mixed role list (parallel "
+              "to engines; scale_to appends/pops with it)"),
+    OwnedAttr("EnginePool", "role_overflows", HANDLER,
+              "", "role-filter overflow counts by wanted role (scrape "
+              "reads; a nonzero row means a phase ran outside its tier)"),
     # -- ReplicaHealth (serving/replica_pool.py) -------------------------
     # Written from three contexts by design (engine-thread step outcomes,
     # routing-path watchdog, background probe): every transition holds

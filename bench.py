@@ -1159,6 +1159,45 @@ def main() -> None:
             print(f"bench: agentic_load probe dropped ({e!r})",
                   file=sys.stderr)
 
+    # Disaggregated prefill/decode A/B (round 16): the same agentic
+    # open-loop trace replayed against a 2x mixed pool vs a 1-prefill +
+    # 1-decode pool riding the cross-replica KV handoff, plus a decode-
+    # ITL-under-long-prefill interference probe. The implementation
+    # lives in scripts/dev/disagg_ab.py (the spec_ab pattern — one core,
+    # two callers, no drift). BENCH_DISAGG_AB=0 disables.
+    disagg_on = os.environ.get(
+        "BENCH_DISAGG_AB", "1") not in ("0", "false")
+    disagg_res = None
+    if disagg_on:
+        try:
+            import importlib.util as _da_ilu
+
+            _da_path = os.path.join(os.path.dirname(os.path.abspath(
+                __file__)), "scripts", "dev", "disagg_ab.py")
+            _da_spec = _da_ilu.spec_from_file_location(
+                "_bench_disagg_ab", _da_path)
+            _da = _da_ilu.module_from_spec(_da_spec)
+            _da_spec.loader.exec_module(_da)
+            _da_tpu = platform == "tpu"
+            disagg_res = _da.run_disagg_ab(
+                model=model,
+                dtype="bfloat16" if _da_tpu else "float32",
+                model_cfg=engine.model_cfg, runner=engine.runner,
+                tasks=2, seed=9, max_tokens=24 if _da_tpu else 10,
+                rates=[16.0, 32.0] if _da_tpu else [8.0, 16.0],
+                seats=min(8, batch),
+                long_prefill=8192 if _da_tpu else 96,
+                target=0.99 if _da_tpu else 0.5)
+            if not (disagg_res["disagg_counters_reconcile"]
+                    and disagg_res["mixed_counters_reconcile"]):
+                raise RuntimeError(
+                    "disagg_ab gate: llm_migrations_total{trigger='disagg'}"
+                    " did not reconcile with the replayed records")
+        except Exception as e:
+            disagg_res = None
+            print(f"bench: disagg_ab probe dropped ({e!r})",
+                  file=sys.stderr)
+
     replica_res = None
     if replicas_on:
         try:
@@ -1522,6 +1561,7 @@ def main() -> None:
         **({} if kv_quant_res is None else kv_quant_res),
         **({} if spec_res is None else spec_res),
         **({} if agentic_res is None else agentic_res),
+        **({} if disagg_res is None else disagg_res),
         **({} if prefill_s is None else {
             # Compute-bound half of serving (round-3 flash prefill site).
             # est_mfu counts dense matmul FLOPs (2 * non-embedding params

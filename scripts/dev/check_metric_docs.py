@@ -54,7 +54,8 @@ def registered_families(prefix: str = "llm") -> tuple[set, set]:
     from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
 
     m = LLMMetrics(prefix, include_tokens=True, num_replicas=2,
-                   host_cache=True, vllm_compat=True)
+                   host_cache=True, vllm_compat=True,
+                   pool_roles=("prefill", "decode", "mixed"))
     fams = _scrape_names(m.registry)
     vllm = {f for f in fams if f.startswith("vllm:")}
     return fams - vllm, vllm

@@ -1,0 +1,349 @@
+#!/usr/bin/env python3
+"""Disaggregated prefill/decode serving A/B driver (round 16).
+
+Two pools over the SAME shared runner, same seats, same trace:
+
+  mixed   — 2 mixed replicas (the LLM_POOL_ROLES-unset shape; migration
+            on, so the only config delta between the arms is the roles).
+  disagg  — 1 prefill-role + 1 decode-role replica: every stream
+            prefills on replica 0, hands its KV to replica 1 after the
+            first sampled token (trigger="disagg"), and decodes there.
+
+Per arm, two measurements:
+
+  * the round-15 agentic open-loop λ sweep (synthesized AgentVerse DAG
+    trace, poisson arrivals) → TTFT-attainment capacity knee
+    (`*_max_sustainable_lambda`);
+  * a prefill-interference probe: N decode streams mid-flight, then one
+    LONG prompt (8k-class on TPU, scaled down on CPU) lands — decode
+    ITL p99 over the client-observed token gaps is the headline. On a
+    mixed pool the long prefill stalls its replica's decode batchmates
+    (prefill-priority admission); on the disagg pool the decode tier
+    never sees it.
+
+Gates (machine-checked here and in tests/test_scripts.py):
+
+  * every request terminates, nothing shed/errored in either arm;
+  * EXACT counter reconciliation: the disagg arm's
+    (disagg, adopted) migration count equals the number of streams that
+    outlived their first decode dispatch — each hands off exactly once,
+    finished-at-first-token streams never do — and (disagg, failed) is
+    zero; the mixed arm records zero migrations.
+
+bench.py's `disagg_ab` probe imports `run_disagg_ab` from this file
+(the spec_ab pattern), so the bench arm and this driver can never
+drift while measuring under the same names.
+
+Usage: python scripts/dev/disagg_ab.py [tasks] [max_tokens] [decoders]
+Env: DISAGG_AB_MODEL (default tiny/fp32 on cpu, llama-3.2-1b/bf16 on
+     tpu), DISAGG_AB_RATES (comma λ list, default "8,16" cpu /
+     "16,32" tpu), DISAGG_AB_TARGET (attainment target for the knee,
+     default 0.99 tpu / 0.5 cpu — the tiny-engine knee).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "..", ".."))
+
+MIXED = ("mixed", "mixed")
+DISAGG = ("prefill", "decode")
+
+
+def _percentile(values, q):
+    if not values:
+        return None
+    v = sorted(values)
+    return v[min(len(v) - 1, int(q * len(v)))]
+
+
+def build_pool(roles, *, model, dtype, model_cfg, runner, seats,
+               max_len, num_blocks):
+    """One pool arm; engines share the runner (weights compiled once)."""
+    from agentic_traffic_testing_tpu.runtime.engine import (
+        EngineConfig,
+        LLMEngine,
+    )
+    from agentic_traffic_testing_tpu.serving.replica_pool import EnginePool
+
+    engines = [LLMEngine(EngineConfig(
+        model=model, dtype=dtype, max_num_seqs=seats,
+        max_model_len=max_len, block_size=16, num_blocks=num_blocks,
+        migration=1,
+        disagg_role="" if role == "mixed" else role,
+    ), model_cfg=model_cfg, runner=runner) for role in roles]
+    return EnginePool(engines, policy="round_robin")
+
+
+def first_window(cfg_or_pool, runner) -> int:
+    """Max tokens a stream can emit before the prefill-role handoff hook
+    is guaranteed to have seen it live: the pipelined engine harvests up
+    to `pipeline_depth + 1` in-flight dispatches of `decode_steps`
+    tokens on top of the prefill's first token, so a stream whose budget
+    fits inside that window may finish before the hook runs."""
+    cfg = getattr(cfg_or_pool, "engines", None)
+    pd = (cfg_or_pool.engines[0].cfg.pipeline_depth if cfg
+          else cfg_or_pool.pipeline_depth)
+    return 1 + max(1, getattr(runner, "decode_steps", 1)) * (pd + 1)
+
+
+def reconcile(pool, records, runner) -> dict:
+    """The exact-counter gate. On a disagg pool every stream whose token
+    budget exceeds the first harvest window hands off exactly once, and
+    a stream finishing at its first sampled token never does; budgets
+    INSIDE the window are schedule-dependent (the stream may finish
+    before the handoff hook sees it), so the drivers here keep every
+    budget out of that band — `ambiguous` streams make the gate fail
+    loudly rather than silently fudge. A mixed pool must record zero."""
+    adopted = pool.migrations.get(("disagg", "adopted"), 0)
+    failed = pool.migrations.get(("disagg", "failed"), 0)
+    win = first_window(pool, runner)
+    ambiguous = sum(1 for r in records if 1 < r.n_tokens <= win)
+    expected = (sum(1 for r in records if r.n_tokens > win)
+                if pool.roles_active else 0)
+    return {
+        "migrations_adopted": adopted,
+        "migrations_failed": failed,
+        "expected_handoffs": expected,
+        "counters_reconcile": (failed == 0 and ambiguous == 0
+                               and adopted == expected),
+    }
+
+
+def run_sweep(roles, rates, trace, vocab, **pool_kw) -> tuple:
+    """Replay the trace open-loop at each λ against a FRESH pool (clean
+    per-rate counters); returns (sweep rows, keyed report, reconcile_ok).
+    """
+    from agentic_traffic_testing_tpu.loadgen.replay import (
+        replay_against_engine,
+    )
+
+    sweep, keyed = [], {}
+    reconcile_ok = True
+    adopted_total = 0
+    for lam in rates:
+        pool = build_pool(roles, **pool_kw)
+        try:
+            records, report = replay_against_engine(
+                pool, trace, arrival="poisson", rate=lam, seed=13,
+                vocab_size=vocab)
+        finally:
+            pool.shutdown()
+        if not report["all_terminated"]:
+            raise RuntimeError(
+                f"disagg_ab gate: requests left unterminated at rate "
+                f"{lam}")
+        if report["completed"] != report["requests"]:
+            raise RuntimeError(
+                f"disagg_ab gate: {report['requests'] - report['completed']}"
+                f" request(s) shed/errored at rate {lam} — the A/B must "
+                f"run clean")
+        rec = reconcile(pool, records, pool_kw["runner"])
+        reconcile_ok = reconcile_ok and rec["counters_reconcile"]
+        adopted_total += rec["migrations_adopted"]
+        sweep.append((lam, report))
+        itls = [r.mean_itl_s for r in records
+                if r.status == "ok" and r.mean_itl_s is not None]
+        keyed[f"r{lam:g}_ttft_attainment"] = report["ttft_attainment"]
+        keyed[f"r{lam:g}_goodput_rate"] = report["goodput_rate"]
+        keyed[f"r{lam:g}_itl_p99_s"] = _percentile(itls, 0.99)
+    return sweep, keyed, reconcile_ok, adopted_total
+
+
+def interference_probe(roles, *, decoders, decode_tokens, prefill_len,
+                       vocab, **pool_kw) -> dict:
+    """Decode ITL under a concurrent LONG prefill: start `decoders`
+    streams, wait for every one to reach decode (handed off, on a
+    disagg pool), then land one `prefill_len`-token prompt and keep
+    streaming. Reports the client-observed inter-token-gap p99 of the
+    decode streams and the exact handoff reconciliation."""
+    import asyncio
+
+    import numpy as np
+
+    from agentic_traffic_testing_tpu.runtime.request import SamplingParams
+
+    rng = np.random.default_rng(19)
+    pool_kw = dict(pool_kw)
+    pool_kw["max_len"] = max(pool_kw["max_len"], prefill_len + 64)
+    bs = 16
+    pool_kw["num_blocks"] = max(
+        pool_kw["num_blocks"],
+        2 * (-(-pool_kw["max_len"] // bs) + 4) * (decoders + 2))
+    pool = build_pool(roles, **pool_kw)
+    gaps: list = []
+    n_tokens = {}
+
+    async def decode_stream(i):
+        prompt = rng.integers(10, vocab, 24).tolist()
+        last = None
+        toks = 0
+        async for ev in pool.generate(
+                prompt, SamplingParams(temperature=0.0,
+                                       max_tokens=decode_tokens,
+                                       ignore_eos=True),
+                request_id=f"dec{i}"):
+            now = time.monotonic()
+            if ev.new_token_ids:
+                if last is not None:
+                    gaps.append(now - last)
+                last = now
+                toks += len(ev.new_token_ids)
+        n_tokens[f"dec{i}"] = toks
+
+    # Budget the long request past the first harvest window too, so it
+    # is itself a guaranteed (and exactly counted) handoff.
+    long_tokens = first_window(pool, pool_kw["runner"]) + 2
+
+    async def long_prefill():
+        prompt = rng.integers(10, vocab, prefill_len).tolist()
+        toks = 0
+        async for ev in pool.generate(
+                prompt, SamplingParams(temperature=0.0,
+                                       max_tokens=long_tokens,
+                                       ignore_eos=True),
+                request_id="long"):
+            toks += len(ev.new_token_ids)
+        n_tokens["long"] = toks
+
+    async def go():
+        streams = [asyncio.ensure_future(decode_stream(i))
+                   for i in range(decoders)]
+        # Let every stream clear prefill (and, disaggregated, hand off)
+        # before the interference lands.
+        while not all(f"dec{i}" in n_tokens or gaps for i in
+                      range(decoders)):
+            await asyncio.sleep(0.05)
+            if all(f.done() for f in streams):
+                break
+        lp = asyncio.ensure_future(long_prefill())
+        await asyncio.gather(*streams, lp)
+
+    pool.start()
+    try:
+        asyncio.run(go())
+    finally:
+        pool.shutdown()
+
+    class _Rec:  # reconcile() reads .n_tokens only
+        def __init__(self, n):
+            self.n_tokens = n
+
+    rec = reconcile(pool, [_Rec(n) for n in n_tokens.values()],
+                    pool_kw["runner"])
+    return {
+        "interference_prefill_tokens": prefill_len,
+        "interference_decode_streams": decoders,
+        "interference_itl_p99_s": _percentile(gaps, 0.99),
+        "interference_itl_p50_s": _percentile(gaps, 0.50),
+        **{f"interference_{k}": v for k, v in rec.items()},
+    }
+
+
+def run_disagg_ab(*, model, dtype, model_cfg, runner, tasks=2, seed=9,
+                  max_tokens=10, rates=(8.0, 16.0), seats=4,
+                  long_prefill=96, decoders=3, decode_tokens=24,
+                  target=0.5) -> dict:
+    """The full A/B under one roof — bench.py's `disagg_ab` probe calls
+    exactly this. Returns the flat keyed dict bench merges into its
+    report."""
+    from agentic_traffic_testing_tpu.loadgen.measure import capacity_knee
+    from agentic_traffic_testing_tpu.loadgen.replay import engine_geometry
+    from agentic_traffic_testing_tpu.loadgen.trace import (
+        synthesize_agentverse_trace,
+    )
+
+    from agentic_traffic_testing_tpu.runtime.engine import EngineConfig
+
+    # Keep every stream's budget ABOVE the pipelined first-harvest
+    # window (see first_window): the smallest trace node budget is
+    # max(4, max_tokens // 4), so raise the trace knob until even that
+    # clears the window and the handoff count becomes exactly
+    # predictable from the records.
+    win = first_window(
+        EngineConfig(model=model, dtype=dtype, max_num_seqs=seats,
+                     max_model_len=256, block_size=16, num_blocks=64,
+                     migration=1), runner)
+    max_tokens = max(max_tokens, 4 * (win + 1))
+    decode_tokens = max(decode_tokens, win + 8)
+
+    trace = synthesize_agentverse_trace(tasks=tasks, seed=seed,
+                                        max_tokens=max_tokens)
+    max_len, num_blocks = engine_geometry(trace, seats)
+    pool_kw = dict(model=model, dtype=dtype, model_cfg=model_cfg,
+                   runner=runner, seats=seats, max_len=max_len,
+                   num_blocks=num_blocks)
+    rates = [float(r) for r in rates]
+
+    # Discarded warmup pass (compiles every trace shape off the clock).
+    run_sweep(MIXED, rates[:1], trace, model_cfg.vocab_size, **pool_kw)
+
+    out: dict = {"disagg_ab_rates": rates,
+                 "disagg_ab_trace_nodes": len(trace.nodes)}
+    knees = {}
+    for tag, roles in (("mixed", MIXED), ("disagg", DISAGG)):
+        sweep, keyed, ok, adopted = run_sweep(
+            roles, rates, trace, model_cfg.vocab_size, **pool_kw)
+        knees[tag] = capacity_knee(sweep, target=target)
+        out[f"agentic_load_{tag}_max_sustainable_lambda"] = knees[tag]
+        out[f"{tag}_counters_reconcile"] = ok
+        out[f"{tag}_migrations_adopted"] = adopted
+        out.update({f"{tag}_{k}": v for k, v in keyed.items()})
+        inter = interference_probe(
+            roles, decoders=decoders, decode_tokens=decode_tokens,
+            prefill_len=long_prefill, vocab=model_cfg.vocab_size,
+            **pool_kw)
+        out.update({f"{tag}_{k}": v for k, v in inter.items()})
+    return out
+
+
+def main(argv=None) -> dict:
+    argv = [int(a) for a in (argv if argv is not None else sys.argv[1:])]
+    tasks = argv[0] if len(argv) > 0 else 2
+    max_tokens = argv[1] if len(argv) > 1 else 8
+    decoders = argv[2] if len(argv) > 2 else 3
+
+    import jax
+    import jax.numpy as jnp
+
+    from agentic_traffic_testing_tpu.models.config import resolve_config
+    from agentic_traffic_testing_tpu.models.llama import init_params
+    from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+
+    platform = jax.devices()[0].platform
+    on_tpu = platform == "tpu"
+    model = os.environ.get(
+        "DISAGG_AB_MODEL", "llama-3.2-1b" if on_tpu else "tiny")
+    dtype = "bfloat16" if on_tpu else "float32"
+    rates = [float(r) for r in os.environ.get(
+        "DISAGG_AB_RATES", "16,32" if on_tpu else "8,16").split(",") if r]
+    target = float(os.environ.get(
+        "DISAGG_AB_TARGET", "0.99" if on_tpu else "0.5"))
+
+    model_cfg = resolve_config(model)
+    params = init_params(
+        model_cfg, jax.random.key(0),
+        dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    runner = ModelRunner(model_cfg, params,
+                         decode_steps=16 if on_tpu else 1)
+    print(f"devices: {jax.devices()}  rates={rates}", file=sys.stderr,
+          flush=True)
+    out = run_disagg_ab(
+        model=model, dtype=dtype, model_cfg=model_cfg, runner=runner,
+        tasks=tasks, max_tokens=max_tokens, rates=rates,
+        seats=16 if on_tpu else 4,
+        long_prefill=8192 if on_tpu else 96, decoders=decoders,
+        target=target)
+    print(json.dumps(out, indent=2), flush=True)
+    ok = out["disagg_counters_reconcile"] and out["mixed_counters_reconcile"]
+    return out if ok else (_ for _ in ()).throw(
+        SystemExit("disagg_ab: counter reconciliation failed"))
+
+
+if __name__ == "__main__":
+    main()

@@ -28,11 +28,18 @@ A final `sweep` line reports the clean arms' capacity knee (max λ at
 registry once on an ephemeral port to prove the second exposition
 surface scrapes with every family present.
 
+When invoked as a script the sweep line also lands on disk as
+`BENCH_LOADGEN_rNN.json` at the repo root (next free round index, the
+BENCH_r* naming) so successive soaks accumulate a λ-knee-over-rounds
+trajectory next to the throughput series; in-process callers (tests)
+opt in with SOAK_WRITE_BENCH=1.
+
 Usage: python scripts/dev/loadgen_soak.py [tasks] [max_tokens]
 Env: SOAK_MODEL (default tiny/fp32 on cpu, llama-3.2-1b/bf16 on tpu),
      SOAK_RATES (comma λ list, default "4,8"),
      SOAK_FAULT_SPEC (default "dispatch_error:p=0.1"),
-     SOAK_ATTAINMENT_TARGET (default 0.5 on cpu — the tiny-engine knee).
+     SOAK_ATTAINMENT_TARGET (default 0.5 on cpu — the tiny-engine knee),
+     SOAK_WRITE_BENCH / SOAK_BENCH_DIR (trajectory file, see above).
 """
 
 from __future__ import annotations
@@ -44,6 +51,24 @@ import urllib.request
 
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "..", ".."))
+
+
+def write_bench_trajectory(summary: dict) -> str:
+    """Persist one sweep summary as the next `BENCH_LOADGEN_rNN.json`
+    round at the repo root (or SOAK_BENCH_DIR): the λ-knee trajectory
+    the ISSUE-16 acceptance reads. Rounds are append-only — an existing
+    rNN is never rewritten, so the series stays a history."""
+    root = os.environ.get("SOAK_BENCH_DIR") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "..", "..")
+    n = 1
+    while os.path.exists(
+            os.path.join(root, f"BENCH_LOADGEN_r{n:02d}.json")):
+        n += 1
+    path = os.path.join(root, f"BENCH_LOADGEN_r{n:02d}.json")
+    with open(path, "w") as f:
+        json.dump({"n": n, **summary}, f, indent=2)
+        f.write("\n")
+    return os.path.abspath(path)
 
 
 def run_one(*, chaos: bool, rate: float, trace, runner, model_cfg,
@@ -207,15 +232,23 @@ def main(argv=None) -> list:
         sweep.append((rate, {"ttft_attainment": clean["ttft_attainment"]}))
     summary = {
         "mode": "sweep",
+        "trace": trace.name,
+        "model": model,
         "rates": rates,
         "attainment_target": target,
+        "ttft_attainment_by_rate": {
+            f"{rate:g}": rep["ttft_attainment"] for rate, rep in sweep},
         "max_sustainable_lambda": capacity_knee(sweep, target=target),
         **scrape_loadgen_surface(trace),
     }
     print(json.dumps(summary), flush=True)
     results.append(summary)
+    if os.environ.get("SOAK_WRITE_BENCH", "0") not in ("0", "false"):
+        print(f"trajectory -> {write_bench_trajectory(summary)}",
+              file=sys.stderr, flush=True)
     return results
 
 
 if __name__ == "__main__":
+    os.environ.setdefault("SOAK_WRITE_BENCH", "1")
     main()

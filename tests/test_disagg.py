@@ -1,0 +1,505 @@
+"""Round-16 disaggregated prefill/decode serving suite.
+
+Covers the ISSUE-16 acceptance gates on CPU:
+
+  * handoff identity — a stream prefilled on a prefill-role replica and
+    handed to a decode replica via the disagg trigger completes with its
+    full token sequence byte-for-byte identical to a never-handed-off
+    mixed-pool run (greedy and seeded), for bf16 and int8 KV pools;
+  * EOS mid-batch churn — a request that finishes ON the prefill replica
+    never migrates, while its batchmates each hand off exactly once
+    (counter reconciliation against pool.migrations[("disagg","adopted")]);
+  * degrade paths — a checkpoint failure mid-handoff takes the round-9
+    kill path (structured ERROR, no adoption), and a decode replica with
+    no seat falls back to recompute (the stream still completes
+    identically);
+  * 1-prefill + N-decode async e2e — concurrent streams through the
+    served pool, every output matching its solo reference;
+  * the byte-identity pin — LLM_POOL_ROLES unset leaves the /metrics
+    payload free of every round-16 family and the routing path free of
+    role filtering;
+  * unit coverage for SLO-class admission, PhaseAwareRouter,
+    decide_role_targets, and the loud empty-eligible router overflow
+    (satellite 6).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from agentic_traffic_testing_tpu.models.config import resolve_config
+from agentic_traffic_testing_tpu.models.llama import init_params
+from agentic_traffic_testing_tpu.runtime.engine import EngineConfig, LLMEngine
+from agentic_traffic_testing_tpu.runtime.request import (
+    FinishReason,
+    SamplingParams,
+)
+from agentic_traffic_testing_tpu.runtime.runner import ModelRunner
+from agentic_traffic_testing_tpu.serving.replica_pool import (
+    DISAGG_TRIGGER,
+    EnginePool,
+)
+
+MODEL = "tiny"
+DTYPE = "float32"
+
+
+@pytest.fixture(scope="module")
+def runner():
+    import jax
+    import jax.numpy as jnp
+
+    cfg = resolve_config(MODEL)
+    params = init_params(cfg, jax.random.key(0), dtype=jnp.float32)
+    return cfg, ModelRunner(cfg, params, decode_steps=1)
+
+
+def make_engine(runner, **kw):
+    model_cfg, r = runner
+    defaults = dict(model=MODEL, dtype=DTYPE, max_num_seqs=4,
+                    max_model_len=256, block_size=16, num_blocks=256,
+                    migration=1)
+    defaults.update(kw)
+    return LLMEngine(EngineConfig(**defaults), model_cfg=model_cfg, runner=r)
+
+
+def disagg_pool(runner, decode_replicas=1, **kw):
+    """1 prefill-role replica + N decode-role replicas."""
+    engines = [make_engine(runner, disagg_role="prefill", **kw)]
+    engines += [make_engine(runner, disagg_role="decode", **kw)
+                for _ in range(decode_replicas)]
+    return EnginePool(engines, policy="round_robin")
+
+
+def mixed_pool(runner, n=2, **kw):
+    return EnginePool([make_engine(runner, **kw) for _ in range(n)],
+                      policy="round_robin")
+
+
+def prompts_for(n, length=24, seed=13):
+    wl = np.random.default_rng(seed)
+    return [wl.integers(10, 200, length).tolist() for _ in range(n)]
+
+
+def drive(pool, cap=4000):
+    steps = 0
+    events = []
+    while pool.has_work() and steps < cap:
+        events.extend(pool.step())
+        steps += 1
+    assert steps < cap, "failed to drain (hung requests)"
+    return events
+
+
+def track_finals(events, finals):
+    for ev in events:
+        cur = finals.get(ev.request.request_id)
+        if cur is None or ev.request.sampling_step >= cur.sampling_step:
+            finals[ev.request.request_id] = ev.request
+    return finals
+
+
+def adopted_count(pool, trigger=DISAGG_TRIGGER):
+    return pool.migrations.get((trigger, "adopted"), 0)
+
+
+# --------------------------------------------------------- handoff identity
+
+
+@pytest.mark.parametrize("pool_kw", [
+    dict(dtype="bfloat16"),
+    dict(kv_cache_dtype="int8"),
+], ids=["bf16", "int8"])
+@pytest.mark.parametrize("sampling", [
+    SamplingParams(temperature=0.0, max_tokens=10, ignore_eos=True),
+    SamplingParams(temperature=0.8, top_k=20, seed=11, max_tokens=10,
+                   ignore_eos=True),
+], ids=["greedy", "seeded"])
+def test_disagg_handoff_token_identity(runner, sampling, pool_kw):
+    """The acceptance criterion: a 1-prefill/1-decode pool must produce
+    the exact token streams of a same-size mixed pool that never hands
+    anything off, for bf16 and int8 KV — the handoff rides the migration
+    plane's byte-identical checkpoint/adopt."""
+    import dataclasses
+
+    prompts = prompts_for(2, 40)
+
+    def run(pool):
+        reqs = [pool.add_request(p, dataclasses.replace(sampling),
+                                 request_id=f"h{i}")
+                for i, p in enumerate(prompts)]
+        finals = {r.request_id: r for r in reqs}
+        track_finals(drive(pool), finals)
+        return pool, finals
+
+    _, base = run(mixed_pool(runner, **pool_kw))
+    pool, moved = run(disagg_pool(runner, **pool_kw))
+    assert adopted_count(pool) == len(prompts), pool.migrations
+    assert not pool.migrations.get((DISAGG_TRIGGER, "failed"))
+    for rid, r in moved.items():
+        assert r.is_finished()
+        assert r.finish_reason in (FinishReason.STOP, FinishReason.LENGTH), \
+            (rid, r.finish_reason, r.error)
+        assert r.generated_ids == base[rid].generated_ids, rid
+
+
+def test_disagg_eos_mid_batch_finisher_never_migrates(runner):
+    """EOS churn on the prefill replica: a request that terminates at its
+    first sampled token finishes IN PLACE (the handoff hook skips finished
+    requests), while every longer batchmate hands off exactly once — the
+    adopted counter reconciles to the survivor count exactly."""
+    prompts = prompts_for(4, seed=23)
+
+    def sampling(i):
+        if i == 0:
+            return SamplingParams(temperature=0.0, max_tokens=1)
+        return SamplingParams(temperature=0.0, max_tokens=8,
+                              ignore_eos=True)
+
+    base_pool = mixed_pool(runner)
+    base = {f"e{i}": base_pool.add_request(p, sampling(i),
+                                           request_id=f"e{i}")
+            for i, p in enumerate(prompts)}
+    drive(base_pool)
+
+    pool = disagg_pool(runner)
+    reqs = [pool.add_request(p, sampling(i), request_id=f"e{i}")
+            for i, p in enumerate(prompts)]
+    finals = track_finals(drive(pool), {r.request_id: r for r in reqs})
+    # The 1-token request finished on the prefill replica, untouched.
+    assert finals["e0"].finish_reason is FinishReason.LENGTH
+    assert adopted_count(pool) == len(prompts) - 1, pool.migrations
+    for rid, r in finals.items():
+        assert r.is_finished()
+        assert r.generated_ids == base[rid].generated_ids, rid
+
+
+# ------------------------------------------------------------ degrade paths
+
+
+def test_disagg_checkpoint_failure_takes_kill_path(runner):
+    """migrate_error injected on the prefill replica: the handoff
+    checkpoint fails BEFORE any teardown and the stream degrades to the
+    round-9 structured ERROR terminal — never a silent hang, never a
+    half-moved stream."""
+    engines = [make_engine(runner, disagg_role="prefill",
+                           fault_spec="migrate_error:p=1", fault_seed=17),
+               make_engine(runner, disagg_role="decode")]
+    pool = EnginePool(engines, policy="round_robin")
+    reqs = [pool.add_request(p, SamplingParams(temperature=0.0, max_tokens=8,
+                                               ignore_eos=True))
+            for p in prompts_for(2, seed=29)]
+    finals = track_finals(drive(pool), {r.request_id: r for r in reqs})
+    assert not adopted_count(pool)
+    killed = [r for r in finals.values()
+              if r.finish_reason is FinishReason.ERROR]
+    assert killed, "the injected checkpoint failure must surface"
+    assert any("migration failed" in (r.error or "") for r in killed)
+
+
+def test_disagg_adopt_without_seat_falls_back_to_recompute(runner):
+    """A decode replica whose only seat is occupied refuses the
+    transplant: the handed-off stream re-queues as a recompute and still
+    completes with the mixed-pool tokens (the adoption fallback, not a
+    loss)."""
+    sp = lambda: SamplingParams(temperature=0.0, max_tokens=8,
+                                ignore_eos=True)
+    prompt = prompts_for(1, 40, seed=31)[0]
+    base = make_engine(runner).generate(prompt, sp()).generated_ids
+
+    engines = [make_engine(runner, disagg_role="prefill"),
+               make_engine(runner, disagg_role="decode", max_num_seqs=1)]
+    pool = EnginePool(engines, policy="round_robin")
+    # Occupy the decode replica's only seat before the handoff arrives.
+    blocker = pool.engines[1].add_request(prompts_for(1, 16, seed=32)[0],
+                                          sp())
+    pool.engines[1].step()
+    req = pool.add_request(prompt, sp(), request_id="r0")
+    finals = track_finals(drive(pool), {"r0": req,
+                                        blocker.request_id: blocker})
+    assert adopted_count(pool) == 1  # handed over, then recomputed there
+    assert finals[blocker.request_id].is_finished()
+    moved = finals["r0"]
+    assert moved.is_finished()
+    assert moved.generated_ids == base
+
+
+# ----------------------------------------------------- 1-prefill + N-decode
+
+
+def test_disagg_one_prefill_two_decode_async_e2e(runner):
+    """Async serving path over a 1-prefill + 2-decode pool: concurrent
+    streams each route to the prefill replica, hand off after their first
+    token, and finish on a decode replica identical to their solo
+    reference — MIGRATED terminals never reach a client."""
+    n = 4
+    prompts = prompts_for(n, seed=37)
+    sp = lambda: SamplingParams(temperature=0.0, max_tokens=10,
+                                ignore_eos=True)
+    ref_eng = make_engine(runner)
+    refs = [ref_eng.generate(p, sp()).generated_ids for p in prompts]
+
+    pool = disagg_pool(runner, decode_replicas=2)
+    assert pool.roles == ["prefill", "decode", "decode"]
+    assert pool.role_counts() == {"prefill": 1, "decode": 2, "mixed": 0}
+    pool.start()
+    try:
+        async def one(i):
+            toks = []
+            async for ev in pool.generate(prompts[i], sp(),
+                                          request_id=f"a{i}"):
+                toks.extend(ev.new_token_ids)
+                if ev.finished:
+                    assert ev.request.finish_reason is not \
+                        FinishReason.MIGRATED
+                    assert ev.request.finish_reason in (
+                        FinishReason.STOP, FinishReason.LENGTH), \
+                        ev.request.error
+            return toks
+
+        async def go():
+            return await asyncio.gather(*(one(i) for i in range(n)))
+
+        outs = asyncio.run(go())
+    finally:
+        pool.shutdown()
+    assert outs == refs
+    assert adopted_count(pool) == n, pool.migrations
+    # Fresh work only ever routed to the prefill replica (index 0); the
+    # decode replicas took adoptions, not routes... except adoption
+    # placement also counts as a routing decision (_alternate).
+    assert pool.routed_requests[0] == n
+
+
+# ------------------------------------------------- byte-identity pin (unset)
+
+
+def test_metrics_payload_unchanged_when_roles_unset():
+    """The LLM_POOL_ROLES-unset contract: at ANY replica count the scrape
+    payload carries none of the round-16 families (role gauges, overflow
+    counter, disagg trigger pre-touch, no_eligible_replica shed reason),
+    and constructing LLMMetrics with and without the new parameter is
+    byte-identical."""
+    from prometheus_client import generate_latest
+
+    from agentic_traffic_testing_tpu.serving.metrics import LLMMetrics
+
+    def scrape(m):
+        # _created samples are wall-clock construction timestamps — they
+        # differ between ANY two registries, PR or no PR, so the byte
+        # contract is over everything else.
+        return b"\n".join(l for l in generate_latest(m.registry).split(b"\n")
+                          if b"_created" not in l)
+
+    for n in (1, 2, 3):
+        default = LLMMetrics("llm", include_tokens=True, num_replicas=n,
+                             host_cache=True, vllm_compat=True)
+        explicit = LLMMetrics("llm", include_tokens=True, num_replicas=n,
+                              host_cache=True, vllm_compat=True,
+                              pool_roles=None)
+        payload = scrape(default)
+        assert payload == scrape(explicit)
+        for token in (b"pool_role_replicas", b"role_overflow_total",
+                      b'trigger="disagg"', b'reason="no_eligible_replica"'):
+            assert token not in payload, token
+    # And with roles SET the families (plus their pre-touched series)
+    # appear.
+    roled = LLMMetrics("llm", num_replicas=2,
+                       pool_roles=("prefill", "decode", "mixed"))
+    payload = generate_latest(roled.registry)
+    assert b'llm_pool_role_replicas{role="prefill"}' in payload
+    assert b'llm_role_overflow_total{role="decode"}' in payload
+    assert b'trigger="disagg"' in payload
+    assert b'reason="no_eligible_replica"' in payload
+
+
+def test_roleless_pool_routing_untouched(runner):
+    """All-mixed (the unset shape): roles_active is False, route() never
+    consults the role filter, and the overflow ledger stays empty."""
+    pool = mixed_pool(runner)
+    assert pool.roles == ["mixed", "mixed"]
+    assert not pool.roles_active
+    reqs = [pool.add_request(p, SamplingParams(temperature=0.0,
+                                               max_tokens=2,
+                                               ignore_eos=True))
+            for p in prompts_for(2, seed=41)]
+    drive(pool)
+    assert all(r.is_finished() for r in reqs)
+    assert pool.role_overflows == {}
+    assert pool.migrations == {}
+
+
+# ----------------------------------------------------------- config plumbing
+
+
+def test_pool_roles_config_validation():
+    from agentic_traffic_testing_tpu.serving.config import ServerConfig
+
+    c = ServerConfig(model=MODEL, num_replicas=2, migration=1,
+                     pool_roles="prefill,decode")
+    c._validate_elastic()
+    assert c.parsed_pool_roles() == ("prefill", "decode")
+    assert ServerConfig(model=MODEL).parsed_pool_roles() is None
+
+    with pytest.raises(ValueError, match="entries"):
+        ServerConfig(model=MODEL, num_replicas=2, migration=1,
+                     pool_roles="prefill,turbo")._validate_elastic()
+    with pytest.raises(ValueError, match="NUM_REPLICAS"):
+        ServerConfig(model=MODEL, num_replicas=3, migration=1,
+                     pool_roles="prefill,decode")._validate_elastic()
+    with pytest.raises(ValueError, match="MIGRATION"):
+        ServerConfig(model=MODEL, num_replicas=2, migration=0,
+                     pool_roles="prefill,decode")._validate_elastic()
+    with pytest.raises(ValueError, match="decode"):
+        ServerConfig(model=MODEL, num_replicas=2, migration=1,
+                     pool_roles="prefill,prefill")._validate_elastic()
+
+
+def test_engine_disagg_role_validation():
+    with pytest.raises(ValueError, match="disagg_role"):
+        EngineConfig(disagg_role="turbo")
+    with pytest.raises(ValueError, match="migration=1"):
+        EngineConfig(disagg_role="prefill", migration=0)
+    cfg = EngineConfig(disagg_role="decode", migration=1)
+    assert cfg.scheduler_config().slo_class_admission
+    assert not EngineConfig().scheduler_config().slo_class_admission
+
+
+# ------------------------------------------------------- scheduler admission
+
+
+def test_slo_class_admission_ordering():
+    from agentic_traffic_testing_tpu.runtime.block_allocator import (
+        BlockAllocator,
+    )
+    from agentic_traffic_testing_tpu.runtime.request import Request
+    from agentic_traffic_testing_tpu.runtime.scheduler import (
+        Scheduler,
+        SchedulerConfig,
+    )
+
+    def req(rid, slo):
+        return Request(request_id=rid, prompt_ids=[1, 2, 3],
+                       sampling=SamplingParams(slo_ttft_ms=slo))
+
+    def order(slo_admission, arrivals):
+        cfg = SchedulerConfig(max_num_seqs=4, max_model_len=64,
+                              block_size=16,
+                              slo_class_admission=slo_admission)
+        sched = Scheduler(cfg, BlockAllocator(num_blocks=32, block_size=16))
+        for rid, slo in arrivals:
+            sched.add_request(req(rid, slo))
+        return [r.request_id for r in sched.waiting]
+
+    arrivals = [("a", None), ("b", 500.0), ("c", 100.0), ("d", 500.0),
+                ("e", None), ("f", 100.0)]
+    # Default admission: plain FCFS, byte-identical to append.
+    assert order(False, arrivals) == ["a", "b", "c", "d", "e", "f"]
+    # SLO-class admission: tightest class first, FIFO within a class,
+    # unclassed (None) last.
+    assert order(True, arrivals) == ["c", "f", "b", "d", "a", "e"]
+
+
+# ------------------------------------------------------------ router policy
+
+
+class StubEngine:
+    def __init__(self, waiting=0, running=0, max_num_seqs=4):
+        self.waiting = waiting
+        self.running = running
+        self.max_num_seqs = max_num_seqs
+
+    def load_snapshot(self):
+        return {"num_waiting": self.waiting, "num_running": self.running,
+                "inflight_dispatches": 0, "free_blocks": 64,
+                "max_num_seqs": self.max_num_seqs, "block_size": 8}
+
+
+PROMPT = list(range(100, 132))
+TIGHT = SamplingParams(slo_ttft_ms=100.0)
+LOOSE = SamplingParams()
+
+
+def test_phase_aware_router_slo_vs_best_effort():
+    from agentic_traffic_testing_tpu.serving.router import make_router
+
+    # Replica 0 is shallow but SLOW (high wait EWMA); replica 1 deeper
+    # but fast. Tight-SLO work picks the lowest PROJECTED wait.
+    r = make_router("phase_aware", [StubEngine(waiting=2),
+                                    StubEngine(waiting=3)])
+    r.note_wait(0, 2.0)
+    r.note_wait(1, 0.1)
+    assert r.select(PROMPT, sampling=TIGHT) == 1
+    # With no observations the projection degrades to least-loaded.
+    cold = make_router("phase_aware", [StubEngine(waiting=2),
+                                       StubEngine(waiting=1)])
+    assert cold.select(PROMPT, sampling=TIGHT) == 1
+    # Best-effort work rotates over the UNSATURATED candidates only.
+    r2 = make_router("phase_aware", [StubEngine(waiting=4, max_num_seqs=4),
+                                     StubEngine(), StubEngine()])
+    picks = {r2.select(PROMPT, sampling=LOOSE) for _ in range(4)}
+    assert picks == {1, 2}
+
+
+def test_phase_aware_note_wait_is_an_ewma():
+    from agentic_traffic_testing_tpu.serving.router import PhaseAwareRouter
+
+    r = PhaseAwareRouter([StubEngine()])
+    r.note_wait(0, 1.0)
+    assert r._wait_ewma[0] == 1.0
+    r.note_wait(0, 0.0)
+    assert r._wait_ewma[0] == pytest.approx(0.8)
+
+
+def test_router_empty_eligible_overflows_loudly(caplog):
+    """Satellite 6: an empty eligible set no longer raises — selection
+    overflows to the full replica set with a warning, and the pool's
+    shed policy stays the real overload valve."""
+    import logging
+
+    from agentic_traffic_testing_tpu.serving.router import make_router
+
+    r = make_router("least_loaded", [StubEngine(), StubEngine(waiting=5)])
+    with caplog.at_level(logging.WARNING, logger="att_tpu.router"):
+        assert r.select(PROMPT, eligible=[]) == 0
+    assert any("empty eligible" in m for m in caplog.messages)
+
+
+def test_pool_role_overflow_counted(runner):
+    """A role-restricted pool whose prefill replica is unavailable
+    overflows loudly and counts it (llm_role_overflow_total{role})."""
+    pool = disagg_pool(runner)
+    # Only the decode replica offered: the prefill/mixed filter keeps
+    # nothing and falls back to the full candidate set.
+    assert pool._role_filter([1], ("prefill", "mixed")) == [1]
+    assert pool.role_overflows == {"prefill": 1}
+
+
+# ------------------------------------------------------- per-role autoscale
+
+
+def test_decide_role_targets():
+    from agentic_traffic_testing_tpu.serving.autoscale import (
+        AutoscalePolicy,
+        AutoscaleSignals,
+        decide_role_targets,
+    )
+
+    pol = AutoscalePolicy(min_replicas=1, max_replicas=4)
+    sig = lambda **kw: AutoscaleSignals(**dict(dict(
+        current=1, waiting=0, running=1, met_delta=0, violated_delta=0,
+        idle_ticks=0), **kw))
+    # A prefill backlog grows the prefill tier; an idle decode tier
+    # shrinks no further than one replica.
+    targets = decide_role_targets(
+        {"prefill": sig(waiting=8),
+         "decode": sig(running=0, idle_ticks=5)}, pol)
+    assert targets == {"prefill": 2, "decode": 1}
+    # A role never shrinks below one replica even when pol.min_replicas
+    # would allow the POOL to (per-role floor beats the pool floor).
+    targets = decide_role_targets(
+        {"decode": sig(current=2, running=0, idle_ticks=5)}, pol)
+    assert targets == {"decode": 1}

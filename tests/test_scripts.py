@@ -397,7 +397,7 @@ def test_chaos_ab_smoke(monkeypatch):
 # ------------------------------------------------ loadgen λ-sweep soak
 
 
-def test_loadgen_soak_smoke(monkeypatch):
+def test_loadgen_soak_smoke(monkeypatch, tmp_path):
     """scripts/dev/loadgen_soak.py end-to-end on the tiny model (the
     ISSUE-15 acceptance smoke): the synthesized AgentVerse DAG trace
     replays open-loop at >= 2 arrival rates against an in-process
@@ -409,6 +409,8 @@ def test_loadgen_soak_smoke(monkeypatch):
     config, like chaos_ab)."""
     monkeypatch.setenv("SOAK_MODEL", "tiny")
     monkeypatch.setenv("SOAK_RATES", "6,12")
+    monkeypatch.setenv("SOAK_WRITE_BENCH", "1")
+    monkeypatch.setenv("SOAK_BENCH_DIR", str(tmp_path))
     soak = load_script("scripts/dev/loadgen_soak.py", "loadgen_soak")
     results = soak.main(["1", "5"])
     runs = [r for r in results if r.get("mode") in ("clean", "chaos")]
@@ -428,6 +430,52 @@ def test_loadgen_soak_smoke(monkeypatch):
     assert sweep["rates"] == [6.0, 12.0]
     assert sweep["port_scraped"] is True
     assert sweep["families_present"] is True
+    # λ-knee trajectory (ISSUE-16 satellite): the sweep line landed on
+    # disk as round r01, append-only — a second write takes r02.
+    traj = tmp_path / "BENCH_LOADGEN_r01.json"
+    assert traj.exists()
+    on_disk = json.loads(traj.read_text())
+    assert on_disk["n"] == 1
+    assert on_disk["rates"] == [6.0, 12.0]
+    assert on_disk["max_sustainable_lambda"] == sweep["max_sustainable_lambda"]
+    assert set(on_disk["ttft_attainment_by_rate"]) == {"6", "12"}
+    assert soak.write_bench_trajectory(sweep).endswith(
+        "BENCH_LOADGEN_r02.json")
+
+
+# ------------------------------------------ disaggregated serving A/B
+
+
+def test_disagg_ab_smoke(monkeypatch):
+    """scripts/dev/disagg_ab.py end-to-end on the tiny model (the
+    ISSUE-16 acceptance smoke): the agentic trace replays against a
+    2x mixed pool and a 1-prefill + 1-decode pool over one shared
+    runner, plus the decode-ITL-under-long-prefill interference probe.
+    Structural gates only (CPU wall-clock comparisons are noise in CI):
+    every request terminates in both arms, the disagg arm's adopted
+    handoff count reconciles EXACTLY with the replayed records (and the
+    interference probe's with its stream set), the mixed arm records
+    zero disagg migrations, and both knees and ITL figures land in the
+    report."""
+    monkeypatch.setenv("DISAGG_AB_MODEL", "tiny")
+    monkeypatch.setenv("DISAGG_AB_RATES", "6")
+    ab = load_script("scripts/dev/disagg_ab.py", "disagg_ab")
+    out = ab.main(["1", "6", "2"])
+    assert out["disagg_ab_rates"] == [6.0]
+    assert out["disagg_ab_trace_nodes"] == 12
+    assert out["mixed_counters_reconcile"] is True
+    assert out["disagg_counters_reconcile"] is True
+    assert out["mixed_migrations_adopted"] == 0
+    assert out["disagg_migrations_adopted"] == 12  # every node hands off
+    assert out["mixed_interference_counters_reconcile"] is True
+    assert out["disagg_interference_counters_reconcile"] is True
+    # 2 decode streams + the long-prefill request itself, exactly once.
+    assert out["disagg_interference_migrations_adopted"] == 3
+    assert out["disagg_interference_migrations_failed"] == 0
+    for tag in ("mixed", "disagg"):
+        assert out[f"agentic_load_{tag}_max_sustainable_lambda"] in (None, 6.0)
+        assert out[f"{tag}_interference_itl_p99_s"] > 0
+        assert out[f"{tag}_r6_ttft_attainment"] >= 0
 
 
 # ------------------------------------------------ step-clock timeline dump
